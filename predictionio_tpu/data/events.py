@@ -1,0 +1,427 @@
+"""Event stores: the pluggable backend SPI and built-in backends.
+
+This is the equivalent of the reference's ``LEvents`` / ``PEvents``
+traits plus its HBase/JDBC backends (reference: [U] data/.../storage/
+{LEvents,PEvents}.scala, storage/{hbase,jdbc}/ — unverified, SURVEY.md
+§2a). Differences by design:
+
+- One synchronous SPI (:class:`EventStore`) serves both roles. The
+  reference split "local" (driver-side, async futures) from "parallel"
+  (RDD-producing) access because Spark forced it; on TPU the training
+  path reads events on the host into columnar numpy batches and
+  ``device_put``s them, so a single iterator/scan SPI suffices.
+  Async ingestion concurrency is provided at the HTTP server layer.
+- Backends register in :mod:`predictionio_tpu.storage.registry` by name
+  (no JVM-style reflection): ``MEMORY``, ``SQLITE`` here; the file/
+  native-log backend lives in :mod:`predictionio_tpu.data.filestore`.
+
+Channels: each (app_id, channel_id) pair is an isolated namespace,
+``channel_id=None`` being the default channel, mirroring the reference's
+``pio_event_<appId>(_<channelId>)`` table-per-channel layout.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import sqlite3
+import threading
+from abc import ABC, abstractmethod
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.data.event import (
+    Event,
+    PropertyMap,
+    aggregate_properties,
+    format_event_time,
+    parse_event_time,
+    validate_event,
+)
+
+
+class EventStore(ABC):
+    """Backend SPI for event storage (one namespace per app/channel)."""
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def init_channel(self, app_id: int, channel_id: Optional[int] = None) -> None:
+        """Prepare storage for a namespace (idempotent)."""
+
+    def remove_channel(self, app_id: int, channel_id: Optional[int] = None) -> None:
+        """Drop a namespace entirely."""
+
+    def close(self) -> None:
+        pass
+
+    # -- writes ----------------------------------------------------------------
+
+    @abstractmethod
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        """Insert one event; returns its (possibly generated) eventId."""
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> List[str]:
+        return [self.insert(e, app_id, channel_id) for e in events]
+
+    @abstractmethod
+    def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Delete by id; returns whether it existed."""
+
+    def wipe(self, app_id: int, channel_id: Optional[int] = None) -> None:
+        """Delete all events in the namespace, keeping it usable."""
+        for e in list(self.find(app_id, channel_id)):
+            assert e.event_id is not None
+            self.delete(e.event_id, app_id, channel_id)
+
+    # -- reads -----------------------------------------------------------------
+
+    @abstractmethod
+    def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
+        ...
+
+    @abstractmethod
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        """Scan events ordered by eventTime asc (desc when ``reversed``).
+
+        Filter semantics match the reference's ``LEvents.futureFind``:
+        ``start_time`` inclusive, ``until_time`` exclusive; ``limit=None``
+        means no limit (the HTTP layer applies its default of 20;
+        ``limit=-1`` from the wire also means unlimited).
+        """
+
+    # -- derived ---------------------------------------------------------------
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+    ) -> Dict[str, PropertyMap]:
+        """Fold $set/$unset/$delete into per-entity snapshots.
+
+        Reference: [U] PEvents.aggregateProperties / PEventAggregator.
+        """
+        evs = self.find(
+            app_id,
+            channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            event_names=["$set", "$unset", "$delete"],
+        )
+        return aggregate_properties(evs)
+
+
+def _match(
+    e: Event,
+    start_time: Optional[_dt.datetime],
+    until_time: Optional[_dt.datetime],
+    entity_type: Optional[str],
+    entity_id: Optional[str],
+    event_names: Optional[Sequence[str]],
+    target_entity_type: Optional[str],
+    target_entity_id: Optional[str],
+) -> bool:
+    if start_time is not None and e.event_time < start_time:
+        return False
+    if until_time is not None and e.event_time >= until_time:
+        return False
+    if entity_type is not None and e.entity_type != entity_type:
+        return False
+    if entity_id is not None and e.entity_id != entity_id:
+        return False
+    if event_names is not None and e.event not in event_names:
+        return False
+    if target_entity_type is not None and e.target_entity_type != target_entity_type:
+        return False
+    if target_entity_id is not None and e.target_entity_id != target_entity_id:
+        return False
+    return True
+
+
+class MemoryEventStore(EventStore):
+    """In-process event store (tests, quickstarts, CI)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._data: Dict[Tuple[int, Optional[int]], List[Event]] = {}
+
+    def _ns(self, app_id: int, channel_id: Optional[int]) -> List[Event]:
+        return self._data.setdefault((app_id, channel_id), [])
+
+    def init_channel(self, app_id: int, channel_id: Optional[int] = None) -> None:
+        with self._lock:
+            self._ns(app_id, channel_id)
+
+    def remove_channel(self, app_id: int, channel_id: Optional[int] = None) -> None:
+        with self._lock:
+            self._data.pop((app_id, channel_id), None)
+
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        validate_event(event)
+        event = event.with_id()
+        with self._lock:
+            self._ns(app_id, channel_id).append(event)
+        assert event.event_id is not None
+        return event.event_id
+
+    def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
+        with self._lock:
+            for e in self._ns(app_id, channel_id):
+                if e.event_id == event_id:
+                    return e
+        return None
+
+    def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self._lock:
+            ns = self._ns(app_id, channel_id)
+            for i, e in enumerate(ns):
+                if e.event_id == event_id:
+                    del ns[i]
+                    return True
+        return False
+
+    def wipe(self, app_id: int, channel_id: Optional[int] = None) -> None:
+        with self._lock:
+            self._data[(app_id, channel_id)] = []
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        with self._lock:
+            snapshot = list(self._ns(app_id, channel_id))
+        snapshot.sort(key=lambda e: (e.event_time, e.creation_time), reverse=reversed)
+        n = 0
+        for e in snapshot:
+            if _match(e, start_time, until_time, entity_type, entity_id,
+                      event_names, target_entity_type, target_entity_id):
+                yield e
+                n += 1
+                if limit is not None and limit >= 0 and n >= limit:
+                    return
+
+
+def _ts(dt: _dt.datetime) -> int:
+    """Epoch microseconds (sortable integer key, like the reference's
+    eventTime-based HBase row key)."""
+    return int(dt.timestamp() * 1_000_000)
+
+
+class SqliteEventStore(EventStore):
+    """Durable event store on SQLite.
+
+    Plays the role of the reference's JDBC event backend
+    (``pio_event_<appId>`` tables; [U] storage/jdbc/JDBCEvents.scala):
+    one table per (app, channel) namespace, indexed on eventTime and
+    entity for the two dominant scan shapes (training reads and
+    serving-time entity lookups).
+    """
+
+    def __init__(self, path: str) -> None:
+        self._path = path
+        self._local = threading.local()
+        self._lock = threading.RLock()
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self._path, timeout=30.0)
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = conn
+        return conn
+
+    @staticmethod
+    def _table(app_id: int, channel_id: Optional[int]) -> str:
+        return f"pio_event_{app_id}" + (f"_{channel_id}" if channel_id is not None else "")
+
+    def init_channel(self, app_id: int, channel_id: Optional[int] = None) -> None:
+        t = self._table(app_id, channel_id)
+        c = self._conn()
+        with self._lock:
+            c.execute(
+                f"""CREATE TABLE IF NOT EXISTS {t} (
+                    id TEXT PRIMARY KEY,
+                    event TEXT NOT NULL,
+                    entityType TEXT NOT NULL,
+                    entityId TEXT NOT NULL,
+                    targetEntityType TEXT,
+                    targetEntityId TEXT,
+                    properties TEXT NOT NULL,
+                    eventTime INTEGER NOT NULL,
+                    eventTimeIso TEXT NOT NULL,
+                    tags TEXT NOT NULL,
+                    prId TEXT,
+                    creationTime INTEGER NOT NULL,
+                    creationTimeIso TEXT NOT NULL
+                )"""
+            )
+            c.execute(f"CREATE INDEX IF NOT EXISTS {t}_time ON {t}(eventTime)")
+            c.execute(f"CREATE INDEX IF NOT EXISTS {t}_entity ON {t}(entityType, entityId)")
+            c.execute(f"CREATE INDEX IF NOT EXISTS {t}_name ON {t}(event)")
+            c.commit()
+
+    def remove_channel(self, app_id: int, channel_id: Optional[int] = None) -> None:
+        t = self._table(app_id, channel_id)
+        c = self._conn()
+        with self._lock:
+            c.execute(f"DROP TABLE IF EXISTS {t}")
+            c.commit()
+
+    def _row(self, event: Event) -> Tuple:
+        return (
+            event.event_id,
+            event.event,
+            event.entity_type,
+            event.entity_id,
+            event.target_entity_type,
+            event.target_entity_id,
+            json.dumps(event.properties, separators=(",", ":")),
+            _ts(event.event_time),
+            format_event_time(event.event_time),
+            json.dumps(event.tags),
+            event.pr_id,
+            _ts(event.creation_time),
+            format_event_time(event.creation_time),
+        )
+
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        return self.insert_batch([event], app_id, channel_id)[0]
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> List[str]:
+        t = self._table(app_id, channel_id)
+        rows = []
+        ids = []
+        for e in events:
+            validate_event(e)
+            e = e.with_id()
+            rows.append(self._row(e))
+            ids.append(e.event_id)
+        c = self._conn()
+        with self._lock:
+            self.init_channel(app_id, channel_id)
+            c.executemany(f"INSERT INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)", rows)
+            c.commit()
+        return ids  # type: ignore[return-value]
+
+    @staticmethod
+    def _event_from_row(row: Tuple) -> Event:
+        return Event(
+            event_id=row[0],
+            event=row[1],
+            entity_type=row[2],
+            entity_id=row[3],
+            target_entity_type=row[4],
+            target_entity_id=row[5],
+            properties=json.loads(row[6]),
+            event_time=parse_event_time(row[8]),
+            tags=json.loads(row[9]),
+            pr_id=row[10],
+            creation_time=parse_event_time(row[12]),
+        )
+
+    def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
+        t = self._table(app_id, channel_id)
+        try:
+            cur = self._conn().execute(f"SELECT * FROM {t} WHERE id=?", (event_id,))
+        except sqlite3.OperationalError:
+            return None
+        row = cur.fetchone()
+        return self._event_from_row(row) if row else None
+
+    def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
+        t = self._table(app_id, channel_id)
+        c = self._conn()
+        with self._lock:
+            try:
+                cur = c.execute(f"DELETE FROM {t} WHERE id=?", (event_id,))
+            except sqlite3.OperationalError:
+                return False
+            c.commit()
+        return cur.rowcount > 0
+
+    def wipe(self, app_id: int, channel_id: Optional[int] = None) -> None:
+        t = self._table(app_id, channel_id)
+        c = self._conn()
+        with self._lock:
+            try:
+                c.execute(f"DELETE FROM {t}")
+            except sqlite3.OperationalError:
+                return
+            c.commit()
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        t = self._table(app_id, channel_id)
+        clauses, args = [], []
+        if start_time is not None:
+            clauses.append("eventTime >= ?")
+            args.append(_ts(start_time))
+        if until_time is not None:
+            clauses.append("eventTime < ?")
+            args.append(_ts(until_time))
+        if entity_type is not None:
+            clauses.append("entityType = ?")
+            args.append(entity_type)
+        if entity_id is not None:
+            clauses.append("entityId = ?")
+            args.append(entity_id)
+        if target_entity_type is not None:
+            clauses.append("targetEntityType = ?")
+            args.append(target_entity_type)
+        if target_entity_id is not None:
+            clauses.append("targetEntityId = ?")
+            args.append(target_entity_id)
+        if event_names is not None:
+            clauses.append(f"event IN ({','.join('?' * len(event_names))})")
+            args.extend(event_names)
+        where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
+        order = "DESC" if reversed else "ASC"
+        lim = f" LIMIT {int(limit)}" if (limit is not None and limit >= 0) else ""
+        sql = f"SELECT * FROM {t}{where} ORDER BY eventTime {order}, creationTime {order}{lim}"
+        try:
+            cur = self._conn().execute(sql, args)
+        except sqlite3.OperationalError:
+            return iter(())
+        return (self._event_from_row(r) for r in cur)

@@ -1,0 +1,120 @@
+"""App-facing event access: the stable API templates program against.
+
+Equivalent of the reference's ``PEventStore`` / ``LEventStore`` +
+``Common`` app-name resolution (reference: [U] data/.../store/ —
+unverified, SURVEY.md §2a). Templates call these with an **app name**
+(not id); channel by name. Two access shapes:
+
+- :func:`find` / :func:`aggregate_properties` — bulk reads for training
+  (the reference's ``PEventStore``; instead of producing an RDD they
+  produce Python iterators/dicts that the data pipeline turns into
+  columnar numpy/jax arrays).
+- :func:`find_by_entity` — low-latency point lookups at serving time
+  (the reference's ``LEventStore.findByEntity``, used by the e-commerce
+  template for live business rules).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.data.event import Event, PropertyMap
+from predictionio_tpu.storage.registry import Storage, get_storage
+
+
+def resolve_app_channel(
+    app_name: str, channel_name: Optional[str] = None, storage: Optional[Storage] = None
+) -> Tuple[int, Optional[int]]:
+    st = storage or get_storage()
+    app = st.meta.get_app_by_name(app_name)
+    if app is None:
+        raise ValueError(f"App {app_name!r} does not exist; create it with `pio app new`")
+    channel_id: Optional[int] = None
+    if channel_name:
+        ch = st.meta.get_channel_by_name(app.id, channel_name)
+        if ch is None:
+            raise ValueError(f"Channel {channel_name!r} does not exist in app {app_name!r}")
+        channel_id = ch.id
+    return app.id, channel_id
+
+
+def find(
+    app_name: str,
+    channel_name: Optional[str] = None,
+    start_time: Optional[_dt.datetime] = None,
+    until_time: Optional[_dt.datetime] = None,
+    entity_type: Optional[str] = None,
+    entity_id: Optional[str] = None,
+    event_names: Optional[Sequence[str]] = None,
+    target_entity_type: Optional[str] = None,
+    target_entity_id: Optional[str] = None,
+    limit: Optional[int] = None,
+    reversed: bool = False,
+    storage: Optional[Storage] = None,
+) -> Iterator[Event]:
+    st = storage or get_storage()
+    app_id, channel_id = resolve_app_channel(app_name, channel_name, st)
+    return st.events.find(
+        app_id,
+        channel_id,
+        start_time=start_time,
+        until_time=until_time,
+        entity_type=entity_type,
+        entity_id=entity_id,
+        event_names=event_names,
+        target_entity_type=target_entity_type,
+        target_entity_id=target_entity_id,
+        limit=limit,
+        reversed=reversed,
+    )
+
+
+def aggregate_properties(
+    app_name: str,
+    entity_type: str,
+    channel_name: Optional[str] = None,
+    start_time: Optional[_dt.datetime] = None,
+    until_time: Optional[_dt.datetime] = None,
+    storage: Optional[Storage] = None,
+) -> Dict[str, PropertyMap]:
+    st = storage or get_storage()
+    app_id, channel_id = resolve_app_channel(app_name, channel_name, st)
+    return st.events.aggregate_properties(
+        app_id, entity_type, channel_id, start_time=start_time, until_time=until_time
+    )
+
+
+def find_by_entity(
+    app_name: str,
+    entity_type: str,
+    entity_id: str,
+    channel_name: Optional[str] = None,
+    event_names: Optional[Sequence[str]] = None,
+    target_entity_type: Optional[str] = None,
+    target_entity_id: Optional[str] = None,
+    start_time: Optional[_dt.datetime] = None,
+    until_time: Optional[_dt.datetime] = None,
+    limit: Optional[int] = None,
+    latest: bool = True,
+    storage: Optional[Storage] = None,
+) -> List[Event]:
+    """Serving-time point lookup (reference: LEventStore.findByEntity;
+    `latest` mirrors its newest-first default)."""
+    st = storage or get_storage()
+    app_id, channel_id = resolve_app_channel(app_name, channel_name, st)
+    return list(
+        st.events.find(
+            app_id,
+            channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+            limit=limit,
+            reversed=latest,
+        )
+    )

@@ -1,0 +1,355 @@
+"""Meta-data store: apps, access keys, channels, engine & evaluation instances.
+
+Equivalent of the reference's meta repos (reference: [U] data/.../storage/
+{Apps,AccessKeys,Channels,EngineInstances,EvaluationInstances}.scala —
+unverified, SURVEY.md §2a), collapsed onto a single SQLite database. The
+record shapes mirror the reference's case classes so the CLI verbs
+(``pio app new``, ``pio accesskey list``, …) and the servers behave
+identically; ``spark_conf`` in the reference's ``EngineInstance`` becomes
+``mesh_conf`` (the pjit mesh / compile options used for the run).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import secrets
+import sqlite3
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from predictionio_tpu.data.event import format_event_time, parse_event_time, utcnow
+
+
+@dataclass
+class App:
+    id: int
+    name: str
+    description: str = ""
+
+
+@dataclass
+class AccessKey:
+    key: str
+    app_id: int
+    events: List[str] = field(default_factory=list)  # empty = all events permitted
+
+
+@dataclass
+class Channel:
+    id: int
+    name: str
+    app_id: int
+
+
+@dataclass
+class EngineInstance:
+    """One train run's record; serving loads the latest COMPLETED one."""
+
+    id: str
+    status: str  # INIT | TRAINING | COMPLETED | FAILED
+    start_time: _dt.datetime
+    end_time: Optional[_dt.datetime]
+    engine_factory: str  # "module.path:factory_callable"
+    engine_variant: str
+    batch: str
+    env: Dict[str, str]
+    mesh_conf: Dict[str, Any]
+    data_source_params: str
+    preparator_params: str
+    algorithms_params: str
+    serving_params: str
+
+
+@dataclass
+class EvaluationInstance:
+    id: str
+    status: str
+    start_time: _dt.datetime
+    end_time: Optional[_dt.datetime]
+    evaluation_class: str
+    engine_params_generator_class: str
+    batch: str
+    env: Dict[str, str]
+    evaluator_results: str = ""        # human-readable summary
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""   # structured per-candidate scores
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS apps (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT UNIQUE NOT NULL,
+    description TEXT NOT NULL DEFAULT ''
+);
+CREATE TABLE IF NOT EXISTS access_keys (
+    key TEXT PRIMARY KEY,
+    appid INTEGER NOT NULL,
+    events TEXT NOT NULL DEFAULT '[]'
+);
+CREATE TABLE IF NOT EXISTS channels (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT NOT NULL,
+    appid INTEGER NOT NULL,
+    UNIQUE(name, appid)
+);
+CREATE TABLE IF NOT EXISTS engine_instances (
+    id TEXT PRIMARY KEY,
+    status TEXT NOT NULL,
+    startTime TEXT NOT NULL,
+    endTime TEXT,
+    engineFactory TEXT NOT NULL,
+    engineVariant TEXT NOT NULL DEFAULT '',
+    batch TEXT NOT NULL DEFAULT '',
+    env TEXT NOT NULL DEFAULT '{}',
+    meshConf TEXT NOT NULL DEFAULT '{}',
+    dataSourceParams TEXT NOT NULL DEFAULT '{}',
+    preparatorParams TEXT NOT NULL DEFAULT '{}',
+    algorithmsParams TEXT NOT NULL DEFAULT '[]',
+    servingParams TEXT NOT NULL DEFAULT '{}'
+);
+CREATE TABLE IF NOT EXISTS evaluation_instances (
+    id TEXT PRIMARY KEY,
+    status TEXT NOT NULL,
+    startTime TEXT NOT NULL,
+    endTime TEXT,
+    evaluationClass TEXT NOT NULL,
+    engineParamsGeneratorClass TEXT NOT NULL DEFAULT '',
+    batch TEXT NOT NULL DEFAULT '',
+    env TEXT NOT NULL DEFAULT '{}',
+    evaluatorResults TEXT NOT NULL DEFAULT '',
+    evaluatorResultsHTML TEXT NOT NULL DEFAULT '',
+    evaluatorResultsJSON TEXT NOT NULL DEFAULT ''
+);
+"""
+
+
+class MetaStore:
+    """SQLite-backed meta store (also supports ':memory:' for tests)."""
+
+    def __init__(self, path: str = ":memory:") -> None:
+        self._path = path
+        self._lock = threading.RLock()
+        # ':memory:' must share one connection; files get per-thread conns.
+        self._memory_conn: Optional[sqlite3.Connection] = None
+        self._local = threading.local()
+        if path == ":memory:":
+            self._memory_conn = sqlite3.connect(path, check_same_thread=False)
+        self._init_schema()
+
+    def _conn(self) -> sqlite3.Connection:
+        if self._memory_conn is not None:
+            return self._memory_conn
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self._path, timeout=30.0)
+            conn.execute("PRAGMA journal_mode=WAL")
+            self._local.conn = conn
+        return conn
+
+    def _init_schema(self) -> None:
+        with self._lock:
+            self._conn().executescript(_SCHEMA)
+            self._conn().commit()
+
+    # -- apps ------------------------------------------------------------------
+
+    def create_app(self, name: str, description: str = "") -> App:
+        with self._lock:
+            c = self._conn()
+            cur = c.execute(
+                "INSERT INTO apps(name, description) VALUES (?,?)", (name, description)
+            )
+            c.commit()
+            assert cur.lastrowid is not None
+            return App(id=cur.lastrowid, name=name, description=description)
+
+    def get_app(self, app_id: int) -> Optional[App]:
+        row = self._conn().execute(
+            "SELECT id,name,description FROM apps WHERE id=?", (app_id,)
+        ).fetchone()
+        return App(*row) if row else None
+
+    def get_app_by_name(self, name: str) -> Optional[App]:
+        row = self._conn().execute(
+            "SELECT id,name,description FROM apps WHERE name=?", (name,)
+        ).fetchone()
+        return App(*row) if row else None
+
+    def list_apps(self) -> List[App]:
+        return [App(*r) for r in self._conn().execute(
+            "SELECT id,name,description FROM apps ORDER BY id")]
+
+    def delete_app(self, app_id: int) -> bool:
+        with self._lock:
+            c = self._conn()
+            cur = c.execute("DELETE FROM apps WHERE id=?", (app_id,))
+            c.execute("DELETE FROM access_keys WHERE appid=?", (app_id,))
+            c.execute("DELETE FROM channels WHERE appid=?", (app_id,))
+            c.commit()
+            return cur.rowcount > 0
+
+    # -- access keys -----------------------------------------------------------
+
+    def create_access_key(
+        self, app_id: int, events: Optional[List[str]] = None, key: Optional[str] = None
+    ) -> AccessKey:
+        key = key or secrets.token_urlsafe(48)
+        with self._lock:
+            c = self._conn()
+            c.execute(
+                "INSERT INTO access_keys(key, appid, events) VALUES (?,?,?)",
+                (key, app_id, json.dumps(events or [])),
+            )
+            c.commit()
+        return AccessKey(key=key, app_id=app_id, events=events or [])
+
+    def get_access_key(self, key: str) -> Optional[AccessKey]:
+        row = self._conn().execute(
+            "SELECT key,appid,events FROM access_keys WHERE key=?", (key,)
+        ).fetchone()
+        return AccessKey(row[0], row[1], json.loads(row[2])) if row else None
+
+    def list_access_keys(self, app_id: Optional[int] = None) -> List[AccessKey]:
+        if app_id is None:
+            rows = self._conn().execute("SELECT key,appid,events FROM access_keys")
+        else:
+            rows = self._conn().execute(
+                "SELECT key,appid,events FROM access_keys WHERE appid=?", (app_id,))
+        return [AccessKey(r[0], r[1], json.loads(r[2])) for r in rows]
+
+    def delete_access_key(self, key: str) -> bool:
+        with self._lock:
+            c = self._conn()
+            cur = c.execute("DELETE FROM access_keys WHERE key=?", (key,))
+            c.commit()
+            return cur.rowcount > 0
+
+    # -- channels --------------------------------------------------------------
+
+    def create_channel(self, app_id: int, name: str) -> Channel:
+        with self._lock:
+            c = self._conn()
+            cur = c.execute(
+                "INSERT INTO channels(name, appid) VALUES (?,?)", (name, app_id))
+            c.commit()
+            assert cur.lastrowid is not None
+            return Channel(id=cur.lastrowid, name=name, app_id=app_id)
+
+    def get_channel_by_name(self, app_id: int, name: str) -> Optional[Channel]:
+        row = self._conn().execute(
+            "SELECT id,name,appid FROM channels WHERE appid=? AND name=?",
+            (app_id, name)).fetchone()
+        return Channel(*row) if row else None
+
+    def list_channels(self, app_id: int) -> List[Channel]:
+        return [Channel(*r) for r in self._conn().execute(
+            "SELECT id,name,appid FROM channels WHERE appid=? ORDER BY id", (app_id,))]
+
+    def delete_channel(self, channel_id: int) -> bool:
+        with self._lock:
+            c = self._conn()
+            cur = c.execute("DELETE FROM channels WHERE id=?", (channel_id,))
+            c.commit()
+            return cur.rowcount > 0
+
+    # -- engine instances ------------------------------------------------------
+
+    def insert_engine_instance(self, ei: EngineInstance) -> None:
+        with self._lock:
+            c = self._conn()
+            c.execute(
+                "INSERT OR REPLACE INTO engine_instances VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                (
+                    ei.id, ei.status, format_event_time(ei.start_time),
+                    format_event_time(ei.end_time) if ei.end_time else None,
+                    ei.engine_factory, ei.engine_variant, ei.batch,
+                    json.dumps(ei.env), json.dumps(ei.mesh_conf),
+                    ei.data_source_params, ei.preparator_params,
+                    ei.algorithms_params, ei.serving_params,
+                ),
+            )
+            c.commit()
+
+    @staticmethod
+    def _ei_from_row(r) -> EngineInstance:
+        return EngineInstance(
+            id=r[0], status=r[1],
+            start_time=parse_event_time(r[2]),
+            end_time=parse_event_time(r[3]) if r[3] else None,
+            engine_factory=r[4], engine_variant=r[5], batch=r[6],
+            env=json.loads(r[7]), mesh_conf=json.loads(r[8]),
+            data_source_params=r[9], preparator_params=r[10],
+            algorithms_params=r[11], serving_params=r[12],
+        )
+
+    def get_engine_instance(self, instance_id: str) -> Optional[EngineInstance]:
+        row = self._conn().execute(
+            "SELECT * FROM engine_instances WHERE id=?", (instance_id,)).fetchone()
+        return self._ei_from_row(row) if row else None
+
+    def update_engine_instance(self, ei: EngineInstance) -> None:
+        self.insert_engine_instance(ei)
+
+    def get_latest_completed_engine_instance(
+        self, engine_factory: str, engine_variant: str = ""
+    ) -> Optional[EngineInstance]:
+        """Reference semantics: deploy loads the latest COMPLETED instance
+        for (engineFactory, variant) ([U] EngineInstances.getLatestCompleted)."""
+        q = ("SELECT * FROM engine_instances WHERE status='COMPLETED' "
+             "AND engineFactory=?")
+        args: List[Any] = [engine_factory]
+        if engine_variant:
+            q += " AND engineVariant=?"
+            args.append(engine_variant)
+        q += " ORDER BY startTime DESC LIMIT 1"
+        row = self._conn().execute(q, args).fetchone()
+        return self._ei_from_row(row) if row else None
+
+    def list_engine_instances(self) -> List[EngineInstance]:
+        return [self._ei_from_row(r) for r in self._conn().execute(
+            "SELECT * FROM engine_instances ORDER BY startTime DESC")]
+
+    # -- evaluation instances --------------------------------------------------
+
+    def insert_evaluation_instance(self, vi: EvaluationInstance) -> None:
+        with self._lock:
+            c = self._conn()
+            c.execute(
+                "INSERT OR REPLACE INTO evaluation_instances VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+                (
+                    vi.id, vi.status, format_event_time(vi.start_time),
+                    format_event_time(vi.end_time) if vi.end_time else None,
+                    vi.evaluation_class, vi.engine_params_generator_class,
+                    vi.batch, json.dumps(vi.env), vi.evaluator_results,
+                    vi.evaluator_results_html, vi.evaluator_results_json,
+                ),
+            )
+            c.commit()
+
+    @staticmethod
+    def _vi_from_row(r) -> EvaluationInstance:
+        return EvaluationInstance(
+            id=r[0], status=r[1],
+            start_time=parse_event_time(r[2]),
+            end_time=parse_event_time(r[3]) if r[3] else None,
+            evaluation_class=r[4], engine_params_generator_class=r[5],
+            batch=r[6], env=json.loads(r[7]), evaluator_results=r[8],
+            evaluator_results_html=r[9], evaluator_results_json=r[10],
+        )
+
+    def get_evaluation_instance(self, instance_id: str) -> Optional[EvaluationInstance]:
+        row = self._conn().execute(
+            "SELECT * FROM evaluation_instances WHERE id=?", (instance_id,)).fetchone()
+        return self._vi_from_row(row) if row else None
+
+    def update_evaluation_instance(self, vi: EvaluationInstance) -> None:
+        self.insert_evaluation_instance(vi)
+
+    def list_evaluation_instances(self) -> List[EvaluationInstance]:
+        return [self._vi_from_row(r) for r in self._conn().execute(
+            "SELECT * FROM evaluation_instances ORDER BY startTime DESC")]
+
+    def new_instance_id(self) -> str:
+        return utcnow().strftime("%Y%m%d%H%M%S") + "-" + secrets.token_hex(4)

@@ -1,0 +1,104 @@
+"""Model blob stores.
+
+Equivalent of the reference's ``Models`` repo + LocalFS/HDFS/S3 blob
+backends (reference: [U] data/.../storage/Models.scala, storage/localfs/
+LocalFSModels.scala — unverified, SURVEY.md §2a). A "model" here is an
+opaque byte blob keyed by engine-instance id; algorithms that want
+structured checkpointing (e.g. Orbax for large factor matrices) persist
+through :class:`DirModelStore`-style per-instance directories instead,
+the analogue of the reference's ``PersistentModel`` escape hatch.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+
+class ModelStore(ABC):
+    @abstractmethod
+    def put(self, instance_id: str, blob: bytes) -> None: ...
+
+    @abstractmethod
+    def get(self, instance_id: str) -> Optional[bytes]: ...
+
+    @abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+    @abstractmethod
+    def list_ids(self) -> List[str]: ...
+
+    def model_dir(self, instance_id: str) -> Optional[str]:
+        """Directory for structured per-instance artifacts (PersistentModel
+        analogue); None when the backend has no filesystem locality."""
+        return None
+
+
+class MemoryModelStore(ModelStore):
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._blobs: dict[str, bytes] = {}
+
+    def put(self, instance_id: str, blob: bytes) -> None:
+        with self._lock:
+            self._blobs[instance_id] = blob
+
+    def get(self, instance_id: str) -> Optional[bytes]:
+        return self._blobs.get(instance_id)
+
+    def delete(self, instance_id: str) -> bool:
+        with self._lock:
+            return self._blobs.pop(instance_id, None) is not None
+
+    def list_ids(self) -> List[str]:
+        return sorted(self._blobs)
+
+
+class LocalFSModelStore(ModelStore):
+    """Blobs under ``<root>/<instance_id>/model.bin`` (reference default:
+    ``~/.pio_store/models``); the per-instance directory doubles as the
+    structured-artifact (Orbax checkpoint) location."""
+
+    def __init__(self, root: str) -> None:
+        self._root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _dir(self, instance_id: str) -> str:
+        safe = instance_id.replace("/", "_")
+        return os.path.join(self._root, safe)
+
+    def put(self, instance_id: str, blob: bytes) -> None:
+        d = self._dir(instance_id)
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, ".model.bin.tmp")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, os.path.join(d, "model.bin"))
+
+    def get(self, instance_id: str) -> Optional[bytes]:
+        p = os.path.join(self._dir(instance_id), "model.bin")
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return f.read()
+
+    def delete(self, instance_id: str) -> bool:
+        d = self._dir(instance_id)
+        if os.path.isdir(d):
+            shutil.rmtree(d)
+            return True
+        return False
+
+    def list_ids(self) -> List[str]:
+        return sorted(
+            d for d in os.listdir(self._root)
+            if os.path.isdir(os.path.join(self._root, d))
+        )
+
+    def model_dir(self, instance_id: str) -> str:
+        d = self._dir(instance_id)
+        os.makedirs(d, exist_ok=True)
+        return d

@@ -1,0 +1,176 @@
+"""Backend registry: env-driven selection of meta/event/model stores.
+
+Equivalent of the reference's ``Storage`` object (reference: [U]
+data/.../storage/Storage.scala — unverified, SURVEY.md §2a), which reads
+``PIO_STORAGE_REPOSITORIES_{METADATA,EVENTDATA,MODELDATA}_{NAME,SOURCE}``
+and ``PIO_STORAGE_SOURCES_<S>_{TYPE,...}`` env vars and reflectively
+loads backend jars. Here backends register by TYPE name in a plain dict
+(extensible via ``register_event_backend`` — the Python-entry-points
+replacement for JVM reflection), and the same env var names are honored
+for drop-in familiarity.
+
+Defaults (no env set): everything under ``$PIO_HOME or ~/.pio_store`` —
+SQLite meta DB, SQLITE events, LOCALFS models.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from predictionio_tpu.data.events import EventStore, MemoryEventStore, SqliteEventStore
+from predictionio_tpu.storage.meta import MetaStore
+from predictionio_tpu.storage.models import LocalFSModelStore, MemoryModelStore, ModelStore
+
+
+def pio_home() -> str:
+    return os.environ.get("PIO_HOME") or os.path.join(
+        os.path.expanduser("~"), ".pio_store"
+    )
+
+
+@dataclass
+class StorageConfig:
+    """Resolved storage configuration (one 'source' per repository)."""
+
+    metadata_type: str = "SQLITE"
+    eventdata_type: str = "SQLITE"
+    modeldata_type: str = "LOCALFS"
+    home: str = field(default_factory=pio_home)
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None) -> "StorageConfig":
+        e = dict(os.environ if env is None else env)
+
+        def source_type(repo: str, default: str) -> str:
+            src = e.get(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "")
+            if src:
+                return e.get(f"PIO_STORAGE_SOURCES_{src}_TYPE", default).upper()
+            return default
+
+        return cls(
+            metadata_type=source_type("METADATA", "SQLITE"),
+            eventdata_type=source_type("EVENTDATA", "SQLITE"),
+            modeldata_type=source_type("MODELDATA", "LOCALFS"),
+            home=e.get("PIO_HOME", pio_home()),
+        )
+
+
+_EVENT_BACKENDS: Dict[str, Callable[[StorageConfig], EventStore]] = {}
+_MODEL_BACKENDS: Dict[str, Callable[[StorageConfig], ModelStore]] = {}
+_META_BACKENDS: Dict[str, Callable[[StorageConfig], MetaStore]] = {}
+
+
+def register_event_backend(name: str, factory: Callable[[StorageConfig], EventStore]) -> None:
+    _EVENT_BACKENDS[name.upper()] = factory
+
+
+def register_model_backend(name: str, factory: Callable[[StorageConfig], ModelStore]) -> None:
+    _MODEL_BACKENDS[name.upper()] = factory
+
+
+def register_meta_backend(name: str, factory: Callable[[StorageConfig], MetaStore]) -> None:
+    _META_BACKENDS[name.upper()] = factory
+
+
+register_event_backend("MEMORY", lambda cfg: MemoryEventStore())
+register_event_backend(
+    "SQLITE",
+    lambda cfg: SqliteEventStore(
+        os.path.join(_ensure(cfg.home), "events.db")),
+)
+register_model_backend("MEMORY", lambda cfg: MemoryModelStore())
+register_model_backend(
+    "LOCALFS", lambda cfg: LocalFSModelStore(os.path.join(_ensure(cfg.home), "models"))
+)
+register_meta_backend("MEMORY", lambda cfg: MetaStore(":memory:"))
+register_meta_backend(
+    "SQLITE", lambda cfg: MetaStore(os.path.join(_ensure(cfg.home), "meta.db"))
+)
+
+
+def _ensure(home: str) -> str:
+    os.makedirs(home, exist_ok=True)
+    return home
+
+
+class Storage:
+    """Aggregated handle on the three repositories (lazy singletons)."""
+
+    def __init__(self, config: Optional[StorageConfig] = None) -> None:
+        self.config = config or StorageConfig.from_env()
+        self._lock = threading.Lock()
+        self._meta: Optional[MetaStore] = None
+        self._events: Optional[EventStore] = None
+        self._models: Optional[ModelStore] = None
+
+    @property
+    def meta(self) -> MetaStore:
+        with self._lock:
+            if self._meta is None:
+                try:
+                    factory = _META_BACKENDS[self.config.metadata_type]
+                except KeyError:
+                    raise KeyError(
+                        f"unknown METADATA backend {self.config.metadata_type!r}; "
+                        f"registered: {sorted(_META_BACKENDS)}")
+                self._meta = factory(self.config)
+            return self._meta
+
+    @property
+    def events(self) -> EventStore:
+        with self._lock:
+            if self._events is None:
+                try:
+                    factory = _EVENT_BACKENDS[self.config.eventdata_type]
+                except KeyError:
+                    raise KeyError(
+                        f"unknown EVENTDATA backend {self.config.eventdata_type!r}; "
+                        f"registered: {sorted(_EVENT_BACKENDS)}")
+                self._events = factory(self.config)
+            return self._events
+
+    @property
+    def models(self) -> ModelStore:
+        with self._lock:
+            if self._models is None:
+                try:
+                    factory = _MODEL_BACKENDS[self.config.modeldata_type]
+                except KeyError:
+                    raise KeyError(
+                        f"unknown MODELDATA backend {self.config.modeldata_type!r}; "
+                        f"registered: {sorted(_MODEL_BACKENDS)}")
+                self._models = factory(self.config)
+            return self._models
+
+    def verify(self) -> Dict[str, str]:
+        """Connectivity check for `pio status` (reference: Storage.verifyAllDataObjects)."""
+        out = {}
+        self.meta.list_apps()
+        out["metadata"] = self.config.metadata_type
+        self.events.init_channel(0)
+        out["eventdata"] = self.config.eventdata_type
+        self.models.list_ids()
+        out["modeldata"] = self.config.modeldata_type
+        return out
+
+
+_default: Optional[Storage] = None
+_default_lock = threading.Lock()
+
+
+def get_storage() -> Storage:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Storage()
+        return _default
+
+
+def set_storage(storage: Optional[Storage]) -> None:
+    """Override the process-wide storage (tests, embedded use)."""
+    global _default
+    with _default_lock:
+        _default = storage

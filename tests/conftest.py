@@ -1,0 +1,38 @@
+"""Test harness configuration.
+
+All tests run JAX on CPU with a *virtual 8-device mesh* — the analogue of
+the reference's `SparkContext("local[*]")` trick (SURVEY.md §4): every
+collective / sharding / pjit code path is exercised with real SPMD
+semantics, no TPU required. Must run before jax is first imported.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from predictionio_tpu.storage.meta import MetaStore  # noqa: E402
+from predictionio_tpu.storage.models import MemoryModelStore  # noqa: E402
+from predictionio_tpu.data.events import MemoryEventStore  # noqa: E402
+from predictionio_tpu.storage.registry import Storage, StorageConfig, set_storage  # noqa: E402
+
+
+@pytest.fixture()
+def storage():
+    """A fresh, fully in-memory Storage installed as process default."""
+    st = Storage(StorageConfig(metadata_type="MEMORY",
+                               eventdata_type="MEMORY",
+                               modeldata_type="MEMORY"))
+    # force instantiation so the fixtures are shared instances
+    st._meta = MetaStore(":memory:")
+    st._events = MemoryEventStore()
+    st._models = MemoryModelStore()
+    set_storage(st)
+    yield st
+    set_storage(None)

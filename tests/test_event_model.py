@@ -1,0 +1,130 @@
+"""Event model contract tests (mirrors the reference's event-JSON
+round-trip + DataMapSpec coverage, SURVEY.md §4 Tier 1)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.event import (
+    Event,
+    EventValidationError,
+    aggregate_properties,
+    format_event_time,
+    parse_event_time,
+    validate_event,
+)
+
+
+def _t(s):
+    return parse_event_time(s)
+
+
+class TestWireFormat:
+    def test_round_trip(self):
+        obj = {
+            "event": "rate",
+            "entityType": "user",
+            "entityId": "u1",
+            "targetEntityType": "item",
+            "targetEntityId": "i9",
+            "properties": {"rating": 4.5},
+            "eventTime": "2026-01-02T03:04:05.678+00:00",
+            "tags": ["a", "b"],
+            "prId": "pr-1",
+        }
+        ev = Event.from_json(obj)
+        out = ev.with_id().to_json()
+        assert out["event"] == "rate"
+        assert out["entityType"] == "user"
+        assert out["targetEntityId"] == "i9"
+        assert out["properties"] == {"rating": 4.5}
+        assert out["eventTime"] == "2026-01-02T03:04:05.678+00:00"
+        assert out["tags"] == ["a", "b"]
+        assert out["prId"] == "pr-1"
+        assert out["eventId"]
+
+    def test_z_suffix_and_offsets(self):
+        assert _t("2026-01-01T00:00:00Z") == _t("2026-01-01T00:00:00+00:00")
+        assert _t("2026-01-01T08:00:00+08:00") == _t("2026-01-01T00:00:00Z")
+
+    def test_naive_time_is_utc(self):
+        assert _t("2026-01-01T00:00:00").tzinfo is not None
+
+    def test_missing_required(self):
+        with pytest.raises(EventValidationError):
+            Event.from_json({"event": "x", "entityType": "user"})
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(EventValidationError):
+            Event.from_json({"event": "x", "entityType": "u", "entityId": "1",
+                             "bogus": 1})
+
+    def test_format_millis(self):
+        t = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+        assert format_event_time(t) == "2026-01-01T00:00:00.000+00:00"
+
+
+class TestValidation:
+    def test_reserved_prefix(self):
+        with pytest.raises(EventValidationError):
+            validate_event(Event(event="$foo", entity_type="user", entity_id="1"))
+
+    def test_set_with_target_rejected(self):
+        with pytest.raises(EventValidationError):
+            validate_event(Event(event="$set", entity_type="user", entity_id="1",
+                                 target_entity_type="item", target_entity_id="2"))
+
+    def test_unset_requires_properties(self):
+        with pytest.raises(EventValidationError):
+            validate_event(Event(event="$unset", entity_type="user", entity_id="1"))
+
+    def test_delete_no_properties(self):
+        with pytest.raises(EventValidationError):
+            validate_event(Event(event="$delete", entity_type="user", entity_id="1",
+                                 properties={"a": 1}))
+
+    def test_half_target_rejected(self):
+        with pytest.raises(EventValidationError):
+            validate_event(Event(event="buy", entity_type="user", entity_id="1",
+                                 target_entity_type="item"))
+
+    def test_plain_ok(self):
+        validate_event(Event(event="view", entity_type="user", entity_id="1",
+                             target_entity_type="item", target_entity_id="2"))
+
+
+class TestAggregation:
+    def test_set_unset_delete_fold(self):
+        evs = [
+            Event(event="$set", entity_type="user", entity_id="u1",
+                  properties={"a": 1, "b": 2}, event_time=_t("2026-01-01T00:00:00Z")),
+            Event(event="$set", entity_type="user", entity_id="u1",
+                  properties={"b": 3, "c": 4}, event_time=_t("2026-01-02T00:00:00Z")),
+            Event(event="$unset", entity_type="user", entity_id="u1",
+                  properties={"a": None}, event_time=_t("2026-01-03T00:00:00Z")),
+            Event(event="$set", entity_type="user", entity_id="u2",
+                  properties={"x": 1}, event_time=_t("2026-01-01T00:00:00Z")),
+            Event(event="$delete", entity_type="user", entity_id="u3",
+                  event_time=_t("2026-01-05T00:00:00Z")),
+            Event(event="$set", entity_type="user", entity_id="u3",
+                  properties={"gone": True}, event_time=_t("2026-01-04T00:00:00Z")),
+        ]
+        snap = aggregate_properties(evs)
+        assert snap["u1"].properties == {"b": 3, "c": 4}
+        assert snap["u1"].first_updated == _t("2026-01-01T00:00:00Z")
+        assert snap["u1"].last_updated == _t("2026-01-03T00:00:00Z")
+        assert snap["u2"].properties == {"x": 1}
+        assert "u3" not in snap  # $delete after $set (by eventTime) removes it
+
+    def test_fold_is_by_event_time_not_arrival(self):
+        evs = [
+            Event(event="$set", entity_type="user", entity_id="u",
+                  properties={"v": "late"}, event_time=_t("2026-01-02T00:00:00Z")),
+            Event(event="$set", entity_type="user", entity_id="u",
+                  properties={"v": "early"}, event_time=_t("2026-01-01T00:00:00Z")),
+        ]
+        assert aggregate_properties(evs)["u"].properties == {"v": "late"}
+
+    def test_non_special_ignored(self):
+        evs = [Event(event="view", entity_type="user", entity_id="u")]
+        assert aggregate_properties(evs) == {}

@@ -1,0 +1,112 @@
+"""Event store SPI contract tests, parameterized over backends —
+the analogue of the reference's LEventsSpec/PEventsSpec backend matrix
+(SURVEY.md §4 Tier 1)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.event import Event, parse_event_time
+from predictionio_tpu.data.events import MemoryEventStore, SqliteEventStore
+
+
+def _t(s):
+    return parse_event_time(s)
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def store(request, tmp_path):
+    if request.param == "memory":
+        yield MemoryEventStore()
+    else:
+        yield SqliteEventStore(str(tmp_path / "events.db"))
+
+
+APP = 7
+
+
+def _seed(store):
+    store.init_channel(APP)
+    evs = [
+        Event(event="rate", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1",
+              properties={"rating": 3.0}, event_time=_t("2026-01-01T00:00:00Z")),
+        Event(event="rate", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i2",
+              properties={"rating": 5.0}, event_time=_t("2026-01-02T00:00:00Z")),
+        Event(event="buy", entity_type="user", entity_id="u2",
+              target_entity_type="item", target_entity_id="i1",
+              event_time=_t("2026-01-03T00:00:00Z")),
+        Event(event="$set", entity_type="item", entity_id="i1",
+              properties={"category": "books"}, event_time=_t("2026-01-01T12:00:00Z")),
+    ]
+    return store.insert_batch(evs, APP)
+
+
+class TestCrud:
+    def test_insert_get_delete(self, store):
+        ids = _seed(store)
+        ev = store.get(ids[0], APP)
+        assert ev is not None and ev.properties == {"rating": 3.0}
+        assert store.delete(ids[0], APP) is True
+        assert store.delete(ids[0], APP) is False
+        assert store.get(ids[0], APP) is None
+
+    def test_wipe(self, store):
+        _seed(store)
+        store.wipe(APP)
+        assert list(store.find(APP)) == []
+
+    def test_channel_isolation(self, store):
+        _seed(store)
+        store.init_channel(APP, 3)
+        store.insert(Event(event="view", entity_type="user", entity_id="u9"), APP, 3)
+        assert len(list(store.find(APP, 3))) == 1
+        assert len(list(store.find(APP))) == 4
+
+    def test_app_isolation(self, store):
+        _seed(store)
+        store.init_channel(99)
+        assert list(store.find(99)) == []
+
+
+class TestFind:
+    def test_ordering_and_reversed(self, store):
+        _seed(store)
+        times = [e.event_time for e in store.find(APP)]
+        assert times == sorted(times)
+        rtimes = [e.event_time for e in store.find(APP, reversed=True)]
+        assert rtimes == sorted(rtimes, reverse=True)
+
+    def test_time_range_inclusive_exclusive(self, store):
+        _seed(store)
+        got = list(store.find(APP, start_time=_t("2026-01-02T00:00:00Z"),
+                              until_time=_t("2026-01-03T00:00:00Z")))
+        assert len(got) == 1 and got[0].event == "rate"
+
+    def test_filters(self, store):
+        _seed(store)
+        assert len(list(store.find(APP, event_names=["rate"]))) == 2
+        assert len(list(store.find(APP, entity_type="user", entity_id="u1"))) == 2
+        assert len(list(store.find(APP, target_entity_type="item",
+                                   target_entity_id="i1"))) == 2
+        assert len(list(store.find(APP, limit=1))) == 1
+        assert len(list(store.find(APP, limit=-1))) == 4
+
+    def test_aggregate_properties(self, store):
+        _seed(store)
+        store.insert(Event(event="$set", entity_type="item", entity_id="i1",
+                           properties={"price": 10}, event_time=_t("2026-01-02T00:00:00Z")),
+                     APP)
+        snap = store.aggregate_properties(APP, "item")
+        assert snap["i1"].properties == {"category": "books", "price": 10}
+
+
+class TestSqlitePersistence:
+    def test_reopen(self, tmp_path):
+        p = str(tmp_path / "e.db")
+        s1 = SqliteEventStore(p)
+        s1.init_channel(1)
+        s1.insert(Event(event="view", entity_type="u", entity_id="1"), 1)
+        s2 = SqliteEventStore(p)
+        assert len(list(s2.find(1))) == 1

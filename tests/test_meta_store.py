@@ -1,0 +1,105 @@
+"""Meta store tests: apps/keys/channels CRUD + engine instance lifecycle
+(the reference's basic_app_usecases.py scenario shape, SURVEY.md §4)."""
+
+import pytest
+
+from predictionio_tpu.data.event import utcnow
+from predictionio_tpu.storage.meta import EngineInstance, MetaStore
+from predictionio_tpu.storage.models import LocalFSModelStore, MemoryModelStore
+
+
+@pytest.fixture()
+def meta(tmp_path):
+    return MetaStore(str(tmp_path / "meta.db"))
+
+
+class TestApps:
+    def test_crud(self, meta):
+        app = meta.create_app("MyApp", "desc")
+        assert app.id >= 1
+        assert meta.get_app_by_name("MyApp").id == app.id
+        assert meta.get_app(app.id).name == "MyApp"
+        assert [a.name for a in meta.list_apps()] == ["MyApp"]
+        assert meta.delete_app(app.id) is True
+        assert meta.get_app_by_name("MyApp") is None
+
+    def test_duplicate_name_rejected(self, meta):
+        meta.create_app("A")
+        with pytest.raises(Exception):
+            meta.create_app("A")
+
+
+class TestAccessKeys:
+    def test_generate_and_auth(self, meta):
+        app = meta.create_app("A")
+        ak = meta.create_access_key(app.id)
+        assert len(ak.key) > 20
+        got = meta.get_access_key(ak.key)
+        assert got.app_id == app.id and got.events == []
+        assert meta.get_access_key("nope") is None
+
+    def test_restricted_events(self, meta):
+        app = meta.create_app("A")
+        ak = meta.create_access_key(app.id, events=["rate", "buy"])
+        assert meta.get_access_key(ak.key).events == ["rate", "buy"]
+
+    def test_delete_app_cascades(self, meta):
+        app = meta.create_app("A")
+        ak = meta.create_access_key(app.id)
+        meta.delete_app(app.id)
+        assert meta.get_access_key(ak.key) is None
+
+
+class TestChannels:
+    def test_crud(self, meta):
+        app = meta.create_app("A")
+        ch = meta.create_channel(app.id, "backtest")
+        assert meta.get_channel_by_name(app.id, "backtest").id == ch.id
+        assert len(meta.list_channels(app.id)) == 1
+        assert meta.delete_channel(ch.id) is True
+
+
+class TestEngineInstances:
+    def _mk(self, meta, status="COMPLETED", factory="m:f", variant=""):
+        ei = EngineInstance(
+            id=meta.new_instance_id(), status=status, start_time=utcnow(),
+            end_time=None, engine_factory=factory, engine_variant=variant,
+            batch="", env={}, mesh_conf={"devices": 1},
+            data_source_params="{}", preparator_params="{}",
+            algorithms_params="[]", serving_params="{}")
+        meta.insert_engine_instance(ei)
+        return ei
+
+    def test_latest_completed(self, meta):
+        self._mk(meta, status="FAILED")
+        a = self._mk(meta)
+        import time; time.sleep(0.01)
+        b = self._mk(meta)
+        latest = meta.get_latest_completed_engine_instance("m:f")
+        assert latest.id == b.id
+        assert meta.get_latest_completed_engine_instance("other:f") is None
+
+    def test_update_status(self, meta):
+        ei = self._mk(meta, status="TRAINING")
+        ei.status = "COMPLETED"
+        ei.end_time = utcnow()
+        meta.update_engine_instance(ei)
+        assert meta.get_engine_instance(ei.id).status == "COMPLETED"
+        assert meta.get_engine_instance(ei.id).mesh_conf == {"devices": 1}
+
+
+class TestModelStores:
+    @pytest.mark.parametrize("kind", ["memory", "localfs"])
+    def test_blob_round_trip(self, kind, tmp_path):
+        ms = MemoryModelStore() if kind == "memory" else LocalFSModelStore(str(tmp_path / "m"))
+        ms.put("inst-1", b"\x00\x01binary")
+        assert ms.get("inst-1") == b"\x00\x01binary"
+        assert ms.list_ids() == ["inst-1"]
+        assert ms.delete("inst-1") is True
+        assert ms.get("inst-1") is None
+
+    def test_model_dir(self, tmp_path):
+        ms = LocalFSModelStore(str(tmp_path / "m"))
+        d = ms.model_dir("inst-2")
+        import os
+        assert os.path.isdir(d)
