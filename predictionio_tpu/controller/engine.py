@@ -1,0 +1,171 @@
+"""Engine: binds the four DASE roles + orchestrates train/eval on them.
+
+Reference: [U] core/.../controller/Engine.scala, EngineParams.scala,
+EngineFactory (unverified, SURVEY.md §3.1). An ``Engine`` is assembled
+by a template's ``engine_factory()`` from component *classes*; params
+arrive separately (from ``engine.json``) so the same engine can be
+trained under many parameter variants (`pio eval` grid search).
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+from predictionio_tpu.controller.base import WorkflowContext, params_from_json
+from predictionio_tpu.controller.components import (
+    Algorithm,
+    DataSource,
+    FirstServing,
+    IdentityPreparator,
+    Preparator,
+    Serving,
+)
+
+
+@dataclass
+class EngineParams:
+    """One full parameterization of an engine (reference: EngineParams)."""
+
+    data_source_params: Any = None
+    preparator_params: Any = None
+    # list of (algorithm name, params) — order defines prediction order
+    algorithms_params: List[Tuple[str, Any]] = field(default_factory=list)
+    serving_params: Any = None
+
+
+class Engine:
+    def __init__(
+        self,
+        data_source_cls: Type[DataSource],
+        preparator_cls: Type[Preparator],
+        algorithm_cls_map: Dict[str, Type[Algorithm]],
+        serving_cls: Type[Serving],
+    ) -> None:
+        self.data_source_cls = data_source_cls
+        self.preparator_cls = preparator_cls or IdentityPreparator
+        self.algorithm_cls_map = dict(algorithm_cls_map)
+        self.serving_cls = serving_cls or FirstServing
+
+    # -- params ----------------------------------------------------------------
+
+    def _param_cls(self, component_cls: Type, default: Any = dict) -> Any:
+        return getattr(component_cls, "ParamsClass", default)
+
+    def params_from_variant(self, variant: Dict[str, Any]) -> EngineParams:
+        """Build EngineParams from a parsed engine.json dict (the variant
+        format of the reference: datasource/preparator/algorithms/serving
+        blocks each holding a ``params`` object)."""
+        dsp_json = (variant.get("datasource") or {}).get("params")
+        pp_json = (variant.get("preparator") or {}).get("params")
+        sp_json = (variant.get("serving") or {}).get("params")
+        algos_json = variant.get("algorithms") or []
+        dsp = params_from_json(self._param_cls(self.data_source_cls), dsp_json)
+        pp = params_from_json(self._param_cls(self.preparator_cls), pp_json)
+        sp = params_from_json(self._param_cls(self.serving_cls), sp_json)
+        algos: List[Tuple[str, Any]] = []
+        for block in algos_json:
+            name = block.get("name")
+            if name not in self.algorithm_cls_map:
+                raise ValueError(
+                    f"unknown algorithm {name!r}; engine defines "
+                    f"{sorted(self.algorithm_cls_map)}")
+            acls = self.algorithm_cls_map[name]
+            algos.append((name, params_from_json(self._param_cls(acls), block.get("params"))))
+        if not algos:
+            if len(self.algorithm_cls_map) == 1:
+                # default: sole algorithm with default params
+                name = next(iter(self.algorithm_cls_map))
+                algos = [(name, params_from_json(
+                    self._param_cls(self.algorithm_cls_map[name]), None))]
+            else:
+                raise ValueError(
+                    "engine defines multiple algorithms "
+                    f"({sorted(self.algorithm_cls_map)}); the variant must "
+                    "list which to train in its 'algorithms' block")
+        return EngineParams(dsp, pp, algos, sp)
+
+    def make_algorithms(self, engine_params: EngineParams) -> List[Tuple[str, Algorithm]]:
+        return [
+            (name, self.algorithm_cls_map[name](params))
+            for name, params in engine_params.algorithms_params
+        ]
+
+    # -- train -----------------------------------------------------------------
+
+    def train(self, ctx: WorkflowContext, engine_params: EngineParams) -> List[Any]:
+        """readTraining → prepare → per-algorithm train (reference:
+        Engine.train, SURVEY.md §3.1). Returns models in algorithms order."""
+        ds = self.data_source_cls(engine_params.data_source_params)
+        td = ds.read_training(ctx)
+        ctx.log("read_training done")
+        if ctx.stop_after_read:
+            return []
+        prep = self.preparator_cls(engine_params.preparator_params)
+        pd = prep.prepare(ctx, td)
+        ctx.log("prepare done")
+        if ctx.stop_after_prepare:
+            return []
+        models = []
+        for name, algo in self.make_algorithms(engine_params):
+            if not ctx.skip_sanity_check:
+                algo.sanity_check(pd)
+            ctx.log(f"training algorithm {name!r}")
+            models.append(algo.train(ctx, pd))
+            ctx.log(f"algorithm {name!r} trained")
+        return models
+
+    # -- eval ------------------------------------------------------------------
+
+    def eval(
+        self, ctx: WorkflowContext, engine_params: EngineParams
+    ) -> List[Tuple[Any, List[Tuple[Any, Any, Any]]]]:
+        """Per fold: train on the fold's training split, predict the fold's
+        (query, actual) pairs → ``[(eval_info, [(q, p, a), ...]), ...]``
+        (reference: Engine.eval producing RDD[(Q,P,A)] per fold)."""
+        ds = self.data_source_cls(engine_params.data_source_params)
+        folds = ds.read_eval(ctx)
+        prep = self.preparator_cls(engine_params.preparator_params)
+        serving = self.serving_cls(engine_params.serving_params)
+        results = []
+        for td, eval_info, qa in folds:
+            pd = prep.prepare(ctx, td)
+            algos = self.make_algorithms(engine_params)
+            models = [algo.train(ctx, pd) for _, algo in algos]
+            queries = [serving.supplement(q) for q, _ in qa]
+            per_algo = [
+                algo.batch_predict(model, queries)
+                for (_, algo), model in zip(algos, models)
+            ]
+            qpa = [
+                (q, serving.serve(q, [preds[i] for preds in per_algo]), a)
+                for i, (q, a) in enumerate(zip(queries, (a for _, a in qa)))
+            ]
+            results.append((eval_info, qpa))
+        return results
+
+
+class EngineFactory:
+    """Resolver for ``"module.path:callable"`` engine-factory strings
+    (replaces the reference's reflective EngineFactory lookup)."""
+
+    @staticmethod
+    def resolve(spec: str) -> Callable[[], Engine]:
+        from predictionio_tpu.utils.imports import resolve_spec
+
+        return resolve_spec(spec)
+
+    @staticmethod
+    def create(spec: str) -> Engine:
+        engine = EngineFactory.resolve(spec)()
+        if not isinstance(engine, Engine):
+            raise TypeError(f"engine factory {spec!r} returned {type(engine).__name__}")
+        return engine
+
+
+def load_variant(path: str) -> Dict[str, Any]:
+    """Read an engine.json variant file."""
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)

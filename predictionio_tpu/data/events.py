@@ -177,7 +177,14 @@ class MemoryEventStore(EventStore):
         validate_event(event)
         event = event.with_id()
         with self._lock:
-            self._ns(app_id, channel_id).append(event)
+            ns = self._ns(app_id, channel_id)
+            # overwrite-by-id (HBase put semantics, same as SqliteEventStore)
+            for i, e in enumerate(ns):
+                if e.event_id == event.event_id:
+                    ns[i] = event
+                    break
+            else:
+                ns.append(event)
         assert event.event_id is not None
         return event.event_id
 
@@ -329,7 +336,12 @@ class SqliteEventStore(EventStore):
         c = self._conn()
         with self._lock:
             self.init_channel(app_id, channel_id)
-            c.executemany(f"INSERT INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)", rows)
+            # OR REPLACE: re-inserting an existing eventId overwrites, the
+            # put semantics of the reference's HBase backend — makes
+            # `pio import` of a previously exported dump idempotent
+            c.executemany(
+                f"INSERT OR REPLACE INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                rows)
             c.commit()
         return ids  # type: ignore[return-value]
 

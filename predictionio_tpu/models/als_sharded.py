@@ -1,0 +1,213 @@
+"""Sharded ALS: SPMD over a device mesh via shard_map + ICI collectives.
+
+This is the TPU replacement for MLlib ALS's block-partitioned
+shuffle-join (reference behavior: Spark ALS ``InBlock``/``OutBlock``
+structures exchanged over the shuffle each half-iteration — SURVEY.md
+§2d P2/C1). Layout:
+
+- Users (and items) are range-partitioned into ``n_dev`` equal blocks;
+  each device owns one block of U rows and one of V rows.
+- Ratings are materialized TWICE on the host, pre-partitioned to match:
+  a by-user copy (device d holds exactly the ratings of d's users,
+  sorted by user) and a by-item copy. This replaces the shuffle: the
+  partitioning is done once at data-prep time, not per iteration.
+- Each half-step inside ``shard_map``: one ``all_gather`` of the
+  counterpart factor block over the ``data`` axis (the only collective —
+  riding ICI), then purely local chunked outer-product accumulation and
+  a batched Cholesky solve for the local block.
+- The full iteration loop is a single ``lax.scan`` under one jit: zero
+  host round-trips, 2 all_gathers per iteration of size n·k.
+
+Per-device memory: (block_e, k, k) normal matrices + the full counterpart
+factor matrix — the same asymptotics as MLlib's per-executor blocks.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+from predictionio_tpu.models.als import (
+    ALSParams,
+    RatingsCOO,
+    _choose_chunk,
+    _counts,
+    _solve_psd,
+    init_factors,
+)
+
+
+def _partition_ratings(
+    idx_self: np.ndarray, idx_other: np.ndarray, vals: np.ndarray,
+    block: int, n_dev: int, chunk: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Partition COO by owner device of idx_self; localize indices; pad
+    every partition to the same chunked length.
+
+    Returns arrays of shape [n_dev, n_chunks, C]: (local_self, other,
+    vals, mask).
+    """
+    owner = idx_self // block
+    parts = []
+    max_len = 0
+    for d in range(n_dev):
+        sel = owner == d
+        s = (idx_self[sel] - d * block).astype(np.int32)
+        o = idx_other[sel].astype(np.int32)
+        v = vals[sel].astype(np.float32)
+        order = np.argsort(s, kind="stable")
+        parts.append((s[order], o[order], v[order]))
+        max_len = max(max_len, s.shape[0])
+    padded = max(chunk, ((max_len + chunk - 1) // chunk) * chunk)
+    n_chunks = padded // chunk
+    # pad tail with block-1 (≥ every local index) to keep each chunk's
+    # self-indices sorted — the scatter asserts indices_are_sorted
+    out_s = np.full((n_dev, padded), block - 1, np.int32)
+    out_o = np.zeros((n_dev, padded), np.int32)
+    out_v = np.zeros((n_dev, padded), np.float32)
+    out_m = np.zeros((n_dev, padded), np.float32)
+    for d, (s, o, v) in enumerate(parts):
+        n = s.shape[0]
+        out_s[d, :n] = s
+        out_o[d, :n] = o
+        out_v[d, :n] = v
+        out_m[d, :n] = 1.0
+    shape = (n_dev, n_chunks, chunk)
+    return (out_s.reshape(shape), out_o.reshape(shape),
+            out_v.reshape(shape), out_m.reshape(shape))
+
+
+def _pad_rows(arr: np.ndarray, n: int) -> np.ndarray:
+    if arr.shape[0] == n:
+        return arr
+    pad = np.zeros((n - arr.shape[0],) + arr.shape[1:], arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled_sharded(mesh, n_dev: int, block_u: int, block_i: int,
+                      u_chunk_shape: Tuple[int, int], i_chunk_shape: Tuple[int, int],
+                      rank: int, iterations: int, reg: float, implicit: bool,
+                      alpha: float, weighted_reg: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    try:  # jax>=0.6 moved shard_map out of experimental
+        from jax import shard_map as _sm
+        shard_map = _sm.shard_map if hasattr(_sm, "shard_map") else _sm
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    k = rank
+    eye = jnp.eye(k, dtype=jnp.float32)
+
+    def local_normal_eq(F_full, chunks, n_local):
+        """Accumulate A [n_local,k,k], b [n_local,k] from this device's
+        chunked ratings (idx_self already block-local). Same math as the
+        single-device path via the shared chunk_update."""
+        from predictionio_tpu.models.als import chunk_update
+
+        A0 = jax.lax.pvary(jnp.zeros((n_local, k, k), jnp.float32), "data")
+        b0 = jax.lax.pvary(jnp.zeros((n_local, k), jnp.float32), "data")
+
+        def body(carry, chunk):
+            A, b = chunk_update(*carry, chunk, F_full, implicit, alpha)
+            return (A, b), None
+
+        (A, b), _ = jax.lax.scan(body, (A0, b0), chunks)
+        return A, b
+
+    def reg_term(cnt):
+        lam = reg * cnt if weighted_reg else jnp.full_like(cnt, reg)
+        lam = jnp.where(cnt > 0, jnp.maximum(lam, 1e-8), 1.0)
+        return lam[:, None, None] * eye
+
+    def body(u_s, u_o, u_v, u_m, i_s, i_o, i_v, i_m, cnt_u, cnt_i, V0):
+        # inside shard_map: leading device dim is local size 1 → squeeze
+        u_chunks = (u_s[0], u_o[0], u_v[0], u_m[0])
+        i_chunks = (i_s[0], i_o[0], i_v[0], i_m[0])
+        Ru = reg_term(cnt_u[0])
+        Ri = reg_term(cnt_i[0])
+        V_l = V0  # [block_i, k] local block (spec splits rows)
+
+        def step(carry, _):
+            U_l, V_l = carry
+            V_full = jax.lax.all_gather(V_l, "data", tiled=True)
+            A, b = local_normal_eq(V_full, u_chunks, block_u)
+            if implicit:
+                A = A + (V_full.T @ V_full)[None, :, :]
+            U_l = _solve_psd(A + Ru, b)
+            U_full = jax.lax.all_gather(U_l, "data", tiled=True)
+            A, b = local_normal_eq(U_full, i_chunks, block_i)
+            if implicit:
+                A = A + (U_full.T @ U_full)[None, :, :]
+            V_l = _solve_psd(A + Ri, b)
+            return (U_l, V_l), None
+
+        # mark the carry as varying over the mesh axis (shard_map's vma
+        # typing: the loop-carried factor blocks differ per device)
+        U0_l = jax.lax.pvary(jnp.zeros((block_u, k), jnp.float32), "data")
+        (U_l, V_l), _ = jax.lax.scan(step, (U0_l, V_l), None, length=iterations)
+        return U_l, V_l
+
+    chunked = P("data", None, None)
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(chunked,) * 8 + (P("data", None), P("data", None), P("data", None)),
+        out_specs=(P("data", None), P("data", None)),
+    )
+    return jax.jit(fn)
+
+
+def als_train_sharded(
+    coo: RatingsCOO, p: ALSParams, mesh
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Train ALS over the mesh's ``data`` axis; returns full (U, V)."""
+    import jax
+    import jax.numpy as jnp
+
+    n_dev = int(np.prod(mesh.devices.shape))
+    if "data" not in mesh.axis_names:
+        raise ValueError(f"mesh must have a 'data' axis, got {mesh.axis_names}")
+
+    block_u = -(-coo.n_users // n_dev)  # ceil
+    block_i = -(-coo.n_items // n_dev)
+    n_users_p, n_items_p = block_u * n_dev, block_i * n_dev
+    chunk = _choose_chunk(max(1, coo.nnz // n_dev), p.rank)
+
+    u_parts = _partition_ratings(coo.user_idx, coo.item_idx, coo.rating,
+                                 block_u, n_dev, chunk)
+    i_parts = _partition_ratings(coo.item_idx, coo.user_idx, coo.rating,
+                                 block_i, n_dev, chunk)
+
+    cnt_u = _pad_rows(_counts(coo.user_idx, coo.n_users), n_users_p)
+    cnt_i = _pad_rows(_counts(coo.item_idx, coo.n_items), n_items_p)
+
+    # identical init to the single-device path; padding rows zeroed so
+    # they contribute nothing to the first implicit Gram term
+    V0 = _pad_rows(init_factors(coo.n_items, p.rank, p.seed), n_items_p)
+
+    train = _compiled_sharded(
+        mesh, n_dev, block_u, block_i,
+        u_parts[0].shape[1:], i_parts[0].shape[1:],
+        p.rank, p.iterations, float(p.reg), bool(p.implicit), float(p.alpha),
+        bool(p.weighted_reg))
+
+    # place inputs directly onto the mesh with their shard_map layouts —
+    # never through the default backend (which may be a different
+    # platform, e.g. the tunneled TPU while training on a CPU mesh)
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    chunked = NamedSharding(mesh, P("data", None, None))
+    rows = NamedSharding(mesh, P("data", None))
+
+    args = [jax.device_put(a, chunked) for a in (*u_parts, *i_parts)]
+    args += [jax.device_put(cnt_u.reshape(n_dev, block_u), rows),
+             jax.device_put(cnt_i.reshape(n_dev, block_i), rows),
+             jax.device_put(V0, rows)]
+    U, V = train(*args)
+    return (np.asarray(U)[: coo.n_users], np.asarray(V)[: coo.n_items])

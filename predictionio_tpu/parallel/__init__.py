@@ -1,0 +1,8 @@
+from predictionio_tpu.parallel.mesh import (
+    MeshConfig,
+    make_mesh,
+    shard_batch,
+    replicated,
+)
+
+__all__ = ["MeshConfig", "make_mesh", "shard_batch", "replicated"]

@@ -1,0 +1,166 @@
+"""Engine Server: low-latency query serving on :8000.
+
+Reference: [U] core/.../workflow/CreateServer.scala (MasterActor +
+akka-http; unverified, SURVEY.md §3.2). Routes preserved:
+
+- ``POST /queries.json`` → prediction JSON (the p50-critical path)
+- ``GET  /``             → engine status JSON
+- ``GET  /reload``       → hot-swap to the latest COMPLETED instance
+- ``GET  /stop``         → shut the server down
+- ``GET  /plugins.json`` + ``/plugins/{name}/{path}`` → plugin surface
+
+TPU-first serving design: the model stays resident (factor matrices /
+params as device arrays), prediction runs on a worker thread pool so the
+asyncio loop never blocks on device dispatch, and the optional feedback
+loop posts served (query, prediction, prId) back to the event store —
+the reference's feedback mechanism — without touching the hot path
+(fire-and-forget task).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import uuid
+from typing import Any, Dict, List, Optional
+
+from predictionio_tpu.core.plugins import engine_server_plugins
+from predictionio_tpu.core.workflow import DeployedEngine, prepare_deploy
+from predictionio_tpu.data.event import Event, utcnow
+from predictionio_tpu.server.http import HTTPServer, Request, Response, Router
+from predictionio_tpu.storage.registry import Storage, get_storage
+
+
+class EngineServer:
+    def __init__(
+        self,
+        engine_factory: Optional[str] = None,
+        instance_id: Optional[str] = None,
+        storage: Optional[Storage] = None,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        variant_id: str = "",
+        feedback: bool = False,
+        feedback_app_name: Optional[str] = None,
+        plugins: Optional[List[Any]] = None,
+    ) -> None:
+        self.storage = storage or get_storage()
+        self.engine_factory = engine_factory
+        self.variant_id = variant_id
+        self.feedback = feedback
+        self.feedback_app_name = feedback_app_name
+        self.plugins = plugins if plugins is not None else engine_server_plugins()
+        self.deployed: DeployedEngine = prepare_deploy(
+            engine_factory=engine_factory, instance_id=instance_id,
+            storage=self.storage, variant_id=variant_id)
+        self.start_time = utcnow()
+        self.query_count = 0
+        router = Router()
+        router.route("POST", "/queries.json", self._queries)
+        router.route("GET", "/", self._status)
+        router.route("GET", "/reload", self._reload)
+        router.route("GET", "/stop", self._stop)
+        router.route("GET", "/plugins.json", self._plugins_list)
+        router.route("GET", "/plugins/{name}/{path+}", self._plugin_route)
+        router.route("POST", "/plugins/{name}/{path+}", self._plugin_route)
+        self.http = HTTPServer(router, host, port)
+
+    # -- handlers --------------------------------------------------------------
+
+    async def _queries(self, req: Request) -> Response:
+        try:
+            query = req.json()
+        except json.JSONDecodeError as e:
+            return Response.json({"message": f"invalid JSON: {e}"}, status=400)
+        if query is None:
+            return Response.json({"message": "empty query"}, status=400)
+        try:
+            prediction = await asyncio.to_thread(self.deployed.query, query)
+        except Exception as e:
+            return Response.json(
+                {"message": f"query failed: {type(e).__name__}: {e}"}, status=400)
+        for p in self.plugins:
+            prediction = p.output_blocker(query, prediction)
+            p.output_sniffer(query, prediction)
+        self.query_count += 1
+        if self.feedback:
+            pr_id = uuid.uuid4().hex
+            if isinstance(prediction, dict):
+                prediction = {**prediction, "prId": pr_id}
+            asyncio.get_running_loop().create_task(
+                asyncio.to_thread(self._record_feedback, query, prediction, pr_id))
+        return Response.json(prediction)
+
+    def _record_feedback(self, query: Any, prediction: Any, pr_id: str) -> None:
+        """Feedback loop: persist served predictions as 'predict' events
+        tagged with prId (reference: CreateServer feedback to the Event
+        Server; here it writes through the same event store)."""
+        try:
+            app_name = self.feedback_app_name
+            if not app_name:
+                dsp = json.loads(self.deployed.instance.data_source_params)
+                app_name = dsp.get("app_name") or dsp.get("appName")
+            if not app_name:
+                return
+            app = self.storage.meta.get_app_by_name(app_name)
+            if app is None:
+                return
+            self.storage.events.insert(Event(
+                event="predict",
+                entity_type="pio_pr", entity_id=pr_id,
+                properties={"query": query, "prediction": prediction},
+                pr_id=pr_id,
+            ), app.id)
+        except Exception:
+            pass  # feedback must never break serving
+
+    async def _status(self, req: Request) -> Response:
+        ei = self.deployed.instance
+        return Response.json({
+            "status": "alive",
+            "engineFactory": ei.engine_factory,
+            "engineInstanceId": ei.id,
+            "engineVariant": ei.engine_variant,
+            "startTime": self.start_time.isoformat(timespec="milliseconds"),
+            "queryCount": self.query_count,
+            "algorithms": [name for name, _ in self.deployed.algorithms],
+        })
+
+    async def _reload(self, req: Request) -> Response:
+        """Hot-swap to the latest COMPLETED instance (reference: /reload)."""
+        factory = self.engine_factory or self.deployed.instance.engine_factory
+        try:
+            new = await asyncio.to_thread(
+                prepare_deploy, factory, None, self.storage, self.variant_id)
+        except Exception as e:
+            return Response.json({"message": f"reload failed: {e}"}, status=500)
+        self.deployed = new
+        return Response.json({"message": "Reloaded",
+                              "engineInstanceId": new.instance.id})
+
+    async def _stop(self, req: Request) -> Response:
+        asyncio.get_running_loop().call_later(0.05, self.http.request_shutdown)
+        return Response.json({"message": "Shutting down"})
+
+    async def _plugins_list(self, req: Request) -> Response:
+        return Response.json({"plugins": {
+            "outputblockers": [p.name for p in self.plugins],
+            "outputsniffers": [p.name for p in self.plugins],
+        }})
+
+    async def _plugin_route(self, req: Request) -> Response:
+        name = req.path_params["name"]
+        for p in self.plugins:
+            if p.name == name:
+                body = req.json() if req.body else None
+                out = p.handle_route(req.path_params["path"], body)
+                return Response.json(out)
+        return Response.json({"message": f"no plugin {name!r}"}, status=404)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def serve_forever(self) -> None:
+        await self.http.serve_forever()
+
+    def run(self) -> None:
+        asyncio.run(self.serve_forever())

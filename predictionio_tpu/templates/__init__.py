@@ -1,0 +1,14 @@
+"""Built-in engine templates.
+
+Equivalent of the reference's ``examples/scala-parallel-*`` templates
+(SURVEY.md §2c) — the behavioral test suite of the framework. Each
+template module exposes ``engine_factory()`` plus its DASE component
+classes, and ships an ``engine.json`` the CLI can copy into a new
+engine directory (``pio template new <name> <dir>``).
+"""
+
+# grown as templates land; `pio template list` reflects exactly this dict
+TEMPLATES = {
+    "recommendation": "predictionio_tpu.templates.recommendation.engine",
+    "vanilla": "predictionio_tpu.templates.vanilla.engine",
+}

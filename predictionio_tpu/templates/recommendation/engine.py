@@ -1,0 +1,222 @@
+"""Recommendation template: ALS collaborative filtering.
+
+Behavioral equivalent of the reference's quickstart template
+(reference: [U] examples/scala-parallel-recommendation/ — DataSource
+reads "rate"/"buy" events into Ratings, ALSAlgorithm wraps MLlib
+``ALS.train`` into an ALSModel with user/item BiMaps, Serving = first;
+SURVEY.md §2c). Query/response wire shapes match the reference:
+
+    POST /queries.json  {"user": "1", "num": 4}
+    → {"itemScores": [{"item": "22", "score": 4.5}, ...]}
+
+The compute is :mod:`predictionio_tpu.models.als` (JAX, mesh-aware).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    Preparator,
+    WorkflowContext,
+)
+from predictionio_tpu.data import store as event_store
+from predictionio_tpu.models.als import (
+    ALSParams,
+    RatingsCOO,
+    als_train,
+    recommend,
+)
+from predictionio_tpu.utils.bimap import BiMap
+
+
+@dataclass
+class Rating:
+    user: str
+    item: str
+    rating: float
+
+
+@dataclass
+class TrainingData:
+    ratings: List[Rating]
+
+
+@dataclass
+class DataSourceParams:
+    app_name: str = ""
+    event_names: List[str] = field(default_factory=lambda: ["rate", "buy"])
+    # rating assigned to implicit "buy" events (reference quickstart: 4.0)
+    buy_rating: float = 4.0
+    eval_k: int = 0          # >0 enables read_eval with k folds
+    eval_seed: int = 3
+
+
+class RecDataSource(DataSource):
+    ParamsClass = DataSourceParams
+
+    def _read_ratings(self, ctx: WorkflowContext) -> List[Rating]:
+        p: DataSourceParams = self.params
+        out: List[Rating] = []
+        for e in event_store.find(
+            p.app_name,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=p.event_names,
+            storage=ctx.storage,
+        ):
+            if e.event == "rate":
+                try:
+                    r = float(e.properties["rating"])
+                except (KeyError, TypeError, ValueError):
+                    continue
+            else:  # implicit positive event ("buy")
+                r = p.buy_rating
+            assert e.target_entity_id is not None
+            out.append(Rating(e.entity_id, e.target_entity_id, r))
+        return out
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        ratings = self._read_ratings(ctx)
+        if not ratings:
+            raise ValueError(
+                "no rate/buy events found; import events before `pio train`")
+        return TrainingData(ratings)
+
+    def read_eval(self, ctx: WorkflowContext):
+        p: DataSourceParams = self.params
+        if p.eval_k <= 0:
+            raise ValueError("set dataSourceParams.evalK > 0 to evaluate")
+        ratings = self._read_ratings(ctx)
+        rng = np.random.default_rng(p.eval_seed)
+        fold_of = rng.integers(0, p.eval_k, size=len(ratings))
+        folds = []
+        for f in range(p.eval_k):
+            train = TrainingData([r for r, g in zip(ratings, fold_of) if g != f])
+            test = [r for r, g in zip(ratings, fold_of) if g == f]
+            qa = [({"user": r.user, "item": r.item, "num": 1}, r.rating) for r in test]
+            folds.append((train, {"fold": f}, qa))
+        return folds
+
+
+class RecPreparator(Preparator):
+    """Pass-through (reference quickstart Preparator)."""
+
+    def prepare(self, ctx: WorkflowContext, training_data: TrainingData) -> TrainingData:
+        return training_data
+
+
+@dataclass
+class ALSAlgorithmParams:
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    seed: Optional[int] = None
+    implicit_prefs: bool = False
+    alpha: float = 1.0
+
+
+class ALSModel:
+    """Resident serving model: factor matrices + id↔index BiMaps."""
+
+    def __init__(self, U: np.ndarray, V: np.ndarray,
+                 user_ids: BiMap, item_ids: BiMap) -> None:
+        self.U = U
+        self.V = V
+        self.user_ids = user_ids
+        self.item_ids = item_ids
+        self._item_inv = item_ids.inverse()
+
+    def recommend_products(self, user: str, num: int) -> List[Dict[str, Any]]:
+        uidx = self.user_ids.get(user)
+        if uidx is None:
+            return []
+        top, scores = recommend(self.U, self.V, uidx, num)
+        return [
+            {"item": self._item_inv[int(i)], "score": float(s)}
+            for i, s in zip(top, scores)
+        ]
+
+    def predict_rating(self, user: str, item: str) -> Optional[float]:
+        uidx = self.user_ids.get(user)
+        iidx = self.item_ids.get(item)
+        if uidx is None or iidx is None:
+            return None
+        return float(self.U[uidx] @ self.V[iidx])
+
+
+class ALSAlgorithm(Algorithm):
+    ParamsClass = ALSAlgorithmParams
+
+    def sanity_check(self, data: TrainingData) -> None:
+        if not data.ratings:
+            raise ValueError("empty TrainingData.ratings")
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> ALSModel:
+        p: ALSAlgorithmParams = self.params
+        user_ids = BiMap.string_int(r.user for r in pd.ratings)
+        item_ids = BiMap.string_int(r.item for r in pd.ratings)
+        coo = RatingsCOO(
+            user_idx=np.fromiter((user_ids[r.user] for r in pd.ratings),
+                                 np.int32, len(pd.ratings)),
+            item_idx=np.fromiter((item_ids[r.item] for r in pd.ratings),
+                                 np.int32, len(pd.ratings)),
+            rating=np.fromiter((r.rating for r in pd.ratings),
+                               np.float32, len(pd.ratings)),
+            n_users=len(user_ids),
+            n_items=len(item_ids),
+        )
+        U, V = als_train(
+            coo,
+            ALSParams(
+                rank=p.rank, iterations=p.num_iterations, reg=p.lambda_,
+                implicit=p.implicit_prefs, alpha=p.alpha,
+                seed=0 if p.seed is None else p.seed,
+            ),
+            mesh=ctx.mesh,
+        )
+        return ALSModel(U, V, user_ids, item_ids)
+
+    def predict(self, model: ALSModel, query: Dict[str, Any]) -> Dict[str, Any]:
+        user = str(query["user"])
+        if "item" in query:  # rating-prediction shape (used by evaluation)
+            r = model.predict_rating(user, str(query["item"]))
+            return {"itemScores": (
+                [{"item": str(query["item"]), "score": r}] if r is not None else [])}
+        num = int(query.get("num", 10))
+        return {"itemScores": model.recommend_products(user, num)}
+
+    # structured persistence: npz for factors (compact, zero-copy load)
+    def save_model(self, model: ALSModel, instance_dir: Optional[str]) -> bytes:
+        buf = io.BytesIO()
+        np.savez_compressed(buf, U=model.U, V=model.V)
+        return pickle.dumps({
+            "npz": buf.getvalue(),
+            "user_ids": model.user_ids.to_dict(),
+            "item_ids": model.item_ids.to_dict(),
+        })
+
+    def load_model(self, blob: Optional[bytes], instance_dir: Optional[str]) -> ALSModel:
+        assert blob is not None
+        d = pickle.loads(blob)
+        arrs = np.load(io.BytesIO(d["npz"]))
+        return ALSModel(arrs["U"], arrs["V"],
+                        BiMap(d["user_ids"]), BiMap(d["item_ids"]))
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_cls=RecDataSource,
+        preparator_cls=RecPreparator,
+        algorithm_cls_map={"als": ALSAlgorithm},
+        serving_cls=FirstServing,
+    )

@@ -1,0 +1,57 @@
+"""Event export/import: events ↔ JSONL files.
+
+Reference: [U] tools/.../export/EventsToFile.scala and
+tools/.../imprt/FileToEvents.scala (Spark jobs; unverified, SURVEY.md
+§2a). Here: streaming host-side JSONL, one event per line in the wire
+format — the same file shape the reference produced, so existing data
+dumps port over directly.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, TextIO
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.storage.registry import Storage, get_storage
+
+BATCH = 1000
+
+
+def export_events(
+    app_id: int,
+    out: TextIO,
+    channel_id: Optional[int] = None,
+    storage: Optional[Storage] = None,
+) -> int:
+    st = storage or get_storage()
+    n = 0
+    for ev in st.events.find(app_id, channel_id):
+        out.write(ev.to_json_str() + "\n")
+        n += 1
+    return n
+
+
+def import_events(
+    app_id: int,
+    src: TextIO,
+    channel_id: Optional[int] = None,
+    storage: Optional[Storage] = None,
+) -> int:
+    st = storage or get_storage()
+    st.events.init_channel(app_id, channel_id)
+    n = 0
+    batch = []
+    for line in src:
+        line = line.strip()
+        if not line:
+            continue
+        batch.append(Event.from_json(json.loads(line)))
+        if len(batch) >= BATCH:
+            st.events.insert_batch(batch, app_id, channel_id)
+            n += len(batch)
+            batch = []
+    if batch:
+        st.events.insert_batch(batch, app_id, channel_id)
+        n += len(batch)
+    return n

@@ -3,19 +3,34 @@
 All tests run JAX on CPU with a *virtual 8-device mesh* — the analogue of
 the reference's `SparkContext("local[*]")` trick (SURVEY.md §4): every
 collective / sharding / pjit code path is exercised with real SPMD
-semantics, no TPU required. Must run before jax is first imported.
+semantics, no TPU required.
+
+Environment note: this image's sitecustomize imports jax and registers
+the TPU ("axon") backend at interpreter startup, so JAX_PLATFORMS is
+decided before conftest runs. The CPU client, however, is created
+lazily — setting XLA_FLAGS here (before anything calls
+jax.devices("cpu")) still yields the 8 virtual CPU devices, and
+PIO_MESH_PLATFORM=cpu points the framework's mesh construction at them.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["PIO_MESH_PLATFORM"] = "cpu"
 
+import jax  # noqa: E402
 import pytest  # noqa: E402
+
+from predictionio_tpu.parallel.mesh import platform_devices  # noqa: E402
+
+# route default (non-mesh) computations to CPU too — tests must not
+# depend on the tunneled TPU chip (platform_devices tolerates a broken
+# TPU/axon backend by restricting jax to cpu)
+jax.config.update("jax_default_device", platform_devices("cpu")[0])
 
 from predictionio_tpu.storage.meta import MetaStore  # noqa: E402
 from predictionio_tpu.storage.models import MemoryModelStore  # noqa: E402
@@ -36,3 +51,11 @@ def storage():
     set_storage(st)
     yield st
     set_storage(None)
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh():
+    """8-virtual-device CPU mesh for collective/sharding tests."""
+    from predictionio_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    return make_mesh(MeshConfig(axes={"data": 8}))

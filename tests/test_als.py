@@ -1,0 +1,124 @@
+"""ALS numerics: convergence, implicit feedback, and single↔sharded
+parity on the 8-device CPU mesh (ICI-collective semantics in CI,
+SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models.als import (
+    ALSParams,
+    RatingsCOO,
+    als_train,
+    predict_ratings,
+    recommend,
+    similar_items,
+)
+
+
+@pytest.fixture(scope="module")
+def synthetic():
+    rng = np.random.default_rng(0)
+    n_u, n_i, k_true = 100, 70, 5
+    U = rng.normal(size=(n_u, k_true))
+    V = rng.normal(size=(n_i, k_true))
+    R = U @ V.T
+    mask = rng.random((n_u, n_i)) < 0.3
+    uu, ii = np.nonzero(mask)
+    coo = RatingsCOO(uu.astype(np.int32), ii.astype(np.int32),
+                     R[uu, ii].astype(np.float32), n_u, n_i)
+    return coo, R, mask
+
+
+class TestSingleDevice:
+    def test_convergence(self, synthetic):
+        coo, R, mask = synthetic
+        U, V = als_train(coo, ALSParams(rank=8, iterations=12, reg=0.05))
+        pred = predict_ratings(U, V, coo.user_idx, coo.item_idx)
+        rmse = float(np.sqrt(np.mean((pred - coo.rating) ** 2)))
+        assert rmse < 0.3, rmse
+        # held-out generalization beats predicting the mean
+        huu, hii = np.nonzero(~mask)
+        hrmse = float(np.sqrt(np.mean(
+            (predict_ratings(U, V, huu, hii) - R[huu, hii]) ** 2)))
+        assert hrmse < R.std()
+
+    def test_implicit_finite_and_ranks_positives_high(self, synthetic):
+        coo, R, _ = synthetic
+        pos = RatingsCOO(coo.user_idx, coo.item_idx,
+                         np.abs(coo.rating), coo.n_users, coo.n_items)
+        U, V = als_train(pos, ALSParams(rank=8, iterations=8, reg=0.05,
+                                        implicit=True, alpha=2.0))
+        assert np.isfinite(U).all() and np.isfinite(V).all()
+        scores = U @ V.T
+        observed = scores[coo.user_idx, coo.item_idx].mean()
+        assert observed > scores.mean()  # observed pairs score higher
+
+    def test_zero_degree_entities_stay_finite(self):
+        # user 3 and item 4 have no ratings at all
+        coo = RatingsCOO(np.array([0, 1, 2], np.int32),
+                         np.array([0, 1, 2], np.int32),
+                         np.array([1.0, 2.0, 3.0], np.float32), 5, 6)
+        U, V = als_train(coo, ALSParams(rank=4, iterations=3, reg=0.1))
+        assert np.isfinite(U).all() and np.isfinite(V).all()
+        assert np.allclose(U[3], 0) and np.allclose(V[4], 0)
+
+    def test_recommend_and_similar(self, synthetic):
+        coo, _, _ = synthetic
+        U, V = als_train(coo, ALSParams(rank=8, iterations=6, reg=0.05))
+        top, scores = recommend(U, V, 0, 7)
+        assert len(top) == 7 and list(scores) == sorted(scores, reverse=True)
+        top2, _ = recommend(U, V, 0, 7, exclude=np.array([top[0]]))
+        assert top[0] not in top2
+        sim, sscores = similar_items(V, np.array([3]), 5)
+        assert 3 not in sim and len(sim) == 5
+
+
+class TestShardedParity:
+    def test_explicit_matches_single(self, synthetic, cpu_mesh):
+        coo, _, _ = synthetic
+        p = ALSParams(rank=8, iterations=8, reg=0.05, seed=3)
+        U1, V1 = als_train(coo, p, mesh=None)
+        U8, V8 = als_train(coo, p, mesh=cpu_mesh)
+        r1 = predict_ratings(U1, V1, coo.user_idx, coo.item_idx)
+        r8 = predict_ratings(U8, V8, coo.user_idx, coo.item_idx)
+        # same math, different init/order → near-identical predictions
+        assert float(np.sqrt(np.mean((r1 - r8) ** 2))) < 0.15
+        assert np.corrcoef(r1, r8)[0, 1] > 0.99
+
+    def test_implicit_matches_single(self, synthetic, cpu_mesh):
+        coo, _, _ = synthetic
+        pos = RatingsCOO(coo.user_idx, coo.item_idx,
+                         np.abs(coo.rating), coo.n_users, coo.n_items)
+        p = ALSParams(rank=8, iterations=6, reg=0.05, implicit=True,
+                      alpha=2.0, seed=3)
+        Ua, Va = als_train(pos, p, mesh=None)
+        Ub, Vb = als_train(pos, p, mesh=cpu_mesh)
+        ra = (Ua @ Va.T)[pos.user_idx, pos.item_idx]
+        rb = (Ub @ Vb.T)[pos.user_idx, pos.item_idx]
+        assert np.corrcoef(ra, rb)[0, 1] > 0.99
+
+    def test_uneven_sizes(self, cpu_mesh):
+        # sizes deliberately not divisible by 8
+        rng = np.random.default_rng(1)
+        n_u, n_i = 37, 23
+        uu = rng.integers(0, n_u, 300).astype(np.int32)
+        ii = rng.integers(0, n_i, 300).astype(np.int32)
+        rr = rng.uniform(1, 5, 300).astype(np.float32)
+        coo = RatingsCOO(uu, ii, rr, n_u, n_i)
+        U, V = als_train(coo, ALSParams(rank=4, iterations=3, reg=0.1),
+                         mesh=cpu_mesh)
+        assert U.shape == (37, 4) and V.shape == (23, 4)
+        assert np.isfinite(U).all() and np.isfinite(V).all()
+
+
+class TestMeshTraining:
+    def test_workflow_train_with_mesh(self, storage):
+        """use_mesh=True end-to-end: the full train workflow on the CPU mesh."""
+        from predictionio_tpu.core.workflow import prepare_deploy, run_train
+        from tests.test_workflow import FACTORY, VARIANT, seed_ratings
+
+        seed_ratings(storage)
+        run_train(FACTORY, variant=VARIANT, storage=storage, use_mesh=True)
+        deployed = prepare_deploy(engine_factory=FACTORY, storage=storage)
+        res = deployed.query({"user": "0", "num": 5})
+        assert len(res["itemScores"]) == 5

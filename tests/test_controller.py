@@ -1,0 +1,143 @@
+"""Controller plumbing tests with tiny fake engines — the analogue of
+the reference's core/controller fixture suite (Engine0/PDataSource0…,
+EngineTest, MetricEvaluatorTest; SURVEY.md §4 Tier 1)."""
+
+from dataclasses import dataclass, field
+from typing import List
+
+import pytest
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    AverageMetric,
+    DataSource,
+    Engine,
+    EngineParams,
+    Evaluation,
+    FirstServing,
+    IdentityPreparator,
+    MetricEvaluator,
+    WorkflowContext,
+    params_from_json,
+)
+
+
+@dataclass
+class DSParams:
+    n: int = 10
+    offset: float = 0.0
+    lambda_: float = 0.5
+
+
+class FakeDataSource(DataSource):
+    ParamsClass = DSParams
+
+    def read_training(self, ctx):
+        return [self.params.offset + i for i in range(self.params.n)]
+
+    def read_eval(self, ctx):
+        td = self.read_training(ctx)
+        qa = [(x, x * 2.0) for x in td]  # actual = 2x
+        return [(td, {"fold": 0}, qa)]
+
+
+@dataclass
+class AlgoParams:
+    mult: float = 1.0
+
+
+class FakeAlgorithm(Algorithm):
+    ParamsClass = AlgoParams
+
+    def train(self, ctx, pd):
+        return {"mean": sum(pd) / len(pd), "mult": self.params.mult}
+
+    def predict(self, model, query):
+        return query * model["mult"]
+
+
+class SquaredError(AverageMetric):
+    higher_is_better = False
+
+    def calculate_one(self, q, p, a):
+        return (p - a) ** 2
+
+
+def make_engine():
+    return Engine(FakeDataSource, IdentityPreparator, {"fake": FakeAlgorithm},
+                  FirstServing)
+
+
+class TestParamsExtraction:
+    def test_snake_camel_and_keyword(self):
+        p = params_from_json(DSParams, {"n": 3, "offset": 1.5, "lambda": 0.9})
+        assert p.n == 3 and p.offset == 1.5 and p.lambda_ == 0.9
+
+    def test_camel_case(self):
+        @dataclass
+        class P:
+            num_iterations: int = 1
+        assert params_from_json(P, {"numIterations": 7}).num_iterations == 7
+
+    def test_unknown_key_raises(self):
+        with pytest.raises(ValueError, match="unknown parameter"):
+            params_from_json(DSParams, {"bogus": 1})
+
+    def test_variant_parsing(self):
+        engine = make_engine()
+        ep = engine.params_from_variant({
+            "datasource": {"params": {"n": 5}},
+            "algorithms": [{"name": "fake", "params": {"mult": 2.0}}],
+        })
+        assert ep.data_source_params.n == 5
+        assert ep.algorithms_params == [("fake", AlgoParams(mult=2.0))]
+
+    def test_variant_unknown_algo(self):
+        with pytest.raises(ValueError, match="unknown algorithm"):
+            make_engine().params_from_variant(
+                {"algorithms": [{"name": "nope", "params": {}}]})
+
+    def test_variant_default_algo(self):
+        ep = make_engine().params_from_variant({})
+        assert ep.algorithms_params == [("fake", AlgoParams())]
+
+
+class TestEngineTrainEval:
+    def test_train(self):
+        engine = make_engine()
+        ep = engine.params_from_variant({"datasource": {"params": {"n": 4}}})
+        models = engine.train(WorkflowContext(), ep)
+        assert models == [{"mean": 1.5, "mult": 1.0}]
+
+    def test_eval_produces_qpa(self):
+        engine = make_engine()
+        ep = EngineParams(DSParams(n=3), None, [("fake", AlgoParams(mult=2.0))], None)
+        results = engine.eval(WorkflowContext(), ep)
+        (info, qpa), = results
+        assert info == {"fold": 0}
+        assert qpa == [(0.0, 0.0, 0.0), (1.0, 2.0, 2.0), (2.0, 4.0, 4.0)]
+
+
+class TestMetricEvaluator:
+    def test_grid_picks_best(self):
+        engine = make_engine()
+        candidates = [
+            EngineParams(DSParams(n=4), None, [("fake", AlgoParams(mult=m))], None)
+            for m in (0.5, 2.0, 3.0)
+        ]
+        evaluator = MetricEvaluator(SquaredError())
+        result = evaluator.evaluate(WorkflowContext(), engine, candidates)
+        # actual = 2x, so mult=2.0 is exact (error 0)
+        assert result.best_index == 1
+        assert result.best_score == 0.0
+        assert len(result.candidates) == 3
+        assert "bestEngineParams" in result.to_json()
+
+    def test_evaluation_binding(self):
+        class Ev(Evaluation):
+            engine_factory = staticmethod(make_engine)
+            metric = SquaredError()
+
+        result = Ev().run(WorkflowContext(), [
+            EngineParams(DSParams(n=2), None, [("fake", AlgoParams(mult=2.0))], None)])
+        assert result.best_score == 0.0

@@ -1,0 +1,231 @@
+"""HTTP quickstart e2e: event server ingestion → train → engine server
+queries — the reference's quickstart_test.py + eventserver_test.py
+scenarios over real sockets (SURVEY.md §4 Tier 2)."""
+
+import asyncio
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.core.workflow import run_train
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.server.engine_server import EngineServer
+from predictionio_tpu.server.event_server import EventServer
+
+FACTORY = "predictionio_tpu.templates.recommendation.engine:engine_factory"
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class ServerThread:
+    """Run an asyncio server (EventServer/EngineServer) on a daemon thread."""
+
+    def __init__(self, server):
+        self.server = server
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_until_complete(self.server.serve_forever())
+
+    def __enter__(self):
+        self.thread.start()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                with socket.create_connection(
+                        ("127.0.0.1", self.server.http.port), timeout=0.2):
+                    return self
+            except OSError:
+                time.sleep(0.02)
+        raise TimeoutError("server did not start")
+
+    def __exit__(self, *exc):
+        self.loop.call_soon_threadsafe(self.server.http.request_shutdown)
+        self.thread.join(timeout=5)
+
+
+def http(method, url, body=None, headers=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json",
+                                          **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read().decode() or "null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "null")
+
+
+@pytest.fixture()
+def app(storage):
+    a = storage.meta.create_app("QuickApp")
+    storage.events.init_channel(a.id)
+    key = storage.meta.create_access_key(a.id)
+    return a, key
+
+
+class TestEventServerAPI:
+    def test_quickstart_ingestion_contract(self, storage, app):
+        a, key = app
+        port = free_port()
+        with ServerThread(EventServer(storage=storage, host="127.0.0.1",
+                                      port=port, stats=True)):
+            base = f"http://127.0.0.1:{port}"
+            # status
+            assert http("GET", f"{base}/")[1] == {"status": "alive"}
+            # auth failures
+            assert http("POST", f"{base}/events.json", {"event": "x"})[0] == 401
+            assert http("POST", f"{base}/events.json?accessKey=wrong",
+                        {"event": "x"})[0] == 401
+            # single event
+            ev = {"event": "rate", "entityType": "user", "entityId": "u1",
+                  "targetEntityType": "item", "targetEntityId": "i1",
+                  "properties": {"rating": 5.0}}
+            code, body = http("POST", f"{base}/events.json?accessKey={key.key}", ev)
+            assert code == 201 and body["eventId"]
+            eid = body["eventId"]
+            # malformed event → 400 with message
+            code, body = http("POST", f"{base}/events.json?accessKey={key.key}",
+                              {"event": "$bogus", "entityType": "u", "entityId": "1"})
+            assert code == 400 and "reserved" in body["message"]
+            # batch (one good, one bad) → per-item statuses
+            code, body = http("POST", f"{base}/batch/events.json?accessKey={key.key}",
+                              [ev, {"event": ""}])
+            assert code == 200
+            assert [item["status"] for item in body] == [201, 400]
+            # batch over limit
+            code, _ = http("POST", f"{base}/batch/events.json?accessKey={key.key}",
+                           [ev] * 51)
+            assert code == 400
+            # get single / filtered find
+            code, got = http("GET", f"{base}/events/{eid}.json?accessKey={key.key}")
+            assert code == 200 and got["event"] == "rate"
+            code, lst = http("GET",
+                             f"{base}/events.json?accessKey={key.key}&event=rate")
+            assert code == 200 and len(lst) == 2
+            # auth header form
+            code, lst = http("GET", f"{base}/events.json",
+                             headers={"Authorization": f"Bearer {key.key}"})
+            assert code == 200
+            # delete
+            assert http("DELETE", f"{base}/events/{eid}.json?accessKey={key.key}")[0] == 200
+            assert http("GET", f"{base}/events/{eid}.json?accessKey={key.key}")[0] == 404
+            # stats
+            code, stats = http("GET", f"{base}/stats.json")
+            assert code == 200 and stats["appStats"][0]["appId"] == a.id
+
+    def test_restricted_key_and_channel(self, storage, app):
+        a, _ = app
+        rkey = storage.meta.create_access_key(a.id, events=["view"])
+        ch = storage.meta.create_channel(a.id, "backtest")
+        storage.events.init_channel(a.id, ch.id)
+        port = free_port()
+        with ServerThread(EventServer(storage=storage, host="127.0.0.1", port=port)):
+            base = f"http://127.0.0.1:{port}"
+            ev = {"event": "rate", "entityType": "user", "entityId": "u",
+                  "targetEntityType": "item", "targetEntityId": "i",
+                  "properties": {"rating": 1.0}}
+            # not permitted by restricted key
+            assert http("POST", f"{base}/events.json?accessKey={rkey.key}", ev)[0] == 403
+            ok = {"event": "view", "entityType": "user", "entityId": "u",
+                  "targetEntityType": "item", "targetEntityId": "i"}
+            assert http("POST", f"{base}/events.json?accessKey={rkey.key}", ok)[0] == 201
+            # channel routing
+            code, _ = http("POST",
+                           f"{base}/events.json?accessKey={rkey.key}&channel=backtest", ok)
+            assert code == 201
+            assert len(list(storage.events.find(a.id, ch.id))) == 1
+            # bad channel
+            assert http("POST",
+                        f"{base}/events.json?accessKey={rkey.key}&channel=nope",
+                        ok)[0] == 400
+
+    def test_webhooks(self, storage, app):
+        a, key = app
+        port = free_port()
+        with ServerThread(EventServer(storage=storage, host="127.0.0.1", port=port)):
+            base = f"http://127.0.0.1:{port}"
+            code, body = http("GET",
+                              f"{base}/webhooks/segmentio.json?accessKey={key.key}")
+            assert code == 200 and body["status"] == "ready"
+            payload = {"type": "track", "userId": "u42", "event": "signup",
+                       "properties": {"plan": "pro"}}
+            code, body = http("POST",
+                              f"{base}/webhooks/segmentio.json?accessKey={key.key}",
+                              payload)
+            assert code == 201
+            evs = list(storage.events.find(a.id, event_names=["signup"]))
+            assert len(evs) == 1 and evs[0].entity_id == "u42"
+            assert http("POST", f"{base}/webhooks/nope.json?accessKey={key.key}",
+                        {})[0] == 404
+
+
+VARIANT = {
+    "id": "default",
+    "engineFactory": FACTORY,
+    "datasource": {"params": {"appName": "QuickApp"}},
+    "algorithms": [{"name": "als",
+                    "params": {"rank": 8, "numIterations": 8, "lambda": 0.05}}],
+}
+
+
+class TestQuickstartEndToEnd:
+    def test_full_loop(self, storage, app):
+        a, key = app
+        es_port, en_port = free_port(), free_port()
+        # 1. ingest ratings through the event server (the quickstart import)
+        with ServerThread(EventServer(storage=storage, host="127.0.0.1",
+                                      port=es_port)):
+            base = f"http://127.0.0.1:{es_port}"
+            batch = []
+            for u in range(20):
+                for i in range(15):
+                    if (u * 31 + i * 17) % 10 < 5:
+                        r = 5.0 if (u % 2) == (i % 2) else 1.0
+                        batch.append({
+                            "event": "rate", "entityType": "user",
+                            "entityId": str(u), "targetEntityType": "item",
+                            "targetEntityId": str(i),
+                            "properties": {"rating": r}})
+            for start in range(0, len(batch), 50):
+                code, _ = http("POST",
+                               f"{base}/batch/events.json?accessKey={key.key}",
+                               batch[start:start + 50])
+                assert code == 200
+        # 2. train
+        instance_id = run_train(FACTORY, variant=VARIANT, storage=storage,
+                                use_mesh=False)
+        # 3. deploy + query over HTTP
+        with ServerThread(EngineServer(engine_factory=FACTORY, storage=storage,
+                                       host="127.0.0.1", port=en_port)):
+            base = f"http://127.0.0.1:{en_port}"
+            code, status = http("GET", f"{base}/")
+            assert status["engineInstanceId"] == instance_id
+            code, pred = http("POST", f"{base}/queries.json",
+                              {"user": "2", "num": 4})
+            assert code == 200 and len(pred["itemScores"]) == 4
+            items = [int(s["item"]) for s in pred["itemScores"]]
+            assert sum(1 for i in items if i % 2 == 0) >= 3
+            # malformed query → 400
+            code, body = http("POST", f"{base}/queries.json", {"nope": 1})
+            assert code == 400
+            # retrain + hot reload picks up the new instance
+            second = run_train(FACTORY, variant=VARIANT, storage=storage,
+                               use_mesh=False)
+            code, body = http("GET", f"{base}/reload")
+            assert code == 200 and body["engineInstanceId"] == second
+            code, status = http("GET", f"{base}/")
+            assert status["engineInstanceId"] == second

@@ -1,0 +1,136 @@
+"""Train→persist→deploy round trip through the real workflow + the
+recommendation template (the in-process core of the reference's
+quickstart scenario; SURVEY.md §4 Tier 2)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.workflow import prepare_deploy, run_train
+from predictionio_tpu.data.event import Event
+
+FACTORY = "predictionio_tpu.templates.recommendation.engine:engine_factory"
+
+
+def seed_ratings(storage, app_name="TestApp", n_users=30, n_items=20, seed=0):
+    app = storage.meta.create_app(app_name)
+    storage.events.init_channel(app.id)
+    rng = np.random.default_rng(seed)
+    # block structure: even users like even items, odd users like odd items
+    evs = []
+    for u in range(n_users):
+        for i in range(n_items):
+            if rng.random() < 0.5:
+                r = 5.0 if (u % 2) == (i % 2) else 1.0
+                evs.append(Event(event="rate", entity_type="user", entity_id=str(u),
+                                 target_entity_type="item", target_entity_id=str(i),
+                                 properties={"rating": r}))
+    # a few implicit buys
+    evs.append(Event(event="buy", entity_type="user", entity_id="0",
+                     target_entity_type="item", target_entity_id="0"))
+    storage.events.insert_batch(evs, app.id)
+    return app
+
+
+VARIANT = {
+    "id": "default",
+    "engineFactory": FACTORY,
+    "datasource": {"params": {"appName": "TestApp"}},
+    "algorithms": [{"name": "als",
+                    "params": {"rank": 8, "numIterations": 8, "lambda": 0.05}}],
+}
+
+
+class TestTrainDeploy:
+    def test_round_trip(self, storage):
+        seed_ratings(storage)
+        instance_id = run_train(FACTORY, variant=VARIANT, storage=storage,
+                                use_mesh=False)
+        ei = storage.meta.get_engine_instance(instance_id)
+        assert ei.status == "COMPLETED"
+        assert ei.end_time is not None
+
+        deployed = prepare_deploy(engine_factory=FACTORY, storage=storage)
+        assert deployed.instance.id == instance_id
+        res = deployed.query({"user": "0", "num": 5})
+        assert len(res["itemScores"]) == 5
+        items = [int(s["item"]) for s in res["itemScores"]]
+        # user 0 (even) should prefer even items
+        even = sum(1 for i in items if i % 2 == 0)
+        assert even >= 4, f"expected even-item preference, got {items}"
+        # scores sorted descending
+        scores = [s["score"] for s in res["itemScores"]]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_unknown_user_empty(self, storage):
+        seed_ratings(storage)
+        run_train(FACTORY, variant=VARIANT, storage=storage, use_mesh=False)
+        deployed = prepare_deploy(engine_factory=FACTORY, storage=storage)
+        assert deployed.query({"user": "zzz", "num": 3}) == {"itemScores": []}
+
+    def test_latest_instance_wins(self, storage):
+        seed_ratings(storage)
+        run_train(FACTORY, variant=VARIANT, storage=storage, use_mesh=False)
+        second = run_train(FACTORY, variant=VARIANT, storage=storage,
+                           use_mesh=False)
+        deployed = prepare_deploy(engine_factory=FACTORY, storage=storage)
+        assert deployed.instance.id == second
+
+    def test_train_failure_marks_failed(self, storage):
+        storage.meta.create_app("TestApp")  # no events → DataSource raises
+        with pytest.raises(ValueError):
+            run_train(FACTORY, variant=VARIANT, storage=storage, use_mesh=False)
+        eis = storage.meta.list_engine_instances()
+        assert eis and eis[0].status == "FAILED"
+        assert prepare_deploy_fails(storage)
+
+
+def prepare_deploy_fails(storage):
+    try:
+        prepare_deploy(engine_factory=FACTORY, storage=storage)
+    except ValueError:
+        return True
+    return False
+
+
+class TestEvalWorkflow:
+    def test_grid_search(self, storage):
+        from predictionio_tpu.controller import (
+            EngineParams,
+            Evaluation,
+            OptionAverageMetric,
+        )
+        from predictionio_tpu.core.workflow import run_evaluation
+        from predictionio_tpu.templates.recommendation.engine import (
+            ALSAlgorithmParams,
+            DataSourceParams,
+        )
+
+        seed_ratings(storage)
+
+        class RMSE(OptionAverageMetric):
+            higher_is_better = False
+            header = "SquaredError"
+
+            def calculate_one_opt(self, q, p, a):
+                scores = p.get("itemScores", [])
+                if not scores or scores[0]["score"] is None:
+                    return None
+                return (scores[0]["score"] - a) ** 2
+
+        class Ev(Evaluation):
+            engine_factory = FACTORY
+            metric = RMSE()
+
+        dsp = DataSourceParams(app_name="TestApp", eval_k=2)
+        candidates = [
+            EngineParams(dsp, None,
+                         [("als", ALSAlgorithmParams(rank=r, num_iterations=6,
+                                                     lambda_=0.05))], None)
+            for r in (2, 8)
+        ]
+        iid, result = run_evaluation(Ev(), candidates, storage=storage,
+                                     use_mesh=False)
+        vi = storage.meta.get_evaluation_instance(iid)
+        assert vi.status == "EVALCOMPLETED"
+        assert len(result.candidates) == 2
+        assert result.best_score == min(s for _, s, _ in result.candidates)
