@@ -72,6 +72,14 @@ class Algorithm(ABC, Generic[PD, M, Q, PR]):
 
     def __init__(self, params: Any = None) -> None:
         self.params = params
+        #: set by prepare_deploy — the Storage serving-time lookups must
+        #: use (live business rules, feedback); None during training
+        self.serving_storage: Any = None
+
+    def set_serving_context(self, storage: Any) -> None:
+        """Called once at deploy time with the Storage backing this
+        serving process (the LEventStore-at-serve-time analogue)."""
+        self.serving_storage = storage
 
     @abstractmethod
     def train(self, ctx: WorkflowContext, prepared_data: PD) -> M:

@@ -196,6 +196,7 @@ def prepare_deploy(
     models = []
     for (name, algo), blob in zip(algorithms, blobs):
         algo_dir = os.path.join(instance_dir, name) if instance_dir else None
+        algo.set_serving_context(storage)
         models.append(algo.load_model(blob, algo_dir))
     serving = engine.serving_cls(engine_params.serving_params)
     return DeployedEngine(

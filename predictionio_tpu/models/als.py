@@ -6,24 +6,26 @@ similar-product / e-commerce templates; block-partitioned factor
 matrices, shuffle-joined rating blocks, per-row normal-equation Cholesky
 solves — SURVEY.md §2d P2). The TPU-first redesign:
 
-- Ratings live as **two sorted COO copies** (by-user and by-item),
-  padded to static shapes. Sorting replaces the reference's shuffle-join
-  "InBlock" structures: each half-step streams a *sorted* rating chunk,
-  so the scatter-add of per-rating outer products onto per-entity normal
-  matrices hits XLA's sorted/fast scatter path.
-- Each half-step builds all normal equations ``A_e = Σ v vᵀ (+ λ n_e I)``,
-  ``b_e = Σ r·v`` with a ``lax.scan`` over fixed-size chunks (bounding
-  the ``(chunk, k, k)`` outer-product intermediate), then solves every
-  entity's k×k system in one **batched Cholesky** — dense, static-shape
-  MXU work instead of MLlib's per-row LAPACK ``dppsv`` calls.
-- The whole training run (``iterations × two half-steps``) is ONE jitted
-  ``lax.scan`` — no host round-trips between iterations.
-- With a mesh: ratings chunks are sharded over the ``data`` axis inside
-  ``shard_map``; each device accumulates partial (A, b) for *all*
-  entities from its local ratings, a ``psum`` over the mesh replaces the
-  reference's shuffle, and every device solves a disjoint slice of the
-  entities (``reduce_scatter``-style split) before an ``all_gather``
-  rebuilds the full factor matrix for the next half-step.
+- Ratings are laid out host-side as **padded rows**: each entity's
+  (sorted) rating list is split into rows of fixed width W, giving
+  static-shape matrices ``other_idx/vals/mask ∈ [R, W]`` plus a sorted
+  ``row_entity ∈ [R]`` map. This is the sparsity-to-MXU bridge: the
+  per-entity normal equations ``A_e = Σ v vᵀ`` become **batched
+  (W×k)ᵀ(W×k) matmuls** over rows — dense systolic-array work — with
+  only one sorted scatter-add of R row-results per half-step
+  (R ≈ nnz/W + n_entities, ~50× fewer scatter updates than per-rating
+  accumulation).
+- Rows stream through a ``lax.scan`` in fixed-size chunks, bounding the
+  ``(RC, W, k)`` gather and ``(RC, k, k)`` partial-result buffers.
+- Every entity's k×k system is solved by one **batched Cholesky**
+  (two batched triangular solves) — replacing MLlib's per-row LAPACK
+  ``dppsv`` calls.
+- The whole training run (iterations × two half-steps) is ONE jitted
+  ``lax.scan``: no host round-trips.
+- With a mesh (:mod:`predictionio_tpu.models.als_sharded`): entities are
+  range-partitioned across devices, each device holds its entities'
+  rating rows, and one ``all_gather`` per half-step replaces the
+  reference's shuffle.
 
 Supports explicit feedback and implicit feedback (Hu-Koren-Volinsky
 confidence weighting, MLlib's ``trainImplicit`` analogue) and MLlib's
@@ -54,40 +56,61 @@ class RatingsCOO:
         return int(self.user_idx.shape[0])
 
 
-def _choose_chunk(nnz: int, rank: int) -> int:
-    """Chunk size bounding the (chunk, k, k) outer-product intermediate
-    to ~256MB fp32 while keeping scan trip counts reasonable."""
-    target = max(256, (1 << 26) // max(rank * rank, 1))
-    # round to a power of two ≤ target
-    c = 1 << (target.bit_length() - 1)
-    return int(min(c, max(256, 1 << int(np.ceil(np.log2(max(nnz, 1))))))) or 256
+@dataclass
+class ALSParams:
+    rank: int = 10
+    iterations: int = 10
+    reg: float = 0.01          # MLlib's `lambda`
+    implicit: bool = False     # MLlib trainImplicit
+    alpha: float = 1.0         # implicit confidence scale
+    weighted_reg: bool = True  # ALS-WR: λ·n_e scaling (MLlib behavior)
+    seed: int = 0
+    row_width: int = 64        # W: ratings per padded row
 
 
-def _sorted_padded(
-    idx_self: np.ndarray, idx_other: np.ndarray, vals: np.ndarray, chunk: int
+def _row_chunk(rank: int) -> int:
+    """Rows per scan step: bounds the (RC, k, k) partials to ~64MB f32."""
+    return int(min(8192, max(256, (1 << 24) // max(rank * rank, 1))))
+
+
+def rows_layout(
+    idx_self: np.ndarray, idx_other: np.ndarray, vals: np.ndarray,
+    n_self: int, width: int, chunk_rows: int,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-    """Sort COO by idx_self and pad to a multiple of chunk (mask marks real)."""
+    """Build the padded-row layout for one half-step orientation.
+
+    Returns (row_entity [R], other_idx [R,W], vals [R,W], mask [R,W])
+    with R padded to a multiple of ``chunk_rows`` and ``row_entity``
+    sorted (so the scatter-add may assert sortedness).
+    """
+    nnz = idx_self.shape[0]
     order = np.argsort(idx_self, kind="stable")
     s, o, v = idx_self[order], idx_other[order], vals[order]
-    nnz = s.shape[0]
-    padded = ((nnz + chunk - 1) // chunk) * chunk
-    pad = padded - nnz
-    # pad self-indices with the LAST real index (not 0): the scatter-adds
-    # assert indices_are_sorted, and a zero tail after sorted data would
-    # violate that — undefined behavior on TPU. Masked rows add zeros, so
-    # the target row is unaffected.
-    s_fill = s[-1] if nnz else 0
-    s = np.concatenate([s, np.full(pad, s_fill, np.int32)])
-    o = np.concatenate([o, np.zeros(pad, np.int32)])
-    v = np.concatenate([v, np.zeros(pad, np.float32)])
-    m = np.concatenate([np.ones(nnz, np.float32), np.zeros(pad, np.float32)])
-    return s.astype(np.int32), o.astype(np.int32), v.astype(np.float32), m
 
+    counts = np.bincount(s, minlength=n_self).astype(np.int64)
+    starts = np.zeros(n_self + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    within = np.arange(nnz, dtype=np.int64) - starts[s]
 
-def _half_step_arrays(coo: RatingsCOO, by_user: bool, chunk: int):
-    if by_user:
-        return _sorted_padded(coo.user_idx, coo.item_idx, coo.rating, chunk)
-    return _sorted_padded(coo.item_idx, coo.user_idx, coo.rating, chunk)
+    rows_per_entity = (counts + width - 1) // width
+    row_starts = np.zeros(n_self + 1, np.int64)
+    np.cumsum(rows_per_entity, out=row_starts[1:])
+    n_rows = int(row_starts[-1])
+
+    row_of = (row_starts[s] + within // width).astype(np.int64)
+    col_of = (within % width).astype(np.int64)
+
+    R = max(chunk_rows, ((n_rows + chunk_rows - 1) // chunk_rows) * chunk_rows)
+    row_entity = np.full(R, max(0, n_self - 1), np.int32)  # sorted tail pad
+    row_entity[:n_rows] = np.repeat(
+        np.arange(n_self, dtype=np.int32), rows_per_entity)
+    other_idx = np.zeros((R, width), np.int32)
+    vmat = np.zeros((R, width), np.float32)
+    mask = np.zeros((R, width), np.float32)
+    other_idx[row_of, col_of] = o
+    vmat[row_of, col_of] = v
+    mask[row_of, col_of] = 1.0
+    return row_entity, other_idx, vmat, mask
 
 
 def _counts(idx: np.ndarray, n: int) -> np.ndarray:
@@ -101,29 +124,17 @@ def init_factors(n: int, rank: int, seed: int) -> np.ndarray:
     return (rng.standard_normal((n, rank)) / np.sqrt(rank)).astype(np.float32)
 
 
-@dataclass
-class ALSParams:
-    rank: int = 10
-    iterations: int = 10
-    reg: float = 0.01          # MLlib's `lambda`
-    implicit: bool = False     # MLlib trainImplicit
-    alpha: float = 1.0         # implicit confidence scale
-    weighted_reg: bool = True  # ALS-WR: λ·n_e scaling (MLlib behavior)
-    seed: int = 0
-    dtype: str = "float32"
-
-
 def chunk_update(A, b, chunk, F_other, implicit: bool, alpha: float):
-    """Accumulate one sorted rating chunk into the normal equations.
+    """Accumulate one chunk of padded rating rows into the normal equations.
 
     Shared by the single-device and sharded paths so their math cannot
-    diverge. ``chunk`` = (idx_self, idx_other, vals, mask), idx_self
-    sorted within the chunk.
+    diverge. ``chunk`` = (row_entity [RC], other_idx [RC,W], vals [RC,W],
+    mask [RC,W]); row_entity sorted within the chunk.
     """
     import jax.numpy as jnp
 
-    si, oi, r, m = chunk
-    F = F_other[oi]  # (C, k) gather
+    re_, oi, r, m = chunk
+    F = F_other[oi]  # (RC, W, k) gather
     if implicit:
         # Hu et al.: c = 1 + α·r ; A gets Σ (c−1)·v vᵀ (the global Gram
         # VᵀV is added outside); b gets Σ c·p·v with p=1.
@@ -132,30 +143,32 @@ def chunk_update(A, b, chunk, F_other, implicit: bool, alpha: float):
     else:
         w_outer = m
         w_b = r * m
-    A = A.at[si].add(
-        jnp.einsum("c,ck,cl->ckl", w_outer, F, F,
-                   preferred_element_type=jnp.float32),
-        indices_are_sorted=True)
-    b = b.at[si].add(F * w_b[:, None], indices_are_sorted=True)
+    # batched matmul on the MXU: contract the W axis per row
+    A_rows = jnp.einsum("rw,rwk,rwl->rkl", w_outer, F, F,
+                        preferred_element_type=jnp.float32)
+    b_rows = jnp.einsum("rw,rwk->rk", w_b, F,
+                        preferred_element_type=jnp.float32)
+    A = A.at[re_].add(A_rows, indices_are_sorted=True)
+    b = b.at[re_].add(b_rows, indices_are_sorted=True)
     return A, b
 
 
-def _build_normal_eq(n_self: int, rank: int, implicit: bool, alpha: float):
+def _build_normal_eq(n_self: int, implicit: bool, alpha: float):
     """Returns f(F_other, chunks) -> (A [n_self,k,k], b [n_self,k]) where
-    chunks = (idx_self, idx_other, vals, mask) each shaped [n_chunks, C]."""
+    chunks are row-layout arrays reshaped to [n_chunks, RC, ...]."""
     import jax
     import jax.numpy as jnp
 
-    def normal_eq(F_other, idx_self, idx_other, vals, mask):
+    def normal_eq(F_other, row_entity, other_idx, vals, mask):
         k = F_other.shape[1]
         A0 = jnp.zeros((n_self, k, k), jnp.float32)
         b0 = jnp.zeros((n_self, k), jnp.float32)
 
         def body(carry, chunk):
-            A, b = chunk_update(*carry, chunk, F_other, implicit, alpha)
-            return (A, b), None
+            return chunk_update(*carry, chunk, F_other, implicit, alpha), None
 
-        (A, b), _ = jax.lax.scan(body, (A0, b0), (idx_self, idx_other, vals, mask))
+        (A, b), _ = jax.lax.scan(body, (A0, b0),
+                                 (row_entity, other_idx, vals, mask))
         return A, b
 
     return normal_eq
@@ -194,21 +207,18 @@ def als_train(
 
 
 @functools.lru_cache(maxsize=8)
-def _compiled_single(n_users: int, n_items: int, nnz_padded: int, n_chunks: int,
+def _compiled_single(n_users: int, n_items: int, u_rows: int, i_rows: int,
+                     chunk_rows: int, width: int,
                      rank: int, iterations: int, reg: float, implicit: bool,
                      alpha: float, weighted_reg: bool):
     """Build + jit the full training program for one problem geometry.
-
     Caching on geometry means `pio eval` grid candidates that share shapes
-    recompile nothing (compile-once, params-as-input would be better still;
-    reg enters the jaxpr as a python float for now).
-    """
+    recompile only when rank/iterations/reg change."""
     import jax
     import jax.numpy as jnp
 
-    ne_user = _build_normal_eq(n_users, rank, implicit, alpha)
-    ne_item = _build_normal_eq(n_items, rank, implicit, alpha)
-    C = nnz_padded // n_chunks
+    ne_user = _build_normal_eq(n_users, implicit, alpha)
+    ne_item = _build_normal_eq(n_items, implicit, alpha)
 
     def train(u_chunks, i_chunks, cnt_u, cnt_i, V0):
         k = rank
@@ -222,50 +232,57 @@ def _compiled_single(n_users: int, n_items: int, nnz_padded: int, n_chunks: int,
 
         Ru = reg_term(cnt_u)
         Ri = reg_term(cnt_i)
-        V = V0
 
-        def half(F_other, ne, chunks, R, gram_needed):
+        def half(F_other, ne, chunks, R):
             A, b = ne(F_other, *chunks)
-            if implicit and gram_needed:
+            if implicit:
                 A = A + (F_other.T @ F_other)[None, :, :]
             return _solve_psd(A + R, b)
 
         def step(carry, _):
             U, V = carry
-            U = half(V, ne_user, u_chunks, Ru, True)
-            V = half(U, ne_item, i_chunks, Ri, True)
+            U = half(V, ne_user, u_chunks, Ru)
+            V = half(U, ne_item, i_chunks, Ri)
             return (U, V), None
 
         U0 = jnp.zeros((n_users, k), jnp.float32)
-        (U, V), _ = jax.lax.scan(step, (U0, V), None, length=iterations)
+        (U, V), _ = jax.lax.scan(step, (U0, V0), None, length=iterations)
         return U, V
 
     return jax.jit(train)
 
 
-def _als_train_single(coo: RatingsCOO, p: ALSParams) -> Tuple[np.ndarray, np.ndarray]:
-    import jax
+def _chunked(arrs, chunk_rows: int):
     import jax.numpy as jnp
 
-    chunk = _choose_chunk(coo.nnz, p.rank)
-    su, ou, vu, mu = _half_step_arrays(coo, by_user=True, chunk=chunk)
-    si, oi, vi, mi = _half_step_arrays(coo, by_user=False, chunk=chunk)
-    nnz_padded = su.shape[0]
-    n_chunks = nnz_padded // chunk
+    out = []
+    for a in arrs:
+        n_chunks = a.shape[0] // chunk_rows
+        out.append(jnp.asarray(a.reshape((n_chunks, chunk_rows) + a.shape[1:])))
+    return tuple(out)
 
-    def chunked(x):
-        return jnp.asarray(x).reshape(n_chunks, chunk)
 
-    u_chunks = tuple(map(chunked, (su, ou, vu, mu)))
-    i_chunks = tuple(map(chunked, (si, oi, vi, mi)))
+def _als_train_single(coo: RatingsCOO, p: ALSParams) -> Tuple[np.ndarray, np.ndarray]:
+    import jax.numpy as jnp
+
+    W = p.row_width
+    RC = _row_chunk(p.rank)
+    u_rows = rows_layout(coo.user_idx, coo.item_idx, coo.rating,
+                         coo.n_users, W, RC)
+    i_rows = rows_layout(coo.item_idx, coo.user_idx, coo.rating,
+                         coo.n_items, W, RC)
+
+    u_chunks = _chunked(u_rows, RC)
+    i_chunks = _chunked(i_rows, RC)
     cnt_u = jnp.asarray(_counts(coo.user_idx, coo.n_users))
     cnt_i = jnp.asarray(_counts(coo.item_idx, coo.n_items))
 
     train = _compiled_single(
-        coo.n_users, coo.n_items, nnz_padded, n_chunks, p.rank, p.iterations,
+        coo.n_users, coo.n_items, u_rows[0].shape[0], i_rows[0].shape[0],
+        RC, W, p.rank, p.iterations,
         float(p.reg), bool(p.implicit), float(p.alpha), bool(p.weighted_reg))
-    U, V = train(u_chunks, i_chunks, cnt_u, cnt_i, jnp.asarray(init_factors(
-        coo.n_items, p.rank, p.seed)))
+    U, V = train(u_chunks, i_chunks, cnt_u, cnt_i,
+                 jnp.asarray(init_factors(coo.n_items, p.rank, p.seed)))
     return np.asarray(U), np.asarray(V)
 
 

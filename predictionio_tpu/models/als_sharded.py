@@ -7,14 +7,16 @@ structures exchanged over the shuffle each half-iteration — SURVEY.md
 
 - Users (and items) are range-partitioned into ``n_dev`` equal blocks;
   each device owns one block of U rows and one of V rows.
-- Ratings are materialized TWICE on the host, pre-partitioned to match:
-  a by-user copy (device d holds exactly the ratings of d's users,
-  sorted by user) and a by-item copy. This replaces the shuffle: the
-  partitioning is done once at data-prep time, not per iteration.
+- Ratings are laid out TWICE on the host in the padded-row format of
+  :mod:`predictionio_tpu.models.als` (see ``rows_layout``), partitioned
+  to match: device d holds the rating rows of d's users (by-user copy)
+  and of d's items (by-item copy), with entity indices block-local.
+  This replaces the shuffle — partitioning happens once at data-prep
+  time, not per iteration.
 - Each half-step inside ``shard_map``: one ``all_gather`` of the
   counterpart factor block over the ``data`` axis (the only collective —
-  riding ICI), then purely local chunked outer-product accumulation and
-  a batched Cholesky solve for the local block.
+  riding ICI), then purely local batched-matmul row accumulation and a
+  batched Cholesky solve for the local block.
 - The full iteration loop is a single ``lax.scan`` under one jit: zero
   host round-trips, 2 all_gathers per iteration of size n·k.
 
@@ -32,51 +34,44 @@ import numpy as np
 from predictionio_tpu.models.als import (
     ALSParams,
     RatingsCOO,
-    _choose_chunk,
     _counts,
+    _row_chunk,
     _solve_psd,
+    chunk_update,
     init_factors,
+    rows_layout,
 )
 
 
-def _partition_ratings(
+def _partition_rows(
     idx_self: np.ndarray, idx_other: np.ndarray, vals: np.ndarray,
-    block: int, n_dev: int, chunk: int,
+    block: int, n_dev: int, width: int, chunk_rows: int,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-    """Partition COO by owner device of idx_self; localize indices; pad
-    every partition to the same chunked length.
+    """Per-device padded-row layouts, equalized to the same row count.
 
-    Returns arrays of shape [n_dev, n_chunks, C]: (local_self, other,
-    vals, mask).
+    Returns arrays shaped [n_dev, n_chunks, RC(, W)]: (row_entity
+    block-local, other_idx, vals, mask).
     """
     owner = idx_self // block
-    parts = []
-    max_len = 0
+    layouts = []
     for d in range(n_dev):
         sel = owner == d
-        s = (idx_self[sel] - d * block).astype(np.int32)
-        o = idx_other[sel].astype(np.int32)
-        v = vals[sel].astype(np.float32)
-        order = np.argsort(s, kind="stable")
-        parts.append((s[order], o[order], v[order]))
-        max_len = max(max_len, s.shape[0])
-    padded = max(chunk, ((max_len + chunk - 1) // chunk) * chunk)
-    n_chunks = padded // chunk
-    # pad tail with block-1 (≥ every local index) to keep each chunk's
-    # self-indices sorted — the scatter asserts indices_are_sorted
-    out_s = np.full((n_dev, padded), block - 1, np.int32)
-    out_o = np.zeros((n_dev, padded), np.int32)
-    out_v = np.zeros((n_dev, padded), np.float32)
-    out_m = np.zeros((n_dev, padded), np.float32)
-    for d, (s, o, v) in enumerate(parts):
-        n = s.shape[0]
-        out_s[d, :n] = s
-        out_o[d, :n] = o
-        out_v[d, :n] = v
-        out_m[d, :n] = 1.0
-    shape = (n_dev, n_chunks, chunk)
-    return (out_s.reshape(shape), out_o.reshape(shape),
-            out_v.reshape(shape), out_m.reshape(shape))
+        layouts.append(rows_layout(
+            (idx_self[sel] - d * block).astype(np.int32),
+            idx_other[sel].astype(np.int32),
+            vals[sel].astype(np.float32),
+            block, width, chunk_rows))
+    R = max(l[0].shape[0] for l in layouts)
+    outs = []
+    for j, fill in enumerate((block - 1, 0, 0.0, 0.0)):
+        dtype = layouts[0][j].dtype
+        shape = (n_dev, R) + layouts[0][j].shape[1:]
+        arr = np.full(shape, fill, dtype)
+        for d, l in enumerate(layouts):
+            arr[d, : l[j].shape[0]] = l[j]
+        n_chunks = R // chunk_rows
+        outs.append(arr.reshape((n_dev, n_chunks, chunk_rows) + shape[2:]))
+    return tuple(outs)  # type: ignore[return-value]
 
 
 def _pad_rows(arr: np.ndarray, n: int) -> np.ndarray:
@@ -88,7 +83,6 @@ def _pad_rows(arr: np.ndarray, n: int) -> np.ndarray:
 
 @functools.lru_cache(maxsize=8)
 def _compiled_sharded(mesh, n_dev: int, block_u: int, block_i: int,
-                      u_chunk_shape: Tuple[int, int], i_chunk_shape: Tuple[int, int],
                       rank: int, iterations: int, reg: float, implicit: bool,
                       alpha: float, weighted_reg: bool):
     import jax
@@ -106,16 +100,13 @@ def _compiled_sharded(mesh, n_dev: int, block_u: int, block_i: int,
 
     def local_normal_eq(F_full, chunks, n_local):
         """Accumulate A [n_local,k,k], b [n_local,k] from this device's
-        chunked ratings (idx_self already block-local). Same math as the
+        rating rows (row_entity already block-local). Same math as the
         single-device path via the shared chunk_update."""
-        from predictionio_tpu.models.als import chunk_update
-
         A0 = jax.lax.pvary(jnp.zeros((n_local, k, k), jnp.float32), "data")
         b0 = jax.lax.pvary(jnp.zeros((n_local, k), jnp.float32), "data")
 
         def body(carry, chunk):
-            A, b = chunk_update(*carry, chunk, F_full, implicit, alpha)
-            return (A, b), None
+            return chunk_update(*carry, chunk, F_full, implicit, alpha), None
 
         (A, b), _ = jax.lax.scan(body, (A0, b0), chunks)
         return A, b
@@ -125,10 +116,10 @@ def _compiled_sharded(mesh, n_dev: int, block_u: int, block_i: int,
         lam = jnp.where(cnt > 0, jnp.maximum(lam, 1e-8), 1.0)
         return lam[:, None, None] * eye
 
-    def body(u_s, u_o, u_v, u_m, i_s, i_o, i_v, i_m, cnt_u, cnt_i, V0):
+    def body(u_re, u_oi, u_v, u_m, i_re, i_oi, i_v, i_m, cnt_u, cnt_i, V0):
         # inside shard_map: leading device dim is local size 1 → squeeze
-        u_chunks = (u_s[0], u_o[0], u_v[0], u_m[0])
-        i_chunks = (i_s[0], i_o[0], i_v[0], i_m[0])
+        u_chunks = (u_re[0], u_oi[0], u_v[0], u_m[0])
+        i_chunks = (i_re[0], i_oi[0], i_v[0], i_m[0])
         Ru = reg_term(cnt_u[0])
         Ri = reg_term(cnt_i[0])
         V_l = V0  # [block_i, k] local block (spec splits rows)
@@ -147,16 +138,17 @@ def _compiled_sharded(mesh, n_dev: int, block_u: int, block_i: int,
             V_l = _solve_psd(A + Ri, b)
             return (U_l, V_l), None
 
-        # mark the carry as varying over the mesh axis (shard_map's vma
-        # typing: the loop-carried factor blocks differ per device)
+        # mark the zero carry as varying over the mesh axis (vma typing)
         U0_l = jax.lax.pvary(jnp.zeros((block_u, k), jnp.float32), "data")
         (U_l, V_l), _ = jax.lax.scan(step, (U0_l, V_l), None, length=iterations)
         return U_l, V_l
 
-    chunked = P("data", None, None)
+    rows4 = P("data", None, None, None)
+    rows3 = P("data", None, None)
     fn = shard_map(
         body, mesh=mesh,
-        in_specs=(chunked,) * 8 + (P("data", None), P("data", None), P("data", None)),
+        in_specs=(rows3, rows4, rows4, rows4, rows3, rows4, rows4, rows4,
+                  P("data", None), P("data", None), P("data", None)),
         out_specs=(P("data", None), P("data", None)),
     )
     return jax.jit(fn)
@@ -167,7 +159,6 @@ def als_train_sharded(
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Train ALS over the mesh's ``data`` axis; returns full (U, V)."""
     import jax
-    import jax.numpy as jnp
 
     n_dev = int(np.prod(mesh.devices.shape))
     if "data" not in mesh.axis_names:
@@ -176,12 +167,13 @@ def als_train_sharded(
     block_u = -(-coo.n_users // n_dev)  # ceil
     block_i = -(-coo.n_items // n_dev)
     n_users_p, n_items_p = block_u * n_dev, block_i * n_dev
-    chunk = _choose_chunk(max(1, coo.nnz // n_dev), p.rank)
+    W = p.row_width
+    RC = _row_chunk(p.rank)
 
-    u_parts = _partition_ratings(coo.user_idx, coo.item_idx, coo.rating,
-                                 block_u, n_dev, chunk)
-    i_parts = _partition_ratings(coo.item_idx, coo.user_idx, coo.rating,
-                                 block_i, n_dev, chunk)
+    u_parts = _partition_rows(coo.user_idx, coo.item_idx, coo.rating,
+                              block_u, n_dev, W, RC)
+    i_parts = _partition_rows(coo.item_idx, coo.user_idx, coo.rating,
+                              block_i, n_dev, W, RC)
 
     cnt_u = _pad_rows(_counts(coo.user_idx, coo.n_users), n_users_p)
     cnt_i = _pad_rows(_counts(coo.item_idx, coo.n_items), n_items_p)
@@ -192,7 +184,6 @@ def als_train_sharded(
 
     train = _compiled_sharded(
         mesh, n_dev, block_u, block_i,
-        u_parts[0].shape[1:], i_parts[0].shape[1:],
         p.rank, p.iterations, float(p.reg), bool(p.implicit), float(p.alpha),
         bool(p.weighted_reg))
 
@@ -202,10 +193,10 @@ def als_train_sharded(
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
-    chunked = NamedSharding(mesh, P("data", None, None))
+    shardings = [NamedSharding(mesh, P("data", *([None] * (a.ndim - 1))))
+                 for a in (*u_parts, *i_parts)]
+    args = [jax.device_put(a, s) for a, s in zip((*u_parts, *i_parts), shardings)]
     rows = NamedSharding(mesh, P("data", None))
-
-    args = [jax.device_put(a, chunked) for a in (*u_parts, *i_parts)]
     args += [jax.device_put(cnt_u.reshape(n_dev, block_u), rows),
              jax.device_put(cnt_i.reshape(n_dev, block_i), rows),
              jax.device_put(V0, rows)]

@@ -1,0 +1,191 @@
+"""Correlated Cross-Occurrence (CCO) with LLR filtering on TPU.
+
+Replaces the Universal Recommender's Mahout-Samsara
+``SimilarityAnalysis.cooccurrencesIDSs`` (reference behavior: LLR-
+thresholded co-occurrence of a primary event with each secondary event
+type, indicators stored in Elasticsearch — SURVEY.md §2c config 4).
+TPU-first redesign:
+
+- Interaction matrices are never materialized sparse-shuffled as in
+  Mahout; instead the co-occurrence products ``PᵀP_e`` stream through
+  the MXU as **dense user-chunk matmuls**: for each chunk of users a
+  dense ``(chunk, n_items)`` 0/1 slab is scattered host-side from CSR
+  and accumulated on device — co-occurrence *is* a matmul, the single
+  thing the systolic array does best.
+- The Dunning log-likelihood ratio is evaluated elementwise on the
+  ``(n_items_primary, n_items_e)`` count matrix in row blocks, followed
+  by a per-row ``top_k`` — one fused XLA kernel per block.
+- Output: per-item indicator lists (item → correlated items), the same
+  shape the reference indexed into Elasticsearch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class CCOParams:
+    max_indicators_per_item: int = 50   # Mahout maxInterestingItemsPerThing
+    llr_threshold: float = 0.0
+    user_chunk: int = 2048
+    row_block: int = 4096
+
+
+def _csr_from_pairs(users: np.ndarray, items: np.ndarray, n_users: int,
+                    n_items: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Dedup (user, item) pairs → CSR (indptr, indices) of the 0/1 matrix."""
+    keys = users.astype(np.int64) * n_items + items.astype(np.int64)
+    keys = np.unique(keys)  # sorted → u is already nondecreasing
+    u = (keys // n_items).astype(np.int32)
+    i = (keys % n_items).astype(np.int32)
+    indptr = np.zeros(n_users + 1, np.int64)
+    np.cumsum(np.bincount(u, minlength=n_users), out=indptr[1:])
+    return indptr, i
+
+
+def _cooccurrence(primary: Tuple[np.ndarray, np.ndarray],
+                  secondary: Tuple[np.ndarray, np.ndarray],
+                  n_users: int, n_a: int, n_b: int, chunk: int) -> np.ndarray:
+    """C = PᵀS over user chunks (dense slabs → MXU matmuls)."""
+    import jax
+    import jax.numpy as jnp
+
+    p_indptr, p_idx = primary
+    s_indptr, s_idx = secondary
+
+    @jax.jit
+    def acc(C, P_slab, S_slab):
+        return C + jnp.einsum("ua,ub->ab", P_slab, S_slab,
+                              preferred_element_type=jnp.float32)
+
+    def slab(indptr, idx, start, stop, width):
+        """Dense 0/1 slab for users [start, stop) in one vectorized scatter."""
+        out = np.zeros((chunk, width), np.float32)
+        lo, hi = indptr[start], indptr[stop]
+        if hi > lo:
+            rows = np.repeat(np.arange(stop - start),
+                             np.diff(indptr[start:stop + 1]))
+            out[rows, idx[lo:hi]] = 1.0
+        return out
+
+    C = jnp.zeros((n_a, n_b), jnp.float32)
+    for start in range(0, n_users, chunk):
+        stop = min(start + chunk, n_users)
+        C = acc(C, slab(p_indptr, p_idx, start, stop, n_a),
+                slab(s_indptr, s_idx, start, stop, n_b))
+    return np.asarray(C)
+
+
+def _llr_topk(C: np.ndarray, row_counts: np.ndarray, col_counts: np.ndarray,
+              n_users: int, k: int, threshold: float, row_block: int,
+              same_space: bool) -> Tuple[np.ndarray, np.ndarray]:
+    """Dunning LLR per entry, then per-row top-k.
+
+    Returns (indices [n_a, k], llr [n_a, k]); entries below threshold get
+    llr -inf. ``same_space`` masks the diagonal (self co-occurrence).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n_a, n_b = C.shape
+    k = min(k, n_b)
+    col_counts_j = jnp.asarray(col_counts, jnp.float32)
+
+    def xlogx(x):
+        return jnp.where(x > 0, x * jnp.log(x), 0.0)
+
+    @jax.jit
+    def block(Cb, rc, diag_start):
+        k11 = Cb
+        k12 = jnp.maximum(rc[:, None] - k11, 0.0)
+        k21 = jnp.maximum(col_counts_j[None, :] - k11, 0.0)
+        k22 = jnp.maximum(n_users - k11 - k12 - k21, 0.0)
+        rowe = xlogx(k11 + k12) + xlogx(k21 + k22)
+        cole = xlogx(k11 + k21) + xlogx(k12 + k22)
+        mate = xlogx(k11) + xlogx(k12) + xlogx(k21) + xlogx(k22)
+        llr = 2.0 * (mate - rowe - cole + xlogx(jnp.float32(n_users)))
+        llr = jnp.where(k11 > 0, llr, -jnp.inf)
+        llr = jnp.where(llr >= threshold, llr, -jnp.inf)
+        if same_space:
+            r = jnp.arange(Cb.shape[0])[:, None] + diag_start
+            c = jnp.arange(n_b)[None, :]
+            llr = jnp.where(r == c, -jnp.inf, llr)
+        vals, idxs = jax.lax.top_k(llr, k)
+        return idxs, vals
+
+    out_i = np.zeros((n_a, k), np.int32)
+    out_v = np.zeros((n_a, k), np.float32)
+    for start in range(0, n_a, row_block):
+        stop = min(start + row_block, n_a)
+        idxs, vals = block(jnp.asarray(C[start:stop]),
+                           jnp.asarray(row_counts[start:stop], jnp.float32),
+                           start)
+        out_i[start:stop] = np.asarray(idxs)
+        out_v[start:stop] = np.asarray(vals)
+    return out_i, out_v
+
+
+def cco_indicators(
+    primary_pairs: Tuple[np.ndarray, np.ndarray],
+    event_pairs: Dict[str, Tuple[np.ndarray, np.ndarray]],
+    n_users: int,
+    n_items_primary: int,
+    n_items_by_event: Dict[str, int],
+    params: Optional[CCOParams] = None,
+) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+    """Compute LLR-filtered indicators for every event type.
+
+    ``primary_pairs`` = (user_idx, item_idx) of the primary (conversion)
+    event; ``event_pairs[e]`` likewise for each event type (the primary
+    should be included under its own name to get classic co-occurrence).
+    Returns ``{event: (indices [n_items_primary, k], llr scores)}``.
+    """
+    p = params or CCOParams()
+    prim = _csr_from_pairs(*primary_pairs, n_users, n_items_primary)
+    prim_item_counts = np.bincount(prim[1], minlength=n_items_primary).astype(np.float32)
+
+    out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    for name, (eu, ei) in event_pairs.items():
+        n_b = n_items_by_event[name]
+        sec = _csr_from_pairs(eu, ei, n_users, n_b)
+        sec_item_counts = np.bincount(sec[1], minlength=n_b).astype(np.float32)
+        C = _cooccurrence(prim, sec, n_users, n_items_primary, n_b,
+                          p.user_chunk)
+        same = (name == "__primary__") or (n_b == n_items_primary and
+                                           np.array_equal(ei, primary_pairs[1]) and
+                                           np.array_equal(eu, primary_pairs[0]))
+        idxs, vals = _llr_topk(C, prim_item_counts, sec_item_counts, n_users,
+                               p.max_indicators_per_item, p.llr_threshold,
+                               p.row_block, same)
+        out[name] = (idxs, vals)
+    return out
+
+
+def score_user(
+    indicators: Dict[str, Tuple[np.ndarray, np.ndarray]],
+    history: Dict[str, Sequence[int]],
+    n_items: int,
+    boosts: Optional[Dict[str, float]] = None,
+) -> np.ndarray:
+    """Score all items for one user from their per-event history.
+
+    score(j) = Σ_e boost_e · Σ_{h ∈ history_e} [h ∈ indicators_e(j)] · llr
+    — the host-side analogue of the reference's Elasticsearch
+    similarity query over indicator fields.
+    """
+    scores = np.zeros(n_items, np.float32)
+    for name, hist in history.items():
+        if name not in indicators or len(hist) == 0:
+            continue
+        idxs, vals = indicators[name]
+        boost = (boosts or {}).get(name, 1.0)
+        hset = set(int(h) for h in hist)
+        # rows = items; find rows whose indicator lists intersect history
+        mask = np.isin(idxs, list(hset)) & np.isfinite(vals)
+        contrib = (np.where(mask, vals, 0.0)).sum(axis=1)
+        scores += boost * contrib
+    return scores

@@ -1,0 +1,164 @@
+"""Two-tower retrieval model (flax + optax), data-parallel over the mesh.
+
+The deep-retrieval target of BASELINE.json (config 5) — not present in
+the reference (SURVEY.md §2c lists it as the new-framework extension):
+user and item ID-embedding towers with MLP heads, trained with in-batch
+sampled-softmax contrastive loss. TPU mapping: batches are sharded over
+the ``data`` mesh axis (XLA inserts the gradient all-reduce), embeddings
+and MLP weights replicated; serving scores a user embedding against the
+full item-embedding table with one MXU matmul + top_k.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class TwoTowerParams:
+    embed_dim: int = 32
+    hidden: List[int] = field(default_factory=lambda: [64])
+    out_dim: int = 32
+    batch_size: int = 1024
+    epochs: int = 5
+    learning_rate: float = 0.01
+    temperature: float = 0.1
+    seed: int = 0
+
+
+def _towers(n_users: int, n_items: int, p: TwoTowerParams):
+    import flax.linen as nn
+
+    class Tower(nn.Module):
+        vocab: int
+        p: TwoTowerParams
+
+        @nn.compact
+        def __call__(self, ids):
+            x = nn.Embed(self.vocab, self.p.embed_dim,
+                         embedding_init=nn.initializers.normal(0.05))(ids)
+            for h in self.p.hidden:
+                x = nn.relu(nn.Dense(h)(x))
+            x = nn.Dense(self.p.out_dim)(x)
+            # L2-normalized embeddings → cosine retrieval
+            return x / (np.float32(1e-8) + jnp_norm(x))
+
+    def jnp_norm(x):
+        import jax.numpy as jnp
+
+        return jnp.linalg.norm(x, axis=-1, keepdims=True)
+
+    return Tower(n_users, p), Tower(n_items, p)
+
+
+def two_tower_train(
+    user_idx: np.ndarray, item_idx: np.ndarray,
+    n_users: int, n_items: int,
+    params: TwoTowerParams, mesh=None,
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Train on positive (user, item) pairs; returns (user_variables,
+    item_variables) flax param pytrees (host numpy)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    p = params
+    user_tower, item_tower = _towers(n_users, n_items, p)
+    rng = jax.random.PRNGKey(p.seed)
+    ru, ri = jax.random.split(rng)
+    uv = user_tower.init(ru, jnp.zeros((1,), jnp.int32))
+    iv = item_tower.init(ri, jnp.zeros((1,), jnp.int32))
+
+    opt = optax.adam(p.learning_rate)
+
+    def loss_fn(variables, bu, bi):
+        uvv, ivv = variables
+        ue = user_tower.apply(uvv, bu)          # (B, D)
+        ie = item_tower.apply(ivv, bi)          # (B, D)
+        logits = (ue @ ie.T) / p.temperature    # in-batch negatives
+        labels = jnp.arange(bu.shape[0])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    @jax.jit
+    def train_epoch(variables, opt_state, users_e, items_e):
+        def step(carry, batch):
+            variables, opt_state = carry
+            bu, bi = batch
+            loss, grads = jax.value_and_grad(loss_fn)(variables, bu, bi)
+            updates, opt_state = opt.update(grads, opt_state)
+            variables = optax.apply_updates(variables, updates)
+            return (variables, opt_state), loss
+
+        (variables, opt_state), losses = jax.lax.scan(
+            step, (variables, opt_state), (users_e, items_e))
+        return variables, opt_state, losses.mean()
+
+    n = len(user_idx)
+    if n < 2:
+        raise ValueError("two-tower training needs at least 2 positive pairs "
+                         "(in-batch negatives)")
+    n_dev = 1
+    if mesh is not None and int(np.prod(mesh.devices.shape)) > 1:
+        n_dev = int(np.prod(mesh.devices.shape))
+    B = min(p.batch_size, n)
+    if n_dev > 1:
+        # batch axis is sharded over the mesh → must divide evenly
+        B = max(n_dev, (B // n_dev) * n_dev)
+        if B > n:  # too few pairs to fill one sharded batch → run unsharded
+            n_dev = 1
+            B = min(p.batch_size, n)
+    n_batches = max(1, n // B)
+    variables = (uv, iv)
+    opt_state = opt.init(variables)
+    host_rng = np.random.default_rng(p.seed)
+
+    if n_dev > 1:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        batch_sharding = NamedSharding(mesh, PartitionSpec(None, "data"))
+    else:
+        batch_sharding = None
+
+    last_loss = None
+    for _ in range(p.epochs):
+        perm = host_rng.permutation(n)[: n_batches * B]
+        ue = user_idx[perm].reshape(n_batches, B).astype(np.int32)
+        ie = item_idx[perm].reshape(n_batches, B).astype(np.int32)
+        if batch_sharding is not None:
+            ue = jax.device_put(ue, batch_sharding)
+            ie = jax.device_put(ie, batch_sharding)
+        variables, opt_state, last_loss = train_epoch(
+            variables, opt_state, jnp.asarray(ue), jnp.asarray(ie))
+    uvv, ivv = variables
+    return (jax.tree.map(np.asarray, uvv), jax.tree.map(np.asarray, ivv))
+
+
+def _tower_forward_np(variables, ids: np.ndarray) -> np.ndarray:
+    """Numpy replay of the tower forward pass (Embed → Dense+relu… → Dense
+    → L2 normalize). Serving stays off the accelerator: a per-query tower
+    pass is a handful of tiny GEMVs — host numpy beats a device dispatch
+    on p50 and keeps serving alive when no accelerator is attached."""
+    p = variables["params"]
+    x = np.asarray(p["Embed_0"]["embedding"])[ids]
+    dense_names = sorted((k for k in p if k.startswith("Dense_")),
+                         key=lambda k: int(k.split("_")[1]))
+    for j, name in enumerate(dense_names):
+        x = x @ np.asarray(p[name]["kernel"]) + np.asarray(p[name]["bias"])
+        if j < len(dense_names) - 1:
+            x = np.maximum(x, 0.0)
+    return x / (1e-8 + np.linalg.norm(x, axis=-1, keepdims=True))
+
+
+def two_tower_embed_items(item_variables, n_items: int,
+                          params: TwoTowerParams) -> np.ndarray:
+    """Precompute the full item-embedding table for serving."""
+    return _tower_forward_np(item_variables, np.arange(n_items))
+
+
+def two_tower_user_embed(user_variables, user_id: int, n_users: int,
+                         params: TwoTowerParams) -> np.ndarray:
+    return _tower_forward_np(user_variables, np.asarray([user_id]))[0]

@@ -10,5 +10,10 @@ engine directory (``pio template new <name> <dir>``).
 # grown as templates land; `pio template list` reflects exactly this dict
 TEMPLATES = {
     "recommendation": "predictionio_tpu.templates.recommendation.engine",
+    "classification": "predictionio_tpu.templates.classification.engine",
+    "similarproduct": "predictionio_tpu.templates.similarproduct.engine",
+    "ecommercerecommendation": "predictionio_tpu.templates.ecommercerecommendation.engine",
+    "universal": "predictionio_tpu.templates.universal.engine",
+    "twotower": "predictionio_tpu.templates.twotower.engine",
     "vanilla": "predictionio_tpu.templates.vanilla.engine",
 }

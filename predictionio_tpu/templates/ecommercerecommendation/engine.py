@@ -1,0 +1,228 @@
+"""E-Commerce Recommendation template: implicit ALS + live business rules.
+
+Behavioral equivalent of the reference's e-commerce template (reference:
+[U] examples/scala-parallel-ecommercerecommendation/ — implicit ALS on
+view/buy events; at query time: exclude items the user has seen (read
+LIVE from the event store), exclude globally unavailable items (a
+``constraint`` entity's ``$set`` events, read live so ops can flip
+availability without retraining), category filter, white/black lists,
+and a popularity fallback for unknown/cold-start users; SURVEY.md §2c).
+
+    POST /queries.json {"user": "u1", "num": 4, "categories": ["c1"],
+                        "whiteList": [], "blackList": ["i3"]}
+    → {"itemScores": [{"item": "i2", "score": 1.2}, ...]}
+
+The live lookups run host-side around the resident-factor scoring —
+serving-time business rules stay out of the compiled path.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    WorkflowContext,
+)
+from predictionio_tpu.data import store as event_store
+from predictionio_tpu.models.als import ALSParams, RatingsCOO, als_train, recommend
+from predictionio_tpu.utils.bimap import BiMap
+
+
+@dataclass
+class DataSourceParams:
+    app_name: str = ""
+    event_names: List[str] = field(default_factory=lambda: ["view", "buy"])
+
+
+@dataclass
+class TrainingData:
+    app_name: str
+    interactions: List[tuple]  # (user, item, weight)
+    item_categories: Dict[str, List[str]]
+
+
+class ECommDataSource(DataSource):
+    ParamsClass = DataSourceParams
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        p: DataSourceParams = self.params
+        inter = []
+        for e in event_store.find(
+            p.app_name, entity_type="user", target_entity_type="item",
+            event_names=p.event_names, storage=ctx.storage,
+        ):
+            if e.target_entity_id is None:
+                continue
+            weight = 4.0 if e.event == "buy" else 1.0  # buys count harder
+            inter.append((e.entity_id, e.target_entity_id, weight))
+        if not inter:
+            raise ValueError("no view/buy events found")
+        cats = {
+            entity_id: list(props.get("categories") or [])
+            for entity_id, props in event_store.aggregate_properties(
+                p.app_name, "item", storage=ctx.storage).items()
+        }
+        return TrainingData(p.app_name, inter, cats)
+
+
+@dataclass
+class ECommAlgorithmParams:
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: Optional[int] = None
+    # live-rule knobs (reference: unseenOnly, seenEvents, similarEvents)
+    unseen_only: bool = True
+    seen_events: List[str] = field(default_factory=lambda: ["view", "buy"])
+
+
+class ECommModel:
+    def __init__(self, U: np.ndarray, V: np.ndarray, user_ids: BiMap,
+                 item_ids: BiMap, item_categories: Dict[str, List[str]],
+                 popularity: np.ndarray, app_name: str,
+                 params: "ECommAlgorithmParams") -> None:
+        self.U = U
+        self.V = V
+        self.user_ids = user_ids
+        self.item_ids = item_ids
+        self._inv = item_ids.inverse()
+        self.item_categories = item_categories
+        self.popularity = popularity  # per item index, for cold start
+        self.app_name = app_name
+        self.params = params
+
+    # -- live lookups (host-side, storage at serving time) --------------------
+
+    def _seen_items(self, user: str, storage) -> Set[str]:
+        if not self.params.unseen_only:
+            return set()
+        evs = event_store.find_by_entity(
+            self.app_name, "user", user,
+            event_names=self.params.seen_events,
+            target_entity_type="item", limit=None, storage=storage)
+        return {e.target_entity_id for e in evs if e.target_entity_id}
+
+    def _unavailable_items(self, storage) -> Set[str]:
+        """Latest $set on the 'constraint' entity 'unavailableItems'
+        (reference behavior: ops toggle availability live)."""
+        snap = event_store.aggregate_properties(self.app_name, "constraint",
+                                                storage=storage)
+        pm = snap.get("unavailableItems")
+        if pm is None:
+            return set()
+        return set(pm.get("items") or [])
+
+    def query(self, user: str, num: int,
+              categories: Optional[List[str]] = None,
+              white_list: Optional[List[str]] = None,
+              black_list: Optional[List[str]] = None,
+              storage=None) -> List[Dict[str, Any]]:
+        banned = self._unavailable_items(storage) | set(black_list or [])
+        banned |= self._seen_items(user, storage)
+        cats = set(categories or [])
+        white = set(white_list or [])
+
+        uidx = self.user_ids.get(user)
+        if uidx is not None:
+            top, scores = recommend(self.U, self.V, uidx,
+                                    min(len(self.item_ids),
+                                        num + len(banned) + 50))
+            ranked = [(self._inv[int(i)], float(s)) for i, s in zip(top, scores)]
+        else:
+            # cold start: popularity fallback (reference behavior)
+            order = np.argsort(-self.popularity)
+            ranked = [(self._inv[int(i)], float(self.popularity[i]))
+                      for i in order]
+
+        out = []
+        for item, score in ranked:
+            if item in banned:
+                continue
+            if white and item not in white:
+                continue
+            if cats and not cats.intersection(self.item_categories.get(item, [])):
+                continue
+            out.append({"item": item, "score": score})
+            if len(out) >= num:
+                break
+        return out
+
+
+class ECommAlgorithm(Algorithm):
+    ParamsClass = ECommAlgorithmParams
+
+    def sanity_check(self, data: TrainingData) -> None:
+        if not data.interactions:
+            raise ValueError("empty interactions")
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> ECommModel:
+        p: ECommAlgorithmParams = self.params
+        user_ids = BiMap.string_int(u for u, _, _ in pd.interactions)
+        item_ids = BiMap.string_int(i for _, i, _ in pd.interactions)
+        agg: Counter = Counter()
+        for u, i, w in pd.interactions:
+            agg[(user_ids[u], item_ids[i])] += w
+        uu = np.fromiter((k[0] for k in agg), np.int32, len(agg))
+        ii = np.fromiter((k[1] for k in agg), np.int32, len(agg))
+        vv = np.fromiter(agg.values(), np.float32, len(agg))
+        coo = RatingsCOO(uu, ii, vv, len(user_ids), len(item_ids))
+        U, V = als_train(
+            coo,
+            ALSParams(rank=p.rank, iterations=p.num_iterations, reg=p.lambda_,
+                      implicit=True, alpha=p.alpha,
+                      seed=0 if p.seed is None else p.seed),
+            mesh=ctx.mesh)
+        popularity = np.bincount(ii, weights=vv, minlength=len(item_ids))
+        return ECommModel(U, V, user_ids, item_ids, pd.item_categories,
+                          popularity.astype(np.float32), pd.app_name, p)
+
+    def predict(self, model: ECommModel, query: Dict[str, Any]) -> Dict[str, Any]:
+        return {"itemScores": model.query(
+            str(query["user"]),
+            int(query.get("num", 10)),
+            query.get("categories"),
+            query.get("whiteList"),
+            query.get("blackList"),
+            storage=self.serving_storage,  # live rules read the deploy Storage
+        )}
+
+    def save_model(self, model: ECommModel, instance_dir: Optional[str]) -> bytes:
+        buf = io.BytesIO()
+        np.savez_compressed(buf, U=model.U, V=model.V, pop=model.popularity)
+        return pickle.dumps({
+            "npz": buf.getvalue(),
+            "user_ids": model.user_ids.to_dict(),
+            "item_ids": model.item_ids.to_dict(),
+            "cats": model.item_categories,
+            "app_name": model.app_name,
+            "params": self.params,
+        })
+
+    def load_model(self, blob: Optional[bytes], instance_dir: Optional[str]) -> ECommModel:
+        assert blob is not None
+        d = pickle.loads(blob)
+        arrs = np.load(io.BytesIO(d["npz"]))
+        return ECommModel(arrs["U"], arrs["V"], BiMap(d["user_ids"]),
+                          BiMap(d["item_ids"]), d["cats"], arrs["pop"],
+                          d["app_name"], d["params"])
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_cls=ECommDataSource,
+        preparator_cls=IdentityPreparator,
+        algorithm_cls_map={"ecomm": ECommAlgorithm},
+        serving_cls=FirstServing,
+    )

@@ -1,0 +1,176 @@
+"""Similar Product template: item-to-item similarity from ALS factors.
+
+Behavioral equivalent of the reference's similar-product template
+(reference: [U] examples/scala-parallel-similarproduct/ — "view" events
+→ implicit ALS; query = list of liked items → top-K cosine-similar
+items, with category/whitelist/blacklist filters; SURVEY.md §2c).
+
+    POST /queries.json {"items": ["i1", "i3"], "num": 4,
+                        "categories": ["c1"], "blackList": ["i5"]}
+    → {"itemScores": [{"item": "i2", "score": 0.87}, ...]}
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    WorkflowContext,
+)
+from predictionio_tpu.data import store as event_store
+from predictionio_tpu.models.als import (
+    ALSParams,
+    RatingsCOO,
+    als_train,
+    similar_items,
+)
+from predictionio_tpu.utils.bimap import BiMap
+
+
+@dataclass
+class DataSourceParams:
+    app_name: str = ""
+    event_names: List[str] = field(default_factory=lambda: ["view"])
+
+
+@dataclass
+class TrainingData:
+    views: List[tuple]             # (user, item) pairs
+    item_categories: Dict[str, List[str]]  # from $set item properties
+
+
+class SimilarProductDataSource(DataSource):
+    ParamsClass = DataSourceParams
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        p: DataSourceParams = self.params
+        views = [
+            (e.entity_id, e.target_entity_id)
+            for e in event_store.find(
+                p.app_name, entity_type="user", target_entity_type="item",
+                event_names=p.event_names, storage=ctx.storage)
+            if e.target_entity_id is not None
+        ]
+        if not views:
+            raise ValueError("no view events found; import events before training")
+        cats = {
+            entity_id: list(props.get("categories") or [])
+            for entity_id, props in event_store.aggregate_properties(
+                p.app_name, "item", storage=ctx.storage).items()
+        }
+        return TrainingData(views, cats)
+
+
+@dataclass
+class ALSAlgorithmParams:
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: Optional[int] = None
+
+
+class SimilarProductModel:
+    def __init__(self, V: np.ndarray, item_ids: BiMap,
+                 item_categories: Dict[str, List[str]]) -> None:
+        self.V = V
+        self.item_ids = item_ids
+        self._inv = item_ids.inverse()
+        self.item_categories = item_categories
+
+    def query(self, items: List[str], num: int,
+              categories: Optional[List[str]] = None,
+              white_list: Optional[List[str]] = None,
+              black_list: Optional[List[str]] = None) -> List[Dict[str, Any]]:
+        idxs = np.asarray([self.item_ids[i] for i in items
+                           if i in self.item_ids], np.int32)
+        if idxs.size == 0:
+            return []
+        # over-fetch so post-filters still fill `num`
+        top, scores = similar_items(self.V, idxs, min(len(self.item_ids),
+                                                      num + idxs.size + 50))
+        cats = set(categories or [])
+        white = set(white_list or [])
+        black = set(black_list or [])
+        out = []
+        for i, s in zip(top, scores):
+            item = self._inv[int(i)]
+            if white and item not in white:
+                continue
+            if item in black:
+                continue
+            if cats and not cats.intersection(self.item_categories.get(item, [])):
+                continue
+            out.append({"item": item, "score": float(s)})
+            if len(out) >= num:
+                break
+        return out
+
+
+class ALSAlgorithm(Algorithm):
+    ParamsClass = ALSAlgorithmParams
+
+    def sanity_check(self, data: TrainingData) -> None:
+        if not data.views:
+            raise ValueError("empty view data")
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> SimilarProductModel:
+        p: ALSAlgorithmParams = self.params
+        user_ids = BiMap.string_int(u for u, _ in pd.views)
+        item_ids = BiMap.string_int(i for _, i in pd.views)
+        counts = Counter((user_ids[u], item_ids[i]) for u, i in pd.views)
+        uu = np.fromiter((k[0] for k in counts), np.int32, len(counts))
+        ii = np.fromiter((k[1] for k in counts), np.int32, len(counts))
+        vv = np.fromiter(counts.values(), np.float32, len(counts))
+        coo = RatingsCOO(uu, ii, vv, len(user_ids), len(item_ids))
+        _, V = als_train(
+            coo,
+            ALSParams(rank=p.rank, iterations=p.num_iterations, reg=p.lambda_,
+                      implicit=True, alpha=p.alpha,
+                      seed=0 if p.seed is None else p.seed),
+            mesh=ctx.mesh)
+        return SimilarProductModel(V, item_ids, pd.item_categories)
+
+    def predict(self, model: SimilarProductModel, query: Dict[str, Any]) -> Dict[str, Any]:
+        return {"itemScores": model.query(
+            [str(i) for i in query.get("items", [])],
+            int(query.get("num", 10)),
+            query.get("categories"),
+            query.get("whiteList"),
+            query.get("blackList"),
+        )}
+
+    def save_model(self, model: SimilarProductModel, instance_dir: Optional[str]) -> bytes:
+        buf = io.BytesIO()
+        np.savez_compressed(buf, V=model.V)
+        return pickle.dumps({
+            "npz": buf.getvalue(),
+            "item_ids": model.item_ids.to_dict(),
+            "cats": model.item_categories,
+        })
+
+    def load_model(self, blob: Optional[bytes], instance_dir: Optional[str]) -> SimilarProductModel:
+        assert blob is not None
+        d = pickle.loads(blob)
+        arrs = np.load(io.BytesIO(d["npz"]))
+        return SimilarProductModel(arrs["V"], BiMap(d["item_ids"]), d["cats"])
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_cls=SimilarProductDataSource,
+        preparator_cls=IdentityPreparator,
+        algorithm_cls_map={"als": ALSAlgorithm},
+        serving_cls=FirstServing,
+    )

@@ -1,0 +1,154 @@
+"""Two-Tower deep retrieval template.
+
+The new-framework extension target (BASELINE.json config 5; absent in
+the reference — SURVEY.md §2c): flax user/item towers trained with
+in-batch contrastive loss on positive interaction events, served by
+cosine retrieval over the precomputed item-embedding table.
+
+    POST /queries.json {"user": "u1", "num": 4}
+    → {"itemScores": [{"item": "i2", "score": 0.93}, ...]}
+"""
+
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    WorkflowContext,
+)
+from predictionio_tpu.data import store as event_store
+from predictionio_tpu.models.two_tower import (
+    TwoTowerParams,
+    two_tower_embed_items,
+    two_tower_train,
+    two_tower_user_embed,
+)
+from predictionio_tpu.utils.bimap import BiMap
+
+
+@dataclass
+class DataSourceParams:
+    app_name: str = ""
+    event_names: List[str] = field(default_factory=lambda: ["view", "buy"])
+
+
+@dataclass
+class TrainingData:
+    pairs: List[tuple]  # positive (user, item)
+
+
+class TTDataSource(DataSource):
+    ParamsClass = DataSourceParams
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        p: DataSourceParams = self.params
+        pairs = [
+            (e.entity_id, e.target_entity_id)
+            for e in event_store.find(
+                p.app_name, entity_type="user", target_entity_type="item",
+                event_names=p.event_names, storage=ctx.storage)
+            if e.target_entity_id is not None
+        ]
+        if not pairs:
+            raise ValueError("no interaction events found")
+        return TrainingData(pairs)
+
+
+@dataclass
+class TTAlgorithmParams:
+    embed_dim: int = 32
+    out_dim: int = 32
+    hidden: List[int] = field(default_factory=lambda: [64])
+    batch_size: int = 1024
+    epochs: int = 5
+    learning_rate: float = 0.01
+    temperature: float = 0.1
+    seed: int = 0
+
+
+class TwoTowerModel:
+    def __init__(self, user_vars, item_embeds: np.ndarray, user_ids: BiMap,
+                 item_ids: BiMap, params: TwoTowerParams) -> None:
+        self.user_vars = user_vars
+        self.item_embeds = item_embeds
+        self.user_ids = user_ids
+        self.item_ids = item_ids
+        self._inv = item_ids.inverse()
+        self.params = params
+
+    def recommend(self, user: str, num: int) -> List[Dict[str, Any]]:
+        uidx = self.user_ids.get(user)
+        if uidx is None:
+            return []
+        ue = two_tower_user_embed(self.user_vars, uidx, len(self.user_ids),
+                                  self.params)
+        scores = self.item_embeds @ ue
+        num = min(num, scores.shape[0])
+        top = np.argpartition(-scores, num - 1)[:num]
+        top = top[np.argsort(-scores[top])]
+        return [{"item": self._inv[int(i)], "score": float(scores[i])}
+                for i in top]
+
+
+class TwoTowerAlgorithm(Algorithm):
+    ParamsClass = TTAlgorithmParams
+
+    def sanity_check(self, data: TrainingData) -> None:
+        if not data.pairs:
+            raise ValueError("empty training pairs")
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> TwoTowerModel:
+        p: TTAlgorithmParams = self.params
+        user_ids = BiMap.string_int(u for u, _ in pd.pairs)
+        item_ids = BiMap.string_int(i for _, i in pd.pairs)
+        uidx = np.fromiter((user_ids[u] for u, _ in pd.pairs), np.int32,
+                           len(pd.pairs))
+        iidx = np.fromiter((item_ids[i] for _, i in pd.pairs), np.int32,
+                           len(pd.pairs))
+        tp = TwoTowerParams(
+            embed_dim=p.embed_dim, hidden=list(p.hidden), out_dim=p.out_dim,
+            batch_size=p.batch_size, epochs=p.epochs,
+            learning_rate=p.learning_rate, temperature=p.temperature,
+            seed=p.seed)
+        uv, iv = two_tower_train(uidx, iidx, len(user_ids), len(item_ids),
+                                 tp, mesh=ctx.mesh)
+        item_embeds = two_tower_embed_items(iv, len(item_ids), tp)
+        return TwoTowerModel(uv, item_embeds, user_ids, item_ids, tp)
+
+    def predict(self, model: TwoTowerModel, query: Dict[str, Any]) -> Dict[str, Any]:
+        return {"itemScores": model.recommend(str(query["user"]),
+                                              int(query.get("num", 10)))}
+
+    def save_model(self, model: TwoTowerModel, instance_dir: Optional[str]) -> bytes:
+        return pickle.dumps({
+            "user_vars": model.user_vars,
+            "item_embeds": model.item_embeds,
+            "user_ids": model.user_ids.to_dict(),
+            "item_ids": model.item_ids.to_dict(),
+            "params": model.params,
+        })
+
+    def load_model(self, blob: Optional[bytes], instance_dir: Optional[str]) -> TwoTowerModel:
+        assert blob is not None
+        d = pickle.loads(blob)
+        return TwoTowerModel(d["user_vars"], d["item_embeds"],
+                             BiMap(d["user_ids"]), BiMap(d["item_ids"]),
+                             d["params"])
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_cls=TTDataSource,
+        preparator_cls=IdentityPreparator,
+        algorithm_cls_map={"twotower": TwoTowerAlgorithm},
+        serving_cls=FirstServing,
+    )

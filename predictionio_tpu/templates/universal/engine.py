@@ -1,0 +1,182 @@
+"""Universal Recommender template: multi-event CCO + LLR indicators.
+
+Behavioral equivalent of the ActionML Universal Recommender (reference
+behavior: Mahout-Samsara CCO — LLR-thresholded co-occurrence of the
+primary conversion event against every secondary event type, indicators
+indexed in Elasticsearch and queried by user history; SURVEY.md §2c
+config 4). Here the indicators live in the model and scoring runs
+host-side over the resident indicator arrays; the co-occurrence and LLR
+math runs on TPU (:mod:`predictionio_tpu.models.cco`).
+
+    POST /queries.json {"user": "u1", "num": 4,
+                        "eventBoosts": {"view": 0.5}}
+    → {"itemScores": [{"item": "i2", "score": 12.3}, ...]}
+
+Item-based queries are supported too: {"item": "i1", "num": 4} returns
+the item's own-event indicators (similar items by LLR).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    WorkflowContext,
+)
+from predictionio_tpu.data import store as event_store
+from predictionio_tpu.models.cco import CCOParams, cco_indicators, score_user
+from predictionio_tpu.utils.bimap import BiMap
+
+
+@dataclass
+class DataSourceParams:
+    app_name: str = ""
+    # first name is the primary (conversion) event, rest are secondary
+    event_names: List[str] = field(default_factory=lambda: ["buy", "view"])
+
+
+@dataclass
+class TrainingData:
+    app_name: str
+    # per event name: list of (user, item)
+    events: Dict[str, List[tuple]]
+
+
+class URDataSource(DataSource):
+    ParamsClass = DataSourceParams
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        p: DataSourceParams = self.params
+        per: Dict[str, List[tuple]] = {name: [] for name in p.event_names}
+        for e in event_store.find(
+            p.app_name, entity_type="user", target_entity_type="item",
+            event_names=p.event_names, storage=ctx.storage,
+        ):
+            if e.target_entity_id is not None:
+                per[e.event].append((e.entity_id, e.target_entity_id))
+        if not per[p.event_names[0]]:
+            raise ValueError(
+                f"no primary event {p.event_names[0]!r} found; import events first")
+        return TrainingData(p.app_name, per)
+
+
+@dataclass
+class URAlgorithmParams:
+    max_indicators_per_item: int = 50
+    llr_threshold: float = 0.0
+    event_boosts: Dict[str, float] = field(default_factory=dict)
+    # live exclusions at query time, like the reference's blacklistEvents
+    blacklist_events: List[str] = field(default_factory=list)
+
+
+class URModel:
+    def __init__(self, indicators, user_history, item_ids: BiMap,
+                 primary_event: str, params: URAlgorithmParams,
+                 popularity: np.ndarray) -> None:
+        self.indicators = indicators          # {event: (idxs, llr)}
+        self.user_history = user_history      # {user: {event: [item_idx]}}
+        self.item_ids = item_ids
+        self._inv = item_ids.inverse()
+        self.primary_event = primary_event
+        self.params = params
+        self.popularity = popularity
+
+    def query_user(self, user: str, num: int,
+                   boosts: Optional[Dict[str, float]] = None,
+                   black_list: Optional[List[str]] = None) -> List[Dict[str, Any]]:
+        hist = self.user_history.get(user)
+        n_items = len(self.item_ids)
+        if hist:
+            scores = score_user(self.indicators, hist, n_items,
+                                boosts or self.params.event_boosts or None)
+            if not scores.any():
+                scores = self.popularity.copy()
+        else:
+            scores = self.popularity.copy()  # cold start
+        banned = {self.item_ids[b] for b in (black_list or [])
+                  if b in self.item_ids}
+        # exclude the user's own primary-event items (don't re-recommend buys)
+        if hist:
+            banned.update(hist.get(self.primary_event, []))
+        if banned:
+            scores[list(banned)] = -np.inf
+        num = min(num, n_items)
+        top = np.argpartition(-scores, num - 1)[:num]
+        top = top[np.argsort(-scores[top])]
+        return [{"item": self._inv[int(i)], "score": float(scores[i])}
+                for i in top if np.isfinite(scores[i]) and scores[i] > 0]
+
+    def query_item(self, item: str, num: int) -> List[Dict[str, Any]]:
+        iidx = self.item_ids.get(item)
+        if iidx is None:
+            return []
+        idxs, vals = self.indicators[self.primary_event]
+        out = []
+        for j, v in zip(idxs[iidx], vals[iidx]):
+            if np.isfinite(v) and len(out) < num:
+                out.append({"item": self._inv[int(j)], "score": float(v)})
+        return out
+
+
+class URAlgorithm(Algorithm):
+    ParamsClass = URAlgorithmParams
+
+    def sanity_check(self, data: TrainingData) -> None:
+        if not any(data.events.values()):
+            raise ValueError("no events")
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> URModel:
+        p: URAlgorithmParams = self.params
+        primary = next(iter(pd.events))
+        all_users = (u for pairs in pd.events.values() for u, _ in pairs)
+        all_items = (i for pairs in pd.events.values() for _, i in pairs)
+        user_ids = BiMap.string_int(all_users)
+        item_ids = BiMap.string_int(all_items)
+        n_items = len(item_ids)
+
+        def to_idx(pairs):
+            return (np.asarray([user_ids[u] for u, _ in pairs], np.int32),
+                    np.asarray([item_ids[i] for _, i in pairs], np.int32))
+
+        event_pairs = {name: to_idx(pairs) for name, pairs in pd.events.items()
+                       if pairs}
+        indicators = cco_indicators(
+            event_pairs[primary], event_pairs, len(user_ids), n_items,
+            {name: n_items for name in event_pairs},
+            CCOParams(max_indicators_per_item=p.max_indicators_per_item,
+                      llr_threshold=p.llr_threshold))
+
+        user_history: Dict[str, Dict[str, List[int]]] = {}
+        for name, pairs in pd.events.items():
+            for u, i in pairs:
+                user_history.setdefault(u, {}).setdefault(name, []).append(
+                    item_ids[i])
+        pu, pi = event_pairs[primary]
+        popularity = np.bincount(pi, minlength=n_items).astype(np.float32)
+        return URModel(indicators, user_history, item_ids, primary, p,
+                       popularity)
+
+    def predict(self, model: URModel, query: Dict[str, Any]) -> Dict[str, Any]:
+        num = int(query.get("num", 10))
+        if "item" in query:
+            return {"itemScores": model.query_item(str(query["item"]), num)}
+        return {"itemScores": model.query_user(
+            str(query["user"]), num,
+            query.get("eventBoosts"), query.get("blackList"))}
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_cls=URDataSource,
+        preparator_cls=IdentityPreparator,
+        algorithm_cls_map={"ur": URAlgorithm},
+        serving_cls=FirstServing,
+    )

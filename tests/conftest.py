@@ -25,12 +25,11 @@ os.environ["PIO_MESH_PLATFORM"] = "cpu"
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
-from predictionio_tpu.parallel.mesh import platform_devices  # noqa: E402
-
-# route default (non-mesh) computations to CPU too — tests must not
-# depend on the tunneled TPU chip (platform_devices tolerates a broken
-# TPU/axon backend by restricting jax to cpu)
-jax.config.update("jax_default_device", platform_devices("cpu")[0])
+# Restrict jax to the CPU platform BEFORE any backend initialization:
+# merely asking for jax.devices("cpu") would initialize every platform in
+# JAX_PLATFORMS first, and a wedged TPU tunnel then hangs the whole test
+# run. Tests must never depend on the tunneled TPU chip.
+jax.config.update("jax_platforms", "cpu")
 
 from predictionio_tpu.storage.meta import MetaStore  # noqa: E402
 from predictionio_tpu.storage.models import MemoryModelStore  # noqa: E402

@@ -1,0 +1,128 @@
+"""CCO/LLR kernel + Universal Recommender template tests (the
+reference's config-4 capability, SURVEY.md §2c)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.workflow import prepare_deploy, run_train
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.models.cco import (
+    CCOParams,
+    _csr_from_pairs,
+    cco_indicators,
+    score_user,
+)
+
+UR_FACTORY = "predictionio_tpu.templates.universal.engine:engine_factory"
+
+
+def llr_reference(k11, k12, k21, k22):
+    """Scalar Dunning LLR for cross-checking the vectorized kernel."""
+    def xlogx(x):
+        return x * np.log(x) if x > 0 else 0.0
+    N = k11 + k12 + k21 + k22
+    mat = xlogx(k11) + xlogx(k12) + xlogx(k21) + xlogx(k22)
+    row = xlogx(k11 + k12) + xlogx(k21 + k22)
+    col = xlogx(k11 + k21) + xlogx(k12 + k22)
+    return 2.0 * (mat - row - col + xlogx(N))
+
+
+class TestCSR:
+    def test_dedup_and_order(self):
+        u = np.array([1, 0, 1, 1], np.int32)
+        i = np.array([2, 0, 2, 1], np.int32)  # (1,2) duplicated
+        indptr, idx = _csr_from_pairs(u, i, 3, 4)
+        assert indptr.tolist() == [0, 1, 3, 3]
+        assert idx.tolist() == [0, 1, 2]
+
+
+class TestCCO:
+    def test_cooccurrence_and_llr_against_reference(self):
+        # deterministic small dataset: users who buy A also buy B strongly
+        # users 0-9 buy {A=0, B=1}; users 10-14 buy {A=0, C=2}; 15-19 buy {C}
+        buys_u, buys_i = [], []
+        for u in range(10):
+            buys_u += [u, u]; buys_i += [0, 1]
+        for u in range(10, 15):
+            buys_u += [u, u]; buys_i += [0, 2]
+        for u in range(15, 20):
+            buys_u += [u]; buys_i += [2]
+        pairs = (np.asarray(buys_u, np.int32), np.asarray(buys_i, np.int32))
+        out = cco_indicators(pairs, {"buy": pairs}, 20, 3, {"buy": 3},
+                             CCOParams(max_indicators_per_item=2))
+        idxs, vals = out["buy"]
+        # item A(0): top indicator should be B(1): k11=10,k12=5,k21=0,k22=5
+        assert idxs[0, 0] == 1
+        expected = llr_reference(10, 5, 0, 5)
+        assert np.isclose(vals[0, 0], expected, rtol=1e-5), (vals[0, 0], expected)
+        # diagonal excluded
+        assert 0 not in idxs[0][np.isfinite(vals[0])]
+
+    def test_cross_event_indicators(self):
+        # viewing D(3) predicts buying A(0): all A-buyers viewed D
+        rng = np.random.default_rng(0)
+        buys = ([u for u in range(10)], [0] * 10)
+        views_u = list(range(10)) + list(range(10, 20))
+        views_i = [3] * 10 + [4] * 10  # buyers view 3, non-buyers view 4
+        pairs_b = (np.asarray(buys[0], np.int32), np.asarray(buys[1], np.int32))
+        pairs_v = (np.asarray(views_u, np.int32), np.asarray(views_i, np.int32))
+        out = cco_indicators(pairs_b, {"buy": pairs_b, "view": pairs_v}, 20,
+                             5, {"buy": 5, "view": 5},
+                             CCOParams(max_indicators_per_item=3))
+        vi, vv = out["view"]
+        assert vi[0, 0] == 3 and np.isfinite(vv[0, 0])  # D indicates A
+
+    def test_score_user(self):
+        idxs = np.array([[1, 2], [0, 2], [0, 1]], np.int32)
+        vals = np.array([[5.0, -np.inf], [3.0, 1.0], [-np.inf, -np.inf]], np.float32)
+        scores = score_user({"buy": (idxs, vals)}, {"buy": [1]}, 3)
+        # item 0's indicators contain 1 with llr 5 → score 5
+        assert scores[0] == 5.0
+        assert scores[1] == 0.0  # item 1's indicators {0,2}: no 1
+        assert scores[2] == 0.0  # all -inf masked
+
+
+def seed_ur(storage, app_name="URApp"):
+    app = storage.meta.create_app(app_name)
+    storage.events.init_channel(app.id)
+    evs = []
+    # clique 1: users 0-9 view+buy items 0-4 ; clique 2: users 10-19 → 5-9
+    rng = np.random.default_rng(3)
+    for u in range(20):
+        lo, hi = (0, 5) if u < 10 else (5, 10)
+        for i in range(lo, hi):
+            if rng.random() < 0.8:
+                evs.append(Event(event="view", entity_type="user",
+                                 entity_id=f"u{u}", target_entity_type="item",
+                                 target_entity_id=f"i{i}"))
+            if rng.random() < 0.5:
+                evs.append(Event(event="buy", entity_type="user",
+                                 entity_id=f"u{u}", target_entity_type="item",
+                                 target_entity_id=f"i{i}"))
+    storage.events.insert_batch(evs, app.id)
+    return app
+
+
+class TestUniversalTemplate:
+    VARIANT = {
+        "engineFactory": UR_FACTORY,
+        "datasource": {"params": {"appName": "URApp",
+                                  "eventNames": ["buy", "view"]}},
+        "algorithms": [{"name": "ur",
+                        "params": {"maxIndicatorsPerItem": 5}}],
+    }
+
+    def test_train_query_user_and_item(self, storage):
+        seed_ur(storage)
+        run_train(UR_FACTORY, variant=self.VARIANT, storage=storage,
+                  use_mesh=False)
+        deployed = prepare_deploy(engine_factory=UR_FACTORY, storage=storage)
+        res = deployed.query({"user": "u1", "num": 3})
+        items = [int(s["item"][1:]) for s in res["itemScores"]]
+        assert items and all(i < 5 for i in items), items  # own clique
+        res_item = deployed.query({"item": "i0", "num": 3})
+        sim = [int(s["item"][1:]) for s in res_item["itemScores"]]
+        assert sim and all(i < 5 for i in sim), sim
+        # cold start returns popular items, not nothing
+        res_cold = deployed.query({"user": "nobody", "num": 3})
+        assert len(res_cold["itemScores"]) == 3

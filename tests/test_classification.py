@@ -1,0 +1,149 @@
+"""Classification models + template tests (the reference's
+classification quickstart behavior, SURVEY.md §2c)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.workflow import prepare_deploy, run_train
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.models.linear import (
+    LogisticRegressionParams,
+    logreg_predict,
+    logreg_train,
+)
+from predictionio_tpu.models.naive_bayes import NaiveBayesParams, nb_predict, nb_train
+
+FACTORY = "predictionio_tpu.templates.classification.engine:engine_factory"
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    """Two well-separated gaussian blobs (binary) + a third for multiclass."""
+    rng = np.random.default_rng(0)
+    n = 200
+    X0 = rng.normal([0, 0, 0], 0.5, size=(n, 3))
+    X1 = rng.normal([3, 3, 0], 0.5, size=(n, 3))
+    X2 = rng.normal([0, 3, 3], 0.5, size=(n, 3))
+    X = np.vstack([X0, X1, X2]).astype(np.float32)
+    y = np.repeat([0, 1, 2], n).astype(np.int32)
+    return X, y
+
+
+class TestLogReg:
+    def test_multiclass_accuracy(self, blobs):
+        X, y = blobs
+        W, b = logreg_train(X, y, LogisticRegressionParams(
+            num_classes=3, iterations=60))
+        acc = (logreg_predict(W, b, X) == y).mean()
+        assert acc > 0.97, acc
+
+    def test_adam_fallback(self, blobs):
+        X, y = blobs
+        W, b = logreg_train(X, y, LogisticRegressionParams(
+            num_classes=3, iterations=300, optimizer="adam",
+            learning_rate=0.3))
+        assert (logreg_predict(W, b, X) == y).mean() > 0.95
+
+    def test_mesh_data_parallel(self, blobs, cpu_mesh):
+        X, y = blobs
+        W1, b1 = logreg_train(X, y, LogisticRegressionParams(
+            num_classes=3, iterations=40))
+        W8, b8 = logreg_train(X, y, LogisticRegressionParams(
+            num_classes=3, iterations=40), mesh=cpu_mesh)
+        # same full-batch optimization → near-identical params
+        assert np.allclose(W1, W8, atol=1e-3), np.abs(W1 - W8).max()
+        p1 = logreg_predict(W1, b1, X)
+        p8 = logreg_predict(W8, b8, X)
+        assert (p1 == p8).mean() > 0.99
+
+
+class TestNaiveBayes:
+    def test_multinomial(self):
+        # count-like features: class 0 heavy on feature 0, class 1 on feature 2
+        rng = np.random.default_rng(1)
+        X0 = rng.poisson([5, 1, 1], size=(150, 3))
+        X1 = rng.poisson([1, 1, 5], size=(150, 3))
+        X = np.vstack([X0, X1]).astype(np.float32)
+        y = np.repeat([0, 1], 150).astype(np.int32)
+        lp, lt = nb_train(X, y, NaiveBayesParams(lambda_=1.0))
+        assert (nb_predict(lp, lt, X) == y).mean() > 0.95
+        # priors sum to 1 in prob space
+        assert np.isclose(np.exp(lp).sum(), 1.0, atol=1e-5)
+
+    def test_bernoulli(self):
+        rng = np.random.default_rng(2)
+        X0 = (rng.random((150, 4)) < [0.9, 0.1, 0.5, 0.5]).astype(np.float32)
+        X1 = (rng.random((150, 4)) < [0.1, 0.9, 0.5, 0.5]).astype(np.float32)
+        X = np.vstack([X0, X1])
+        y = np.repeat([0, 1], 150).astype(np.int32)
+        p = NaiveBayesParams(lambda_=1.0, model_type="bernoulli")
+        lp, lt = nb_train(X, y, p)
+        assert (nb_predict(lp, lt, X, "bernoulli") == y).mean() > 0.9
+
+
+def seed_classification(storage, app_name="ClsApp"):
+    app = storage.meta.create_app(app_name)
+    storage.events.init_channel(app.id)
+    rng = np.random.default_rng(5)
+    evs = []
+    for i in range(120):
+        label = i % 2
+        base = [0.0, 0.0, 0.0] if label == 0 else [4.0, 4.0, 0.0]
+        feats = rng.normal(base, 0.4)
+        evs.append(Event(
+            event="$set", entity_type="user", entity_id=f"u{i}",
+            properties={"attr0": float(feats[0]), "attr1": float(feats[1]),
+                        "attr2": float(feats[2]), "label": label}))
+    storage.events.insert_batch(evs, app.id)
+    return app
+
+
+class TestClassificationTemplate:
+    @pytest.mark.parametrize("algo,params", [
+        ("naive", {"lambda": 1.0, "modelType": "bernoulli"}),
+        ("lr", {"iterations": 60}),
+    ])
+    def test_train_deploy_query(self, storage, algo, params):
+        seed_classification(storage)
+        variant = {
+            "id": "default",
+            "engineFactory": FACTORY,
+            "datasource": {"params": {"appName": "ClsApp"}},
+            "algorithms": [{"name": algo, "params": params}],
+        }
+        run_train(FACTORY, variant=variant, storage=storage, use_mesh=False)
+        deployed = prepare_deploy(engine_factory=FACTORY, storage=storage)
+        assert deployed.query({"attr0": 0.1, "attr1": -0.2, "attr2": 0.0}) == {"label": 0.0}
+        assert deployed.query({"attr0": 4.2, "attr1": 3.9, "attr2": 0.1}) == {"label": 1.0}
+
+    def test_eval_grid(self, storage):
+        from predictionio_tpu.controller import (
+            AverageMetric,
+            EngineParams,
+            Evaluation,
+        )
+        from predictionio_tpu.core.workflow import run_evaluation
+        from predictionio_tpu.templates.classification.engine import (
+            DataSourceParams,
+            LRAlgoParams,
+            NBAlgoParams,
+        )
+
+        seed_classification(storage)
+
+        class Accuracy(AverageMetric):
+            def calculate_one(self, q, p, a):
+                return 1.0 if p["label"] == a else 0.0
+
+        class Ev(Evaluation):
+            engine_factory = FACTORY
+            metric = Accuracy()
+
+        dsp = DataSourceParams(app_name="ClsApp", eval_k=2)
+        candidates = [
+            EngineParams(dsp, None, [("naive", NBAlgoParams(model_type="bernoulli"))], None),
+            EngineParams(dsp, None, [("lr", LRAlgoParams(iterations=60))], None),
+        ]
+        _, result = run_evaluation(Ev(), candidates, storage=storage,
+                                   use_mesh=False)
+        assert result.best_score > 0.9

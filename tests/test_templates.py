@@ -1,0 +1,128 @@
+"""Similar-product and e-commerce template behavior tests: the
+reference's business-rule surface — live seen-item exclusion, live
+availability constraints, category filters, cold-start fallback
+(SURVEY.md §2c)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.workflow import prepare_deploy, run_train
+from predictionio_tpu.data.event import Event
+
+SP_FACTORY = "predictionio_tpu.templates.similarproduct.engine:engine_factory"
+EC_FACTORY = "predictionio_tpu.templates.ecommercerecommendation.engine:engine_factory"
+
+
+def seed_views(storage, app_name, with_buys=False):
+    """Two user cliques: users<10 view items 0-9, users>=10 view items 10-19.
+    Item categories: even→'electronics', odd→'books'."""
+    app = storage.meta.create_app(app_name)
+    storage.events.init_channel(app.id)
+    rng = np.random.default_rng(0)
+    evs = []
+    for u in range(20):
+        lo, hi = (0, 10) if u < 10 else (10, 20)
+        for i in range(lo, hi):
+            if rng.random() < 0.7:
+                evs.append(Event(event="view", entity_type="user",
+                                 entity_id=f"u{u}", target_entity_type="item",
+                                 target_entity_id=f"i{i}"))
+                if with_buys and rng.random() < 0.3:
+                    evs.append(Event(event="buy", entity_type="user",
+                                     entity_id=f"u{u}", target_entity_type="item",
+                                     target_entity_id=f"i{i}"))
+    for i in range(20):
+        cat = "electronics" if i % 2 == 0 else "books"
+        evs.append(Event(event="$set", entity_type="item", entity_id=f"i{i}",
+                         properties={"categories": [cat]}))
+    storage.events.insert_batch(evs, app.id)
+    return app
+
+
+class TestSimilarProduct:
+    VARIANT = {
+        "engineFactory": SP_FACTORY,
+        "datasource": {"params": {"appName": "SPApp"}},
+        "algorithms": [{"name": "als", "params": {"rank": 8, "numIterations": 10}}],
+    }
+
+    def test_similar_within_clique(self, storage):
+        seed_views(storage, "SPApp")
+        run_train(SP_FACTORY, variant=self.VARIANT, storage=storage,
+                  use_mesh=False)
+        deployed = prepare_deploy(engine_factory=SP_FACTORY, storage=storage)
+        res = deployed.query({"items": ["i2", "i3"], "num": 5})
+        items = [int(s["item"][1:]) for s in res["itemScores"]]
+        assert len(items) == 5
+        # co-viewed items come from the same clique (0-9)
+        assert sum(1 for i in items if i < 10) >= 4, items
+        assert "i2" not in [s["item"] for s in res["itemScores"]]
+
+    def test_filters(self, storage):
+        seed_views(storage, "SPApp")
+        run_train(SP_FACTORY, variant=self.VARIANT, storage=storage,
+                  use_mesh=False)
+        deployed = prepare_deploy(engine_factory=SP_FACTORY, storage=storage)
+        res = deployed.query({"items": ["i2"], "num": 4,
+                              "categories": ["books"]})
+        assert all(int(s["item"][1:]) % 2 == 1 for s in res["itemScores"])
+        res = deployed.query({"items": ["i2"], "num": 4,
+                              "blackList": ["i3", "i5"]})
+        assert not {"i3", "i5"} & {s["item"] for s in res["itemScores"]}
+        res = deployed.query({"items": ["zzz"], "num": 4})
+        assert res["itemScores"] == []
+
+
+class TestECommerce:
+    VARIANT = {
+        "engineFactory": EC_FACTORY,
+        "datasource": {"params": {"appName": "ECApp"}},
+        "algorithms": [{"name": "ecomm",
+                        "params": {"rank": 8, "numIterations": 10}}],
+    }
+
+    def _train(self, storage):
+        seed_views(storage, "ECApp", with_buys=True)
+        run_train(EC_FACTORY, variant=self.VARIANT, storage=storage,
+                  use_mesh=False)
+        return prepare_deploy(engine_factory=EC_FACTORY, storage=storage)
+
+    def test_recommends_unseen_from_own_clique(self, storage):
+        deployed = self._train(storage)
+        app = storage.meta.get_app_by_name("ECApp")
+        seen = {e.target_entity_id for e in storage.events.find(
+            app.id, entity_type="user", entity_id="u1",
+            event_names=["view", "buy"])}
+        res = deployed.query({"user": "u1", "num": 3})
+        got = {s["item"] for s in res["itemScores"]}
+        assert got and not (got & seen), (got, seen)
+
+    def test_live_unavailable_constraint(self, storage):
+        deployed = self._train(storage)
+        app = storage.meta.get_app_by_name("ECApp")
+        res = deployed.query({"user": "u1", "num": 3})
+        first = res["itemScores"][0]["item"]
+        # ops flips availability LIVE — no retrain, next query excludes it
+        storage.events.insert(Event(
+            event="$set", entity_type="constraint",
+            entity_id="unavailableItems",
+            properties={"items": [first]}), app.id)
+        res2 = deployed.query({"user": "u1", "num": 3})
+        assert first not in {s["item"] for s in res2["itemScores"]}
+
+    def test_cold_start_popularity(self, storage):
+        deployed = self._train(storage)
+        res = deployed.query({"user": "brand-new-user", "num": 4})
+        assert len(res["itemScores"]) == 4  # popularity fallback, not empty
+
+    def test_seen_items_update_live(self, storage):
+        deployed = self._train(storage)
+        app = storage.meta.get_app_by_name("ECApp")
+        res = deployed.query({"user": "u1", "num": 3})
+        first = res["itemScores"][0]["item"]
+        # user views the top recommendation → it disappears live
+        storage.events.insert(Event(
+            event="view", entity_type="user", entity_id="u1",
+            target_entity_type="item", target_entity_id=first), app.id)
+        res2 = deployed.query({"user": "u1", "num": 3})
+        assert first not in {s["item"] for s in res2["itemScores"]}
