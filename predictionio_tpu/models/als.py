@@ -143,11 +143,14 @@ def chunk_update(A, b, chunk, F_other, implicit: bool, alpha: float):
     else:
         w_outer = m
         w_b = r * m
-    # batched matmul on the MXU: contract the W axis per row
-    A_rows = jnp.einsum("rw,rwk,rwl->rkl", w_outer, F, F,
-                        preferred_element_type=jnp.float32)
-    b_rows = jnp.einsum("rw,rwk->rk", w_b, F,
-                        preferred_element_type=jnp.float32)
+    # batched weighted Gram on the MXU (Pallas kernel on TPU fuses the
+    # weighting so the weighted copy of F never round-trips HBM)
+    from predictionio_tpu import ops
+
+    if ops.use_pallas():
+        A_rows, b_rows = ops.rows_gram(F, w_outer, w_b)
+    else:
+        A_rows, b_rows = ops.rows_gram_xla(F, w_outer, w_b)
     A = A.at[re_].add(A_rows, indices_are_sorted=True)
     b = b.at[re_].add(b_rows, indices_are_sorted=True)
     return A, b
@@ -206,14 +209,22 @@ def als_train(
     return _als_train_single(coo, params)
 
 
+def _ops_use_pallas() -> bool:
+    from predictionio_tpu import ops
+
+    return ops.use_pallas()
+
+
 @functools.lru_cache(maxsize=8)
 def _compiled_single(n_users: int, n_items: int, u_rows: int, i_rows: int,
                      chunk_rows: int, width: int,
                      rank: int, iterations: int, reg: float, implicit: bool,
-                     alpha: float, weighted_reg: bool):
+                     alpha: float, weighted_reg: bool,
+                     pallas: bool = False):
     """Build + jit the full training program for one problem geometry.
     Caching on geometry means `pio eval` grid candidates that share shapes
-    recompile only when rank/iterations/reg change."""
+    recompile only when rank/iterations/reg change. ``pallas`` is part of
+    the key so flipping PIO_NO_PALLAS mid-process takes effect."""
     import jax
     import jax.numpy as jnp
 
@@ -280,7 +291,8 @@ def _als_train_single(coo: RatingsCOO, p: ALSParams) -> Tuple[np.ndarray, np.nda
     train = _compiled_single(
         coo.n_users, coo.n_items, u_rows[0].shape[0], i_rows[0].shape[0],
         RC, W, p.rank, p.iterations,
-        float(p.reg), bool(p.implicit), float(p.alpha), bool(p.weighted_reg))
+        float(p.reg), bool(p.implicit), float(p.alpha), bool(p.weighted_reg),
+        _ops_use_pallas())
     U, V = train(u_chunks, i_chunks, cnt_u, cnt_i,
                  jnp.asarray(init_factors(coo.n_items, p.rank, p.seed)))
     return np.asarray(U), np.asarray(V)
@@ -308,6 +320,93 @@ def recommend(
     top = np.argpartition(-scores, num - 1)[:num]
     top = top[np.argsort(-scores[top])]
     return top, scores[top]
+
+
+class ResidentScorer:
+    """Serving-time scorer with factors resident on device.
+
+    The reference's serving path keeps the ``MatrixFactorizationModel``
+    in JVM heap and scores per query ([U] MLlib
+    ``recommendProducts`` — SURVEY.md §3.2). Here U and V live in HBM
+    across requests; each query is one compiled score→top-k program
+    (streaming Pallas kernel on TPU, dense XLA fallback elsewhere).
+    Exclusions are handled by over-fetching a padded k (bucketed to
+    limit recompiles) and filtering host-side.
+    """
+
+    _TILE = 2048  # item-tile width of the streaming kernel
+
+    def __init__(self, U: np.ndarray, V: np.ndarray):
+        import jax
+        import jax.numpy as jnp
+
+        self.n_users, self.rank = U.shape
+        self.n_items = V.shape[0]
+        self._U = jax.device_put(jnp.asarray(U, jnp.float32))
+        self._V = jax.device_put(jnp.asarray(V, jnp.float32))
+        # pad V once at load (resident + immutable) so the streaming
+        # kernel never re-pads the full factor matrix per request
+        pad = -self.n_items % self._TILE
+        Vp = np.concatenate([V, np.zeros((pad, self.rank), V.dtype)]) if pad else V
+        self._V_padded = jax.device_put(jnp.asarray(Vp, jnp.float32))
+
+    def _topk(self, Q, k: int):
+        from predictionio_tpu import ops
+
+        # The streaming kernel pays off once the (B, n_items) score
+        # matrix is too big to live cheaply in HBM between the matmul
+        # and the top_k; below that XLA's fused path wins (measured on
+        # v5e: XLA 1.5ms vs Pallas 2.8ms at B=32, N=27k).
+        # k > 1024 would unroll the kernel's selection loop too far —
+        # XLA's top_k handles large k better.
+        if (ops.use_pallas() and k <= 1024
+                and Q.shape[0] * self.n_items > 64_000_000):
+            return ops.score_topk(Q, self._V_padded, k, tile=self._TILE,
+                                  n_valid=self.n_items)
+        return ops.score_topk_xla(Q, self._V, k)
+
+    def recommend_batch(
+        self, user_ids: np.ndarray, num: int,
+        exclude: Optional[list] = None,
+    ) -> list:
+        """Top-``num`` per user → list of (item_indices, scores) pairs.
+
+        ``exclude[i]`` is an optional array of item indices to drop for
+        user i (seen-item / constraint filtering, e-commerce template);
+        ``exclude`` itself or any entry may be None/empty.
+        """
+        import jax.numpy as jnp
+
+        if not exclude:
+            exclude = [None] * len(user_ids)
+        exclude = [np.asarray([] if e is None else e, np.int32)
+                   for e in exclude]
+        max_ex = max((e.size for e in exclude), default=0)
+        # bucket k to powers of two (bounds recompiles); over-fetch for
+        # exclusions but never more than the catalog
+        want = min(num + max_ex, self.n_items)
+        k = 16
+        while k < want:
+            k *= 2
+        k = min(k, self.n_items)
+        Q = self._U[jnp.asarray(user_ids, jnp.int32)]
+        vals, idx = self._topk(Q, k)
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        out = []
+        for row in range(len(user_ids)):
+            iv, vv = idx[row], vals[row]
+            if exclude[row].size:
+                keep = ~np.isin(iv, exclude[row])
+                iv, vv = iv[keep], vv[keep]
+            out.append((iv[:num], vv[:num]))
+        return out
+
+    def recommend(self, user: int, num: int,
+                  exclude: Optional[np.ndarray] = None):
+        [(iv, vv)] = self.recommend_batch(
+            np.asarray([user]), num,
+            [np.asarray(exclude if exclude is not None else [], np.int32)])
+        return iv, vv
 
 
 def similar_items(

@@ -1,0 +1,143 @@
+"""Pallas kernels (interpret mode on CPU) vs numpy/XLA references."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from predictionio_tpu.ops import (
+    rows_gram, rows_gram_xla, score_topk, score_topk_xla,
+    segment_count, segment_mean, segment_sum,
+)
+
+
+class TestRowsGram:
+    def _data(self, R=32, W=16, k=8, seed=0):
+        rng = np.random.default_rng(seed)
+        F = rng.standard_normal((R, W, k)).astype(np.float32)
+        wo = rng.uniform(0, 2, (R, W)).astype(np.float32)
+        wb = rng.uniform(0, 2, (R, W)).astype(np.float32)
+        return F, wo, wb
+
+    def _ref(self, F, wo, wb):
+        A = np.einsum("rw,rwk,rwl->rkl", wo, F, F)
+        b = np.einsum("rw,rwk->rk", wb, F)
+        return A, b
+
+    def test_pallas_matches_numpy(self):
+        F, wo, wb = self._data()
+        A, b = rows_gram(jnp.asarray(F), jnp.asarray(wo), jnp.asarray(wb),
+                         interpret=True)
+        An, bn = self._ref(F, wo, wb)
+        np.testing.assert_allclose(np.asarray(A), An, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(b), bn, rtol=1e-5, atol=1e-5)
+
+    def test_xla_matches_numpy(self):
+        F, wo, wb = self._data(R=7, W=5, k=3, seed=1)
+        A, b = rows_gram_xla(jnp.asarray(F), jnp.asarray(wo), jnp.asarray(wb))
+        An, bn = self._ref(F, wo, wb)
+        np.testing.assert_allclose(np.asarray(A), An, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(b), bn, rtol=1e-5, atol=1e-5)
+
+    def test_odd_row_count_falls_back_to_divisor_block(self):
+        F, wo, wb = self._data(R=20, W=4, k=4, seed=2)  # 20 % 8 != 0 → block 4
+        A, b = rows_gram(jnp.asarray(F), jnp.asarray(wo), jnp.asarray(wb),
+                         interpret=True)
+        An, bn = self._ref(F, wo, wb)
+        np.testing.assert_allclose(np.asarray(A), An, rtol=1e-5, atol=1e-5)
+
+
+class TestScoreTopK:
+    def _check(self, B, N, d, k, tile=64, seed=0):
+        rng = np.random.default_rng(seed)
+        Q = rng.standard_normal((B, d)).astype(np.float32)
+        V = rng.standard_normal((N, d)).astype(np.float32)
+        vals, idx = score_topk(jnp.asarray(Q), jnp.asarray(V), k,
+                               tile=tile, interpret=True)
+        scores = Q @ V.T
+        ref_idx = np.argsort(-scores, axis=1)[:, :k]
+        ref_vals = np.take_along_axis(scores, ref_idx, axis=1)
+        np.testing.assert_allclose(np.asarray(vals), ref_vals,
+                                   rtol=1e-4, atol=1e-4)
+        # indices must produce the same scores (ties may permute)
+        got = np.take_along_axis(scores, np.asarray(idx), axis=1)
+        np.testing.assert_allclose(got, ref_vals, rtol=1e-4, atol=1e-4)
+
+    def test_exact_tile_multiple(self):
+        self._check(B=4, N=256, d=16, k=10, tile=64)
+
+    def test_padding_tail(self):
+        self._check(B=3, N=200, d=8, k=7, tile=64, seed=1)
+
+    def test_single_tile(self):
+        self._check(B=2, N=40, d=4, k=5, tile=64, seed=2)
+
+    def test_xla_fallback(self):
+        rng = np.random.default_rng(3)
+        Q = rng.standard_normal((2, 8)).astype(np.float32)
+        V = rng.standard_normal((50, 8)).astype(np.float32)
+        vals, idx = score_topk_xla(jnp.asarray(Q), jnp.asarray(V), 5)
+        scores = Q @ V.T
+        ref = np.sort(scores, axis=1)[:, ::-1][:, :5]
+        np.testing.assert_allclose(np.asarray(vals), ref, rtol=1e-5)
+
+
+class TestSegmentOps:
+    def test_segment_sum(self):
+        data = jnp.asarray(np.arange(12, dtype=np.float32).reshape(6, 2))
+        ids = jnp.asarray([0, 0, 2, 2, 2, 1])
+        out = np.asarray(segment_sum(data, ids, 4))
+        assert out.shape == (4, 2)
+        np.testing.assert_allclose(out[0], [2.0, 4.0])
+        np.testing.assert_allclose(out[3], [0.0, 0.0])
+
+    def test_segment_count_and_mean(self):
+        ids = jnp.asarray([1, 1, 1, 0])
+        assert np.asarray(segment_count(ids, 3)).tolist() == [1, 3, 0]
+        data = jnp.asarray([[2.0], [4.0], [6.0], [10.0]])
+        m = np.asarray(segment_mean(data, ids, 3))
+        np.testing.assert_allclose(m[:, 0], [10.0, 4.0, 0.0])
+
+
+class TestResidentScorer:
+    def test_matches_numpy_recommend(self):
+        from predictionio_tpu.models.als import ResidentScorer, recommend
+
+        rng = np.random.default_rng(0)
+        U = rng.standard_normal((20, 6)).astype(np.float32)
+        V = rng.standard_normal((100, 6)).astype(np.float32)
+        sc = ResidentScorer(U, V)
+        for user in (0, 7, 19):
+            iv, vv = sc.recommend(user, 5)
+            ri, rv = recommend(U, V, user, 5)
+            np.testing.assert_array_equal(iv, ri)
+            np.testing.assert_allclose(vv, rv, rtol=1e-5)
+
+    def test_exclusions(self):
+        from predictionio_tpu.models.als import ResidentScorer, recommend
+
+        rng = np.random.default_rng(1)
+        U = rng.standard_normal((5, 4)).astype(np.float32)
+        V = rng.standard_normal((30, 4)).astype(np.float32)
+        sc = ResidentScorer(U, V)
+        excl = np.asarray([3, 11, 29], np.int32)
+        iv, vv = sc.recommend(2, 6, exclude=excl)
+        ri, rv = recommend(U, V, 2, 6, exclude=excl)
+        np.testing.assert_array_equal(iv, ri)
+        assert not set(iv.tolist()) & set(excl.tolist())
+
+    def test_exclude_edge_cases(self):
+        from predictionio_tpu.models.als import ResidentScorer
+
+        rng = np.random.default_rng(2)
+        U = rng.standard_normal((4, 4)).astype(np.float32)
+        V = rng.standard_normal((20, 4)).astype(np.float32)
+        sc = ResidentScorer(U, V)
+        ids = np.asarray([0, 1])
+        for ex in (None, [], [None, None], [None, np.asarray([1, 2])]):
+            out = sc.recommend_batch(ids, 3, exclude=ex)
+            assert len(out) == 2 and all(len(iv) == 3 for iv, _ in out)
+        # over-fetch larger than the catalog must clamp, not explode
+        big = [np.arange(18, dtype=np.int32), np.asarray([], np.int32)]
+        out = sc.recommend_batch(ids, 5, exclude=big)
+        assert len(out[0][0]) == 2  # 20 items - 18 excluded
