@@ -1,0 +1,270 @@
+"""EVENTLOG backend: event store on the native C++ log engine.
+
+The framework's first-party native storage path (SURVEY.md §2b mandates
+C++ equivalents where the reference leans on native dependencies — its
+event store rides HBase's native client ([U] storage/hbase/)). The
+engine (:mod:`predictionio_tpu.native` / ``eventlog.cc``) keeps an
+append-only framed binary log per (app, channel) namespace with an
+in-memory index; filtered scans and the ``$set/$unset/$delete``
+property fold run in C++, so training reads never pay Python-loop cost
+per event.
+
+Wire format (shared with the C++ side): see eventlog.cc header comment.
+Single-writer per namespace file; in-process thread safety via the
+engine's per-handle mutex.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import datetime as _dt
+import json
+import os
+import struct
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.data.event import (
+    Event,
+    PropertyMap,
+    validate_event,
+)
+from predictionio_tpu.data.events import EventStore
+
+_UNBOUNDED_LO = -(2**62)
+_UNBOUNDED_HI = 2**62
+
+
+_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+
+
+def _ts_us(dt: _dt.datetime) -> int:
+    # exact integer microseconds — float .timestamp() rounding corrupts
+    # ~1% of values by 1µs, breaking round-trips and window boundaries
+    return (dt - _EPOCH) // _dt.timedelta(microseconds=1)
+
+
+def _dt_us(us: int) -> _dt.datetime:
+    return _dt.datetime.fromtimestamp(us / 1_000_000, tz=_dt.timezone.utc)
+
+
+def _pack_str(s: Optional[str]) -> bytes:
+    b = (s or "").encode("utf-8")
+    return struct.pack("<I", len(b)) + b
+
+
+def serialize_event(e: Event) -> bytes:
+    """One framed kind-0 record ([u32 len][u8 kind=0][payload])."""
+    payload = struct.pack("<qq", _ts_us(e.event_time), _ts_us(e.creation_time))
+    payload += b"".join(_pack_str(s) for s in (
+        e.event_id, e.event, e.entity_type, e.entity_id,
+        e.target_entity_type, e.target_entity_id,
+        json.dumps(e.properties, separators=(",", ":")),
+        json.dumps(e.tags, separators=(",", ":")),
+        e.pr_id,
+    ))
+    return struct.pack("<IB", len(payload) + 1, 0) + payload
+
+
+def deserialize_payload(buf: bytes, off: int, plen: int) -> Event:
+    t_us, c_us = struct.unpack_from("<qq", buf, off)
+    pos = off + 16
+    strs: List[str] = []
+    for _ in range(9):
+        (n,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        strs.append(buf[pos:pos + n].decode("utf-8"))
+        pos += n
+    assert pos == off + plen, "corrupt event payload"
+    return Event(
+        event_id=strs[0],
+        event=strs[1],
+        entity_type=strs[2],
+        entity_id=strs[3],
+        target_entity_type=strs[4] or None,
+        target_entity_id=strs[5] or None,
+        properties=json.loads(strs[6]),
+        tags=json.loads(strs[7]),
+        pr_id=strs[8] or None,
+        event_time=_dt_us(t_us),
+        creation_time=_dt_us(c_us),
+    )
+
+
+class NativeEventLogStore(EventStore):
+    """Event store backed by the C++ append-only log engine."""
+
+    def __init__(self, directory: str) -> None:
+        from predictionio_tpu import native
+
+        lib = native.eventlog_library()
+        if lib is None:
+            raise RuntimeError(
+                "EVENTLOG backend unavailable: native engine failed to "
+                "build (is g++ installed?) — use SQLITE instead")
+        self._lib = lib
+        self._dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self._handles: Dict[Tuple[int, Optional[int]], int] = {}
+        self._lock = threading.RLock()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _path(self, app_id: int, channel_id: Optional[int]) -> str:
+        name = f"events_{app_id}" + (
+            f"_{channel_id}" if channel_id is not None else "")
+        return os.path.join(self._dir, name + ".pel")
+
+    def _handle(self, app_id: int, channel_id: Optional[int]) -> int:
+        key = (app_id, channel_id)
+        with self._lock:
+            h = self._handles.get(key)
+            if h is None:
+                h = self._lib.pel_open(self._path(app_id, channel_id).encode())
+                if not h:
+                    raise IOError(f"cannot open event log for app {app_id}")
+                self._handles[key] = h
+            return h
+
+    def _take(self, ptr: ctypes.c_void_p, length: int) -> bytes:
+        try:
+            return ctypes.string_at(ptr, length)
+        finally:
+            self._lib.pel_free(ptr)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def init_channel(self, app_id: int, channel_id: Optional[int] = None) -> None:
+        self._handle(app_id, channel_id)
+
+    def remove_channel(self, app_id: int, channel_id: Optional[int] = None) -> None:
+        key = (app_id, channel_id)
+        with self._lock:
+            h = self._handles.pop(key, None)
+            if h is not None:
+                self._lib.pel_close(h)
+            try:
+                os.unlink(self._path(app_id, channel_id))
+            except FileNotFoundError:
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            for h in self._handles.values():
+                self._lib.pel_close(h)
+            self._handles.clear()
+
+    # -- writes -------------------------------------------------------------
+
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        return self.insert_batch([event], app_id, channel_id)[0]
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> List[str]:
+        frames = []
+        ids = []
+        for e in events:
+            validate_event(e)
+            e = e.with_id()
+            frames.append(serialize_event(e))
+            ids.append(e.event_id)
+        buf = b"".join(frames)
+        h = self._handle(app_id, channel_id)
+        n = self._lib.pel_append_batch(h, buf, len(buf), len(frames))
+        if n != len(frames):
+            raise IOError(f"event log append failed ({n}/{len(frames)})")
+        return ids  # type: ignore[return-value]
+
+    def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
+        h = self._handle(app_id, channel_id)
+        b = event_id.encode()
+        r = self._lib.pel_delete(h, b, len(b))
+        if r < 0:
+            raise IOError("event log delete failed")
+        return bool(r)
+
+    def wipe(self, app_id: int, channel_id: Optional[int] = None) -> None:
+        h = self._handle(app_id, channel_id)
+        if self._lib.pel_wipe(h) != 0:
+            raise IOError("event log wipe failed")
+
+    # -- reads --------------------------------------------------------------
+
+    def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
+        h = self._handle(app_id, channel_id)
+        out = ctypes.c_void_p()
+        b = event_id.encode()
+        n = self._lib.pel_get(h, b, len(b), ctypes.byref(out))
+        if n < 0:
+            raise IOError("event log get failed")
+        if n == 0:
+            return None
+        payload = self._take(out, n)
+        return deserialize_payload(payload, 0, len(payload))
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        h = self._handle(app_id, channel_id)
+        out = ctypes.c_void_p()
+        names = "\n".join(event_names).encode() if event_names is not None else None
+        n = self._lib.pel_find(
+            h,
+            _ts_us(start_time) if start_time else _UNBOUNDED_LO,
+            _ts_us(until_time) if until_time else _UNBOUNDED_HI,
+            entity_type.encode() if entity_type is not None else None,
+            entity_id.encode() if entity_id is not None else None,
+            target_entity_type.encode() if target_entity_type is not None else None,
+            target_entity_id.encode() if target_entity_id is not None else None,
+            names,
+            1 if reversed else 0,
+            limit if (limit is not None and limit >= 0) else -1,
+            ctypes.byref(out),
+        )
+        if n < 0:
+            raise IOError("event log scan failed")
+        buf = self._take(out, n)
+        pos = 0
+        while pos < len(buf):
+            (plen,) = struct.unpack_from("<I", buf, pos)
+            pos += 4
+            yield deserialize_payload(buf, pos, plen)
+            pos += plen
+
+    # -- derived (native fold) ------------------------------------------------
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+    ) -> Dict[str, PropertyMap]:
+        h = self._handle(app_id, channel_id)
+        out = ctypes.c_void_p()
+        n = self._lib.pel_aggregate(
+            h, entity_type.encode(),
+            _ts_us(start_time) if start_time else _UNBOUNDED_LO,
+            _ts_us(until_time) if until_time else _UNBOUNDED_HI,
+            ctypes.byref(out),
+        )
+        if n < 0:
+            raise IOError("event log aggregate failed")
+        folded = json.loads(self._take(out, n).decode("utf-8"))
+        return {
+            eid: PropertyMap(v["p"], _dt_us(v["f"]), _dt_us(v["l"]))
+            for eid, v in folded.items()
+        }

@@ -343,9 +343,8 @@ class ResidentScorer:
         self.n_users, self.rank = U.shape
         self.n_items = V.shape[0]
         self._U = jax.device_put(jnp.asarray(U, jnp.float32))
-        self._V = jax.device_put(jnp.asarray(V, jnp.float32))
-        # pad V once at load (resident + immutable) so the streaming
-        # kernel never re-pads the full factor matrix per request
+        # ONE resident copy, padded once at load to the streaming
+        # kernel's tile; both scoring paths mask the pad rows
         pad = -self.n_items % self._TILE
         Vp = np.concatenate([V, np.zeros((pad, self.rank), V.dtype)]) if pad else V
         self._V_padded = jax.device_put(jnp.asarray(Vp, jnp.float32))
@@ -363,7 +362,7 @@ class ResidentScorer:
                 and Q.shape[0] * self.n_items > 64_000_000):
             return ops.score_topk(Q, self._V_padded, k, tile=self._TILE,
                                   n_valid=self.n_items)
-        return ops.score_topk_xla(Q, self._V, k)
+        return ops.score_topk_xla(Q, self._V_padded, k, n_valid=self.n_items)
 
     def recommend_batch(
         self, user_ids: np.ndarray, num: int,

@@ -84,7 +84,10 @@ def _pad_rows(arr: np.ndarray, n: int) -> np.ndarray:
 @functools.lru_cache(maxsize=8)
 def _compiled_sharded(mesh, n_dev: int, block_u: int, block_i: int,
                       rank: int, iterations: int, reg: float, implicit: bool,
-                      alpha: float, weighted_reg: bool):
+                      alpha: float, weighted_reg: bool,
+                      pallas: bool = False):
+    # ``pallas`` keys the cache so flipping PIO_NO_PALLAS mid-process
+    # takes effect (chunk_update branches on it at trace time)
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -182,10 +185,12 @@ def als_train_sharded(
     # they contribute nothing to the first implicit Gram term
     V0 = _pad_rows(init_factors(coo.n_items, p.rank, p.seed), n_items_p)
 
+    from predictionio_tpu.models.als import _ops_use_pallas
+
     train = _compiled_sharded(
         mesh, n_dev, block_u, block_i,
         p.rank, p.iterations, float(p.reg), bool(p.implicit), float(p.alpha),
-        bool(p.weighted_reg))
+        bool(p.weighted_reg), _ops_use_pallas())
 
     # place inputs directly onto the mesh with their shard_map layouts —
     # never through the default backend (which may be a different

@@ -1,0 +1,632 @@
+// Native event-log storage engine.
+//
+// The reference's event store rides HBase's native RPC/row-key machinery
+// ([U] storage/hbase/HBEventsUtil.scala — SURVEY.md §2a); this is the
+// framework's own C++ equivalent: an append-only framed binary log per
+// (app, channel) namespace with an in-memory index, filtered scans, and
+// a native $set/$unset/$delete property fold (the PEventAggregator
+// analogue). Exposed as a C ABI consumed via ctypes from
+// predictionio_tpu/data/filestore.py.
+//
+// Record framing (little-endian):
+//   [u32 rec_len][u8 kind][payload]          rec_len = 1 + payload size
+//   kind 0 (event):  i64 time_us, i64 creation_us, then 9 strings each
+//                    [u32 len][bytes]: id, event, entityType, entityId,
+//                    targetEntityType, targetEntityId, propertiesJson,
+//                    tagsJson, prId  (empty string = null for the
+//                    nullable fields)
+//   kind 1 (tombstone): [u32 len][id bytes]
+//
+// Semantics matching the Python SPI (data/events.py):
+//   - re-appending an existing id overwrites (HBase put semantics)
+//   - find() orders by (eventTime, creationTime, insertion seq)
+//   - aggregate folds $set/$unset/$delete in that order
+//
+// Single-writer per file (like the reference's LocalFS model store);
+// in-process concurrency is guarded by a per-handle mutex.
+
+#include <unistd.h>  // truncate
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Rec {
+  uint64_t payload_off;  // file offset of payload (after frame header)
+  uint32_t payload_len;
+  int64_t time_us;
+  int64_t creation_us;
+  uint64_t seq;        // insertion order, tie-break
+  std::string id;
+  bool alive;
+};
+
+struct Handle {
+  std::string path;
+  FILE* f = nullptr;  // open in "a+b": reads anywhere, writes append
+  std::mutex mu;
+  std::vector<Rec> recs;
+  std::unordered_map<std::string, size_t> by_id;  // id -> index of latest
+  std::vector<size_t> sorted;  // alive indices by (time, creation, seq)
+  bool sorted_dirty = true;
+  uint64_t next_seq = 0;
+};
+
+uint32_t rd_u32(const unsigned char* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+int64_t rd_i64(const unsigned char* p) {
+  uint64_t v = 0;
+  for (int i = 7; i >= 0; --i) v = (v << 8) | p[i];
+  return (int64_t)v;
+}
+
+// Parse the 9 strings of an event payload into string_views over buf.
+// Returns false on corruption.
+bool parse_event(const unsigned char* buf, uint32_t len, int64_t* time_us,
+                 int64_t* creation_us, std::string_view out[9]) {
+  if (len < 16) return false;
+  *time_us = rd_i64(buf);
+  *creation_us = rd_i64(buf + 8);
+  uint32_t off = 16;
+  for (int i = 0; i < 9; ++i) {
+    if (off + 4 > len) return false;
+    uint32_t n = rd_u32(buf + off);
+    off += 4;
+    if (off + n > len) return false;
+    out[i] = std::string_view((const char*)buf + off, n);
+    off += n;
+  }
+  return off == len;
+}
+
+bool read_payload(Handle* h, const Rec& r, std::string* out) {
+  out->resize(r.payload_len);
+  if (fseek(h->f, (long)r.payload_off, SEEK_SET) != 0) return false;
+  return fread(out->data(), 1, r.payload_len, h->f) == r.payload_len;
+}
+
+void index_record(Handle* h, uint8_t kind, const unsigned char* payload,
+                  uint32_t plen, uint64_t payload_off) {
+  if (kind == 1) {  // tombstone
+    if (plen < 4) return;
+    uint32_t n = rd_u32(payload);
+    if (4 + n > plen) return;
+    std::string id((const char*)payload + 4, n);
+    auto it = h->by_id.find(id);
+    if (it != h->by_id.end()) {
+      h->recs[it->second].alive = false;
+      h->by_id.erase(it);
+      h->sorted_dirty = true;
+    }
+    return;
+  }
+  int64_t t, c;
+  std::string_view s[9];
+  if (!parse_event(payload, plen, &t, &c, s)) return;
+  std::string id(s[0]);
+  auto it = h->by_id.find(id);
+  if (it != h->by_id.end()) h->recs[it->second].alive = false;
+  Rec r{payload_off, plen, t, c, h->next_seq++, id, true};
+  h->recs.push_back(std::move(r));
+  h->by_id[id] = h->recs.size() - 1;
+  h->sorted_dirty = true;
+}
+
+bool load_index(Handle* h) {
+  if (fseek(h->f, 0, SEEK_SET) != 0) return false;
+  uint64_t off = 0;  // end of last fully-readable record
+  std::string buf;
+  bool torn = false;
+  for (;;) {
+    unsigned char hdr[5];
+    size_t n = fread(hdr, 1, 5, h->f);
+    if (n == 0) break;                     // clean EOF
+    if (n < 5) { torn = true; break; }     // torn tail write
+    uint32_t rec_len = rd_u32(hdr);
+    if (rec_len < 1) { torn = true; break; }
+    uint8_t kind = hdr[4];
+    uint32_t plen = rec_len - 1;
+    buf.resize(plen);
+    if (fread(buf.data(), 1, plen, h->f) != plen) { torn = true; break; }
+    index_record(h, kind, (const unsigned char*)buf.data(), plen, off + 5);
+    off += 5 + plen;
+  }
+  if (torn) {
+    // drop the torn tail so later appends stay readable on reopen
+    fflush(h->f);
+    if (truncate(h->path.c_str(), (off_t)off) != 0) return false;
+    fclose(h->f);
+    h->f = fopen(h->path.c_str(), "a+b");  // nullptr on failure: caller
+    if (!h->f) return false;               // must not fclose again
+  }
+  return true;
+}
+
+void ensure_sorted(Handle* h) {
+  if (!h->sorted_dirty) return;
+  h->sorted.clear();
+  for (size_t i = 0; i < h->recs.size(); ++i)
+    if (h->recs[i].alive) h->sorted.push_back(i);
+  std::sort(h->sorted.begin(), h->sorted.end(), [&](size_t a, size_t b) {
+    const Rec &x = h->recs[a], &y = h->recs[b];
+    if (x.time_us != y.time_us) return x.time_us < y.time_us;
+    if (x.creation_us != y.creation_us) return x.creation_us < y.creation_us;
+    return x.seq < y.seq;
+  });
+  h->sorted_dirty = false;
+}
+
+// ---------------- JSON (minimal, for the property fold) -----------------
+
+// Skip one JSON value starting at s[i]; returns one-past-end index or
+// npos on error. Handles strings w/ escapes and nested {}/[].
+size_t skip_value(std::string_view s, size_t i) {
+  while (i < s.size() && (s[i] == ' ' || s[i] == '\t' || s[i] == '\n' ||
+                          s[i] == '\r'))
+    ++i;
+  if (i >= s.size()) return std::string_view::npos;
+  char c = s[i];
+  if (c == '"') {
+    ++i;
+    while (i < s.size()) {
+      if (s[i] == '\\') i += 2;
+      else if (s[i] == '"') return i + 1;
+      else ++i;
+    }
+    return std::string_view::npos;
+  }
+  if (c == '{' || c == '[') {
+    char close = (c == '{') ? '}' : ']';
+    int depth = 1;
+    ++i;
+    while (i < s.size() && depth > 0) {
+      char d = s[i];
+      if (d == '"') {
+        size_t e = skip_value(s, i);
+        if (e == std::string_view::npos) return e;
+        i = e;
+        continue;
+      }
+      if (d == '{' || d == '[') ++depth;
+      else if (d == '}' || d == ']') --depth;
+      ++i;
+    }
+    return depth == 0 ? i : std::string_view::npos;
+  }
+  // literal: number / true / false / null
+  size_t j = i;
+  while (j < s.size() && s[j] != ',' && s[j] != '}' && s[j] != ']' &&
+         s[j] != ' ' && s[j] != '\t' && s[j] != '\n' && s[j] != '\r')
+    ++j;
+  return j;
+}
+
+void append_utf8(std::string* out, uint32_t cp) {
+  if (cp < 0x80) {
+    *out += (char)cp;
+  } else if (cp < 0x800) {
+    *out += (char)(0xC0 | (cp >> 6));
+    *out += (char)(0x80 | (cp & 0x3F));
+  } else if (cp < 0x10000) {
+    *out += (char)(0xE0 | (cp >> 12));
+    *out += (char)(0x80 | ((cp >> 6) & 0x3F));
+    *out += (char)(0x80 | (cp & 0x3F));
+  } else {
+    *out += (char)(0xF0 | (cp >> 18));
+    *out += (char)(0x80 | ((cp >> 12) & 0x3F));
+    *out += (char)(0x80 | ((cp >> 6) & 0x3F));
+    *out += (char)(0x80 | (cp & 0x3F));
+  }
+}
+
+int hex4(std::string_view s, size_t i) {  // -1 on malformed
+  if (i + 4 > s.size()) return -1;
+  int v = 0;
+  for (int k = 0; k < 4; ++k) {
+    char c = s[i + k];
+    int d = (c >= '0' && c <= '9')   ? c - '0'
+            : (c >= 'a' && c <= 'f') ? c - 'a' + 10
+            : (c >= 'A' && c <= 'F') ? c - 'A' + 10
+                                     : -1;
+    if (d < 0) return -1;
+    v = (v << 4) | d;
+  }
+  return v;
+}
+
+// Decode a JSON string token (with quotes) to raw UTF-8 text,
+// including \uXXXX escapes and surrogate pairs.
+std::string json_unescape(std::string_view tok) {
+  std::string out;
+  if (tok.size() < 2) return out;
+  for (size_t i = 1; i + 1 < tok.size(); ++i) {
+    char c = tok[i];
+    if (c != '\\') { out += c; continue; }
+    ++i;
+    if (i + 1 > tok.size()) break;
+    switch (tok[i]) {
+      case 'n': out += '\n'; break;
+      case 't': out += '\t'; break;
+      case 'r': out += '\r'; break;
+      case 'b': out += '\b'; break;
+      case 'f': out += '\f'; break;
+      case '/': out += '/'; break;
+      case '"': out += '"'; break;
+      case '\\': out += '\\'; break;
+      case 'u': {
+        int hi = hex4(tok, i + 1);
+        if (hi < 0) break;
+        i += 4;
+        uint32_t cp = (uint32_t)hi;
+        if (cp >= 0xD800 && cp <= 0xDBFF && i + 2 < tok.size() &&
+            tok[i + 1] == '\\' && tok[i + 2] == 'u') {
+          int lo = hex4(tok, i + 3);
+          if (lo >= 0xDC00 && lo <= 0xDFFF) {
+            cp = 0x10000 + ((cp - 0xD800) << 10) + ((uint32_t)lo - 0xDC00);
+            i += 6;
+          }
+        }
+        append_utf8(&out, cp);
+        break;
+      }
+      default: out += tok[i];
+    }
+  }
+  return out;
+}
+
+std::string json_escape(std::string_view s) {
+  std::string out;
+  out.reserve(s.size() + 2);
+  for (size_t i = 0; i < s.size(); ++i) {
+    char c = s[i];
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      default:
+        if ((unsigned char)c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;  // raw UTF-8 passes through
+        }
+    }
+  }
+  return out;
+}
+
+// Parse top-level {key: rawvalue} spans of a JSON object.
+bool json_object_items(
+    std::string_view s,
+    std::vector<std::pair<std::string, std::string_view>>* items) {
+  size_t i = 0;
+  while (i < s.size() && isspace((unsigned char)s[i])) ++i;
+  if (i >= s.size() || s[i] != '{') return false;
+  ++i;
+  for (;;) {
+    while (i < s.size() && (isspace((unsigned char)s[i]) || s[i] == ',')) ++i;
+    if (i < s.size() && s[i] == '}') return true;
+    if (i >= s.size() || s[i] != '"') return false;
+    size_t ke = skip_value(s, i);
+    if (ke == std::string_view::npos) return false;
+    std::string key = json_unescape(s.substr(i, ke - i));
+    i = ke;
+    while (i < s.size() && isspace((unsigned char)s[i])) ++i;
+    if (i >= s.size() || s[i] != ':') return false;
+    ++i;
+    while (i < s.size() && isspace((unsigned char)s[i])) ++i;
+    size_t ve = skip_value(s, i);
+    if (ve == std::string_view::npos) return false;
+    items->emplace_back(std::move(key), s.substr(i, ve - i));
+    i = ve;
+  }
+}
+
+std::string out_buf_to_c(std::string&& s, long long* out_len) {
+  *out_len = (long long)s.size();
+  return std::move(s);
+}
+
+char* dup_out(const std::string& s) {
+  char* p = (char*)malloc(s.size() + 1);
+  if (!p) return nullptr;
+  memcpy(p, s.data(), s.size());
+  p[s.size()] = '\0';
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pel_open(const char* path) {
+  FILE* f = fopen(path, "a+b");
+  if (!f) return nullptr;
+  Handle* h = new Handle();
+  h->path = path;
+  h->f = f;
+  if (!load_index(h)) {
+    if (h->f) fclose(h->f);  // may already be closed+nulled by recovery
+    delete h;
+    return nullptr;
+  }
+  return h;
+}
+
+void pel_close(void* hv) {
+  if (!hv) return;
+  Handle* h = (Handle*)hv;
+  fclose(h->f);
+  delete h;
+}
+
+// Append n framed records (concatenated, as produced by the Python
+// serializer). Returns number indexed, or -1 on IO error.
+int pel_append_batch(void* hv, const unsigned char* buf, long long len,
+                     int n) {
+  Handle* h = (Handle*)hv;
+  std::lock_guard<std::mutex> g(h->mu);
+  fseek(h->f, 0, SEEK_END);
+  uint64_t base = (uint64_t)ftell(h->f);
+  if (fwrite(buf, 1, (size_t)len, h->f) != (size_t)len) return -1;
+  fflush(h->f);
+  // index from the in-memory buffer
+  uint64_t off = 0;
+  int done = 0;
+  while (off + 5 <= (uint64_t)len && done < n) {
+    uint32_t rec_len = rd_u32(buf + off);
+    if (rec_len < 1 || off + 4 + rec_len > (uint64_t)len) break;
+    uint8_t kind = buf[off + 4];
+    index_record(h, kind, buf + off + 5, rec_len - 1, base + off + 5);
+    off += 4 + rec_len;
+    ++done;
+  }
+  return done;
+}
+
+// Tombstone an id. Returns 1 if it existed, 0 otherwise, -1 on IO error.
+int pel_delete(void* hv, const char* id, int idlen) {
+  Handle* h = (Handle*)hv;
+  std::lock_guard<std::mutex> g(h->mu);
+  std::string key(id, idlen);
+  if (h->by_id.find(key) == h->by_id.end()) return 0;
+  std::string frame;
+  uint32_t rec_len = 1 + 4 + (uint32_t)idlen;
+  unsigned char hdr[9];
+  hdr[0] = rec_len & 0xff; hdr[1] = (rec_len >> 8) & 0xff;
+  hdr[2] = (rec_len >> 16) & 0xff; hdr[3] = (rec_len >> 24) & 0xff;
+  hdr[4] = 1;  // kind tombstone
+  hdr[5] = idlen & 0xff; hdr[6] = (idlen >> 8) & 0xff;
+  hdr[7] = (idlen >> 16) & 0xff; hdr[8] = (idlen >> 24) & 0xff;
+  frame.append((char*)hdr, 9);
+  frame.append(id, idlen);
+  fseek(h->f, 0, SEEK_END);
+  if (fwrite(frame.data(), 1, frame.size(), h->f) != frame.size()) return -1;
+  fflush(h->f);
+  auto it = h->by_id.find(key);
+  h->recs[it->second].alive = false;
+  h->by_id.erase(it);
+  h->sorted_dirty = true;
+  return 1;
+}
+
+// Truncate the log (wipe namespace, keep usable).
+int pel_wipe(void* hv) {
+  Handle* h = (Handle*)hv;
+  std::lock_guard<std::mutex> g(h->mu);
+  fclose(h->f);
+  FILE* trunc = fopen(h->path.c_str(), "wb");  // truncate to zero
+  if (trunc) fclose(trunc);
+  h->f = fopen(h->path.c_str(), "a+b");
+  h->recs.clear();
+  h->by_id.clear();
+  h->sorted.clear();
+  h->sorted_dirty = true;
+  h->next_seq = 0;
+  return h->f ? 0 : -1;
+}
+
+long long pel_count(void* hv) {
+  Handle* h = (Handle*)hv;
+  std::lock_guard<std::mutex> g(h->mu);
+  return (long long)h->by_id.size();
+}
+
+// Fetch one framed record by id into *out (malloc'd). Returns byte
+// length, 0 if missing, -1 on error.
+long long pel_get(void* hv, const char* id, int idlen, char** out) {
+  Handle* h = (Handle*)hv;
+  std::lock_guard<std::mutex> g(h->mu);
+  auto it = h->by_id.find(std::string(id, idlen));
+  if (it == h->by_id.end()) return 0;
+  std::string payload;
+  if (!read_payload(h, h->recs[it->second], &payload)) return -1;
+  *out = dup_out(payload);
+  return *out ? (long long)payload.size() : -1;
+}
+
+// Filtered scan. NULL filter = wildcard; event_names is a
+// '\n'-joined list or NULL. Times in epoch-us; INT64_MIN/MAX act as
+// unbounded. Returns a malloc'd concatenation of [u32 len][payload]
+// frames (no kind byte — all events) in scan order; length via
+// *out_len; -1 on error.
+long long pel_find(void* hv, long long start_us, long long until_us,
+                   const char* entity_type, const char* entity_id,
+                   const char* target_entity_type,
+                   const char* target_entity_id, const char* event_names,
+                   int reversed, long long limit, char** out) {
+  Handle* h = (Handle*)hv;
+  std::lock_guard<std::mutex> g(h->mu);
+  ensure_sorted(h);
+  std::vector<std::string_view> names;
+  std::string names_buf;
+  if (event_names) {
+    names_buf = event_names;
+    size_t p = 0;
+    while (p <= names_buf.size()) {
+      size_t q = names_buf.find('\n', p);
+      if (q == std::string::npos) q = names_buf.size();
+      names.emplace_back(names_buf.data() + p, q - p);
+      p = q + 1;
+    }
+  }
+  std::string result;
+  long long matched = 0;
+  std::string payload;
+  auto visit = [&](size_t idx) -> bool {  // returns false to stop
+    if (limit >= 0 && matched >= limit) return false;  // incl. limit=0
+    const Rec& r = h->recs[idx];
+    if (r.time_us < start_us || r.time_us >= until_us) return true;
+    if (!read_payload(h, r, &payload)) return true;
+    int64_t t, c;
+    std::string_view s[9];
+    if (!parse_event((const unsigned char*)payload.data(),
+                     (uint32_t)payload.size(), &t, &c, s))
+      return true;
+    if (entity_type && s[2] != entity_type) return true;
+    if (entity_id && s[3] != entity_id) return true;
+    if (target_entity_type && s[4] != target_entity_type) return true;
+    if (target_entity_id && s[5] != target_entity_id) return true;
+    if (event_names) {
+      bool ok = false;
+      for (auto& n : names)
+        if (s[1] == n) { ok = true; break; }
+      if (!ok) return true;
+    }
+    uint32_t plen = (uint32_t)payload.size();
+    unsigned char lenb[4] = {(unsigned char)(plen & 0xff),
+                             (unsigned char)((plen >> 8) & 0xff),
+                             (unsigned char)((plen >> 16) & 0xff),
+                             (unsigned char)((plen >> 24) & 0xff)};
+    result.append((char*)lenb, 4);
+    result.append(payload);
+    ++matched;
+    return !(limit >= 0 && matched >= limit);
+  };
+  if (reversed) {
+    for (auto it = h->sorted.rbegin(); it != h->sorted.rend(); ++it)
+      if (!visit(*it)) break;
+  } else {
+    for (size_t idx : h->sorted)
+      if (!visit(idx)) break;
+  }
+  *out = dup_out(result);
+  return *out ? (long long)result.size() : -1;
+}
+
+// Native $set/$unset/$delete fold (PEventAggregator equivalent).
+// Returns malloc'd JSON:
+//   {"<entityId>": {"f": first_us, "l": last_us, "p": {..props..}}, ...}
+// -1 on error.
+long long pel_aggregate(void* hv, const char* entity_type,
+                        long long start_us, long long until_us, char** out) {
+  Handle* h = (Handle*)hv;
+  std::lock_guard<std::mutex> g(h->mu);
+  ensure_sorted(h);
+  struct Ent {
+    // insertion-ordered props: vector + map of key -> vector index
+    std::vector<std::pair<std::string, std::string>> props;
+    std::unordered_map<std::string, size_t> pos;
+    int64_t first_us = 0, last_us = 0;
+  };
+  std::map<std::string, Ent> state;
+  std::string payload;
+  for (size_t idx : h->sorted) {
+    const Rec& r = h->recs[idx];
+    if (r.time_us < start_us || r.time_us >= until_us) continue;
+    if (!read_payload(h, r, &payload)) continue;
+    int64_t t, c;
+    std::string_view s[9];
+    if (!parse_event((const unsigned char*)payload.data(),
+                     (uint32_t)payload.size(), &t, &c, s))
+      continue;
+    if (entity_type && s[2] != entity_type) continue;
+    std::string eid(s[3]);
+    if (s[1] == "$set") {
+      std::vector<std::pair<std::string, std::string_view>> items;
+      if (!json_object_items(s[6], &items)) continue;
+      auto it = state.find(eid);
+      if (it == state.end()) {
+        Ent e;
+        e.first_us = t;
+        e.last_us = t;
+        for (auto& kv : items) {
+          e.pos[kv.first] = e.props.size();
+          e.props.emplace_back(kv.first, std::string(kv.second));
+        }
+        state.emplace(std::move(eid), std::move(e));
+      } else {
+        Ent& e = it->second;
+        for (auto& kv : items) {
+          auto p = e.pos.find(kv.first);
+          if (p == e.pos.end()) {
+            e.pos[kv.first] = e.props.size();
+            e.props.emplace_back(kv.first, std::string(kv.second));
+          } else {
+            e.props[p->second].second = std::string(kv.second);
+          }
+        }
+        if (t > e.last_us) e.last_us = t;
+      }
+    } else if (s[1] == "$unset") {
+      auto it = state.find(eid);
+      if (it == state.end()) continue;
+      std::vector<std::pair<std::string, std::string_view>> items;
+      if (!json_object_items(s[6], &items)) continue;
+      Ent& e = it->second;
+      for (auto& kv : items) {
+        auto p = e.pos.find(kv.first);
+        if (p != e.pos.end()) {
+          e.props[p->second].first.clear();  // mark dead (empty key)
+          e.props[p->second].second.clear();
+          e.pos.erase(p);
+        }
+      }
+      if (t > e.last_us) e.last_us = t;
+    } else if (s[1] == "$delete") {
+      state.erase(eid);
+    }
+  }
+  std::string outj = "{";
+  bool first_e = true;
+  for (auto& [eid, e] : state) {
+    if (!first_e) outj += ",";
+    first_e = false;
+    outj += "\"" + json_escape(eid) + "\":{\"f\":" +
+            std::to_string(e.first_us) + ",\"l\":" +
+            std::to_string(e.last_us) + ",\"p\":{";
+    bool first_p = true;
+    for (auto& kv : e.props) {
+      if (kv.first.empty() && kv.second.empty()) continue;  // unset
+      if (!first_p) outj += ",";
+      first_p = false;
+      outj += "\"" + json_escape(kv.first) + "\":" + kv.second;
+    }
+    outj += "}}";
+  }
+  outj += "}";
+  *out = dup_out(outj);
+  return *out ? (long long)outj.size() : -1;
+}
+
+void pel_free(char* p) { free(p); }
+
+}  // extern "C"

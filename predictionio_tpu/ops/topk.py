@@ -26,10 +26,17 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG = -3.0e38  # finite "-inf" (python float so the kernel doesn't capture a traced constant)
 
 
-def score_topk_xla(Q, V, k: int):
-    """XLA fallback: full (B, N) score matrix then lax.top_k."""
+def score_topk_xla(Q, V, k: int, n_valid: int = 0):
+    """XLA fallback: full (B, N) score matrix then lax.top_k.
+
+    ``n_valid``: real row count when V carries tail padding (lets a
+    caller share one padded resident copy with :func:`score_topk`).
+    """
     scores = jnp.dot(Q, V.T, preferred_element_type=jnp.float32,
                      precision=jax.lax.Precision.HIGHEST)
+    if n_valid and n_valid < V.shape[0]:
+        col = jnp.arange(V.shape[0])[None, :]
+        scores = jnp.where(col < n_valid, scores, _NEG)
     vals, idx = jax.lax.top_k(scores, k)
     return vals, idx.astype(jnp.int32)
 

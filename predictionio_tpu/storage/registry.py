@@ -81,6 +81,14 @@ register_event_backend(
     lambda cfg: SqliteEventStore(
         os.path.join(_ensure(cfg.home), "events.db")),
 )
+def _eventlog_factory(cfg: "StorageConfig") -> EventStore:
+    # lazy import: building the C++ engine only happens when selected
+    from predictionio_tpu.data.filestore import NativeEventLogStore
+
+    return NativeEventLogStore(os.path.join(_ensure(cfg.home), "eventlog"))
+
+
+register_event_backend("EVENTLOG", _eventlog_factory)
 register_model_backend("MEMORY", lambda cfg: MemoryModelStore())
 register_model_backend(
     "LOCALFS", lambda cfg: LocalFSModelStore(os.path.join(_ensure(cfg.home), "models"))

@@ -14,12 +14,25 @@ def _t(s):
     return parse_event_time(s)
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+def _native_store(tmp_path):
+    try:
+        from predictionio_tpu.data.filestore import NativeEventLogStore
+
+        return NativeEventLogStore(str(tmp_path / "eventlog"))
+    except RuntimeError as e:  # no g++ in this environment
+        pytest.skip(str(e))
+
+
+@pytest.fixture(params=["memory", "sqlite", "eventlog"])
 def store(request, tmp_path):
     if request.param == "memory":
         yield MemoryEventStore()
-    else:
+    elif request.param == "sqlite":
         yield SqliteEventStore(str(tmp_path / "events.db"))
+    else:
+        s = _native_store(tmp_path)
+        yield s
+        s.close()
 
 
 APP = 7

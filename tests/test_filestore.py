@@ -1,0 +1,186 @@
+"""Native (C++) event-log engine specifics: durability, index rebuild,
+and the native $set/$unset/$delete fold vs the Python reference fold."""
+
+import datetime as dt
+import json
+
+import pytest
+
+from predictionio_tpu.data.event import Event, aggregate_properties, parse_event_time
+
+
+def _t(s):
+    return parse_event_time(s)
+
+
+@pytest.fixture
+def store(tmp_path):
+    from predictionio_tpu.data.filestore import NativeEventLogStore
+
+    try:
+        s = NativeEventLogStore(str(tmp_path / "log"))  # builds the engine
+    except RuntimeError as e:  # no g++ in this environment
+        pytest.skip(str(e))
+    yield s
+    s.close()
+
+
+APP = 1
+
+
+def test_reopen_rebuilds_index(tmp_path, store):
+    ids = store.insert_batch(
+        [Event(event="rate", entity_type="user", entity_id=str(i),
+               target_entity_type="item", target_entity_id="x",
+               properties={"rating": float(i)},
+               event_time=_t(f"2026-01-0{i+1}T00:00:00Z"))
+         for i in range(3)],
+        APP)
+    store.delete(ids[1], APP)
+    store.close()
+
+    from predictionio_tpu.data.filestore import NativeEventLogStore
+
+    s2 = NativeEventLogStore(str(tmp_path / "log"))
+    evs = list(s2.find(APP))
+    assert [e.event_id for e in evs] == [ids[0], ids[2]]
+    assert s2.get(ids[1], APP) is None
+    assert s2.get(ids[2], APP).properties == {"rating": 2.0}
+    s2.close()
+
+
+def test_overwrite_by_id(store):
+    e = Event(event="$set", entity_type="user", entity_id="u",
+              properties={"a": 1}, event_time=_t("2026-01-01T00:00:00Z"))
+    eid = store.insert(e, APP)
+    e2 = Event(event_id=eid, event="$set", entity_type="user", entity_id="u",
+               properties={"a": 2}, event_time=_t("2026-01-01T00:00:00Z"))
+    store.insert(e2, APP)
+    evs = list(store.find(APP))
+    assert len(evs) == 1 and evs[0].properties == {"a": 2}
+
+
+def test_nul_and_unicode_roundtrip(store):
+    e = Event(event="note", entity_type="user", entity_id="ué中",
+              properties={"text": 'quote " backslash \\ newline \n tab \t',
+                          "nested": {"k": [1, 2, {"d": None}]},
+                          "num": 1.5, "bool": True},
+              event_time=_t("2026-01-01T00:00:00Z"))
+    eid = store.insert(e, APP)
+    got = store.get(eid, APP)
+    assert got.entity_id == "ué中"
+    assert got.properties == e.properties
+
+
+def test_native_fold_matches_python_fold(store):
+    evs = [
+        Event(event="$set", entity_type="user", entity_id="a",
+              properties={"x": 1, "name": "A"},
+              event_time=_t("2026-01-01T00:00:00Z")),
+        Event(event="$set", entity_type="user", entity_id="a",
+              properties={"x": 2, "y": [1, 2]},
+              event_time=_t("2026-01-03T00:00:00Z")),
+        Event(event="$unset", entity_type="user", entity_id="a",
+              properties={"name": None},
+              event_time=_t("2026-01-04T00:00:00Z")),
+        Event(event="$set", entity_type="user", entity_id="b",
+              properties={"deep": {"n": {"m": "q\"uote"}}},
+              event_time=_t("2026-01-02T00:00:00Z")),
+        Event(event="$set", entity_type="user", entity_id="gone",
+              properties={"z": 1}, event_time=_t("2026-01-02T00:00:00Z")),
+        Event(event="$delete", entity_type="user", entity_id="gone",
+              event_time=_t("2026-01-05T00:00:00Z")),
+        Event(event="rate", entity_type="user", entity_id="a",
+              target_entity_type="item", target_entity_id="i",
+              event_time=_t("2026-01-02T12:00:00Z")),
+        Event(event="$set", entity_type="item", entity_id="other-type",
+              properties={"w": 1}, event_time=_t("2026-01-01T00:00:00Z")),
+    ]
+    store.insert_batch(evs, APP)
+
+    native = store.aggregate_properties(APP, "user")
+    ref = aggregate_properties(
+        e for e in evs if e.entity_type == "user")
+
+    assert set(native) == set(ref) == {"a", "b"}
+    for eid in native:
+        assert native[eid].properties == ref[eid].properties, eid
+        assert native[eid].first_updated == ref[eid].first_updated
+        assert native[eid].last_updated == ref[eid].last_updated
+
+
+def test_fold_backslash_and_unicode_ids(store):
+    # literal backslash text and non-ASCII must survive the native fold
+    evs = [
+        Event(event="$set", entity_type="user", entity_id="C:\\users",
+              properties={"p\\u0041th": "a\\u0042", "中文": "漢"},
+              event_time=_t("2026-01-01T00:00:00Z")),
+    ]
+    store.insert_batch(evs, APP)
+    native = store.aggregate_properties(APP, "user")
+    ref = aggregate_properties(evs)
+    assert set(native) == set(ref) == {"C:\\users"}
+    assert native["C:\\users"].properties == ref["C:\\users"].properties
+
+
+def test_microsecond_roundtrip(store):
+    t = _t("2005-03-28T19:42:50.536110Z")  # float-timestamp rounding victim
+    eid = store.insert(
+        Event(event="e", entity_type="t", entity_id="1", event_time=t), APP)
+    assert store.get(eid, APP).event_time == t
+
+
+def test_limit_zero_returns_nothing(store):
+    store.insert(Event(event="e", entity_type="t", entity_id="1",
+                       event_time=_t("2026-01-01T00:00:00Z")), APP)
+    assert list(store.find(APP, limit=0)) == []
+
+
+def test_fold_time_window(store):
+    for day, val in ((1, 1), (2, 2), (3, 3)):
+        store.insert(
+            Event(event="$set", entity_type="user", entity_id="u",
+                  properties={"v": val},
+                  event_time=_t(f"2026-01-0{day}T00:00:00Z")), APP)
+    agg = store.aggregate_properties(
+        APP, "user", until_time=_t("2026-01-03T00:00:00Z"))
+    assert agg["u"].properties == {"v": 2}
+
+
+def test_find_filters_and_limits(store):
+    store.insert_batch(
+        [Event(event="view", entity_type="user", entity_id="u1",
+               target_entity_type="item", target_entity_id=f"i{k}",
+               event_time=_t(f"2026-02-0{k}T00:00:00Z"))
+         for k in range(1, 6)], APP)
+    got = list(store.find(APP, limit=2, reversed=True))
+    assert [e.target_entity_id for e in got] == ["i5", "i4"]
+    got = list(store.find(APP, target_entity_id="i3"))
+    assert len(got) == 1
+    got = list(store.find(APP, start_time=_t("2026-02-02T00:00:00Z"),
+                          until_time=_t("2026-02-04T00:00:00Z")))
+    assert [e.target_entity_id for e in got] == ["i2", "i3"]
+
+
+def test_torn_tail_write_is_ignored(tmp_path, store):
+    ids = store.insert_batch(
+        [Event(event="e", entity_type="t", entity_id="1",
+               event_time=_t("2026-01-01T00:00:00Z")),
+         Event(event="e", entity_type="t", entity_id="2",
+               event_time=_t("2026-01-02T00:00:00Z"))], APP)
+    store.close()
+    path = tmp_path / "log" / "events_1.pel"
+    raw = path.read_bytes()
+    path.write_bytes(raw + b"\x40\x00\x00\x00\x00partial")  # truncated record
+
+    from predictionio_tpu.data.filestore import NativeEventLogStore
+
+    s2 = NativeEventLogStore(str(tmp_path / "log"))
+    assert [e.event_id for e in s2.find(APP)] == ids
+    # the torn tail is truncated at open: writes after it survive reopen
+    new_id = s2.insert(Event(event="e", entity_type="t", entity_id="3",
+                             event_time=_t("2026-01-03T00:00:00Z")), APP)
+    s2.close()
+    s3 = NativeEventLogStore(str(tmp_path / "log"))
+    assert [e.event_id for e in s3.find(APP)] == ids + [new_id]
+    s3.close()
