@@ -241,7 +241,10 @@ _EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
 def _ts(dt: _dt.datetime) -> int:
     """Epoch microseconds (sortable integer key, like the reference's
     eventTime-based HBase row key). Integer arithmetic — float
-    ``.timestamp()`` is 1µs off for ~1% of values."""
+    ``.timestamp()`` is 1µs off for ~1% of values. Naive datetimes are
+    treated as UTC, matching parse_event_time/format_event_time."""
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
     return (dt - _EPOCH) // _dt.timedelta(microseconds=1)
 
 

@@ -29,19 +29,10 @@ from predictionio_tpu.data.event import (
     PropertyMap,
     validate_event,
 )
-from predictionio_tpu.data.events import EventStore
+from predictionio_tpu.data.events import EventStore, _ts as _ts_us
 
 _UNBOUNDED_LO = -(2**62)
 _UNBOUNDED_HI = 2**62
-
-
-_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
-
-
-def _ts_us(dt: _dt.datetime) -> int:
-    # exact integer microseconds — float .timestamp() rounding corrupts
-    # ~1% of values by 1µs, breaking round-trips and window boundaries
-    return (dt - _EPOCH) // _dt.timedelta(microseconds=1)
 
 
 def _dt_us(us: int) -> _dt.datetime:
@@ -187,6 +178,11 @@ class NativeEventLogStore(EventStore):
     def wipe(self, app_id: int, channel_id: Optional[int] = None) -> None:
         h = self._handle(app_id, channel_id)
         if self._lib.pel_wipe(h) != 0:
+            # the handle may have lost its backing FILE* — drop it from
+            # the cache so the next call reopens instead of segfaulting
+            with self._lock:
+                if self._handles.pop((app_id, channel_id), None) is not None:
+                    self._lib.pel_close(h)
             raise IOError("event log wipe failed")
 
     # -- reads --------------------------------------------------------------

@@ -79,13 +79,13 @@ bool parse_event(const unsigned char* buf, uint32_t len, int64_t* time_us,
   if (len < 16) return false;
   *time_us = rd_i64(buf);
   *creation_us = rd_i64(buf + 8);
-  uint32_t off = 16;
+  uint64_t off = 16;  // 64-bit so a corrupted length field cannot wrap
   for (int i = 0; i < 9; ++i) {
     if (off + 4 > len) return false;
-    uint32_t n = rd_u32(buf + off);
+    uint64_t n = rd_u32(buf + off);
     off += 4;
     if (off + n > len) return false;
-    out[i] = std::string_view((const char*)buf + off, n);
+    out[i] = std::string_view((const char*)buf + off, (size_t)n);
     off += n;
   }
   return off == len;
@@ -125,6 +125,8 @@ void index_record(Handle* h, uint8_t kind, const unsigned char* payload,
 }
 
 bool load_index(Handle* h) {
+  if (fseek(h->f, 0, SEEK_END) != 0) return false;
+  uint64_t file_size = (uint64_t)ftell(h->f);
   if (fseek(h->f, 0, SEEK_SET) != 0) return false;
   uint64_t off = 0;  // end of last fully-readable record
   std::string buf;
@@ -135,7 +137,12 @@ bool load_index(Handle* h) {
     if (n == 0) break;                     // clean EOF
     if (n < 5) { torn = true; break; }     // torn tail write
     uint32_t rec_len = rd_u32(hdr);
-    if (rec_len < 1) { torn = true; break; }
+    // a length that cannot fit in the rest of the file is corruption,
+    // not just a torn tail — truncate rather than try a huge resize
+    if (rec_len < 1 || off + 5 + (uint64_t)(rec_len - 1) > file_size) {
+      torn = true;
+      break;
+    }
     uint8_t kind = hdr[4];
     uint32_t plen = rec_len - 1;
     buf.resize(plen);
@@ -340,11 +347,6 @@ bool json_object_items(
   }
 }
 
-std::string out_buf_to_c(std::string&& s, long long* out_len) {
-  *out_len = (long long)s.size();
-  return std::move(s);
-}
-
 char* dup_out(const std::string& s) {
   char* p = (char*)malloc(s.size() + 1);
   if (!p) return nullptr;
@@ -374,7 +376,7 @@ void* pel_open(const char* path) {
 void pel_close(void* hv) {
   if (!hv) return;
   Handle* h = (Handle*)hv;
-  fclose(h->f);
+  if (h->f) fclose(h->f);
   delete h;
 }
 
@@ -434,7 +436,13 @@ int pel_wipe(void* hv) {
   std::lock_guard<std::mutex> g(h->mu);
   fclose(h->f);
   FILE* trunc = fopen(h->path.c_str(), "wb");  // truncate to zero
-  if (trunc) fclose(trunc);
+  if (!trunc) {
+    // keep the handle usable and the data intact: report failure
+    // instead of clearing the in-memory index over a non-empty file
+    h->f = fopen(h->path.c_str(), "a+b");
+    return -1;
+  }
+  fclose(trunc);
   h->f = fopen(h->path.c_str(), "a+b");
   h->recs.clear();
   h->by_id.clear();

@@ -1,0 +1,147 @@
+"""e2 helper-library tests (reference test model: [U] e2/src/test/scala/
+.../engine/{CategoricalNaiveBayesTest,MarkovChainTest}.scala)."""
+
+import math
+import os
+import stat
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.e2 import (
+    CategoricalNaiveBayesModel,
+    ExternalAlgorithm,
+    LabeledPoint,
+    MarkovChainModel,
+    categorical_naive_bayes_train,
+    markov_chain_train,
+)
+
+
+class TestCategoricalNaiveBayes:
+    POINTS = [
+        LabeledPoint("spam", ["offer", "money"]),
+        LabeledPoint("spam", ["offer", "pills"]),
+        LabeledPoint("spam", ["win", "money"]),
+        LabeledPoint("ham", ["meeting", "money"]),
+        LabeledPoint("ham", ["meeting", "notes"]),
+    ]
+
+    def test_priors_sum_to_one(self):
+        model = categorical_naive_bayes_train(self.POINTS)
+        assert math.isclose(
+            sum(math.exp(v) for v in model.priors.values()), 1.0, rel_tol=1e-6)
+        assert math.isclose(math.exp(model.priors["spam"]), 3 / 5, rel_tol=1e-6)
+
+    def test_likelihoods_normalized_per_position(self):
+        model = categorical_naive_bayes_train(self.POINTS, smoothing=1.0)
+        for label in ("spam", "ham"):
+            for table in model.likelihoods[label]:
+                total = sum(math.exp(v) for v in table.values())
+                # vocabulary covers all observed values → smoothed probs
+                # sum to 1 over the observed vocab
+                assert math.isclose(total, 1.0, rel_tol=1e-6)
+
+    def test_predict(self):
+        model = categorical_naive_bayes_train(self.POINTS)
+        assert model.predict(["offer", "money"]) == "spam"
+        assert model.predict(["meeting", "notes"]) == "ham"
+
+    def test_unseen_value_uses_floor(self):
+        model = categorical_naive_bayes_train(self.POINTS)
+        s = model.log_score(LabeledPoint("spam", ["offer", "UNSEEN"]))
+        assert s is not None and np.isfinite(s)
+
+    def test_unknown_label_none(self):
+        model = categorical_naive_bayes_train(self.POINTS)
+        assert model.log_score(LabeledPoint("nope", ["offer", "money"])) is None
+
+    def test_custom_default_likelihood(self):
+        model = categorical_naive_bayes_train(self.POINTS)
+        s = model.log_score(
+            LabeledPoint("spam", ["offer", "UNSEEN"]),
+            default_likelihood=lambda ll: min(ll) - 1.0,
+        )
+        assert s is not None and np.isfinite(s)
+
+    def test_matches_exact_counts(self):
+        # P(offer|spam) smoothed = (2+1)/(3+V) with V=3 first-position values
+        model = categorical_naive_bayes_train(self.POINTS, smoothing=1.0)
+        got = math.exp(model.likelihoods["spam"][0]["offer"])
+        assert math.isclose(got, 3 / 6, rel_tol=1e-6)
+
+    def test_ragged_rejected(self):
+        with pytest.raises(ValueError):
+            categorical_naive_bayes_train(
+                [LabeledPoint("a", ["x"]), LabeledPoint("b", ["x", "y"])])
+
+
+class TestMarkovChain:
+    def test_row_normalization(self):
+        model = markov_chain_train([(0, 1), (0, 1), (0, 2), (1, 0)], 3)
+        assert math.isclose(model.transition_prob(0, 1), 2 / 3, rel_tol=1e-6)
+        assert math.isclose(model.transition_prob(0, 2), 1 / 3, rel_tol=1e-6)
+        assert model.transition_prob(1, 0) == 1.0
+        # unseen row stays all-zero
+        assert model.transitions[2].sum() == 0.0
+
+    def test_top_k(self):
+        model = markov_chain_train(
+            [(0, 1), (0, 1), (0, 2), (0, 3), (0, 3), (0, 3)], 4)
+        top = model.predict_top_k(0, 2)
+        assert [s for s, _ in top] == [3, 1]
+
+    def test_top_k_excludes_zero_prob(self):
+        model = markov_chain_train([(0, 1)], 5)
+        assert model.predict_top_k(0, 5) == [(1, 1.0)]
+
+    def test_out_of_range_rejected(self):
+        with pytest.raises(ValueError):
+            markov_chain_train([(0, 7)], 3)
+
+
+TRAINER = textwrap.dedent("""\
+    #!%PY%
+    import json, os, sys
+    mode = sys.argv[1]
+    if mode == "train":
+        data = [json.loads(l) for l in open(sys.argv[2])]
+        mean = sum(r["x"] for r in data) / len(data)
+        json.dump({"mean": mean}, open(os.path.join(sys.argv[3], "m.json"), "w"))
+    else:
+        model = json.load(open(os.path.join(sys.argv[2], "m.json")))
+        for line in sys.stdin:
+            q = json.loads(line)
+            print(json.dumps({"y": q["x"] - model["mean"]}), flush=True)
+""")
+
+
+class TestExternalAlgorithm:
+    @pytest.fixture()
+    def algo(self, tmp_path):
+        script = tmp_path / "engine.py"
+        script.write_text(TRAINER.replace("%PY%", sys.executable))
+        script.chmod(script.stat().st_mode | stat.S_IEXEC)
+        a = ExternalAlgorithm({"command": [sys.executable, str(script)]})
+        yield a
+        a.close()
+
+    def test_train_save_load_predict(self, algo, tmp_path, storage):
+        from predictionio_tpu.controller.base import WorkflowContext
+
+        ctx = WorkflowContext(storage=storage)
+        model_dir = algo.train(ctx, [{"x": 1.0}, {"x": 3.0}])
+        inst = str(tmp_path / "instance")
+        os.makedirs(inst)
+        assert algo.save_model(model_dir, inst) is None
+        loaded = algo.load_model(None, inst)
+        out = algo.predict(loaded, {"x": 10.0})
+        assert out == {"y": 8.0}
+        # resident child reused across calls
+        assert algo.predict(loaded, {"x": 2.0}) == {"y": 0.0}
+
+    def test_missing_command_rejected(self):
+        with pytest.raises(ValueError):
+            ExternalAlgorithm({})
