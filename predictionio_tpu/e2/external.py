@@ -24,6 +24,7 @@ import os
 import shutil
 import subprocess
 import tempfile
+import threading
 from typing import Any, Dict, List, Optional
 
 from predictionio_tpu.controller.base import WorkflowContext
@@ -39,6 +40,9 @@ class ExternalAlgorithm(Algorithm):
         if not self.params.get("command"):
             raise ValueError("ExternalAlgorithm needs params['command']")
         self._child: Optional[subprocess.Popen] = None
+        # serializes the write+readline round-trip: the engine server
+        # dispatches concurrent queries via asyncio.to_thread
+        self._lock = threading.Lock()
 
     def _command(self) -> List[str]:
         return list(self.params["command"])
@@ -100,11 +104,12 @@ class ExternalAlgorithm(Algorithm):
         return self._child
 
     def predict(self, model: str, query: Any) -> Any:
-        child = self._ensure_child(model)
-        assert child.stdin is not None and child.stdout is not None
-        child.stdin.write(json.dumps(query) + "\n")
-        child.stdin.flush()
-        line = child.stdout.readline()
+        with self._lock:
+            child = self._ensure_child(model)
+            assert child.stdin is not None and child.stdout is not None
+            child.stdin.write(json.dumps(query) + "\n")
+            child.stdin.flush()
+            line = child.stdout.readline()
         if not line:
             raise RuntimeError("external serve process closed its stdout")
         return json.loads(line)
@@ -116,4 +121,5 @@ class ExternalAlgorithm(Algorithm):
                 self._child.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 self._child.kill()
+                self._child.wait()  # reap — no zombie in a resident server
         self._child = None

@@ -52,10 +52,17 @@ def markov_chain_train(
 
     if n_states <= 0:
         raise ValueError("n_states must be positive")
+    if n_states > 46_340:
+        # S*S must fit int32 (JAX x32 mode) — and a dense (S, S) f32
+        # matrix past this point is >8 GB anyway; shard or sparsify
+        # externally for larger state spaces
+        raise ValueError(
+            f"n_states={n_states} too large for the dense transition "
+            "matrix (max 46340)")
     arr = np.asarray(pairs, np.int32).reshape(-1, 2)
     if arr.size and (arr.min() < 0 or arr.max() >= n_states):
         raise ValueError("state id out of range")
-    flat = arr[:, 0].astype(np.int64) * n_states + arr[:, 1]
+    flat = arr[:, 0].astype(np.int32) * n_states + arr[:, 1]
     counts = segment_sum(
         jnp.ones((len(flat),), jnp.float32), jnp.asarray(flat),
         n_states * n_states,

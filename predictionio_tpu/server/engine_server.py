@@ -43,6 +43,7 @@ class EngineServer:
         feedback: bool = False,
         feedback_app_name: Optional[str] = None,
         plugins: Optional[List[Any]] = None,
+        ssl_context: Optional[Any] = None,
     ) -> None:
         self.storage = storage or get_storage()
         self.engine_factory = engine_factory
@@ -63,7 +64,10 @@ class EngineServer:
         router.route("GET", "/plugins.json", self._plugins_list)
         router.route("GET", "/plugins/{name}/{path+}", self._plugin_route)
         router.route("POST", "/plugins/{name}/{path+}", self._plugin_route)
-        self.http = HTTPServer(router, host, port)
+        if ssl_context is None:
+            from predictionio_tpu.server.ssl_config import ssl_context_from_env
+            ssl_context = ssl_context_from_env()
+        self.http = HTTPServer(router, host, port, ssl_context=ssl_context)
 
     # -- handlers --------------------------------------------------------------
 

@@ -73,6 +73,7 @@ class EventServer:
         port: int = 7070,
         stats: bool = False,
         plugins: Optional[List[Any]] = None,
+        ssl_context: Optional[Any] = None,
     ) -> None:
         self.storage = storage or get_storage()
         self.stats = Stats() if stats else None
@@ -87,7 +88,10 @@ class EventServer:
         router.route("GET", "/stats.json", self._get_stats)
         router.route("POST", "/webhooks/{connector}.json", self._webhook)
         router.route("GET", "/webhooks/{connector}.json", self._webhook_probe)
-        self.http = HTTPServer(router, host, port)
+        if ssl_context is None:
+            from predictionio_tpu.server.ssl_config import ssl_context_from_env
+            ssl_context = ssl_context_from_env()
+        self.http = HTTPServer(router, host, port, ssl_context=ssl_context)
 
     # -- auth ------------------------------------------------------------------
 

@@ -89,10 +89,13 @@ class Router:
 
 
 class HTTPServer:
-    def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 8000) -> None:
+    def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 8000,
+                 ssl_context: Optional[Any] = None) -> None:
         self.router = router
         self.host = host
         self.port = port
+        #: optional ssl.SSLContext (see server.ssl_config) → HTTPS
+        self.ssl_context = ssl_context
         self._server: Optional[asyncio.AbstractServer] = None
         self._shutdown = asyncio.Event()
 
@@ -174,7 +177,7 @@ class HTTPServer:
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port)
+            self._handle_conn, self.host, self.port, ssl=self.ssl_context)
 
     async def serve_forever(self) -> None:
         await self.start()

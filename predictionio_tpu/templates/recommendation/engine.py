@@ -30,6 +30,7 @@ from predictionio_tpu.controller import (
     WorkflowContext,
 )
 from predictionio_tpu.data import store as event_store
+from predictionio_tpu.data.cleaning import SelfCleaningDataSource
 from predictionio_tpu.models.als import (
     ALSParams,
     RatingsCOO,
@@ -59,9 +60,12 @@ class DataSourceParams:
     buy_rating: float = 4.0
     eval_k: int = 0          # >0 enables read_eval with k folds
     eval_seed: int = 3
+    #: optional {"duration": "30 days", "removeDuplicates": bool,
+    #: "compressProperties": bool} — SelfCleaningDataSource window
+    event_window: Optional[Dict[str, Any]] = None
 
 
-class RecDataSource(DataSource):
+class RecDataSource(SelfCleaningDataSource, DataSource):
     ParamsClass = DataSourceParams
 
     def _read_ratings(self, ctx: WorkflowContext) -> List[Rating]:
@@ -86,6 +90,7 @@ class RecDataSource(DataSource):
         return out
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        self.clean(ctx, self.params.app_name)
         ratings = self._read_ratings(ctx)
         if not ratings:
             raise ValueError(

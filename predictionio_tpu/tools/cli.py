@@ -5,9 +5,10 @@ parser dispatching every verb; unverified, SURVEY.md §3). Verb surface
 preserved: ``app`` (new/list/show/delete/data-delete/channel-new/
 channel-delete), ``accesskey`` (new/list/delete), ``eventserver``,
 ``train``, ``deploy``, ``undeploy``, ``eval``, ``batchpredict``,
-``export``, ``import``, ``status``, ``dashboard``, ``template``,
-``version``. Where the reference shelled out to sbt/spark-submit,
-training runs in-process on the JAX mesh — there is no build step.
+``export``, ``import``, ``status``, ``dashboard``, ``adminserver``,
+``template``, ``build``, ``run``, ``shell``, ``version``. Where the
+reference shelled out to sbt/spark-submit, training runs in-process on
+the JAX mesh — ``build`` is static validation rather than compilation.
 
 Usage: ``python -m predictionio_tpu.tools.cli <verb> …`` (or the
 ``pio`` console script once installed).
@@ -305,6 +306,61 @@ def cmd_template(args: argparse.Namespace) -> None:
           f"Edit {dst} (set appName) and run `pio train`.")
 
 
+def cmd_adminserver(args: argparse.Namespace) -> None:
+    from predictionio_tpu.tools.admin import AdminServer
+
+    print(f"[info] Admin server on {args.ip}:{args.port}")
+    AdminServer(host=args.ip, port=args.port).run()
+
+
+def cmd_build(args: argparse.Namespace) -> None:
+    """Validate an engine dir: engine.json parses, factory imports, params
+    bind. The reference's `pio build` compiles Scala; Python needs no
+    compile step, so build = static validation (same gate in the verb
+    sequence build → train → deploy)."""
+    variant = _load_variant_file(args.engine_dir, args.variant)
+    factory = variant.get("engineFactory") or _die("engine.json missing engineFactory")
+    sys.path.insert(0, os.path.abspath(args.engine_dir))
+    from predictionio_tpu.controller.engine import EngineFactory
+
+    try:
+        engine = EngineFactory.create(factory)
+        engine.params_from_variant(variant)
+    except Exception as e:
+        _die(f"engine validation failed: {e}")
+    print(f"[info] Engine {factory} is valid. Ready for `pio train`.")
+
+
+def cmd_run(args: argparse.Namespace) -> None:
+    """Run an arbitrary `module:callable` inside the framework env
+    (reference: `pio run` submits a main class through spark-submit)."""
+    from predictionio_tpu.utils.imports import resolve_spec
+
+    sys.path.insert(0, os.path.abspath(args.engine_dir))
+    fn = resolve_spec(args.main)
+    rv = fn(*args.args)
+    if rv is not None:
+        print(rv)
+
+
+def cmd_shell(args: argparse.Namespace) -> None:
+    """Interactive REPL with the framework pre-imported (reference:
+    `pio-shell` opens a spark-shell with PIO on the classpath)."""
+    import code
+
+    import predictionio_tpu
+    from predictionio_tpu.data import store
+
+    banner = (f"predictionio_tpu {__version__} shell\n"
+              "preloaded: predictionio_tpu, storage (Storage), store "
+              "(PEventStore/LEventStore API)")
+    code.interact(banner=banner, local={
+        "predictionio_tpu": predictionio_tpu,
+        "storage": get_storage(),
+        "store": store,
+    })
+
+
 # -- parser -------------------------------------------------------------------
 
 
@@ -404,6 +460,25 @@ def build_parser() -> argparse.ArgumentParser:
     tps.add_parser("list")
     x = tps.add_parser("new"); x.add_argument("name"); x.add_argument("dir")
     tp.set_defaults(fn=cmd_template)
+
+    ad = sub.add_parser("adminserver", help="REST admin API")
+    ad.add_argument("--ip", default="0.0.0.0")
+    ad.add_argument("--port", type=int, default=7071)
+    ad.set_defaults(fn=cmd_adminserver)
+
+    bd = sub.add_parser("build", help="validate an engine dir")
+    bd.add_argument("--engine-dir", default=".")
+    bd.add_argument("-e", "--variant")
+    bd.set_defaults(fn=cmd_build)
+
+    rn = sub.add_parser("run", help="run a module:callable in the framework env")
+    rn.add_argument("main", help="module:callable")
+    rn.add_argument("args", nargs="*")
+    rn.add_argument("--engine-dir", default=".")
+    rn.set_defaults(fn=cmd_run)
+
+    sh = sub.add_parser("shell", help="interactive framework REPL")
+    sh.set_defaults(fn=cmd_shell)
 
     vp = sub.add_parser("version")
     vp.set_defaults(fn=lambda a: print(__version__))
