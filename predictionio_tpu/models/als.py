@@ -35,6 +35,7 @@ weighted-λ regularization (λ scaled by each entity's rating count).
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
@@ -124,12 +125,17 @@ def init_factors(n: int, rank: int, seed: int) -> np.ndarray:
     return (rng.standard_normal((n, rank)) / np.sqrt(rank)).astype(np.float32)
 
 
-def chunk_update(A, b, chunk, F_other, implicit: bool, alpha: float):
+def chunk_update(A, b, chunk, F_other, implicit: bool, alpha: float,
+                 pallas: Optional[bool] = None):
     """Accumulate one chunk of padded rating rows into the normal equations.
 
     Shared by the single-device and sharded paths so their math cannot
     diverge. ``chunk`` = (row_entity [RC], other_idx [RC,W], vals [RC,W],
-    mask [RC,W]); row_entity sorted within the chunk.
+    mask [RC,W]); row_entity sorted within the chunk. ``pallas`` selects
+    the kernel explicitly — callers tracing for a non-TPU mesh must pass
+    False, because ``jax.default_backend()`` is not a reliable proxy for
+    the platform the trace will run on (e.g. CPU shard_map under a
+    tunneled-TPU default backend).
     """
     import jax.numpy as jnp
 
@@ -147,7 +153,9 @@ def chunk_update(A, b, chunk, F_other, implicit: bool, alpha: float):
     # weighting so the weighted copy of F never round-trips HBM)
     from predictionio_tpu import ops
 
-    if ops.use_pallas():
+    if pallas is None:
+        pallas = ops.use_pallas()
+    if pallas:
         A_rows, b_rows = ops.rows_gram(F, w_outer, w_b)
     else:
         A_rows, b_rows = ops.rows_gram_xla(F, w_outer, w_b)
@@ -156,7 +164,8 @@ def chunk_update(A, b, chunk, F_other, implicit: bool, alpha: float):
     return A, b
 
 
-def _build_normal_eq(n_self: int, implicit: bool, alpha: float):
+def _build_normal_eq(n_self: int, implicit: bool, alpha: float,
+                     pallas: Optional[bool] = None):
     """Returns f(F_other, chunks) -> (A [n_self,k,k], b [n_self,k]) where
     chunks are row-layout arrays reshaped to [n_chunks, RC, ...]."""
     import jax
@@ -168,7 +177,8 @@ def _build_normal_eq(n_self: int, implicit: bool, alpha: float):
         b0 = jnp.zeros((n_self, k), jnp.float32)
 
         def body(carry, chunk):
-            return chunk_update(*carry, chunk, F_other, implicit, alpha), None
+            return chunk_update(*carry, chunk, F_other, implicit, alpha,
+                                pallas), None
 
         (A, b), _ = jax.lax.scan(body, (A0, b0),
                                  (row_entity, other_idx, vals, mask))
@@ -206,13 +216,10 @@ def als_train(
         from predictionio_tpu.models.als_sharded import als_train_sharded
 
         return als_train_sharded(coo, params, mesh)
-    return _als_train_single(coo, params)
-
-
-def _ops_use_pallas() -> bool:
-    from predictionio_tpu import ops
-
-    return ops.use_pallas()
+    # a 1-device mesh still pins the platform: run the single-device path
+    # on THAT device, not wherever the default backend happens to live
+    device = mesh.devices.flat[0] if mesh is not None else None
+    return _als_train_single(coo, params, device=device)
 
 
 @functools.lru_cache(maxsize=8)
@@ -228,8 +235,8 @@ def _compiled_single(n_users: int, n_items: int, u_rows: int, i_rows: int,
     import jax
     import jax.numpy as jnp
 
-    ne_user = _build_normal_eq(n_users, implicit, alpha)
-    ne_item = _build_normal_eq(n_items, implicit, alpha)
+    ne_user = _build_normal_eq(n_users, implicit, alpha, pallas)
+    ne_item = _build_normal_eq(n_items, implicit, alpha, pallas)
 
     def train(u_chunks, i_chunks, cnt_u, cnt_i, V0):
         k = rank
@@ -263,17 +270,20 @@ def _compiled_single(n_users: int, n_items: int, u_rows: int, i_rows: int,
     return jax.jit(train)
 
 
-def _chunked(arrs, chunk_rows: int):
+def _chunked(arrs, chunk_rows: int, put=None):
     import jax.numpy as jnp
 
+    put = put or jnp.asarray
     out = []
     for a in arrs:
         n_chunks = a.shape[0] // chunk_rows
-        out.append(jnp.asarray(a.reshape((n_chunks, chunk_rows) + a.shape[1:])))
+        out.append(put(a.reshape((n_chunks, chunk_rows) + a.shape[1:])))
     return tuple(out)
 
 
-def _als_train_single(coo: RatingsCOO, p: ALSParams) -> Tuple[np.ndarray, np.ndarray]:
+def _als_train_single(coo: RatingsCOO, p: ALSParams,
+                      device=None) -> Tuple[np.ndarray, np.ndarray]:
+    import jax
     import jax.numpy as jnp
 
     W = p.row_width
@@ -283,18 +293,26 @@ def _als_train_single(coo: RatingsCOO, p: ALSParams) -> Tuple[np.ndarray, np.nda
     i_rows = rows_layout(coo.item_idx, coo.user_idx, coo.rating,
                          coo.n_items, W, RC)
 
-    u_chunks = _chunked(u_rows, RC)
-    i_chunks = _chunked(i_rows, RC)
-    cnt_u = jnp.asarray(_counts(coo.user_idx, coo.n_users))
-    cnt_i = jnp.asarray(_counts(coo.item_idx, coo.n_items))
+    def put(a):
+        return jnp.asarray(a) if device is None else jax.device_put(a, device)
 
+    u_chunks = _chunked(u_rows, RC, put)
+    i_chunks = _chunked(i_rows, RC, put)
+    cnt_u = put(_counts(coo.user_idx, coo.n_users))
+    cnt_i = put(_counts(coo.item_idx, coo.n_items))
+
+    from predictionio_tpu import ops
+
+    # Pallas keyed on the device actually used (an explicit 1-device mesh
+    # pins it; otherwise the default backend decides)
+    pallas = ops.use_pallas(device.platform if device is not None else None)
     train = _compiled_single(
         coo.n_users, coo.n_items, u_rows[0].shape[0], i_rows[0].shape[0],
         RC, W, p.rank, p.iterations,
         float(p.reg), bool(p.implicit), float(p.alpha), bool(p.weighted_reg),
-        _ops_use_pallas())
+        pallas)
     U, V = train(u_chunks, i_chunks, cnt_u, cnt_i,
-                 jnp.asarray(init_factors(coo.n_items, p.rank, p.seed)))
+                 put(init_factors(coo.n_items, p.rank, p.seed)))
     return np.asarray(U), np.asarray(V)
 
 

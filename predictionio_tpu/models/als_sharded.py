@@ -101,15 +101,22 @@ def _compiled_sharded(mesh, n_dev: int, block_u: int, block_i: int,
     k = rank
     eye = jnp.eye(k, dtype=jnp.float32)
 
+    def _pvary(x):
+        # vma-typing compat: pcast on new jax, pvary on older
+        if hasattr(jax.lax, "pcast"):
+            return jax.lax.pcast(x, "data", to="varying")
+        return jax.lax.pvary(x, "data")
+
     def local_normal_eq(F_full, chunks, n_local):
         """Accumulate A [n_local,k,k], b [n_local,k] from this device's
         rating rows (row_entity already block-local). Same math as the
         single-device path via the shared chunk_update."""
-        A0 = jax.lax.pvary(jnp.zeros((n_local, k, k), jnp.float32), "data")
-        b0 = jax.lax.pvary(jnp.zeros((n_local, k), jnp.float32), "data")
+        A0 = _pvary(jnp.zeros((n_local, k, k), jnp.float32))
+        b0 = _pvary(jnp.zeros((n_local, k), jnp.float32))
 
         def body(carry, chunk):
-            return chunk_update(*carry, chunk, F_full, implicit, alpha), None
+            return chunk_update(*carry, chunk, F_full, implicit, alpha,
+                                pallas), None
 
         (A, b), _ = jax.lax.scan(body, (A0, b0), chunks)
         return A, b
@@ -142,7 +149,7 @@ def _compiled_sharded(mesh, n_dev: int, block_u: int, block_i: int,
             return (U_l, V_l), None
 
         # mark the zero carry as varying over the mesh axis (vma typing)
-        U0_l = jax.lax.pvary(jnp.zeros((block_u, k), jnp.float32), "data")
+        U0_l = _pvary(jnp.zeros((block_u, k), jnp.float32))
         (U_l, V_l), _ = jax.lax.scan(step, (U0_l, V_l), None, length=iterations)
         return U_l, V_l
 
@@ -185,12 +192,17 @@ def als_train_sharded(
     # they contribute nothing to the first implicit Gram term
     V0 = _pad_rows(init_factors(coo.n_items, p.rank, p.seed), n_items_p)
 
-    from predictionio_tpu.models.als import _ops_use_pallas
+    from predictionio_tpu import ops
 
+    # key Pallas on the MESH devices, not jax.default_backend(): a CPU
+    # mesh can be traced while the default backend is a tunneled TPU
+    # (and vice versa)
+    mesh_is_tpu = all(d.platform == "tpu" for d in mesh.devices.flat)
+    pallas = ops.use_pallas("tpu" if mesh_is_tpu else "cpu")
     train = _compiled_sharded(
         mesh, n_dev, block_u, block_i,
         p.rank, p.iterations, float(p.reg), bool(p.implicit), float(p.alpha),
-        bool(p.weighted_reg), _ops_use_pallas())
+        bool(p.weighted_reg), pallas)
 
     # place inputs directly onto the mesh with their shard_map layouts —
     # never through the default backend (which may be a different

@@ -17,17 +17,25 @@ from predictionio_tpu.ops.segment import segment_count, segment_mean, segment_su
 from predictionio_tpu.ops.topk import score_topk, score_topk_xla
 
 
-def use_pallas() -> bool:
+def use_pallas(platform=None) -> bool:
     """Compiled Pallas kernels only make sense on real TPU backends.
+
+    ``platform`` is the platform the trace will actually run on (pass
+    the mesh's / target device's ``.platform``); when None the default
+    backend decides — callers compiling for an explicit device or mesh
+    must pass it, because ``jax.default_backend()`` can differ from the
+    execution platform (e.g. CPU mesh under a tunneled-TPU backend).
     ``PIO_NO_PALLAS=1`` forces the XLA fallbacks (A/B benching, triage).
     """
     import os
 
-    import jax
-
     if os.environ.get("PIO_NO_PALLAS"):
         return False
-    return jax.default_backend() == "tpu"
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    return platform == "tpu"
 
 
 __all__ = [

@@ -485,8 +485,24 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+# verbs whose command path (or user engine code under it) imports jax —
+# the others must not pay jax import cost at CLI startup
+_JAX_VERBS = {"train", "deploy", "eval", "batchpredict", "status", "run",
+              "shell", "build"}
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     args = build_parser().parse_args(argv)
+    # Restrict jax to a specific platform before any backend init. The
+    # env-var route (JAX_PLATFORMS) is not reliable here: this image's
+    # sitecustomize registers the tunneled-TPU plugin at interpreter
+    # startup regardless, so the config knob is the only effective one.
+    # Used by the integration harness (tests/scenarios) to force CPU.
+    platforms = os.environ.get("PIO_JAX_PLATFORMS")
+    if platforms and args.cmd in _JAX_VERBS:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
     args.fn(args)
 
 

@@ -1,0 +1,124 @@
+"""Tier-2 scenario: Event Server API contract over real HTTP.
+
+Mirrors the reference's eventserver integration scenario (reference: [U]
+tests/pio_tests/scenarios/eventserver_test.py — auth errors, batch
+limits, filters; SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tests.scenarios import harness as h
+
+
+@pytest.fixture(scope="module")
+def es(tmp_path_factory):
+    env = h.scenario_env(str(tmp_path_factory.mktemp("pio_home")))
+    key = h.new_app(env, "ESContractApp")
+    port = h.free_port()
+    server = h.Server(["eventserver", "--ip", "127.0.0.1",
+                       "--port", str(port), "--stats"], env, port)
+    server.access_key = key  # type: ignore[attr-defined]
+    yield server
+    server.stop()
+
+
+EV = {"event": "rate", "entityType": "user", "entityId": "u1",
+      "targetEntityType": "item", "targetEntityId": "i1",
+      "properties": {"rating": 3.0}}
+
+
+@pytest.mark.scenario
+class TestAuth:
+    def test_missing_key(self, es):
+        status, _ = es.post("/events.json", EV)
+        assert status == 401
+
+    def test_wrong_key(self, es):
+        status, _ = es.post("/events.json?accessKey=bogus", EV)
+        assert status == 401
+
+    def test_get_requires_key_too(self, es):
+        status, _ = es.get("/events.json")
+        assert status == 401
+
+
+@pytest.mark.scenario
+class TestContract:
+    def test_single_insert_fetch_delete(self, es):
+        k = es.access_key
+        status, body = es.post(f"/events.json?accessKey={k}", EV)
+        assert status == 201
+        eid = body["eventId"]
+
+        status, body = es.get(f"/events/{eid}.json?accessKey={k}")
+        assert status == 200
+        assert body["event"] == "rate" and body["entityId"] == "u1"
+
+        status, _ = es.delete(f"/events/{eid}.json?accessKey={k}")
+        assert status == 200
+        status, _ = es.get(f"/events/{eid}.json?accessKey={k}")
+        assert status == 404
+
+    def test_malformed_event_rejected(self, es):
+        k = es.access_key
+        status, _ = es.post(f"/events.json?accessKey={k}",
+                            {"event": "rate"})  # no entityType/entityId
+        assert status == 400
+        # reserved $-event with a target entity is invalid
+        status, _ = es.post(f"/events.json?accessKey={k}",
+                            {"event": "$set", "entityType": "user",
+                             "entityId": "u1", "targetEntityType": "item",
+                             "targetEntityId": "i1"})
+        assert status == 400
+
+    def test_batch_limit_50(self, es):
+        k = es.access_key
+        status, body = es.post(f"/batch/events.json?accessKey={k}", [EV] * 51)
+        assert status == 400
+
+    def test_batch_per_item_status(self, es):
+        k = es.access_key
+        bad = {"event": "rate"}  # invalid: missing entity fields
+        status, body = es.post(f"/batch/events.json?accessKey={k}",
+                               [EV, bad, EV])
+        assert status == 200
+        assert [item["status"] for item in body] == [201, 400, 201]
+
+    def test_find_filters(self, es):
+        k = es.access_key
+        evs = [
+            {"event": "view", "entityType": "user", "entityId": "f1",
+             "targetEntityType": "item", "targetEntityId": "x",
+             "eventTime": "2020-01-01T00:00:00.000Z"},
+            {"event": "buy", "entityType": "user", "entityId": "f1",
+             "targetEntityType": "item", "targetEntityId": "x",
+             "eventTime": "2020-06-01T00:00:00.000Z"},
+            {"event": "view", "entityType": "user", "entityId": "f2",
+             "targetEntityType": "item", "targetEntityId": "y",
+             "eventTime": "2021-01-01T00:00:00.000Z"},
+        ]
+        status, body = es.post(f"/batch/events.json?accessKey={k}", evs)
+        assert status == 200
+
+        status, body = es.get(
+            f"/events.json?accessKey={k}&event=view&entityId=f1&entityType=user")
+        assert status == 200
+        assert len(body) == 1 and body[0]["eventTime"].startswith("2020-01-01")
+
+        status, body = es.get(
+            f"/events.json?accessKey={k}&entityType=user&entityId=f1"
+            f"&startTime=2020-03-01T00:00:00.000Z")
+        assert status == 200
+        assert [e["event"] for e in body] == ["buy"]
+
+        status, body = es.get(
+            f"/events.json?accessKey={k}&entityType=user&entityId=f1"
+            f"&reversed=true")
+        assert status == 200
+        assert body[0]["event"] == "buy"  # newest first
+
+    def test_stats_endpoint(self, es):
+        status, body = es.get("/stats.json")
+        assert status == 200

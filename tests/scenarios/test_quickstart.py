@@ -1,0 +1,115 @@
+"""Tier-2 acceptance scenario: the full quickstart loop, real processes.
+
+Mirrors the reference's quickstart integration scenario (reference: [U]
+tests/pio_tests/scenarios/quickstart_test.py — app new → import events →
+build → train → deploy → query → assert predictions; SURVEY.md §4), with
+real ``bin/pio`` subprocesses and HTTP servers — no Docker, CPU JAX.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tests.scenarios import harness as h
+
+
+@pytest.mark.scenario
+def test_quickstart_full_loop(tmp_path):
+    env = h.scenario_env(str(tmp_path / "pio_home"))
+    engine_dir = str(tmp_path / "engine")
+
+    # -- app new ---------------------------------------------------------
+    access_key = h.new_app(env, "ScenarioApp")
+    assert access_key
+
+    # -- build (static validation of the engine dir) ---------------------
+    h.write_engine_variant(engine_dir, "ScenarioApp")
+    h.pio(["build", "--engine-dir", engine_dir], env)
+
+    # -- event ingestion over HTTP ---------------------------------------
+    es_port = h.free_port()
+    with h.Server(["eventserver", "--ip", "127.0.0.1",
+                   "--port", str(es_port), "--stats"], env, es_port) as es:
+        status, body = es.get("/")
+        assert status == 200
+
+        events = h.rating_events()
+        # single inserts for a few, batch for the rest (both API paths)
+        for ev in events[:3]:
+            status, body = es.post(f"/events.json?accessKey={access_key}", ev)
+            assert status == 201, body
+            assert body["eventId"]
+        status, body = es.post(
+            f"/batch/events.json?accessKey={access_key}", events[3:])
+        assert status == 200
+        assert all(item["status"] == 201 for item in body)
+
+        status, body = es.get(f"/events.json?accessKey={access_key}&limit=500")
+        assert status == 200
+        assert len(body) == len(events)
+
+        status, body = es.get("/stats.json")
+        assert status == 200
+
+        # -- train (separate process, shared PIO_HOME storage) -----------
+        out = h.pio(["train", "--engine-dir", engine_dir], env).stdout
+        assert "Training completed" in out
+
+        # -- deploy + query ----------------------------------------------
+        dp_port = h.free_port()
+        with h.Server(["deploy", "--engine-dir", engine_dir, "--ip",
+                       "127.0.0.1", "--port", str(dp_port)], env, dp_port) as dp:
+            status, body = dp.get("/")
+            assert status == 200
+
+            status, body = dp.post("/queries.json", {"user": "0", "num": 4})
+            assert status == 200, body
+            scores = body["itemScores"]
+            assert len(scores) == 4
+            # user 0 belongs to the even clique: top recs must be even items
+            assert all(int(s["item"]) % 2 == 0 for s in scores), scores
+            assert scores == sorted(scores, key=lambda s: -s["score"])
+
+            # unknown user → graceful empty result, not an error
+            status, body = dp.post("/queries.json", {"user": "nope", "num": 4})
+            assert status == 200
+            assert body["itemScores"] == []
+
+            # /reload hot-swaps to the latest completed instance
+            status, _ = dp.get("/reload")
+            assert status == 200
+            status, body = dp.post("/queries.json", {"user": "1", "num": 3})
+            assert status == 200
+            assert all(int(s["item"]) % 2 == 1 for s in body["itemScores"])
+
+
+@pytest.mark.scenario
+def test_batchpredict_cli(tmp_path):
+    """`pio batchpredict`: queries JSONL in → predictions JSONL out."""
+    import json
+
+    env = h.scenario_env(str(tmp_path / "pio_home"))
+    engine_dir = str(tmp_path / "engine")
+    access_key = h.new_app(env, "BatchApp")
+    h.write_engine_variant(engine_dir, "BatchApp")
+
+    es_port = h.free_port()
+    with h.Server(["eventserver", "--ip", "127.0.0.1",
+                   "--port", str(es_port)], env, es_port) as es:
+        status, body = es.post(
+            f"/batch/events.json?accessKey={access_key}", h.rating_events())
+        assert status == 200
+
+    h.pio(["train", "--engine-dir", engine_dir], env)
+
+    qfile = tmp_path / "queries.jsonl"
+    qfile.write_text("\n".join(
+        json.dumps({"user": str(u), "num": 3}) for u in range(4)))
+    ofile = tmp_path / "predictions.jsonl"
+    h.pio(["batchpredict", "--engine-dir", engine_dir,
+           "--input", str(qfile), "--output", str(ofile)], env)
+
+    lines = [json.loads(l) for l in ofile.read_text().splitlines() if l]
+    assert len(lines) == 4
+    for rec in lines:
+        assert len(rec["prediction"]["itemScores"]) == 3
