@@ -92,20 +92,14 @@ def _compiled_sharded(mesh, n_dev: int, block_u: int, block_i: int,
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    try:  # jax>=0.6 moved shard_map out of experimental
-        from jax import shard_map as _sm
-        shard_map = _sm.shard_map if hasattr(_sm, "shard_map") else _sm
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
+    from predictionio_tpu.parallel.mesh import get_shard_map, pvary
 
+    shard_map = get_shard_map()
     k = rank
     eye = jnp.eye(k, dtype=jnp.float32)
 
     def _pvary(x):
-        # vma-typing compat: pcast on new jax, pvary on older
-        if hasattr(jax.lax, "pcast"):
-            return jax.lax.pcast(x, "data", to="varying")
-        return jax.lax.pvary(x, "data")
+        return pvary(x, "data")
 
     def local_normal_eq(F_full, chunks, n_local):
         """Accumulate A [n_local,k,k], b [n_local,k] from this device's

@@ -4,5 +4,13 @@ from predictionio_tpu.parallel.mesh import (
     shard_batch,
     replicated,
 )
+from predictionio_tpu.parallel.ring_attention import (
+    attention_reference,
+    ring_attention,
+)
+from predictionio_tpu.parallel.ulysses import ulysses_attention
 
-__all__ = ["MeshConfig", "make_mesh", "shard_batch", "replicated"]
+__all__ = [
+    "MeshConfig", "make_mesh", "shard_batch", "replicated",
+    "attention_reference", "ring_attention", "ulysses_attention",
+]

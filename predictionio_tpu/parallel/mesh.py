@@ -100,6 +100,28 @@ def platform_devices(platform: Optional[str] = None):
         return jax.devices(platform)
 
 
+def get_shard_map():
+    """jax version compat: shard_map moved out of experimental in 0.6."""
+    try:
+        from jax import shard_map as _sm
+
+        return _sm.shard_map if hasattr(_sm, "shard_map") else _sm
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map
+
+
+def pvary(x, axis: str):
+    """Mark ``x`` varying over ``axis`` (vma typing for scan/fori carries
+    inside shard_map). pcast on new jax, pvary on older."""
+    import jax
+
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis, to="varying")
+    return jax.lax.pvary(x, axis)
+
+
 def replicated(mesh) -> Any:
     from jax.sharding import NamedSharding, PartitionSpec
 

@@ -1,0 +1,112 @@
+"""Sequence-parallel attention + distributed shell, on the 8-device
+CPU mesh (SURVEY.md §4 testing model: real SPMD semantics, no TPU)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from predictionio_tpu.parallel.ring_attention import (
+    attention_reference,
+    ring_attention,
+)
+from predictionio_tpu.parallel.ulysses import ulysses_attention
+
+
+def _qkv(B=2, S=32, H=8, D=16, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+class TestRingAttention:
+    def test_matches_reference(self, cpu_mesh):
+        q, k, v = _qkv()
+        out = ring_attention(q, k, v, mesh=cpu_mesh)
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_causal_matches_reference(self, cpu_mesh):
+        q, k, v = _qkv(seed=1)
+        out = ring_attention(q, k, v, mesh=cpu_mesh, causal=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_cross_length_causal(self, cpu_mesh):
+        """Sq != Sk: K blocks must stride by their OWN local length."""
+        rng = np.random.default_rng(4)
+        q = jnp.asarray(rng.standard_normal((2, 8, 4, 16)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((2, 16, 4, 16)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((2, 16, 4, 16)), jnp.float32)
+        out = ring_attention(q, k, v, mesh=cpu_mesh, causal=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_unknown_axis_raises(self, cpu_mesh):
+        q, k, v = _qkv()
+        with pytest.raises(ValueError):
+            ring_attention(q, k, v, mesh=cpu_mesh, axis="seq")
+
+    def test_no_mesh_fallback(self):
+        q, k, v = _qkv(S=8)
+        out = ring_attention(q, k, v, mesh=None, causal=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5)
+
+    def test_indivisible_seq_raises(self, cpu_mesh):
+        q, k, v = _qkv(S=30)  # 30 % 8 != 0
+        with pytest.raises(ValueError):
+            ring_attention(q, k, v, mesh=cpu_mesh)
+
+
+class TestUlysses:
+    def test_matches_reference(self, cpu_mesh):
+        q, k, v = _qkv(seed=2)
+        out = ulysses_attention(q, k, v, mesh=cpu_mesh)
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_causal_matches_reference(self, cpu_mesh):
+        q, k, v = _qkv(seed=3)
+        out = ulysses_attention(q, k, v, mesh=cpu_mesh, causal=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_indivisible_heads_raises(self, cpu_mesh):
+        q, k, v = _qkv(H=6)  # 6 % 8 != 0
+        with pytest.raises(ValueError):
+            ulysses_attention(q, k, v, mesh=cpu_mesh)
+
+
+class TestDistributedShell:
+    def test_single_process_degenerate(self):
+        from predictionio_tpu.parallel import distributed as dist
+
+        assert dist.initialize() is False  # no multi-process requested
+        assert dist.process_count() == 1
+        assert dist.is_coordinator()
+        dist.barrier()  # no-op, must not raise
+        tree = {"a": np.arange(3)}
+        out = dist.broadcast_from_coordinator(tree)
+        np.testing.assert_array_equal(out["a"], tree["a"])
+        assert len(dist.local_devices()) >= 1
+
+    def test_config_from_env(self, monkeypatch):
+        from predictionio_tpu.parallel.distributed import DistributedConfig
+
+        monkeypatch.setenv("PIO_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+        monkeypatch.setenv("PIO_NUM_PROCESSES", "4")
+        monkeypatch.setenv("PIO_PROCESS_ID", "2")
+        cfg = DistributedConfig.from_env()
+        assert cfg.requested
+        assert (cfg.coordinator_address, cfg.num_processes, cfg.process_id) \
+            == ("10.0.0.1:1234", 4, 2)
