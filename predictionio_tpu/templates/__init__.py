@@ -15,5 +15,6 @@ TEMPLATES = {
     "ecommercerecommendation": "predictionio_tpu.templates.ecommercerecommendation.engine",
     "universal": "predictionio_tpu.templates.universal.engine",
     "twotower": "predictionio_tpu.templates.twotower.engine",
+    "sequentialrec": "predictionio_tpu.templates.sequentialrec.engine",
     "vanilla": "predictionio_tpu.templates.vanilla.engine",
 }

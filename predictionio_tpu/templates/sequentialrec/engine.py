@@ -1,0 +1,207 @@
+"""Sequential Recommendation template: self-attentive next-item model.
+
+No counterpart in the reference (it has no sequence models — SURVEY.md
+§5); this template extends the gallery with the framework's long-context
+model family (:mod:`predictionio_tpu.models.seq_rec`, SASRec-style).
+DASE shape mirrors the other recommenders:
+
+- DataSource: interaction events (default ``view``/``buy``/``rate``)
+  grouped per user, ordered by eventTime → item-id sequences.
+- Algorithm: causal-transformer next-item model; one compiled training
+  program; ring attention over a mesh sequence axis for long histories.
+- Serving: the user's recent history is read LIVE from the event store
+  at query time (like the e-commerce template's seen-items rule), so
+  new events shift predictions without retraining.
+
+    POST /queries.json {"user": "u1", "num": 4}
+    → {"itemScores": [{"item": "i9", "score": 3.1}, ...]}
+
+Optional query keys: ``history`` (explicit item list overriding the
+live lookup — supports anonymous sessions), ``blackList``.
+"""
+
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    WorkflowContext,
+)
+from predictionio_tpu.data import store as event_store
+from predictionio_tpu.models.seq_rec import (
+    SeqRecParams,
+    seq_rec_scores,
+    seq_rec_train,
+)
+from predictionio_tpu.utils.bimap import BiMap
+
+
+@dataclass
+class DataSourceParams:
+    app_name: str = ""
+    event_names: List[str] = field(
+        default_factory=lambda: ["view", "buy", "rate"])
+
+
+@dataclass
+class TrainingData:
+    app_name: str
+    # per user: item ids ordered by event time (strings, raw)
+    sequences: Dict[str, List[str]]
+
+
+class SeqDataSource(DataSource):
+    ParamsClass = DataSourceParams
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        p: DataSourceParams = self.params
+        per_user: Dict[str, List[tuple]] = {}
+        for e in event_store.find(
+            p.app_name, entity_type="user", target_entity_type="item",
+            event_names=p.event_names, storage=ctx.storage,
+        ):
+            if e.target_entity_id is None:
+                continue
+            per_user.setdefault(e.entity_id, []).append(
+                (e.event_time, e.target_entity_id))
+        if not per_user:
+            raise ValueError("no interaction events found")
+        seqs = {u: [i for _, i in sorted(evs, key=lambda t: t[0])]
+                for u, evs in per_user.items()}
+        return TrainingData(p.app_name, seqs)
+
+
+@dataclass
+class SeqRecAlgorithmParams:
+    hidden: int = 64
+    num_blocks: int = 2
+    num_heads: int = 2
+    seq_len: int = 64
+    epochs: int = 20
+    lr: float = 1e-3
+    batch_size: int = 128
+    seed: int = 7
+    # serving: which events form the live history
+    history_events: List[str] = field(
+        default_factory=lambda: ["view", "buy", "rate"])
+    # sequential consumption is often repeat-friendly (music, groceries);
+    # flip on to ban already-seen items like the ALS recommenders do
+    exclude_seen: bool = False
+
+
+class SeqRecModel:
+    def __init__(self, params: Dict, item_ids: BiMap, app_name: str,
+                 hp: SeqRecParams, algo_params: "SeqRecAlgorithmParams",
+                 losses: np.ndarray) -> None:
+        self.params = params
+        self.item_ids = item_ids  # raw item id → 1-based index
+        self._inv = item_ids.inverse()
+        self.app_name = app_name
+        self.hp = hp
+        self.algo_params = algo_params
+        self.losses = losses
+
+    def live_history(self, user: str, storage) -> List[str]:
+        # only the last seq_len interactions can influence the model; with
+        # exclude_seen the FULL history is needed to ban every seen item
+        limit = None if self.algo_params.exclude_seen else self.hp.seq_len
+        evs = event_store.find_by_entity(
+            self.app_name, "user", user,
+            event_names=self.algo_params.history_events,
+            target_entity_type="item", limit=limit, latest=True,
+            storage=storage)
+        ordered = sorted(evs, key=lambda e: e.event_time)
+        return [e.target_entity_id for e in ordered if e.target_entity_id]
+
+    def next_items(self, history_raw: List[str], num: int,
+                   black_list: Optional[List[str]] = None
+                   ) -> List[Dict[str, Any]]:
+        hist = [self.item_ids[i] + 1 for i in history_raw
+                if i in self.item_ids]
+        scores = seq_rec_scores(self.params, hist, self.hp)  # PAD = -inf
+        banned = set(black_list or [])
+        if self.algo_params.exclude_seen:
+            banned |= set(history_raw)
+        for raw in banned:  # ban by -inf, then one partial top-k (als.py shape)
+            idx = self.item_ids.get(raw)
+            if idx is not None:
+                scores[idx + 1] = -np.inf
+        num = min(num, len(self.item_ids))
+        top = np.argpartition(-scores, num)[:num]
+        top = top[np.argsort(-scores[top])]
+        return [{"item": self._inv[int(i) - 1], "score": float(scores[i])}
+                for i in top if np.isfinite(scores[i])]
+
+
+class SeqRecAlgorithm(Algorithm):
+    ParamsClass = SeqRecAlgorithmParams
+
+    def sanity_check(self, data: TrainingData) -> None:
+        if not any(len(s) >= 2 for s in data.sequences.values()):
+            raise ValueError("no user has a sequence of length ≥ 2")
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> SeqRecModel:
+        p: SeqRecAlgorithmParams = self.params
+        item_ids = BiMap.string_int(
+            i for seq in pd.sequences.values() for i in seq)
+        # vocab ids are 1-based (0 = PAD)
+        sequences = [[item_ids[i] + 1 for i in seq]
+                     for seq in pd.sequences.values()]
+        hp = SeqRecParams(hidden=p.hidden, num_blocks=p.num_blocks,
+                          num_heads=p.num_heads, seq_len=p.seq_len,
+                          epochs=p.epochs, lr=p.lr,
+                          batch_size=p.batch_size, seed=p.seed)
+        # meshConf routes attention through ring attention over the mesh's
+        # sequence axis (falls back to local if seq_len doesn't divide)
+        params, losses = seq_rec_train(sequences, len(item_ids), hp,
+                                       mesh=ctx.mesh)
+        return SeqRecModel(params, item_ids, pd.app_name, hp, p, losses)
+
+    def predict(self, model: SeqRecModel, query: Dict[str, Any]
+                ) -> Dict[str, Any]:
+        num = int(query.get("num", 10))
+        if "history" in query:  # anonymous-session path
+            history = [str(i) for i in query["history"]]
+        else:
+            history = model.live_history(str(query["user"]),
+                                         self.serving_storage)
+        return {"itemScores": model.next_items(
+            history, num, query.get("blackList"))}
+
+    def save_model(self, model: SeqRecModel, instance_dir: Optional[str]
+                   ) -> bytes:
+        import jax
+
+        return pickle.dumps({
+            "params": jax.tree.map(np.asarray, model.params),
+            "item_ids": model.item_ids.to_dict(),
+            "app_name": model.app_name,
+            "hp": model.hp,
+            "algo_params": model.algo_params,
+            "losses": model.losses,
+        })
+
+    def load_model(self, blob: Optional[bytes],
+                   instance_dir: Optional[str]) -> SeqRecModel:
+        assert blob is not None
+        d = pickle.loads(blob)
+        return SeqRecModel(d["params"], BiMap(d["item_ids"]), d["app_name"],
+                           d["hp"], d["algo_params"], d["losses"])
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_cls=SeqDataSource,
+        preparator_cls=IdentityPreparator,
+        algorithm_cls_map={"seqrec": SeqRecAlgorithm},
+        serving_cls=FirstServing,
+    )

@@ -1,0 +1,149 @@
+"""Sequential recommendation: model learns + template round-trips."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models.seq_rec import (
+    SeqRecParams,
+    forward,
+    init_params,
+    make_training_batches,
+    seq_rec_scores,
+    seq_rec_train,
+)
+
+TINY = dict(hidden=32, num_blocks=1, num_heads=2, seq_len=16, epochs=30,
+            lr=3e-3, batch_size=32, seed=0)
+
+
+def _cyclic_sequences(n_items=12, n_users=40, length=20, seed=0):
+    """item i is always followed by i+1 (mod n): a deterministic pattern
+    a next-item model must learn."""
+    rng = np.random.default_rng(seed)
+    seqs = []
+    for _ in range(n_users):
+        start = int(rng.integers(1, n_items + 1))
+        seqs.append([(start + t - 1) % n_items + 1 for t in range(length)])
+    return seqs, n_items
+
+
+class TestModel:
+    def test_loss_decreases(self):
+        seqs, n = _cyclic_sequences()
+        params, losses = seq_rec_train(seqs, n, SeqRecParams(**TINY))
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_learns_cyclic_next_item(self):
+        seqs, n = _cyclic_sequences()
+        params, _ = seq_rec_train(seqs, n, SeqRecParams(**TINY))
+        hp = SeqRecParams(**TINY)
+        for start in (1, 5, 9):
+            hist = [(start + t - 1) % n + 1 for t in range(8)]
+            want = (hist[-1] % n) + 1
+            scores = seq_rec_scores(params, hist, hp)
+            assert int(np.argmax(scores)) == want
+
+    def test_batching_shapes_and_padding(self):
+        p = SeqRecParams(**{**TINY, "seq_len": 8, "batch_size": 4})
+        X, Y = make_training_batches([[1, 2, 3], [4, 5], [6]], p)
+        assert X.ndim == 3 and X.shape[2] == 8
+        Xf, Yf = X.reshape(-1, 8), Y.reshape(-1, 8)
+        # the length-1 sequence is dropped
+        assert not ((Xf == 6).any() or (Yf == 6).any())
+        # targets are inputs shifted by one at every real position
+        for xr, yr in zip(Xf, Yf):
+            real = np.nonzero(xr)[0]
+            assert (yr[real[:-1]] == xr[real[1:]]).all()
+            assert yr[real[-1]] > 0  # last target is the held-out next item
+        # left-padded: zeros form a prefix
+        for xr in Xf:
+            nz = np.nonzero(xr)[0]
+            assert len(nz) == 0 or (xr[: nz[0]] == 0).all()
+
+    def test_train_on_mesh_matches_local(self, cpu_mesh):
+        """Gradients flow through ring attention: sequence-parallel
+        training reaches the same solution as local training."""
+        seqs, n = _cyclic_sequences(n_users=16, length=12)
+        p = SeqRecParams(**{**TINY, "epochs": 5})
+        _, losses_local = seq_rec_train(seqs, n, p)
+        from predictionio_tpu.models import seq_rec as m
+        m._train_compiled.cache_clear()  # force a fresh mesh-keyed trace
+        _, losses_mesh = seq_rec_train(seqs, n, p, mesh=cpu_mesh)
+        np.testing.assert_allclose(losses_mesh, losses_local, rtol=2e-3)
+
+    def test_forward_ring_parity(self, cpu_mesh):
+        """Sequence-parallel forward == local forward (long-context path)."""
+        import jax.numpy as jnp
+
+        p = SeqRecParams(hidden=32, num_blocks=2, num_heads=2, seq_len=16)
+        params = {k: jnp.asarray(v) if not isinstance(v, (list, dict)) else v
+                  for k, v in init_params(10, p).items()}
+        rng = np.random.default_rng(0)
+        seqs = jnp.asarray(rng.integers(0, 11, (4, 16)), jnp.int32)
+        local = forward(params, seqs, p, mesh=None)
+        ring = forward(params, seqs, p, mesh=cpu_mesh)
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(local),
+                                   rtol=2e-4, atol=2e-5)
+
+
+@pytest.fixture()
+def seq_app(storage):
+    """App + cyclic view events with increasing timestamps."""
+    import datetime as dt
+
+    from predictionio_tpu.data.event import Event
+
+    meta = storage.meta
+    app = meta.create_app("SeqApp", "")
+    storage.events.init_channel(app.id)
+    t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+    n_items = 8
+    for u in range(30):
+        start = u % n_items
+        for t in range(12):
+            item = (start + t) % n_items
+            storage.events.insert(Event(
+                event="view", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{item}",
+                event_time=t0 + dt.timedelta(minutes=t)), app.id)
+    return app
+
+
+FACTORY = "predictionio_tpu.templates.sequentialrec.engine:engine_factory"
+
+VARIANT = {
+    "id": "default",
+    "engineFactory": FACTORY,
+    "datasource": {"params": {"appName": "SeqApp"}},
+    "algorithms": [{"name": "seqrec", "params": {
+        "hidden": 32, "numBlocks": 1, "numHeads": 2, "seqLen": 16,
+        "epochs": 30, "lr": 0.003}}],
+}
+
+
+class TestTemplate:
+    def test_train_predict_roundtrip(self, storage, seq_app):
+        from predictionio_tpu.core.workflow import prepare_deploy, run_train
+
+        iid = run_train(FACTORY, variant=VARIANT, storage=storage,
+                        use_mesh=False)
+        deployed = prepare_deploy(engine_factory=FACTORY, storage=storage,
+                                  instance_id=iid)
+
+        # u0's live history cycles i0..i7 over 12 events; the last item is
+        # i((0+11) % 8) = i3, so the learned pattern predicts i4 next
+        res = deployed.query({"user": "u0", "num": 3})
+        items = [s["item"] for s in res["itemScores"]]
+        assert len(items) == 3
+        assert items[0] == "i4", items
+
+        # explicit-history (anonymous session) path: next after i2 is i3
+        res = deployed.query({"history": ["i0", "i1", "i2"], "num": 1})
+        assert res["itemScores"][0]["item"] == "i3"
+
+        # blackList filters
+        res = deployed.query({"history": ["i0", "i1", "i2"], "num": 1,
+                              "blackList": ["i3"]})
+        assert res["itemScores"][0]["item"] != "i3"
