@@ -98,6 +98,12 @@ register_meta_backend(
     "SQLITE", lambda cfg: MetaStore(os.path.join(_ensure(cfg.home), "meta.db"))
 )
 
+# network backends (S3/HDFS model stores, gated SQL servers) register
+# their TYPE names here; their drivers bind lazily at first use
+from predictionio_tpu.storage import remote as _remote  # noqa: E402
+
+_remote.register_all()
+
 
 def _ensure(home: str) -> str:
     os.makedirs(home, exist_ok=True)

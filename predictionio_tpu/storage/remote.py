@@ -1,0 +1,185 @@
+"""Network storage backends: S3 / HDFS model stores, gated SQL servers.
+
+The reference shipped six network backends (HBase, JDBC, Elasticsearch,
+HDFS, LocalFS, S3 — SURVEY.md §2a); this environment has no network
+services or drivers, so these register their TYPE names with factories
+that bind lazily: the S3 and HDFS model stores are full implementations
+that connect when their driver (boto3 / pyarrow+libhdfs) is present and
+raise :class:`StorageClientError` with install instructions when not;
+the PostgreSQL/MySQL event+meta types are gated the same way at
+registration (their SQL dialects ride the SQLite implementations'
+schema once a DB-API driver exists).
+
+Config (same env scheme as every backend, reference pio-env.sh names):
+
+    PIO_STORAGE_SOURCES_<S>_TYPE=S3|HDFS|PGSQL|MYSQL
+    PIO_STORAGE_SOURCES_<S>_BUCKET_NAME / _BASE_PATH   (S3)
+    PIO_STORAGE_SOURCES_<S>_HOSTS / _PORTS / _PATH     (HDFS)
+    PIO_STORAGE_SOURCES_<S>_URL / _USERNAME / _PASSWORD (SQL)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Optional
+
+from predictionio_tpu.storage.models import ModelStore
+
+
+class StorageClientError(RuntimeError):
+    """Backend selected but unusable (missing driver / bad config) —
+    reference: StorageClientException."""
+
+
+def _source_env(key: str, default: str = "") -> str:
+    # any source name may carry the setting; first match wins. Match the
+    # FULL key shape PIO_STORAGE_SOURCES_<NAME>_<KEY> — a suffix match
+    # would let e.g. *_BASE_PATH shadow a lookup of PATH
+    pattern = re.compile(rf"^PIO_STORAGE_SOURCES_[A-Za-z0-9]+_{key}$")
+    for k, v in os.environ.items():
+        if pattern.match(k):
+            return v
+    return default
+
+
+class S3ModelStore(ModelStore):
+    """Model blobs on S3 (reference: [U] storage/s3/ S3Models)."""
+
+    def __init__(self, bucket: Optional[str] = None,
+                 base_path: Optional[str] = None) -> None:
+        try:
+            import boto3  # type: ignore[import-not-found]
+        except ImportError as e:
+            raise StorageClientError(
+                "MODELDATA type S3 requires the boto3 driver "
+                "(pip install boto3)") from e
+        self.bucket = bucket or _source_env("BUCKET_NAME")
+        if not self.bucket:
+            raise StorageClientError(
+                "S3 model store needs PIO_STORAGE_SOURCES_<S>_BUCKET_NAME")
+        self.base = (base_path or _source_env("BASE_PATH", "pio_models")
+                     ).strip("/")
+        self._s3 = boto3.client("s3")
+
+    def _key(self, instance_id: str) -> str:
+        return f"{self.base}/{instance_id}.bin"
+
+    def put(self, instance_id: str, blob: bytes) -> None:
+        self._s3.put_object(Bucket=self.bucket, Key=self._key(instance_id),
+                            Body=blob)
+
+    def get(self, instance_id: str) -> Optional[bytes]:
+        try:
+            r = self._s3.get_object(Bucket=self.bucket,
+                                    Key=self._key(instance_id))
+        except self._s3.exceptions.NoSuchKey:
+            return None
+        return r["Body"].read()
+
+    def delete(self, instance_id: str) -> bool:
+        self._s3.delete_object(Bucket=self.bucket, Key=self._key(instance_id))
+        return True
+
+    def list_ids(self) -> List[str]:
+        out, token = [], None
+        while True:
+            kw = {"Bucket": self.bucket, "Prefix": self.base + "/"}
+            if token:
+                kw["ContinuationToken"] = token
+            r = self._s3.list_objects_v2(**kw)
+            out += [o["Key"][len(self.base) + 1:-4]
+                    for o in r.get("Contents", ())
+                    if o["Key"].endswith(".bin")]
+            if not r.get("IsTruncated"):
+                return out
+            token = r.get("NextContinuationToken")
+
+
+class HDFSModelStore(ModelStore):
+    """Model blobs on HDFS via pyarrow (reference: [U] storage/hdfs/
+    HDFSModels). Needs libhdfs (a Hadoop install) at runtime."""
+
+    def __init__(self, host: Optional[str] = None, port: Optional[int] = None,
+                 path: Optional[str] = None) -> None:
+        try:
+            from pyarrow import fs
+        except ImportError as e:  # pragma: no cover - pyarrow is baked in
+            raise StorageClientError(
+                "MODELDATA type HDFS requires pyarrow") from e
+        host = host or _source_env("HOSTS", "default")
+        port = port if port is not None else int(_source_env("PORTS", "8020"))
+        self.root = (path or _source_env("PATH", "/pio_models")).rstrip("/")
+        try:
+            self._fs = fs.HadoopFileSystem(host, port)
+        except Exception as e:
+            raise StorageClientError(
+                f"cannot reach HDFS at {host}:{port} (libhdfs present?): {e}"
+            ) from e
+
+    def _key(self, instance_id: str) -> str:
+        return f"{self.root}/{instance_id}.bin"
+
+    def put(self, instance_id: str, blob: bytes) -> None:
+        from pyarrow import fs
+
+        self._fs.create_dir(self.root, recursive=True)
+        with self._fs.open_output_stream(self._key(instance_id)) as f:
+            f.write(blob)
+
+    def get(self, instance_id: str) -> Optional[bytes]:
+        from pyarrow import fs
+
+        info = self._fs.get_file_info(self._key(instance_id))
+        if info.type == fs.FileType.NotFound:
+            return None
+        with self._fs.open_input_stream(self._key(instance_id)) as f:
+            return f.read()
+
+    def delete(self, instance_id: str) -> bool:
+        from pyarrow import fs
+
+        info = self._fs.get_file_info(self._key(instance_id))
+        if info.type == fs.FileType.NotFound:
+            return False
+        self._fs.delete_file(self._key(instance_id))
+        return True
+
+    def list_ids(self) -> List[str]:
+        from pyarrow import fs
+
+        sel = fs.FileSelector(self.root, allow_not_found=True)
+        return [i.base_name[:-4] for i in self._fs.get_file_info(sel)
+                if i.base_name.endswith(".bin")]
+
+
+def _sql_server_gate(type_name: str, driver: str, pip_name: str):
+    def factory(cfg):
+        try:
+            __import__(driver)
+        except ImportError as e:
+            raise StorageClientError(
+                f"storage type {type_name} requires the {driver} driver "
+                f"(pip install {pip_name}); with no SQL-server driver in "
+                "this environment use SQLITE (same schema, single file) or "
+                "EVENTLOG (native engine)") from e
+        raise StorageClientError(  # pragma: no cover - needs the driver
+            f"{type_name} driver found but server-backed stores are not "
+            "wired in this build; see predictionio_tpu/storage/remote.py")
+
+    return factory
+
+
+def register_all() -> None:
+    from predictionio_tpu.storage import registry as reg
+
+    reg.register_model_backend("S3", lambda cfg: S3ModelStore())
+    reg.register_model_backend("HDFS", lambda cfg: HDFSModelStore())
+    # the reference's pio-env idiom points METADATA and EVENTDATA at the
+    # same SQL source — gate both repositories
+    pg = _sql_server_gate("PGSQL", "psycopg2", "psycopg2-binary")
+    my = _sql_server_gate("MYSQL", "pymysql", "pymysql")
+    reg.register_event_backend("PGSQL", pg)
+    reg.register_event_backend("MYSQL", my)
+    reg.register_meta_backend("PGSQL", pg)
+    reg.register_meta_backend("MYSQL", my)

@@ -1,0 +1,118 @@
+"""pypio bridge (DataFrame reads, model hand-off, cleanup) + gated
+network storage backends."""
+
+from __future__ import annotations
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.event import Event
+
+
+@pytest.fixture()
+def bridged(storage):
+    import pypio
+
+    app = storage.meta.create_app("PyApp", "")
+    storage.events.init_channel(app.id)
+    t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+    evs = [
+        Event(event="rate", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1",
+              properties={"rating": 4.0}, event_time=t0),
+        Event(event="buy", entity_type="user", entity_id="u2",
+              target_entity_type="item", target_entity_id="i2",
+              event_time=t0 + dt.timedelta(hours=1)),
+        Event(event="$set", entity_type="user", entity_id="u1",
+              properties={"plan": "pro"}, event_time=t0),
+        Event(event="$set", entity_type="user", entity_id="u1",
+              properties={"plan": "free"},
+              event_time=t0 + dt.timedelta(days=1)),
+    ]
+    storage.events.insert_batch(evs, app.id)
+    pypio.init(storage)
+    yield pypio
+    pypio.stop()
+
+
+class TestBridge:
+    def test_find_events_dataframe(self, bridged):
+        df = bridged.find_events("PyApp")
+        assert len(df) == 4
+        assert set(df.columns) >= {"event", "entityId", "properties",
+                                   "eventTime"}
+        rated = df[df.event == "rate"].iloc[0]
+        assert rated.properties["rating"] == 4.0
+
+        df = bridged.find_events("PyApp", event_names=["buy"])
+        assert list(df.entityId) == ["u2"]
+
+    def test_aggregate_properties_dataframe(self, bridged):
+        df = bridged.data.PEventStore.aggregate_properties("PyApp", "user")
+        # later $set wins the fold
+        assert df.loc["u1", "plan"] == "free"
+
+    def test_model_round_trip(self, bridged):
+        bridged.save_model({"w": [1, 2, 3]}, "inst-1", algorithm="nb")
+        assert bridged.load_model("inst-1", algorithm="nb") == {"w": [1, 2, 3]}
+        # a second algorithm on the same instance preserves the first
+        bridged.save_model("lr-model", "inst-1", algorithm="lr")
+        assert bridged.load_model("inst-1", algorithm="nb") == {"w": [1, 2, 3]}
+        assert bridged.load_model("inst-1", algorithm="lr") == "lr-model"
+
+    def test_cleanup_functions(self, bridged):
+        from pypio.workflow import CleanupFunctions
+
+        calls = []
+        CleanupFunctions.clear()
+        CleanupFunctions.add(lambda: calls.append(1))
+        CleanupFunctions.add(lambda: calls.append(2))
+        CleanupFunctions.run()
+        assert calls == [1, 2]
+        CleanupFunctions.clear()
+
+    def test_clean_events(self, bridged, storage):
+        from pypio.workflow import clean_events
+
+        counts = clean_events("PyApp", keep_days=30000)
+        assert counts["kept"] >= 1
+
+    def test_utils(self):
+        from pypio.utils import new_string_array, to_datetime
+
+        assert new_string_array(("a", "b"), gateway=object()) == ["a", "b"]
+        t = to_datetime("2026-01-01T00:00:00.000Z")
+        assert t.tzinfo is not None and t.year == 2026
+
+
+class TestGatedBackends:
+    def test_types_registered(self):
+        from predictionio_tpu.storage import registry as reg
+
+        assert "S3" in reg._MODEL_BACKENDS
+        assert "HDFS" in reg._MODEL_BACKENDS
+        assert "PGSQL" in reg._EVENT_BACKENDS
+        assert "MYSQL" in reg._EVENT_BACKENDS
+
+    def test_missing_driver_message(self):
+        from predictionio_tpu.storage.registry import Storage, StorageConfig
+        from predictionio_tpu.storage.remote import StorageClientError
+
+        st = Storage(StorageConfig(eventdata_type="PGSQL"))
+        with pytest.raises(StorageClientError, match="psycopg2"):
+            _ = st.events
+        # the metadata repository gates identically (shared-source idiom)
+        st = Storage(StorageConfig(metadata_type="MYSQL"))
+        with pytest.raises(StorageClientError, match="pymysql"):
+            _ = st.meta
+
+    def test_s3_without_config(self):
+        from predictionio_tpu.storage.remote import (
+            S3ModelStore,
+            StorageClientError,
+        )
+
+        # boto3 missing in this image → actionable error mentioning it
+        with pytest.raises(StorageClientError, match="boto3"):
+            S3ModelStore(bucket="b")
