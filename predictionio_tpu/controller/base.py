@@ -99,6 +99,9 @@ class WorkflowContext:
     skip_sanity_check: bool = False
     stop_after_read: bool = False
     stop_after_prepare: bool = False
+    # per-phase wall-clock seconds, filled by Engine.train/eval
+    # (SURVEY.md §5 "per-phase timing log")
+    timings: Dict[str, float] = field(default_factory=dict)
     instance_id: str = ""
 
     def log(self, msg: str) -> None:

@@ -97,14 +97,21 @@ class Engine:
 
     def train(self, ctx: WorkflowContext, engine_params: EngineParams) -> List[Any]:
         """readTraining → prepare → per-algorithm train (reference:
-        Engine.train, SURVEY.md §3.1). Returns models in algorithms order."""
+        Engine.train, SURVEY.md §3.1). Returns models in algorithms order;
+        per-phase wall-clock lands in ``ctx.timings``."""
+        import time
+
+        t0 = time.perf_counter()
         ds = self.data_source_cls(engine_params.data_source_params)
         td = ds.read_training(ctx)
+        ctx.timings["read_training"] = time.perf_counter() - t0
         ctx.log("read_training done")
         if ctx.stop_after_read:
             return []
+        t0 = time.perf_counter()
         prep = self.preparator_cls(engine_params.preparator_params)
         pd = prep.prepare(ctx, td)
+        ctx.timings["prepare"] = time.perf_counter() - t0
         ctx.log("prepare done")
         if ctx.stop_after_prepare:
             return []
@@ -113,7 +120,9 @@ class Engine:
             if not ctx.skip_sanity_check:
                 algo.sanity_check(pd)
             ctx.log(f"training algorithm {name!r}")
+            t0 = time.perf_counter()
             models.append(algo.train(ctx, pd))
+            ctx.timings[f"train:{name}"] = time.perf_counter() - t0
             ctx.log(f"algorithm {name!r} trained")
         return models
 

@@ -103,7 +103,20 @@ def run_train(
     try:
         ei.status = "TRAINING"
         storage.meta.update_engine_instance(ei)
-        models = engine.train(ctx, engine_params)
+        # tracing hook (SURVEY.md §5): PIO_PROFILE_DIR=<dir> wraps the
+        # train in a JAX profiler trace (xplane → Perfetto/TensorBoard)
+        profile_dir = os.environ.get("PIO_PROFILE_DIR")
+        if profile_dir:
+            import jax
+
+            with jax.profiler.trace(profile_dir):
+                models = engine.train(ctx, engine_params)
+        else:
+            models = engine.train(ctx, engine_params)
+        if ctx.timings:
+            phases = ", ".join(f"{k}={v:.3f}s"
+                               for k, v in ctx.timings.items())
+            ctx.log(f"train phases: {phases}")
 
         # persist per-algorithm models: blob entries and/or structured dirs
         instance_dir = storage.models.model_dir(instance_id)

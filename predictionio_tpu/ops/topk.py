@@ -26,11 +26,14 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG = -3.0e38  # finite "-inf" (python float so the kernel doesn't capture a traced constant)
 
 
+@functools.partial(jax.jit, static_argnames=("k", "n_valid"))
 def score_topk_xla(Q, V, k: int, n_valid: int = 0):
     """XLA fallback: full (B, N) score matrix then lax.top_k.
 
     ``n_valid``: real row count when V carries tail padding (lets a
     caller share one padded resident copy with :func:`score_topk`).
+    Jitted: the serving path must be ONE dispatch — eager ops each pay
+    a host→device round trip (brutal over a tunneled chip).
     """
     scores = jnp.dot(Q, V.T, preferred_element_type=jnp.float32,
                      precision=jax.lax.Precision.HIGHEST)

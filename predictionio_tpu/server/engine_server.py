@@ -56,11 +56,18 @@ class EngineServer:
             storage=self.storage, variant_id=variant_id)
         self.start_time = utcnow()
         self.query_count = 0
+        from predictionio_tpu.utils.metrics import REGISTRY
+
+        self._m_queries = REGISTRY.counter(
+            "pio_engine_queries_total", "Queries served", ("status",))
+        self._m_latency = REGISTRY.histogram(
+            "pio_engine_query_seconds", "Query latency (handler, seconds)")
         router = Router()
         router.route("POST", "/queries.json", self._queries)
         router.route("GET", "/", self._status)
         router.route("GET", "/reload", self._reload)
         router.route("GET", "/stop", self._stop)
+        router.route("GET", "/metrics", self._metrics)
         router.route("GET", "/plugins.json", self._plugins_list)
         router.route("GET", "/plugins/{name}/{path+}", self._plugin_route)
         router.route("POST", "/plugins/{name}/{path+}", self._plugin_route)
@@ -72,17 +79,25 @@ class EngineServer:
     # -- handlers --------------------------------------------------------------
 
     async def _queries(self, req: Request) -> Response:
+        import time
+
+        t0 = time.perf_counter()
         try:
             query = req.json()
         except json.JSONDecodeError as e:
+            self._m_queries.inc(("400",))
             return Response.json({"message": f"invalid JSON: {e}"}, status=400)
         if query is None:
+            self._m_queries.inc(("400",))
             return Response.json({"message": "empty query"}, status=400)
         try:
             prediction = await asyncio.to_thread(self.deployed.query, query)
         except Exception as e:
+            self._m_queries.inc(("400",))
             return Response.json(
                 {"message": f"query failed: {type(e).__name__}: {e}"}, status=400)
+        self._m_queries.inc(("200",))
+        self._m_latency.observe(time.perf_counter() - t0)
         for p in self.plugins:
             prediction = p.output_blocker(query, prediction)
             p.output_sniffer(query, prediction)
@@ -145,6 +160,12 @@ class EngineServer:
     async def _stop(self, req: Request) -> Response:
         asyncio.get_running_loop().call_later(0.05, self.http.request_shutdown)
         return Response.json({"message": "Shutting down"})
+
+    async def _metrics(self, req: Request) -> Response:
+        from predictionio_tpu.utils.metrics import REGISTRY
+
+        return Response.text(REGISTRY.render(),
+                             content_type="text/plain; version=0.0.4")
 
     async def _plugins_list(self, req: Request) -> Response:
         return Response.json({"plugins": {

@@ -78,8 +78,16 @@ class EventServer:
         self.storage = storage or get_storage()
         self.stats = Stats() if stats else None
         self.plugins = plugins if plugins is not None else _discover_plugins()
+        from predictionio_tpu.utils.metrics import REGISTRY
+
+        self._m_events = REGISTRY.counter(
+            "pio_events_ingested_total", "Events accepted/rejected",
+            ("app_id", "status"))
+        self._m_insert = REGISTRY.histogram(
+            "pio_event_insert_seconds", "Single-event insert latency")
         router = Router()
         router.route("GET", "/", self._status)
+        router.route("GET", "/metrics", self._metrics)
         router.route("POST", "/events.json", self._post_event)
         router.route("GET", "/events.json", self._get_events)
         router.route("POST", "/batch/events.json", self._post_batch)
@@ -137,22 +145,36 @@ class EventServer:
 
     def _insert_one(self, obj: Any, app_id: int, channel_id: Optional[int],
                     allowed: List[str]) -> Tuple[int, Dict[str, Any]]:
+        import time
+
+        t0 = time.perf_counter()
         try:
             ev = Event.from_json(obj)
         except EventValidationError as e:
+            self._m_events.inc((app_id, 400))
             return 400, {"message": str(e)}
         if not self._check_permitted(allowed, ev.event):
+            self._m_events.inc((app_id, 403))
             return 403, {"message": f"event {ev.event!r} not permitted by this key"}
         for p in self.plugins:
             verdict = p.input_blocker(ev, app_id, channel_id)
             if verdict is not None:
+                self._m_events.inc((app_id, 403))
                 return 403, {"message": verdict}
         eid = self.storage.events.insert(ev, app_id, channel_id)
         for p in self.plugins:
             p.input_sniffer(ev, app_id, channel_id)
         if self.stats:
             self.stats.record(app_id, ev.event, 201)
+        self._m_events.inc((app_id, 201))
+        self._m_insert.observe(time.perf_counter() - t0)
         return 201, {"eventId": eid}
+
+    async def _metrics(self, req: Request) -> Response:
+        from predictionio_tpu.utils.metrics import REGISTRY
+
+        return Response.text(REGISTRY.render(),
+                             content_type="text/plain; version=0.0.4")
 
     async def _post_event(self, req: Request) -> Response:
         auth, err = self._auth(req)

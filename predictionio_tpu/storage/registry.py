@@ -33,27 +33,67 @@ def pio_home() -> str:
 
 @dataclass
 class StorageConfig:
-    """Resolved storage configuration (one 'source' per repository)."""
+    """Resolved storage configuration (one 'source' per repository).
+
+    ``sources`` holds every configured source's extra settings
+    (``PIO_STORAGE_SOURCES_<NAME>_<KEY>`` → ``sources[NAME][KEY]``) and
+    ``*_source`` records which named source backs each repository, so a
+    backend factory can read ITS source's settings instead of scanning
+    the environment (two S3 sources must not shadow each other).
+    """
 
     metadata_type: str = "SQLITE"
     eventdata_type: str = "SQLITE"
     modeldata_type: str = "LOCALFS"
+    metadata_source: str = ""
+    eventdata_source: str = ""
+    modeldata_source: str = ""
+    sources: Dict[str, Dict[str, str]] = field(default_factory=dict)
     home: str = field(default_factory=pio_home)
+
+    def source_properties(self, repo: str) -> Dict[str, str]:
+        """Settings of the source backing ``repo`` ('METADATA', …)."""
+        name = getattr(self, f"{repo.lower()}_source", "")
+        return self.sources.get(name, {})
 
     @classmethod
     def from_env(cls, env: Optional[Dict[str, str]] = None) -> "StorageConfig":
         e = dict(os.environ if env is None else env)
 
+        def repo_source(repo: str) -> str:
+            return e.get(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "")
+
+        # Source names may contain underscores (e.g. MY_PG), and so may
+        # setting keys (BUCKET_NAME). Candidate names come from the
+        # repository SOURCE declarations plus every *_TYPE key; each env
+        # var then binds to the LONGEST candidate name prefixing it.
+        prefix = "PIO_STORAGE_SOURCES_"
+        rests = [k[len(prefix):] for k in e if k.startswith(prefix)]
+        names = {repo_source(r) for r in ("METADATA", "EVENTDATA", "MODELDATA")}
+        names |= {r[: -len("_TYPE")] for r in rests if r.endswith("_TYPE")}
+        names.discard("")
+        sources: Dict[str, Dict[str, str]] = {}
+        for rest in rests:
+            owner = max((n for n in names if rest.startswith(n + "_")),
+                        key=len, default="")
+            if owner:
+                sources.setdefault(owner, {})[rest[len(owner) + 1:]] = \
+                    e[prefix + rest]
+
         def source_type(repo: str, default: str) -> str:
-            src = e.get(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "")
+            src = repo_source(repo)
             if src:
-                return e.get(f"PIO_STORAGE_SOURCES_{src}_TYPE", default).upper()
+                return sources.get(src, {}).get("TYPE", default).upper()
             return default
 
         return cls(
             metadata_type=source_type("METADATA", "SQLITE"),
             eventdata_type=source_type("EVENTDATA", "SQLITE"),
             modeldata_type=source_type("MODELDATA", "LOCALFS"),
+            metadata_source=repo_source("METADATA"),
+            eventdata_source=repo_source("EVENTDATA"),
+            modeldata_source=repo_source("MODELDATA"),
+            sources=sources,
             home=e.get("PIO_HOME", pio_home()),
         )
 

@@ -44,22 +44,30 @@ def _source_env(key: str, default: str = "") -> str:
 
 
 class S3ModelStore(ModelStore):
-    """Model blobs on S3 (reference: [U] storage/s3/ S3Models)."""
+    """Model blobs on S3 (reference: [U] storage/s3/ S3Models).
+
+    ``props`` = the backing source's settings (StorageConfig
+    ``source_properties``); direct construction may pass bucket/base
+    explicitly or fall back to a single-source env scan.
+    """
 
     def __init__(self, bucket: Optional[str] = None,
-                 base_path: Optional[str] = None) -> None:
+                 base_path: Optional[str] = None,
+                 props: Optional[dict] = None) -> None:
         try:
             import boto3  # type: ignore[import-not-found]
         except ImportError as e:
             raise StorageClientError(
                 "MODELDATA type S3 requires the boto3 driver "
                 "(pip install boto3)") from e
-        self.bucket = bucket or _source_env("BUCKET_NAME")
+        props = props or {}
+        self.bucket = (bucket or props.get("BUCKET_NAME")
+                       or _source_env("BUCKET_NAME"))
         if not self.bucket:
             raise StorageClientError(
                 "S3 model store needs PIO_STORAGE_SOURCES_<S>_BUCKET_NAME")
-        self.base = (base_path or _source_env("BASE_PATH", "pio_models")
-                     ).strip("/")
+        self.base = (base_path or props.get("BASE_PATH")
+                     or _source_env("BASE_PATH", "pio_models")).strip("/")
         self._s3 = boto3.client("s3")
 
     def _key(self, instance_id: str) -> str:
@@ -101,15 +109,19 @@ class HDFSModelStore(ModelStore):
     HDFSModels). Needs libhdfs (a Hadoop install) at runtime."""
 
     def __init__(self, host: Optional[str] = None, port: Optional[int] = None,
-                 path: Optional[str] = None) -> None:
+                 path: Optional[str] = None,
+                 props: Optional[dict] = None) -> None:
         try:
             from pyarrow import fs
         except ImportError as e:  # pragma: no cover - pyarrow is baked in
             raise StorageClientError(
                 "MODELDATA type HDFS requires pyarrow") from e
-        host = host or _source_env("HOSTS", "default")
-        port = port if port is not None else int(_source_env("PORTS", "8020"))
-        self.root = (path or _source_env("PATH", "/pio_models")).rstrip("/")
+        props = props or {}
+        host = host or props.get("HOSTS") or _source_env("HOSTS", "default")
+        port = port if port is not None else int(
+            props.get("PORTS") or _source_env("PORTS", "8020"))
+        self.root = (path or props.get("PATH")
+                     or _source_env("PATH", "/pio_models")).rstrip("/")
         try:
             self._fs = fs.HadoopFileSystem(host, port)
         except Exception as e:
@@ -173,8 +185,12 @@ def _sql_server_gate(type_name: str, driver: str, pip_name: str):
 def register_all() -> None:
     from predictionio_tpu.storage import registry as reg
 
-    reg.register_model_backend("S3", lambda cfg: S3ModelStore())
-    reg.register_model_backend("HDFS", lambda cfg: HDFSModelStore())
+    reg.register_model_backend(
+        "S3", lambda cfg: S3ModelStore(
+            props=cfg.source_properties("MODELDATA")))
+    reg.register_model_backend(
+        "HDFS", lambda cfg: HDFSModelStore(
+            props=cfg.source_properties("MODELDATA")))
     # the reference's pio-env idiom points METADATA and EVENTDATA at the
     # same SQL source — gate both repositories
     pg = _sql_server_gate("PGSQL", "psycopg2", "psycopg2-binary")

@@ -42,10 +42,20 @@ def save_model(model: Any, engine_instance_id: str,
                algorithm: str = "default") -> None:
     """Persist a Python model blob under an engine instance (the
     reference's PythonEngine model hand-off). Other algorithms already
-    saved under the same instance are preserved."""
+    saved under the same instance are preserved.
+
+    Notebook models use a ``{algorithm: model}`` dict blob; instances
+    trained by ``pio train`` store a per-algorithm list managed by the
+    workflow — refuse to clobber those.
+    """
     st = _st()
     blob = st.models.get(engine_instance_id)
     d = pickle.loads(blob) if blob else {}
+    if not isinstance(d, dict):
+        raise ValueError(
+            f"engine instance {engine_instance_id!r} was trained by the "
+            "workflow (`pio train`); its models belong to prepare_deploy. "
+            "Save notebook models under a fresh instance id.")
     d[algorithm] = model
     st.models.put(engine_instance_id, pickle.dumps(d))
 
@@ -54,4 +64,10 @@ def load_model(engine_instance_id: str, algorithm: str = "default") -> Any:
     blob = _st().models.get(engine_instance_id)
     if blob is None:
         raise KeyError(f"no model for engine instance {engine_instance_id}")
-    return pickle.loads(blob)[algorithm]
+    d = pickle.loads(blob)
+    if not isinstance(d, dict):
+        raise ValueError(
+            f"engine instance {engine_instance_id!r} was trained by the "
+            "workflow (`pio train`); load it with "
+            "predictionio_tpu.core.workflow.prepare_deploy instead.")
+    return d[algorithm]

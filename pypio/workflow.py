@@ -38,10 +38,12 @@ def clean_events(app_name: str, keep_days: int = 30,
     import datetime as dt
 
     from predictionio_tpu.data.cleaning import EventWindow, clean_persisted_events
+    from pypio.pypio import _st
 
     return clean_persisted_events(
         app_name,
         window=EventWindow(duration=dt.timedelta(days=keep_days),
                            remove_duplicates=remove_duplicates,
                            compress_properties=compress_properties),
+        storage=_st(),
     )

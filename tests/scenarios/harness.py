@@ -102,7 +102,10 @@ class Server:
             headers={"Content-Type": "application/json"})
         try:
             with urllib.request.urlopen(req, timeout=timeout) as r:
-                return r.status, json.loads(r.read().decode() or "null")
+                raw = r.read().decode()
+                if "json" in (r.headers.get("Content-Type") or ""):
+                    return r.status, json.loads(raw or "null")
+                return r.status, raw
         except urllib.error.HTTPError as e:
             payload = e.read().decode()
             try:

@@ -51,6 +51,11 @@ def test_quickstart_full_loop(tmp_path):
         status, body = es.get("/stats.json")
         assert status == 200
 
+        # Prometheus exposition: ingestion counters are live
+        status, text = es.request("GET", "/metrics", None)
+        assert status == 200
+        assert "pio_events_ingested_total" in str(text)
+
         # -- train (separate process, shared PIO_HOME storage) -----------
         out = h.pio(["train", "--engine-dir", engine_dir], env).stdout
         assert "Training completed" in out

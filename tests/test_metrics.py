@@ -1,0 +1,51 @@
+"""Prometheus metrics module + /metrics endpoints (SURVEY.md §5)."""
+
+from __future__ import annotations
+
+from predictionio_tpu.utils.metrics import Counter, Histogram, Registry
+
+
+class TestPrimitives:
+    def test_counter_labels(self):
+        c = Counter("t_total", "help text", ("app", "status"))
+        c.inc(("1", "201"))
+        c.inc(("1", "201"), 2)
+        c.inc(("2", "400"))
+        lines = c.render()
+        assert "# TYPE t_total counter" in lines
+        assert 't_total{app="1",status="201"} 3' in lines
+        assert 't_total{app="2",status="400"} 1' in lines
+
+    def test_histogram_buckets(self):
+        h = Histogram("lat_seconds", "h", buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+            h.observe(v)
+        lines = h.render()
+        assert 'lat_seconds_bucket{le="0.01"} 1' in lines
+        assert 'lat_seconds_bucket{le="0.1"} 3' in lines
+        assert 'lat_seconds_bucket{le="1"} 4' in lines
+        assert 'lat_seconds_bucket{le="+Inf"} 5' in lines
+        assert "lat_seconds_count 5" in lines
+
+    def test_registry_get_or_create(self):
+        """Re-instantiating a server must reuse the family, not split it."""
+        r = Registry()
+        c1 = r.counter("dup_total", "a")
+        c1.inc()
+        c2 = r.counter("dup_total", "a")
+        c2.inc()
+        assert c1 is c2
+        assert r.render().count("# TYPE dup_total counter") == 1
+        assert "dup_total 2" in r.render()
+        with __import__("pytest").raises(ValueError):
+            r.histogram("dup_total", "clash")
+
+    def test_registry_render(self):
+        r = Registry()
+        c = r.counter("a_total", "a")
+        c.inc()
+        h = r.histogram("b_seconds", "b", buckets=(1.0,))
+        h.observe(0.5)
+        text = r.render()
+        assert text.endswith("\n")
+        assert "a_total 1" in text and "b_seconds_count 1" in text
