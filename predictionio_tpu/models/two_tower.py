@@ -27,6 +27,11 @@ class TwoTowerParams:
     learning_rate: float = 0.01
     temperature: float = 0.1
     seed: int = 0
+    # mid-train checkpoint/resume (SURVEY.md §5): save full state every
+    # N epochs; a restarted train with the same dir resumes at the
+    # newest epoch. None disables.
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 1
 
 
 def _towers(n_users: int, n_items: int, p: TwoTowerParams):
@@ -114,7 +119,6 @@ def two_tower_train(
     n_batches = max(1, n // B)
     variables = (uv, iv)
     opt_state = opt.init(variables)
-    host_rng = np.random.default_rng(p.seed)
 
     if n_dev > 1:
         from jax.sharding import NamedSharding, PartitionSpec
@@ -123,9 +127,25 @@ def two_tower_train(
     else:
         batch_sharding = None
 
+    # mid-train checkpoint/resume: per-epoch RNG is seeded by epoch index
+    # so a resumed run replays the exact batch permutations a straight
+    # run would have used
+    start_epoch = 0
+    ckpt = None
+    if p.checkpoint_dir:
+        from predictionio_tpu.utils.checkpoint import TrainCheckpointer
+
+        ckpt = TrainCheckpointer(p.checkpoint_dir)
+        latest = ckpt.latest_step()
+        if latest is not None:
+            state = ckpt.restore(template={"variables": variables,
+                                           "opt_state": opt_state})
+            variables, opt_state = state["variables"], state["opt_state"]
+            start_epoch = latest
+
     last_loss = None
-    for _ in range(p.epochs):
-        perm = host_rng.permutation(n)[: n_batches * B]
+    for epoch in range(start_epoch, p.epochs):
+        perm = np.random.default_rng(p.seed + epoch).permutation(n)[: n_batches * B]
         ue = user_idx[perm].reshape(n_batches, B).astype(np.int32)
         ie = item_idx[perm].reshape(n_batches, B).astype(np.int32)
         if batch_sharding is not None:
@@ -133,6 +153,11 @@ def two_tower_train(
             ie = jax.device_put(ie, batch_sharding)
         variables, opt_state, last_loss = train_epoch(
             variables, opt_state, jnp.asarray(ue), jnp.asarray(ie))
+        if ckpt is not None and (epoch + 1) % max(1, p.checkpoint_every) == 0:
+            ckpt.save(epoch + 1, {"variables": jax.tree.map(np.asarray, variables),
+                                  "opt_state": jax.tree.map(np.asarray, opt_state)})
+    if ckpt is not None:
+        ckpt.close()
     uvv, ivv = variables
     return (jax.tree.map(np.asarray, uvv), jax.tree.map(np.asarray, ivv))
 

@@ -1,0 +1,111 @@
+"""Continuous micro-batching for the query hot path.
+
+The reference serves one query per request thread (akka-http →
+``predictBase`` — SURVEY.md §3.2); on TPU the score program wants
+batched queries (one MXU matmul amortizes dispatch + the fixed
+device↔host round trip across the whole batch). This layer sits in
+front of ``DeployedEngine.batch_query``: concurrent requests are
+collected for at most ``max_wait_ms`` (or until ``max_batch``), scored
+as ONE device dispatch, and the results are fanned back out — the
+standard continuous-batching pattern, at the request level.
+
+Latency math: a lone query pays ≤ max_wait_ms extra; under load the
+wait never triggers (the batch fills) and per-query cost approaches
+dispatch/B. Enable with ``pio deploy --batching`` (or
+``EngineServer(batching=True)``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+from typing import Any, Callable, List, Optional, Sequence
+
+
+class MicroBatcher:
+    """Order-preserving async micro-batcher around a sync batch fn."""
+
+    def __init__(self, fn_batch: Callable[[Sequence[Any]], List[Any]],
+                 max_batch: int = 64, max_wait_ms: float = 2.0) -> None:
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.fn_batch = fn_batch
+        self.max_batch = max_batch
+        self.max_wait = max_wait_ms / 1e3
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._worker: Optional[asyncio.Task] = None
+        # dedicated executor: the shared to_thread pool can be saturated
+        # by blocked request handlers, which would deadlock the very
+        # dispatch those handlers are waiting on
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="pio-batcher")
+        self.batches = 0      # observability: dispatches issued
+        self.submitted = 0    # queries accepted
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or self._worker.done():
+            self._worker = asyncio.get_running_loop().create_task(self._run())
+
+    async def submit(self, query: Any) -> Any:
+        """Enqueue one query; resolves to its prediction (or raises)."""
+        self._ensure_worker()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.submitted += 1
+        await self._queue.put((query, fut))
+        return await fut
+
+    async def _collect(self) -> List[tuple]:
+        """One batch: block for the first item, then drain until full or
+        the wait window closes."""
+        first = await self._queue.get()
+        items = [first]
+        if self.max_batch == 1:
+            return items
+        deadline = asyncio.get_running_loop().time() + self.max_wait
+        while len(items) < self.max_batch:
+            timeout = deadline - asyncio.get_running_loop().time()
+            if timeout <= 0:
+                break
+            try:
+                items.append(await asyncio.wait_for(self._queue.get(),
+                                                    timeout))
+            except asyncio.TimeoutError:
+                break
+        return items
+
+    async def _run(self) -> None:
+        while True:
+            items = await self._collect()
+            queries = [q for q, _ in items]
+            self.batches += 1
+            try:
+                results = await asyncio.get_running_loop().run_in_executor(
+                    self._executor, self.fn_batch, queries)
+                if len(results) != len(queries):
+                    raise RuntimeError(
+                        f"batch fn returned {len(results)} results for "
+                        f"{len(queries)} queries")
+            except Exception as e:
+                for _, fut in items:
+                    if not fut.done():
+                        fut.set_exception(
+                            e if len(items) == 1 else _BatchError(e))
+                continue
+            for (_, fut), r in zip(items, results):
+                if not fut.done():
+                    fut.set_result(r)
+
+    def stop(self) -> None:
+        if self._worker is not None:
+            self._worker.cancel()
+            self._worker = None
+        self._executor.shutdown(wait=False)
+
+
+class _BatchError(RuntimeError):
+    """Wraps a failure that killed a whole batch (so a caller can tell
+    their own bad query from collateral damage)."""
+
+    def __init__(self, cause: BaseException) -> None:
+        super().__init__(f"batched query failed: {cause}")
+        self.cause = cause

@@ -44,6 +44,9 @@ class EngineServer:
         feedback_app_name: Optional[str] = None,
         plugins: Optional[List[Any]] = None,
         ssl_context: Optional[Any] = None,
+        batching: bool = False,
+        batch_max: int = 64,
+        batch_wait_ms: float = 2.0,
     ) -> None:
         self.storage = storage or get_storage()
         self.engine_factory = engine_factory
@@ -62,6 +65,14 @@ class EngineServer:
             "pio_engine_queries_total", "Queries served", ("status",))
         self._m_latency = REGISTRY.histogram(
             "pio_engine_query_seconds", "Query latency (handler, seconds)")
+        self._batcher = None
+        if batching:
+            from predictionio_tpu.server.batching import MicroBatcher
+
+            # bind late so /reload hot-swaps reach the batcher too
+            self._batcher = MicroBatcher(
+                lambda qs: self.deployed.batch_query(qs),
+                max_batch=batch_max, max_wait_ms=batch_wait_ms)
         router = Router()
         router.route("POST", "/queries.json", self._queries)
         router.route("GET", "/", self._status)
@@ -91,7 +102,10 @@ class EngineServer:
             self._m_queries.inc(("400",))
             return Response.json({"message": "empty query"}, status=400)
         try:
-            prediction = await asyncio.to_thread(self.deployed.query, query)
+            if self._batcher is not None:
+                prediction = await self._batcher.submit(query)
+            else:
+                prediction = await asyncio.to_thread(self.deployed.query, query)
         except Exception as e:
             self._m_queries.inc(("400",))
             return Response.json(

@@ -179,6 +179,12 @@ class HTTPServer:
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self.port, ssl=self.ssl_context)
 
+    @property
+    def bound_port(self) -> int:
+        """Actual listening port (use with ``port=0`` in tests)."""
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
     async def serve_forever(self) -> None:
         await self.start()
         assert self._server is not None

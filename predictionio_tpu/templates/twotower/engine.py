@@ -73,6 +73,9 @@ class TTAlgorithmParams:
     learning_rate: float = 0.01
     temperature: float = 0.1
     seed: int = 0
+    # mid-train checkpoint/resume (Orbax); None disables
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 1
 
 
 class TwoTowerModel:
@@ -118,7 +121,8 @@ class TwoTowerAlgorithm(Algorithm):
             embed_dim=p.embed_dim, hidden=list(p.hidden), out_dim=p.out_dim,
             batch_size=p.batch_size, epochs=p.epochs,
             learning_rate=p.learning_rate, temperature=p.temperature,
-            seed=p.seed)
+            seed=p.seed, checkpoint_dir=p.checkpoint_dir,
+            checkpoint_every=p.checkpoint_every)
         uv, iv = two_tower_train(uidx, iidx, len(user_ids), len(item_ids),
                                  tp, mesh=ctx.mesh)
         item_embeds = two_tower_embed_items(iv, len(item_ids), tp)

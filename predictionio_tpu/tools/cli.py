@@ -143,6 +143,9 @@ def cmd_deploy(args: argparse.Namespace) -> None:
         host=args.ip, port=args.port,
         variant_id=str(variant.get("id", "")),
         feedback=args.feedback,
+        batching=args.batching,
+        batch_max=args.batch_max,
+        batch_wait_ms=args.batch_wait_ms,
     )
     print(f"[info] Engine Server (instance {server.deployed.instance.id}) "
           f"listening on {args.ip}:{args.port}")
@@ -411,6 +414,10 @@ def build_parser() -> argparse.ArgumentParser:
     dp.add_argument("--port", type=int, default=8000)
     dp.add_argument("--engine-instance-id")
     dp.add_argument("--feedback", action="store_true")
+    dp.add_argument("--batching", action="store_true",
+                    help="micro-batch concurrent queries into one dispatch")
+    dp.add_argument("--batch-max", type=int, default=64)
+    dp.add_argument("--batch-wait-ms", type=float, default=2.0)
     dp.set_defaults(fn=cmd_deploy)
 
     ud = sub.add_parser("undeploy", help="stop a running engine server")

@@ -1,0 +1,70 @@
+"""Mid-training checkpoint/resume on Orbax (SURVEY.md §5).
+
+The reference's recovery unit is a completed EngineInstance — it has no
+mid-train checkpoints and relies on Spark task retry. On TPU the
+failure unit is the whole slice, so the survey mandates "training
+restart from latest checkpoint (Orbax)": training loops save their
+full state (model + optimizer + step) every N steps and a restarted
+job resumes from the newest step instead of from scratch.
+
+Layout: ``<dir>/<step>/`` per step (Orbax-managed), newest ``keep``
+retained. State must be a pytree of arrays plus ints/floats.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+
+class TrainCheckpointer:
+    """Thin wrapper over ``orbax.checkpoint.CheckpointManager``.
+
+    >>> ckpt = TrainCheckpointer(dir_, keep=3)
+    >>> start = ckpt.latest_step()                  # None on fresh start
+    >>> state = ckpt.restore(template=state) if start is not None else state
+    >>> ckpt.save(step, state); ...; ckpt.close()
+    """
+
+    def __init__(self, directory: str, keep: int = 3) -> None:
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=keep),
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def save(self, step: int, state: Any) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        self._mgr.wait_until_finished()
+
+    def restore(self, step: Optional[int] = None,
+                template: Optional[Any] = None) -> Any:
+        """Restore ``step`` (default: latest). ``template`` is a pytree
+        with the target structure/dtypes (abstract or concrete)."""
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if template is not None:
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(template))
+        return self._mgr.restore(step)
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "TrainCheckpointer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

@@ -1,0 +1,133 @@
+"""Continuous micro-batching serving layer."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from predictionio_tpu.server.batching import MicroBatcher, _BatchError
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestMicroBatcher:
+    def test_single_query_passthrough(self):
+        calls = []
+
+        def fn(qs):
+            calls.append(list(qs))
+            return [q * 10 for q in qs]
+
+        async def main():
+            mb = MicroBatcher(fn, max_batch=8, max_wait_ms=1.0)
+            out = await mb.submit(7)
+            mb.stop()
+            return out
+
+        assert run(main()) == 70
+        assert calls == [[7]]
+
+    def test_concurrent_queries_coalesce(self):
+        calls = []
+
+        def fn(qs):
+            calls.append(len(qs))
+            return [q + 1 for q in qs]
+
+        async def main():
+            mb = MicroBatcher(fn, max_batch=64, max_wait_ms=20.0)
+            outs = await asyncio.gather(*(mb.submit(i) for i in range(32)))
+            mb.stop()
+            return outs
+
+        outs = run(main())
+        assert outs == [i + 1 for i in range(32)]  # order preserved
+        assert sum(calls) == 32
+        assert len(calls) < 32  # genuinely coalesced
+        assert max(calls) > 1
+
+    def test_max_batch_bound(self):
+        calls = []
+
+        def fn(qs):
+            calls.append(len(qs))
+            return list(qs)
+
+        async def main():
+            mb = MicroBatcher(fn, max_batch=4, max_wait_ms=50.0)
+            await asyncio.gather(*(mb.submit(i) for i in range(10)))
+            mb.stop()
+
+        run(main())
+        assert max(calls) <= 4
+
+    def test_batch_error_propagates(self):
+        def fn(qs):
+            raise ValueError("boom")
+
+        async def main():
+            mb = MicroBatcher(fn, max_batch=8, max_wait_ms=5.0)
+            res = await asyncio.gather(*(mb.submit(i) for i in range(3)),
+                                       return_exceptions=True)
+            mb.stop()
+            return res
+
+        res = run(main())
+        assert all(isinstance(r, (ValueError, _BatchError)) for r in res)
+
+    def test_length_mismatch_detected(self):
+        def fn(qs):
+            return [1]  # wrong arity
+
+        async def main():
+            mb = MicroBatcher(fn, max_batch=8, max_wait_ms=5.0)
+            res = await asyncio.gather(*(mb.submit(i) for i in range(2)),
+                                       return_exceptions=True)
+            mb.stop()
+            return res
+
+        res = run(main())
+        assert all(isinstance(r, (RuntimeError, _BatchError)) for r in res)
+
+
+@pytest.mark.scenario
+def test_engine_server_batching_end_to_end(storage):
+    """EngineServer(batching=True) answers concurrent queries correctly
+    and in fewer device dispatches than queries."""
+    import urllib.request
+    import json
+    import threading
+
+    from tests.test_workflow import FACTORY, VARIANT, seed_ratings
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.server.engine_server import EngineServer
+
+    seed_ratings(storage)
+    run_train(FACTORY, variant=VARIANT, storage=storage, use_mesh=False)
+    server = EngineServer(engine_factory=FACTORY, storage=storage,
+                          host="127.0.0.1", port=0, batching=True,
+                          batch_max=16, batch_wait_ms=10.0)
+
+    import asyncio
+
+    async def drive():
+        await server.http.start()
+        port = server.http.bound_port
+        def q(u):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/queries.json",
+                data=json.dumps({"user": str(u), "num": 3}).encode())
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())
+        outs = await asyncio.gather(*(
+            asyncio.to_thread(q, u % 10) for u in range(12)))
+        await server.http.stop()
+        return outs
+
+    outs = asyncio.run(drive())
+    assert all(len(o["itemScores"]) == 3 for o in outs)
+    assert server._batcher.submitted == 12
+    assert server._batcher.batches <= 12

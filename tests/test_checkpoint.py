@@ -1,0 +1,70 @@
+"""Mid-train checkpoint/resume (Orbax) — SURVEY.md §5 recovery model."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.utils.checkpoint import TrainCheckpointer
+
+
+class TestCheckpointer:
+    def test_round_trip_and_latest(self, tmp_path):
+        state = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                 "opt": {"mu": np.zeros(3), "count": np.asarray(4)}}
+        with TrainCheckpointer(str(tmp_path / "ck")) as ck:
+            assert ck.latest_step() is None
+            ck.save(1, state)
+            state2 = {**state, "w": state["w"] * 2}
+            ck.save(2, state2)
+            assert ck.latest_step() == 2
+            got = ck.restore(template=state)
+            np.testing.assert_array_equal(got["w"], state2["w"])
+            got1 = ck.restore(step=1, template=state)
+            np.testing.assert_array_equal(got1["w"], state["w"])
+
+    def test_keep_policy(self, tmp_path):
+        with TrainCheckpointer(str(tmp_path / "ck"), keep=2) as ck:
+            for s in (1, 2, 3, 4):
+                ck.save(s, {"x": np.asarray([s])})
+            assert ck.latest_step() == 4
+            with pytest.raises(Exception):
+                ck.restore(step=1, template={"x": np.asarray([0])})
+
+    def test_restore_empty_raises(self, tmp_path):
+        with TrainCheckpointer(str(tmp_path / "ck")) as ck:
+            with pytest.raises(FileNotFoundError):
+                ck.restore()
+
+
+class TestTwoTowerResume:
+    def _pairs(self, n=256, n_users=40, n_items=30, seed=0):
+        rng = np.random.default_rng(seed)
+        return (rng.integers(0, n_users, n).astype(np.int32),
+                rng.integers(0, n_items, n).astype(np.int32),
+                n_users, n_items)
+
+    def test_resume_matches_straight_run(self, tmp_path):
+        from predictionio_tpu.models.two_tower import (
+            TwoTowerParams,
+            two_tower_train,
+        )
+
+        u, i, nu, ni = self._pairs()
+        base = dict(embed_dim=16, hidden=[32], out_dim=16, batch_size=64,
+                    learning_rate=0.01, seed=3)
+
+        straight = two_tower_train(
+            u, i, nu, ni, TwoTowerParams(**base, epochs=4))
+
+        ckdir = str(tmp_path / "ck")
+        # "crash" after 2 epochs, then restart asking for 4
+        two_tower_train(u, i, nu, ni, TwoTowerParams(
+            **base, epochs=2, checkpoint_dir=ckdir))
+        resumed = two_tower_train(u, i, nu, ni, TwoTowerParams(
+            **base, epochs=4, checkpoint_dir=ckdir))
+
+        for a, b in zip(__import__("jax").tree.leaves(straight),
+                        __import__("jax").tree.leaves(resumed)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
