@@ -41,6 +41,7 @@ class MicroBatcher:
             max_workers=1, thread_name_prefix="pio-batcher")
         self.batches = 0      # observability: dispatches issued
         self.submitted = 0    # queries accepted
+        self.isolations = 0   # failed batches re-run query-by-query
 
     def _ensure_worker(self) -> None:
         if self._worker is None or self._worker.done():
@@ -78,18 +79,40 @@ class MicroBatcher:
             items = await self._collect()
             queries = [q for q, _ in items]
             self.batches += 1
+            loop = asyncio.get_running_loop()
             try:
-                results = await asyncio.get_running_loop().run_in_executor(
+                results = await loop.run_in_executor(
                     self._executor, self.fn_batch, queries)
                 if len(results) != len(queries):
                     raise RuntimeError(
                         f"batch fn returned {len(results)} results for "
                         f"{len(queries)} queries")
             except Exception as e:
-                for _, fut in items:
-                    if not fut.done():
-                        fut.set_exception(
-                            e if len(items) == 1 else _BatchError(e))
+                if len(items) == 1:
+                    if not items[0][1].done():
+                        items[0][1].set_exception(e)
+                    continue
+                # One bad query must not poison its batch siblings — and
+                # each caller must see their OWN error (a sibling getting
+                # the offender's ValueError would read as 400 for a fine
+                # query). Isolate by re-running every query alone.
+                self.isolations += 1
+                for q, fut in items:
+                    if fut.done():  # caller gone — don't burn a dispatch
+                        continue
+                    try:
+                        r = await loop.run_in_executor(
+                            self._executor, self.fn_batch, [q])
+                        if len(r) != 1:
+                            raise RuntimeError(
+                                f"batch fn returned {len(r)} results for "
+                                "1 query")
+                    except Exception as single_e:
+                        if not fut.done():
+                            fut.set_exception(single_e)
+                    else:
+                        if not fut.done():
+                            fut.set_result(r[0])
                 continue
             for (_, fut), r in zip(items, results):
                 if not fut.done():
@@ -100,12 +123,3 @@ class MicroBatcher:
             self._worker.cancel()
             self._worker = None
         self._executor.shutdown(wait=False)
-
-
-class _BatchError(RuntimeError):
-    """Wraps a failure that killed a whole batch (so a caller can tell
-    their own bad query from collateral damage)."""
-
-    def __init__(self, cause: BaseException) -> None:
-        super().__init__(f"batched query failed: {cause}")
-        self.cause = cause

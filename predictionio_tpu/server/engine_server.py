@@ -106,10 +106,19 @@ class EngineServer:
                 prediction = await self._batcher.submit(query)
             else:
                 prediction = await asyncio.to_thread(self.deployed.query, query)
-        except Exception as e:
+        except (ValueError, KeyError, TypeError) as e:
+            # malformed/invalid query (bad fields, unknown entity, wrong types)
             self._m_queries.inc(("400",))
             return Response.json(
                 {"message": f"query failed: {type(e).__name__}: {e}"}, status=400)
+        except Exception as e:
+            # internal fault; retryable, so 500 (the reference returns
+            # 500 on server faults). Micro-batch failures are isolated
+            # per-query by the batcher, so a malformed query still
+            # surfaces as its own ValueError → 400 above.
+            self._m_queries.inc(("500",))
+            return Response.json(
+                {"message": f"server error: {type(e).__name__}: {e}"}, status=500)
         self._m_queries.inc(("200",))
         self._m_latency.observe(time.perf_counter() - t0)
         for p in self.plugins:

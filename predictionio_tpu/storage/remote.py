@@ -33,12 +33,15 @@ class StorageClientError(RuntimeError):
 
 
 def _source_env(key: str, default: str = "") -> str:
-    # any source name may carry the setting; first match wins. Match the
-    # FULL key shape PIO_STORAGE_SOURCES_<NAME>_<KEY> — a suffix match
-    # would let e.g. *_BASE_PATH shadow a lookup of PATH
-    pattern = re.compile(rf"^PIO_STORAGE_SOURCES_[A-Za-z0-9]+_{key}$")
-    for k, v in os.environ.items():
-        if pattern.match(k):
+    # any source name may carry the setting; first match wins. Source
+    # names are discovered from their (mandatory) _TYPE key, so names
+    # with underscores (MY_PG) resolve too — and because the name is
+    # matched as a whole, *_BASE_PATH can never shadow a lookup of PATH.
+    names = [m.group(1) for k in os.environ
+             if (m := re.match(r"^PIO_STORAGE_SOURCES_(.+)_TYPE$", k))]
+    for name in names:
+        v = os.environ.get(f"PIO_STORAGE_SOURCES_{name}_{key}")
+        if v is not None:
             return v
     return default
 

@@ -91,6 +91,10 @@ class Registry:
                 m = self._metrics[name] = Counter(name, help, labelnames)
             elif not isinstance(m, Counter):
                 raise ValueError(f"metric {name!r} already a {type(m).__name__}")
+            elif m.labelnames != tuple(labelnames):
+                raise ValueError(
+                    f"metric {name!r} already registered with labels "
+                    f"{m.labelnames}, requested {tuple(labelnames)}")
             return m
 
     def histogram(self, name: str, help: str,
@@ -102,6 +106,10 @@ class Registry:
                     name, help, buckets or _DEFAULT_BUCKETS)
             elif not isinstance(m, Histogram):
                 raise ValueError(f"metric {name!r} already a {type(m).__name__}")
+            elif buckets is not None and m.buckets != tuple(sorted(buckets)):
+                raise ValueError(
+                    f"metric {name!r} already registered with buckets "
+                    f"{m.buckets}, requested {tuple(sorted(buckets))}")
             return m
 
     def render(self) -> str:

@@ -6,7 +6,7 @@ import asyncio
 
 import pytest
 
-from predictionio_tpu.server.batching import MicroBatcher, _BatchError
+from predictionio_tpu.server.batching import MicroBatcher
 
 
 def run(coro):
@@ -76,21 +76,41 @@ class TestMicroBatcher:
             return res
 
         res = run(main())
-        assert all(isinstance(r, (ValueError, _BatchError)) for r in res)
+        # isolation re-runs each query alone; every caller sees the
+        # ORIGINAL error for their own query, never a wrapper
+        assert all(isinstance(r, ValueError) for r in res)
 
-    def test_length_mismatch_detected(self):
+    def test_bad_query_isolated_from_siblings(self):
         def fn(qs):
-            return [1]  # wrong arity
+            if any(q < 0 for q in qs):
+                raise ValueError("negative query")
+            return [q * 2 for q in qs]
 
         async def main():
-            mb = MicroBatcher(fn, max_batch=8, max_wait_ms=5.0)
+            mb = MicroBatcher(fn, max_batch=8, max_wait_ms=20.0)
+            res = await asyncio.gather(*(mb.submit(q) for q in (-1, 5, 7)),
+                                       return_exceptions=True)
+            iso = mb.isolations
+            mb.stop()
+            return res, iso
+
+        res, iso = run(main())
+        assert isinstance(res[0], ValueError)   # offender gets its error
+        assert res[1:] == [10, 14]              # siblings still answered
+
+    def test_length_mismatch_recovers_by_isolation(self):
+        def fn(qs):
+            return [qs[0]]  # wrong arity for batches, fine for singles
+
+        async def main():
+            mb = MicroBatcher(fn, max_batch=8, max_wait_ms=20.0)
             res = await asyncio.gather(*(mb.submit(i) for i in range(2)),
                                        return_exceptions=True)
             mb.stop()
             return res
 
         res = run(main())
-        assert all(isinstance(r, (RuntimeError, _BatchError)) for r in res)
+        assert res == [0, 1]  # per-query re-runs deliver correct results
 
 
 @pytest.mark.scenario
