@@ -6,22 +6,26 @@ similar-product / e-commerce templates; block-partitioned factor
 matrices, shuffle-joined rating blocks, per-row normal-equation Cholesky
 solves — SURVEY.md §2d P2). The TPU-first redesign:
 
-- Ratings are laid out host-side as **padded rows**: each entity's
-  (sorted) rating list is split into rows of fixed width W, giving
-  static-shape matrices ``other_idx/vals/mask ∈ [R, W]`` plus a sorted
-  ``row_entity ∈ [R]`` map. This is the sparsity-to-MXU bridge: the
-  per-entity normal equations ``A_e = Σ v vᵀ`` become **batched
-  (W×k)ᵀ(W×k) matmuls** over rows — dense systolic-array work — with
-  only one sorted scatter-add of R row-results per half-step
-  (R ≈ nnz/W + n_entities, ~50× fewer scatter updates than per-rating
-  accumulation).
-- Rows stream through a ``lax.scan`` in fixed-size chunks, bounding the
-  ``(RC, W, k)`` gather and ``(RC, k, k)`` partial-result buffers.
-- Every entity's k×k system is solved by one **batched Cholesky**
-  (two batched triangular solves) — replacing MLlib's per-row LAPACK
-  ``dppsv`` calls.
+- Single-device: ratings are **bucketed by entity** — entities sorted
+  by rating count, each padded to the next power-of-two width C, and
+  same-width entities batched into dense ``(nb, C)`` blocks. This is
+  the sparsity-to-MXU bridge: each entity's normal equations
+  ``A_e = Σ v vᵀ`` are ONE batch element of a dense batched weighted
+  Gram ``(C×k)ᵀdiag(w)(C×k)`` — systolic-array work with **no scatter
+  anywhere** (TPU scatter-add of row partials measured ~40% of the
+  iteration in the earlier padded-row design, which the sharded path
+  still uses per-device).
+- Buckets stream through ``lax.scan`` in fixed-size slabs, and each
+  slab's k×k systems are solved immediately — the (n, k, k) normal
+  matrices never materialize, so memory stays flat in catalog size.
+- Solves use a **block-recursive batched Cholesky built from batched
+  matmuls** (:mod:`predictionio_tpu.ops.cholesky`) — replacing MLlib's
+  per-row LAPACK ``dppsv`` calls, and ~18× faster on TPU than XLA's
+  sequential ``cholesky`` lowering at ML-20M batch sizes.
 - The whole training run (iterations × two half-steps) is ONE jitted
-  ``lax.scan``: no host round-trips.
+  ``lax.scan``: no host round-trips. Layout construction
+  (:func:`als_prepare`) is a separate host-side step — the analogue of
+  MLlib's InBlock build — done once per dataset and reused.
 - With a mesh (:mod:`predictionio_tpu.models.als_sharded`): entities are
   range-partitioned across devices, each device holds its entities'
   rating rows, and one ``all_gather`` per half-step replaces the
@@ -164,42 +168,175 @@ def chunk_update(A, b, chunk, F_other, implicit: bool, alpha: float,
     return A, b
 
 
-def _build_normal_eq(n_self: int, implicit: bool, alpha: float,
-                     pallas: Optional[bool] = None):
-    """Returns f(F_other, chunks) -> (A [n_self,k,k], b [n_self,k]) where
-    chunks are row-layout arrays reshaped to [n_chunks, RC, ...]."""
-    import jax
-    import jax.numpy as jnp
+# -- bucketed single-device layout -------------------------------------------
+#
+# The padded-row layout above (still used by the sharded path) pays one
+# sorted scatter-add of ~nnz/W row partials per half-step; TPU scatter
+# measured ~140-200 ms per ML-20M half-step — comparable to all the
+# matmul work combined. The single-device path instead buckets entities
+# by padded rating count (powers of two), so each entity's normal
+# equations are ONE batch element of a dense batched Gram — no scatter
+# anywhere. This is the "bucketed/padded rating blocks" design SURVEY.md
+# §7 anticipated. Entities live in count-descending permuted order
+# during training (so same-width entities are contiguous); factors are
+# un-permuted once at the end.
 
-    def normal_eq(F_other, row_entity, other_idx, vals, mask):
-        k = F_other.shape[1]
-        A0 = jnp.zeros((n_self, k, k), jnp.float32)
-        b0 = jnp.zeros((n_self, k), jnp.float32)
+_SLAB_ELEMS = 1 << 18   # slab_entities × width bound per scan step
+                        # (bounds the (slab, C, k) gather to ~64MB at k=64)
+_MIN_WIDTH = 8
 
-        def body(carry, chunk):
-            return chunk_update(*carry, chunk, F_other, implicit, alpha,
-                                pallas), None
 
-        (A, b), _ = jax.lax.scan(body, (A0, b0),
-                                 (row_entity, other_idx, vals, mask))
-        return A, b
+@dataclass
+class _Bucket:
+    """Entities sharing one padded width C, sliced into scan slabs."""
 
-    return normal_eq
+    C: int
+    nb: int        # real entity count
+    slab: int
+    n_slabs: int
+    other_idx: np.ndarray  # (n_slabs, slab, C) int32 — PERMUTED other pos
+    vals: np.ndarray       # (n_slabs, slab, C) f32
+    mask: np.ndarray       # (n_slabs, slab, C) f32
+    counts: np.ndarray     # (n_slabs, slab) f32 — true rating counts
+
+    @property
+    def geometry(self) -> Tuple[int, int, int, int]:
+        return (self.C, self.nb, self.slab, self.n_slabs)
+
+
+@dataclass
+class _BucketSide:
+    """One half-step orientation: self entities bucketed, other side
+    referenced by permuted position."""
+
+    n: int
+    perm: np.ndarray       # position p → original entity id
+    inv_perm: np.ndarray   # original entity id → position
+    buckets: list
+
+    @property
+    def geometry(self):
+        return (self.n, tuple(b.geometry for b in self.buckets))
+
+
+def _perm_by_count_desc(counts: np.ndarray):
+    perm = np.argsort(-counts, kind="stable").astype(np.int32)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(len(perm), dtype=np.int32)
+    return perm, inv
+
+
+def _bucket_side(idx_self, idx_other_pos, vals, n_self, counts,
+                 perm, inv_perm) -> _BucketSide:
+    """Bucket one orientation. ``idx_other_pos`` must already be mapped
+    to the other side's permuted positions; ``counts/perm/inv_perm``
+    come from :func:`_perm_by_count_desc` on this side's counts."""
+    nnz = idx_self.shape[0]
+    pos = inv_perm[idx_self]
+    order = np.argsort(pos, kind="stable")
+    ps, o, v = pos[order], idx_other_pos[order], vals[order]
+    counts_perm = counts[perm].astype(np.int64)
+    starts = np.zeros(n_self + 1, np.int64)
+    np.cumsum(counts_perm, out=starts[1:])
+    within = (np.arange(nnz, dtype=np.int64) - starts[ps]).astype(np.int64)
+
+    n_nz = int((counts_perm > 0).sum())
+    widths = np.zeros(n_self, np.int64)
+    if n_nz:
+        widths[:n_nz] = np.maximum(
+            _MIN_WIDTH,
+            1 << np.ceil(np.log2(counts_perm[:n_nz])).astype(np.int64))
+    buckets = []
+    e = 0
+    while e < n_nz:
+        C = int(widths[e])
+        e_end = int(np.searchsorted(-widths[:n_nz], -C, side="right"))
+        nb = e_end - e
+        slab = max(1, _SLAB_ELEMS // C)
+        n_slabs = -(-nb // slab)
+        nb_pad = n_slabs * slab
+        oi = np.zeros((nb_pad, C), np.int32)
+        vv = np.zeros((nb_pad, C), np.float32)
+        mm = np.zeros((nb_pad, C), np.float32)
+        lo, hi = int(starts[e]), int(starts[e_end])
+        row = (ps[lo:hi] - e).astype(np.int64)
+        col = within[lo:hi]
+        oi[row, col] = o[lo:hi]
+        vv[row, col] = v[lo:hi]
+        mm[row, col] = 1.0
+        cnt = np.zeros(nb_pad, np.float32)
+        cnt[:nb] = counts_perm[e:e_end]
+        buckets.append(_Bucket(
+            C, nb, slab, n_slabs,
+            oi.reshape(n_slabs, slab, C),
+            vv.reshape(n_slabs, slab, C),
+            mm.reshape(n_slabs, slab, C),
+            cnt.reshape(n_slabs, slab)))
+        e = e_end
+    return _BucketSide(n_self, perm, inv_perm, buckets)
+
+
+@dataclass
+class ALSPrepared:
+    """Host-side prepared training layout (the analogue of MLlib ALS's
+    InBlock construction — built once per dataset, reused across train
+    calls; `bench.py` times training only, per BASELINE.md's
+    "excluding data prep" protocol)."""
+
+    n_users: int
+    n_items: int
+    nnz: int
+    u_side: _BucketSide
+    i_side: _BucketSide
+    _device_bufs: Optional[dict] = None
+
+    @property
+    def geometry(self):
+        return (self.u_side.geometry, self.i_side.geometry)
+
+    def device_buffers(self, device=None):
+        """Bucket arrays as device arrays (cached per device across
+        train calls — a reused prep may be trained on different pinned
+        devices, e.g. a `pio eval` grid over 1-device meshes)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._device_bufs is None:
+            self._device_bufs = {}
+        if device not in self._device_bufs:
+            def put(a):
+                return (jnp.asarray(a) if device is None
+                        else jax.device_put(a, device))
+
+            self._device_bufs[device] = tuple(
+                tuple((put(b.other_idx), put(b.vals), put(b.mask),
+                       put(b.counts)) for b in side.buckets)
+                for side in (self.u_side, self.i_side))
+        return self._device_bufs[device]
+
+
+def als_prepare(coo: RatingsCOO) -> ALSPrepared:
+    """Build the bucketed layout for single-device training."""
+    cnt_u = np.bincount(coo.user_idx, minlength=coo.n_users)
+    cnt_i = np.bincount(coo.item_idx, minlength=coo.n_items)
+    perm_u, inv_u = _perm_by_count_desc(cnt_u)
+    perm_i, inv_i = _perm_by_count_desc(cnt_i)
+    u_side = _bucket_side(coo.user_idx, inv_i[coo.item_idx], coo.rating,
+                          coo.n_users, cnt_u, perm_u, inv_u)
+    i_side = _bucket_side(coo.item_idx, inv_u[coo.user_idx], coo.rating,
+                          coo.n_items, cnt_i, perm_i, inv_i)
+    return ALSPrepared(coo.n_users, coo.n_items, coo.nnz, u_side, i_side)
 
 
 def _solve_psd(A, b):
-    """Batched SPD solve via Cholesky (the MXU replacement for MLlib's
-    per-row LAPACK dppsv)."""
-    import jax
-    import jax.numpy as jnp
+    """Batched SPD solve (the MXU replacement for MLlib's per-row LAPACK
+    dppsv). Delegates to the block-recursive batched Cholesky in
+    :mod:`predictionio_tpu.ops.cholesky` — XLA's ``cholesky`` +
+    ``triangular_solve`` lower to sequential column loops that measured
+    1.28 s for the ML-20M user batch on v5e (~70% of the iteration)."""
+    from predictionio_tpu.ops.cholesky import chol_solve_batched
 
-    L = jnp.linalg.cholesky(A)
-    # two batched triangular solves: L y = b ; Lᵀ x = y
-    y = jax.lax.linalg.triangular_solve(
-        L, b[..., None], left_side=True, lower=True)
-    x = jax.lax.linalg.triangular_solve(
-        L, y, left_side=True, lower=True, transpose_a=True)
-    return x[..., 0]
+    return chol_solve_batched(A, b)
 
 
 def als_train(
@@ -223,97 +360,116 @@ def als_train(
 
 
 @functools.lru_cache(maxsize=8)
-def _compiled_single(n_users: int, n_items: int, u_rows: int, i_rows: int,
-                     chunk_rows: int, width: int,
-                     rank: int, iterations: int, reg: float, implicit: bool,
-                     alpha: float, weighted_reg: bool,
-                     pallas: bool = False):
-    """Build + jit the full training program for one problem geometry.
-    Caching on geometry means `pio eval` grid candidates that share shapes
-    recompile only when rank/iterations/reg change. ``pallas`` is part of
-    the key so flipping PIO_NO_PALLAS mid-process takes effect."""
+def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
+                       rank: int, iterations: int, reg: float,
+                       implicit: bool, alpha: float, weighted_reg: bool):
+    """Build + jit the full bucketed training program for one problem
+    geometry. Caching on geometry means `pio eval` grid candidates that
+    share shapes recompile only when rank/iterations change.
+
+    Per half-step, per bucket, per slab (a ``lax.scan`` step): gather
+    the (slab, C, k) factor block, one batched weighted-Gram einsum
+    (MXU), add ridge + implicit term, and solve the slab's k×k systems
+    immediately with the block-recursive batched Cholesky — so the
+    (n, k, k) normal matrices are never materialized (peak extra memory
+    is one slab, ~64 MB, regardless of catalog size) and there is no
+    scatter anywhere in the program.
+    """
     import jax
     import jax.numpy as jnp
 
-    ne_user = _build_normal_eq(n_users, implicit, alpha, pallas)
-    ne_item = _build_normal_eq(n_items, implicit, alpha, pallas)
+    k = rank
+    eye = jnp.eye(k, dtype=jnp.float32)
 
-    def train(u_chunks, i_chunks, cnt_u, cnt_i, V0):
-        k = rank
-        eye = jnp.eye(k, dtype=jnp.float32)
-        # λ·n_e·I (ALS-WR) or λ·I; entities with zero ratings get identity
-        # (solve yields 0 factor since b=0, and stays non-singular).
-        def reg_term(cnt):
-            lam = reg * cnt if weighted_reg else jnp.full_like(cnt, reg)
-            lam = jnp.where(cnt > 0, jnp.maximum(lam, 1e-8), 1.0)
-            return lam[:, None, None] * eye
+    from predictionio_tpu.ops.cholesky import chol_solve_batched
 
-        Ru = reg_term(cnt_u)
-        Ri = reg_term(cnt_i)
+    def half(F_other, bufs, geometry):
+        n_self, bucket_geoms = geometry
+        if implicit:
+            G = jnp.einsum("nk,nl->kl", F_other, F_other,
+                           precision=jax.lax.Precision.HIGHEST,
+                           preferred_element_type=jnp.float32)
+        outs = []
+        total = 0
+        for (C, nb, slab, n_slabs), (oi, vv, mm, cnt) in zip(
+                bucket_geoms, bufs):
 
-        def half(F_other, ne, chunks, R):
-            A, b = ne(F_other, *chunks)
-            if implicit:
-                A = A + (F_other.T @ F_other)[None, :, :]
-            return _solve_psd(A + R, b)
+            def body(_, chunk):
+                oi_s, v_s, m_s, cnt_s = chunk
+                F = F_other[oi_s]                       # (slab, C, k)
+                if implicit:
+                    wo = (alpha * v_s) * m_s
+                    wb = (1.0 + alpha * v_s) * m_s
+                else:
+                    wo = m_s
+                    wb = v_s * m_s
+                # HIGHEST: normal equations need f32 MXU passes — bf16
+                # Gram error is ~3e-1 vs 6e-5 (see ops/gram.py) and the
+                # Cholesky solve amplifies it
+                A = jnp.einsum("nc,nck,ncl->nkl", wo, F, F,
+                               precision=jax.lax.Precision.HIGHEST,
+                               preferred_element_type=jnp.float32)
+                b = jnp.einsum("nc,nck->nk", wb, F,
+                               precision=jax.lax.Precision.HIGHEST,
+                               preferred_element_type=jnp.float32)
+                if implicit:
+                    A = A + G[None, :, :]
+                lam = reg * cnt_s if weighted_reg else jnp.full_like(
+                    cnt_s, reg)
+                lam = jnp.where(cnt_s > 0, jnp.maximum(lam, 1e-8), 1.0)
+                A = A + lam[:, None, None] * eye
+                return None, chol_solve_batched(A, b)
 
+            if n_slabs == 1:
+                x = body(None, (oi[0], vv[0], mm[0], cnt[0]))[1]
+            else:
+                _, xs = jax.lax.scan(body, None, (oi, vv, mm, cnt))
+                x = xs.reshape(-1, k)
+            outs.append(x[:nb])
+            total += nb
+        if total < n_self:  # zero-rating tail entities → zero factors
+            outs.append(jnp.zeros((n_self - total, k), jnp.float32))
+        return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+
+    def train(u_bufs, i_bufs, V0p):
         def step(carry, _):
             U, V = carry
-            U = half(V, ne_user, u_chunks, Ru)
-            V = half(U, ne_item, i_chunks, Ri)
+            U = half(V, u_bufs, geom_u)
+            V = half(U, i_bufs, geom_i)
             return (U, V), None
 
         U0 = jnp.zeros((n_users, k), jnp.float32)
-        (U, V), _ = jax.lax.scan(step, (U0, V0), None, length=iterations)
+        (U, V), _ = jax.lax.scan(step, (U0, V0p), None, length=iterations)
         return U, V
 
     return jax.jit(train)
 
 
-def _chunked(arrs, chunk_rows: int, put=None):
+def als_train_prepared(prep: ALSPrepared, p: ALSParams,
+                       device=None) -> Tuple[np.ndarray, np.ndarray]:
+    """Train from a prepared layout; returns (U, V) in ORIGINAL entity
+    order as numpy arrays."""
+    import jax
     import jax.numpy as jnp
 
-    put = put or jnp.asarray
-    out = []
-    for a in arrs:
-        n_chunks = a.shape[0] // chunk_rows
-        out.append(put(a.reshape((n_chunks, chunk_rows) + a.shape[1:])))
-    return tuple(out)
+    u_bufs, i_bufs = prep.device_buffers(device)
+    train = _compiled_bucketed(
+        prep.u_side.geometry, prep.i_side.geometry,
+        prep.n_users, prep.n_items,
+        p.rank, p.iterations, float(p.reg), bool(p.implicit),
+        float(p.alpha), bool(p.weighted_reg))
+    V0 = init_factors(prep.n_items, p.rank, p.seed)[prep.i_side.perm]
+    V0 = (jnp.asarray(V0) if device is None
+          else jax.device_put(V0, device))
+    U, V = train(u_bufs, i_bufs, V0)
+    # un-permute back to original entity order
+    return (np.asarray(U)[prep.u_side.inv_perm],
+            np.asarray(V)[prep.i_side.inv_perm])
 
 
 def _als_train_single(coo: RatingsCOO, p: ALSParams,
                       device=None) -> Tuple[np.ndarray, np.ndarray]:
-    import jax
-    import jax.numpy as jnp
-
-    W = p.row_width
-    RC = _row_chunk(p.rank)
-    u_rows = rows_layout(coo.user_idx, coo.item_idx, coo.rating,
-                         coo.n_users, W, RC)
-    i_rows = rows_layout(coo.item_idx, coo.user_idx, coo.rating,
-                         coo.n_items, W, RC)
-
-    def put(a):
-        return jnp.asarray(a) if device is None else jax.device_put(a, device)
-
-    u_chunks = _chunked(u_rows, RC, put)
-    i_chunks = _chunked(i_rows, RC, put)
-    cnt_u = put(_counts(coo.user_idx, coo.n_users))
-    cnt_i = put(_counts(coo.item_idx, coo.n_items))
-
-    from predictionio_tpu import ops
-
-    # Pallas keyed on the device actually used (an explicit 1-device mesh
-    # pins it; otherwise the default backend decides)
-    pallas = ops.use_pallas(device.platform if device is not None else None)
-    train = _compiled_single(
-        coo.n_users, coo.n_items, u_rows[0].shape[0], i_rows[0].shape[0],
-        RC, W, p.rank, p.iterations,
-        float(p.reg), bool(p.implicit), float(p.alpha), bool(p.weighted_reg),
-        pallas)
-    U, V = train(u_chunks, i_chunks, cnt_u, cnt_i,
-                 put(init_factors(coo.n_items, p.rank, p.seed)))
-    return np.asarray(U), np.asarray(V)
+    return als_train_prepared(als_prepare(coo), p, device=device)
 
 
 # -- scoring ------------------------------------------------------------------

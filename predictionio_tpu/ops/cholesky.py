@@ -1,0 +1,149 @@
+"""Batched SPD solve as MXU matmuls — the ALS normal-equation solver.
+
+MLlib solves each entity's k×k normal equations with one LAPACK
+``dppsv`` call per row (reference behavior: [U] mllib ALS
+NormalEquation / CholeskySolver — SURVEY.md §2d P2). The direct XLA
+translation (``jnp.linalg.cholesky`` + two ``triangular_solve``) is
+catastrophically slow on TPU for large batches of small matrices: both
+ops lower to *sequential* column loops that leave the MXU idle
+(measured 1.28 s for a (138k, 64, 64) batch on v5e — ~70% of the whole
+ALS iteration).
+
+This module reorganizes the same factorization so ~all FLOPs are
+batched matmuls, which XLA tiles onto the MXU:
+
+- ``L⁻¹`` is built by **recursive 2×2 blocking**::
+
+      inv(chol([[A11,   ·],          [[L11⁻¹,        0],
+                [A21, A22]]))    =    [-L22⁻¹L21L11⁻¹, L22⁻¹]]
+
+  where ``L21 = A21·L11⁻ᵀ`` and ``L22⁻¹ = inv(chol(A22 − L21·L21ᵀ))``
+  — every step a batched (h×h) matmul except the ≤8×8 leaves, which use
+  an unrolled Cholesky–Banachiewicz + forward substitution vectorized
+  over the batch (scalar ops on (n,) lanes, VPU work).
+- The solve is then two batched matvecs: ``x = L⁻ᵀ(L⁻¹b)``.
+
+Same flop count and numerical profile as LAPACK's blocked algorithm
+(explicit triangular inverses are benign here: ALS systems carry a
+``λ·n_e·I`` ridge, so condition numbers are modest); ~25× faster than
+the sequential lowering at ALS scale.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_LEAF = 8  # unrolled base-case size
+
+
+def _mm(a, b):
+    """Batched matmul in full f32 precision.
+
+    XLA's batched dot on TPU loops the (huge) batch dim with a fixed
+    ~1–6 ms cost per op at these shapes, so for the small half-block
+    contractions (h ≤ 32) and for matvecs a broadcast-multiply-reduce —
+    pure fused VPU work, exact f32 — is 3–10× faster (measured on v5e:
+    0.1/0.6/3.8 ms vs 1.2/2.8/5.5 ms per op at h=8/16/32, batch 65k).
+    Larger contractions go to the MXU via einsum at HIGHEST precision
+    (ALS solves are sensitive to Gram/solve precision — see ops/gram.py).
+    """
+    if a.shape[-1] <= 32 or b.shape[-1] == 1:
+        return (a[..., :, :, None] * b[..., None, :, :]).sum(-2)
+    return jnp.einsum("...ij,...jk->...ik", a, b,
+                      precision=jax.lax.Precision.HIGHEST,
+                      preferred_element_type=jnp.float32)
+
+
+def _t(a):
+    return jnp.swapaxes(a, -1, -2)
+
+
+def _chol_inv_leaf(A):
+    """(..., m, m) SPD with m ≤ _LEAF → L⁻¹, fully unrolled and
+    vectorized over the batch dims.
+
+    The matrix dims are moved to the FRONT first so each of the ~m³/3
+    unrolled scalar steps reads a contiguous (batch,) vector — as
+    (..., i, j) slices every step would re-read the strided (..., m, m)
+    buffer (measured 13 ms vs <1 ms per leaf at batch 65k on v5e)."""
+    m = A.shape[-1]
+    At = jnp.moveaxis(A, (-2, -1), (0, 1))  # (m, m, *batch)
+    batch = At.shape[2:]
+    L = [[None] * m for _ in range(m)]
+    for i in range(m):
+        for j in range(i + 1):
+            s = At[i][j]
+            for p in range(j):
+                s = s - L[i][p] * L[j][p]
+            if i == j:
+                # the ridge keeps diagonals strictly positive; the floor
+                # only guards padded identity blocks from rounding
+                L[i][j] = jnp.sqrt(jnp.maximum(s, 1e-30))
+            else:
+                L[i][j] = s / L[j][j]
+    inv = [[None] * m for _ in range(m)]
+    for j in range(m):
+        for i in range(j, m):
+            if i == j:
+                inv[i][j] = 1.0 / L[i][i]
+            else:
+                s = L[i][j] * inv[j][j]
+                for p in range(j + 1, i):
+                    s = s + L[i][p] * inv[p][j]
+                inv[i][j] = -s / L[i][i]
+    zero = jnp.zeros(batch, A.dtype)
+    out = jnp.stack([jnp.stack([inv[i][j] if j <= i else zero
+                                for j in range(m)], axis=0)
+                     for i in range(m)], axis=0)
+    return jnp.moveaxis(out, (0, 1), (-2, -1))
+
+
+def _chol_inv(A):
+    """(..., m, m) SPD, m a power of two ≥ _LEAF → L⁻¹ by 2×2 block
+    recursion (batched MXU matmuls at every level)."""
+    m = A.shape[-1]
+    if m <= _LEAF:
+        return _chol_inv_leaf(A)
+    h = m // 2
+    A11 = A[..., :h, :h]
+    A21 = A[..., h:, :h]
+    A22 = A[..., h:, h:]
+    L11i = _chol_inv(A11)
+    L21 = _mm(A21, _t(L11i))          # A21 · L11⁻ᵀ
+    S = A22 - _mm(L21, _t(L21))       # Schur complement
+    L22i = _chol_inv(S)
+    B = -_mm(L22i, _mm(L21, L11i))
+    zeros = jnp.zeros(A.shape[:-2] + (h, m - h), A.dtype)
+    return jnp.concatenate([
+        jnp.concatenate([L11i, zeros], axis=-1),
+        jnp.concatenate([B, L22i], axis=-1),
+    ], axis=-2)
+
+
+def chol_solve_batched(A, b):
+    """Solve the batched SPD systems ``A x = b``.
+
+    A: (..., k, k) SPD (symmetric positive definite — ALS adds a ridge),
+    b: (..., k) → x: (..., k). Any k ≥ 1; internally padded to a power
+    of two with an identity block (which factors to itself and leaves
+    the leading k×k solve untouched).
+    """
+    A = jnp.asarray(A, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    k = A.shape[-1]
+    m = _LEAF
+    while m < k:
+        m *= 2
+    if m != k:
+        pad = m - k
+        batch_pad = [(0, 0)] * (A.ndim - 2)
+        A = jnp.pad(A, batch_pad + [(0, pad), (0, pad)])
+        tail = jnp.concatenate(
+            [jnp.zeros(k, A.dtype), jnp.ones(pad, A.dtype)])
+        A = A + jnp.diag(tail)
+        b = jnp.pad(b, batch_pad + [(0, pad)])
+    Li = _chol_inv(A)
+    y = _mm(Li, b[..., None])
+    x = _mm(_t(Li), y)[..., 0]
+    return x[..., :k]
