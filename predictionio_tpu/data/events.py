@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import datetime as _dt
 import json
-import sqlite3
 import threading
 from abc import ABC, abstractmethod
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
@@ -248,29 +247,31 @@ def _ts(dt: _dt.datetime) -> int:
     return (dt - _EPOCH) // _dt.timedelta(microseconds=1)
 
 
-class SqliteEventStore(EventStore):
-    """Durable event store on SQLite.
+_EVENT_COLS = ("id", "event", "entityType", "entityId", "targetEntityType",
+               "targetEntityId", "properties", "eventTime", "eventTimeIso",
+               "tags", "prId", "creationTime", "creationTimeIso")
+
+
+class SQLEventStore(EventStore):
+    """Durable event store on any SQL engine with a registered dialect.
 
     Plays the role of the reference's JDBC event backend
-    (``pio_event_<appId>`` tables; [U] storage/jdbc/JDBCEvents.scala):
-    one table per (app, channel) namespace, indexed on eventTime and
-    entity for the two dominant scan shapes (training reads and
-    serving-time entity lookups).
+    (``pio_event_<appId>`` tables; [U] storage/jdbc/JDBCEvents.scala,
+    JDBCPEvents.scala): one table per (app, channel) namespace, indexed
+    on eventTime and entity for the two dominant scan shapes (training
+    reads and serving-time entity lookups). Engine differences
+    (paramstyle, DDL types, upsert form) live in
+    :mod:`predictionio_tpu.storage.sqldialect`.
     """
 
-    def __init__(self, path: str) -> None:
-        self._path = path
-        self._local = threading.local()
+    def __init__(self, dialect) -> None:
+        self._d = dialect
+        self._conns = dialect.thread_conns()
         self._lock = threading.RLock()
+        self._known: set = set()  # namespaces whose DDL already ran
 
-    def _conn(self) -> sqlite3.Connection:
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            conn = sqlite3.connect(self._path, timeout=30.0)
-            conn.execute("PRAGMA journal_mode=WAL")
-            conn.execute("PRAGMA synchronous=NORMAL")
-            self._local.conn = conn
-        return conn
+    def _conn(self):
+        return self._conns.get()
 
     @staticmethod
     def _table(app_id: int, channel_id: Optional[int]) -> str:
@@ -278,36 +279,41 @@ class SqliteEventStore(EventStore):
 
     def init_channel(self, app_id: int, channel_id: Optional[int] = None) -> None:
         t = self._table(app_id, channel_id)
+        d = self._d
         c = self._conn()
         with self._lock:
-            c.execute(
+            if (t, id(c)) in self._known:
+                return
+            c.cursor().execute(
                 f"""CREATE TABLE IF NOT EXISTS {t} (
-                    id TEXT PRIMARY KEY,
-                    event TEXT NOT NULL,
-                    entityType TEXT NOT NULL,
-                    entityId TEXT NOT NULL,
-                    targetEntityType TEXT,
-                    targetEntityId TEXT,
+                    id {d.key_type} PRIMARY KEY,
+                    event {d.str_type} NOT NULL,
+                    entityType {d.str_type} NOT NULL,
+                    entityId {d.str_type} NOT NULL,
+                    targetEntityType {d.str_type},
+                    targetEntityId {d.str_type},
                     properties TEXT NOT NULL,
-                    eventTime INTEGER NOT NULL,
+                    eventTime BIGINT NOT NULL,
                     eventTimeIso TEXT NOT NULL,
                     tags TEXT NOT NULL,
-                    prId TEXT,
-                    creationTime INTEGER NOT NULL,
+                    prId {d.str_type},
+                    creationTime BIGINT NOT NULL,
                     creationTimeIso TEXT NOT NULL
                 )"""
             )
-            c.execute(f"CREATE INDEX IF NOT EXISTS {t}_time ON {t}(eventTime)")
-            c.execute(f"CREATE INDEX IF NOT EXISTS {t}_entity ON {t}(entityType, entityId)")
-            c.execute(f"CREATE INDEX IF NOT EXISTS {t}_name ON {t}(event)")
+            d.create_index(c, f"{t}_time", t, "eventTime")
+            d.create_index(c, f"{t}_entity", t, "entityType, entityId")
+            d.create_index(c, f"{t}_name", t, "event")
             c.commit()
+            self._known.add((t, id(c)))
 
     def remove_channel(self, app_id: int, channel_id: Optional[int] = None) -> None:
         t = self._table(app_id, channel_id)
         c = self._conn()
         with self._lock:
-            c.execute(f"DROP TABLE IF EXISTS {t}")
+            c.cursor().execute(f"DROP TABLE IF EXISTS {t}")
             c.commit()
+            self._known = {k for k in self._known if k[0] != t}
 
     def _row(self, event: Event) -> Tuple:
         return (
@@ -340,15 +346,14 @@ class SqliteEventStore(EventStore):
             e = e.with_id()
             rows.append(self._row(e))
             ids.append(e.event_id)
+        self.init_channel(app_id, channel_id)
         c = self._conn()
         with self._lock:
-            self.init_channel(app_id, channel_id)
-            # OR REPLACE: re-inserting an existing eventId overwrites, the
+            # upsert: re-inserting an existing eventId overwrites, the
             # put semantics of the reference's HBase backend — makes
             # `pio import` of a previously exported dump idempotent
-            c.executemany(
-                f"INSERT OR REPLACE INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
-                rows)
+            c.cursor().executemany(
+                self._d.sql(self._d.upsert(t, _EVENT_COLS, "id")), rows)
             c.commit()
         return ids  # type: ignore[return-value]
 
@@ -370,11 +375,20 @@ class SqliteEventStore(EventStore):
 
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
         t = self._table(app_id, channel_id)
+        c = self._conn()
+        cols = ",".join(_EVENT_COLS)
         try:
-            cur = self._conn().execute(f"SELECT * FROM {t} WHERE id=?", (event_id,))
-        except sqlite3.OperationalError:
+            cur = c.cursor()
+            cur.execute(self._d.sql(f"SELECT {cols} FROM {t} WHERE id=?"),
+                        (event_id,))
+            row = cur.fetchone()
+            c.commit()  # end the read transaction (see find())
+        except self._d.missing_table_errors:
+            self._d.recover(c)
             return None
-        row = cur.fetchone()
+        except Exception:
+            self._d.recover(c)
+            raise
         return self._event_from_row(row) if row else None
 
     def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
@@ -382,8 +396,11 @@ class SqliteEventStore(EventStore):
         c = self._conn()
         with self._lock:
             try:
-                cur = c.execute(f"DELETE FROM {t} WHERE id=?", (event_id,))
-            except sqlite3.OperationalError:
+                cur = c.cursor()
+                cur.execute(self._d.sql(f"DELETE FROM {t} WHERE id=?"),
+                            (event_id,))
+            except self._d.missing_table_errors:
+                self._d.recover(c)
                 return False
             c.commit()
         return cur.rowcount > 0
@@ -393,8 +410,9 @@ class SqliteEventStore(EventStore):
         c = self._conn()
         with self._lock:
             try:
-                c.execute(f"DELETE FROM {t}")
-            except sqlite3.OperationalError:
+                c.cursor().execute(f"DELETE FROM {t}")
+            except self._d.missing_table_errors:
+                self._d.recover(c)
                 return
             c.commit()
 
@@ -438,9 +456,47 @@ class SqliteEventStore(EventStore):
         where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
         order = "DESC" if reversed else "ASC"
         lim = f" LIMIT {int(limit)}" if (limit is not None and limit >= 0) else ""
-        sql = f"SELECT * FROM {t}{where} ORDER BY eventTime {order}, creationTime {order}{lim}"
+        cols = ",".join(_EVENT_COLS)
+        sql = (f"SELECT {cols} FROM {t}{where} "
+               f"ORDER BY eventTime {order}, creationTime {order}{lim}")
+        c = self._conn()
         try:
-            cur = self._conn().execute(sql, args)
-        except sqlite3.OperationalError:
+            cur = c.cursor()
+            cur.execute(self._d.sql(sql), args)
+        except self._d.missing_table_errors:
+            self._d.recover(c)
             return iter(())
-        return (self._event_from_row(r) for r in cur)
+        except Exception:
+            self._d.recover(c)
+            raise
+
+        def stream():
+            # stream in batches (a training read must not materialize
+            # the whole table), then COMMIT to end the read transaction
+            # — server engines otherwise pin a stale snapshot (MySQL
+            # REPEATABLE READ) or sit idle-in-transaction (PostgreSQL)
+            # on this thread's cached connection forever
+            try:
+                while True:
+                    rows = cur.fetchmany(1024)
+                    if not rows:
+                        break
+                    for r in rows:
+                        yield self._event_from_row(r)
+            finally:
+                try:
+                    c.commit()
+                except Exception:
+                    self._d.recover(c)
+
+        return stream()
+
+
+class SqliteEventStore(SQLEventStore):
+    """SQLite-backed event store (the default durable backend)."""
+
+    def __init__(self, path: str) -> None:
+        from predictionio_tpu.storage.sqldialect import SqliteDialect
+
+        super().__init__(SqliteDialect(path))
+        self._path = path

@@ -14,7 +14,7 @@ from __future__ import annotations
 import datetime as _dt
 import json
 import secrets
-import sqlite3
+
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -77,118 +77,176 @@ class EvaluationInstance:
     evaluator_results_json: str = ""   # structured per-candidate scores
 
 
-_SCHEMA = """
-CREATE TABLE IF NOT EXISTS apps (
-    id INTEGER PRIMARY KEY AUTOINCREMENT,
-    name TEXT UNIQUE NOT NULL,
-    description TEXT NOT NULL DEFAULT ''
-);
-CREATE TABLE IF NOT EXISTS access_keys (
-    key TEXT PRIMARY KEY,
-    appid INTEGER NOT NULL,
-    events TEXT NOT NULL DEFAULT '[]'
-);
-CREATE TABLE IF NOT EXISTS channels (
-    id INTEGER PRIMARY KEY AUTOINCREMENT,
-    name TEXT NOT NULL,
-    appid INTEGER NOT NULL,
-    UNIQUE(name, appid)
-);
-CREATE TABLE IF NOT EXISTS engine_instances (
-    id TEXT PRIMARY KEY,
-    status TEXT NOT NULL,
-    startTime TEXT NOT NULL,
-    endTime TEXT,
-    engineFactory TEXT NOT NULL,
-    engineVariant TEXT NOT NULL DEFAULT '',
-    batch TEXT NOT NULL DEFAULT '',
-    env TEXT NOT NULL DEFAULT '{}',
-    meshConf TEXT NOT NULL DEFAULT '{}',
-    dataSourceParams TEXT NOT NULL DEFAULT '{}',
-    preparatorParams TEXT NOT NULL DEFAULT '{}',
-    algorithmsParams TEXT NOT NULL DEFAULT '[]',
-    servingParams TEXT NOT NULL DEFAULT '{}'
-);
-CREATE TABLE IF NOT EXISTS evaluation_instances (
-    id TEXT PRIMARY KEY,
-    status TEXT NOT NULL,
-    startTime TEXT NOT NULL,
-    endTime TEXT,
-    evaluationClass TEXT NOT NULL,
-    engineParamsGeneratorClass TEXT NOT NULL DEFAULT '',
-    batch TEXT NOT NULL DEFAULT '',
-    env TEXT NOT NULL DEFAULT '{}',
-    evaluatorResults TEXT NOT NULL DEFAULT '',
-    evaluatorResultsHTML TEXT NOT NULL DEFAULT '',
-    evaluatorResultsJSON TEXT NOT NULL DEFAULT ''
-);
-"""
+def _schema(d) -> List[str]:
+    """Per-dialect DDL: autoincrement spelling and index-able string
+    types come from the dialect (MySQL cannot PK/UNIQUE a bare TEXT)."""
+    return [
+        f"""CREATE TABLE IF NOT EXISTS apps (
+            id {d.autoinc_pk},
+            name {d.str_type} UNIQUE NOT NULL,
+            description TEXT NOT NULL
+        )""",
+        f"""CREATE TABLE IF NOT EXISTS access_keys (
+            accesskey {d.key_type} PRIMARY KEY,
+            appid INTEGER NOT NULL,
+            events TEXT NOT NULL
+        )""",
+        f"""CREATE TABLE IF NOT EXISTS channels (
+            id {d.autoinc_pk},
+            name {d.str_type} NOT NULL,
+            appid INTEGER NOT NULL,
+            UNIQUE(name, appid)
+        )""",
+        f"""CREATE TABLE IF NOT EXISTS engine_instances (
+            id {d.key_type} PRIMARY KEY,
+            status TEXT NOT NULL,
+            startTime TEXT NOT NULL,
+            endTime TEXT,
+            engineFactory TEXT NOT NULL,
+            engineVariant TEXT NOT NULL,
+            batch TEXT NOT NULL,
+            env TEXT NOT NULL,
+            meshConf TEXT NOT NULL,
+            dataSourceParams TEXT NOT NULL,
+            preparatorParams TEXT NOT NULL,
+            algorithmsParams TEXT NOT NULL,
+            servingParams TEXT NOT NULL
+        )""",
+        f"""CREATE TABLE IF NOT EXISTS evaluation_instances (
+            id {d.key_type} PRIMARY KEY,
+            status TEXT NOT NULL,
+            startTime TEXT NOT NULL,
+            endTime TEXT,
+            evaluationClass TEXT NOT NULL,
+            engineParamsGeneratorClass TEXT NOT NULL,
+            batch TEXT NOT NULL,
+            env TEXT NOT NULL,
+            evaluatorResults TEXT NOT NULL,
+            evaluatorResultsHTML TEXT NOT NULL,
+            evaluatorResultsJSON TEXT NOT NULL
+        )""",
+    ]
+
+
+_EI_COLS = ("id", "status", "startTime", "endTime", "engineFactory",
+            "engineVariant", "batch", "env", "meshConf", "dataSourceParams",
+            "preparatorParams", "algorithmsParams", "servingParams")
+_VI_COLS = ("id", "status", "startTime", "endTime", "evaluationClass",
+            "engineParamsGeneratorClass", "batch", "env", "evaluatorResults",
+            "evaluatorResultsHTML", "evaluatorResultsJSON")
 
 
 class MetaStore:
-    """SQLite-backed meta store (also supports ':memory:' for tests)."""
+    """SQL-backed meta store. Defaults to SQLite (':memory:' for tests);
+    any :mod:`predictionio_tpu.storage.sqldialect` dialect (PGSQL/MYSQL)
+    plugs in via ``dialect=`` — the JDBC-meta-repos parity path."""
 
-    def __init__(self, path: str = ":memory:") -> None:
+    def __init__(self, path: str = ":memory:", dialect=None) -> None:
+        from predictionio_tpu.storage.sqldialect import SqliteDialect
+
         self._path = path
+        self._d = dialect if dialect is not None else SqliteDialect(path)
+        self._conns = self._d.thread_conns()
         self._lock = threading.RLock()
-        # ':memory:' must share one connection; files get per-thread conns.
-        self._memory_conn: Optional[sqlite3.Connection] = None
-        self._local = threading.local()
-        if path == ":memory:":
-            self._memory_conn = sqlite3.connect(path, check_same_thread=False)
         self._init_schema()
 
-    def _conn(self) -> sqlite3.Connection:
-        if self._memory_conn is not None:
-            return self._memory_conn
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            conn = sqlite3.connect(self._path, timeout=30.0)
-            conn.execute("PRAGMA journal_mode=WAL")
-            self._local.conn = conn
-        return conn
+    def _conn(self):
+        return self._conns.get()
+
+    def _sql(self, q: str) -> str:
+        return self._d.sql(q)
 
     def _init_schema(self) -> None:
         with self._lock:
-            self._conn().executescript(_SCHEMA)
-            self._conn().commit()
+            c = self._conn()
+            cur = c.cursor()
+            for stmt in _schema(self._d):
+                cur.execute(stmt)
+            c.commit()
+
+    # -- statement helpers -----------------------------------------------------
+    #
+    # Reads COMMIT too: server engines run every statement inside a
+    # transaction on the cached per-thread connection — without ending
+    # it, MySQL REPEATABLE READ pins a snapshot forever (stale reads)
+    # and PostgreSQL sits idle-in-transaction. Any failure rolls the
+    # connection back so it stays usable (PostgreSQL aborts the open
+    # transaction on error).
+
+    def _q(self, q: str, args: tuple = ()) -> List[tuple]:
+        c = self._conn()
+        try:
+            cur = c.cursor()
+            cur.execute(self._sql(q), args)
+            rows = cur.fetchall()
+            c.commit()
+            return rows
+        except Exception:
+            self._d.recover(c)
+            raise
+
+    def _q1(self, q: str, args: tuple = ()) -> Optional[tuple]:
+        rows = self._q(q, args)
+        return rows[0] if rows else None
+
+    def _x(self, q: str, args: tuple = ()) -> int:
+        with self._lock:
+            c = self._conn()
+            try:
+                cur = c.cursor()
+                cur.execute(self._sql(q), args)
+                c.commit()
+                return cur.rowcount
+            except Exception:
+                self._d.recover(c)
+                raise
 
     # -- apps ------------------------------------------------------------------
 
     def create_app(self, name: str, description: str = "") -> App:
         with self._lock:
             c = self._conn()
-            cur = c.execute(
-                "INSERT INTO apps(name, description) VALUES (?,?)", (name, description)
-            )
-            c.commit()
-            assert cur.lastrowid is not None
-            return App(id=cur.lastrowid, name=name, description=description)
+            try:
+                rid = self._d.insert_returning_id(
+                    c, "INSERT INTO apps(name, description) VALUES (?,?)",
+                    (name, description))
+                c.commit()
+            except Exception:
+                self._d.recover(c)  # duplicate-name race must not poison
+                raise               # this thread's cached connection
+            return App(id=rid, name=name, description=description)
 
     def get_app(self, app_id: int) -> Optional[App]:
-        row = self._conn().execute(
-            "SELECT id,name,description FROM apps WHERE id=?", (app_id,)
-        ).fetchone()
+        row = self._q1("SELECT id,name,description FROM apps WHERE id=?",
+                       (app_id,))
         return App(*row) if row else None
 
     def get_app_by_name(self, name: str) -> Optional[App]:
-        row = self._conn().execute(
-            "SELECT id,name,description FROM apps WHERE name=?", (name,)
-        ).fetchone()
+        row = self._q1("SELECT id,name,description FROM apps WHERE name=?",
+                       (name,))
         return App(*row) if row else None
 
     def list_apps(self) -> List[App]:
-        return [App(*r) for r in self._conn().execute(
+        return [App(*r) for r in self._q(
             "SELECT id,name,description FROM apps ORDER BY id")]
 
     def delete_app(self, app_id: int) -> bool:
         with self._lock:
             c = self._conn()
-            cur = c.execute("DELETE FROM apps WHERE id=?", (app_id,))
-            c.execute("DELETE FROM access_keys WHERE appid=?", (app_id,))
-            c.execute("DELETE FROM channels WHERE appid=?", (app_id,))
-            c.commit()
-            return cur.rowcount > 0
+            try:
+                cur = c.cursor()
+                cur.execute(self._sql("DELETE FROM apps WHERE id=?"),
+                            (app_id,))
+                existed = cur.rowcount > 0
+                cur.execute(self._sql("DELETE FROM access_keys WHERE appid=?"),
+                            (app_id,))
+                cur.execute(self._sql("DELETE FROM channels WHERE appid=?"),
+                            (app_id,))
+                c.commit()
+            except Exception:
+                self._d.recover(c)
+                raise
+            return existed
 
     # -- access keys -----------------------------------------------------------
 
@@ -196,81 +254,72 @@ class MetaStore:
         self, app_id: int, events: Optional[List[str]] = None, key: Optional[str] = None
     ) -> AccessKey:
         key = key or secrets.token_urlsafe(48)
-        with self._lock:
-            c = self._conn()
-            c.execute(
-                "INSERT INTO access_keys(key, appid, events) VALUES (?,?,?)",
-                (key, app_id, json.dumps(events or [])),
-            )
-            c.commit()
+        self._x("INSERT INTO access_keys(accesskey, appid, events) VALUES (?,?,?)",
+                (key, app_id, json.dumps(events or [])))
         return AccessKey(key=key, app_id=app_id, events=events or [])
 
     def get_access_key(self, key: str) -> Optional[AccessKey]:
-        row = self._conn().execute(
-            "SELECT key,appid,events FROM access_keys WHERE key=?", (key,)
-        ).fetchone()
+        row = self._q1(
+            "SELECT accesskey,appid,events FROM access_keys "
+            "WHERE accesskey=?", (key,))
         return AccessKey(row[0], row[1], json.loads(row[2])) if row else None
 
     def list_access_keys(self, app_id: Optional[int] = None) -> List[AccessKey]:
         if app_id is None:
-            rows = self._conn().execute("SELECT key,appid,events FROM access_keys")
+            rows = self._q("SELECT accesskey,appid,events FROM access_keys")
         else:
-            rows = self._conn().execute(
-                "SELECT key,appid,events FROM access_keys WHERE appid=?", (app_id,))
+            rows = self._q(
+                "SELECT accesskey,appid,events FROM access_keys WHERE appid=?",
+                (app_id,))
         return [AccessKey(r[0], r[1], json.loads(r[2])) for r in rows]
 
     def delete_access_key(self, key: str) -> bool:
-        with self._lock:
-            c = self._conn()
-            cur = c.execute("DELETE FROM access_keys WHERE key=?", (key,))
-            c.commit()
-            return cur.rowcount > 0
+        return self._x("DELETE FROM access_keys WHERE accesskey=?",
+                       (key,)) > 0
 
     # -- channels --------------------------------------------------------------
 
     def create_channel(self, app_id: int, name: str) -> Channel:
         with self._lock:
             c = self._conn()
-            cur = c.execute(
-                "INSERT INTO channels(name, appid) VALUES (?,?)", (name, app_id))
-            c.commit()
-            assert cur.lastrowid is not None
-            return Channel(id=cur.lastrowid, name=name, app_id=app_id)
+            try:
+                rid = self._d.insert_returning_id(
+                    c, "INSERT INTO channels(name, appid) VALUES (?,?)",
+                    (name, app_id))
+                c.commit()
+            except Exception:
+                self._d.recover(c)
+                raise
+            return Channel(id=rid, name=name, app_id=app_id)
 
     def get_channel_by_name(self, app_id: int, name: str) -> Optional[Channel]:
-        row = self._conn().execute(
+        row = self._q1(
             "SELECT id,name,appid FROM channels WHERE appid=? AND name=?",
-            (app_id, name)).fetchone()
+            (app_id, name))
         return Channel(*row) if row else None
 
     def list_channels(self, app_id: int) -> List[Channel]:
-        return [Channel(*r) for r in self._conn().execute(
-            "SELECT id,name,appid FROM channels WHERE appid=? ORDER BY id", (app_id,))]
+        return [Channel(*r) for r in self._q(
+            "SELECT id,name,appid FROM channels WHERE appid=? ORDER BY id",
+            (app_id,))]
 
     def delete_channel(self, channel_id: int) -> bool:
-        with self._lock:
-            c = self._conn()
-            cur = c.execute("DELETE FROM channels WHERE id=?", (channel_id,))
-            c.commit()
-            return cur.rowcount > 0
+        return self._x("DELETE FROM channels WHERE id=?", (channel_id,)) > 0
 
     # -- engine instances ------------------------------------------------------
 
     def insert_engine_instance(self, ei: EngineInstance) -> None:
-        with self._lock:
-            c = self._conn()
-            c.execute(
-                "INSERT OR REPLACE INTO engine_instances VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
-                (
-                    ei.id, ei.status, format_event_time(ei.start_time),
-                    format_event_time(ei.end_time) if ei.end_time else None,
-                    ei.engine_factory, ei.engine_variant, ei.batch,
-                    json.dumps(ei.env), json.dumps(ei.mesh_conf),
-                    ei.data_source_params, ei.preparator_params,
-                    ei.algorithms_params, ei.serving_params,
-                ),
-            )
-            c.commit()
+        self._x(
+            self._d.upsert("engine_instances", _EI_COLS, "id"),
+            (
+                ei.id, ei.status, format_event_time(ei.start_time),
+                format_event_time(ei.end_time) if ei.end_time else None,
+                ei.engine_factory, ei.engine_variant, ei.batch,
+                json.dumps(ei.env), json.dumps(ei.mesh_conf),
+                ei.data_source_params, ei.preparator_params,
+                ei.algorithms_params, ei.serving_params,
+            ),
+        )
 
     @staticmethod
     def _ei_from_row(r) -> EngineInstance:
@@ -285,8 +334,9 @@ class MetaStore:
         )
 
     def get_engine_instance(self, instance_id: str) -> Optional[EngineInstance]:
-        row = self._conn().execute(
-            "SELECT * FROM engine_instances WHERE id=?", (instance_id,)).fetchone()
+        row = self._q1(
+            f"SELECT {','.join(_EI_COLS)} FROM engine_instances WHERE id=?",
+            (instance_id,))
         return self._ei_from_row(row) if row else None
 
     def update_engine_instance(self, ei: EngineInstance) -> None:
@@ -297,36 +347,34 @@ class MetaStore:
     ) -> Optional[EngineInstance]:
         """Reference semantics: deploy loads the latest COMPLETED instance
         for (engineFactory, variant) ([U] EngineInstances.getLatestCompleted)."""
-        q = ("SELECT * FROM engine_instances WHERE status='COMPLETED' "
-             "AND engineFactory=?")
+        q = (f"SELECT {','.join(_EI_COLS)} FROM engine_instances "
+             "WHERE status='COMPLETED' AND engineFactory=?")
         args: List[Any] = [engine_factory]
         if engine_variant:
             q += " AND engineVariant=?"
             args.append(engine_variant)
         q += " ORDER BY startTime DESC LIMIT 1"
-        row = self._conn().execute(q, args).fetchone()
+        row = self._q1(q, tuple(args))
         return self._ei_from_row(row) if row else None
 
     def list_engine_instances(self) -> List[EngineInstance]:
-        return [self._ei_from_row(r) for r in self._conn().execute(
-            "SELECT * FROM engine_instances ORDER BY startTime DESC")]
+        return [self._ei_from_row(r) for r in self._q(
+            f"SELECT {','.join(_EI_COLS)} FROM engine_instances "
+            "ORDER BY startTime DESC")]
 
     # -- evaluation instances --------------------------------------------------
 
     def insert_evaluation_instance(self, vi: EvaluationInstance) -> None:
-        with self._lock:
-            c = self._conn()
-            c.execute(
-                "INSERT OR REPLACE INTO evaluation_instances VALUES (?,?,?,?,?,?,?,?,?,?,?)",
-                (
-                    vi.id, vi.status, format_event_time(vi.start_time),
-                    format_event_time(vi.end_time) if vi.end_time else None,
-                    vi.evaluation_class, vi.engine_params_generator_class,
-                    vi.batch, json.dumps(vi.env), vi.evaluator_results,
-                    vi.evaluator_results_html, vi.evaluator_results_json,
-                ),
-            )
-            c.commit()
+        self._x(
+            self._d.upsert("evaluation_instances", _VI_COLS, "id"),
+            (
+                vi.id, vi.status, format_event_time(vi.start_time),
+                format_event_time(vi.end_time) if vi.end_time else None,
+                vi.evaluation_class, vi.engine_params_generator_class,
+                vi.batch, json.dumps(vi.env), vi.evaluator_results,
+                vi.evaluator_results_html, vi.evaluator_results_json,
+            ),
+        )
 
     @staticmethod
     def _vi_from_row(r) -> EvaluationInstance:
@@ -340,16 +388,18 @@ class MetaStore:
         )
 
     def get_evaluation_instance(self, instance_id: str) -> Optional[EvaluationInstance]:
-        row = self._conn().execute(
-            "SELECT * FROM evaluation_instances WHERE id=?", (instance_id,)).fetchone()
+        row = self._q1(
+            f"SELECT {','.join(_VI_COLS)} FROM evaluation_instances "
+            "WHERE id=?", (instance_id,))
         return self._vi_from_row(row) if row else None
 
     def update_evaluation_instance(self, vi: EvaluationInstance) -> None:
         self.insert_evaluation_instance(vi)
 
     def list_evaluation_instances(self) -> List[EvaluationInstance]:
-        return [self._vi_from_row(r) for r in self._conn().execute(
-            "SELECT * FROM evaluation_instances ORDER BY startTime DESC")]
+        return [self._vi_from_row(r) for r in self._q(
+            f"SELECT {','.join(_VI_COLS)} FROM evaluation_instances "
+            "ORDER BY startTime DESC")]
 
     def new_instance_id(self) -> str:
         return utcnow().strftime("%Y%m%d%H%M%S") + "-" + secrets.token_hex(4)

@@ -57,6 +57,71 @@ class MemoryModelStore(ModelStore):
         return sorted(self._blobs)
 
 
+class SQLModelStore(ModelStore):
+    """Model blobs in a SQL table (reference: [U] storage/jdbc/
+    JDBCModels.scala — ``pio_model_data`` with a blob column). Works
+    with any :mod:`predictionio_tpu.storage.sqldialect` dialect; used
+    by the PGSQL/MYSQL sources so a pure-SQL deployment needs no shared
+    filesystem for models."""
+
+    _TABLE = "pio_model_data"
+
+    def __init__(self, dialect) -> None:
+        self._d = dialect
+        self._conns = dialect.thread_conns()
+        self._lock = threading.Lock()
+        c = self._conns.get()
+        c.cursor().execute(
+            f"""CREATE TABLE IF NOT EXISTS {self._TABLE} (
+                id {dialect.key_type} PRIMARY KEY,
+                model {dialect.blob_type} NOT NULL
+            )""")
+        c.commit()
+
+    def put(self, instance_id: str, blob: bytes) -> None:
+        with self._lock:
+            c = self._conns.get()
+            c.cursor().execute(
+                self._d.sql(self._d.upsert(self._TABLE, ("id", "model"), "id")),
+                (instance_id, self._d.binary(blob)))
+            c.commit()
+
+    def get(self, instance_id: str) -> Optional[bytes]:
+        c = self._conns.get()
+        try:
+            cur = c.cursor()
+            cur.execute(self._d.sql(
+                f"SELECT model FROM {self._TABLE} WHERE id=?"),
+                (instance_id,))
+            row = cur.fetchone()
+            c.commit()  # end the read transaction on server engines
+        except Exception:
+            self._d.recover(c)
+            raise
+        return bytes(row[0]) if row else None
+
+    def delete(self, instance_id: str) -> bool:
+        with self._lock:
+            c = self._conns.get()
+            cur = c.cursor()
+            cur.execute(self._d.sql(
+                f"DELETE FROM {self._TABLE} WHERE id=?"), (instance_id,))
+            c.commit()
+            return cur.rowcount > 0
+
+    def list_ids(self) -> List[str]:
+        c = self._conns.get()
+        try:
+            cur = c.cursor()
+            cur.execute(f"SELECT id FROM {self._TABLE} ORDER BY id")
+            rows = cur.fetchall()
+            c.commit()
+        except Exception:
+            self._d.recover(c)
+            raise
+        return [r[0] for r in rows]
+
+
 class LocalFSModelStore(ModelStore):
     """Blobs under ``<root>/<instance_id>/model.bin`` (reference default:
     ``~/.pio_store/models``); the per-instance directory doubles as the

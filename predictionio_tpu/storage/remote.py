@@ -1,14 +1,13 @@
-"""Network storage backends: S3 / HDFS model stores, gated SQL servers.
+"""Network storage backends: S3 / HDFS model stores, SQL servers.
 
 The reference shipped six network backends (HBase, JDBC, Elasticsearch,
-HDFS, LocalFS, S3 — SURVEY.md §2a); this environment has no network
-services or drivers, so these register their TYPE names with factories
-that bind lazily: the S3 and HDFS model stores are full implementations
-that connect when their driver (boto3 / pyarrow+libhdfs) is present and
-raise :class:`StorageClientError` with install instructions when not;
-the PostgreSQL/MySQL event+meta types are gated the same way at
-registration (their SQL dialects ride the SQLite implementations'
-schema once a DB-API driver exists).
+HDFS, LocalFS, S3 — SURVEY.md §2a). These register their TYPE names
+with factories that bind lazily: each store is a full implementation
+that connects when its driver (boto3 / pyarrow+libhdfs / psycopg2 /
+pymysql) is present and raises :class:`StorageClientError` with install
+instructions when not. The PGSQL/MYSQL types run the shared SQL store
+implementations (events, meta, model blobs) on their engine's dialect —
+see :mod:`predictionio_tpu.storage.sqldialect`.
 
 Config (same env scheme as every backend, reference pio-env.sh names):
 
@@ -168,25 +167,19 @@ class HDFSModelStore(ModelStore):
                 if i.base_name.endswith(".bin")]
 
 
-def _sql_server_gate(type_name: str, driver: str, pip_name: str):
-    def factory(cfg):
-        try:
-            __import__(driver)
-        except ImportError as e:
-            raise StorageClientError(
-                f"storage type {type_name} requires the {driver} driver "
-                f"(pip install {pip_name}); with no SQL-server driver in "
-                "this environment use SQLITE (same schema, single file) or "
-                "EVENTLOG (native engine)") from e
-        raise StorageClientError(  # pragma: no cover - needs the driver
-            f"{type_name} driver found but server-backed stores are not "
-            "wired in this build; see predictionio_tpu/storage/remote.py")
+def _sql_dialect(type_name: str, cfg, repo: str):
+    """Dialect for a SQL-server source; raises StorageClientError with
+    install instructions when the DB-API driver is absent."""
+    from predictionio_tpu.storage.sqldialect import dialect_for
 
-    return factory
+    return dialect_for(type_name, cfg.source_properties(repo), "")
 
 
 def register_all() -> None:
     from predictionio_tpu.storage import registry as reg
+    from predictionio_tpu.data.events import SQLEventStore
+    from predictionio_tpu.storage.meta import MetaStore
+    from predictionio_tpu.storage.models import SQLModelStore
 
     reg.register_model_backend(
         "S3", lambda cfg: S3ModelStore(
@@ -194,11 +187,17 @@ def register_all() -> None:
     reg.register_model_backend(
         "HDFS", lambda cfg: HDFSModelStore(
             props=cfg.source_properties("MODELDATA")))
-    # the reference's pio-env idiom points METADATA and EVENTDATA at the
-    # same SQL source — gate both repositories
-    pg = _sql_server_gate("PGSQL", "psycopg2", "psycopg2-binary")
-    my = _sql_server_gate("MYSQL", "pymysql", "pymysql")
-    reg.register_event_backend("PGSQL", pg)
-    reg.register_event_backend("MYSQL", my)
-    reg.register_meta_backend("PGSQL", pg)
-    reg.register_meta_backend("MYSQL", my)
+    # SQL-server backends (reference: [U] storage/jdbc/ — every repo type
+    # on PostgreSQL/MySQL). The shared SQL store implementations run on
+    # the engine's dialect; the reference's pio-env idiom points all
+    # three repositories at the same SQL source.
+    for t in ("PGSQL", "MYSQL"):
+        reg.register_event_backend(
+            t, lambda cfg, _t=t: SQLEventStore(
+                _sql_dialect(_t, cfg, "EVENTDATA")))
+        reg.register_meta_backend(
+            t, lambda cfg, _t=t: MetaStore(
+                dialect=_sql_dialect(_t, cfg, "METADATA")))
+        reg.register_model_backend(
+            t, lambda cfg, _t=t: SQLModelStore(
+                _sql_dialect(_t, cfg, "MODELDATA")))

@@ -23,12 +23,19 @@ def _native_store(tmp_path):
         pytest.skip(str(e))
 
 
-@pytest.fixture(params=["memory", "sqlite", "eventlog"])
+@pytest.fixture(params=["memory", "sqlite", "format_sql", "eventlog"])
 def store(request, tmp_path):
     if request.param == "memory":
         yield MemoryEventStore()
     elif request.param == "sqlite":
         yield SqliteEventStore(str(tmp_path / "events.db"))
+    elif request.param == "format_sql":
+        # server-driver paramstyle (%s) through the dialect layer — the
+        # SPI contract run the PGSQL/MYSQL stores would get
+        from predictionio_tpu.data.events import SQLEventStore
+        from tests.test_sqldialect import FormatSqliteDialect
+
+        yield SQLEventStore(FormatSqliteDialect(str(tmp_path / "f.db")))
     else:
         s = _native_store(tmp_path)
         yield s
