@@ -103,7 +103,23 @@ class WorkflowContext:
     # (SURVEY.md §5 "per-phase timing log")
     timings: Dict[str, float] = field(default_factory=dict)
     instance_id: str = ""
+    # mid-train checkpoint/resume (SURVEY.md §5): run_train points this
+    # at a per-(factory, variant) directory; iterative algorithms ask
+    # for a named sub-checkpointer and save every N steps. On --resume
+    # the directory is kept and restore-latest continues the run.
+    checkpoint_dir: Optional[str] = None
 
     def log(self, msg: str) -> None:
         if self.verbose:
             print(f"[workflow {self.instance_id or '-'}] {msg}", flush=True)
+
+    def checkpointer(self, name: str):
+        """A TrainCheckpointer under ``checkpoint_dir/name`` (None when
+        checkpointing is off for this run)."""
+        if not self.checkpoint_dir:
+            return None
+        import os
+
+        from predictionio_tpu.utils.checkpoint import TrainCheckpointer
+
+        return TrainCheckpointer(os.path.join(self.checkpoint_dir, name))

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import shutil
 import traceback
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -49,13 +50,21 @@ def _build_context(
     verbose: int,
     instance_id: str,
     use_mesh: bool,
+    checkpoint_dir: Optional[str] = None,
 ) -> WorkflowContext:
     mesh = None
     if use_mesh:
         mesh = make_mesh(MeshConfig.from_json(mesh_conf))
     return WorkflowContext(
-        storage=storage, mesh=mesh, verbose=verbose, instance_id=instance_id
+        storage=storage, mesh=mesh, verbose=verbose, instance_id=instance_id,
+        checkpoint_dir=checkpoint_dir,
     )
+
+
+def _ckpt_root(storage: Storage, engine_factory: str, variant_id: str) -> str:
+    safe = "".join(ch if ch.isalnum() else "_"
+                   for ch in f"{engine_factory}_{variant_id}")
+    return os.path.join(storage.config.home, "train_ckpt", safe)
 
 
 def run_train(
@@ -67,12 +76,27 @@ def run_train(
     verbose: int = 0,
     use_mesh: bool = True,
     batch: str = "",
+    resume: bool = False,
 ) -> str:
     """Train and persist one engine instance; returns its id.
 
     Exactly one of ``variant``/``variant_path``/``engine_params`` supplies
-    parameters (variant = parsed engine.json dict).
+    parameters (variant = parsed engine.json dict). ``resume=True``
+    (``pio train --resume``) keeps the per-(factory, variant) checkpoint
+    directory from an interrupted run so iterative trainers restore the
+    latest mid-train checkpoint and continue; by default a fresh run
+    clears it (SURVEY.md §5 checkpoint/resume).
     """
+    from predictionio_tpu.parallel import distributed
+
+    # Multi-host (SURVEY.md §2d P5): when the PIO_* rendezvous vars are
+    # set (or a Cloud-TPU slice announces itself), every host runs this
+    # same function in lockstep — jax.distributed rendezvous here, the
+    # coordinator mints the instance id and owns all meta/model writes,
+    # barriers keep hosts aligned around training.
+    multi = distributed.initialize()
+    coord = distributed.is_coordinator()
+
     storage = storage or get_storage()
     engine = EngineFactory.create(engine_factory)
     if variant_path is not None:
@@ -81,7 +105,9 @@ def run_train(
     if engine_params is None:
         engine_params = engine.params_from_variant(variant)
 
-    instance_id = storage.meta.new_instance_id()
+    instance_id = storage.meta.new_instance_id() if coord else ""
+    if multi:
+        instance_id = distributed.broadcast_string(instance_id)
     mesh_conf = variant.get("meshConf") or variant.get("sparkConf") or {}
     ei = EngineInstance(
         id=instance_id,
@@ -98,11 +124,19 @@ def run_train(
         algorithms_params=_algorithms_params_json(engine_params),
         serving_params=json.dumps(params_to_json(engine_params.serving_params)),
     )
-    storage.meta.insert_engine_instance(ei)
-    ctx = _build_context(storage, mesh_conf, verbose, instance_id, use_mesh)
+    if coord:
+        storage.meta.insert_engine_instance(ei)
+    ckpt_root = _ckpt_root(storage, engine_factory, ei.engine_variant)
+    if coord and not resume:
+        shutil.rmtree(ckpt_root, ignore_errors=True)
+    if multi:
+        distributed.barrier("pio_ckpt_ready")
+    ctx = _build_context(storage, mesh_conf, verbose, instance_id, use_mesh,
+                         checkpoint_dir=ckpt_root)
     try:
         ei.status = "TRAINING"
-        storage.meta.update_engine_instance(ei)
+        if coord:
+            storage.meta.update_engine_instance(ei)
         # tracing hook (SURVEY.md §5): PIO_PROFILE_DIR=<dir> wraps the
         # train in a JAX profiler trace (xplane → Perfetto/TensorBoard)
         profile_dir = os.environ.get("PIO_PROFILE_DIR")
@@ -117,26 +151,36 @@ def run_train(
             phases = ", ".join(f"{k}={v:.3f}s"
                                for k, v in ctx.timings.items())
             ctx.log(f"train phases: {phases}")
+        if multi:
+            distributed.barrier("pio_train_done")
 
-        # persist per-algorithm models: blob entries and/or structured dirs
-        instance_dir = storage.models.model_dir(instance_id)
-        blobs: List[Optional[bytes]] = []
-        for (name, algo), model in zip(engine.make_algorithms(engine_params), models):
-            algo_dir = None
-            if instance_dir is not None:
-                algo_dir = os.path.join(instance_dir, name)
-                os.makedirs(algo_dir, exist_ok=True)
-            blobs.append(algo.save_model(model, algo_dir))
-        storage.models.put(instance_id, pickle.dumps(blobs))
+        # persist per-algorithm models (coordinator only under multi-host:
+        # the trained arrays are replicated, one writer suffices)
+        if coord:
+            instance_dir = storage.models.model_dir(instance_id)
+            blobs: List[Optional[bytes]] = []
+            for (name, algo), model in zip(
+                    engine.make_algorithms(engine_params), models):
+                algo_dir = None
+                if instance_dir is not None:
+                    algo_dir = os.path.join(instance_dir, name)
+                    os.makedirs(algo_dir, exist_ok=True)
+                blobs.append(algo.save_model(model, algo_dir))
+            storage.models.put(instance_id, pickle.dumps(blobs))
 
-        ei.status = "COMPLETED"
-        ei.end_time = utcnow()
-        storage.meta.update_engine_instance(ei)
+            ei.status = "COMPLETED"
+            ei.end_time = utcnow()
+            storage.meta.update_engine_instance(ei)
+            # the run completed: its mid-train checkpoints are consumed
+            shutil.rmtree(ckpt_root, ignore_errors=True)
+        if multi:
+            distributed.barrier("pio_persist_done")
         return instance_id
     except Exception:
         ei.status = "FAILED"
         ei.end_time = utcnow()
-        storage.meta.update_engine_instance(ei)
+        if coord:
+            storage.meta.update_engine_instance(ei)
         traceback.print_exc()
         raise
 

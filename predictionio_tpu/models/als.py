@@ -343,11 +343,16 @@ def als_train(
     coo: RatingsCOO,
     params: ALSParams,
     mesh=None,
+    checkpointer=None,
+    checkpoint_every: int = 0,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Train ALS; returns (U [n_users,k], V [n_items,k]) as numpy arrays.
 
     ``mesh`` (a jax.sharding.Mesh with a ``"data"`` axis) enables the
-    sharded path; None runs single-device.
+    sharded path; None runs single-device. ``checkpointer`` +
+    ``checkpoint_every`` enable mid-train checkpoint/resume on the
+    single-device path (see :func:`als_train_prepared`; the sharded
+    path's single fused scan has no mid-train host boundary to save at).
     """
     if mesh is not None and np.prod(mesh.devices.shape) > 1:
         from predictionio_tpu.models.als_sharded import als_train_sharded
@@ -356,7 +361,9 @@ def als_train(
     # a 1-device mesh still pins the platform: run the single-device path
     # on THAT device, not wherever the default backend happens to live
     device = mesh.devices.flat[0] if mesh is not None else None
-    return _als_train_single(coo, params, device=device)
+    return als_train_prepared(als_prepare(coo), params, device=device,
+                              checkpointer=checkpointer,
+                              checkpoint_every=checkpoint_every)
 
 
 @functools.lru_cache(maxsize=8)
@@ -432,6 +439,12 @@ def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
         return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
 
     def train(u_bufs, i_bufs, V0p):
+        if iterations == 0:
+            # U-recovery program: derive U from already-converged V (the
+            # resume path when a run died between its final checkpoint
+            # and model persistence)
+            return half(V0p, u_bufs, geom_u), V0p
+
         def step(carry, _):
             U, V = carry
             U = half(V, u_bufs, geom_u)
@@ -445,23 +458,73 @@ def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
     return jax.jit(train)
 
 
-def als_train_prepared(prep: ALSPrepared, p: ALSParams,
-                       device=None) -> Tuple[np.ndarray, np.ndarray]:
+def als_train_prepared(prep: ALSPrepared, p: ALSParams, device=None,
+                       checkpointer=None, checkpoint_every: int = 0,
+                       ) -> Tuple[np.ndarray, np.ndarray]:
     """Train from a prepared layout; returns (U, V) in ORIGINAL entity
-    order as numpy arrays."""
+    order as numpy arrays.
+
+    With ``checkpointer`` + ``checkpoint_every > 0`` the iteration loop
+    runs in blocks of ``checkpoint_every`` iterations, saving the
+    (permuted) V factors after each block — an interrupted train
+    restarted with the same checkpointer resumes from the newest block
+    and produces the same result as an uninterrupted run (V fully
+    determines the next iteration; U is recomputed from V). This is the
+    SURVEY §5 restart-from-checkpoint contract; the checkpoint cadence
+    costs one extra dispatch + a host fetch of V per block.
+    """
     import jax
     import jax.numpy as jnp
 
+    def put(a):
+        return jnp.asarray(a) if device is None else jax.device_put(a, device)
+
     u_bufs, i_bufs = prep.device_buffers(device)
-    train = _compiled_bucketed(
-        prep.u_side.geometry, prep.i_side.geometry,
-        prep.n_users, prep.n_items,
-        p.rank, p.iterations, float(p.reg), bool(p.implicit),
-        float(p.alpha), bool(p.weighted_reg))
+
+    def compiled(n_iters: int):
+        return _compiled_bucketed(
+            prep.u_side.geometry, prep.i_side.geometry,
+            prep.n_users, prep.n_items,
+            p.rank, n_iters, float(p.reg), bool(p.implicit),
+            float(p.alpha), bool(p.weighted_reg))
+
+    start = 0
     V0 = init_factors(prep.n_items, p.rank, p.seed)[prep.i_side.perm]
-    V0 = (jnp.asarray(V0) if device is None
-          else jax.device_put(V0, device))
-    U, V = train(u_bufs, i_bufs, V0)
+    U0 = None  # restored U (only consumed when start == iterations)
+    if checkpointer is not None:
+        step = checkpointer.latest_step()
+        if step is not None:
+            template = {"U": np.zeros((prep.n_users, p.rank), np.float32),
+                        "V": np.zeros_like(V0)}
+            try:
+                state = checkpointer.restore(step, template=template)
+                okay = all(np.asarray(state[k]).shape == template[k].shape
+                           for k in template)
+            except Exception:
+                okay = False
+            if okay:
+                # stale checkpoints (different geometry/rank) fail the
+                # shape check above and fall back to a fresh start
+                V0 = np.asarray(state["V"])
+                U0 = np.asarray(state["U"])
+                start = min(int(step), p.iterations)
+
+    if start >= p.iterations and U0 is not None:
+        # died between the final checkpoint and model persistence: the
+        # train is already done, nothing to recompute
+        U, V = U0, V0
+    elif checkpointer is None or checkpoint_every <= 0:
+        U, V = compiled(p.iterations - start)(u_bufs, i_bufs, put(V0))
+    else:
+        V = put(V0)
+        U = None
+        it = start
+        while it < p.iterations:
+            n = min(checkpoint_every, p.iterations - it)
+            U, V = compiled(n)(u_bufs, i_bufs, V)
+            it += n
+            checkpointer.save(it, {"U": np.asarray(U), "V": np.asarray(V)})
+        assert U is not None  # start < iterations here, loop ran
     # un-permute back to original entity order
     return (np.asarray(U)[prep.u_side.inv_perm],
             np.asarray(V)[prep.i_side.inv_perm])

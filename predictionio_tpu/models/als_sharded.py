@@ -212,4 +212,15 @@ def als_train_sharded(
              jax.device_put(cnt_i.reshape(n_dev, block_i), rows),
              jax.device_put(V0, rows)]
     U, V = train(*args)
-    return (np.asarray(U)[: coo.n_users], np.asarray(V)[: coo.n_items])
+
+    def fetch(x):
+        # multi-host: the result spans non-addressable devices — gather
+        # the global value onto every host (replicated model output,
+        # the torrent-broadcast analogue in reverse)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(x)
+
+    return (fetch(U)[: coo.n_users], fetch(V)[: coo.n_items])

@@ -137,3 +137,20 @@ def broadcast_from_coordinator(pytree):
     from jax.experimental import multihost_utils
 
     return multihost_utils.broadcast_one_to_all(pytree)
+
+
+def broadcast_string(s: str, max_len: int = 256) -> str:
+    """Broadcast a short string (e.g. the engine-instance id minted by
+    the coordinator) to every process."""
+    import jax
+    import numpy as np
+
+    if jax.process_count() <= 1:
+        return s
+    buf = np.zeros(max_len, np.uint8)
+    raw = s.encode()
+    if len(raw) > max_len:
+        raise ValueError(f"string longer than {max_len} bytes")
+    buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+    out = np.asarray(broadcast_from_coordinator(buf))
+    return bytes(out).rstrip(b"\x00").decode()

@@ -82,12 +82,21 @@ class SQLDialect(ABC):
         """Wrap bytes for a BLOB parameter."""
         return blob
 
+    def stream_cursor(self, conn):
+        """A cursor suitable for row-streaming large result sets (the
+        training-read path must not materialize the whole event table).
+        Default DB-API cursors often buffer everything at execute();
+        engines with true server-side cursors override."""
+        return conn.cursor()
+
     # -- error taxonomy --------------------------------------------------------
 
-    @property
     @abstractmethod
-    def missing_table_errors(self) -> Tuple[type, ...]:
-        """Exception classes raised when a statement hits a missing table."""
+    def is_missing_table(self, exc: BaseException) -> bool:
+        """Whether ``exc`` means the statement hit a missing table —
+        and ONLY that. Classifying broader error classes as "missing
+        table" would let connection failures or SQL bugs read as
+        "no events", silently training empty models."""
 
     def recover(self, conn) -> None:
         """Put the connection back in a usable state after an error
@@ -143,11 +152,11 @@ class SqliteDialect(SQLDialect):
             return _ThreadConns(self, shared=self.connect())
         return _ThreadConns(self)
 
-    @property
-    def missing_table_errors(self):
+    def is_missing_table(self, exc: BaseException) -> bool:
         import sqlite3
 
-        return (sqlite3.OperationalError,)
+        return (isinstance(exc, sqlite3.OperationalError)
+                and "no such table" in str(exc))
 
 
 def _server_props(props: Dict[str, str], default_port: int,
@@ -241,12 +250,15 @@ class PostgresDialect(SQLDialect):
     def binary(self, blob: bytes):
         return self._psycopg2.Binary(blob)
 
-    @property
-    def missing_table_errors(self):
-        # ONLY UndefinedTable: a connection failure must propagate, not
-        # read as "no events" (training on an empty scan would silently
-        # produce an empty model)
-        return (self._psycopg2.errors.UndefinedTable,)
+    def stream_cursor(self, conn):
+        # a named (server-side) cursor actually streams; the default
+        # client-side cursor buffers the whole result set at execute()
+        global _PG_CURSOR_SEQ
+        _PG_CURSOR_SEQ += 1
+        return conn.cursor(name=f"pio_stream_{_PG_CURSOR_SEQ}")
+
+    def is_missing_table(self, exc: BaseException) -> bool:
+        return isinstance(exc, self._psycopg2.errors.UndefinedTable)
 
 
 class MySQLDialect(SQLDialect):
@@ -296,9 +308,16 @@ class MySQLDialect(SQLDialect):
             if not (e.args and e.args[0] == 1061):
                 raise
 
-    @property
-    def missing_table_errors(self):
-        return (self._pymysql.err.ProgrammingError,)
+    def stream_cursor(self, conn):
+        # SSCursor = unbuffered (server-side) streaming cursor
+        return conn.cursor(self._pymysql.cursors.SSCursor)
+
+    def is_missing_table(self, exc: BaseException) -> bool:
+        # 1146 = ER_NO_SUCH_TABLE; plain ProgrammingError also covers
+        # SQL syntax bugs (1064), which must propagate
+        return (isinstance(exc, (self._pymysql.err.ProgrammingError,
+                                 self._pymysql.err.OperationalError))
+                and bool(exc.args) and exc.args[0] == 1146)
 
 
 def dialect_for(type_name: str, props: Dict[str, str],

@@ -128,6 +128,9 @@ class ALSAlgorithmParams:
     seed: Optional[int] = None
     implicit_prefs: bool = False
     alpha: float = 1.0
+    # mid-train checkpoint cadence (iterations per block) when the
+    # workflow provides a checkpoint dir; 0 disables (SURVEY.md §5)
+    checkpoint_every: int = 5
 
 
 class ALSModel:
@@ -188,6 +191,10 @@ class ALSAlgorithm(Algorithm):
                 seed=0 if p.seed is None else p.seed,
             ),
             mesh=ctx.mesh,
+            # restart-from-checkpoint (run_train --resume): save V every
+            # checkpoint_every iterations under the workflow's ckpt dir
+            checkpointer=ctx.checkpointer("als"),
+            checkpoint_every=p.checkpoint_every,
         )
         return ALSModel(U, V, user_ids, item_ids)
 

@@ -117,11 +117,18 @@ class TwoTowerAlgorithm(Algorithm):
                            len(pd.pairs))
         iidx = np.fromiter((item_ids[i] for _, i in pd.pairs), np.int32,
                            len(pd.pairs))
+        # explicit checkpoint_dir param wins; else the workflow's
+        # per-run checkpoint dir enables restart-from-checkpoint
+        ckpt_dir = p.checkpoint_dir
+        if ckpt_dir is None and ctx.checkpoint_dir:
+            import os
+
+            ckpt_dir = os.path.join(ctx.checkpoint_dir, "two_tower")
         tp = TwoTowerParams(
             embed_dim=p.embed_dim, hidden=list(p.hidden), out_dim=p.out_dim,
             batch_size=p.batch_size, epochs=p.epochs,
             learning_rate=p.learning_rate, temperature=p.temperature,
-            seed=p.seed, checkpoint_dir=p.checkpoint_dir,
+            seed=p.seed, checkpoint_dir=ckpt_dir,
             checkpoint_every=p.checkpoint_every)
         uv, iv = two_tower_train(uidx, iidx, len(user_ids), len(item_ids),
                                  tp, mesh=ctx.mesh)

@@ -176,6 +176,7 @@ def cmd_train(args: argparse.Namespace) -> None:
         verbose=args.verbose,
         use_mesh=not args.no_mesh,
         batch=args.batch or "",
+        resume=bool(getattr(args, "resume", False)),
     )
     print(f"[info] Training completed. Engine instance: {instance_id}")
 
@@ -405,6 +406,9 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("-v", "--verbose", action="count", default=0)
     tr.add_argument("--no-mesh", action="store_true",
                     help="single-device training (skip mesh construction)")
+    tr.add_argument("--resume", action="store_true",
+                    help="resume an interrupted train from its latest "
+                         "mid-train checkpoint")
     tr.set_defaults(fn=cmd_train)
 
     dp = sub.add_parser("deploy", help="serve the latest trained instance")

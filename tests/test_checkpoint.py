@@ -68,3 +68,151 @@ class TestTwoTowerResume:
                         __import__("jax").tree.leaves(resumed)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-5, atol=1e-6)
+
+
+class TestALSResume:
+    """Block-wise ALS checkpointing: interrupted + resumed == straight."""
+
+    def _coo(self):
+        from predictionio_tpu.models.als import RatingsCOO
+
+        rng = np.random.default_rng(5)
+        n_u, n_i, nnz = 40, 25, 400
+        return RatingsCOO(rng.integers(0, n_u, nnz).astype(np.int32),
+                          rng.integers(0, n_i, nnz).astype(np.int32),
+                          rng.uniform(1, 5, nnz).astype(np.float32),
+                          n_u, n_i)
+
+    def test_resume_matches_straight_run(self, tmp_path):
+        from predictionio_tpu.models.als import (ALSParams, als_prepare,
+                                                 als_train_prepared)
+
+        coo = self._coo()
+        prep = als_prepare(coo)
+        p8 = ALSParams(rank=4, iterations=8, reg=0.1, seed=2)
+        U_ref, V_ref = als_train_prepared(prep, p8)
+
+        # "crash" after 4 of 8 iterations (two 2-iteration blocks saved)
+        with TrainCheckpointer(str(tmp_path / "als")) as ck:
+            als_train_prepared(prep, ALSParams(rank=4, iterations=4,
+                                               reg=0.1, seed=2),
+                               checkpointer=ck, checkpoint_every=2)
+            assert ck.latest_step() == 4
+        # restart: restores step 4, runs the remaining 4
+        with TrainCheckpointer(str(tmp_path / "als")) as ck:
+            U, V = als_train_prepared(prep, p8, checkpointer=ck,
+                                      checkpoint_every=2)
+            assert ck.latest_step() == 8
+        np.testing.assert_allclose(U, U_ref, rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(V, V_ref, rtol=2e-4, atol=2e-5)
+
+    def test_resume_after_final_checkpoint_recovers_u(self, tmp_path):
+        # death AFTER the last save but BEFORE persistence: the resume
+        # run must not re-train, just recover U from the stored V
+        from predictionio_tpu.models.als import (ALSParams, als_prepare,
+                                                 als_train_prepared)
+
+        coo = self._coo()
+        prep = als_prepare(coo)
+        p = ALSParams(rank=4, iterations=4, reg=0.1, seed=2)
+        with TrainCheckpointer(str(tmp_path / "als")) as ck:
+            U_ref, V_ref = als_train_prepared(prep, p, checkpointer=ck,
+                                              checkpoint_every=2)
+        with TrainCheckpointer(str(tmp_path / "als")) as ck:
+            U, V = als_train_prepared(prep, p, checkpointer=ck,
+                                      checkpoint_every=2)
+        np.testing.assert_allclose(V, V_ref, rtol=1e-6)
+        np.testing.assert_allclose(U, U_ref, rtol=2e-4, atol=2e-5)
+
+    def test_stale_checkpoint_falls_back_to_fresh(self, tmp_path):
+        from predictionio_tpu.models.als import (ALSParams, als_prepare,
+                                                 als_train_prepared)
+
+        coo = self._coo()
+        prep = als_prepare(coo)
+        with TrainCheckpointer(str(tmp_path / "als")) as ck:
+            ck.save(3, {"V": np.zeros((7, 9), np.float32)})  # wrong shape
+        p = ALSParams(rank=4, iterations=3, reg=0.1, seed=2)
+        U_ref, V_ref = als_train_prepared(prep, p)
+        with TrainCheckpointer(str(tmp_path / "als")) as ck:
+            U, V = als_train_prepared(prep, p, checkpointer=ck)
+        np.testing.assert_allclose(U, U_ref, rtol=1e-6)
+
+
+class TestWorkflowResume:
+    """run_train --resume: the kill-and-resume contract end to end."""
+
+    def _variant(self):
+        from tests.test_workflow import FACTORY
+
+        return {
+            "id": "ckpt",
+            "engineFactory": FACTORY,
+            "datasource": {"params": {"appName": "TestApp"}},
+            "algorithms": [{"name": "als",
+                            "params": {"rank": 4, "numIterations": 6,
+                                       "lambda": 0.05,
+                                       "checkpointEvery": 2}}],
+        }
+
+    def test_kill_and_resume(self, storage, tmp_path, monkeypatch):
+        import predictionio_tpu.utils.checkpoint as ckpt_mod
+        from predictionio_tpu.core.workflow import prepare_deploy, run_train
+        from tests.test_workflow import FACTORY, seed_ratings
+
+        storage.config.home = str(tmp_path)  # checkpoints under tmp
+        seed_ratings(storage)
+        variant = self._variant()
+
+        # clean reference run
+        run_train(FACTORY, variant=variant, storage=storage, use_mesh=False)
+        ref = prepare_deploy(engine_factory=FACTORY,
+                             storage=storage).query({"user": "0", "num": 5})
+
+        # interrupted run: die right after the step-4 checkpoint lands
+        orig_save = ckpt_mod.TrainCheckpointer.save
+        saves = {"n": 0}
+
+        def flaky_save(self, step, state):
+            orig_save(self, step, state)
+            saves["n"] += 1
+            if saves["n"] == 2:
+                raise RuntimeError("simulated preemption")
+
+        monkeypatch.setattr(ckpt_mod.TrainCheckpointer, "save", flaky_save)
+        with pytest.raises(RuntimeError):
+            run_train(FACTORY, variant=variant, storage=storage,
+                      use_mesh=False)
+        assert storage.meta.list_engine_instances()[0].status == "FAILED"
+
+        # resume: only the remaining block runs (one more save, step 6)
+        saves2 = {"n": 0}
+
+        def counting_save(self, step, state):
+            orig_save(self, step, state)
+            saves2["n"] += 1
+
+        monkeypatch.setattr(ckpt_mod.TrainCheckpointer, "save", counting_save)
+        run_train(FACTORY, variant=variant, storage=storage, use_mesh=False,
+                  resume=True)
+        assert saves2["n"] == 1, "resume must continue, not retrain"
+
+        res = prepare_deploy(engine_factory=FACTORY,
+                             storage=storage).query({"user": "0", "num": 5})
+        assert [s["item"] for s in res["itemScores"]] == \
+            [s["item"] for s in ref["itemScores"]]
+        np.testing.assert_allclose(
+            [s["score"] for s in res["itemScores"]],
+            [s["score"] for s in ref["itemScores"]], rtol=2e-4)
+
+    def test_completed_run_clears_checkpoints(self, storage, tmp_path):
+        import os
+
+        from predictionio_tpu.core.workflow import _ckpt_root, run_train
+        from tests.test_workflow import FACTORY, seed_ratings
+
+        storage.config.home = str(tmp_path)
+        seed_ratings(storage)
+        run_train(FACTORY, variant=self._variant(), storage=storage,
+                  use_mesh=False)
+        assert not os.path.exists(_ckpt_root(storage, FACTORY, "ckpt"))
