@@ -357,6 +357,14 @@ class SQLEventStore(EventStore):
             c.commit()
         return ids  # type: ignore[return-value]
 
+    def _missing_table(self, c, e: BaseException) -> bool:
+        """After a statement failed: put the connection back in a usable
+        state, then classify. True means the namespace's table doesn't
+        exist yet — a fresh app reads as empty (the reference's LEvents
+        missing-table semantics); callers re-raise anything else."""
+        self._d.recover(c)
+        return self._d.is_missing_table(e)
+
     @staticmethod
     def _event_from_row(row: Tuple) -> Event:
         return Event(
@@ -383,11 +391,9 @@ class SQLEventStore(EventStore):
                         (event_id,))
             row = cur.fetchone()
             c.commit()  # end the read transaction (see find())
-        except self._d.missing_table_errors:
-            self._d.recover(c)
-            return None
-        except Exception:
-            self._d.recover(c)
+        except Exception as e:
+            if self._missing_table(c, e):
+                return None
             raise
         return self._event_from_row(row) if row else None
 
@@ -399,10 +405,11 @@ class SQLEventStore(EventStore):
                 cur = c.cursor()
                 cur.execute(self._d.sql(f"DELETE FROM {t} WHERE id=?"),
                             (event_id,))
-            except self._d.missing_table_errors:
-                self._d.recover(c)
-                return False
-            c.commit()
+                c.commit()
+            except Exception as e:
+                if self._missing_table(c, e):
+                    return False
+                raise
         return cur.rowcount > 0
 
     def wipe(self, app_id: int, channel_id: Optional[int] = None) -> None:
@@ -411,10 +418,11 @@ class SQLEventStore(EventStore):
         with self._lock:
             try:
                 c.cursor().execute(f"DELETE FROM {t}")
-            except self._d.missing_table_errors:
-                self._d.recover(c)
-                return
-            c.commit()
+                c.commit()
+            except Exception as e:
+                if self._missing_table(c, e):
+                    return
+                raise
 
     def find(
         self,
@@ -461,13 +469,17 @@ class SQLEventStore(EventStore):
                f"ORDER BY eventTime {order}, creationTime {order}{lim}")
         c = self._conn()
         try:
-            cur = c.cursor()
+            # a server-side cursor (psycopg2 named / pymysql SSCursor)
+            # actually streams; the default client cursor buffers the
+            # whole result set at execute(). The first fetch happens
+            # inside the try because server-side cursors surface
+            # missing-table errors at first fetch, not execute().
+            cur = self._d.stream_cursor(c)
             cur.execute(self._d.sql(sql), args)
-        except self._d.missing_table_errors:
-            self._d.recover(c)
-            return iter(())
-        except Exception:
-            self._d.recover(c)
+            first = cur.fetchmany(1024)
+        except Exception as e:
+            if self._missing_table(c, e):
+                return iter(())
             raise
 
         def stream():
@@ -476,13 +488,12 @@ class SQLEventStore(EventStore):
             # — server engines otherwise pin a stale snapshot (MySQL
             # REPEATABLE READ) or sit idle-in-transaction (PostgreSQL)
             # on this thread's cached connection forever
+            rows = first
             try:
-                while True:
-                    rows = cur.fetchmany(1024)
-                    if not rows:
-                        break
+                while rows:
                     for r in rows:
                         yield self._event_from_row(r)
+                    rows = cur.fetchmany(1024)
             finally:
                 try:
                     c.commit()

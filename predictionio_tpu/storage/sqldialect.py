@@ -26,6 +26,7 @@ same SPI test suite when a server is reachable (tests/test_sqldialect.py).
 
 from __future__ import annotations
 
+import itertools
 import re
 import threading
 from abc import ABC, abstractmethod
@@ -206,6 +207,10 @@ def _server_props(props: Dict[str, str], default_port: int,
     return out
 
 
+# psycopg2 named (server-side) cursors need process-unique names
+_PG_CURSOR_SEQ = itertools.count(1)
+
+
 class PostgresDialect(SQLDialect):
     """PostgreSQL via psycopg2 (reference: [U] storage/jdbc on the
     PostgreSQL driver — the default production meta/event store)."""
@@ -253,9 +258,7 @@ class PostgresDialect(SQLDialect):
     def stream_cursor(self, conn):
         # a named (server-side) cursor actually streams; the default
         # client-side cursor buffers the whole result set at execute()
-        global _PG_CURSOR_SEQ
-        _PG_CURSOR_SEQ += 1
-        return conn.cursor(name=f"pio_stream_{_PG_CURSOR_SEQ}")
+        return conn.cursor(name=f"pio_stream_{next(_PG_CURSOR_SEQ)}")
 
     def is_missing_table(self, exc: BaseException) -> bool:
         return isinstance(exc, self._psycopg2.errors.UndefinedTable)

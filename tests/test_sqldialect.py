@@ -105,6 +105,18 @@ class TestStatementShaping:
         assert PostgresDialect.blob_type == "BYTEA"
         assert MySQLDialect.blob_type == "LONGBLOB"
 
+    def test_pg_stream_cursor_names_are_unique(self):
+        """Regression: _PG_CURSOR_SEQ was once an uninitialized global —
+        the first PostgreSQL find() would NameError."""
+        class FakeConn:
+            def cursor(self, name=None):
+                return name
+
+        pg = _bare(PostgresDialect)
+        a = pg.stream_cursor(FakeConn())
+        b = pg.stream_cursor(FakeConn())
+        assert a.startswith("pio_stream_") and a != b
+
     def test_server_props_from_url_and_keys(self):
         p = _server_props({"URL": "jdbc:postgresql://u:pw@db.host:5555/mydb"},
                           5432, "postgresql")
@@ -166,6 +178,43 @@ class TestFormatParamstyleStores:
         # missing-table paths return empty, not raise
         assert list(st.find(999)) == []
         assert st.get("nope", 999) is None
+
+    @pytest.mark.parametrize("dialect_cls", [SqliteDialect, FormatSqliteDialect])
+    def test_fresh_app_missing_table_is_empty(self, tmp_path, dialect_cls):
+        """Regression: every missing-table path on a fresh app (no table
+        created yet) must read as empty — find/get/delete/wipe — on every
+        dialect, via the catch-inspect `is_missing_table` idiom. Round 2
+        shipped `except self._d.missing_table_errors:` (an attribute no
+        dialect defines), which turned each of these into AttributeError
+        and 500'd GET /events.json on fresh apps."""
+        st = SQLEventStore(dialect_cls(str(tmp_path / "fresh.db")))
+        app = 7  # never inserted into: pio_event_7 does not exist
+        assert list(st.find(app)) == []
+        assert list(st.find(app, event_names=["rate"], limit=5)) == []
+        assert st.get("no-such-id", app) is None
+        assert st.delete("no-such-id", app) is False
+        st.wipe(app)  # must not raise
+        assert st.aggregate_properties(app, "user") == {}
+
+    def test_non_missing_table_errors_propagate(self, tmp_path):
+        """The flip side: only missing-table reads as empty. Any other
+        SQL failure must raise, not silently train an empty model."""
+        import sqlite3
+
+        st = SQLEventStore(SqliteDialect(str(tmp_path / "err.db")))
+        app = 1
+        st.insert(Event(event="rate", entity_type="user", entity_id="u",
+                        event_time=_t("2026-01-01T00:00:00Z")), app)
+        # corrupt the schema out from under the store: drop a column the
+        # SELECT list needs → OperationalError that is NOT missing-table
+        conn = st._conn()
+        raw = getattr(conn, "_conn", conn)
+        raw.executescript(
+            "ALTER TABLE pio_event_1 RENAME COLUMN prId TO zz")
+        with pytest.raises(sqlite3.OperationalError):
+            list(st.find(app))
+        with pytest.raises(sqlite3.OperationalError):
+            st.get("any", app)
 
     def test_meta_store_roundtrip(self, tmp_path):
         ms = MetaStore(dialect=FormatSqliteDialect(str(tmp_path / "meta.db")))
