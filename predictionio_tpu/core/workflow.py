@@ -88,6 +88,9 @@ def run_train(
     clears it (SURVEY.md §5 checkpoint/resume).
     """
     from predictionio_tpu.parallel import distributed
+    from predictionio_tpu.utils import compilecache
+
+    compilecache.enable()
 
     # Multi-host (SURVEY.md §2d P5): when the PIO_* rendezvous vars are
     # set (or a Cloud-TPU slice announces itself), every host runs this
@@ -220,6 +223,9 @@ def prepare_deploy(
 ) -> DeployedEngine:
     """Load the latest COMPLETED instance (or a specific one) for serving
     (reference: CreateServer / engine.prepareDeploy, SURVEY.md §3.2)."""
+    from predictionio_tpu.utils import compilecache
+
+    compilecache.enable()
     storage = storage or get_storage()
     if instance_id is not None:
         ei = storage.meta.get_engine_instance(instance_id)
@@ -272,6 +278,9 @@ def run_evaluation(
 ) -> Tuple[str, MetricEvaluatorResult]:
     """Grid-search evaluation; persists an EvaluationInstance row the
     dashboard renders (reference: EvaluationWorkflow, SURVEY.md §3.4)."""
+    from predictionio_tpu.utils import compilecache
+
+    compilecache.enable()
     storage = storage or get_storage()
     instance_id = storage.meta.new_instance_id()
     vi = EvaluationInstance(

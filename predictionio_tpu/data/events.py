@@ -482,6 +482,19 @@ class SQLEventStore(EventStore):
                 return iter(())
             raise
 
+        if len(first) < 1024:
+            # result fully consumed: end the read transaction NOW and
+            # hand back a plain list iterator — the generator below
+            # only commits when actually iterated, and an abandoned
+            # server-side cursor pins the thread's cached connection
+            # (PostgreSQL idle-in-transaction; MySQL drains the rest of
+            # the result set at the next statement)
+            try:
+                c.commit()
+            except Exception:
+                self._d.recover(c)
+            return iter([self._event_from_row(r) for r in first])
+
         def stream():
             # stream in batches (a training read must not materialize
             # the whole table), then COMMIT to end the read transaction

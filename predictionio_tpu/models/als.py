@@ -183,12 +183,49 @@ def chunk_update(A, b, chunk, F_other, implicit: bool, alpha: float,
 
 _SLAB_ELEMS = 1 << 18   # slab_entities × width bound per scan step
                         # (bounds the (slab, C, k) gather to ~64MB at k=64)
-_MIN_WIDTH = 8
+
+# Allowed padded widths. Round 2 used every power of two up to the
+# heaviest entity's count (8.4M!): 38 buckets across both sides, each
+# inlining its own copy of the solve — 219k lines of StableHLO, 111 s
+# of tracing + 291 s of XLA compile at ML-20M geometry — and the
+# super-C_MAX buckets alone held ~25M padded slots (more than nnz).
+# A ×4 ladder capped at 8 K bounds the program at ≤7 buckets per side;
+# entities heavier than the cap are segmented across rows instead
+# (see _bucket_side), which is also strictly less gather work.
+_LADDER = (8, 32, 128, 512, 2048, 8192)
+_C_MAX = _LADDER[-1]
+
+# Solve-pass shape: normal equations from every bucket are written into
+# one (N, k, k) device buffer and solved by a single lax.scan in chunks
+# of this many systems — so the whole program contains exactly ONE
+# instance of the block-recursive Cholesky graph. Solving inside each
+# bucket body (round 2) inlined that graph 38× → 219k lines of HLO and
+# 258 s of XLA compile. The buffer costs N·k²·4 bytes (2.7 GB at
+# ML-20M, k=64); catalogs where it would exceed the cap below fall back
+# to in-body solves (memory flat, compile slower, persistent cache
+# amortizes).
+_SOLVE_CHUNK = 4096
+_SOLVE_BUF_MB = int(os.environ.get("PIO_ALS_SOLVE_BUF_MB", "4096"))
 
 
 @dataclass
 class _Bucket:
-    """Entities sharing one padded width C, sliced into scan slabs."""
+    """Entities sharing one padded width C, sliced into scan slabs.
+
+    Two row↔entity regimes:
+    - ``seg is None``: one row per entity (``counts`` is per-row,
+      shaped (n_slabs, slab)).
+    - ``seg`` set (the single heavy bucket, entities with more than
+      ``_C_MAX`` ratings): each entity spans several width-C rows.
+      Rows are entity-sorted, so a slab of S rows touches ≤ S
+      CONSECUTIVE entities; ``seg`` is the (n_slabs, slab, slab)
+      SLAB-LOCAL one-hot row→entity matrix (entity index relative to
+      ``seg_off`` for that slab) that aggregates per-row partial Grams
+      into per-entity normal equations with ONE batched matmul per slab
+      (MXU work, no scatter). Slab-local keeps ``seg`` at R×slab floats
+      — a dense (R, nb) matrix would grow quadratically with the number
+      of heavy entities. ``counts`` is per-entity, shaped (nb,).
+    """
 
     C: int
     nb: int        # real entity count
@@ -197,11 +234,14 @@ class _Bucket:
     other_idx: np.ndarray  # (n_slabs, slab, C) int32 — PERMUTED other pos
     vals: np.ndarray       # (n_slabs, slab, C) f32
     mask: np.ndarray       # (n_slabs, slab, C) f32
-    counts: np.ndarray     # (n_slabs, slab) f32 — true rating counts
+    counts: np.ndarray     # see class docstring
+    seg: Optional[np.ndarray] = None
+    seg_off: Optional[np.ndarray] = None  # (n_slabs,) int32 first entity
 
     @property
-    def geometry(self) -> Tuple[int, int, int, int]:
-        return (self.C, self.nb, self.slab, self.n_slabs)
+    def geometry(self) -> Tuple[int, int, int, int, bool]:
+        return (self.C, self.nb, self.slab, self.n_slabs,
+                self.seg is not None)
 
 
 @dataclass
@@ -241,13 +281,58 @@ def _bucket_side(idx_self, idx_other_pos, vals, n_self, counts,
     within = (np.arange(nnz, dtype=np.int64) - starts[ps]).astype(np.int64)
 
     n_nz = int((counts_perm > 0).sum())
-    widths = np.zeros(n_self, np.int64)
-    if n_nz:
-        widths[:n_nz] = np.maximum(
-            _MIN_WIDTH,
-            1 << np.ceil(np.log2(counts_perm[:n_nz])).astype(np.int64))
     buckets = []
-    e = 0
+
+    # heavy entities (count > _C_MAX): one SEGMENTED bucket — each
+    # entity spans ceil(count/C) rows of width C; the one-hot ``seg``
+    # matrix aggregates row partials per entity inside the compiled
+    # program. Entities are count-descending, so these are positions
+    # 0..n_heavy-1 and the output concatenation order is preserved.
+    n_heavy = int((counts_perm > _C_MAX).sum())
+    if n_heavy:
+        C = _C_MAX
+        cnts = counts_perm[:n_heavy]
+        rows_per = (cnts + C - 1) // C
+        row_starts = np.zeros(n_heavy + 1, np.int64)
+        np.cumsum(rows_per, out=row_starts[1:])
+        n_rows = int(row_starts[-1])
+        slab = max(1, _SLAB_ELEMS // C)
+        n_slabs = -(-n_rows // slab)
+        R = n_slabs * slab
+        oi = np.zeros((R, C), np.int32)
+        vv = np.zeros((R, C), np.float32)
+        mm = np.zeros((R, C), np.float32)
+        hi = int(starts[n_heavy])
+        row = row_starts[ps[:hi]] + within[:hi] // C
+        col = within[:hi] % C
+        oi[row, col] = o[:hi]
+        vv[row, col] = v[:hi]
+        mm[row, col] = 1.0
+        row_ent = np.repeat(np.arange(n_heavy), rows_per)
+        # slab-local one-hot: entity index relative to the slab's first
+        # entity (rows are entity-sorted → ≤ slab consecutive entities)
+        seg_off = row_ent[np.minimum(np.arange(n_slabs) * slab,
+                                     n_rows - 1)].astype(np.int32)
+        local = row_ent - seg_off[np.arange(n_rows) // slab]
+        seg = np.zeros((R, slab), np.float32)
+        seg[np.arange(n_rows), local] = 1.0  # pad rows stay all-zero
+        buckets.append(_Bucket(
+            C, n_heavy, slab, n_slabs,
+            oi.reshape(n_slabs, slab, C),
+            vv.reshape(n_slabs, slab, C),
+            mm.reshape(n_slabs, slab, C),
+            cnts.astype(np.float32),
+            seg=seg.reshape(n_slabs, slab, slab),
+            seg_off=seg_off))
+
+    # the rest: one row per entity, padded to the ladder width
+    widths = np.zeros(n_self, np.int64)
+    widths[:n_heavy] = 4 * _C_MAX  # sentinel keeping the array sorted
+    if n_nz > n_heavy:
+        ladder = np.asarray(_LADDER, np.int64)
+        widths[n_heavy:n_nz] = ladder[
+            np.searchsorted(ladder, counts_perm[n_heavy:n_nz])]
+    e = n_heavy
     while e < n_nz:
         C = int(widths[e])
         e_end = int(np.searchsorted(-widths[:n_nz], -C, side="right"))
@@ -310,7 +395,10 @@ class ALSPrepared:
 
             self._device_bufs[device] = tuple(
                 tuple((put(b.other_idx), put(b.vals), put(b.mask),
-                       put(b.counts)) for b in side.buckets)
+                       put(b.counts))
+                      + ((put(b.seg), put(b.seg_off))
+                         if b.seg is not None else ())
+                      for b in side.buckets)
                 for side in (self.u_side, self.i_side))
         return self._device_bufs[device]
 
@@ -376,11 +464,12 @@ def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
 
     Per half-step, per bucket, per slab (a ``lax.scan`` step): gather
     the (slab, C, k) factor block, one batched weighted-Gram einsum
-    (MXU), add ridge + implicit term, and solve the slab's k×k systems
-    immediately with the block-recursive batched Cholesky — so the
-    (n, k, k) normal matrices are never materialized (peak extra memory
-    is one slab, ~64 MB, regardless of catalog size) and there is no
-    scatter anywhere in the program.
+    (MXU), add ridge + implicit term, and write the slab's k×k systems
+    into the solve buffer; a single chunked scan then solves the whole
+    side with ONE instance of the block-recursive batched Cholesky
+    (compile-time bound — see ``_SOLVE_CHUNK``). No scatter anywhere in
+    the program. Catalogs too large for the solve buffer solve inside
+    each bucket body instead (memory flat in catalog size).
     """
     import jax
     import jax.numpy as jnp
@@ -390,49 +479,160 @@ def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
 
     from predictionio_tpu.ops.cholesky import chol_solve_batched
 
+    def weights(v_s, m_s):
+        if implicit:
+            return (alpha * v_s) * m_s, (1.0 + alpha * v_s) * m_s
+        return m_s, v_s * m_s
+
+    def row_grams(F_other, oi_s, v_s, m_s):
+        """One slab's per-row normal-equation partials on the MXU.
+
+        HIGHEST: normal equations need f32 MXU passes — bf16 Gram error
+        is ~3e-1 vs 6e-5 (see ops/gram.py) and the Cholesky solve
+        amplifies it."""
+        F = F_other[oi_s]                               # (slab, C, k)
+        wo, wb = weights(v_s, m_s)
+        A = jnp.einsum("nc,nck,ncl->nkl", wo, F, F,
+                       precision=jax.lax.Precision.HIGHEST,
+                       preferred_element_type=jnp.float32)
+        b = jnp.einsum("nc,nck->nk", wb, F,
+                       precision=jax.lax.Precision.HIGHEST,
+                       preferred_element_type=jnp.float32)
+        return A, b
+
+    def ridge(A, cnt_s, G):
+        if implicit:
+            A = A + G[None, :, :]
+        lam = reg * cnt_s if weighted_reg else jnp.full_like(cnt_s, reg)
+        lam = jnp.where(cnt_s > 0, jnp.maximum(lam, 1e-8), 1.0)
+        return A + lam[:, None, None] * eye
+
+    def seg_equations(F_other, buf, nb, slab, G):
+        """Heavy bucket: entities span rows; each slab aggregates its
+        per-row partials into ≤ slab consecutive entities with one
+        (slab, slab) × (slab, k·(k+1)) matmul (slab-local one-hot, no
+        scatter), accumulated into the per-entity buffer at the slab's
+        entity offset. Buffer is over-allocated by one slab so the
+        update-slice never clamps."""
+        oi, vv, mm, cnt, seg, seg_off = buf
+
+        def seg_body(carry, chunk):
+            A_e, b_e = carry
+            oi_s, v_s, m_s, seg_s, off_s = chunk
+            A_r, b_r = row_grams(F_other, oi_s, v_s, m_s)
+            A_l = jnp.einsum("ne,nkl->ekl", seg_s, A_r,
+                             precision=jax.lax.Precision.HIGHEST,
+                             preferred_element_type=jnp.float32)
+            b_l = jnp.einsum("ne,nk->ek", seg_s, b_r,
+                             precision=jax.lax.Precision.HIGHEST,
+                             preferred_element_type=jnp.float32)
+            blk_A = jax.lax.dynamic_slice(A_e, (off_s, 0, 0),
+                                          (slab, k, k))
+            blk_b = jax.lax.dynamic_slice(b_e, (off_s, 0), (slab, k))
+            A_e = jax.lax.dynamic_update_slice(A_e, blk_A + A_l,
+                                               (off_s, 0, 0))
+            b_e = jax.lax.dynamic_update_slice(b_e, blk_b + b_l,
+                                               (off_s, 0))
+            return (A_e, b_e), None
+
+        init = (jnp.zeros((nb + slab, k, k), jnp.float32),
+                jnp.zeros((nb + slab, k), jnp.float32))
+        (A_e, b_e), _ = jax.lax.scan(
+            seg_body, init, (oi, vv, mm, seg, seg_off))
+        return ridge(A_e[:nb], cnt, G), b_e[:nb]
+
+    def half_materialized(F_other, bufs, geometry, G, spans, n_chunks):
+        """Two-phase half-step: every bucket emits its (ridged) normal
+        equations as scan outputs, concatenated into one solve buffer a
+        single chunked scan then solves — ONE Cholesky instance in the
+        program. Emitting via scan ``ys`` (not a carried buffer updated
+        with dynamic_update_slice) matters: the carry pattern measured
+        +116 ms per ML-20M half-step in buffer copies."""
+        N_pad = n_chunks * _SOLVE_CHUNK
+        n_self, bucket_geoms = geometry
+        A_parts, b_parts = [], []
+        for (C, nb, slab, n_slabs, is_seg), buf in zip(bucket_geoms, bufs):
+            if is_seg:
+                A_e, b_e = seg_equations(F_other, buf, nb, slab, G)
+                A_parts.append(A_e)
+                b_parts.append(b_e)
+            else:
+                oi, vv, mm, cnt = buf
+
+                def body(_, chunk):
+                    oi_s, v_s, m_s, cnt_s = chunk
+                    A, b = row_grams(F_other, oi_s, v_s, m_s)
+                    return None, (ridge(A, cnt_s, G), b)
+
+                if n_slabs == 1:
+                    A, b = body(None, (oi[0], vv[0], mm[0], cnt[0]))[1]
+                else:
+                    _, (A, b) = jax.lax.scan(body, None, (oi, vv, mm, cnt))
+                    A = A.reshape(-1, k, k)
+                    b = b.reshape(-1, k)
+                A_parts.append(A)
+                b_parts.append(b)
+        if sum(spans) < N_pad:  # tail pad: identity systems, x = 0
+            A_parts.append(jnp.zeros((N_pad - sum(spans), k, k),
+                                     jnp.float32) + eye)
+            b_parts.append(jnp.zeros((N_pad - sum(spans), k), jnp.float32))
+        A_all = jnp.concatenate(A_parts) if len(A_parts) > 1 else A_parts[0]
+        b_all = jnp.concatenate(b_parts) if len(b_parts) > 1 else b_parts[0]
+        if n_chunks == 1:
+            x_all = chol_solve_batched(A_all, b_all)
+        else:
+            _, xc = jax.lax.scan(
+                lambda _, ab: (None, chol_solve_batched(*ab)), None,
+                (A_all.reshape(n_chunks, _SOLVE_CHUNK, k, k),
+                 b_all.reshape(n_chunks, _SOLVE_CHUNK, k)))
+            x_all = xc.reshape(N_pad, k)
+        outs, off, total = [], 0, 0
+        for (C, nb, slab, n_slabs, is_seg), span in zip(bucket_geoms, spans):
+            outs.append(x_all[off:off + nb])
+            off += span
+            total += nb
+        if total < n_self:  # zero-rating tail entities → zero factors
+            outs.append(jnp.zeros((n_self - total, k), jnp.float32))
+        return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+
     def half(F_other, bufs, geometry):
         n_self, bucket_geoms = geometry
+        G = None
         if implicit:
             G = jnp.einsum("nk,nl->kl", F_other, F_other,
                            precision=jax.lax.Precision.HIGHEST,
                            preferred_element_type=jnp.float32)
+        # each bucket's span in the solve buffer: seg buckets emit nb
+        # exact rows once, regular buckets emit their padded slabs
+        spans = [nb if is_seg else n_slabs * slab
+                 for (C, nb, slab, n_slabs, is_seg) in bucket_geoms]
+        n_chunks = max(1, -(-sum(spans) // _SOLVE_CHUNK))
+        if n_chunks * _SOLVE_CHUNK * k * k * 4 <= _SOLVE_BUF_MB << 20:
+            return half_materialized(F_other, bufs, geometry, G, spans,
+                                     n_chunks)
+        # huge catalog: solve inside each bucket body (memory flat in
+        # catalog size; compiles one Cholesky per bucket)
         outs = []
         total = 0
-        for (C, nb, slab, n_slabs), (oi, vv, mm, cnt) in zip(
-                bucket_geoms, bufs):
-
-            def body(_, chunk):
-                oi_s, v_s, m_s, cnt_s = chunk
-                F = F_other[oi_s]                       # (slab, C, k)
-                if implicit:
-                    wo = (alpha * v_s) * m_s
-                    wb = (1.0 + alpha * v_s) * m_s
-                else:
-                    wo = m_s
-                    wb = v_s * m_s
-                # HIGHEST: normal equations need f32 MXU passes — bf16
-                # Gram error is ~3e-1 vs 6e-5 (see ops/gram.py) and the
-                # Cholesky solve amplifies it
-                A = jnp.einsum("nc,nck,ncl->nkl", wo, F, F,
-                               precision=jax.lax.Precision.HIGHEST,
-                               preferred_element_type=jnp.float32)
-                b = jnp.einsum("nc,nck->nk", wb, F,
-                               precision=jax.lax.Precision.HIGHEST,
-                               preferred_element_type=jnp.float32)
-                if implicit:
-                    A = A + G[None, :, :]
-                lam = reg * cnt_s if weighted_reg else jnp.full_like(
-                    cnt_s, reg)
-                lam = jnp.where(cnt_s > 0, jnp.maximum(lam, 1e-8), 1.0)
-                A = A + lam[:, None, None] * eye
-                return None, chol_solve_batched(A, b)
-
-            if n_slabs == 1:
-                x = body(None, (oi[0], vv[0], mm[0], cnt[0]))[1]
+        for (C, nb, slab, n_slabs, is_seg), buf in zip(bucket_geoms, bufs):
+            if is_seg:
+                A_e, b_e = seg_equations(F_other, buf, nb, slab, G)
+                x = chol_solve_batched(A_e, b_e)
             else:
-                _, xs = jax.lax.scan(body, None, (oi, vv, mm, cnt))
-                x = xs.reshape(-1, k)
-            outs.append(x[:nb])
+                oi, vv, mm, cnt = buf
+
+                def body(_, chunk):
+                    oi_s, v_s, m_s, cnt_s = chunk
+                    A, b = row_grams(F_other, oi_s, v_s, m_s)
+                    return None, chol_solve_batched(ridge(A, cnt_s, G), b)
+
+                if n_slabs == 1:
+                    x = body(None, (oi[0], vv[0], mm[0], cnt[0]))[1]
+                else:
+                    _, xs = jax.lax.scan(body, None, (oi, vv, mm, cnt))
+                    x = xs.reshape(-1, k)
+                x = x[:nb]
+            outs.append(x)
             total += nb
         if total < n_self:  # zero-rating tail entities → zero factors
             outs.append(jnp.zeros((n_self - total, k), jnp.float32))

@@ -60,42 +60,39 @@ def _t(a):
 
 
 def _chol_inv_leaf(A):
-    """(..., m, m) SPD with m ≤ _LEAF → L⁻¹, fully unrolled and
-    vectorized over the batch dims.
+    """(..., m, m) SPD with m ≤ _LEAF → L⁻¹, vectorized over the batch
+    dims.
 
-    The matrix dims are moved to the FRONT first so each of the ~m³/3
-    unrolled scalar steps reads a contiguous (batch,) vector — as
-    (..., i, j) slices every step would re-read the strided (..., m, m)
-    buffer (measured 13 ms vs <1 ms per leaf at batch 65k on v5e)."""
+    Column-vectorized: m rank-1 downdates build L, then m forward-
+    substitution rows build L⁻¹ — ~10 traced ops per column instead of
+    the earlier fully-unrolled ~m³/3 scalar graph. Same flops, same
+    numerics, but ~5× less HLO: with ~tens of inlined call sites in the
+    ALS program the unrolled leaf dominated XLA compile time (258 s at
+    ML-20M geometry).
+
+    The matrix dims are moved to the FRONT so every step reads
+    contiguous (batch,) lanes — (..., i, j) slices would re-read the
+    strided (..., m, m) buffer (measured 13 ms vs <1 ms per leaf at
+    batch 65k on v5e)."""
     m = A.shape[-1]
     At = jnp.moveaxis(A, (-2, -1), (0, 1))  # (m, m, *batch)
-    batch = At.shape[2:]
-    L = [[None] * m for _ in range(m)]
-    for i in range(m):
-        for j in range(i + 1):
-            s = At[i][j]
-            for p in range(j):
-                s = s - L[i][p] * L[j][p]
-            if i == j:
-                # the ridge keeps diagonals strictly positive; the floor
-                # only guards padded identity blocks from rounding
-                L[i][j] = jnp.sqrt(jnp.maximum(s, 1e-30))
-            else:
-                L[i][j] = s / L[j][j]
-    inv = [[None] * m for _ in range(m)]
+    bshape = (1,) * (At.ndim - 2)
+    lane = jnp.arange(m).reshape((m,) + bshape)
+    cols = []  # cols[j][i] = L[i, j], each (m, *batch)
     for j in range(m):
-        for i in range(j, m):
-            if i == j:
-                inv[i][j] = 1.0 / L[i][i]
-            else:
-                s = L[i][j] * inv[j][j]
-                for p in range(j + 1, i):
-                    s = s + L[i][p] * inv[p][j]
-                inv[i][j] = -s / L[i][i]
-    zero = jnp.zeros(batch, A.dtype)
-    out = jnp.stack([jnp.stack([inv[i][j] if j <= i else zero
-                                for j in range(m)], axis=0)
-                     for i in range(m)], axis=0)
+        # the ridge keeps diagonals strictly positive; the floor only
+        # guards padded identity blocks from rounding
+        d = jnp.sqrt(jnp.maximum(At[j, j], 1e-30))
+        col = jnp.where(lane >= j, At[:, j] / d, 0.0)
+        At = At - col[:, None] * col[None, :]
+        cols.append(col)
+    inv = []  # rows of L⁻¹, each (m, *batch)
+    for i in range(m):
+        s = jnp.where(lane == i, jnp.ones_like(cols[0]), 0.0)
+        for p in range(i):
+            s = s - cols[p][i] * inv[p]
+        inv.append(jnp.where(lane <= i, s / cols[i][i], 0.0))
+    out = jnp.stack(inv, axis=0)  # (i, j, *batch)
     return jnp.moveaxis(out, (0, 1), (-2, -1))
 
 
@@ -121,16 +118,13 @@ def _chol_inv(A):
     ], axis=-2)
 
 
-def chol_solve_batched(A, b):
-    """Solve the batched SPD systems ``A x = b``.
-
-    A: (..., k, k) SPD (symmetric positive definite — ALS adds a ridge),
-    b: (..., k) → x: (..., k). Any k ≥ 1; internally padded to a power
-    of two with an identity block (which factors to itself and leaves
-    the leading k×k solve untouched).
-    """
-    A = jnp.asarray(A, jnp.float32)
-    b = jnp.asarray(b, jnp.float32)
+@jax.jit
+def _chol_solve(A, b):
+    """jit-wrapped so tracing is cached per (batch, k) shape — callers
+    like the ALS program may instantiate several solves, and re-tracing
+    the recursive graph at every call site multiplies lowering time.
+    (The ALS program additionally arranges to contain only ONE solve
+    shape at all — see models/als.py ``_SOLVE_CHUNK``.)"""
     k = A.shape[-1]
     m = _LEAF
     while m < k:
@@ -147,3 +141,15 @@ def chol_solve_batched(A, b):
     y = _mm(Li, b[..., None])
     x = _mm(_t(Li), y)[..., 0]
     return x[..., :k]
+
+
+def chol_solve_batched(A, b):
+    """Solve the batched SPD systems ``A x = b``.
+
+    A: (..., k, k) SPD (symmetric positive definite — ALS adds a ridge),
+    b: (..., k) → x: (..., k). Any k ≥ 1; internally padded to a power
+    of two with an identity block (which factors to itself and leaves
+    the leading k×k solve untouched).
+    """
+    return _chol_solve(jnp.asarray(A, jnp.float32),
+                       jnp.asarray(b, jnp.float32))

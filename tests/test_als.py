@@ -73,6 +73,108 @@ class TestSingleDevice:
         assert 3 not in sim and len(sim) == 5
 
 
+def _ref_half(idx_self, idx_other, vals, n_self, F, p):
+    """Dense per-entity normal-equation solve (the math the bucketed
+    MXU program must reproduce), in float64."""
+    k = p.rank
+    F = F.astype(np.float64)
+    X = np.zeros((n_self, k), np.float64)
+    for e in range(n_self):
+        sel = idx_self == e
+        n_e = int(sel.sum())
+        if n_e == 0:
+            continue
+        Fe = F[idx_other[sel]]
+        lam = p.reg * n_e if p.weighted_reg else p.reg
+        A = Fe.T @ Fe + max(lam, 1e-8) * np.eye(k)
+        X[e] = np.linalg.solve(A, Fe.T @ vals[sel].astype(np.float64))
+    return X
+
+
+def _ref_als(coo, p):
+    from predictionio_tpu.models.als import init_factors
+
+    V = init_factors(coo.n_items, p.rank, p.seed).astype(np.float64)
+    U = np.zeros((coo.n_users, p.rank), np.float64)
+    for _ in range(p.iterations):
+        U = _ref_half(coo.user_idx, coo.item_idx, coo.rating,
+                      coo.n_users, V, p)
+        V = _ref_half(coo.item_idx, coo.user_idx, coo.rating,
+                      coo.n_items, U, p)
+    return U, V
+
+
+class TestBucketedLayout:
+    def test_segmented_heavy_bucket_matches_dense_reference(self, monkeypatch):
+        """Shrink the width ladder so the heavy (segmented, one-hot
+        aggregated) bucket path runs on a small dataset, and check the
+        whole program against a dense float64 reference."""
+        import predictionio_tpu.models.als as als_mod
+
+        monkeypatch.setattr(als_mod, "_LADDER", (2, 8))
+        monkeypatch.setattr(als_mod, "_C_MAX", 8)
+        rng = np.random.default_rng(5)
+        n_u, n_i, nnz = 40, 25, 600
+        uu = (rng.zipf(1.3, nnz) % n_u).astype(np.int32)
+        ii = (rng.zipf(1.3, nnz) % n_i).astype(np.int32)
+        # dedupe (user, item) pairs so counts are exact
+        keep = np.unique(uu.astype(np.int64) * n_i + ii, return_index=True)[1]
+        uu, ii = uu[keep], ii[keep]
+        rr = rng.uniform(1, 5, len(uu)).astype(np.float32)
+        coo = RatingsCOO(uu, ii, rr, n_u, n_i)
+
+        prep = als_mod.als_prepare(coo)
+        assert any(b.seg is not None for b in prep.u_side.buckets), \
+            "test dataset must exercise the segmented bucket"
+        assert any(b.seg is None for b in prep.u_side.buckets)
+
+        p = ALSParams(rank=4, iterations=2, reg=0.1, seed=2)
+        U, V = als_mod.als_train_prepared(prep, p)
+        Ur, Vr = _ref_als(coo, p)
+        np.testing.assert_allclose(U, Ur, rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(V, Vr, rtol=2e-3, atol=2e-3)
+
+    def test_in_body_solve_fallback_matches_materialized(self, monkeypatch):
+        """The huge-catalog fallback (solve inside each bucket body,
+        taken when the solve buffer would exceed PIO_ALS_SOLVE_BUF_MB)
+        must produce the same factors as the materialized path."""
+        import predictionio_tpu.models.als as als_mod
+
+        rng = np.random.default_rng(7)
+        n_u, n_i = 50, 30
+        uu = rng.integers(0, n_u, 500).astype(np.int32)
+        ii = rng.integers(0, n_i, 500).astype(np.int32)
+        keep = np.unique(uu.astype(np.int64) * n_i + ii, return_index=True)[1]
+        uu, ii = uu[keep], ii[keep]
+        rr = rng.uniform(1, 5, len(uu)).astype(np.float32)
+        coo = RatingsCOO(uu, ii, rr, n_u, n_i)
+        p = ALSParams(rank=4, iterations=3, reg=0.1, seed=2)
+        U_m, V_m = als_mod.als_train(coo, p)
+        monkeypatch.setattr(als_mod, "_SOLVE_BUF_MB", 0)
+        als_mod._compiled_bucketed.cache_clear()
+        try:
+            U_f, V_f = als_mod.als_train(coo, p)
+        finally:
+            als_mod._compiled_bucketed.cache_clear()
+        np.testing.assert_allclose(U_f, U_m, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(V_f, V_m, rtol=1e-4, atol=1e-5)
+
+    def test_default_ladder_matches_dense_reference(self):
+        rng = np.random.default_rng(6)
+        n_u, n_i = 30, 20
+        uu = rng.integers(0, n_u, 350).astype(np.int32)
+        ii = rng.integers(0, n_i, 350).astype(np.int32)
+        keep = np.unique(uu.astype(np.int64) * n_i + ii, return_index=True)[1]
+        uu, ii = uu[keep], ii[keep]
+        rr = rng.uniform(1, 5, len(uu)).astype(np.float32)
+        coo = RatingsCOO(uu, ii, rr, n_u, n_i)
+        p = ALSParams(rank=4, iterations=2, reg=0.1, seed=2)
+        U, V = als_train(coo, p)
+        Ur, Vr = _ref_als(coo, p)
+        np.testing.assert_allclose(U, Ur, rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(V, Vr, rtol=2e-3, atol=2e-3)
+
+
 class TestShardedParity:
     def test_explicit_matches_single(self, synthetic, cpu_mesh):
         coo, _, _ = synthetic
