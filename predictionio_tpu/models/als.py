@@ -6,30 +6,31 @@ similar-product / e-commerce templates; block-partitioned factor
 matrices, shuffle-joined rating blocks, per-row normal-equation Cholesky
 solves — SURVEY.md §2d P2). The TPU-first redesign:
 
-- Single-device: ratings are **bucketed by entity** — entities sorted
-  by rating count, each padded to the next power-of-two width C, and
-  same-width entities batched into dense ``(nb, C)`` blocks. This is
-  the sparsity-to-MXU bridge: each entity's normal equations
-  ``A_e = Σ v vᵀ`` are ONE batch element of a dense batched weighted
-  Gram ``(C×k)ᵀdiag(w)(C×k)`` — systolic-array work with **no scatter
-  anywhere** (TPU scatter-add of row partials measured ~40% of the
-  iteration in the earlier padded-row design, which the sharded path
-  still uses per-device).
-- Buckets stream through ``lax.scan`` in fixed-size slabs, and each
-  slab's k×k systems are solved immediately — the (n, k, k) normal
-  matrices never materialize, so memory stays flat in catalog size.
-- Solves use a **block-recursive batched Cholesky built from batched
-  matmuls** (:mod:`predictionio_tpu.ops.cholesky`) — replacing MLlib's
-  per-row LAPACK ``dppsv`` calls, and ~18× faster on TPU than XLA's
-  sequential ``cholesky`` lowering at ML-20M batch sizes.
+- Ratings are **bucketed by entity** — entities sorted by rating
+  count, each padded to a ladder width C (capped at 8K; heavier
+  entities are segmented across rows), and same-width entities batched
+  into dense ``(nb, C)`` blocks. This is the sparsity-to-MXU bridge:
+  each entity's normal equations ``A_e = Σ v vᵀ`` are ONE batch
+  element of a dense batched weighted Gram ``(C×k)ᵀdiag(w)(C×k)`` —
+  systolic-array work with **no scatter anywhere** (TPU scatter-add of
+  row partials measured ~40% of the iteration in the round-1
+  padded-row design).
+- Buckets stream through ``lax.scan`` in fixed-size slabs, emitting
+  ridged normal equations into ONE solve buffer; a single chunked scan
+  solves everything with one instance of the **block-recursive batched
+  Cholesky built from batched matmuls**
+  (:mod:`predictionio_tpu.ops.cholesky`) — replacing MLlib's per-row
+  LAPACK ``dppsv`` calls (~18× faster on TPU than XLA's sequential
+  ``cholesky`` lowering at ML-20M batch sizes, and a single Cholesky
+  graph instance keeps XLA compile bounded).
 - The whole training run (iterations × two half-steps) is ONE jitted
   ``lax.scan``: no host round-trips. Layout construction
   (:func:`als_prepare`) is a separate host-side step — the analogue of
   MLlib's InBlock build — done once per dataset and reused.
 - With a mesh (:mod:`predictionio_tpu.models.als_sharded`): entities are
-  range-partitioned across devices, each device holds its entities'
-  rating rows, and one ``all_gather`` per half-step replaces the
-  reference's shuffle.
+  range-partitioned across devices, each device runs this same bucketed
+  program on its block, and one ``all_gather`` per half-step replaces
+  the reference's shuffle.
 
 Supports explicit feedback and implicit feedback (Hu-Koren-Volinsky
 confidence weighting, MLlib's ``trainImplicit`` analogue) and MLlib's
@@ -70,56 +71,9 @@ class ALSParams:
     alpha: float = 1.0         # implicit confidence scale
     weighted_reg: bool = True  # ALS-WR: λ·n_e scaling (MLlib behavior)
     seed: int = 0
-    row_width: int = 64        # W: ratings per padded row
 
 
-def _row_chunk(rank: int) -> int:
-    """Rows per scan step: bounds the (RC, k, k) partials to ~64MB f32."""
-    return int(min(8192, max(256, (1 << 24) // max(rank * rank, 1))))
 
-
-def rows_layout(
-    idx_self: np.ndarray, idx_other: np.ndarray, vals: np.ndarray,
-    n_self: int, width: int, chunk_rows: int,
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-    """Build the padded-row layout for one half-step orientation.
-
-    Returns (row_entity [R], other_idx [R,W], vals [R,W], mask [R,W])
-    with R padded to a multiple of ``chunk_rows`` and ``row_entity``
-    sorted (so the scatter-add may assert sortedness).
-    """
-    nnz = idx_self.shape[0]
-    order = np.argsort(idx_self, kind="stable")
-    s, o, v = idx_self[order], idx_other[order], vals[order]
-
-    counts = np.bincount(s, minlength=n_self).astype(np.int64)
-    starts = np.zeros(n_self + 1, np.int64)
-    np.cumsum(counts, out=starts[1:])
-    within = np.arange(nnz, dtype=np.int64) - starts[s]
-
-    rows_per_entity = (counts + width - 1) // width
-    row_starts = np.zeros(n_self + 1, np.int64)
-    np.cumsum(rows_per_entity, out=row_starts[1:])
-    n_rows = int(row_starts[-1])
-
-    row_of = (row_starts[s] + within // width).astype(np.int64)
-    col_of = (within % width).astype(np.int64)
-
-    R = max(chunk_rows, ((n_rows + chunk_rows - 1) // chunk_rows) * chunk_rows)
-    row_entity = np.full(R, max(0, n_self - 1), np.int32)  # sorted tail pad
-    row_entity[:n_rows] = np.repeat(
-        np.arange(n_self, dtype=np.int32), rows_per_entity)
-    other_idx = np.zeros((R, width), np.int32)
-    vmat = np.zeros((R, width), np.float32)
-    mask = np.zeros((R, width), np.float32)
-    other_idx[row_of, col_of] = o
-    vmat[row_of, col_of] = v
-    mask[row_of, col_of] = 1.0
-    return row_entity, other_idx, vmat, mask
-
-
-def _counts(idx: np.ndarray, n: int) -> np.ndarray:
-    return np.bincount(idx, minlength=n).astype(np.float32)
 
 
 def init_factors(n: int, rank: int, seed: int) -> np.ndarray:
@@ -129,53 +83,14 @@ def init_factors(n: int, rank: int, seed: int) -> np.ndarray:
     return (rng.standard_normal((n, rank)) / np.sqrt(rank)).astype(np.float32)
 
 
-def chunk_update(A, b, chunk, F_other, implicit: bool, alpha: float,
-                 pallas: Optional[bool] = None):
-    """Accumulate one chunk of padded rating rows into the normal equations.
 
-    Shared by the single-device and sharded paths so their math cannot
-    diverge. ``chunk`` = (row_entity [RC], other_idx [RC,W], vals [RC,W],
-    mask [RC,W]); row_entity sorted within the chunk. ``pallas`` selects
-    the kernel explicitly — callers tracing for a non-TPU mesh must pass
-    False, because ``jax.default_backend()`` is not a reliable proxy for
-    the platform the trace will run on (e.g. CPU shard_map under a
-    tunneled-TPU default backend).
-    """
-    import jax.numpy as jnp
-
-    re_, oi, r, m = chunk
-    F = F_other[oi]  # (RC, W, k) gather
-    if implicit:
-        # Hu et al.: c = 1 + α·r ; A gets Σ (c−1)·v vᵀ (the global Gram
-        # VᵀV is added outside); b gets Σ c·p·v with p=1.
-        w_outer = (alpha * r) * m
-        w_b = (1.0 + alpha * r) * m
-    else:
-        w_outer = m
-        w_b = r * m
-    # batched weighted Gram on the MXU (Pallas kernel on TPU fuses the
-    # weighting so the weighted copy of F never round-trips HBM)
-    from predictionio_tpu import ops
-
-    if pallas is None:
-        pallas = ops.use_pallas()
-    if pallas:
-        A_rows, b_rows = ops.rows_gram(F, w_outer, w_b)
-    else:
-        A_rows, b_rows = ops.rows_gram_xla(F, w_outer, w_b)
-    A = A.at[re_].add(A_rows, indices_are_sorted=True)
-    b = b.at[re_].add(b_rows, indices_are_sorted=True)
-    return A, b
-
-
-# -- bucketed single-device layout -------------------------------------------
+# -- bucketed layout ----------------------------------------------------------
 #
-# The padded-row layout above (still used by the sharded path) pays one
-# sorted scatter-add of ~nnz/W row partials per half-step; TPU scatter
-# measured ~140-200 ms per ML-20M half-step — comparable to all the
-# matmul work combined. The single-device path instead buckets entities
-# by padded rating count (powers of two), so each entity's normal
-# equations are ONE batch element of a dense batched Gram — no scatter
+# Round 1's padded-row layout paid one sorted scatter-add of ~nnz/W row
+# partials per half-step; TPU scatter measured ~140-200 ms per ML-20M
+# half-step — comparable to all the matmul work combined. Bucketing
+# entities by padded rating count instead makes each entity's normal
+# equations ONE batch element of a dense batched Gram — no scatter
 # anywhere. This is the "bucketed/padded rating blocks" design SURVEY.md
 # §7 anticipated. Entities live in count-descending permuted order
 # during training (so same-width entities are contiguous); factors are
@@ -266,11 +181,49 @@ def _perm_by_count_desc(counts: np.ndarray):
     return perm, inv
 
 
+def _merge_bounds(counts_sorted_list) -> tuple:
+    """Common bucket boundaries for one or many count-desc-sorted count
+    vectors: ``((nb_seg, n_slabs_seg), ((width, nb), … desc))``.
+
+    For the sharded path every device must run the SAME program, so
+    boundaries are the elementwise max over the devices' natural
+    boundaries. Placing a lighter entity in a wider bucket is always
+    safe (capacity ≥ count — see the argument in ``_bucket_side``), so
+    max-merging never breaks a device, only pads it.
+    """
+    nb_seg = max(int((c > _C_MAX).sum()) for c in counts_sorted_list)
+    rows_cap = 0
+    if nb_seg:
+        for c in counts_sorted_list:
+            rows = int(((c[:nb_seg] + _C_MAX - 1) // _C_MAX).sum())
+            rows_cap = max(rows_cap, rows, 1)
+    ladder = np.asarray(_LADDER, np.int64)
+    nbs: dict = {}
+    for c in counts_sorted_list:
+        rest = c[nb_seg:]
+        rest = rest[rest > 0]
+        if rest.size:
+            w, n = np.unique(ladder[np.searchsorted(ladder, rest)],
+                             return_counts=True)
+            for wi, ni in zip(w, n):
+                nbs[int(wi)] = max(nbs.get(int(wi), 0), int(ni))
+    regs = tuple(sorted(nbs.items(), reverse=True))
+    return ((nb_seg, rows_cap), regs)
+
+
 def _bucket_side(idx_self, idx_other_pos, vals, n_self, counts,
-                 perm, inv_perm) -> _BucketSide:
+                 perm, inv_perm, bounds=None) -> _BucketSide:
     """Bucket one orientation. ``idx_other_pos`` must already be mapped
-    to the other side's permuted positions; ``counts/perm/inv_perm``
-    come from :func:`_perm_by_count_desc` on this side's counts."""
+    to the other side's factor-row positions; ``counts/perm/inv_perm``
+    come from :func:`_perm_by_count_desc` on this side's counts.
+
+    ``bounds`` forces common bucket boundaries (sharded path: the
+    max-merge over all devices, so every device traces one program).
+    Forced boundaries are safe: the entity at permuted position p has
+    count ≤ every entity before it, and merged boundaries only ever
+    move p into a bucket at least as wide as its natural one — so
+    capacity C ≥ count always holds.
+    """
     nnz = idx_self.shape[0]
     pos = inv_perm[idx_self]
     order = np.argsort(pos, kind="stable")
@@ -280,44 +233,53 @@ def _bucket_side(idx_self, idx_other_pos, vals, n_self, counts,
     np.cumsum(counts_perm, out=starts[1:])
     within = (np.arange(nnz, dtype=np.int64) - starts[ps]).astype(np.int64)
 
-    n_nz = int((counts_perm > 0).sum())
+    if bounds is None:
+        bounds = _merge_bounds([counts_perm])
+    (nb_seg, rows_cap), regs = bounds
     buckets = []
 
     # heavy entities (count > _C_MAX): one SEGMENTED bucket — each
     # entity spans ceil(count/C) rows of width C; the one-hot ``seg``
     # matrix aggregates row partials per entity inside the compiled
     # program. Entities are count-descending, so these are positions
-    # 0..n_heavy-1 and the output concatenation order is preserved.
-    n_heavy = int((counts_perm > _C_MAX).sum())
-    if n_heavy:
+    # 0..nb_seg-1 and the output concatenation order is preserved.
+    if nb_seg:
         C = _C_MAX
-        cnts = counts_perm[:n_heavy]
-        rows_per = (cnts + C - 1) // C
-        row_starts = np.zeros(n_heavy + 1, np.int64)
+        cnts = counts_perm[:nb_seg]
+        rows_per = (cnts + C - 1) // C  # forced-in light entities: 1 row
+        row_starts = np.zeros(nb_seg + 1, np.int64)
         np.cumsum(rows_per, out=row_starts[1:])
         n_rows = int(row_starts[-1])
-        slab = max(1, _SLAB_ELEMS // C)
-        n_slabs = -(-n_rows // slab)
+        # slab capped at the (merged) row count: padding a small bucket
+        # to a full 64MB slab made every tiny block solve tens of
+        # thousands of identity systems
+        slab = max(1, min(_SLAB_ELEMS // C, rows_cap))
+        n_slabs = -(-rows_cap // slab)
+        assert n_rows <= n_slabs * slab
         R = n_slabs * slab
         oi = np.zeros((R, C), np.int32)
         vv = np.zeros((R, C), np.float32)
         mm = np.zeros((R, C), np.float32)
-        hi = int(starts[n_heavy])
+        hi = int(starts[nb_seg])
         row = row_starts[ps[:hi]] + within[:hi] // C
         col = within[:hi] % C
         oi[row, col] = o[:hi]
         vv[row, col] = v[:hi]
         mm[row, col] = 1.0
-        row_ent = np.repeat(np.arange(n_heavy), rows_per)
+        row_ent = np.repeat(np.arange(nb_seg), rows_per)
         # slab-local one-hot: entity index relative to the slab's first
         # entity (rows are entity-sorted → ≤ slab consecutive entities)
-        seg_off = row_ent[np.minimum(np.arange(n_slabs) * slab,
-                                     n_rows - 1)].astype(np.int32)
-        local = row_ent - seg_off[np.arange(n_rows) // slab]
-        seg = np.zeros((R, slab), np.float32)
-        seg[np.arange(n_rows), local] = 1.0  # pad rows stay all-zero
+        if n_rows:
+            seg_off = row_ent[np.minimum(np.arange(n_slabs) * slab,
+                                         n_rows - 1)].astype(np.int32)
+            local = row_ent - seg_off[np.arange(n_rows) // slab]
+            seg = np.zeros((R, slab), np.float32)
+            seg[np.arange(n_rows), local] = 1.0  # pad rows stay all-zero
+        else:  # a device with no ratings in the (forced) seg range
+            seg_off = np.zeros(n_slabs, np.int32)
+            seg = np.zeros((R, slab), np.float32)
         buckets.append(_Bucket(
-            C, n_heavy, slab, n_slabs,
+            C, nb_seg, slab, n_slabs,
             oi.reshape(n_slabs, slab, C),
             vv.reshape(n_slabs, slab, C),
             mm.reshape(n_slabs, slab, C),
@@ -325,39 +287,32 @@ def _bucket_side(idx_self, idx_other_pos, vals, n_self, counts,
             seg=seg.reshape(n_slabs, slab, slab),
             seg_off=seg_off))
 
-    # the rest: one row per entity, padded to the ladder width
-    widths = np.zeros(n_self, np.int64)
-    widths[:n_heavy] = 4 * _C_MAX  # sentinel keeping the array sorted
-    if n_nz > n_heavy:
-        ladder = np.asarray(_LADDER, np.int64)
-        widths[n_heavy:n_nz] = ladder[
-            np.searchsorted(ladder, counts_perm[n_heavy:n_nz])]
-    e = n_heavy
-    while e < n_nz:
-        C = int(widths[e])
-        e_end = int(np.searchsorted(-widths[:n_nz], -C, side="right"))
-        nb = e_end - e
-        slab = max(1, _SLAB_ELEMS // C)
+    # the rest: one row per entity, padded to the bucket width
+    e = nb_seg
+    for C, nb in regs:
+        slab = max(1, min(_SLAB_ELEMS // C, nb))
         n_slabs = -(-nb // slab)
         nb_pad = n_slabs * slab
         oi = np.zeros((nb_pad, C), np.int32)
         vv = np.zeros((nb_pad, C), np.float32)
         mm = np.zeros((nb_pad, C), np.float32)
-        lo, hi = int(starts[e]), int(starts[e_end])
+        # forced boundaries may extend past this device's entities
+        e_end = min(e + nb, n_self)
+        lo, hi = int(starts[min(e, n_self)]), int(starts[e_end])
         row = (ps[lo:hi] - e).astype(np.int64)
         col = within[lo:hi]
         oi[row, col] = o[lo:hi]
         vv[row, col] = v[lo:hi]
         mm[row, col] = 1.0
         cnt = np.zeros(nb_pad, np.float32)
-        cnt[:nb] = counts_perm[e:e_end]
+        cnt[: max(e_end - e, 0)] = counts_perm[e:e_end]
         buckets.append(_Bucket(
             C, nb, slab, n_slabs,
             oi.reshape(n_slabs, slab, C),
             vv.reshape(n_slabs, slab, C),
             mm.reshape(n_slabs, slab, C),
             cnt.reshape(n_slabs, slab)))
-        e = e_end
+        e += nb
     return _BucketSide(n_self, perm, inv_perm, buckets)
 
 
@@ -416,16 +371,6 @@ def als_prepare(coo: RatingsCOO) -> ALSPrepared:
     return ALSPrepared(coo.n_users, coo.n_items, coo.nnz, u_side, i_side)
 
 
-def _solve_psd(A, b):
-    """Batched SPD solve (the MXU replacement for MLlib's per-row LAPACK
-    dppsv). Delegates to the block-recursive batched Cholesky in
-    :mod:`predictionio_tpu.ops.cholesky` — XLA's ``cholesky`` +
-    ``triangular_solve`` lower to sequential column loops that measured
-    1.28 s for the ML-20M user batch on v5e (~70% of the iteration)."""
-    from predictionio_tpu.ops.cholesky import chol_solve_batched
-
-    return chol_solve_batched(A, b)
-
 
 def als_train(
     coo: RatingsCOO,
@@ -454,27 +399,28 @@ def als_train(
                               checkpoint_every=checkpoint_every)
 
 
-@functools.lru_cache(maxsize=8)
-def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
-                       rank: int, iterations: int, reg: float,
-                       implicit: bool, alpha: float, weighted_reg: bool):
-    """Build + jit the full bucketed training program for one problem
-    geometry. Caching on geometry means `pio eval` grid candidates that
-    share shapes recompile only when rank/iterations change.
+def _make_half(k: int, reg: float, implicit: bool, alpha: float,
+               weighted_reg: bool, pvary=None):
+    """Build the half-step program shared by the single-device and
+    sharded (shard_map) paths: ``half(F_other, bufs, geometry)`` — one
+    full re-solve of one side's factors from the other side's.
 
-    Per half-step, per bucket, per slab (a ``lax.scan`` step): gather
-    the (slab, C, k) factor block, one batched weighted-Gram einsum
-    (MXU), add ridge + implicit term, and write the slab's k×k systems
-    into the solve buffer; a single chunked scan then solves the whole
-    side with ONE instance of the block-recursive batched Cholesky
-    (compile-time bound — see ``_SOLVE_CHUNK``). No scatter anywhere in
-    the program. Catalogs too large for the solve buffer solve inside
-    each bucket body instead (memory flat in catalog size).
+    Per bucket, per slab (a ``lax.scan`` step): gather the (slab, C, k)
+    factor block, one batched weighted-Gram einsum (MXU), add ridge +
+    implicit term; all buckets emit their k×k systems into one solve
+    buffer and a single chunked scan solves the whole side with ONE
+    instance of the block-recursive batched Cholesky (compile-time
+    bound — see ``_SOLVE_CHUNK``). No scatter anywhere in the program.
+    Catalogs too large for the solve buffer solve inside each bucket
+    body instead (memory flat in catalog size).
+
+    ``pvary`` marks created constants as varying over the mesh axis
+    when tracing inside ``shard_map`` (vma typing); identity otherwise.
     """
     import jax
     import jax.numpy as jnp
 
-    k = rank
+    pv = pvary if pvary is not None else (lambda x: x)
     eye = jnp.eye(k, dtype=jnp.float32)
 
     from predictionio_tpu.ops.cholesky import chol_solve_batched
@@ -535,20 +481,21 @@ def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
                                                (off_s, 0))
             return (A_e, b_e), None
 
-        init = (jnp.zeros((nb + slab, k, k), jnp.float32),
-                jnp.zeros((nb + slab, k), jnp.float32))
+        init = (pv(jnp.zeros((nb + slab, k, k), jnp.float32)),
+                pv(jnp.zeros((nb + slab, k), jnp.float32)))
         (A_e, b_e), _ = jax.lax.scan(
             seg_body, init, (oi, vv, mm, seg, seg_off))
         return ridge(A_e[:nb], cnt, G), b_e[:nb]
 
-    def half_materialized(F_other, bufs, geometry, G, spans, n_chunks):
+    def half_materialized(F_other, bufs, geometry, G, spans, chunk,
+                          n_chunks):
         """Two-phase half-step: every bucket emits its (ridged) normal
         equations as scan outputs, concatenated into one solve buffer a
         single chunked scan then solves — ONE Cholesky instance in the
         program. Emitting via scan ``ys`` (not a carried buffer updated
         with dynamic_update_slice) matters: the carry pattern measured
         +116 ms per ML-20M half-step in buffer copies."""
-        N_pad = n_chunks * _SOLVE_CHUNK
+        N_pad = n_chunks * chunk
         n_self, bucket_geoms = geometry
         A_parts, b_parts = [], []
         for (C, nb, slab, n_slabs, is_seg), buf in zip(bucket_geoms, bufs):
@@ -573,9 +520,10 @@ def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
                 A_parts.append(A)
                 b_parts.append(b)
         if sum(spans) < N_pad:  # tail pad: identity systems, x = 0
-            A_parts.append(jnp.zeros((N_pad - sum(spans), k, k),
-                                     jnp.float32) + eye)
-            b_parts.append(jnp.zeros((N_pad - sum(spans), k), jnp.float32))
+            A_parts.append(pv(jnp.zeros((N_pad - sum(spans), k, k),
+                                        jnp.float32) + eye))
+            b_parts.append(pv(jnp.zeros((N_pad - sum(spans), k),
+                                        jnp.float32)))
         A_all = jnp.concatenate(A_parts) if len(A_parts) > 1 else A_parts[0]
         b_all = jnp.concatenate(b_parts) if len(b_parts) > 1 else b_parts[0]
         if n_chunks == 1:
@@ -583,8 +531,8 @@ def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
         else:
             _, xc = jax.lax.scan(
                 lambda _, ab: (None, chol_solve_batched(*ab)), None,
-                (A_all.reshape(n_chunks, _SOLVE_CHUNK, k, k),
-                 b_all.reshape(n_chunks, _SOLVE_CHUNK, k)))
+                (A_all.reshape(n_chunks, chunk, k, k),
+                 b_all.reshape(n_chunks, chunk, k)))
             x_all = xc.reshape(N_pad, k)
         outs, off, total = [], 0, 0
         for (C, nb, slab, n_slabs, is_seg), span in zip(bucket_geoms, spans):
@@ -592,8 +540,10 @@ def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
             off += span
             total += nb
         if total < n_self:  # zero-rating tail entities → zero factors
-            outs.append(jnp.zeros((n_self - total, k), jnp.float32))
-        return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+            outs.append(pv(jnp.zeros((n_self - total, k), jnp.float32)))
+        out = jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+        # forced (merged) boundaries can exceed n_self; extras are zeros
+        return out[:n_self] if total > n_self else out
 
     def half(F_other, bufs, geometry):
         n_self, bucket_geoms = geometry
@@ -606,10 +556,13 @@ def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
         # exact rows once, regular buckets emit their padded slabs
         spans = [nb if is_seg else n_slabs * slab
                  for (C, nb, slab, n_slabs, is_seg) in bucket_geoms]
-        n_chunks = max(1, -(-sum(spans) // _SOLVE_CHUNK))
-        if n_chunks * _SOLVE_CHUNK * k * k * 4 <= _SOLVE_BUF_MB << 20:
+        # solve chunk shrinks for small sides (sharded per-device
+        # blocks) so the floor isn't thousands of padded identity solves
+        chunk = min(_SOLVE_CHUNK, max(256, -(-sum(spans) // 256) * 256))
+        n_chunks = max(1, -(-sum(spans) // chunk))
+        if n_chunks * chunk * k * k * 4 <= _SOLVE_BUF_MB << 20:
             return half_materialized(F_other, bufs, geometry, G, spans,
-                                     n_chunks)
+                                     chunk, n_chunks)
         # huge catalog: solve inside each bucket body (memory flat in
         # catalog size; compiles one Cholesky per bucket)
         outs = []
@@ -635,8 +588,27 @@ def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
             outs.append(x)
             total += nb
         if total < n_self:  # zero-rating tail entities → zero factors
-            outs.append(jnp.zeros((n_self - total, k), jnp.float32))
-        return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+            outs.append(pv(jnp.zeros((n_self - total, k), jnp.float32)))
+        out = jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+        return out[:n_self] if total > n_self else out
+
+    return half
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
+                       rank: int, iterations: int, reg: float,
+                       implicit: bool, alpha: float, weighted_reg: bool):
+    """Build + jit the full single-device training program for one
+    problem geometry (two `_make_half` programs under one iteration
+    scan). Caching on geometry means `pio eval` grid candidates that
+    share shapes recompile only when rank/iterations change."""
+    import jax
+    import jax.numpy as jnp
+
+    k = rank
+    half = _make_half(k, float(reg), bool(implicit), float(alpha),
+                      bool(weighted_reg))
 
     def train(u_bufs, i_bufs, V0p):
         if iterations == 0:

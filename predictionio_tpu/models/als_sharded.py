@@ -3,75 +3,58 @@
 This is the TPU replacement for MLlib ALS's block-partitioned
 shuffle-join (reference behavior: Spark ALS ``InBlock``/``OutBlock``
 structures exchanged over the shuffle each half-iteration — SURVEY.md
-§2d P2/C1). Layout:
+§2d P2/C1), running the SAME bucketed MXU kernel as the single-device
+path (:func:`predictionio_tpu.models.als._make_half`):
 
 - Users (and items) are range-partitioned into ``n_dev`` equal blocks;
-  each device owns one block of U rows and one of V rows.
-- Ratings are laid out TWICE on the host in the padded-row format of
-  :mod:`predictionio_tpu.models.als` (see ``rows_layout``), partitioned
-  to match: device d holds the rating rows of d's users (by-user copy)
-  and of d's items (by-item copy), with entity indices block-local.
-  This replaces the shuffle — partitioning happens once at data-prep
+  each device owns one block of U rows and one of V rows, kept in
+  count-descending PERMUTED order for the whole run (un-permuted once
+  on the host at the end).
+- Each device's rating rows are laid out in the bucketed format of
+  :mod:`predictionio_tpu.models.als` — entity-width ladder, segmented
+  heavy bucket, batched weighted-Gram einsums, one chunked Cholesky
+  solve pass — with bucket boundaries MAX-MERGED across devices
+  (:func:`als._merge_bounds`) so every device traces one identical
+  program. Other-side indices are pre-mapped on the host to the
+  counterpart's permuted GLOBAL positions, so the gathered factor
+  matrix is indexed directly — partitioning happens once at data-prep
   time, not per iteration.
 - Each half-step inside ``shard_map``: one ``all_gather`` of the
-  counterpart factor block over the ``data`` axis (the only collective —
-  riding ICI), then purely local batched-matmul row accumulation and a
-  batched Cholesky solve for the local block.
+  counterpart factor blocks over the ``data`` axis (the only
+  collective — riding ICI), then purely local bucketed Gram + solve
+  for the local block.
 - The full iteration loop is a single ``lax.scan`` under one jit: zero
   host round-trips, 2 all_gathers per iteration of size n·k.
 
-Per-device memory: (block_e, k, k) normal matrices + the full counterpart
-factor matrix — the same asymptotics as MLlib's per-executor blocks.
+Per-device memory: the local solve buffer (≤ block·k² floats, chunked)
+plus the full counterpart factor matrix — the same asymptotics as
+MLlib's per-executor blocks.
+
+The previous padded-row + scatter-add layout this replaces measured
+~40% of each iteration in TPU scatter cost and solved through XLA's
+sequential Cholesky lowering; the bucketed port brings the sharded
+path to parity with the round-2 single-chip redesign (VERDICT r2
+ask #3).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from dataclasses import dataclass
+from typing import List, Tuple
 
 import numpy as np
 
 from predictionio_tpu.models.als import (
     ALSParams,
     RatingsCOO,
-    _counts,
-    _row_chunk,
-    _solve_psd,
-    chunk_update,
+    _bucket_side,
+    _BucketSide,
+    _make_half,
+    _merge_bounds,
+    _perm_by_count_desc,
     init_factors,
-    rows_layout,
 )
-
-
-def _partition_rows(
-    idx_self: np.ndarray, idx_other: np.ndarray, vals: np.ndarray,
-    block: int, n_dev: int, width: int, chunk_rows: int,
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-    """Per-device padded-row layouts, equalized to the same row count.
-
-    Returns arrays shaped [n_dev, n_chunks, RC(, W)]: (row_entity
-    block-local, other_idx, vals, mask).
-    """
-    owner = idx_self // block
-    layouts = []
-    for d in range(n_dev):
-        sel = owner == d
-        layouts.append(rows_layout(
-            (idx_self[sel] - d * block).astype(np.int32),
-            idx_other[sel].astype(np.int32),
-            vals[sel].astype(np.float32),
-            block, width, chunk_rows))
-    R = max(l[0].shape[0] for l in layouts)
-    outs = []
-    for j, fill in enumerate((block - 1, 0, 0.0, 0.0)):
-        dtype = layouts[0][j].dtype
-        shape = (n_dev, R) + layouts[0][j].shape[1:]
-        arr = np.full(shape, fill, dtype)
-        for d, l in enumerate(layouts):
-            arr[d, : l[j].shape[0]] = l[j]
-        n_chunks = R // chunk_rows
-        outs.append(arr.reshape((n_dev, n_chunks, chunk_rows) + shape[2:]))
-    return tuple(outs)  # type: ignore[return-value]
 
 
 def _pad_rows(arr: np.ndarray, n: int) -> np.ndarray:
@@ -81,13 +64,130 @@ def _pad_rows(arr: np.ndarray, n: int) -> np.ndarray:
     return np.concatenate([arr, pad], axis=0)
 
 
+@dataclass
+class ALSShardedPrepared:
+    """Per-device bucketed layouts with common (max-merged) geometry."""
+
+    n_users: int
+    n_items: int
+    nnz: int
+    n_dev: int
+    block_u: int
+    block_i: int
+    u_sides: List[_BucketSide]  # one per device, identical geometry
+    i_sides: List[_BucketSide]
+    _device_bufs: dict = None  # type: ignore[assignment]
+
+    @property
+    def geom_u(self):
+        return self.u_sides[0].geometry
+
+    @property
+    def geom_i(self):
+        return self.i_sides[0].geometry
+
+    def _stacked(self, sides: List[_BucketSide]):
+        """Per-bucket arrays stacked over the leading device dim."""
+        out = []
+        for j in range(len(sides[0].buckets)):
+            bs = [s.buckets[j] for s in sides]
+            arrs = [np.stack([b.other_idx for b in bs]),
+                    np.stack([b.vals for b in bs]),
+                    np.stack([b.mask for b in bs]),
+                    np.stack([b.counts for b in bs])]
+            if bs[0].seg is not None:
+                arrs += [np.stack([b.seg for b in bs]),
+                         np.stack([b.seg_off for b in bs])]
+            out.append(tuple(arrs))
+        return tuple(out)
+
+    def device_buffers(self, mesh):
+        """Stacked layouts placed on the mesh, cached per mesh — a
+        reused prep (e.g. a `pio eval` grid over rank/reg candidates)
+        must not re-copy and re-upload GBs of rating layout per train
+        call (mirrors ALSPrepared.device_buffers)."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        if self._device_bufs is None:
+            self._device_bufs = {}
+        if mesh not in self._device_bufs:
+            def put(tree):
+                return tuple(
+                    tuple(jax.device_put(a, NamedSharding(
+                        mesh, P("data", *([None] * (a.ndim - 1)))))
+                        for a in bkt)
+                    for bkt in tree)
+
+            self._device_bufs[mesh] = (put(self._stacked(self.u_sides)),
+                                       put(self._stacked(self.i_sides)))
+        return self._device_bufs[mesh]
+
+
+def _device_perms(idx, block, n_dev):
+    """Per-device local counts and count-desc permutations, plus the
+    map from ORIGINAL global entity id → permuted global position
+    (owner_block_start + inv_perm_owner[local_id]). Computed ONCE per
+    side: the layout builder and the other side's index mapping must
+    agree on these permutations exactly."""
+    counts = np.bincount(idx, minlength=block * n_dev).astype(np.int64)
+    locs, perms, invs = [], [], []
+    pos = np.empty(block * n_dev, np.int32)
+    for d in range(n_dev):
+        c = counts[d * block:(d + 1) * block]
+        perm, inv = _perm_by_count_desc(c.astype(np.float32))
+        locs.append(c)
+        perms.append(perm)
+        invs.append(inv)
+        pos[d * block:(d + 1) * block] = d * block + inv
+    return locs, perms, invs, pos
+
+
+def _side_prepared(idx_self, idx_other, vals, block, n_dev,
+                   locs, perms, invs, other_pos):
+    """Build all devices' bucketed layouts for one orientation.
+
+    ``other_pos[j]`` maps an ORIGINAL other-entity id to its permuted
+    global position in the gathered factor matrix."""
+    owner = idx_self // block
+    bounds = _merge_bounds([locs[d][perms[d]] for d in range(n_dev)])
+    sides = []
+    for d in range(n_dev):
+        sel = owner == d
+        sides.append(_bucket_side(
+            (idx_self[sel] - d * block).astype(np.int32),
+            other_pos[idx_other[sel]].astype(np.int32),
+            vals[sel].astype(np.float32),
+            block, locs[d].astype(np.float32), perms[d], invs[d],
+            bounds=bounds))
+    geom = sides[0].geometry
+    assert all(s.geometry == geom for s in sides), \
+        "max-merged bounds must give every device the same geometry"
+    return sides
+
+
+def als_prepare_sharded(coo: RatingsCOO, n_dev: int) -> ALSShardedPrepared:
+    """Host-side layout construction for the sharded path (the analogue
+    of MLlib's InBlock build, partitioned; done once per dataset)."""
+    block_u = -(-coo.n_users // n_dev)  # ceil
+    block_i = -(-coo.n_items // n_dev)
+
+    ulocs, uperms, uinvs, upos = _device_perms(coo.user_idx, block_u, n_dev)
+    ilocs, iperms, iinvs, ipos = _device_perms(coo.item_idx, block_i, n_dev)
+
+    u_sides = _side_prepared(coo.user_idx, coo.item_idx, coo.rating,
+                             block_u, n_dev, ulocs, uperms, uinvs, ipos)
+    i_sides = _side_prepared(coo.item_idx, coo.user_idx, coo.rating,
+                             block_i, n_dev, ilocs, iperms, iinvs, upos)
+    return ALSShardedPrepared(coo.n_users, coo.n_items, coo.nnz, n_dev,
+                              block_u, block_i, u_sides, i_sides)
+
+
 @functools.lru_cache(maxsize=8)
-def _compiled_sharded(mesh, n_dev: int, block_u: int, block_i: int,
-                      rank: int, iterations: int, reg: float, implicit: bool,
-                      alpha: float, weighted_reg: bool,
-                      pallas: bool = False):
-    # ``pallas`` keys the cache so flipping PIO_NO_PALLAS mid-process
-    # takes effect (chunk_update branches on it at trace time)
+def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,
+                      reg: float, implicit: bool, alpha: float,
+                      weighted_reg: bool):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -96,122 +196,85 @@ def _compiled_sharded(mesh, n_dev: int, block_u: int, block_i: int,
 
     shard_map = get_shard_map()
     k = rank
-    eye = jnp.eye(k, dtype=jnp.float32)
+    block_u, u_buckets = geom_u
+    half = _make_half(k, reg, implicit, alpha, weighted_reg,
+                      pvary=lambda x: pvary(x, "data"))
 
-    def _pvary(x):
-        return pvary(x, "data")
-
-    def local_normal_eq(F_full, chunks, n_local):
-        """Accumulate A [n_local,k,k], b [n_local,k] from this device's
-        rating rows (row_entity already block-local). Same math as the
-        single-device path via the shared chunk_update."""
-        A0 = _pvary(jnp.zeros((n_local, k, k), jnp.float32))
-        b0 = _pvary(jnp.zeros((n_local, k), jnp.float32))
-
-        def body(carry, chunk):
-            return chunk_update(*carry, chunk, F_full, implicit, alpha,
-                                pallas), None
-
-        (A, b), _ = jax.lax.scan(body, (A0, b0), chunks)
-        return A, b
-
-    def reg_term(cnt):
-        lam = reg * cnt if weighted_reg else jnp.full_like(cnt, reg)
-        lam = jnp.where(cnt > 0, jnp.maximum(lam, 1e-8), 1.0)
-        return lam[:, None, None] * eye
-
-    def body(u_re, u_oi, u_v, u_m, i_re, i_oi, i_v, i_m, cnt_u, cnt_i, V0):
-        # inside shard_map: leading device dim is local size 1 → squeeze
-        u_chunks = (u_re[0], u_oi[0], u_v[0], u_m[0])
-        i_chunks = (i_re[0], i_oi[0], i_v[0], i_m[0])
-        Ru = reg_term(cnt_u[0])
-        Ri = reg_term(cnt_i[0])
-        V_l = V0  # [block_i, k] local block (spec splits rows)
+    def body(u_bufs, i_bufs, V0_l):
+        # inside shard_map the stacked arrays arrive with a local
+        # leading device dim of 1 → squeeze it
+        u_l = tuple(tuple(a[0] for a in bkt) for bkt in u_bufs)
+        i_l = tuple(tuple(a[0] for a in bkt) for bkt in i_bufs)
 
         def step(carry, _):
             U_l, V_l = carry
             V_full = jax.lax.all_gather(V_l, "data", tiled=True)
-            A, b = local_normal_eq(V_full, u_chunks, block_u)
-            if implicit:
-                A = A + (V_full.T @ V_full)[None, :, :]
-            U_l = _solve_psd(A + Ru, b)
+            U_l = half(V_full, u_l, geom_u)
             U_full = jax.lax.all_gather(U_l, "data", tiled=True)
-            A, b = local_normal_eq(U_full, i_chunks, block_i)
-            if implicit:
-                A = A + (U_full.T @ U_full)[None, :, :]
-            V_l = _solve_psd(A + Ri, b)
+            V_l = half(U_full, i_l, geom_i)
             return (U_l, V_l), None
 
-        # mark the zero carry as varying over the mesh axis (vma typing)
-        U0_l = _pvary(jnp.zeros((block_u, k), jnp.float32))
-        (U_l, V_l), _ = jax.lax.scan(step, (U0_l, V_l), None, length=iterations)
+        U0 = pvary(jnp.zeros((block_u, k), jnp.float32), "data")
+        (U_l, V_l), _ = jax.lax.scan(step, (U0, V0_l), None,
+                                     length=iterations)
         return U_l, V_l
 
-    rows4 = P("data", None, None, None)
-    rows3 = P("data", None, None)
+    def bucket_specs(buckets):
+        specs = []
+        for (C, nb, slab, n_slabs, is_seg) in buckets:
+            s = [P("data", None, None, None)] * 3          # oi, vals, mask
+            s.append(P("data", None) if is_seg
+                     else P("data", None, None))           # counts
+            if is_seg:
+                s += [P("data", None, None, None),         # seg
+                      P("data", None)]                     # seg_off
+            specs.append(tuple(s))
+        return tuple(specs)
+
     fn = shard_map(
         body, mesh=mesh,
-        in_specs=(rows3, rows4, rows4, rows4, rows3, rows4, rows4, rows4,
-                  P("data", None), P("data", None), P("data", None)),
+        in_specs=(bucket_specs(geom_u[1]), bucket_specs(geom_i[1]),
+                  P("data", None)),
         out_specs=(P("data", None), P("data", None)),
     )
     return jax.jit(fn)
 
 
-def als_train_sharded(
-    coo: RatingsCOO, p: ALSParams, mesh
+def als_train_sharded_prepared(
+    prep: ALSShardedPrepared, p: ALSParams, mesh
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Train ALS over the mesh's ``data`` axis; returns full (U, V)."""
     import jax
-
-    n_dev = int(np.prod(mesh.devices.shape))
-    if "data" not in mesh.axis_names:
-        raise ValueError(f"mesh must have a 'data' axis, got {mesh.axis_names}")
-
-    block_u = -(-coo.n_users // n_dev)  # ceil
-    block_i = -(-coo.n_items // n_dev)
-    n_users_p, n_items_p = block_u * n_dev, block_i * n_dev
-    W = p.row_width
-    RC = _row_chunk(p.rank)
-
-    u_parts = _partition_rows(coo.user_idx, coo.item_idx, coo.rating,
-                              block_u, n_dev, W, RC)
-    i_parts = _partition_rows(coo.item_idx, coo.user_idx, coo.rating,
-                              block_i, n_dev, W, RC)
-
-    cnt_u = _pad_rows(_counts(coo.user_idx, coo.n_users), n_users_p)
-    cnt_i = _pad_rows(_counts(coo.item_idx, coo.n_items), n_items_p)
-
-    # identical init to the single-device path; padding rows zeroed so
-    # they contribute nothing to the first implicit Gram term
-    V0 = _pad_rows(init_factors(coo.n_items, p.rank, p.seed), n_items_p)
-
-    from predictionio_tpu import ops
-
-    # key Pallas on the MESH devices, not jax.default_backend(): a CPU
-    # mesh can be traced while the default backend is a tunneled TPU
-    # (and vice versa)
-    mesh_is_tpu = all(d.platform == "tpu" for d in mesh.devices.flat)
-    pallas = ops.use_pallas("tpu" if mesh_is_tpu else "cpu")
-    train = _compiled_sharded(
-        mesh, n_dev, block_u, block_i,
-        p.rank, p.iterations, float(p.reg), bool(p.implicit), float(p.alpha),
-        bool(p.weighted_reg), pallas)
-
-    # place inputs directly onto the mesh with their shard_map layouts —
-    # never through the default backend (which may be a different
-    # platform, e.g. the tunneled TPU while training on a CPU mesh)
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
-    shardings = [NamedSharding(mesh, P("data", *([None] * (a.ndim - 1))))
-                 for a in (*u_parts, *i_parts)]
-    args = [jax.device_put(a, s) for a, s in zip((*u_parts, *i_parts), shardings)]
-    rows = NamedSharding(mesh, P("data", None))
-    args += [jax.device_put(cnt_u.reshape(n_dev, block_u), rows),
-             jax.device_put(cnt_i.reshape(n_dev, block_i), rows),
-             jax.device_put(V0, rows)]
-    U, V = train(*args)
+    n_dev = prep.n_dev
+    block_u, block_i = prep.block_u, prep.block_i
+    if int(np.prod(mesh.devices.shape)) != n_dev:
+        raise ValueError(
+            f"layout was prepared for {n_dev} devices but the mesh has "
+            f"{int(np.prod(mesh.devices.shape))}")
+
+    train = _compiled_sharded(
+        mesh, prep.geom_u, prep.geom_i,
+        p.rank, p.iterations, float(p.reg), bool(p.implicit),
+        float(p.alpha), bool(p.weighted_reg))
+
+    # inputs are placed directly onto the mesh with their shard_map
+    # layouts (cached per mesh) — never through the default backend
+    # (which may be a different platform, e.g. the tunneled TPU while
+    # training on a CPU mesh)
+    u_bufs, i_bufs = prep.device_buffers(mesh)
+
+    # identical init to the single-device path, per-device permuted so
+    # the resident factor order matches the bucketed layouts
+    V0g = _pad_rows(init_factors(prep.n_items, p.rank, p.seed),
+                    block_i * n_dev)
+    V0p = np.concatenate([
+        V0g[d * block_i:(d + 1) * block_i][prep.i_sides[d].perm]
+        for d in range(n_dev)])
+    V0 = jax.device_put(V0p, NamedSharding(mesh, P("data", None)))
+
+    U, V = train(u_bufs, i_bufs, V0)
 
     def fetch(x):
         # multi-host: the result spans non-addressable devices — gather
@@ -223,4 +286,20 @@ def als_train_sharded(
             return np.asarray(multihost_utils.process_allgather(x, tiled=True))
         return np.asarray(x)
 
-    return (fetch(U)[: coo.n_users], fetch(V)[: coo.n_items])
+    def unpermute(xp, sides, block, n):
+        blocks = [xp[d * block:(d + 1) * block][sides[d].inv_perm]
+                  for d in range(n_dev)]
+        return np.concatenate(blocks)[:n]
+
+    return (unpermute(fetch(U), prep.u_sides, block_u, prep.n_users),
+            unpermute(fetch(V), prep.i_sides, block_i, prep.n_items))
+
+
+def als_train_sharded(
+    coo: RatingsCOO, p: ALSParams, mesh
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Train ALS over the mesh's ``data`` axis; returns full (U, V)."""
+    n_dev = int(np.prod(mesh.devices.shape))
+    if "data" not in mesh.axis_names:
+        raise ValueError(f"mesh must have a 'data' axis, got {mesh.axis_names}")
+    return als_train_sharded_prepared(als_prepare_sharded(coo, n_dev), p, mesh)

@@ -1,6 +1,12 @@
 """Batched weighted Gram accumulation — the ALS inner op, as a Pallas kernel.
 
-Per padded rating row r (layout: models/als.py ``rows_layout``):
+NOTE: since the bucketed-layout rework, ALS training builds its Grams
+with plain XLA einsums inside ``models/als.py _make_half`` (XLA fuses
+the weighting there); this kernel is kept as the Pallas reference
+implementation of the fused weighted Gram (exercised by tests/test_ops)
+for when a hand-fused variant is needed again.
+
+Per padded rating row r:
 
     A_r = Fᵣᵀ · diag(w_outer[r]) · Fᵣ     (k×k)
     b_r = Fᵣᵀ · w_b[r]                    (k)
