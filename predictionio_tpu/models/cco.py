@@ -22,7 +22,7 @@ TPU-first redesign:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -174,8 +174,9 @@ def score_user(
     """Score all items for one user from their per-event history.
 
     score(j) = Σ_e boost_e · Σ_{h ∈ history_e} [h ∈ indicators_e(j)] · llr
-    — the host-side analogue of the reference's Elasticsearch
-    similarity query over indicator fields.
+    — the host-side reference implementation of the scoring math (kept
+    for parity tests); serving uses :class:`CCOResidentScorer`, the
+    one-dispatch device path.
     """
     scores = np.zeros(n_items, np.float32)
     for name, hist in history.items():
@@ -189,3 +190,112 @@ def score_user(
         contrib = (np.where(mask, vals, 0.0)).sum(axis=1)
         scores += boost * contrib
     return scores
+
+
+class CCOResidentScorer:
+    """Universal-Recommender serving with indicators resident on device.
+
+    The reference serves UR queries as an Elasticsearch similarity query
+    over indicator fields (SURVEY.md §2c config 4); round 2 of this
+    framework scanned the indicator matrix with host numpy per request.
+    Here the per-event indicator arrays (item → top-k correlated items +
+    LLR weights) live in HBM across requests, and each query is ONE
+    compiled dispatch — history bitmap, gather, weighted sum, popularity
+    cold-start fallback, top-k — returning a single packed array so the
+    host pays exactly one device→host fetch (the same one-dispatch
+    doctrine as :class:`predictionio_tpu.models.als.ResidentScorer`).
+    """
+
+    _MIN_H = 16  # history padding bucket floor (bounds recompiles)
+
+    def __init__(self, indicators: Dict[str, Tuple[np.ndarray, np.ndarray]],
+                 n_items: int, popularity: np.ndarray) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if n_items >= 1 << 24:
+            # the packed single-fetch output carries item indices in
+            # f32 (exact integers only below 2^24) — same bound as
+            # als.ResidentScorer
+            raise ValueError(
+                "CCOResidentScorer supports catalogs < 2^24 items")
+        self.events = sorted(indicators)
+        self.n_items = n_items
+        self._idxs = tuple(
+            jax.device_put(jnp.asarray(indicators[e][0], jnp.int32))
+            for e in self.events)
+        vals = []
+        for e in self.events:
+            v = indicators[e][1]
+            vals.append(jax.device_put(jnp.asarray(
+                np.where(np.isfinite(v), v, 0.0), jnp.float32)))
+        self._vals = tuple(vals)
+        self._pop = jax.device_put(jnp.asarray(popularity, jnp.float32))
+        self._fns: Dict[Tuple[int, int], Any] = {}
+
+    def _fn(self, H: int, k: int):
+        """Compiled scorer for one (history-pad, top-k) shape."""
+        if (H, k) in self._fns:
+            return self._fns[(H, k)]
+        import jax
+        import jax.numpy as jnp
+
+        n_items = self.n_items
+
+        def run(idxs, vals, pop, hists, mask, boosts):
+            scores = jnp.zeros((n_items,), jnp.float32)
+            for e, (ix, vv) in enumerate(zip(idxs, vals)):
+                # membership bitmap over the catalog, then one gather
+                # along the indicator lists — no per-row set scans
+                bitmap = jnp.zeros((n_items,), jnp.float32).at[
+                    hists[e]].max(mask[e])
+                scores = scores + boosts[e] * (bitmap[ix] * vv).sum(axis=1)
+            # cold start / no indicator hits → popularity ranking
+            scores = jnp.where((scores > 0).any(), scores, pop)
+            vals_k, idx_k = jax.lax.top_k(scores, k)
+            # pack into ONE output array: one host fetch per query
+            return jnp.concatenate([vals_k, idx_k.astype(jnp.float32)])
+
+        fn = jax.jit(run)
+        self._fns[(H, k)] = fn
+        return fn
+
+    def recommend(
+        self,
+        history: Dict[str, Sequence[int]],
+        num: int,
+        boosts: Optional[Dict[str, float]] = None,
+        banned: Optional[Sequence[int]] = None,
+    ) -> List[Tuple[int, float]]:
+        """Top-``num`` (item_idx, score) pairs, scores > 0 only."""
+        import jax.numpy as jnp
+
+        banned_set = set(int(b) for b in (banned or ()))
+        max_h = max((len(history.get(e, ())) for e in self.events),
+                    default=0)
+        H = self._MIN_H
+        while H < max_h:
+            H *= 2
+        hists = np.zeros((len(self.events), H), np.int32)
+        mask = np.zeros((len(self.events), H), np.float32)
+        bvec = np.ones(len(self.events), np.float32)
+        for e, name in enumerate(self.events):
+            h = list(history.get(name, ()))[:H]
+            hists[e, :len(h)] = h
+            mask[e, :len(h)] = 1.0
+            if boosts and name in boosts:
+                bvec[e] = boosts[name]
+        want = min(num + len(banned_set), self.n_items)
+        k = 16
+        while k < want:
+            k *= 2
+        k = min(k, self.n_items)
+        packed = np.asarray(self._fn(H, k)(
+            self._idxs, self._vals, self._pop,
+            jnp.asarray(hists), jnp.asarray(mask), jnp.asarray(bvec)))
+        vals_k, idx_k = packed[:k], packed[k:].astype(np.int32)
+        out = []
+        for i, v in zip(idx_k, vals_k):
+            if v > 0 and int(i) not in banned_set and len(out) < num:
+                out.append((int(i), float(v)))
+        return out

@@ -144,6 +144,11 @@ from predictionio_tpu.storage import remote as _remote  # noqa: E402
 
 _remote.register_all()
 
+# the embedded indexed store registers the reference's ELASTICSEARCH type
+from predictionio_tpu.storage import indexed as _indexed  # noqa: E402
+
+_indexed.register_all()
+
 
 def _ensure(home: str) -> str:
     os.makedirs(home, exist_ok=True)

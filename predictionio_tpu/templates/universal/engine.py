@@ -32,7 +32,8 @@ from predictionio_tpu.controller import (
     WorkflowContext,
 )
 from predictionio_tpu.data import store as event_store
-from predictionio_tpu.models.cco import CCOParams, cco_indicators, score_user
+from predictionio_tpu.models.cco import (CCOParams, CCOResidentScorer,
+                                         cco_indicators)
 from predictionio_tpu.utils.bimap import BiMap
 
 
@@ -88,31 +89,41 @@ class URModel:
         self.primary_event = primary_event
         self.params = params
         self.popularity = popularity
+        self._scorer: Optional[CCOResidentScorer] = None
+
+    def __getstate__(self):
+        # device buffers + compiled functions don't serialize; the
+        # scorer rebuilds lazily after model load
+        d = dict(self.__dict__)
+        d["_scorer"] = None
+        return d
+
+    @property
+    def scorer(self) -> CCOResidentScorer:
+        """Device-resident scorer (built lazily: a model fresh out of
+        deserialization gets its indicator arrays back into HBM on the
+        first query, like ResidentScorer for ALS)."""
+        # getattr: models pickled before the scorer existed have no
+        # _scorer attribute at all
+        if getattr(self, "_scorer", None) is None:
+            self._scorer = CCOResidentScorer(
+                self.indicators, len(self.item_ids), self.popularity)
+        return self._scorer
 
     def query_user(self, user: str, num: int,
                    boosts: Optional[Dict[str, float]] = None,
                    black_list: Optional[List[str]] = None) -> List[Dict[str, Any]]:
-        hist = self.user_history.get(user)
-        n_items = len(self.item_ids)
-        if hist:
-            scores = score_user(self.indicators, hist, n_items,
-                                boosts or self.params.event_boosts or None)
-            if not scores.any():
-                scores = self.popularity.copy()
-        else:
-            scores = self.popularity.copy()  # cold start
+        hist = self.user_history.get(user) or {}
         banned = {self.item_ids[b] for b in (black_list or [])
                   if b in self.item_ids}
         # exclude the user's own primary-event items (don't re-recommend buys)
-        if hist:
-            banned.update(hist.get(self.primary_event, []))
-        if banned:
-            scores[list(banned)] = -np.inf
-        num = min(num, n_items)
-        top = np.argpartition(-scores, num - 1)[:num]
-        top = top[np.argsort(-scores[top])]
-        return [{"item": self._inv[int(i)], "score": float(scores[i])}
-                for i in top if np.isfinite(scores[i]) and scores[i] > 0]
+        banned.update(hist.get(self.primary_event, []))
+        # ONE device dispatch: bitmap+gather+sum+popularity-fallback+top-k
+        hits = self.scorer.recommend(
+            hist, num, boosts or self.params.event_boosts or None,
+            banned=sorted(banned))
+        return [{"item": self._inv[i], "score": score}
+                for i, score in hits]
 
     def query_item(self, item: str, num: int) -> List[Dict[str, Any]]:
         iidx = self.item_ids.get(item)

@@ -81,6 +81,47 @@ class TestCCO:
         assert scores[1] == 0.0  # item 1's indicators {0,2}: no 1
         assert scores[2] == 0.0  # all -inf masked
 
+    def test_resident_scorer_matches_host_reference(self):
+        """The one-dispatch device scorer must reproduce score_user's
+        math (hits, boosts, multi-event) plus the popularity fallback
+        and ban filtering the template layer used to do host-side."""
+        from predictionio_tpu.models.cco import CCOResidentScorer
+
+        rng = np.random.default_rng(3)
+        n_items, k = 50, 6
+        indicators = {}
+        for name in ("buy", "view"):
+            idxs = rng.integers(0, n_items, (n_items, k)).astype(np.int32)
+            vals = rng.uniform(0.5, 9.0, (n_items, k)).astype(np.float32)
+            vals[rng.random((n_items, k)) < 0.3] = -np.inf
+            indicators[name] = (idxs, vals)
+        pop = rng.uniform(0, 1, n_items).astype(np.float32)
+        scorer = CCOResidentScorer(indicators, n_items, pop)
+
+        history = {"buy": [3, 7, 11], "view": [2, 3]}
+        boosts = {"view": 0.5}
+        ref = score_user(indicators, history, n_items, boosts)
+        hits = scorer.recommend(history, 10, boosts)
+        assert hits, "dense random indicators must produce hits"
+        order = np.argsort(-ref, kind="stable")
+        expect = [(int(i), float(ref[i])) for i in order[:10] if ref[i] > 0]
+        got_idx = [i for i, _ in hits]
+        assert got_idx == [i for i, _ in expect]
+        np.testing.assert_allclose([v for _, v in hits],
+                                   [v for _, v in expect], rtol=1e-5)
+
+        # banned items are over-fetched around, not just dropped
+        banned = got_idx[:3]
+        hits2 = scorer.recommend(history, 10, boosts, banned=banned)
+        assert not set(banned) & {i for i, _ in hits2}
+        assert len(hits2) == min(10, len([i for i in order
+                                          if ref[i] > 0]) - 3)
+
+        # cold start: empty history ranks by popularity
+        cold = scorer.recommend({}, 5)
+        assert [i for i, _ in cold] == list(np.argsort(-pop,
+                                                       kind="stable")[:5])
+
 
 def seed_ur(storage, app_name="URApp"):
     app = storage.meta.create_app(app_name)

@@ -23,12 +23,19 @@ def _native_store(tmp_path):
         pytest.skip(str(e))
 
 
-@pytest.fixture(params=["memory", "sqlite", "format_sql", "eventlog"])
+@pytest.fixture(params=["memory", "sqlite", "format_sql", "eventlog", "es"])
 def store(request, tmp_path):
     if request.param == "memory":
         yield MemoryEventStore()
     elif request.param == "sqlite":
         yield SqliteEventStore(str(tmp_path / "events.db"))
+    elif request.param == "es":
+        from predictionio_tpu.storage.indexed import (ESEventStore,
+                                                      IndexedStorageClient)
+
+        s = ESEventStore(IndexedStorageClient(str(tmp_path / "es")))
+        yield s
+        s.close()
     elif request.param == "format_sql":
         # server-driver paramstyle (%s) through the dialect layer — the
         # SPI contract run the PGSQL/MYSQL stores would get

@@ -1,0 +1,215 @@
+"""Embedded indexed store (the Elasticsearch-equivalent backend):
+index engine semantics, durability, the registered ELASTICSEARCH TYPE,
+and the reference-shaped indicator search (SURVEY.md §2a
+storage/elasticsearch, §2c Universal Recommender)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.storage.indexed import (
+    EmbeddedIndex,
+    ESModelStore,
+    IndexedStorageClient,
+    Sequences,
+    index_indicators,
+    search_similar,
+)
+
+
+class TestEmbeddedIndex:
+    def test_term_and_bool_queries(self):
+        idx = EmbeddedIndex()
+        idx.index("a", {"kind": "x", "tags": ["t1", "t2"], "n": 1})
+        idx.index("b", {"kind": "x", "tags": ["t2"], "n": 5})
+        idx.index("c", {"kind": "y", "tags": ["t1"], "n": 9})
+        # must = AND
+        hits = idx.search(must=[("kind", "x"), ("tags", "t2")])
+        assert {h[0] for h in hits} == {"a", "b"}
+        # must_any = terms query (OR within the clause)
+        hits = idx.search(must_any=[("tags", ["t1"])])
+        assert {h[0] for h in hits} == {"a", "c"}
+        # ranges: lo inclusive, hi exclusive
+        hits = idx.search(ranges=[("n", 1, 9)])
+        assert {h[0] for h in hits} == {"a", "b"}
+        # should scoring: sum of matched boosts, sorted desc
+        hits = idx.search(should=[("tags", "t1", 2.0), ("tags", "t2", 1.0)])
+        assert [h[0] for h in hits] == ["a", "c", "b"]
+        assert hits[0][1] == 3.0
+
+    def test_upsert_and_delete_update_postings(self):
+        idx = EmbeddedIndex()
+        idx.index("a", {"kind": "x"})
+        idx.index("a", {"kind": "y"})  # upsert replaces terms
+        assert idx.search(must=[("kind", "x")]) == []
+        assert [h[0] for h in idx.search(must=[("kind", "y")])] == ["a"]
+        assert idx.delete("a") and not idx.delete("a")
+        assert idx.search() == []
+
+    def test_sort_by_field(self):
+        idx = EmbeddedIndex()
+        for i, t in enumerate([3.0, 1.0, 2.0]):
+            idx.index(f"d{i}", {"t": t})
+        assert [h[0] for h in idx.search(sort="t")] == ["d1", "d2", "d0"]
+        assert [h[0] for h in idx.search(sort="t", reverse=True)] == \
+            ["d0", "d2", "d1"]
+        assert [h[0] for h in idx.search(sort="t", size=2)] == ["d1", "d2"]
+
+
+class TestDurability:
+    def test_wal_replay(self, tmp_path):
+        p = str(tmp_path / "i.jsonl")
+        idx = EmbeddedIndex(p)
+        idx.index("a", {"k": "v"})
+        idx.index("b", {"k": "w"})
+        idx.delete("a")
+        idx.close()
+        idx2 = EmbeddedIndex(p)
+        assert idx2.get("a") is None
+        assert idx2.get("b") == {"k": "w"}
+        assert [h[0] for h in idx2.search(must=[("k", "w")])] == ["b"]
+
+    def test_torn_tail_recovery(self, tmp_path):
+        p = str(tmp_path / "i.jsonl")
+        idx = EmbeddedIndex(p)
+        idx.index("a", {"k": "v"})
+        idx.close()
+        with open(p, "a") as f:
+            f.write('{"op":"index","id":"b","doc":{"k"')  # crash mid-append
+        idx2 = EmbeddedIndex(p)
+        assert idx2.get("a") == {"k": "v"}
+        assert idx2.get("b") is None
+
+    def test_writes_after_torn_tail_survive_restart(self, tmp_path):
+        """Regression: appending after a torn tail used to weld the next
+        record onto the partial line — the following replay then
+        discarded it and everything after."""
+        p = str(tmp_path / "i.jsonl")
+        idx = EmbeddedIndex(p)
+        idx.index("a", {"k": "v"})
+        idx.close()
+        with open(p, "a") as f:
+            f.write('{"op":"index","id":"b","doc":{"k')
+        idx2 = EmbeddedIndex(p)
+        idx2.index("c", {"k": "w"})
+        idx2.index("d", {"k": "x"})
+        idx2.close()
+        idx3 = EmbeddedIndex(p)
+        assert idx3.get("a") == {"k": "v"}
+        assert idx3.get("c") == {"k": "w"}
+        assert idx3.get("d") == {"k": "x"}
+
+    def test_closed_index_rejects_writes(self, tmp_path):
+        idx = EmbeddedIndex(str(tmp_path / "i.jsonl"))
+        idx.index("a", {"k": "v"})
+        idx.close()
+        with pytest.raises(ValueError):
+            idx.index("b", {"k": "w"})
+        with pytest.raises(ValueError):
+            idx.delete("a")
+
+    def test_compaction_bounds_log(self, tmp_path):
+        p = str(tmp_path / "i.jsonl")
+        idx = EmbeddedIndex(p)
+        for _ in range(600):  # same doc rewritten: log would grow unbounded
+            idx.index("a", {"k": "v"})
+        idx.close()
+        n_lines = sum(1 for _ in open(p))
+        assert n_lines < 600
+        idx2 = EmbeddedIndex(p)
+        assert idx2.get("a") == {"k": "v"}
+
+
+class TestClientAndSequences:
+    def test_sequences_monotonic_and_durable(self, tmp_path):
+        c = IndexedStorageClient(str(tmp_path / "es"))
+        s = Sequences(c)
+        assert [s.next("x") for _ in range(3)] == [1, 2, 3]
+        assert s.next("y") == 1
+        c.close()
+        s2 = Sequences(IndexedStorageClient(str(tmp_path / "es")))
+        assert s2.next("x") == 4
+
+    def test_sequences_survive_sibling_store_close(self, tmp_path):
+        """Regression: ESMetaStore and ESEventStore share one client;
+        closing the client through one store must not turn the other's
+        id allocation non-durable (ids were silently reused after
+        restart, overwriting live documents)."""
+        from predictionio_tpu.storage.indexed import ESMetaStore
+
+        root = str(tmp_path / "es")
+        c = IndexedStorageClient(root)
+        meta = ESMetaStore(c)
+        one = meta.create_app("one")
+        c.close()  # e.g. the event store sharing this client shut down
+        two = meta.create_app("two")  # must reopen, stay durable
+        assert two.id == one.id + 1
+        meta2 = ESMetaStore(IndexedStorageClient(root))
+        three = meta2.create_app("three")
+        assert three.id == two.id + 1
+        assert meta2.get_app(two.id).name == "two"
+
+    def test_drop_and_list(self, tmp_path):
+        c = IndexedStorageClient(str(tmp_path / "es"))
+        c.index("one").index("a", {"x": 1})
+        c.index("two").index("b", {"x": 2})
+        assert c.list_indices() == ["one", "two"]
+        c.drop("one")
+        assert c.list_indices() == ["two"]
+        assert c.index("one").get("a") is None
+
+    def test_model_store(self, tmp_path):
+        st = ESModelStore(IndexedStorageClient(str(tmp_path / "es")))
+        st.put("i1", b"\x00\x01\xff")
+        st.put("i1", b"\x02")  # upsert
+        assert st.get("i1") == b"\x02"
+        assert st.list_ids() == ["i1"]
+        assert st.delete("i1") and st.get("i1") is None
+
+
+class TestRegistryWiring:
+    def test_elasticsearch_type_backs_all_repos(self, tmp_path):
+        from predictionio_tpu.storage.registry import Storage, StorageConfig
+
+        cfg = StorageConfig.from_env({
+            "PIO_HOME": str(tmp_path),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "ES",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "ES",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "ES",
+            "PIO_STORAGE_SOURCES_ES_TYPE": "ELASTICSEARCH",
+        })
+        st = Storage(cfg)
+        assert st.verify() == {"metadata": "ELASTICSEARCH",
+                               "eventdata": "ELASTICSEARCH",
+                               "modeldata": "ELASTICSEARCH"}
+        app = st.meta.create_app("esapp")
+        from predictionio_tpu.data.event import Event, parse_event_time
+
+        eid = st.events.insert(
+            Event(event="rate", entity_type="user", entity_id="u",
+                  event_time=parse_event_time("2026-01-01T00:00:00Z")),
+            app.id)
+        assert st.events.get(eid, app.id) is not None
+        st.models.put(st.meta.new_instance_id(), b"blob")
+
+
+class TestIndicatorSearch:
+    def test_reference_shaped_similarity_query(self, tmp_path):
+        """Indicators indexed per item; the UR query = should-terms over
+        indicator fields — scores must match the host score_user math
+        for binary boosts."""
+        from predictionio_tpu.utils.bimap import BiMap
+
+        item_ids = BiMap.string_int(iter(["i0", "i1", "i2"]))
+        # item rows: indicator lists (idx, llr); -inf = below threshold
+        idxs = np.array([[1, 2], [0, 2], [0, 1]], np.int32)
+        vals = np.array([[1.0, -np.inf], [2.0, 3.0], [-np.inf, 4.0]],
+                        np.float32)
+        indicators = {"buy": (idxs, vals)}
+        c = IndexedStorageClient(str(tmp_path / "es"))
+        idx = index_indicators(c, "ur_indicators", indicators, item_ids)
+        # i0's indicators: [i1]; i1's: [i0, i2]; i2's: [i1]
+        assert idx.get("i1")["buy"] == ["i0", "i2"]
+        hits = search_similar(idx, {"buy": ["i0"]}, num=5)
+        # items whose indicator lists contain i0: i1 and i2 (i2's i0 is
+        # -inf → filtered out at indexing time) → only i1
+        assert [h[0] for h in hits] == ["i1"]

@@ -8,8 +8,13 @@ from predictionio_tpu.storage.meta import EngineInstance, MetaStore
 from predictionio_tpu.storage.models import LocalFSModelStore, MemoryModelStore
 
 
-@pytest.fixture()
-def meta(tmp_path):
+@pytest.fixture(params=["sqlite", "es"])
+def meta(request, tmp_path):
+    if request.param == "es":
+        from predictionio_tpu.storage.indexed import (ESMetaStore,
+                                                      IndexedStorageClient)
+
+        return ESMetaStore(IndexedStorageClient(str(tmp_path / "es")))
     return MetaStore(str(tmp_path / "meta.db"))
 
 
