@@ -94,6 +94,17 @@ class Algorithm(ABC, Generic[PD, M, Q, PR]):
         ``predict``; algorithms override to batch onto the device."""
         return [self.predict(model, q) for q in queries]
 
+    @classmethod
+    def train_many(cls, ctx: WorkflowContext, prepared_data: PD,
+                   params_list: Sequence[Any]) -> List[M]:
+        """Train one model per params on the SAME prepared data — the
+        grid-search fan-out (`pio eval`, SURVEY.md §2d P4). Default is
+        sequential; algorithms whose hyperparameters are continuous
+        (e.g. regularization) override this to STACK same-geometry
+        candidates into one vmapped program, turning k separate
+        trace+compile+run cycles into one."""
+        return [cls(p).train(ctx, prepared_data) for p in params_list]
+
     # -- persistence (PersistentModel analogue) --------------------------------
 
     def save_model(self, model: M, instance_dir: Optional[str]) -> Optional[bytes]:

@@ -129,31 +129,143 @@ class Engine:
     # -- eval ------------------------------------------------------------------
 
     def eval(
-        self, ctx: WorkflowContext, engine_params: EngineParams
+        self, ctx: WorkflowContext, engine_params: EngineParams,
+        cache: Optional["FastEvalCache"] = None,
     ) -> List[Tuple[Any, List[Tuple[Any, Any, Any]]]]:
         """Per fold: train on the fold's training split, predict the fold's
         (query, actual) pairs → ``[(eval_info, [(q, p, a), ...]), ...]``
         (reference: Engine.eval producing RDD[(Q,P,A)] per fold)."""
-        ds = self.data_source_cls(engine_params.data_source_params)
-        folds = ds.read_eval(ctx)
-        prep = self.preparator_cls(engine_params.preparator_params)
-        serving = self.serving_cls(engine_params.serving_params)
-        results = []
-        for td, eval_info, qa in folds:
-            pd = prep.prepare(ctx, td)
-            algos = self.make_algorithms(engine_params)
-            models = [algo.train(ctx, pd) for _, algo in algos]
-            queries = [serving.supplement(q) for q, _ in qa]
-            per_algo = [
-                algo.batch_predict(model, queries)
-                for (_, algo), model in zip(algos, models)
-            ]
-            qpa = [
-                (q, serving.serve(q, [preds[i] for preds in per_algo]), a)
-                for i, (q, a) in enumerate(zip(queries, (a for _, a in qa)))
-            ]
-            results.append((eval_info, qpa))
-        return results
+        return self.eval_batch(ctx, [engine_params], cache)[0]
+
+    def eval_batch(
+        self, ctx: WorkflowContext, candidates: Sequence[EngineParams],
+        cache: Optional["FastEvalCache"] = None,
+    ) -> List[List[Tuple[Any, List[Tuple[Any, Any, Any]]]]]:
+        """Evaluate several candidates, sharing the expensive pipeline
+        prefixes (the FastEvalEngine behavior, reference: [U]
+        core/.../FastEvalEngineTest — SURVEY.md §2d P4):
+
+        - ``read_eval`` folds are computed once per distinct
+          dataSourceParams, ``prepare`` once per (dataSourceParams,
+          preparatorParams, fold) — memoized in ``cache`` so the reuse
+          also spans separate ``eval_batch`` calls;
+        - per fold, each algorithm slot trains ALL candidates that share
+          the (dsp, pp) prefix through ONE ``Algorithm.train_many`` call,
+          which stacks same-geometry candidates into a vmapped program
+          where the algorithm supports it.
+
+        Returns per-candidate eval data, in input order.
+        """
+        cache = cache if cache is not None else FastEvalCache()
+        out: List[Optional[list]] = [None] * len(candidates)
+
+        # group candidates by shared (dsp, pp, algorithm slots) prefix,
+        # preserving order — only same-slot candidates can train through
+        # one train_many call. Cache keys carry the COMPONENT CLASS too:
+        # one cache may serve several engines (the public eval(...,
+        # cache) signature invites it), and params alone would collide
+        # across engines whose params serialize identically (e.g. None).
+        def cls_key(c) -> str:
+            return f"{c.__module__}:{c.__qualname__}"
+
+        groups: Dict[Tuple[str, str, Tuple[str, ...]], List[int]] = {}
+        for i, ep in enumerate(candidates):
+            key = (cls_key(self.data_source_cls) + "|"
+                   + cache.params_key(ep.data_source_params),
+                   cls_key(self.preparator_cls) + "|"
+                   + cache.params_key(ep.preparator_params),
+                   tuple(n for n, _ in ep.algorithms_params))
+            groups.setdefault(key, []).append(i)
+
+        for (ds_key, pp_key, _names), idxs in groups.items():
+            ep0 = candidates[idxs[0]]
+            folds = cache.folds(
+                ds_key,
+                lambda: self.data_source_cls(
+                    ep0.data_source_params).read_eval(ctx))
+            prep = self.preparator_cls(ep0.preparator_params)
+            results: List[list] = [[] for _ in idxs]
+            for f, (td, eval_info, qa) in enumerate(folds):
+                pd = cache.prepared(ds_key, pp_key, f,
+                                    lambda: prep.prepare(ctx, td))
+                # per algorithm slot: one train_many over the group
+                names = [n for n, _ in ep0.algorithms_params]
+                models_by_cand: List[list] = [[] for _ in idxs]
+                for slot, name in enumerate(names):
+                    cls = self.algorithm_cls_map[name]
+                    plist = [candidates[i].algorithms_params[slot][1]
+                             for i in idxs]
+                    if not ctx.skip_sanity_check:
+                        # every candidate's params get checked — sanity
+                        # may validate params against the data, and a
+                        # degenerate candidate must fail here, not deep
+                        # inside the stacked trainer
+                        for p in plist:
+                            cls(p).sanity_check(pd)
+                    models = cls.train_many(ctx, pd, plist)
+                    for j, m in enumerate(models):
+                        models_by_cand[j].append(m)
+                for j, i in enumerate(idxs):
+                    ep = candidates[i]
+                    serving = self.serving_cls(ep.serving_params)
+                    algos = self.make_algorithms(ep)
+                    queries = [serving.supplement(q) for q, _ in qa]
+                    per_algo = [
+                        algo.batch_predict(model, queries)
+                        for (_, algo), model in zip(algos, models_by_cand[j])
+                    ]
+                    qpa = [
+                        (q, serving.serve(q, [preds[qi] for preds in per_algo]), a)
+                        for qi, (q, a) in enumerate(
+                            zip(queries, (a for _, a in qa)))
+                    ]
+                    results[j].append((eval_info, qpa))
+            for j, i in enumerate(idxs):
+                out[i] = results[j]
+        return out  # type: ignore[return-value]
+
+
+class FastEvalCache:
+    """Memoizes the eval pipeline's expensive prefixes across grid
+    candidates: dataSourceParams → folds, (dsp, pp, fold) → PreparedData
+    (the reference's FastEvalEngine workflow caching). ``stats`` counts
+    misses (i.e. actual reads/prepares) and hits for tests and logs."""
+
+    def __init__(self) -> None:
+        self._folds: Dict[str, list] = {}
+        self._prepared: Dict[Tuple[str, str, int], Any] = {}
+        self.stats = {"read_eval": 0, "read_eval_hits": 0,
+                      "prepare": 0, "prepare_hits": 0}
+
+    @staticmethod
+    def params_key(params: Any) -> str:
+        from predictionio_tpu.controller.base import params_to_json
+
+        try:
+            return json.dumps(params_to_json(params), sort_keys=True,
+                              default=str)
+        except TypeError:
+            # params types outside the JSON contract (plain classes)
+            # still evaluate — they just key by identity-ish repr, so
+            # equal-looking instances won't share cache entries
+            return repr(params)
+
+    def folds(self, ds_key: str, compute) -> list:
+        if ds_key not in self._folds:
+            self.stats["read_eval"] += 1
+            self._folds[ds_key] = compute()
+        else:
+            self.stats["read_eval_hits"] += 1
+        return self._folds[ds_key]
+
+    def prepared(self, ds_key: str, pp_key: str, fold: int, compute) -> Any:
+        key = (ds_key, pp_key, fold)
+        if key not in self._prepared:
+            self.stats["prepare"] += 1
+            self._prepared[key] = compute()
+        else:
+            self.stats["prepare_hits"] += 1
+        return self._prepared[key]
 
 
 class EngineFactory:

@@ -150,13 +150,21 @@ class MetricEvaluator:
     ) -> MetricEvaluatorResult:
         if not candidates:
             raise ValueError("no candidate engine params to evaluate")
+        # FastEval: candidates share read_eval/prepare through the cache
+        # and same-prefix candidates train through one train_many call
+        # (stacked/vmapped where the algorithm supports it) — SURVEY.md
+        # §2d P4's TPU upgrade of the reference's sequential grid.
+        from predictionio_tpu.controller.engine import FastEvalCache
+
+        cache = FastEvalCache()
+        eval_datas = engine.eval_batch(ctx, candidates, cache)
         rows: List[Tuple[EngineParams, float, List[float]]] = []
-        for i, ep in enumerate(candidates):
-            eval_data = engine.eval(ctx, ep)
+        for i, (ep, eval_data) in enumerate(zip(candidates, eval_datas)):
             score = self.metric.calculate(ctx, eval_data)
             others = [m.calculate(ctx, eval_data) for m in self.other_metrics]
             ctx.log(f"candidate {i}: {self.metric.header}={score}")
             rows.append((ep, score, others))
+        ctx.log(f"fast-eval cache: {cache.stats}")
 
         def key(i: int) -> float:
             s = rows[i][1]

@@ -12,7 +12,7 @@ annotations — the pjit replacement for MLlib's ``treeAggregate``
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -111,6 +111,84 @@ def logreg_train(
 
         (W, b), losses = run((W0, b0))
     return np.asarray(W), np.asarray(b)
+
+
+def logreg_train_many(
+    X: np.ndarray, y: np.ndarray,
+    params_list: Sequence[LogisticRegressionParams], mesh=None,
+) -> list:
+    """Train k candidates on the SAME batch — the `pio eval` grid
+    fan-out (SURVEY.md §2d P4). Candidates sharing geometry (classes,
+    iterations, optimizer) differ only in continuous hyperparameters
+    (reg, learning rate), so they STACK: one ``vmap``-ed program trains
+    all of them in a single trace+compile+run instead of k — and since
+    hyperparameters are trace constants in :func:`logreg_train`, the
+    sequential path would recompile for every candidate. Mixed
+    geometries fall back per group; order is preserved.
+    Returns ``[(W, b), ...]``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    out: list = [None] * len(params_list)
+    groups: dict = {}
+    for i, p in enumerate(params_list):
+        groups.setdefault(
+            (p.num_classes, p.iterations, p.optimizer), []).append(i)
+    for (C, iters, optname), idxs in groups.items():
+        if len(idxs) == 1 or (mesh is not None
+                              and int(np.prod(mesh.devices.shape)) > 1):
+            # sharded batches keep the un-vmapped path (vmap over a
+            # sharded axis would need a 2D mesh); single candidates
+            # gain nothing from stacking
+            for i in idxs:
+                out[i] = logreg_train(X, y, params_list[i], mesh)
+            continue
+        n, d = X.shape
+        Xd, yd = jnp.asarray(X, jnp.float32), jnp.asarray(y, jnp.int32)
+        regs = jnp.asarray([params_list[i].reg for i in idxs], jnp.float32)
+        lrs = jnp.asarray([params_list[i].learning_rate for i in idxs],
+                          jnp.float32)
+
+        def train_one(reg, lr):
+            def loss_fn(wb):
+                W, b = wb
+                logits = Xd @ W + b
+                ll = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, yd).mean()
+                return ll + 0.5 * reg * (W * W).sum()
+
+            wb0 = (jnp.zeros((d, C), jnp.float32),
+                   jnp.zeros((C,), jnp.float32))
+            if optname == "lbfgs" and hasattr(optax, "lbfgs"):
+                opt = optax.lbfgs()
+
+                def step(carry, _):
+                    wb, state = carry
+                    loss, grads = jax.value_and_grad(loss_fn)(wb)
+                    updates, state = opt.update(
+                        grads, state, wb, value=loss, grad=grads,
+                        value_fn=loss_fn)
+                    return (optax.apply_updates(wb, updates), state), loss
+            else:
+                opt = optax.adam(lr)
+
+                def step(carry, _):
+                    wb, state = carry
+                    loss, grads = jax.value_and_grad(loss_fn)(wb)
+                    updates, state = opt.update(grads, state)
+                    return (optax.apply_updates(wb, updates), state), loss
+
+            (wb, _), _ = jax.lax.scan(step, (wb0, opt.init(wb0)), None,
+                                      length=iters)
+            return wb
+
+        Ws, bs = jax.jit(jax.vmap(train_one))(regs, lrs)
+        Ws, bs = np.asarray(Ws), np.asarray(bs)
+        for j, i in enumerate(idxs):
+            out[i] = (Ws[j], bs[j])
+    return out
 
 
 def logreg_predict(W: np.ndarray, b: np.ndarray, X: np.ndarray) -> np.ndarray:

@@ -166,6 +166,30 @@ class LogisticRegressionAlgorithm(Algorithm):
             mesh=ctx.mesh)
         return ClassificationModel("lr", pd.attrs, W=W, b=b)
 
+    @classmethod
+    def train_many(cls, ctx: WorkflowContext, pd: LabeledData,
+                   params_list) -> List[ClassificationModel]:
+        """Grid-search fan-out: same-geometry candidates (differing in
+        reg) train as ONE vmapped program (SURVEY.md §2d P4).
+
+        num_classes resolves PER CANDIDATE exactly as ``train`` does —
+        a candidate's model must not depend on which other candidates
+        share the grid (logreg_train_many groups by geometry, so mixed
+        num_classes simply land in different stacks)."""
+        from predictionio_tpu.models.linear import logreg_train_many
+
+        data_classes = int(pd.y.max()) + 1
+        wbs = logreg_train_many(
+            pd.X, pd.y,
+            [LogisticRegressionParams(
+                num_classes=max(p.num_classes, data_classes),
+                iterations=p.iterations, reg=p.reg,
+                optimizer=p.optimizer)
+             for p in params_list],
+            mesh=ctx.mesh)
+        return [ClassificationModel("lr", pd.attrs, W=W, b=b)
+                for W, b in wbs]
+
     def predict(self, model: ClassificationModel, query: Dict[str, Any]) -> Dict[str, Any]:
         label = logreg_predict(model.arrays["W"], model.arrays["b"],
                                model.features(query))[0]
