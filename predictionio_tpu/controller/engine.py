@@ -229,7 +229,15 @@ class FastEvalCache:
     """Memoizes the eval pipeline's expensive prefixes across grid
     candidates: dataSourceParams → folds, (dsp, pp, fold) → PreparedData
     (the reference's FastEvalEngine workflow caching). ``stats`` counts
-    misses (i.e. actual reads/prepares) and hits for tests and logs."""
+    misses (i.e. actual reads/prepares) and hits for tests and logs.
+
+    Contracts the sharing imposes (same as the reference's FastEval):
+
+    - entries are SNAPSHOTS of the event data at first read — create a
+      fresh cache after ingesting new events (MetricEvaluator already
+      creates one per evaluate() call);
+    - folds/PreparedData are shared across candidates and cache hits,
+      so preparators and algorithms must not mutate them in place."""
 
     def __init__(self) -> None:
         self._folds: Dict[str, list] = {}

@@ -42,6 +42,10 @@ class EngineServer:
         variant_id: str = "",
         feedback: bool = False,
         feedback_app_name: Optional[str] = None,
+        feedback_url: Optional[str] = None,
+        feedback_access_key: Optional[str] = None,
+        feedback_channel: Optional[str] = None,
+        event_sink: Optional[Any] = None,
         plugins: Optional[List[Any]] = None,
         ssl_context: Optional[Any] = None,
         batching: bool = False,
@@ -51,8 +55,19 @@ class EngineServer:
         self.storage = storage or get_storage()
         self.engine_factory = engine_factory
         self.variant_id = variant_id
-        self.feedback = feedback
+        self.feedback = feedback or bool(feedback_url) or event_sink is not None
         self.feedback_app_name = feedback_app_name
+        self._event_sink = event_sink
+        if self._event_sink is None and feedback_url:
+            # the reference contract: feedback goes through the Event
+            # Server's authenticated HTTP API (SURVEY.md §3.2), the only
+            # path that works when event storage is remote to this host
+            from predictionio_tpu.server.eventsink import HTTPEventSink
+
+            if not feedback_access_key:
+                raise ValueError("feedback_url requires feedback_access_key")
+            self._event_sink = HTTPEventSink(
+                feedback_url, feedback_access_key, feedback_channel)
         self.plugins = plugins if plugins is not None else engine_server_plugins()
         self.deployed: DeployedEngine = prepare_deploy(
             engine_factory=engine_factory, instance_id=instance_id,
@@ -65,6 +80,10 @@ class EngineServer:
             "pio_engine_queries_total", "Queries served", ("status",))
         self._m_latency = REGISTRY.histogram(
             "pio_engine_query_seconds", "Query latency (handler, seconds)")
+        self._m_feedback = REGISTRY.counter(
+            "pio_engine_feedback_total", "Feedback events sent", ("status",))
+        self._feedback_pool = None
+        self._feedback_inflight = 0
         self._batcher = None
         if batching:
             from predictionio_tpu.server.batching import MicroBatcher
@@ -129,32 +148,68 @@ class EngineServer:
             pr_id = uuid.uuid4().hex
             if isinstance(prediction, dict):
                 prediction = {**prediction, "prId": pr_id}
-            asyncio.get_running_loop().create_task(
-                asyncio.to_thread(self._record_feedback, query, prediction, pr_id))
+            self._submit_feedback(query, prediction, pr_id)
         return Response.json(prediction)
 
-    def _record_feedback(self, query: Any, prediction: Any, pr_id: str) -> None:
-        """Feedback loop: persist served predictions as 'predict' events
-        tagged with prId (reference: CreateServer feedback to the Event
-        Server; here it writes through the same event store)."""
-        try:
+    def _submit_feedback(self, query: Any, prediction: Any,
+                         pr_id: str) -> None:
+        """Queue feedback on a DEDICATED small executor — a slow or down
+        Event Server (HTTP sink blocks up to its timeout) must not eat
+        the shared to_thread pool that query handling runs on. Bounded:
+        past 256 in flight, feedback drops (counted), serving doesn't."""
+        import concurrent.futures
+
+        if self._feedback_pool is None:
+            self._feedback_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="pio-feedback")
+        if self._feedback_inflight >= 256:
+            self._m_feedback.inc(("dropped",))
+            return
+        self._feedback_inflight += 1
+
+        def run():
+            try:
+                self._record_feedback(query, prediction, pr_id)
+            finally:
+                self._feedback_inflight -= 1
+
+        self._feedback_pool.submit(run)
+
+    def _sink(self):
+        if self._event_sink is None:
+            # no Event Server configured: fall back to the in-process
+            # write against the app named in the trained instance's
+            # data-source params
+            from predictionio_tpu.server.eventsink import DirectEventSink
+
             app_name = self.feedback_app_name
             if not app_name:
                 dsp = json.loads(self.deployed.instance.data_source_params)
                 app_name = dsp.get("app_name") or dsp.get("appName")
             if not app_name:
+                return None
+            self._event_sink = DirectEventSink(self.storage, app_name)
+        return self._event_sink
+
+    def _record_feedback(self, query: Any, prediction: Any, pr_id: str) -> None:
+        """Feedback loop: served predictions become 'predict' events
+        tagged with prId, delivered through the configured sink —
+        the Event Server's authenticated HTTP API when a feedback URL
+        is set (reference: CreateServer feedback, SURVEY.md §3.2), else
+        a direct local write."""
+        try:
+            sink = self._sink()
+            if sink is None:
                 return
-            app = self.storage.meta.get_app_by_name(app_name)
-            if app is None:
-                return
-            self.storage.events.insert(Event(
+            sink.send(Event(
                 event="predict",
                 entity_type="pio_pr", entity_id=pr_id,
                 properties={"query": query, "prediction": prediction},
                 pr_id=pr_id,
-            ), app.id)
+            ))
+            self._m_feedback.inc(("ok",))
         except Exception:
-            pass  # feedback must never break serving
+            self._m_feedback.inc(("error",))  # never breaks serving
 
     async def _status(self, req: Request) -> Response:
         ei = self.deployed.instance

@@ -143,6 +143,9 @@ def cmd_deploy(args: argparse.Namespace) -> None:
         host=args.ip, port=args.port,
         variant_id=str(variant.get("id", "")),
         feedback=args.feedback,
+        feedback_url=args.feedback_url,
+        feedback_access_key=args.feedback_accesskey,
+        feedback_channel=args.feedback_channel,
         batching=args.batching,
         batch_max=args.batch_max,
         batch_wait_ms=args.batch_wait_ms,
@@ -418,6 +421,14 @@ def build_parser() -> argparse.ArgumentParser:
     dp.add_argument("--port", type=int, default=8000)
     dp.add_argument("--engine-instance-id")
     dp.add_argument("--feedback", action="store_true")
+    dp.add_argument("--feedback-url",
+                    help="Event Server base URL (e.g. http://host:7070); "
+                         "feedback then posts through its authenticated "
+                         "HTTP API instead of writing storage directly")
+    dp.add_argument("--feedback-accesskey",
+                    help="access key for --feedback-url")
+    dp.add_argument("--feedback-channel",
+                    help="optional channel name for feedback events")
     dp.add_argument("--batching", action="store_true",
                     help="micro-batch concurrent queries into one dispatch")
     dp.add_argument("--batch-max", type=int, default=64)

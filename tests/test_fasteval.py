@@ -159,13 +159,39 @@ class TestStackedTraining:
             np.testing.assert_allclose(W, Wr, rtol=2e-4, atol=2e-5)
             np.testing.assert_allclose(b, br, rtol=2e-4, atol=2e-5)
 
+    def test_lbfgs_vmapped_matches_sequential(self):
+        """The template DEFAULT optimizer is lbfgs — the vmapped zoom
+        linesearch must agree with the sequential path."""
+        import optax
+
+        from predictionio_tpu.models.linear import (
+            LogisticRegressionParams, logreg_train, logreg_train_many)
+
+        if not hasattr(optax, "lbfgs"):
+            pytest.skip("optax.lbfgs unavailable")
+        X, y = self._data()
+        plist = [LogisticRegressionParams(num_classes=2, iterations=15,
+                                          reg=r, optimizer="lbfgs")
+                 for r in (0.001, 0.05)]
+        stacked = logreg_train_many(X, y, plist)
+        for p, (W, b) in zip(plist, stacked):
+            Wr, br = logreg_train(X, y, p)
+            np.testing.assert_allclose(W, Wr, rtol=1e-3, atol=1e-4)
+            np.testing.assert_allclose(b, br, rtol=1e-3, atol=1e-4)
+
     def test_stacked_beats_sequential_wall_clock(self):
         """The measured P4 speedup: hyperparameters are trace constants
         in logreg_train, so k sequential candidates pay k compiles; the
         stacked path pays one vmapped compile."""
+        import jax
+
         from predictionio_tpu.models.linear import (
             LogisticRegressionParams, logreg_train, logreg_train_many)
 
+        # earlier tests in this process may have enabled the persistent
+        # compilation cache (run_train does), which would collapse the
+        # sequential path's compile cost on re-runs and flake the timing
+        jax.config.update("jax_compilation_cache_dir", None)
         X, y = self._data()
         k = 6
         plist = [LogisticRegressionParams(num_classes=2, iterations=40,

@@ -182,6 +182,92 @@ VARIANT = {
 }
 
 
+class TestFeedbackThroughEventServer:
+    def test_feedback_posts_via_authenticated_http(self, storage, app):
+        """Reference contract (SURVEY.md §3.2): serving feedback goes
+        through the Event Server's authenticated HTTP API — the only
+        path that works when event storage is remote to the serving
+        host — not a direct storage write."""
+        import time as _time
+
+        a, key = app
+        es_port, en_port = free_port(), free_port()
+        seed_ratings_http = []
+        for u in range(12):
+            for i in range(10):
+                if (u + i) % 2 == 0:
+                    seed_ratings_http.append({
+                        "event": "rate", "entityType": "user",
+                        "entityId": str(u), "targetEntityType": "item",
+                        "targetEntityId": str(i),
+                        "properties": {"rating": 4.0}})
+        with ServerThread(EventServer(storage=storage, host="127.0.0.1",
+                                      port=es_port)):
+            base_es = f"http://127.0.0.1:{es_port}"
+            code, _ = http("POST",
+                           f"{base_es}/batch/events.json?accessKey={key.key}",
+                           seed_ratings_http[:50])
+            assert code == 200
+            instance_id = run_train(FACTORY, variant=VARIANT, storage=storage,
+                                    use_mesh=False)
+            with ServerThread(EngineServer(
+                    engine_factory=FACTORY, storage=storage,
+                    host="127.0.0.1", port=en_port,
+                    feedback_url=base_es, feedback_access_key=key.key)):
+                base = f"http://127.0.0.1:{en_port}"
+                code, pred = http("POST", f"{base}/queries.json",
+                                  {"user": "2", "num": 3})
+                assert code == 200 and "prId" in pred
+                # the predict event lands via the AUTHENTICATED API
+                deadline = _time.time() + 10
+                evs = []
+                while _time.time() < deadline:
+                    code, evs = http(
+                        "GET",
+                        f"{base_es}/events.json?accessKey={key.key}"
+                        "&event=predict")
+                    if code == 200 and evs:
+                        break
+                    _time.sleep(0.1)
+                assert evs, "feedback event never arrived"
+                assert evs[0]["prId"] == pred["prId"]
+                assert evs[0]["entityType"] == "pio_pr"
+                assert evs[0]["properties"]["query"]["user"] == "2"
+
+    def test_bad_access_key_rejected_not_fatal(self, storage, app):
+        a, key = app
+        es_port, en_port = free_port(), free_port()
+        with ServerThread(EventServer(storage=storage, host="127.0.0.1",
+                                      port=es_port)):
+            base_es = f"http://127.0.0.1:{es_port}"
+            batch = [{"event": "rate", "entityType": "user",
+                      "entityId": str(u), "targetEntityType": "item",
+                      "targetEntityId": str(i),
+                      "properties": {"rating": 3.0}}
+                     for u in range(8) for i in range(6)]
+            http("POST", f"{base_es}/batch/events.json?accessKey={key.key}",
+                 batch[:50])
+            run_train(FACTORY, variant=VARIANT, storage=storage,
+                      use_mesh=False)
+            with ServerThread(EngineServer(
+                    engine_factory=FACTORY, storage=storage,
+                    host="127.0.0.1", port=en_port,
+                    feedback_url=base_es, feedback_access_key="wrong-key")):
+                base = f"http://127.0.0.1:{en_port}"
+                # serving still works; feedback fails auth, is counted,
+                # and never surfaces to the client
+                code, pred = http("POST", f"{base}/queries.json",
+                                  {"user": "1", "num": 2})
+                assert code == 200
+                import time as _time
+
+                _time.sleep(0.5)
+                code, evs = http(
+                    "GET",
+                    f"{base_es}/events.json?accessKey={key.key}&event=predict")
+                assert evs == []
+
+
 class TestQuickstartEndToEnd:
     def test_full_loop(self, storage, app):
         a, key = app
