@@ -32,6 +32,9 @@ class TwoTowerParams:
     # newest epoch. None disables.
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1
+    # streaming path: total pair count from the reader's vocabulary
+    # pass (avoids an extra counting pass over the event log)
+    n_pairs: int = 0
 
 
 def _towers(n_users: int, n_items: int, p: TwoTowerParams):
@@ -63,9 +66,24 @@ def two_tower_train(
     user_idx: np.ndarray, item_idx: np.ndarray,
     n_users: int, n_items: int,
     params: TwoTowerParams, mesh=None,
+    pair_chunks: Optional[Any] = None,
 ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     """Train on positive (user, item) pairs; returns (user_variables,
-    item_variables) flax param pytrees (host numpy)."""
+    item_variables) flax param pytrees (host numpy).
+
+    ``pair_chunks`` (a zero-arg callable returning an iterator of
+    (user_idx, item_idx, …) numpy chunks, e.g.
+    ``InteractionData.chunks``) selects the STREAMING input path: each
+    epoch re-streams the chunks through a
+    :class:`~predictionio_tpu.data.pipeline.DevicePrefetcher`
+    (double-buffered host→HBM) and shuffles WITHIN chunks — event logs
+    larger than host RAM train, at the cost of chunk-local instead of
+    global shuffling (the standard streaming trade-off; pass the whole
+    dataset as one chunk to recover exact global-shuffle semantics).
+    Sub-batch remainders carry into the next chunk. ``user_idx``/
+    ``item_idx`` may then be empty; the pair count comes from
+    ``params.n_pairs`` (the reader's vocabulary pass) or, failing that,
+    one extra counting pass."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -103,6 +121,11 @@ def two_tower_train(
         return variables, opt_state, losses.mean()
 
     n = len(user_idx)
+    if pair_chunks is not None and n == 0:
+        if p.n_pairs:
+            n = p.n_pairs  # caller already counted (vocabulary pass)
+        else:
+            n = sum(len(c[0]) for c in pair_chunks())
     if n < 2:
         raise ValueError("two-tower training needs at least 2 positive pairs "
                          "(in-batch negatives)")
@@ -145,14 +168,55 @@ def two_tower_train(
 
     last_loss = None
     for epoch in range(start_epoch, p.epochs):
-        perm = np.random.default_rng(p.seed + epoch).permutation(n)[: n_batches * B]
-        ue = user_idx[perm].reshape(n_batches, B).astype(np.int32)
-        ie = item_idx[perm].reshape(n_batches, B).astype(np.int32)
-        if batch_sharding is not None:
-            ue = jax.device_put(ue, batch_sharding)
-            ie = jax.device_put(ie, batch_sharding)
-        variables, opt_state, last_loss = train_epoch(
-            variables, opt_state, jnp.asarray(ue), jnp.asarray(ie))
+        if pair_chunks is not None:
+            # streaming path (SURVEY §2d C4): shuffle within each chunk,
+            # reshape to scan batches, and let the prefetcher decode +
+            # device_put the NEXT chunk while this one trains
+            from predictionio_tpu.data.pipeline import DevicePrefetcher
+
+            erng = np.random.default_rng(p.seed + epoch)
+
+            def host_batches():
+                # remainders carry into the next chunk so chunks
+                # smaller than the batch size still train (rather than
+                # silently yielding zero steps)
+                carry_u = np.zeros(0, np.int32)
+                carry_i = np.zeros(0, np.int32)
+                for chunk in pair_chunks():
+                    u_c = np.concatenate([carry_u, np.asarray(chunk[0],
+                                                              np.int32)])
+                    i_c = np.concatenate([carry_i, np.asarray(chunk[1],
+                                                              np.int32)])
+                    m = len(u_c) // B
+                    if m == 0:
+                        carry_u, carry_i = u_c, i_c
+                        continue
+                    cperm = erng.permutation(len(u_c))
+                    take, rest = cperm[: m * B], cperm[m * B:]
+                    carry_u, carry_i = u_c[rest], i_c[rest]
+                    yield (u_c[take].reshape(m, B),
+                           i_c[take].reshape(m, B))
+
+            steps = 0
+            with DevicePrefetcher(host_batches(),
+                                  sharding=batch_sharding) as pf:
+                for ue, ie in pf:
+                    variables, opt_state, last_loss = train_epoch(
+                        variables, opt_state, ue, ie)
+                    steps += 1
+            if steps == 0:
+                raise ValueError(
+                    f"streaming train performed zero steps: {n} pairs "
+                    f"never filled one batch of {B}; lower batch_size")
+        else:
+            perm = np.random.default_rng(p.seed + epoch).permutation(n)[: n_batches * B]
+            ue = user_idx[perm].reshape(n_batches, B).astype(np.int32)
+            ie = item_idx[perm].reshape(n_batches, B).astype(np.int32)
+            if batch_sharding is not None:
+                ue = jax.device_put(ue, batch_sharding)
+                ie = jax.device_put(ie, batch_sharding)
+            variables, opt_state, last_loss = train_epoch(
+                variables, opt_state, jnp.asarray(ue), jnp.asarray(ie))
         if ckpt is not None and (epoch + 1) % max(1, p.checkpoint_every) == 0:
             ckpt.save(epoch + 1, {"variables": jax.tree.map(np.asarray, variables),
                                   "opt_state": jax.tree.map(np.asarray, opt_state)})

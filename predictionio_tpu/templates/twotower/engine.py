@@ -39,11 +39,15 @@ from predictionio_tpu.utils.bimap import BiMap
 class DataSourceParams:
     app_name: str = ""
     event_names: List[str] = field(default_factory=lambda: ["view", "buy"])
+    # >0 selects the streaming read path with this chunk size (events
+    # per columnar chunk); 0 materializes pairs in host RAM
+    stream_chunk: int = 0
 
 
 @dataclass
 class TrainingData:
-    pairs: List[tuple]  # positive (user, item)
+    pairs: List[tuple]  # positive (user, item); empty in streaming mode
+    interactions: Any = None  # data.pipeline.InteractionData (streaming)
 
 
 class TTDataSource(DataSource):
@@ -51,6 +55,22 @@ class TTDataSource(DataSource):
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
         p: DataSourceParams = self.params
+        if p.stream_chunk > 0:
+            # streaming read (SURVEY §2d C4): columnar chunks + vocab
+            # passes, memory O(chunk + vocabulary) — event logs larger
+            # than host RAM train; the trainer double-buffers chunks
+            # into HBM
+            from predictionio_tpu.data.pipeline import read_interactions
+
+            data = read_interactions(
+                lambda: event_store.find(
+                    p.app_name, entity_type="user",
+                    target_entity_type="item",
+                    event_names=p.event_names, storage=ctx.storage),
+                chunk_size=p.stream_chunk)
+            if data.n_events == 0:
+                raise ValueError("no interaction events found")
+            return TrainingData([], interactions=data)
         pairs = [
             (e.entity_id, e.target_entity_id)
             for e in event_store.find(
@@ -106,17 +126,23 @@ class TwoTowerAlgorithm(Algorithm):
     ParamsClass = TTAlgorithmParams
 
     def sanity_check(self, data: TrainingData) -> None:
-        if not data.pairs:
+        if not data.pairs and data.interactions is None:
             raise ValueError("empty training pairs")
 
     def train(self, ctx: WorkflowContext, pd: TrainingData) -> TwoTowerModel:
         p: TTAlgorithmParams = self.params
-        user_ids = BiMap.string_int(u for u, _ in pd.pairs)
-        item_ids = BiMap.string_int(i for _, i in pd.pairs)
-        uidx = np.fromiter((user_ids[u] for u, _ in pd.pairs), np.int32,
-                           len(pd.pairs))
-        iidx = np.fromiter((item_ids[i] for _, i in pd.pairs), np.int32,
-                           len(pd.pairs))
+        if pd.interactions is not None:
+            user_ids = pd.interactions.user_ids
+            item_ids = pd.interactions.item_ids
+            uidx = np.zeros(0, np.int32)
+            iidx = np.zeros(0, np.int32)
+        else:
+            user_ids = BiMap.string_int(u for u, _ in pd.pairs)
+            item_ids = BiMap.string_int(i for _, i in pd.pairs)
+            uidx = np.fromiter((user_ids[u] for u, _ in pd.pairs), np.int32,
+                               len(pd.pairs))
+            iidx = np.fromiter((item_ids[i] for _, i in pd.pairs), np.int32,
+                               len(pd.pairs))
         # explicit checkpoint_dir param wins; else the workflow's
         # per-run checkpoint dir enables restart-from-checkpoint
         ckpt_dir = p.checkpoint_dir
@@ -129,9 +155,13 @@ class TwoTowerAlgorithm(Algorithm):
             batch_size=p.batch_size, epochs=p.epochs,
             learning_rate=p.learning_rate, temperature=p.temperature,
             seed=p.seed, checkpoint_dir=ckpt_dir,
-            checkpoint_every=p.checkpoint_every)
-        uv, iv = two_tower_train(uidx, iidx, len(user_ids), len(item_ids),
-                                 tp, mesh=ctx.mesh)
+            checkpoint_every=p.checkpoint_every,
+            n_pairs=(pd.interactions.n_events
+                     if pd.interactions is not None else 0))
+        uv, iv = two_tower_train(
+            uidx, iidx, len(user_ids), len(item_ids), tp, mesh=ctx.mesh,
+            pair_chunks=(pd.interactions.chunks
+                         if pd.interactions is not None else None))
         item_embeds = two_tower_embed_items(iv, len(item_ids), tp)
         return TwoTowerModel(uv, item_embeds, user_ids, item_ids, tp)
 

@@ -1,0 +1,253 @@
+"""Streaming input pipeline (SURVEY.md §2d C4): chunked columnar event
+reads, the two-pass beyond-RAM interaction reader, and the
+double-buffered host→device prefetcher — proven with small chunk sizes
+so every chunk boundary is exercised."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.event import Event, parse_event_time
+from predictionio_tpu.data.events import MemoryEventStore
+from predictionio_tpu.data.pipeline import (
+    DevicePrefetcher,
+    iter_columnar,
+    read_interactions,
+)
+
+
+def _seed(store, app=1, n=23):
+    evs = []
+    for j in range(n):
+        evs.append(Event(
+            event="rate", entity_type="user", entity_id=f"u{j % 7}",
+            target_entity_type="item", target_entity_id=f"i{j % 5}",
+            properties={"rating": float(j % 5 + 1)},
+            event_time=parse_event_time(f"2026-01-01T00:00:{j:02d}Z")))
+    store.insert_batch(evs, app)
+    return evs
+
+
+def _rating(e):
+    try:
+        return float(e.properties["rating"])
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+class TestIterColumnar:
+    def test_chunks_cover_everything_in_order(self):
+        store = MemoryEventStore()
+        _seed(store, n=23)
+        chunks = list(iter_columnar(store.find(1), chunk_size=5,
+                                    value_fn=_rating))
+        assert [len(c[0]) for c in chunks] == [5, 5, 5, 5, 3]
+        ents = [u for c in chunks for u in c[0]]
+        assert ents == [f"u{j % 7}" for j in range(23)]
+        vals = np.concatenate([c[2] for c in chunks])
+        np.testing.assert_allclose(vals, [j % 5 + 1 for j in range(23)])
+
+    def test_value_fn_none_drops_event(self):
+        store = MemoryEventStore()
+        _seed(store, n=6)
+        store.insert(Event(event="rate", entity_type="user", entity_id="ux",
+                           target_entity_type="item", target_entity_id="ix",
+                           properties={"rating": "garbage"},
+                           event_time=parse_event_time("2026-01-02T00:00:00Z")),
+                     1)
+        chunks = list(iter_columnar(store.find(1), chunk_size=100,
+                                    value_fn=_rating))
+        assert sum(len(c[0]) for c in chunks) == 6
+        assert "ux" not in chunks[0][0]
+
+
+class TestReadInteractions:
+    def test_two_pass_matches_one_shot(self):
+        store = MemoryEventStore()
+        _seed(store, n=23)
+        data = read_interactions(lambda: store.find(1), chunk_size=4,
+                                 value_fn=_rating)
+        assert data.n_events == 23
+        assert len(data.user_ids) == 7 and len(data.item_ids) == 5
+        u, i, v = data.arrays()
+        assert len(u) == 23
+        # index mapping round-trips to the original string ids, in order
+        inv_u = data.user_ids.inverse()
+        assert [inv_u[int(x)] for x in u] == [f"u{j % 7}" for j in range(23)]
+        # memory contract: chunks() yields ≤ chunk_size rows at a time
+        sizes = [len(c[0]) for c in data.chunks()]
+        assert max(sizes) <= 4 and sum(sizes) == 23
+
+
+class TestDevicePrefetcher:
+    def test_order_and_device_placement(self):
+        import jax
+
+        src = (np.full((3,), k, np.int32) for k in range(6))
+        with DevicePrefetcher(src) as pf:
+            out = list(pf)
+        assert [int(a[0]) for a in out] == list(range(6))
+        assert all(isinstance(a, jax.Array) for a in out)
+
+    def test_overlap(self):
+        """The producer must run ahead of the consumer (double buffer)."""
+        produced = []
+
+        def slow_source():
+            for k in range(4):
+                produced.append(k)
+                yield np.asarray([k])
+
+        with DevicePrefetcher(slow_source(), depth=2) as pf:
+            first = next(pf)
+            time.sleep(0.3)  # consumer "computes" — producer runs ahead
+            assert len(produced) >= 2, "producer did not prefetch"
+            rest = list(pf)
+        assert int(first[0]) == 0 and len(rest) == 3
+
+    def test_transform_and_exception_propagation(self):
+        def bad_source():
+            yield np.asarray([1])
+            raise RuntimeError("source broke")
+
+        pf = DevicePrefetcher(bad_source(), transform=lambda a: a * 2)
+        assert int(next(pf)[0]) == 2
+        with pytest.raises(RuntimeError, match="source broke"):
+            next(pf)
+        pf.close()
+
+    def test_close_early_stops_thread(self):
+        def infinite():
+            k = 0
+            while True:
+                yield np.asarray([k])
+                k += 1
+
+        pf = DevicePrefetcher(infinite())
+        next(pf)
+        pf.close()
+        assert not pf._thread.is_alive()
+
+
+class TestStreamingTwoTowerParity:
+    def test_streaming_train_matches_in_memory(self):
+        """Small-chunk streaming training converges like the in-memory
+        path (chunk-local shuffle; single-chunk streaming is exactly
+        the in-memory permutation)."""
+        from predictionio_tpu.models.two_tower import (TwoTowerParams,
+                                                       two_tower_train)
+
+        rng = np.random.default_rng(0)
+        n_u, n_i, n = 30, 20, 600
+        # block structure: user u likes items with same parity
+        uu = rng.integers(0, n_u, n).astype(np.int32)
+        ii = (2 * rng.integers(0, n_i // 2, n) + (uu % 2)).astype(np.int32)
+        p = TwoTowerParams(embed_dim=8, out_dim=8, hidden=[16],
+                           batch_size=64, epochs=4, learning_rate=0.05,
+                           seed=1)
+
+        def chunks():
+            for lo in range(0, n, 150):  # 4 chunks → boundaries exercised
+                yield uu[lo:lo + 150], ii[lo:lo + 150]
+
+        uv, iv = two_tower_train(np.zeros(0, np.int32), np.zeros(0, np.int32),
+                                 n_u, n_i, p, pair_chunks=chunks)
+        from predictionio_tpu.models.two_tower import (two_tower_embed_items,
+                                                       two_tower_user_embed)
+
+        ie = two_tower_embed_items(iv, n_i, p)
+        # the learned structure: even users score even items higher
+        ue = two_tower_user_embed(uv, 2, n_u, p)  # even user
+        scores = ie @ ue
+        assert scores[::2].mean() > scores[1::2].mean()
+
+    def test_chunks_smaller_than_batch_still_train(self):
+        """Regression: sub-batch chunks used to be skipped entirely —
+        streamChunk < batch_size silently returned an untrained model.
+        Remainders now carry across chunks."""
+        from predictionio_tpu.models.two_tower import (TwoTowerParams,
+                                                       two_tower_train)
+
+        rng = np.random.default_rng(2)
+        n = 300
+        uu = rng.integers(0, 20, n).astype(np.int32)
+        ii = rng.integers(0, 10, n).astype(np.int32)
+        p = TwoTowerParams(embed_dim=4, out_dim=4, hidden=[],
+                           batch_size=128, epochs=1, n_pairs=n)
+
+        def tiny_chunks():  # every chunk (50) < batch size (128)
+            for lo in range(0, n, 50):
+                yield uu[lo:lo + 50], ii[lo:lo + 50]
+
+        uv, iv = two_tower_train(np.zeros(0, np.int32),
+                                 np.zeros(0, np.int32), 20, 10, p,
+                                 pair_chunks=tiny_chunks)
+        assert uv is not None  # trained without error
+
+    def test_zero_possible_steps_raises(self):
+        from predictionio_tpu.models.two_tower import (TwoTowerParams,
+                                                       two_tower_train)
+
+        uu = np.arange(10, dtype=np.int32)
+        p = TwoTowerParams(embed_dim=4, out_dim=4, hidden=[],
+                           batch_size=1024, epochs=1, n_pairs=4000)
+
+        def chunks():  # total pairs (10) can never fill a 1024 batch
+            yield uu, uu
+
+        with pytest.raises(ValueError, match="zero steps"):
+            two_tower_train(np.zeros(0, np.int32), np.zeros(0, np.int32),
+                            10, 10, p, pair_chunks=chunks)
+
+    def test_unknown_ids_after_vocab_pass_are_skipped(self):
+        """Events ingested between the vocabulary pass and a data pass
+        (live store) must be skipped, not crash the stream."""
+        store = MemoryEventStore()
+        _seed(store, n=10)
+        data = read_interactions(lambda: store.find(1), chunk_size=4,
+                                 value_fn=_rating)
+        store.insert(Event(event="rate", entity_type="user",
+                           entity_id="NEW", target_entity_type="item",
+                           target_entity_id="ALSO_NEW",
+                           properties={"rating": 5.0},
+                           event_time=parse_event_time(
+                               "2026-01-02T00:00:00Z")), 1)
+        u, i, v = data.arrays()
+        assert len(u) == 10  # the new event is absent, no KeyError
+
+    def test_template_streaming_end_to_end(self, storage):
+        from predictionio_tpu.core.workflow import prepare_deploy, run_train
+
+        app = storage.meta.create_app("ttstream")
+        rng = np.random.default_rng(1)
+        evs = []
+        for _ in range(400):
+            u = int(rng.integers(0, 25))
+            i = 2 * int(rng.integers(0, 8)) + (u % 2)
+            evs.append(Event(event="view", entity_type="user",
+                             entity_id=str(u), target_entity_type="item",
+                             target_entity_id=str(i),
+                             event_time=parse_event_time(
+                                 "2026-01-01T00:00:00Z")))
+        storage.events.insert_batch(evs, app.id)
+        variant = {
+            "engineFactory":
+                "predictionio_tpu.templates.twotower.engine:engine_factory",
+            "datasource": {"params": {"appName": "ttstream",
+                                      "eventNames": ["view"],
+                                      "streamChunk": 100}},
+            "algorithms": [{"name": "twotower",
+                            "params": {"embedDim": 8, "outDim": 8,
+                                       "hidden": [16], "batchSize": 32,
+                                       "epochs": 2}}],
+        }
+        run_train("predictionio_tpu.templates.twotower.engine:engine_factory",
+                  variant=variant, storage=storage, use_mesh=False)
+        dep = prepare_deploy(
+            engine_factory=
+            "predictionio_tpu.templates.twotower.engine:engine_factory",
+            storage=storage)
+        res = dep.query({"user": "3", "num": 4})
+        assert len(res["itemScores"]) == 4
