@@ -19,9 +19,9 @@ Three layers, each usable alone:
   (user, item[, rating]) training data: pass 1 streams once to build
   the id vocabularies (entities are small even when events are not),
   pass 2 re-streams yielding index-mapped chunks. Also usable one-shot
-  (``concat=True``) as a drop-in replacement for list-building reads at
-  ~1/50th the transient memory (12 B/event columnar vs ~1 KB/event of
-  Event objects).
+  (``InteractionData.arrays()``) as a drop-in replacement for
+  list-building reads at ~1/50th the transient memory (12 B/event
+  columnar vs ~1 KB/event of Event objects).
 - :class:`DevicePrefetcher` — double-buffering: a background thread
   pulls the next host chunk and ``device_put``s it (optionally with a
   sharding) while the consumer computes on the current one, so host IO

@@ -179,7 +179,10 @@ def two_tower_train(
             def host_batches():
                 # remainders carry into the next chunk so chunks
                 # smaller than the batch size still train (rather than
-                # silently yielding zero steps)
+                # silently yielding zero steps). Every yield is ONE
+                # (1, B) batch: a per-chunk (m, B) shape would vary with
+                # the carry and re-trace/re-compile train_epoch's scan
+                # for every distinct m.
                 carry_u = np.zeros(0, np.int32)
                 carry_i = np.zeros(0, np.int32)
                 for chunk in pair_chunks():
@@ -194,8 +197,10 @@ def two_tower_train(
                     cperm = erng.permutation(len(u_c))
                     take, rest = cperm[: m * B], cperm[m * B:]
                     carry_u, carry_i = u_c[rest], i_c[rest]
-                    yield (u_c[take].reshape(m, B),
-                           i_c[take].reshape(m, B))
+                    ub = u_c[take].reshape(m, B)
+                    ib = i_c[take].reshape(m, B)
+                    for j in range(m):
+                        yield ub[j:j + 1], ib[j:j + 1]
 
             steps = 0
             with DevicePrefetcher(host_batches(),

@@ -48,6 +48,8 @@ class EngineServer:
         event_sink: Optional[Any] = None,
         plugins: Optional[List[Any]] = None,
         ssl_context: Optional[Any] = None,
+        bind_retries: int = 3,
+        bind_retry_sec: float = 1.0,
         batching: bool = False,
         batch_max: int = 64,
         batch_wait_ms: float = 2.0,
@@ -104,7 +106,10 @@ class EngineServer:
         if ssl_context is None:
             from predictionio_tpu.server.ssl_config import ssl_context_from_env
             ssl_context = ssl_context_from_env()
-        self.http = HTTPServer(router, host, port, ssl_context=ssl_context)
+        self.http = HTTPServer(router, host, port,
+                               ssl_context=ssl_context,
+                               bind_retries=bind_retries,
+                               bind_retry_sec=bind_retry_sec)
 
     # -- handlers --------------------------------------------------------------
 

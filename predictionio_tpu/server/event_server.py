@@ -74,6 +74,8 @@ class EventServer:
         stats: bool = False,
         plugins: Optional[List[Any]] = None,
         ssl_context: Optional[Any] = None,
+        bind_retries: int = 3,
+        bind_retry_sec: float = 1.0,
     ) -> None:
         self.storage = storage or get_storage()
         self.stats = Stats() if stats else None
@@ -99,7 +101,10 @@ class EventServer:
         if ssl_context is None:
             from predictionio_tpu.server.ssl_config import ssl_context_from_env
             ssl_context = ssl_context_from_env()
-        self.http = HTTPServer(router, host, port, ssl_context=ssl_context)
+        self.http = HTTPServer(router, host, port,
+                               ssl_context=ssl_context,
+                               bind_retries=bind_retries,
+                               bind_retry_sec=bind_retry_sec)
 
     # -- auth ------------------------------------------------------------------
 

@@ -90,12 +90,17 @@ class Router:
 
 class HTTPServer:
     def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 8000,
-                 ssl_context: Optional[Any] = None) -> None:
+                 ssl_context: Optional[Any] = None,
+                 bind_retries: int = 0, bind_retry_sec: float = 1.0) -> None:
         self.router = router
         self.host = host
         self.port = port
         #: optional ssl.SSLContext (see server.ssl_config) → HTTPS
         self.ssl_context = ssl_context
+        #: port-in-use bind retry (the reference's MasterActor retries
+        #: the bind while the previous instance shuts down)
+        self.bind_retries = bind_retries
+        self.bind_retry_sec = bind_retry_sec
         self._server: Optional[asyncio.AbstractServer] = None
         self._shutdown = asyncio.Event()
 
@@ -176,8 +181,20 @@ class HTTPServer:
             return Response.json({"message": "Internal Server Error"}, status=500)
 
     async def start(self) -> None:
-        self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port, ssl=self.ssl_context)
+        import errno
+
+        attempt = 0
+        while True:
+            try:
+                self._server = await asyncio.start_server(
+                    self._handle_conn, self.host, self.port,
+                    ssl=self.ssl_context)
+                return
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE or attempt >= self.bind_retries:
+                    raise
+                attempt += 1
+                await asyncio.sleep(self.bind_retry_sec)
 
     @property
     def bound_port(self) -> int:

@@ -46,41 +46,31 @@ class DataSourceParams:
 
 @dataclass
 class TrainingData:
-    pairs: List[tuple]  # positive (user, item); empty in streaming mode
-    interactions: Any = None  # data.pipeline.InteractionData (streaming)
+    interactions: Any   # data.pipeline.InteractionData
+    stream: bool = False  # True → trainer consumes chunks, not arrays
 
 
 class TTDataSource(DataSource):
     ParamsClass = DataSourceParams
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
-        p: DataSourceParams = self.params
-        if p.stream_chunk > 0:
-            # streaming read (SURVEY §2d C4): columnar chunks + vocab
-            # passes, memory O(chunk + vocabulary) — event logs larger
-            # than host RAM train; the trainer double-buffers chunks
-            # into HBM
-            from predictionio_tpu.data.pipeline import read_interactions
+        """Columnar read through the streaming pipeline in BOTH modes
+        (SURVEY §2d C4) — ~1/50th the transient memory of building a
+        Python pair list. ``stream_chunk > 0`` additionally keeps the
+        data chunked end-to-end (memory O(chunk + vocabulary), event
+        logs larger than host RAM; the trainer double-buffers chunks
+        into HBM)."""
+        from predictionio_tpu.data.pipeline import read_interactions
 
-            data = read_interactions(
-                lambda: event_store.find(
-                    p.app_name, entity_type="user",
-                    target_entity_type="item",
-                    event_names=p.event_names, storage=ctx.storage),
-                chunk_size=p.stream_chunk)
-            if data.n_events == 0:
-                raise ValueError("no interaction events found")
-            return TrainingData([], interactions=data)
-        pairs = [
-            (e.entity_id, e.target_entity_id)
-            for e in event_store.find(
+        p: DataSourceParams = self.params
+        data = read_interactions(
+            lambda: event_store.find(
                 p.app_name, entity_type="user", target_entity_type="item",
-                event_names=p.event_names, storage=ctx.storage)
-            if e.target_entity_id is not None
-        ]
-        if not pairs:
+                event_names=p.event_names, storage=ctx.storage),
+            chunk_size=p.stream_chunk or 65536)
+        if data.n_events == 0:
             raise ValueError("no interaction events found")
-        return TrainingData(pairs)
+        return TrainingData(data, stream=p.stream_chunk > 0)
 
 
 @dataclass
@@ -126,23 +116,18 @@ class TwoTowerAlgorithm(Algorithm):
     ParamsClass = TTAlgorithmParams
 
     def sanity_check(self, data: TrainingData) -> None:
-        if not data.pairs and data.interactions is None:
+        if data.interactions is None or data.interactions.n_events == 0:
             raise ValueError("empty training pairs")
 
     def train(self, ctx: WorkflowContext, pd: TrainingData) -> TwoTowerModel:
         p: TTAlgorithmParams = self.params
-        if pd.interactions is not None:
-            user_ids = pd.interactions.user_ids
-            item_ids = pd.interactions.item_ids
+        user_ids = pd.interactions.user_ids
+        item_ids = pd.interactions.item_ids
+        if pd.stream:
             uidx = np.zeros(0, np.int32)
             iidx = np.zeros(0, np.int32)
         else:
-            user_ids = BiMap.string_int(u for u, _ in pd.pairs)
-            item_ids = BiMap.string_int(i for _, i in pd.pairs)
-            uidx = np.fromiter((user_ids[u] for u, _ in pd.pairs), np.int32,
-                               len(pd.pairs))
-            iidx = np.fromiter((item_ids[i] for _, i in pd.pairs), np.int32,
-                               len(pd.pairs))
+            uidx, iidx, _ = pd.interactions.arrays()
         # explicit checkpoint_dir param wins; else the workflow's
         # per-run checkpoint dir enables restart-from-checkpoint
         ckpt_dir = p.checkpoint_dir
@@ -156,12 +141,10 @@ class TwoTowerAlgorithm(Algorithm):
             learning_rate=p.learning_rate, temperature=p.temperature,
             seed=p.seed, checkpoint_dir=ckpt_dir,
             checkpoint_every=p.checkpoint_every,
-            n_pairs=(pd.interactions.n_events
-                     if pd.interactions is not None else 0))
+            n_pairs=pd.interactions.n_events)
         uv, iv = two_tower_train(
             uidx, iidx, len(user_ids), len(item_ids), tp, mesh=ctx.mesh,
-            pair_chunks=(pd.interactions.chunks
-                         if pd.interactions is not None else None))
+            pair_chunks=(pd.interactions.chunks if pd.stream else None))
         item_embeds = two_tower_embed_items(iv, len(item_ids), tp)
         return TwoTowerModel(uv, item_embeds, user_ids, item_ids, tp)
 

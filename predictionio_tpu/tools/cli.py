@@ -211,6 +211,22 @@ def cmd_eval(args: argparse.Namespace) -> None:
         print(f"[info] wrote {args.output}")
 
 
+def cmd_daemon(args: argparse.Namespace) -> None:
+    from predictionio_tpu.tools.supervise import main as supervise_main
+
+    argv = []
+    if args.pidfile:
+        argv += ["--pidfile", args.pidfile]
+    if args.health_url:
+        argv += ["--health-url", args.health_url]
+    argv += ["--health-interval", str(args.health_interval),
+             "--health-grace", str(args.health_grace),
+             "--max-restarts", str(args.max_restarts),
+             "--restart-window", str(args.restart_window), "--"]
+    argv += args.command
+    raise SystemExit(supervise_main(argv))
+
+
 def cmd_batchpredict(args: argparse.Namespace) -> None:
     from predictionio_tpu.core.batchpredict import run_batch_predict
     from predictionio_tpu.core.workflow import prepare_deploy
@@ -471,6 +487,19 @@ def build_parser() -> argparse.ArgumentParser:
 
     stp = sub.add_parser("status", help="check storage + device connectivity")
     stp.set_defaults(fn=cmd_status)
+
+    dm = sub.add_parser(
+        "daemon",
+        help="supervise a server verb: crash restart with backoff, "
+             "health checks, pidfile (MasterActor-grade supervision)")
+    dm.add_argument("--pidfile")
+    dm.add_argument("--health-url")
+    dm.add_argument("--health-interval", type=float, default=5.0)
+    dm.add_argument("--health-grace", type=float, default=30.0)
+    dm.add_argument("--max-restarts", type=int, default=10)
+    dm.add_argument("--restart-window", type=float, default=600.0)
+    dm.add_argument("command", nargs=argparse.REMAINDER)
+    dm.set_defaults(fn=cmd_daemon)
 
     db = sub.add_parser("dashboard", help="evaluation results dashboard")
     db.add_argument("--ip", default="0.0.0.0")

@@ -1,0 +1,153 @@
+"""Server supervision (reference MasterActor parity, SURVEY.md §2a
+CreateServer / §5 failure detection): crash restart with backoff +
+budget, health-check restarts, clean stop, and port-in-use bind retry."""
+
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.tools.supervise import Supervisor
+
+
+def _run_in_thread(sup):
+    out = {}
+
+    def run():
+        out["code"] = sup.run()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, out
+
+
+class TestSupervisor:
+    def test_crash_restart_with_budget(self, tmp_path):
+        marker = tmp_path / "starts.txt"
+        sup = Supervisor(
+            [sys.executable, "-c",
+             f"open(r'{marker}', 'a').write('x'); raise SystemExit(3)"],
+            max_restarts=3, restart_window=60.0, backoff=0.05,
+            backoff_max=0.05, log=lambda *a: None)
+        t, out = _run_in_thread(sup)
+        t.join(timeout=30)
+        assert not t.is_alive()
+        # initial start + 3 budgeted restarts, then gave up with code 1
+        assert out["code"] == 1
+        assert marker.read_text().count("x") == 4
+        assert sup.restarts == 3
+
+    def test_clean_stop_returns_zero(self, tmp_path):
+        sup = Supervisor([sys.executable, "-c",
+                          "import time; time.sleep(60)"],
+                         backoff=0.05, log=lambda *a: None)
+        t, out = _run_in_thread(sup)
+        time.sleep(0.8)
+        sup.stop()
+        t.join(timeout=15)
+        assert not t.is_alive()
+        assert out["code"] == 0
+        assert sup._child.poll() is not None  # child is gone
+
+    def test_health_check_restarts_wedged_child(self, tmp_path):
+        """A child that stays alive but never answers health checks
+        (URL points at a closed port) gets killed and restarted."""
+        marker = tmp_path / "starts.txt"
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            dead_port = s.getsockname()[1]
+        sup = Supervisor(
+            [sys.executable, "-c",
+             f"open(r'{marker}', 'a').write('x');"
+             "import time; time.sleep(60)"],
+            health_url=f"http://127.0.0.1:{dead_port}/",
+            health_interval=0.2, health_timeout=0.5, health_grace=0.3,
+            max_restarts=50, backoff=0.05, backoff_max=0.05,
+            log=lambda *a: None)
+        t, out = _run_in_thread(sup)
+        deadline = time.time() + 20
+        while time.time() < deadline and sup.restarts < 2:
+            time.sleep(0.1)
+        sup.stop()
+        t.join(timeout=15)
+        assert sup.restarts >= 2
+        # ≥2: the final restart's child may be stopped before it writes
+        assert marker.read_text().count("x") >= 2
+
+    def test_pidfile_lifecycle(self, tmp_path):
+        pidfile = tmp_path / "sup.pid"
+        sup = Supervisor([sys.executable, "-c",
+                          "import time; time.sleep(60)"],
+                         pidfile=str(pidfile), backoff=0.05,
+                         log=lambda *a: None)
+        t, out = _run_in_thread(sup)
+        deadline = time.time() + 10
+        while time.time() < deadline and not pidfile.exists():
+            time.sleep(0.05)
+        assert pidfile.exists()
+        sup.stop()
+        t.join(timeout=15)
+        assert not pidfile.exists()  # removed on shutdown
+
+
+class TestBindRetry:
+    def test_event_server_retries_port_in_use(self, storage):
+        """MasterActor parity: the server retries the bind while the
+        previous occupant shuts down, instead of dying."""
+        import asyncio
+
+        from predictionio_tpu.server.event_server import EventServer
+
+        blocker = socket.socket()
+        blocker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        blocker.bind(("127.0.0.1", 0))
+        port = blocker.getsockname()[1]
+        blocker.listen(1)
+
+        server = EventServer(storage=storage, host="127.0.0.1", port=port,
+                             bind_retries=20, bind_retry_sec=0.1)
+        loop = asyncio.new_event_loop()
+
+        def run():
+            asyncio.set_event_loop(loop)
+            loop.run_until_complete(server.http.serve_forever())
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        time.sleep(0.5)       # server is in its retry loop
+        blocker.close()       # previous occupant goes away
+        deadline = time.time() + 10
+        ok = False
+        import urllib.request
+
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/", timeout=1) as r:
+                    ok = r.status == 200
+                    break
+            except Exception:
+                time.sleep(0.1)
+        assert ok, "server never bound after the port freed up"
+        loop.call_soon_threadsafe(server.http.request_shutdown)
+        t.join(timeout=10)
+
+    def test_no_retry_raises_immediately(self, storage):
+        import asyncio
+
+        from predictionio_tpu.server.event_server import EventServer
+
+        blocker = socket.socket()
+        blocker.bind(("127.0.0.1", 0))
+        port = blocker.getsockname()[1]
+        blocker.listen(1)
+        try:
+            server = EventServer(storage=storage, host="127.0.0.1",
+                                 port=port, bind_retries=0)
+            with pytest.raises(OSError):
+                asyncio.new_event_loop().run_until_complete(
+                    server.http.start())
+        finally:
+            blocker.close()
