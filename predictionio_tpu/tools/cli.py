@@ -212,19 +212,18 @@ def cmd_eval(args: argparse.Namespace) -> None:
 
 
 def cmd_daemon(args: argparse.Namespace) -> None:
-    from predictionio_tpu.tools.supervise import main as supervise_main
+    from predictionio_tpu.tools.supervise import Supervisor, normalize_command
 
-    argv = []
-    if args.pidfile:
-        argv += ["--pidfile", args.pidfile]
-    if args.health_url:
-        argv += ["--health-url", args.health_url]
-    argv += ["--health-interval", str(args.health_interval),
-             "--health-grace", str(args.health_grace),
-             "--max-restarts", str(args.max_restarts),
-             "--restart-window", str(args.restart_window), "--"]
-    argv += args.command
-    raise SystemExit(supervise_main(argv))
+    cmd = normalize_command(args.command)
+    if not cmd:
+        _die("pio daemon: no command given")
+    sup = Supervisor(cmd, health_url=args.health_url,
+                     health_interval=args.health_interval,
+                     health_grace=args.health_grace,
+                     max_restarts=args.max_restarts,
+                     restart_window=args.restart_window,
+                     pidfile=args.pidfile)
+    raise SystemExit(sup.run())
 
 
 def cmd_batchpredict(args: argparse.Namespace) -> None:

@@ -34,6 +34,13 @@ import urllib.request
 from typing import List, Optional, Sequence
 
 
+def _log(*args) -> None:
+    # flush per line: under `pio-daemon`'s redirected stdout, plain print
+    # is block-buffered and restart events would not reach the log until
+    # the buffer fills
+    print(*args, flush=True)
+
+
 class Supervisor:
     def __init__(
         self,
@@ -47,7 +54,7 @@ class Supervisor:
         backoff: float = 1.0,
         backoff_max: float = 30.0,
         pidfile: Optional[str] = None,
-        log=print,
+        log=_log,
     ) -> None:
         self.argv = list(argv)
         self.health_url = health_url
@@ -132,6 +139,12 @@ class Supervisor:
                 if code is not None:
                     if self._stopping:
                         break
+                    if code == 0:
+                        # a clean exit is a finished job, not a crash —
+                        # restarting it (e.g. `pio daemon -- train`) would
+                        # re-run a successful run until the budget ran out
+                        self.log("[supervise] child exited cleanly; done")
+                        return 0
                     self.log(f"[supervise] child exited with {code}")
                     restart = True
                 elif (self.health_url is not None
@@ -177,6 +190,22 @@ class Supervisor:
         self._terminate_child()
 
 
+def normalize_command(command: Sequence[str]) -> List[str]:
+    """Resolve the supervised command line: drop the one leading ``--``
+    argparse leaves in REMAINDER, and route bare verbs through this
+    interpreter's CLI (``eventserver --port 7070`` →
+    ``python -m predictionio_tpu.tools.cli eventserver --port 7070``)."""
+    cmd = list(command)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        return cmd
+    head = os.path.basename(cmd[0])
+    if cmd[0] != sys.executable and not head.startswith("python"):
+        cmd = [sys.executable, "-m", "predictionio_tpu.tools.cli"] + cmd
+    return cmd
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
 
@@ -199,12 +228,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="the pio verb to supervise, e.g. "
                          "eventserver --port 7070")
     args = ap.parse_args(argv)
-    cmd = [c for c in args.command if c != "--"]
+    cmd = normalize_command(args.command)
     if not cmd:
         ap.error("no command given")
-    if cmd[0] != sys.executable and not cmd[0].startswith("python"):
-        # a bare verb runs through this interpreter's CLI
-        cmd = [sys.executable, "-m", "predictionio_tpu.tools.cli"] + cmd
     sup = Supervisor(cmd, health_url=args.health_url,
                      health_interval=args.health_interval,
                      health_grace=args.health_grace,

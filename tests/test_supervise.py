@@ -27,7 +27,7 @@ class TestSupervisor:
     def test_crash_restart_with_budget(self, tmp_path):
         marker = tmp_path / "starts.txt"
         sup = Supervisor(
-            [sys.executable, "-c",
+            [sys.executable, "-S", "-c",
              f"open(r'{marker}', 'a').write('x'); raise SystemExit(3)"],
             max_restarts=3, restart_window=60.0, backoff=0.05,
             backoff_max=0.05, log=lambda *a: None)
@@ -40,7 +40,7 @@ class TestSupervisor:
         assert sup.restarts == 3
 
     def test_clean_stop_returns_zero(self, tmp_path):
-        sup = Supervisor([sys.executable, "-c",
+        sup = Supervisor([sys.executable, "-S", "-c",
                           "import time; time.sleep(60)"],
                          backoff=0.05, log=lambda *a: None)
         t, out = _run_in_thread(sup)
@@ -58,12 +58,15 @@ class TestSupervisor:
         with socket.socket() as s:
             s.bind(("127.0.0.1", 0))
             dead_port = s.getsockname()[1]
+        # -S: bare interpreter startup is ~15ms vs ~3s with site init on
+        # this image — the child must write its marker inside the grace
+        # window before the failing health check kills it.
         sup = Supervisor(
-            [sys.executable, "-c",
+            [sys.executable, "-S", "-c",
              f"open(r'{marker}', 'a').write('x');"
              "import time; time.sleep(60)"],
             health_url=f"http://127.0.0.1:{dead_port}/",
-            health_interval=0.2, health_timeout=0.5, health_grace=0.3,
+            health_interval=0.2, health_timeout=0.5, health_grace=1.0,
             max_restarts=50, backoff=0.05, backoff_max=0.05,
             log=lambda *a: None)
         t, out = _run_in_thread(sup)
@@ -76,9 +79,25 @@ class TestSupervisor:
         # ≥2: the final restart's child may be stopped before it writes
         assert marker.read_text().count("x") >= 2
 
+    def test_clean_exit_is_not_a_crash(self, tmp_path):
+        """Exit code 0 means the job finished — the supervisor must
+        return 0, not burn the restart budget re-running it."""
+        marker = tmp_path / "starts.txt"
+        sup = Supervisor(
+            [sys.executable, "-S", "-c",
+             f"open(r'{marker}', 'a').write('x')"],
+            max_restarts=3, backoff=0.05, backoff_max=0.05,
+            log=lambda *a: None)
+        t, out = _run_in_thread(sup)
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert out["code"] == 0
+        assert sup.restarts == 0
+        assert marker.read_text() == "x"  # ran exactly once
+
     def test_pidfile_lifecycle(self, tmp_path):
         pidfile = tmp_path / "sup.pid"
-        sup = Supervisor([sys.executable, "-c",
+        sup = Supervisor([sys.executable, "-S", "-c",
                           "import time; time.sleep(60)"],
                          pidfile=str(pidfile), backoff=0.05,
                          log=lambda *a: None)
@@ -90,6 +109,24 @@ class TestSupervisor:
         sup.stop()
         t.join(timeout=15)
         assert not pidfile.exists()  # removed on shutdown
+
+
+class TestNormalizeCommand:
+    def test_bare_verb_routes_through_cli(self):
+        from predictionio_tpu.tools.supervise import normalize_command
+        cmd = normalize_command(["--", "eventserver", "--port", "7070"])
+        assert cmd == [sys.executable, "-m", "predictionio_tpu.tools.cli",
+                       "eventserver", "--port", "7070"]
+
+    def test_absolute_interpreter_path_left_alone(self):
+        from predictionio_tpu.tools.supervise import normalize_command
+        cmd = normalize_command(["/usr/bin/python3", "server.py"])
+        assert cmd == ["/usr/bin/python3", "server.py"]
+
+    def test_only_leading_separator_stripped(self):
+        from predictionio_tpu.tools.supervise import normalize_command
+        cmd = normalize_command([sys.executable, "tool.py", "--", "-x"])
+        assert cmd == [sys.executable, "tool.py", "--", "-x"]
 
 
 class TestBindRetry:
