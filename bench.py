@@ -54,25 +54,36 @@ def synthetic_ml20m(nnz: int, n_users: int = 138_493, n_items: int = 26_744,
 
 
 def _train_flops(prep, rank: int, iterations: int) -> float:
-    """Model FLOPs: batched weighted Gram + rhs per padded rating slot,
+    """Executed FLOPs: batched weighted Gram + rhs per padded rating
+    slot, the dense-head GEMMs (weight rows × factor outer products),
     plus the per-entity Cholesky factor/inverse/apply."""
     k = rank
     padded = sum(b.n_slabs * b.slab * b.C
                  for side in (prep.u_side, prep.i_side)
                  for b in side.buckets)
     gram = 2.0 * padded * k * (k + 2)          # A (k×(k+1)) + b (k) builds
+    dense = sum(2.0 * side.dense.nb * side.dense.n_other * k * (k + 1)
+                + side.dense.n_other * k * k    # FF outer products
+                for side in (prep.u_side, prep.i_side)
+                if side.dense is not None)
     solves = (prep.n_users + prep.n_items) * (2 * k**3 / 3 + 4 * k**2)
-    return iterations * (gram + solves)
+    return iterations * (gram + dense + solves)
 
 
 def _train_bytes(prep, rank: int, iterations: int) -> float:
-    """Modeled HBM traffic: the factor gather dominates (k·4 bytes per
-    padded rating slot), plus the layout operands and factor writes."""
+    """Modeled HBM traffic: the factor gather (k·4 bytes per padded
+    rating slot) + layout operands, the dense-head weight rows + FF
+    write/read, and factor writes."""
     k = rank
     padded = sum(b.n_slabs * b.slab * b.C
                  for side in (prep.u_side, prep.i_side)
                  for b in side.buckets)
-    per_iter = padded * (k * 4 + 12) + (prep.n_users + prep.n_items) * k * 4
+    dense = sum(side.dense.nb * side.dense.n_other * 8      # w_cnt+w_val
+                + 2 * side.dense.n_other * k * k * 4        # FF w+r
+                for side in (prep.u_side, prep.i_side)
+                if side.dense is not None)
+    per_iter = (padded * (k * 4 + 12) + dense
+                + (prep.n_users + prep.n_items) * k * 4)
     return iterations * float(per_iter)
 
 

@@ -122,6 +122,22 @@ _C_MAX = _LADDER[-1]
 _SOLVE_CHUNK = 4096
 _SOLVE_BUF_MB = int(os.environ.get("PIO_ALS_SOLVE_BUF_MB", "4096"))
 
+# Dense-head crossover. The heaviest entities dominate padded slots
+# under a power law (ML-20M shape: the >8K-rating "seg" entities are
+# ~280 of 165K yet hold ~65% of all padded slots, and their gathers
+# measured ~70% of the whole Gram phase at ~140 GB/s effective — the
+# XLA row-gather ceiling). For an entity with C rating slots the
+# gather-path cost is ~C·256B at that ceiling, while a DENSE weight
+# row over the whole other side costs ~n_other·k(k+1) MXU flops via
+# one GEMM against the other side's factor outer products (no gather
+# at all). Measured crossover on v5e: C ≳ n_other/14. Entities above
+# it form the "dense head": per-entity (multiplicity, rating-sum)
+# rows over the full other side, normal equations by plain GEMM.
+# _DENSE_MIN_COUNT keeps tiny problems (tests, small apps) on the
+# uniform bucket path.
+_DENSE_RATIO = 1.0 / 14.0
+_DENSE_MIN_COUNT = 256
+
 
 @dataclass
 class _Bucket:
@@ -160,18 +176,42 @@ class _Bucket:
 
 
 @dataclass
+class _DenseHead:
+    """The heaviest entities (see ``_DENSE_RATIO``): per-entity dense
+    weight rows over the FULL other side. ``w_cnt[e, o]`` is the
+    multiplicity of the (e, o) pair (0 almost everywhere), ``w_val``
+    the rating sum — together they express exactly the same normal
+    equations as the bucketed slots, as two GEMMs with no gather."""
+
+    nb: int
+    n_other: int
+    w_cnt: np.ndarray   # (nb, n_other) f32
+    w_val: np.ndarray   # (nb, n_other) f32
+    counts: np.ndarray  # (nb,) f32 — rating count (ridge weighting)
+
+    @property
+    def geometry(self) -> Tuple[int, int]:
+        return (self.nb, self.n_other)
+
+
+@dataclass
 class _BucketSide:
     """One half-step orientation: self entities bucketed, other side
-    referenced by permuted position."""
+    referenced by permuted position. ``dense`` (optional) covers the
+    heaviest entities — permuted positions [0, dense.nb) — with the
+    remaining entities in ``buckets``."""
 
     n: int
     perm: np.ndarray       # position p → original entity id
     inv_perm: np.ndarray   # original entity id → position
     buckets: list
+    dense: Optional[_DenseHead] = None
 
     @property
     def geometry(self):
-        return (self.n, tuple(b.geometry for b in self.buckets))
+        return (self.n,
+                self.dense.geometry if self.dense is not None else None,
+                tuple(b.geometry for b in self.buckets))
 
 
 def _perm_by_count_desc(counts: np.ndarray):
@@ -181,26 +221,31 @@ def _perm_by_count_desc(counts: np.ndarray):
     return perm, inv
 
 
-def _merge_bounds(counts_sorted_list) -> tuple:
+def _merge_bounds(counts_sorted_list, n_other: int) -> tuple:
     """Common bucket boundaries for one or many count-desc-sorted count
-    vectors: ``((nb_seg, n_slabs_seg), ((width, nb), … desc))``.
+    vectors: ``(nb_dense, (nb_seg, n_slabs_seg), ((width, nb), … desc))``.
 
     For the sharded path every device must run the SAME program, so
     boundaries are the elementwise max over the devices' natural
-    boundaries. Placing a lighter entity in a wider bucket is always
-    safe (capacity ≥ count — see the argument in ``_bucket_side``), so
-    max-merging never breaks a device, only pads it.
+    boundaries. Placing a lighter entity in a wider bucket (or the
+    dense head) is always safe (capacity ≥ count — see the argument in
+    ``_bucket_side``), so max-merging never breaks a device, only pads
+    it.
     """
-    nb_seg = max(int((c > _C_MAX).sum()) for c in counts_sorted_list)
+    thresh = max(_DENSE_MIN_COUNT, int(_DENSE_RATIO * n_other))
+    nb_dense = max(int((c >= thresh).sum()) for c in counts_sorted_list)
+    nb_seg = max(int((c[nb_dense:] > _C_MAX).sum())
+                 for c in counts_sorted_list)
     rows_cap = 0
     if nb_seg:
         for c in counts_sorted_list:
-            rows = int(((c[:nb_seg] + _C_MAX - 1) // _C_MAX).sum())
+            seg_c = c[nb_dense:nb_dense + nb_seg]
+            rows = int(((seg_c + _C_MAX - 1) // _C_MAX).sum())
             rows_cap = max(rows_cap, rows, 1)
     ladder = np.asarray(_LADDER, np.int64)
     nbs: dict = {}
     for c in counts_sorted_list:
-        rest = c[nb_seg:]
+        rest = c[nb_dense + nb_seg:]
         rest = rest[rest > 0]
         if rest.size:
             w, n = np.unique(ladder[np.searchsorted(ladder, rest)],
@@ -208,22 +253,27 @@ def _merge_bounds(counts_sorted_list) -> tuple:
             for wi, ni in zip(w, n):
                 nbs[int(wi)] = max(nbs.get(int(wi), 0), int(ni))
     regs = tuple(sorted(nbs.items(), reverse=True))
-    return ((nb_seg, rows_cap), regs)
+    return (nb_dense, (nb_seg, rows_cap), regs)
 
 
 def _bucket_side(idx_self, idx_other_pos, vals, n_self, counts,
-                 perm, inv_perm, bounds=None) -> _BucketSide:
+                 perm, inv_perm, n_other=None, bounds=None) -> _BucketSide:
     """Bucket one orientation. ``idx_other_pos`` must already be mapped
     to the other side's factor-row positions; ``counts/perm/inv_perm``
-    come from :func:`_perm_by_count_desc` on this side's counts.
+    come from :func:`_perm_by_count_desc` on this side's counts;
+    ``n_other`` is the other side's factor-row count (the width of
+    dense-head weight rows — the gathered factor matrix height).
 
     ``bounds`` forces common bucket boundaries (sharded path: the
     max-merge over all devices, so every device traces one program).
     Forced boundaries are safe: the entity at permuted position p has
     count ≤ every entity before it, and merged boundaries only ever
-    move p into a bucket at least as wide as its natural one — so
-    capacity C ≥ count always holds.
+    move p into the dense head or a bucket at least as wide as its
+    natural one — so capacity C ≥ count always holds.
     """
+    if n_other is None:
+        n_other = (int(idx_other_pos.max()) + 1 if idx_other_pos.size
+                   else 1)
     nnz = idx_self.shape[0]
     pos = inv_perm[idx_self]
     order = np.argsort(pos, kind="stable")
@@ -234,15 +284,44 @@ def _bucket_side(idx_self, idx_other_pos, vals, n_self, counts,
     within = (np.arange(nnz, dtype=np.int64) - starts[ps]).astype(np.int64)
 
     if bounds is None:
-        bounds = _merge_bounds([counts_perm])
-    (nb_seg, rows_cap), regs = bounds
+        bounds = _merge_bounds([counts_perm], n_other)
+    nb_dense, (nb_seg, rows_cap), regs = bounds
+
+    # dense head: heaviest entities (permuted positions [0, nb_dense))
+    # as dense weight rows — see _DENSE_RATIO
+    dense = None
+    if nb_dense:
+        hi = int(starts[min(nb_dense, n_self)])
+        # bincount over linearized (entity, other) indices: np.add.at
+        # is an unbuffered scalar scatter, ~50-100× slower over the
+        # millions of nnz the dense head holds
+        lin = ps[:hi].astype(np.int64) * n_other + o[:hi]
+        size = nb_dense * n_other
+        w_cnt = np.bincount(lin, minlength=size).astype(
+            np.float32).reshape(nb_dense, n_other)
+        w_val = np.bincount(lin, weights=v[:hi], minlength=size).astype(
+            np.float32).reshape(nb_dense, n_other)
+        cnts = np.zeros(nb_dense, np.float32)
+        real = min(nb_dense, n_self)
+        cnts[:real] = counts_perm[:real]
+        dense = _DenseHead(nb_dense, n_other, w_cnt, w_val, cnts)
+        # rebase the remainder so the seg/ladder code below sees a
+        # self-contained problem over positions [nb_dense, n_self)
+        ps = ps[hi:] - nb_dense
+        o, v, within = o[hi:], v[hi:], within[hi:]
+        counts_perm = counts_perm[nb_dense:]
+        starts = starts[nb_dense:] - hi
+        n_self_rest = max(n_self - nb_dense, 0)
+    else:
+        n_self_rest = n_self
     buckets = []
 
     # heavy entities (count > _C_MAX): one SEGMENTED bucket — each
     # entity spans ceil(count/C) rows of width C; the one-hot ``seg``
     # matrix aggregates row partials per entity inside the compiled
-    # program. Entities are count-descending, so these are positions
-    # 0..nb_seg-1 and the output concatenation order is preserved.
+    # program. Entities are count-descending, so these are the first
+    # positions after the dense head and the output concatenation order
+    # is preserved.
     if nb_seg:
         C = _C_MAX
         cnts = counts_perm[:nb_seg]
@@ -297,8 +376,8 @@ def _bucket_side(idx_self, idx_other_pos, vals, n_self, counts,
         vv = np.zeros((nb_pad, C), np.float32)
         mm = np.zeros((nb_pad, C), np.float32)
         # forced boundaries may extend past this device's entities
-        e_end = min(e + nb, n_self)
-        lo, hi = int(starts[min(e, n_self)]), int(starts[e_end])
+        e_end = min(e + nb, n_self_rest)
+        lo, hi = int(starts[min(e, n_self_rest)]), int(starts[e_end])
         row = (ps[lo:hi] - e).astype(np.int64)
         col = within[lo:hi]
         oi[row, col] = o[lo:hi]
@@ -313,7 +392,7 @@ def _bucket_side(idx_self, idx_other_pos, vals, n_self, counts,
             mm.reshape(n_slabs, slab, C),
             cnt.reshape(n_slabs, slab)))
         e += nb
-    return _BucketSide(n_self, perm, inv_perm, buckets)
+    return _BucketSide(n_self, perm, inv_perm, buckets, dense=dense)
 
 
 @dataclass
@@ -348,13 +427,19 @@ class ALSPrepared:
                 return (jnp.asarray(a) if device is None
                         else jax.device_put(a, device))
 
-            self._device_bufs[device] = tuple(
-                tuple((put(b.other_idx), put(b.vals), put(b.mask),
-                       put(b.counts))
-                      + ((put(b.seg), put(b.seg_off))
-                         if b.seg is not None else ())
-                      for b in side.buckets)
-                for side in (self.u_side, self.i_side))
+            def side_bufs(side):
+                dense = (() if side.dense is None else
+                         (put(side.dense.w_cnt), put(side.dense.w_val),
+                          put(side.dense.counts)))
+                return (dense, tuple(
+                    tuple((put(b.other_idx), put(b.vals), put(b.mask),
+                           put(b.counts))
+                          + ((put(b.seg), put(b.seg_off))
+                             if b.seg is not None else ())
+                          for b in side.buckets)))
+
+            self._device_bufs[device] = (side_bufs(self.u_side),
+                                         side_bufs(self.i_side))
         return self._device_bufs[device]
 
 
@@ -365,9 +450,11 @@ def als_prepare(coo: RatingsCOO) -> ALSPrepared:
     perm_u, inv_u = _perm_by_count_desc(cnt_u)
     perm_i, inv_i = _perm_by_count_desc(cnt_i)
     u_side = _bucket_side(coo.user_idx, inv_i[coo.item_idx], coo.rating,
-                          coo.n_users, cnt_u, perm_u, inv_u)
+                          coo.n_users, cnt_u, perm_u, inv_u,
+                          n_other=coo.n_items)
     i_side = _bucket_side(coo.item_idx, inv_u[coo.user_idx], coo.rating,
-                          coo.n_items, cnt_i, perm_i, inv_i)
+                          coo.n_items, cnt_i, perm_i, inv_i,
+                          n_other=coo.n_users)
     return ALSPrepared(coo.n_users, coo.n_items, coo.nnz, u_side, i_side)
 
 
@@ -433,18 +520,25 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
     def row_grams(F_other, oi_s, v_s, m_s):
         """One slab's per-row normal-equation partials on the MXU.
 
-        HIGHEST: normal equations need f32 MXU passes — bf16 Gram error
-        is ~3e-1 vs 6e-5 (see ops/gram.py) and the Cholesky solve
-        amplifies it."""
+        A and b are built by ONE packed einsum: H = [w_o·F | w_b] is a
+        (slab, C, k+1) block, and F'H = [A | b]. Computing b separately
+        ("nc,nck->nk") lowered to a VPU multiply-reduce that measured
+        ~45 ms/iteration at ML-20M — pure overhead next to the A matmul
+        the MXU was already doing; packed, it is one extra MXU column.
+
+        HIGH (3-pass bf16 ≈ f32): normal equations need f32-grade MXU
+        passes — single-pass bf16 Gram error is ~3e-1 vs 6e-5 (see
+        ops/gram.py) and the Cholesky solve amplifies it. HIGHEST
+        (6-pass) halves MXU throughput for precision ALS cannot use:
+        measured iterate divergence HIGH-vs-HIGHEST after 10 iterations
+        is ~1e-4 relative — f32 solve noise level, far inside the
+        parity-test tolerances."""
         F = F_other[oi_s]                               # (slab, C, k)
         wo, wb = weights(v_s, m_s)
-        A = jnp.einsum("nc,nck,ncl->nkl", wo, F, F,
-                       precision=jax.lax.Precision.HIGHEST,
-                       preferred_element_type=jnp.float32)
-        b = jnp.einsum("nc,nck->nk", wb, F,
-                       precision=jax.lax.Precision.HIGHEST,
-                       preferred_element_type=jnp.float32)
-        return A, b
+        H = jnp.concatenate([wo[..., None] * F, wb[..., None]], axis=-1)
+        return jnp.einsum("nck,ncl->nkl", F, H,
+                          precision=jax.lax.Precision.HIGH,
+                          preferred_element_type=jnp.float32)
 
     def ridge(A, cnt_s, G):
         if implicit:
@@ -462,42 +556,61 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
         update-slice never clamps."""
         oi, vv, mm, cnt, seg, seg_off = buf
 
-        def seg_body(carry, chunk):
-            A_e, b_e = carry
+        def seg_body(Ab_e, chunk):
             oi_s, v_s, m_s, seg_s, off_s = chunk
-            A_r, b_r = row_grams(F_other, oi_s, v_s, m_s)
-            A_l = jnp.einsum("ne,nkl->ekl", seg_s, A_r,
-                             precision=jax.lax.Precision.HIGHEST,
-                             preferred_element_type=jnp.float32)
-            b_l = jnp.einsum("ne,nk->ek", seg_s, b_r,
-                             precision=jax.lax.Precision.HIGHEST,
-                             preferred_element_type=jnp.float32)
-            blk_A = jax.lax.dynamic_slice(A_e, (off_s, 0, 0),
-                                          (slab, k, k))
-            blk_b = jax.lax.dynamic_slice(b_e, (off_s, 0), (slab, k))
-            A_e = jax.lax.dynamic_update_slice(A_e, blk_A + A_l,
-                                               (off_s, 0, 0))
-            b_e = jax.lax.dynamic_update_slice(b_e, blk_b + b_l,
-                                               (off_s, 0))
-            return (A_e, b_e), None
+            Ab_r = row_grams(F_other, oi_s, v_s, m_s)   # (slab, k, k+1)
+            Ab_l = jnp.einsum("ne,nkm->ekm", seg_s, Ab_r,
+                              precision=jax.lax.Precision.HIGH,
+                              preferred_element_type=jnp.float32)
+            blk = jax.lax.dynamic_slice(Ab_e, (off_s, 0, 0),
+                                        (slab, k, k + 1))
+            Ab_e = jax.lax.dynamic_update_slice(Ab_e, blk + Ab_l,
+                                                (off_s, 0, 0))
+            return Ab_e, None
 
-        init = (pv(jnp.zeros((nb + slab, k, k), jnp.float32)),
-                pv(jnp.zeros((nb + slab, k), jnp.float32)))
-        (A_e, b_e), _ = jax.lax.scan(
-            seg_body, init, (oi, vv, mm, seg, seg_off))
-        return ridge(A_e[:nb], cnt, G), b_e[:nb]
+        init = pv(jnp.zeros((nb + slab, k, k + 1), jnp.float32))
+        Ab_e, _ = jax.lax.scan(seg_body, init, (oi, vv, mm, seg, seg_off))
+        return ridge(Ab_e[:nb, :, :k], cnt, G), Ab_e[:nb, :, k]
 
-    def half_materialized(F_other, bufs, geometry, G, spans, chunk,
-                          n_chunks):
-        """Two-phase half-step: every bucket emits its (ridged) normal
-        equations as scan outputs, concatenated into one solve buffer a
+    def dense_equations(F_other, dbuf, G):
+        """Dense head: normal equations for the heaviest entities as
+        two GEMMs over the FULL other side — A rows against the factor
+        outer products, b rows against the factors — replacing the
+        gathered seg path that measured ~70% of the Gram phase at
+        ML-20M (~280 entities holding ~65% of padded slots). No gather,
+        no scan: pure MXU work."""
+        w_cnt, w_val, cnt = dbuf
+        if implicit:
+            wo_m, wb_m = alpha * w_val, w_cnt + alpha * w_val
+        else:
+            wo_m, wb_m = w_cnt, w_val
+        n_other = F_other.shape[0]
+        FF = (F_other[:, :, None] * F_other[:, None, :]).reshape(
+            n_other, k * k)
+        A = jnp.einsum("nc,cm->nm", wo_m, FF,
+                       precision=jax.lax.Precision.HIGH,
+                       preferred_element_type=jnp.float32
+                       ).reshape(-1, k, k)
+        b = jnp.einsum("nc,ck->nk", wb_m, F_other,
+                       precision=jax.lax.Precision.HIGH,
+                       preferred_element_type=jnp.float32)
+        return ridge(A, cnt, G), b
+
+    def half_materialized(F_other, dense_buf, bufs, geometry, G, spans,
+                          chunk, n_chunks):
+        """Two-phase half-step: the dense head and every bucket emit
+        (ridged) normal equations, concatenated into one solve buffer a
         single chunked scan then solves — ONE Cholesky instance in the
         program. Emitting via scan ``ys`` (not a carried buffer updated
         with dynamic_update_slice) matters: the carry pattern measured
         +116 ms per ML-20M half-step in buffer copies."""
         N_pad = n_chunks * chunk
-        n_self, bucket_geoms = geometry
+        n_self, dense_geom, bucket_geoms = geometry
         A_parts, b_parts = [], []
+        if dense_geom is not None:
+            A_d, b_d = dense_equations(F_other, dense_buf, G)
+            A_parts.append(A_d)
+            b_parts.append(b_d)
         for (C, nb, slab, n_slabs, is_seg), buf in zip(bucket_geoms, bufs):
             if is_seg:
                 A_e, b_e = seg_equations(F_other, buf, nb, slab, G)
@@ -508,8 +621,8 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
 
                 def body(_, chunk):
                     oi_s, v_s, m_s, cnt_s = chunk
-                    A, b = row_grams(F_other, oi_s, v_s, m_s)
-                    return None, (ridge(A, cnt_s, G), b)
+                    Ab = row_grams(F_other, oi_s, v_s, m_s)
+                    return None, (ridge(Ab[..., :k], cnt_s, G), Ab[..., k])
 
                 if n_slabs == 1:
                     A, b = body(None, (oi[0], vv[0], mm[0], cnt[0]))[1]
@@ -535,7 +648,9 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
                  b_all.reshape(n_chunks, chunk, k)))
             x_all = xc.reshape(N_pad, k)
         outs, off, total = [], 0, 0
-        for (C, nb, slab, n_slabs, is_seg), span in zip(bucket_geoms, spans):
+        nbs = ([dense_geom[0]] if dense_geom is not None else []) + \
+            [nb for (C, nb, slab, n_slabs, is_seg) in bucket_geoms]
+        for nb, span in zip(nbs, spans):
             outs.append(x_all[off:off + nb])
             off += span
             total += nb
@@ -545,28 +660,34 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
         # forced (merged) boundaries can exceed n_self; extras are zeros
         return out[:n_self] if total > n_self else out
 
-    def half(F_other, bufs, geometry):
-        n_self, bucket_geoms = geometry
+    def half(F_other, bufs_side, geometry):
+        n_self, dense_geom, bucket_geoms = geometry
+        dense_buf, bufs = bufs_side
         G = None
         if implicit:
             G = jnp.einsum("nk,nl->kl", F_other, F_other,
-                           precision=jax.lax.Precision.HIGHEST,
+                           precision=jax.lax.Precision.HIGH,
                            preferred_element_type=jnp.float32)
-        # each bucket's span in the solve buffer: seg buckets emit nb
-        # exact rows once, regular buckets emit their padded slabs
-        spans = [nb if is_seg else n_slabs * slab
-                 for (C, nb, slab, n_slabs, is_seg) in bucket_geoms]
+        # spans in the solve buffer: the dense head and seg buckets
+        # emit nb exact rows once, regular buckets their padded slabs
+        spans = ([dense_geom[0]] if dense_geom is not None else []) + \
+            [nb if is_seg else n_slabs * slab
+             for (C, nb, slab, n_slabs, is_seg) in bucket_geoms]
         # solve chunk shrinks for small sides (sharded per-device
         # blocks) so the floor isn't thousands of padded identity solves
         chunk = min(_SOLVE_CHUNK, max(256, -(-sum(spans) // 256) * 256))
         n_chunks = max(1, -(-sum(spans) // chunk))
         if n_chunks * chunk * k * k * 4 <= _SOLVE_BUF_MB << 20:
-            return half_materialized(F_other, bufs, geometry, G, spans,
-                                     chunk, n_chunks)
+            return half_materialized(F_other, dense_buf, bufs, geometry,
+                                     G, spans, chunk, n_chunks)
         # huge catalog: solve inside each bucket body (memory flat in
         # catalog size; compiles one Cholesky per bucket)
         outs = []
         total = 0
+        if dense_geom is not None:
+            A_d, b_d = dense_equations(F_other, dense_buf, G)
+            outs.append(chol_solve_batched(A_d, b_d))
+            total += dense_geom[0]
         for (C, nb, slab, n_slabs, is_seg), buf in zip(bucket_geoms, bufs):
             if is_seg:
                 A_e, b_e = seg_equations(F_other, buf, nb, slab, G)
@@ -576,8 +697,9 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
 
                 def body(_, chunk):
                     oi_s, v_s, m_s, cnt_s = chunk
-                    A, b = row_grams(F_other, oi_s, v_s, m_s)
-                    return None, chol_solve_batched(ridge(A, cnt_s, G), b)
+                    Ab = row_grams(F_other, oi_s, v_s, m_s)
+                    return None, chol_solve_batched(
+                        ridge(Ab[..., :k], cnt_s, G), Ab[..., k])
 
                 if n_slabs == 1:
                     x = body(None, (oi[0], vv[0], mm[0], cnt[0]))[1]
@@ -630,6 +752,18 @@ def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
     return jax.jit(train)
 
 
+@functools.lru_cache(maxsize=1)
+def _unpermute_pack():
+    import jax
+    import jax.numpy as jnp
+
+    def f(U, V, inv_u, inv_v):
+        return jnp.concatenate([jnp.take(U, inv_u, axis=0),
+                                jnp.take(V, inv_v, axis=0)], axis=0)
+
+    return jax.jit(f)
+
+
 def als_train_prepared(prep: ALSPrepared, p: ALSParams, device=None,
                        checkpointer=None, checkpoint_every: int = 0,
                        ) -> Tuple[np.ndarray, np.ndarray]:
@@ -675,11 +809,16 @@ def als_train_prepared(prep: ALSPrepared, p: ALSParams, device=None,
             except Exception:
                 okay = False
             if okay:
-                # stale checkpoints (different geometry/rank) fail the
-                # shape check above and fall back to a fresh start
                 V0 = np.asarray(state["V"])
                 U0 = np.asarray(state["U"])
                 start = min(int(step), p.iterations)
+            else:
+                # stale checkpoints (different geometry/rank) fall back
+                # to a fresh start — and the dir must be WIPED, else
+                # the fresh run's lower step numbers stay shadowed by
+                # the stale latest_step and every future resume
+                # restores the bad checkpoint again
+                checkpointer.clear()
 
     if start >= p.iterations and U0 is not None:
         # died between the final checkpoint and model persistence: the
@@ -697,9 +836,14 @@ def als_train_prepared(prep: ALSPrepared, p: ALSParams, device=None,
             it += n
             checkpointer.save(it, {"U": np.asarray(U), "V": np.asarray(V)})
         assert U is not None  # start < iterations here, loop ran
-    # un-permute back to original entity order
-    return (np.asarray(U)[prep.u_side.inv_perm],
-            np.asarray(V)[prep.i_side.inv_perm])
+    # un-permute to original entity order ON DEVICE and fetch U and V as
+    # ONE packed array: each device→host fetch is a full round trip
+    # (~66 ms over a tunneled chip), and the device does the
+    # fancy-index copy faster than the host would
+    packed = np.asarray(_unpermute_pack()(
+        put(U), put(V), put(prep.u_side.inv_perm),
+        put(prep.i_side.inv_perm)))
+    return packed[:prep.n_users], packed[prep.n_users:]
 
 
 def _als_train_single(coo: RatingsCOO, p: ALSParams,

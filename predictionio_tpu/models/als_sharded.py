@@ -87,7 +87,14 @@ class ALSShardedPrepared:
         return self.i_sides[0].geometry
 
     def _stacked(self, sides: List[_BucketSide]):
-        """Per-bucket arrays stacked over the leading device dim."""
+        """Per-bucket (and dense-head) arrays stacked over the leading
+        device dim, in the (dense, buckets) structure ``_make_half``
+        consumes."""
+        dense = ()
+        if sides[0].dense is not None:
+            dense = (np.stack([s.dense.w_cnt for s in sides]),
+                     np.stack([s.dense.w_val for s in sides]),
+                     np.stack([s.dense.counts for s in sides]))
         out = []
         for j in range(len(sides[0].buckets)):
             bs = [s.buckets[j] for s in sides]
@@ -99,7 +106,7 @@ class ALSShardedPrepared:
                 arrs += [np.stack([b.seg for b in bs]),
                          np.stack([b.seg_off for b in bs])]
             out.append(tuple(arrs))
-        return tuple(out)
+        return (dense, tuple(out))
 
     def device_buffers(self, mesh):
         """Stacked layouts placed on the mesh, cached per mesh — a
@@ -114,11 +121,15 @@ class ALSShardedPrepared:
             self._device_bufs = {}
         if mesh not in self._device_bufs:
             def put(tree):
-                return tuple(
-                    tuple(jax.device_put(a, NamedSharding(
+                dense, buckets = tree
+
+                def place(a):
+                    return jax.device_put(a, NamedSharding(
                         mesh, P("data", *([None] * (a.ndim - 1)))))
-                        for a in bkt)
-                    for bkt in tree)
+
+                return (tuple(place(a) for a in dense),
+                        tuple(tuple(place(a) for a in bkt)
+                              for bkt in buckets))
 
             self._device_bufs[mesh] = (put(self._stacked(self.u_sides)),
                                        put(self._stacked(self.i_sides)))
@@ -145,13 +156,15 @@ def _device_perms(idx, block, n_dev):
 
 
 def _side_prepared(idx_self, idx_other, vals, block, n_dev,
-                   locs, perms, invs, other_pos):
+                   locs, perms, invs, other_pos, n_other):
     """Build all devices' bucketed layouts for one orientation.
 
     ``other_pos[j]`` maps an ORIGINAL other-entity id to its permuted
-    global position in the gathered factor matrix."""
+    global position in the gathered factor matrix; ``n_other`` is that
+    matrix's height (padded global size)."""
     owner = idx_self // block
-    bounds = _merge_bounds([locs[d][perms[d]] for d in range(n_dev)])
+    bounds = _merge_bounds([locs[d][perms[d]] for d in range(n_dev)],
+                           n_other)
     sides = []
     for d in range(n_dev):
         sel = owner == d
@@ -160,7 +173,7 @@ def _side_prepared(idx_self, idx_other, vals, block, n_dev,
             other_pos[idx_other[sel]].astype(np.int32),
             vals[sel].astype(np.float32),
             block, locs[d].astype(np.float32), perms[d], invs[d],
-            bounds=bounds))
+            n_other=n_other, bounds=bounds))
     geom = sides[0].geometry
     assert all(s.geometry == geom for s in sides), \
         "max-merged bounds must give every device the same geometry"
@@ -177,9 +190,11 @@ def als_prepare_sharded(coo: RatingsCOO, n_dev: int) -> ALSShardedPrepared:
     ilocs, iperms, iinvs, ipos = _device_perms(coo.item_idx, block_i, n_dev)
 
     u_sides = _side_prepared(coo.user_idx, coo.item_idx, coo.rating,
-                             block_u, n_dev, ulocs, uperms, uinvs, ipos)
+                             block_u, n_dev, ulocs, uperms, uinvs, ipos,
+                             n_other=block_i * n_dev)
     i_sides = _side_prepared(coo.item_idx, coo.user_idx, coo.rating,
-                             block_i, n_dev, ilocs, iperms, iinvs, upos)
+                             block_i, n_dev, ilocs, iperms, iinvs, upos,
+                             n_other=block_u * n_dev)
     return ALSShardedPrepared(coo.n_users, coo.n_items, coo.nnz, n_dev,
                               block_u, block_i, u_sides, i_sides)
 
@@ -196,15 +211,20 @@ def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,
 
     shard_map = get_shard_map()
     k = rank
-    block_u, u_buckets = geom_u
+    block_u = geom_u[0]
     half = _make_half(k, reg, implicit, alpha, weighted_reg,
                       pvary=lambda x: pvary(x, "data"))
 
     def body(u_bufs, i_bufs, V0_l):
         # inside shard_map the stacked arrays arrive with a local
         # leading device dim of 1 → squeeze it
-        u_l = tuple(tuple(a[0] for a in bkt) for bkt in u_bufs)
-        i_l = tuple(tuple(a[0] for a in bkt) for bkt in i_bufs)
+        def squeeze(side):
+            dense, buckets = side
+            return (tuple(a[0] for a in dense),
+                    tuple(tuple(a[0] for a in bkt) for bkt in buckets))
+
+        u_l = squeeze(u_bufs)
+        i_l = squeeze(i_bufs)
 
         def step(carry, _):
             U_l, V_l = carry
@@ -219,7 +239,12 @@ def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,
                                      length=iterations)
         return U_l, V_l
 
-    def bucket_specs(buckets):
+    def side_specs(geom):
+        n_self, dense_geom, buckets = geom
+        dense = (() if dense_geom is None else
+                 (P("data", None, None),     # w_cnt
+                  P("data", None, None),     # w_val
+                  P("data", None)))          # counts
         specs = []
         for (C, nb, slab, n_slabs, is_seg) in buckets:
             s = [P("data", None, None, None)] * 3          # oi, vals, mask
@@ -229,11 +254,11 @@ def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,
                 s += [P("data", None, None, None),         # seg
                       P("data", None)]                     # seg_off
             specs.append(tuple(s))
-        return tuple(specs)
+        return (dense, tuple(specs))
 
     fn = shard_map(
         body, mesh=mesh,
-        in_specs=(bucket_specs(geom_u[1]), bucket_specs(geom_i[1]),
+        in_specs=(side_specs(geom_u), side_specs(geom_i),
                   P("data", None)),
         out_specs=(P("data", None), P("data", None)),
     )

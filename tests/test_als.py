@@ -134,6 +134,52 @@ class TestBucketedLayout:
         np.testing.assert_allclose(U, Ur, rtol=2e-3, atol=2e-3)
         np.testing.assert_allclose(V, Vr, rtol=2e-3, atol=2e-3)
 
+    def test_dense_head_matches_dense_reference(self, monkeypatch):
+        """Lower the dense-head threshold so the heaviest entities run
+        through the dense-weight GEMM path on a small dataset, and
+        check the whole program against the float64 reference."""
+        import predictionio_tpu.models.als as als_mod
+
+        monkeypatch.setattr(als_mod, "_DENSE_MIN_COUNT", 6)
+        rng = np.random.default_rng(9)
+        n_u, n_i, nnz = 40, 25, 500
+        uu = (rng.zipf(1.3, nnz) % n_u).astype(np.int32)
+        ii = rng.integers(0, n_i, nnz).astype(np.int32)
+        rr = rng.uniform(1, 5, nnz).astype(np.float32)  # duplicates kept
+        coo = RatingsCOO(uu, ii, rr, n_u, n_i)
+
+        prep = als_mod.als_prepare(coo)
+        assert prep.u_side.dense is not None and prep.u_side.dense.nb > 0
+        assert prep.u_side.buckets, "light entities must stay bucketed"
+
+        p = ALSParams(rank=4, iterations=2, reg=0.1, seed=2)
+        U, V = als_mod.als_train_prepared(prep, p)
+        Ur, Vr = _ref_als(coo, p)
+        np.testing.assert_allclose(U, Ur, rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(V, Vr, rtol=2e-3, atol=2e-3)
+
+    def test_dense_head_equivalent_to_bucketed_implicit(self, monkeypatch):
+        """Implicit feedback: the dense-head program must produce the
+        same factors as the pure bucketed layout on identical data."""
+        import predictionio_tpu.models.als as als_mod
+
+        rng = np.random.default_rng(10)
+        n_u, n_i, nnz = 30, 20, 400
+        uu = (rng.zipf(1.3, nnz) % n_u).astype(np.int32)
+        ii = rng.integers(0, n_i, nnz).astype(np.int32)
+        rr = rng.uniform(0.5, 3, nnz).astype(np.float32)
+        coo = RatingsCOO(uu, ii, rr, n_u, n_i)
+        p = ALSParams(rank=4, iterations=3, reg=0.1, implicit=True,
+                      alpha=2.0, seed=2)
+
+        U0, V0 = als_mod.als_train_prepared(als_mod.als_prepare(coo), p)
+        monkeypatch.setattr(als_mod, "_DENSE_MIN_COUNT", 6)
+        prep = als_mod.als_prepare(coo)
+        assert prep.u_side.dense is not None and prep.u_side.dense.nb > 0
+        U1, V1 = als_mod.als_train_prepared(prep, p)
+        np.testing.assert_allclose(U0, U1, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(V0, V1, rtol=1e-4, atol=1e-5)
+
     def test_in_body_solve_fallback_matches_materialized(self, monkeypatch):
         """The huge-catalog fallback (solve inside each bucket body,
         taken when the solve buffer would exceed PIO_ALS_SOLVE_BUF_MB)
@@ -247,6 +293,34 @@ class TestShardedParity:
         assert any(b.seg is not None for b in prep.u_sides[0].buckets)
         geoms = {s.geometry for s in prep.u_sides}
         assert len(geoms) == 1, "all devices must share one geometry"
+
+        p = ALSParams(rank=4, iterations=2, reg=0.1, seed=2)
+        U, V = als_train_sharded(coo, p, cpu_mesh)
+        Ur, Vr = _ref_als(coo, p)
+        np.testing.assert_allclose(U, Ur, rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(V, Vr, rtol=2e-3, atol=2e-3)
+
+    def test_sharded_dense_head_matches_reference(self, cpu_mesh,
+                                                  monkeypatch):
+        """Dense head under shard_map: per-device dense rows over the
+        gathered (padded global) other side, max-merged nb_dense."""
+        import predictionio_tpu.models.als as als_mod
+
+        monkeypatch.setattr(als_mod, "_DENSE_MIN_COUNT", 6)
+        rng = np.random.default_rng(12)
+        n_u, n_i, nnz = 33, 17, 400
+        uu = (rng.zipf(1.3, nnz) % n_u).astype(np.int32)
+        ii = rng.integers(0, n_i, nnz).astype(np.int32)
+        rr = rng.uniform(1, 5, nnz).astype(np.float32)
+        coo = RatingsCOO(uu, ii, rr, n_u, n_i)
+
+        from predictionio_tpu.models.als_sharded import (als_prepare_sharded,
+                                                         als_train_sharded)
+
+        prep = als_prepare_sharded(coo, 8)
+        assert prep.u_sides[0].dense is not None
+        assert prep.u_sides[0].dense.nb > 0
+        assert len({s.geometry for s in prep.u_sides}) == 1
 
         p = ALSParams(rank=4, iterations=2, reg=0.1, seed=2)
         U, V = als_train_sharded(coo, p, cpu_mesh)
