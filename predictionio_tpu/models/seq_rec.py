@@ -46,6 +46,14 @@ class SeqRecParams:
     batch_size: int = 128
     l2: float = 0.0
     seed: int = 7
+    # mid-train checkpoint/resume (SURVEY.md §5): save params +
+    # optimizer state every N epochs; a restarted train with the same
+    # dir resumes from the newest checkpoint and (batches are fixed per
+    # seed) produces the same final model as an uninterrupted run. None
+    # disables. The iteration loop then runs in blocks of
+    # ``checkpoint_every`` epochs (each block one compiled program).
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 1
 
 
 def init_params(n_items: int, p: SeqRecParams) -> Dict:
@@ -192,9 +200,7 @@ def _train_compiled(hidden: int, num_blocks: int, num_heads: int,
                      num_heads=num_heads, seq_len=seq_len, lr=lr, l2=l2)
     tx = optax.adam(lr)
 
-    def train(params, X, Y):
-        opt_state = tx.init(params)
-
+    def train(params, opt_state, X, Y):
         def batch_step(carry, xy):
             params, opt_state = carry
             loss, grads = jax.value_and_grad(_loss)(params, xy[0], xy[1], p,
@@ -207,9 +213,9 @@ def _train_compiled(hidden: int, num_blocks: int, num_heads: int,
             carry, losses = jax.lax.scan(batch_step, carry, (X, Y))
             return carry, losses.mean()
 
-        (params, _), losses = jax.lax.scan(epoch, (params, opt_state), None,
-                                           length=epochs)
-        return params, losses
+        (params, opt_state), losses = jax.lax.scan(
+            epoch, (params, opt_state), None, length=epochs)
+        return params, opt_state, losses
 
     return jax.jit(train)
 
@@ -227,17 +233,69 @@ def seq_rec_train(sequences, n_items: int, p: SeqRecParams, mesh=None,
     import jax
     import jax.numpy as jnp
 
+    import optax
+
     if mesh is not None and (
             seq_axis not in mesh.axis_names
             or p.seq_len % mesh.shape[seq_axis]):
         mesh = None
     X, Y = make_training_batches(sequences, p, seed=p.seed)
     params = jax.tree.map(jnp.asarray, init_params(n_items, p))
-    train = _train_compiled(p.hidden, p.num_blocks, p.num_heads, p.seq_len,
-                            float(p.lr), int(p.epochs), float(p.l2),
-                            mesh)
-    params, losses = train(params, X, Y)
-    return params, np.asarray(losses)
+    opt_state = optax.adam(p.lr).init(params)
+
+    def compiled(n_epochs: int):
+        return _train_compiled(p.hidden, p.num_blocks, p.num_heads,
+                               p.seq_len, float(p.lr), int(n_epochs),
+                               float(p.l2), mesh)
+
+    if not p.checkpoint_dir:
+        params, _, losses = compiled(p.epochs)(params, opt_state, X, Y)
+        return params, np.asarray(losses)
+
+    # checkpointed path: epoch blocks between saves; params + optimizer
+    # state fully determine the remainder (batches are fixed per seed),
+    # so resume reproduces the uninterrupted run
+    from predictionio_tpu.utils.checkpoint import TrainCheckpointer
+
+    ckpt = TrainCheckpointer(p.checkpoint_dir)
+    start = 0
+    latest = ckpt.latest_step()
+    if latest is not None:
+        template = {"params": jax.tree.map(np.asarray, params),
+                    "opt_state": jax.tree.map(np.asarray, opt_state)}
+        try:
+            state = ckpt.restore(latest, template=template)
+            # Orbax restores arrays of a DIFFERENT shape into a
+            # concrete template without raising — validate explicitly
+            chex_ok = all(
+                np.asarray(a).shape == np.asarray(b).shape
+                for a, b in zip(jax.tree.leaves(state),
+                                jax.tree.leaves(template)))
+            if not chex_ok:
+                raise ValueError("checkpoint geometry mismatch")
+            params = jax.tree.map(jnp.asarray, state["params"])
+            opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
+            start = min(int(latest), p.epochs)
+        except Exception:
+            # stale/incompatible (or crash-truncated) checkpoint →
+            # fresh start; WIPE the dir, else the fresh run's lower
+            # step numbers stay shadowed by the stale latest_step and
+            # every future resume restores the bad checkpoint again
+            ckpt.clear()
+    loss_parts = []
+    epoch = start
+    while epoch < p.epochs:
+        n = min(max(1, p.checkpoint_every), p.epochs - epoch)
+        params, opt_state, losses = compiled(n)(params, opt_state, X, Y)
+        loss_parts.append(np.asarray(losses))
+        epoch += n
+        ckpt.save(epoch, {"params": jax.tree.map(np.asarray, params),
+                          "opt_state": jax.tree.map(np.asarray, opt_state)})
+    ckpt.close()
+    # losses cover only the epochs run in THIS process (a resumed run
+    # reports the remainder)
+    return params, (np.concatenate(loss_parts) if loss_parts
+                    else np.zeros(0, np.float32))
 
 
 @functools.lru_cache(maxsize=8)

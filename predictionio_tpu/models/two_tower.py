@@ -161,10 +161,22 @@ def two_tower_train(
         ckpt = TrainCheckpointer(p.checkpoint_dir)
         latest = ckpt.latest_step()
         if latest is not None:
-            state = ckpt.restore(template={"variables": variables,
-                                           "opt_state": opt_state})
-            variables, opt_state = state["variables"], state["opt_state"]
-            start_epoch = latest
+            try:
+                template = {"variables": variables, "opt_state": opt_state}
+                state = ckpt.restore(template=template)
+                # Orbax restores differently-shaped arrays into a
+                # concrete template without raising — validate
+                if not all(np.asarray(a).shape == np.asarray(b).shape
+                           for a, b in zip(jax.tree_util.tree_leaves(state),
+                                           jax.tree_util.tree_leaves(template))):
+                    raise ValueError("checkpoint geometry mismatch")
+                variables, opt_state = state["variables"], state["opt_state"]
+                start_epoch = latest
+            except Exception:
+                # stale/incompatible checkpoint (e.g. different tower
+                # geometry) → fresh start; wipe so the stale
+                # latest_step can't shadow this run's saves
+                ckpt.clear()
 
     last_loss = None
     for epoch in range(start_epoch, p.epochs):
@@ -176,13 +188,17 @@ def two_tower_train(
 
             erng = np.random.default_rng(p.seed + epoch)
 
+            # fixed-size (G, B) step groups: one dispatch and one
+            # device_put per G steps, so the depth-2 prefetcher buffers
+            # ~2·G steps of work and chunk decode genuinely overlaps
+            # compute (per-(1, B)-step yields shrank the window to ~2
+            # sub-millisecond steps — the device stalled at every chunk
+            # boundary). Remainders carry across chunks; the tail that
+            # can't fill a group trains as (1, B) steps — exactly TWO
+            # compiled shapes regardless of chunk geometry.
+            G = max(1, 65536 // B)
+
             def host_batches():
-                # remainders carry into the next chunk so chunks
-                # smaller than the batch size still train (rather than
-                # silently yielding zero steps). Every yield is ONE
-                # (1, B) batch: a per-chunk (m, B) shape would vary with
-                # the carry and re-trace/re-compile train_epoch's scan
-                # for every distinct m.
                 carry_u = np.zeros(0, np.int32)
                 carry_i = np.zeros(0, np.int32)
                 for chunk in pair_chunks():
@@ -190,15 +206,22 @@ def two_tower_train(
                                                               np.int32)])
                     i_c = np.concatenate([carry_i, np.asarray(chunk[1],
                                                               np.int32)])
-                    m = len(u_c) // B
-                    if m == 0:
+                    g = len(u_c) // (G * B)
+                    if g == 0:
                         carry_u, carry_i = u_c, i_c
                         continue
                     cperm = erng.permutation(len(u_c))
-                    take, rest = cperm[: m * B], cperm[m * B:]
+                    take, rest = cperm[: g * G * B], cperm[g * G * B:]
                     carry_u, carry_i = u_c[rest], i_c[rest]
-                    ub = u_c[take].reshape(m, B)
-                    ib = i_c[take].reshape(m, B)
+                    ub = u_c[take].reshape(g, G, B)
+                    ib = i_c[take].reshape(g, G, B)
+                    for j in range(g):
+                        yield ub[j], ib[j]
+                m = len(carry_u) // B
+                if m:
+                    cperm = erng.permutation(len(carry_u))[: m * B]
+                    ub = carry_u[cperm].reshape(m, B)
+                    ib = carry_i[cperm].reshape(m, B)
                     for j in range(m):
                         yield ub[j:j + 1], ib[j:j + 1]
 
@@ -208,7 +231,7 @@ def two_tower_train(
                 for ue, ie in pf:
                     variables, opt_state, last_loss = train_epoch(
                         variables, opt_state, ue, ie)
-                    steps += 1
+                    steps += int(ue.shape[0])
             if steps == 0:
                 raise ValueError(
                     f"streaming train performed zero steps: {n} pairs "

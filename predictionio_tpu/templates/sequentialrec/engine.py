@@ -156,10 +156,19 @@ class SeqRecAlgorithm(Algorithm):
         # vocab ids are 1-based (0 = PAD)
         sequences = [[item_ids[i] + 1 for i in seq]
                      for seq in pd.sequences.values()]
+        # the workflow's per-run checkpoint dir enables mid-train
+        # restart-from-checkpoint (SURVEY §5), like the ALS/two-tower
+        # templates
+        ckpt_dir = None
+        if ctx.checkpoint_dir:
+            import os
+
+            ckpt_dir = os.path.join(ctx.checkpoint_dir, "seq_rec")
         hp = SeqRecParams(hidden=p.hidden, num_blocks=p.num_blocks,
                           num_heads=p.num_heads, seq_len=p.seq_len,
                           epochs=p.epochs, lr=p.lr,
-                          batch_size=p.batch_size, seed=p.seed)
+                          batch_size=p.batch_size, seed=p.seed,
+                          checkpoint_dir=ckpt_dir)
         # meshConf routes attention through ring attention over the mesh's
         # sequence axis (falls back to local if seq_len doesn't divide)
         params, losses = seq_rec_train(sequences, len(item_ids), hp,

@@ -30,6 +30,7 @@ class TrainCheckpointer:
         import orbax.checkpoint as ocp
 
         self.directory = os.path.abspath(directory)
+        self._keep = keep
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self.directory,
@@ -59,6 +60,27 @@ class TrainCheckpointer:
             return self._mgr.restore(
                 step, args=ocp.args.StandardRestore(template))
         return self._mgr.restore(step)
+
+    def clear(self) -> None:
+        """Delete every checkpoint and start the manager over.
+
+        Used when a restore fails (stale geometry from an earlier run,
+        or a save truncated by the crash being recovered from): the
+        fresh run's saves restart at low step numbers, and Orbax's
+        ``latest_step`` would keep pointing at the stale higher step —
+        every later resume would restore the bad checkpoint again and
+        silently retrain from scratch forever."""
+        import shutil
+
+        import orbax.checkpoint as ocp
+
+        self._mgr.close()
+        shutil.rmtree(self.directory, ignore_errors=True)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=self._keep),
+        )
 
     def close(self) -> None:
         self._mgr.close()

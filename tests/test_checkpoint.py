@@ -70,6 +70,94 @@ class TestTwoTowerResume:
                                        rtol=1e-5, atol=1e-6)
 
 
+class TestSeqRecResume:
+    def _seqs(self, n_users=30, n_items=20, seed=2):
+        rng = np.random.default_rng(seed)
+        return [list(rng.integers(1, n_items + 1,
+                                  rng.integers(3, 12)))
+                for _ in range(n_users)], n_items
+
+    def test_resume_matches_straight_run(self, tmp_path):
+        from predictionio_tpu.models.seq_rec import (
+            SeqRecParams,
+            seq_rec_train,
+        )
+
+        seqs, n_items = self._seqs()
+        base = dict(hidden=16, num_blocks=1, num_heads=2, seq_len=8,
+                    batch_size=16, lr=1e-3, seed=4)
+
+        straight, _ = seq_rec_train(seqs, n_items,
+                                    SeqRecParams(**base, epochs=4))
+
+        ckdir = str(tmp_path / "ck")
+        # "crash" after 2 epochs, then restart asking for 4
+        seq_rec_train(seqs, n_items, SeqRecParams(
+            **base, epochs=2, checkpoint_dir=ckdir, checkpoint_every=1))
+        resumed, losses = seq_rec_train(seqs, n_items, SeqRecParams(
+            **base, epochs=4, checkpoint_dir=ckdir, checkpoint_every=1))
+
+        assert len(losses) == 2  # only the remaining epochs ran
+        import jax
+
+        for a, b in zip(jax.tree.leaves(straight), jax.tree.leaves(resumed)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_stale_checkpoint_wiped_so_resume_recovers(self, tmp_path):
+        """A checkpoint from an incompatible geometry must not shadow
+        the fresh run's saves: after one run past the stale dir,
+        resume must work from the NEW checkpoints."""
+        from predictionio_tpu.models.seq_rec import (
+            SeqRecParams,
+            seq_rec_train,
+        )
+
+        seqs, n_items = self._seqs()
+        ckdir = str(tmp_path / "ck")
+        # stale: bigger geometry, saves steps 1..3
+        seq_rec_train(seqs, n_items, SeqRecParams(
+            hidden=32, num_blocks=1, num_heads=2, seq_len=8,
+            batch_size=16, epochs=3, seed=4, checkpoint_dir=ckdir))
+        # new geometry: restore fails → dir wiped → fresh run saves 1..2
+        base = dict(hidden=16, num_blocks=1, num_heads=2, seq_len=8,
+                    batch_size=16, lr=1e-3, seed=4)
+        seq_rec_train(seqs, n_items, SeqRecParams(
+            **base, epochs=2, checkpoint_dir=ckdir))
+        # resume must pick up the NEW step-2 checkpoint, not the stale
+        # step-3 one (which would silently retrain from scratch)
+        resumed, losses = seq_rec_train(seqs, n_items, SeqRecParams(
+            **base, epochs=4, checkpoint_dir=ckdir))
+        assert len(losses) == 2  # epochs 3..4 only
+        straight, _ = seq_rec_train(seqs, n_items,
+                                    SeqRecParams(**base, epochs=4))
+        import jax
+
+        for a, b in zip(jax.tree.leaves(straight), jax.tree.leaves(resumed)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_completed_run_restores_without_retraining(self, tmp_path):
+        from predictionio_tpu.models.seq_rec import (
+            SeqRecParams,
+            seq_rec_train,
+        )
+
+        seqs, n_items = self._seqs()
+        base = dict(hidden=16, num_blocks=1, num_heads=2, seq_len=8,
+                    batch_size=16, lr=1e-3, seed=4)
+        ckdir = str(tmp_path / "ck")
+        done, _ = seq_rec_train(seqs, n_items, SeqRecParams(
+            **base, epochs=3, checkpoint_dir=ckdir))
+        again, losses = seq_rec_train(seqs, n_items, SeqRecParams(
+            **base, epochs=3, checkpoint_dir=ckdir))
+        assert losses.size == 0  # nothing left to train
+        import jax
+
+        for a, b in zip(jax.tree.leaves(done), jax.tree.leaves(again)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 class TestALSResume:
     """Block-wise ALS checkpointing: interrupted + resumed == straight."""
 
