@@ -487,7 +487,7 @@ def als_train(
 
 
 def _make_half(k: int, reg: float, implicit: bool, alpha: float,
-               weighted_reg: bool, pvary=None):
+               weighted_reg: bool, pvary=None, platform=None):
     """Build the half-step program shared by the single-device and
     sharded (shard_map) paths: ``half(F_other, bufs, geometry)`` — one
     full re-solve of one side's factors from the other side's.
@@ -503,14 +503,21 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
 
     ``pvary`` marks created constants as varying over the mesh axis
     when tracing inside ``shard_map`` (vma typing); identity otherwise.
+    ``platform`` is the platform the trace will RUN on (mesh/device
+    platform — may differ from the default backend): it routes the
+    solve to the Pallas VMEM kernel on TPU, XLA elsewhere.
     """
+    import functools
+
     import jax
     import jax.numpy as jnp
 
     pv = pvary if pvary is not None else (lambda x: x)
     eye = jnp.eye(k, dtype=jnp.float32)
 
-    from predictionio_tpu.ops.cholesky import chol_solve_batched
+    from predictionio_tpu.ops.cholesky import chol_solve_batched as _csb
+
+    chol_solve_batched = functools.partial(_csb, platform=platform)
 
     def weights(v_s, m_s):
         if implicit:
@@ -720,7 +727,8 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
 @functools.lru_cache(maxsize=8)
 def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
                        rank: int, iterations: int, reg: float,
-                       implicit: bool, alpha: float, weighted_reg: bool):
+                       implicit: bool, alpha: float, weighted_reg: bool,
+                       platform: Optional[str] = None):
     """Build + jit the full single-device training program for one
     problem geometry (two `_make_half` programs under one iteration
     scan). Caching on geometry means `pio eval` grid candidates that
@@ -730,7 +738,7 @@ def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
 
     k = rank
     half = _make_half(k, float(reg), bool(implicit), float(alpha),
-                      bool(weighted_reg))
+                      bool(weighted_reg), platform=platform)
 
     def train(u_bufs, i_bufs, V0p):
         if iterations == 0:
@@ -787,12 +795,15 @@ def als_train_prepared(prep: ALSPrepared, p: ALSParams, device=None,
 
     u_bufs, i_bufs = prep.device_buffers(device)
 
+    platform = (device.platform if device is not None
+                else jax.default_backend())
+
     def compiled(n_iters: int):
         return _compiled_bucketed(
             prep.u_side.geometry, prep.i_side.geometry,
             prep.n_users, prep.n_items,
             p.rank, n_iters, float(p.reg), bool(p.implicit),
-            float(p.alpha), bool(p.weighted_reg))
+            float(p.alpha), bool(p.weighted_reg), platform)
 
     start = 0
     V0 = init_factors(prep.n_items, p.rank, p.seed)[prep.i_side.perm]

@@ -213,7 +213,8 @@ def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,
     k = rank
     block_u = geom_u[0]
     half = _make_half(k, reg, implicit, alpha, weighted_reg,
-                      pvary=lambda x: pvary(x, "data"))
+                      pvary=lambda x: pvary(x, "data"),
+                      platform=mesh.devices.flat[0].platform)
 
     def body(u_bufs, i_bufs, V0_l):
         # inside shard_map the stacked arrays arrive with a local

@@ -143,13 +143,176 @@ def _chol_solve(A, b):
     return x[..., :k]
 
 
-def chol_solve_batched(A, b):
+def chol_solve_batched(A, b, platform=None):
     """Solve the batched SPD systems ``A x = b``.
 
     A: (..., k, k) SPD (symmetric positive definite — ALS adds a ridge),
-    b: (..., k) → x: (..., k). Any k ≥ 1; internally padded to a power
-    of two with an identity block (which factors to itself and leaves
-    the leading k×k solve untouched).
+    b: (..., k) → x: (..., k). Any k ≥ 1.
+
+    On TPU (``platform="tpu"``, or the default backend when None) a
+    2-D batch dispatches to the Pallas VMEM-resident kernel
+    (:func:`chol_solve_pallas`); elsewhere the XLA block-recursive
+    path runs (internally padded to a power of two with an identity
+    block, which factors to itself and leaves the k×k solve untouched).
     """
-    return _chol_solve(jnp.asarray(A, jnp.float32),
-                       jnp.asarray(b, jnp.float32))
+    A = jnp.asarray(A, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    import os
+
+    from predictionio_tpu import ops
+
+    # PIO_PALLAS_SOLVE=1 opts in (correct under the Mosaic interpreter
+    # and in tests; stays off by default until the compiled kernel has
+    # been timed against the XLA recursion on real silicon)
+    if (A.ndim == 3 and A.shape[0] >= 256 and ops.use_pallas(platform)
+            and os.environ.get("PIO_PALLAS_SOLVE") == "1"):
+        return chol_solve_pallas(A, b)
+    return _chol_solve(A, b)
+
+
+# -- Pallas VMEM-resident blocked solve ---------------------------------------
+#
+# The XLA recursion above is ~50 separate HLO ops per solve; between
+# them every (batch, h, h) intermediate round-trips through HBM —
+# measured ~80 ms/iteration at ML-20M (41 chunks × 4096 systems)
+# against a ~3 ms read-the-operands-once roofline. This kernel holds a
+# batch tile entirely in VMEM and runs a blocked (LAPACK-style,
+# 8×8 blocks) Cholesky factor + forward/backward substitution with NO
+# intermediate HBM traffic.
+#
+# Layout: batch lives on the LANE dimension — work arrays are
+# (8, 8, bt) / (8, bt) with bt = 128, so every elementwise op runs on
+# full (8, 128) f32 vregs (a (bt, 8, 8) layout would use 8 of 128
+# lanes). The caller transposes A to (k, k, N) once in XLA (one
+# efficient pass) and the grid walks lane-dim tiles.
+
+_BT = 128  # batch tile = one f32 lane group
+
+
+def _t_l(a):
+    """Transpose of a lane-major block: (i, j, bt) → (j, i, bt)."""
+    return jnp.swapaxes(a, 0, 1)
+
+
+def _bmm_l(a, b):
+    """(m, m, bt) @ (m, m, bt) batched over lanes: full-width VPU."""
+    return (a[:, :, None, :] * b[None, :, :, :]).sum(axis=1)
+
+
+def _bmv_l(L, y):
+    """(m, m, bt) @ (m, bt) → (m, bt)."""
+    return (L * y[None, :, :]).sum(axis=1)
+
+
+def _leaf_inv_lanes(S):
+    """L⁻¹ of an (m, m, bt) SPD block, m ≤ 8, batch on lanes — the
+    lane-major twin of :func:`_chol_inv_leaf` (same math)."""
+    m = S.shape[0]
+    At = S
+    lane = jnp.arange(m).reshape(m, 1)
+    cols = []
+    for j in range(m):
+        d = jnp.sqrt(jnp.maximum(At[j, j], 1e-30))
+        col = jnp.where(lane >= j, At[:, j] / d, 0.0)      # (m, bt)
+        At = At - col[:, None, :] * col[None, :, :]
+        cols.append(col)
+    inv = []
+    for i in range(m):
+        s = jnp.where(lane == i, jnp.ones_like(cols[0]), 0.0)
+        for p in range(i):
+            s = s - cols[p][i] * inv[p]
+        inv.append(jnp.where(lane <= i, s / cols[i][i], 0.0))
+    return jnp.stack(inv, axis=0)                          # (m, m, bt)
+
+
+def _solve_kernel(At_ref, bt_ref, x_ref, *, k: int):
+    A = At_ref[...]            # (k, k, bt)
+    b = bt_ref[...]            # (k, bt)
+    m = k // _LEAF
+
+    def blk(i, j):
+        return A[_LEAF * i:_LEAF * (i + 1), _LEAF * j:_LEAF * (j + 1), :]
+
+    # left-looking blocked factorization; only diagonal INVERSES and
+    # off-diagonal L blocks are kept (VMEM-resident python dicts)
+    L = {}
+    Dinv = {}
+    for j in range(m):
+        S = blk(j, j)
+        for p in range(j):
+            S = S - _bmm_l(L[(j, p)], _t_l(L[(j, p)]))
+        Dinv[j] = _leaf_inv_lanes(S)
+        for i in range(j + 1, m):
+            S2 = blk(i, j)
+            for p in range(j):
+                S2 = S2 - _bmm_l(L[(i, p)], _t_l(L[(j, p)]))
+            L[(i, j)] = _bmm_l(S2, _t_l(Dinv[j]))
+
+    # forward substitution: L y = b
+    y = []
+    for i in range(m):
+        s = b[_LEAF * i:_LEAF * (i + 1), :]
+        for p in range(i):
+            s = s - _bmv_l(L[(i, p)], y[p])
+        y.append(_bmv_l(Dinv[i], s))
+    # backward substitution: Lᵀ x = y
+    x = [None] * m
+    for i in reversed(range(m)):
+        s = y[i]
+        for p in range(i + 1, m):
+            s = s - _bmv_l(_t_l(L[(p, i)]), x[p])
+        x[i] = _bmv_l(_t_l(Dinv[i]), s)
+    x_ref[...] = jnp.concatenate(x, axis=0)                # (k, bt)
+
+
+def chol_solve_pallas(A, b, interpret: bool = False):
+    """Batched SPD solve as ONE Pallas kernel: A (N, k, k), b (N, k)
+    → x (N, k). Pads k to a multiple of 8 (identity tail) and N to the
+    lane tile. ``interpret=True`` runs the Mosaic interpreter (CPU
+    tests)."""
+    import functools
+
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    N, k = b.shape
+    kp = -(-max(k, 1) // _LEAF) * _LEAF
+    if kp != k:
+        batch_pad = [(0, 0)]
+        A = jnp.pad(A, batch_pad + [(0, kp - k), (0, kp - k)])
+        tail = jnp.concatenate(
+            [jnp.zeros(k, A.dtype), jnp.ones(kp - k, A.dtype)])
+        A = A + jnp.diag(tail)
+        b = jnp.pad(b, batch_pad + [(0, kp - k)])
+    Np = -(-max(N, 1) // _BT) * _BT
+    if Np != N:
+        pad = Np - N
+        eye_tail = jnp.broadcast_to(jnp.eye(kp, dtype=A.dtype),
+                                    (pad, kp, kp))
+        A = jnp.concatenate([A, eye_tail]) if N else eye_tail
+        b = jnp.concatenate([b, jnp.zeros((pad, kp), b.dtype)]) if N \
+            else jnp.zeros((pad, kp), b.dtype)
+    At = jnp.transpose(A, (1, 2, 0))   # (k, k, Np) — one XLA pass
+    bt = jnp.transpose(b, (1, 0))      # (k, Np)
+
+    xt = pl.pallas_call(
+        functools.partial(_solve_kernel, k=kp),
+        grid=(Np // _BT,),
+        in_specs=[
+            pl.BlockSpec((kp, kp, _BT), lambda i: (0, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kp, _BT), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((kp, _BT), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((kp, Np), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=int(Np * (2 * kp**3 / 3 + 4 * kp**2)),
+            bytes_accessed=4 * (Np * kp * kp + 3 * Np * kp),
+            transcendentals=Np * kp,   # the sqrt per column
+        ),
+        interpret=interpret,
+    )(At, bt)
+    return jnp.transpose(xt, (1, 0))[:N, :k]
