@@ -1,0 +1,97 @@
+"""Tier-2 scenario: `pio eval` grid search through the real CLI, then
+the dashboard renders the recorded evaluation instance.
+
+Mirrors the reference flow (reference: [U] tests/pio_tests/ +
+Dashboard — SURVEY.md §3.4: eval → EvaluationInstances row → Dashboard
+table), with a user-style evaluation definition file living in the
+engine dir, resolved by `pio eval module:attr` exactly as upstream
+resolves Evaluation/EngineParamsGenerator classes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tests.scenarios import harness as h
+
+EVAL_DEF = textwrap.dedent('''
+    """Scenario evaluation definition (lives in the engine dir)."""
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.controller.evaluation import (
+        AverageMetric, EngineParamsGenerator, Evaluation,
+    )
+    from predictionio_tpu.templates.recommendation.engine import (
+        ALSAlgorithmParams, DataSourceParams, engine_factory,
+    )
+
+
+    class NegMAE(AverageMetric):
+        """-|predicted - actual| on the top-1 recommendation score."""
+
+        def calculate_one(self, query, predicted, actual):
+            scores = predicted.get("itemScores", [])
+            if not scores:
+                return -abs(float(actual))
+            return -abs(scores[0]["score"] - float(actual))
+
+
+    class ScenarioEval(Evaluation):
+        engine_factory = staticmethod(engine_factory)
+        metric = NegMAE()
+
+
+    def _candidate(rank):
+        return EngineParams(
+            data_source_params=DataSourceParams(
+                app_name="EvalApp", event_names=["rate"], eval_k=2),
+            algorithms_params=[("als", ALSAlgorithmParams(
+                rank=rank, num_iterations=4, lambda_=0.05, seed=3))],
+        )
+
+
+    class ScenarioGrid(EngineParamsGenerator):
+        engine_params_list = [_candidate(4), _candidate(8)]
+''')
+
+
+@pytest.mark.scenario
+def test_eval_cli_and_dashboard(tmp_path):
+    env = h.scenario_env(str(tmp_path / "pio_home"))
+    engine_dir = str(tmp_path / "engine")
+    access_key = h.new_app(env, "EvalApp")
+    h.write_engine_variant(engine_dir, "EvalApp")
+    with open(os.path.join(engine_dir, "eval_def.py"), "w") as f:
+        f.write(EVAL_DEF)
+
+    es_port = h.free_port()
+    with h.Server(["eventserver", "--ip", "127.0.0.1",
+                   "--port", str(es_port)], env, es_port) as es:
+        status, body = es.post(
+            f"/batch/events.json?accessKey={access_key}", h.rating_events())
+        assert status == 200
+
+    out_file = tmp_path / "result.json"
+    proc = h.pio(["eval", "eval_def:ScenarioEval", "eval_def:ScenarioGrid",
+                  "--engine-dir", engine_dir, "--output", str(out_file)],
+                 env, timeout=600)
+    assert "Evaluation completed" in proc.stdout
+    assert "*best*" in proc.stdout
+
+    result = json.loads(out_file.read_text())
+    assert len(result["candidates"]) == 2
+    assert result["bestIndex"] in (0, 1)
+    assert result["bestScore"] == max(
+        c["score"] for c in result["candidates"])
+
+    # the dashboard renders the recorded evaluation instance
+    db_port = h.free_port()
+    with h.Server(["dashboard", "--ip", "127.0.0.1",
+                   "--port", str(db_port)], env, db_port) as db:
+        status, html = db.request("GET", "/", None)
+        assert status == 200
+        assert "ScenarioEval" in str(html)
+        assert "NegMAE" in str(html)

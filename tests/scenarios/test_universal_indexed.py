@@ -1,0 +1,100 @@
+"""Tier-2 scenario: the Universal (CCO) template end-to-end on the
+embedded ELASTICSEARCH-type indexed storage.
+
+The reference's Universal Recommender stores everything in
+Elasticsearch and serving IS an ES similarity query (SURVEY.md §2c
+config 4); here all three repositories run on the embedded indexed
+store and the full loop — app → multi-event ingestion → train →
+deploy → user and item queries — goes through real `pio` subprocesses
+and HTTP, the ES-backend analogue of the quickstart scenario.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from tests.scenarios import harness as h
+
+
+def _es_env(pio_home: str):
+    env = h.scenario_env(pio_home)
+    for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+        env[f"PIO_STORAGE_REPOSITORIES_{repo}_NAME"] = f"pio_{repo.lower()}"
+        env[f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE"] = "ES"
+    env["PIO_STORAGE_SOURCES_ES_TYPE"] = "ELASTICSEARCH"
+    return env
+
+
+def _interaction_events():
+    """Two cliques. Serving excludes already-seen items, so each clique
+    member leaves one clique item untouched: u2 never touches b3 — its
+    top recommendation must be b3, via co-occurrence with u3/u4."""
+    events = []
+
+    def ev(name, user, item):
+        events.append({"event": name, "entityType": "user",
+                       "entityId": user, "targetEntityType": "item",
+                       "targetEntityId": item})
+
+    for user in ("u0", "u1"):
+        for item in ("a0", "a1", "a2", "a3"):
+            ev("buy", user, item)
+            ev("view", user, item)
+    for user in ("u3", "u4"):
+        for item in ("b0", "b1", "b2", "b3"):
+            ev("buy", user, item)
+            ev("view", user, item)
+    for item in ("b0", "b1", "b2"):   # u2: b-clique minus b3
+        ev("buy", "u2", item)
+        ev("view", "u2", item)
+    return events
+
+
+@pytest.mark.scenario
+def test_universal_full_loop_on_indexed_store(tmp_path):
+    env = _es_env(str(tmp_path / "pio_home"))
+    engine_dir = str(tmp_path / "engine")
+    access_key = h.new_app(env, "URApp")
+
+    # engine dir from the bundled template, pointed at the app
+    h.pio(["template", "new", "universal", engine_dir], env)
+    variant_path = os.path.join(engine_dir, "engine.json")
+    with open(variant_path) as f:
+        variant = json.load(f)
+    variant["datasource"]["params"]["appName"] = "URApp"
+    with open(variant_path, "w") as f:
+        json.dump(variant, f)
+
+    es_port = h.free_port()
+    with h.Server(["eventserver", "--ip", "127.0.0.1",
+                   "--port", str(es_port)], env, es_port) as es:
+        status, body = es.post(
+            f"/batch/events.json?accessKey={access_key}",
+            _interaction_events())
+        assert status == 200
+        assert all(item["status"] == 201 for item in body)
+
+    out = h.pio(["train", "--engine-dir", engine_dir], env).stdout
+    assert "Training completed" in out
+
+    # `pio status` verifies the ELASTICSEARCH repos end to end
+    status_out = h.pio(["status"], env).stdout
+    assert status_out.count("ELASTICSEARCH (ok)") == 3, status_out
+
+    dp_port = h.free_port()
+    with h.Server(["deploy", "--engine-dir", engine_dir, "--ip",
+                   "127.0.0.1", "--port", str(dp_port)], env, dp_port) as dp:
+        # user query: u2's only unseen clique item is b3
+        status, body = dp.post("/queries.json", {"user": "u2", "num": 3})
+        assert status == 200, body
+        items = [s["item"] for s in body["itemScores"]]
+        assert items and items[0] == "b3", body
+
+        # item-based query: similar items of a0 are the a-clique
+        status, body = dp.post("/queries.json", {"item": "a0", "num": 2})
+        assert status == 200, body
+        sim = [s["item"] for s in body["itemScores"]]
+        assert sim and all(i.startswith("a") for i in sim), body
