@@ -71,6 +71,12 @@ class ALSParams:
     alpha: float = 1.0         # implicit confidence scale
     weighted_reg: bool = True  # ALS-WR: λ·n_e scaling (MLlib behavior)
     seed: int = 0
+    # opt-in: gather factors in bfloat16 (halves the dominant HBM
+    # traffic — the gather measured ~140 GB/s effective and ~60% of
+    # device time); the Gram einsum accumulates f32. Costs ~1e-2
+    # relative factor error (measured) — fine for recommendation
+    # ranking, off by default for reference-grade numerics.
+    bf16_gather: bool = False
 
 
 
@@ -487,7 +493,8 @@ def als_train(
 
 
 def _make_half(k: int, reg: float, implicit: bool, alpha: float,
-               weighted_reg: bool, pvary=None, platform=None):
+               weighted_reg: bool, pvary=None, platform=None,
+               bf16_gather: bool = False):
     """Build the half-step program shared by the single-device and
     sharded (shard_map) paths: ``half(F_other, bufs, geometry)`` — one
     full re-solve of one side's factors from the other side's.
@@ -541,6 +548,16 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
         is ~1e-4 relative — f32 solve noise level, far inside the
         parity-test tolerances."""
         F = F_other[oi_s]                               # (slab, C, k)
+        if bf16_gather:
+            # F_other arrives pre-cast to bf16 (one pass per half
+            # step); weights round to bf16 and the MXU runs a single
+            # pass with f32 accumulation
+            wo, wb = weights(v_s, m_s)
+            H = jnp.concatenate(
+                [(wo[..., None] * F).astype(jnp.bfloat16),
+                 wb[..., None].astype(jnp.bfloat16)], axis=-1)
+            return jnp.einsum("nck,ncl->nkl", F, H,
+                              preferred_element_type=jnp.float32)
         wo, wb = weights(v_s, m_s)
         H = jnp.concatenate([wo[..., None] * F, wb[..., None]], axis=-1)
         return jnp.einsum("nck,ncl->nkl", F, H,
@@ -554,7 +571,7 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
         lam = jnp.where(cnt_s > 0, jnp.maximum(lam, 1e-8), 1.0)
         return A + lam[:, None, None] * eye
 
-    def seg_equations(F_other, buf, nb, slab, G):
+    def seg_equations(F_g, buf, nb, slab, G):
         """Heavy bucket: entities span rows; each slab aggregates its
         per-row partials into ≤ slab consecutive entities with one
         (slab, slab) × (slab, k·(k+1)) matmul (slab-local one-hot, no
@@ -565,7 +582,7 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
 
         def seg_body(Ab_e, chunk):
             oi_s, v_s, m_s, seg_s, off_s = chunk
-            Ab_r = row_grams(F_other, oi_s, v_s, m_s)   # (slab, k, k+1)
+            Ab_r = row_grams(F_g, oi_s, v_s, m_s)   # (slab, k, k+1)
             Ab_l = jnp.einsum("ne,nkm->ekm", seg_s, Ab_r,
                               precision=jax.lax.Precision.HIGH,
                               preferred_element_type=jnp.float32)
@@ -603,8 +620,8 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
                        preferred_element_type=jnp.float32)
         return ridge(A, cnt, G), b
 
-    def half_materialized(F_other, dense_buf, bufs, geometry, G, spans,
-                          chunk, n_chunks):
+    def half_materialized(F_other, F_g, dense_buf, bufs, geometry, G,
+                          spans, chunk, n_chunks):
         """Two-phase half-step: the dense head and every bucket emit
         (ridged) normal equations, concatenated into one solve buffer a
         single chunked scan then solves — ONE Cholesky instance in the
@@ -618,6 +635,7 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
             A_d, b_d = dense_equations(F_other, dense_buf, G)
             A_parts.append(A_d)
             b_parts.append(b_d)
+        F_other = F_g  # buckets below gather from the cast copy
         for (C, nb, slab, n_slabs, is_seg), buf in zip(bucket_geoms, bufs):
             if is_seg:
                 A_e, b_e = seg_equations(F_other, buf, nb, slab, G)
@@ -670,6 +688,10 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
     def half(F_other, bufs_side, geometry):
         n_self, dense_geom, bucket_geoms = geometry
         dense_buf, bufs = bufs_side
+        # bf16 gather mode: ONE cast pass per half-step; every bucket
+        # gather then moves half the bytes (dense head and the implicit
+        # Gram stay f32)
+        F_g = (F_other.astype(jnp.bfloat16) if bf16_gather else F_other)
         G = None
         if implicit:
             G = jnp.einsum("nk,nl->kl", F_other, F_other,
@@ -685,8 +707,8 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
         chunk = min(_SOLVE_CHUNK, max(256, -(-sum(spans) // 256) * 256))
         n_chunks = max(1, -(-sum(spans) // chunk))
         if n_chunks * chunk * k * k * 4 <= _SOLVE_BUF_MB << 20:
-            return half_materialized(F_other, dense_buf, bufs, geometry,
-                                     G, spans, chunk, n_chunks)
+            return half_materialized(F_other, F_g, dense_buf, bufs,
+                                     geometry, G, spans, chunk, n_chunks)
         # huge catalog: solve inside each bucket body (memory flat in
         # catalog size; compiles one Cholesky per bucket)
         outs = []
@@ -697,14 +719,14 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
             total += dense_geom[0]
         for (C, nb, slab, n_slabs, is_seg), buf in zip(bucket_geoms, bufs):
             if is_seg:
-                A_e, b_e = seg_equations(F_other, buf, nb, slab, G)
+                A_e, b_e = seg_equations(F_g, buf, nb, slab, G)
                 x = chol_solve_batched(A_e, b_e)
             else:
                 oi, vv, mm, cnt = buf
 
                 def body(_, chunk):
                     oi_s, v_s, m_s, cnt_s = chunk
-                    Ab = row_grams(F_other, oi_s, v_s, m_s)
+                    Ab = row_grams(F_g, oi_s, v_s, m_s)
                     return None, chol_solve_batched(
                         ridge(Ab[..., :k], cnt_s, G), Ab[..., k])
 
@@ -728,7 +750,8 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
 def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
                        rank: int, iterations: int, reg: float,
                        implicit: bool, alpha: float, weighted_reg: bool,
-                       platform: Optional[str] = None):
+                       platform: Optional[str] = None,
+                       bf16_gather: bool = False):
     """Build + jit the full single-device training program for one
     problem geometry (two `_make_half` programs under one iteration
     scan). Caching on geometry means `pio eval` grid candidates that
@@ -738,7 +761,8 @@ def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
 
     k = rank
     half = _make_half(k, float(reg), bool(implicit), float(alpha),
-                      bool(weighted_reg), platform=platform)
+                      bool(weighted_reg), platform=platform,
+                      bf16_gather=bf16_gather)
 
     def train(u_bufs, i_bufs, V0p):
         if iterations == 0:
@@ -803,7 +827,8 @@ def als_train_prepared(prep: ALSPrepared, p: ALSParams, device=None,
             prep.u_side.geometry, prep.i_side.geometry,
             prep.n_users, prep.n_items,
             p.rank, n_iters, float(p.reg), bool(p.implicit),
-            float(p.alpha), bool(p.weighted_reg), platform)
+            float(p.alpha), bool(p.weighted_reg), platform,
+            bool(p.bf16_gather))
 
     start = 0
     V0 = init_factors(prep.n_items, p.rank, p.seed)[prep.i_side.perm]

@@ -202,7 +202,7 @@ def als_prepare_sharded(coo: RatingsCOO, n_dev: int) -> ALSShardedPrepared:
 @functools.lru_cache(maxsize=8)
 def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,
                       reg: float, implicit: bool, alpha: float,
-                      weighted_reg: bool):
+                      weighted_reg: bool, bf16_gather: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -214,7 +214,8 @@ def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,
     block_u = geom_u[0]
     half = _make_half(k, reg, implicit, alpha, weighted_reg,
                       pvary=lambda x: pvary(x, "data"),
-                      platform=mesh.devices.flat[0].platform)
+                      platform=mesh.devices.flat[0].platform,
+                      bf16_gather=bf16_gather)
 
     def body(u_bufs, i_bufs, V0_l):
         # inside shard_map the stacked arrays arrive with a local
@@ -283,7 +284,7 @@ def als_train_sharded_prepared(
     train = _compiled_sharded(
         mesh, prep.geom_u, prep.geom_i,
         p.rank, p.iterations, float(p.reg), bool(p.implicit),
-        float(p.alpha), bool(p.weighted_reg))
+        float(p.alpha), bool(p.weighted_reg), bool(p.bf16_gather))
 
     # inputs are placed directly onto the mesh with their shard_map
     # layouts (cached per mesh) — never through the default backend

@@ -180,6 +180,30 @@ class TestBucketedLayout:
         np.testing.assert_allclose(U0, U1, rtol=1e-4, atol=1e-5)
         np.testing.assert_allclose(V0, V1, rtol=1e-4, atol=1e-5)
 
+    def test_bf16_gather_mode_close_to_f32(self):
+        """Opt-in bf16 gathers: same data, loose agreement with the
+        f32 path and equivalent reconstruction quality."""
+        rng = np.random.default_rng(13)
+        n_u, n_i, k_true = 80, 60, 4
+        Ut = rng.normal(size=(n_u, k_true))
+        Vt = rng.normal(size=(n_i, k_true))
+        mask = rng.random((n_u, n_i)) < 0.3
+        uu, ii = np.nonzero(mask)
+        coo = RatingsCOO(uu.astype(np.int32), ii.astype(np.int32),
+                         (Ut @ Vt.T)[uu, ii].astype(np.float32), n_u, n_i)
+        p32 = ALSParams(rank=6, iterations=6, reg=0.05, seed=2)
+        p16 = ALSParams(rank=6, iterations=6, reg=0.05, seed=2,
+                        bf16_gather=True)
+        U32, V32 = als_train(coo, p32)
+        U16, V16 = als_train(coo, p16)
+        r32 = predict_ratings(U32, V32, coo.user_idx, coo.item_idx)
+        r16 = predict_ratings(U16, V16, coo.user_idx, coo.item_idx)
+        rmse32 = float(np.sqrt(np.mean((r32 - coo.rating) ** 2)))
+        rmse16 = float(np.sqrt(np.mean((r16 - coo.rating) ** 2)))
+        assert rmse16 < rmse32 + 0.05, (rmse16, rmse32)
+        # factors agree to bf16-accumulation noise
+        np.testing.assert_allclose(U16, U32, rtol=0.15, atol=0.1)
+
     def test_in_body_solve_fallback_matches_materialized(self, monkeypatch):
         """The huge-catalog fallback (solve inside each bucket body,
         taken when the solve buffer would exceed PIO_ALS_SOLVE_BUF_MB)
