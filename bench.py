@@ -122,19 +122,53 @@ def _device_predict_latency(scorer, n_users: int, iters: int = 200) -> float:
     return max(t_many - t_one, 0.0) / (iters - 1) * 1e3
 
 
+def _backend_watchdog(seconds: float):
+    """The tunneled chip's PJRT init can HANG indefinitely when the
+    relay's far side is wedged (observed: a killed client left the chip
+    unclaimable for hours and even backend registration blocked). The
+    driver must get a loud failure, not a hung process: if the first
+    device op hasn't completed within ``seconds``, explain and exit 2.
+    Returns the event to set once the backend answered."""
+    import threading
+
+    done = threading.Event()
+
+    def fire():
+        if not done.wait(seconds):
+            print("bench.py: accelerator backend unreachable after "
+                  f"{seconds:.0f}s (tunnel relay wedged?) — no "
+                  "measurement possible; see the previous round's BENCH "
+                  "file for last good numbers", flush=True)
+            os._exit(2)
+
+    threading.Thread(target=fire, daemon=True).start()
+    return done
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--rank", type=int, default=64)
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--nnz", type=int, default=20_000_000)
+    ap.add_argument("--backend-timeout", type=float, default=float(
+        os.environ.get("PIO_BENCH_BACKEND_TIMEOUT", "900")))
     args = ap.parse_args()
+
+    backend_up = _backend_watchdog(args.backend_timeout)
 
     from predictionio_tpu.models.als import (ALSParams, RatingsCOO,
                                              als_prepare, als_train_prepared)
     from predictionio_tpu.utils import compilecache
 
     xla_cache = compilecache.enable()
+
+    # first device op under the watchdog: proves the backend answers
+    import jax
+    import jax.numpy as jnp
+
+    np.asarray(jnp.ones(1))
+    backend_up.set()
 
     nnz = args.nnz // 20 if args.quick else args.nnz
     n_users = 138_493 // (20 if args.quick else 1)
