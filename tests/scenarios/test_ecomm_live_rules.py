@@ -1,0 +1,108 @@
+"""Tier-2 scenario: the E-Commerce template's LIVE business rules.
+
+The reference template's signature behavior (SURVEY.md §2c): business
+constraints are read from the event store AT QUERY TIME, so operations
+can flip an item unavailable without retraining or redeploying. This
+scenario proves it through real processes: train, deploy, query — then
+POST a ``constraint`` ``$set`` event while the server is up and watch
+the item vanish from the next response.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tests.scenarios import harness as h
+
+
+def _events():
+    events = []
+
+    def ev(name, user, item):
+        events.append({"event": name, "entityType": "user",
+                       "entityId": user, "targetEntityType": "item",
+                       "targetEntityId": item})
+
+    # u0..u3 view/buy a small catalog with one runaway favorite, i0
+    for u in range(4):
+        for it in range(6):
+            ev("view", f"u{u}", f"i{it}")
+        ev("buy", f"u{u}", "i0")
+        ev("buy", f"u{u}", f"i{1 + (u % 2)}")
+    # item categories via $set
+    for it in range(6):
+        events.append({"event": "$set", "entityType": "item",
+                       "entityId": f"i{it}",
+                       "properties": {"categories":
+                                      ["phones" if it < 3 else "cases"]}})
+    return events
+
+
+@pytest.mark.scenario
+def test_live_constraint_flips_availability(tmp_path):
+    env = h.scenario_env(str(tmp_path / "pio_home"))
+    engine_dir = str(tmp_path / "engine")
+    access_key = h.new_app(env, "EcommApp")
+
+    h.pio(["template", "new", "ecommercerecommendation", engine_dir], env)
+    import json
+    import os
+
+    vp = os.path.join(engine_dir, "engine.json")
+    with open(vp) as f:
+        variant = json.load(f)
+    variant["datasource"]["params"]["appName"] = "EcommApp"
+    # keep the scenario's queries deterministic-ish and fast
+    variant["algorithms"][0]["params"]["numIterations"] = 5
+    variant["algorithms"][0]["params"]["unseenOnly"] = False
+    with open(vp, "w") as f:
+        json.dump(variant, f)
+
+    es_port = h.free_port()
+    with h.Server(["eventserver", "--ip", "127.0.0.1",
+                   "--port", str(es_port)], env, es_port) as es:
+        status, body = es.post(
+            f"/batch/events.json?accessKey={access_key}", _events())
+        assert status == 200
+        assert all(item["status"] == 201 for item in body)
+
+        h.pio(["train", "--engine-dir", engine_dir], env)
+
+        dp_port = h.free_port()
+        with h.Server(["deploy", "--engine-dir", engine_dir, "--ip",
+                       "127.0.0.1", "--port", str(dp_port)], env,
+                      dp_port) as dp:
+            status, body = dp.post("/queries.json", {"user": "u0", "num": 6})
+            assert status == 200, body
+            before = [s["item"] for s in body["itemScores"]]
+            assert "i0" in before, body
+
+            # ops flips i0 unavailable — a constraint $set through the
+            # EVENT SERVER, no retrain, no redeploy
+            status, _ = es.post(
+                f"/events.json?accessKey={access_key}",
+                {"event": "$set", "entityType": "constraint",
+                 "entityId": "unavailableItems",
+                 "properties": {"items": ["i0"]}})
+            assert status == 201
+
+            status, body = dp.post("/queries.json", {"user": "u0", "num": 6})
+            assert status == 200
+            after = [s["item"] for s in body["itemScores"]]
+            assert "i0" not in after, body
+
+            # category filter still applies on top
+            status, body = dp.post(
+                "/queries.json",
+                {"user": "u0", "num": 6, "categories": ["cases"]})
+            assert status == 200
+            assert body["itemScores"], body
+            assert all(s["item"] in ("i3", "i4", "i5")
+                       for s in body["itemScores"]), body
+
+            # cold-start user: popularity fallback, constraint honored
+            status, body = dp.post("/queries.json",
+                                   {"user": "stranger", "num": 3})
+            assert status == 200
+            cold = [s["item"] for s in body["itemScores"]]
+            assert cold and "i0" not in cold, body

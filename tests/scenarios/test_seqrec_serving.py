@@ -1,0 +1,86 @@
+"""Tier-2 scenario: the sequential-recommendation template end to end —
+train on ordered interaction events, serve next-item queries from LIVE
+user history and from anonymous session history."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from tests.scenarios import harness as h
+
+
+def _sequential_events():
+    """Deterministic loops: users cycle i0→i1→i2→i3→i0…, so after
+    seeing iK the next item is i(K+1 mod 4). eventTime orders the
+    sequence explicitly."""
+    events = []
+    t0 = 1735689600  # 2025-01-01T00:00:00Z epoch
+    for u in range(6):
+        for step in range(12):
+            item = f"i{(u + step) % 4}"
+            ts = time.strftime("%Y-%m-%dT%H:%M:%S.000Z",
+                               time.gmtime(t0 + u * 1000 + step))
+            events.append({"event": "view", "entityType": "user",
+                           "entityId": f"u{u}", "targetEntityType": "item",
+                           "targetEntityId": item, "eventTime": ts})
+    return events
+
+
+@pytest.mark.scenario
+def test_seqrec_full_loop(tmp_path):
+    env = h.scenario_env(str(tmp_path / "pio_home"))
+    engine_dir = str(tmp_path / "engine")
+    access_key = h.new_app(env, "SeqApp")
+
+    h.pio(["template", "new", "sequentialrec", engine_dir], env)
+    vp = os.path.join(engine_dir, "engine.json")
+    with open(vp) as f:
+        variant = json.load(f)
+    variant["datasource"]["params"]["appName"] = "SeqApp"
+    variant["algorithms"][0]["params"].update(
+        {"hidden": 16, "numBlocks": 1, "numHeads": 2, "seqLen": 8,
+         "epochs": 60, "lr": 0.01})
+    with open(vp, "w") as f:
+        json.dump(variant, f)
+
+    es_port = h.free_port()
+    with h.Server(["eventserver", "--ip", "127.0.0.1",
+                   "--port", str(es_port)], env, es_port) as es:
+        events = _sequential_events()
+        for i in range(0, len(events), 50):  # batch API caps at 50
+            status, body = es.post(
+                f"/batch/events.json?accessKey={access_key}",
+                events[i:i + 50])
+            assert status == 200
+            assert all(item["status"] == 201 for item in body)
+
+    out = h.pio(["train", "--engine-dir", engine_dir], env,
+                timeout=600).stdout
+    assert "Training completed" in out
+
+    dp_port = h.free_port()
+    with h.Server(["deploy", "--engine-dir", engine_dir, "--ip",
+                   "127.0.0.1", "--port", str(dp_port)], env, dp_port) as dp:
+        # anonymous session: after ...i1, i2 the next item is i3
+        status, body = dp.post(
+            "/queries.json", {"history": ["i0", "i1", "i2"], "num": 2})
+        assert status == 200, body
+        items = [s["item"] for s in body["itemScores"]]
+        assert items and items[0] == "i3", body
+
+        # known user: u0's recorded history ends ...i2, i3 → next is i0
+        status, body = dp.post("/queries.json", {"user": "u0", "num": 2})
+        assert status == 200, body
+        items = [s["item"] for s in body["itemScores"]]
+        assert items and items[0] == "i0", body
+
+        # blackList removes the would-be top item
+        status, body = dp.post(
+            "/queries.json",
+            {"history": ["i0", "i1", "i2"], "num": 2, "blackList": ["i3"]})
+        assert status == 200
+        assert all(s["item"] != "i3" for s in body["itemScores"]), body
