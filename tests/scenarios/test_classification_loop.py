@@ -1,0 +1,73 @@
+"""Tier-2 scenario: the classification template — $set property
+ingestion through the event server, train, and label queries."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from tests.scenarios import harness as h
+
+
+def _property_events():
+    """Count-style attrs the multinomial NB (MLlib parity) separates by
+    COMPOSITION: label 0 users are attr0-heavy, label 1 attr1-heavy —
+    the reference quickstart's integer-attribute shape."""
+    import numpy as np
+
+    rng = np.random.default_rng(4)
+    events = []
+    for i in range(60):
+        label = i % 2
+        heavy, light = (8, 1) if label == 0 else (1, 8)
+        events.append({
+            "event": "$set", "entityType": "user", "entityId": f"u{i}",
+            "properties": {
+                "attr0": int(heavy + rng.integers(0, 3)),
+                "attr1": int(light + rng.integers(0, 3)),
+                "attr2": int(rng.integers(1, 3)),
+                "label": label}})
+    return events
+
+
+@pytest.mark.scenario
+def test_classification_full_loop(tmp_path):
+    env = h.scenario_env(str(tmp_path / "pio_home"))
+    engine_dir = str(tmp_path / "engine")
+    access_key = h.new_app(env, "ClsApp")
+
+    h.pio(["template", "new", "classification", engine_dir], env)
+    vp = os.path.join(engine_dir, "engine.json")
+    with open(vp) as f:
+        variant = json.load(f)
+    variant["datasource"]["params"]["appName"] = "ClsApp"
+    with open(vp, "w") as f:
+        json.dump(variant, f)
+
+    es_port = h.free_port()
+    with h.Server(["eventserver", "--ip", "127.0.0.1",
+                   "--port", str(es_port)], env, es_port) as es:
+        events = _property_events()
+        for i in range(0, len(events), 50):
+            status, body = es.post(
+                f"/batch/events.json?accessKey={access_key}",
+                events[i:i + 50])
+            assert status == 200
+            assert all(item["status"] == 201 for item in body)
+
+    h.pio(["train", "--engine-dir", engine_dir], env)
+
+    dp_port = h.free_port()
+    with h.Server(["deploy", "--engine-dir", engine_dir, "--ip",
+                   "127.0.0.1", "--port", str(dp_port)], env, dp_port) as dp:
+        status, body = dp.post(
+            "/queries.json", {"attr0": 9, "attr1": 1, "attr2": 2})
+        assert status == 200, body
+        assert float(body["label"]) == 0.0, body
+
+        status, body = dp.post(
+            "/queries.json", {"attr0": 1, "attr1": 9, "attr2": 2})
+        assert status == 200
+        assert float(body["label"]) == 1.0, body
