@@ -1,0 +1,71 @@
+"""Tier-2 scenario: the two-tower retrieval template end to end on the
+CPU mesh — contrastive training from interaction events, top-K
+retrieval serving."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from tests.scenarios import harness as h
+
+
+def _clique_events():
+    """Two disjoint taste cliques (as in the quickstart scenario):
+    even users interact with even items, odd with odd."""
+    events = []
+    for u in range(8):
+        for it in range(12):
+            if u % 2 == it % 2:
+                events.append({"event": "view", "entityType": "user",
+                               "entityId": f"u{u}",
+                               "targetEntityType": "item",
+                               "targetEntityId": f"i{it}"})
+    return events
+
+
+@pytest.mark.scenario
+def test_twotower_full_loop(tmp_path):
+    env = h.scenario_env(str(tmp_path / "pio_home"))
+    engine_dir = str(tmp_path / "engine")
+    access_key = h.new_app(env, "TTApp")
+
+    h.pio(["template", "new", "twotower", engine_dir], env)
+    vp = os.path.join(engine_dir, "engine.json")
+    with open(vp) as f:
+        variant = json.load(f)
+    variant["datasource"]["params"]["appName"] = "TTApp"
+    variant["algorithms"][0]["params"].update(
+        {"embedDim": 8, "outDim": 8, "hidden": [16], "batchSize": 16,
+         "epochs": 60, "learningRate": 0.05})
+    with open(vp, "w") as f:
+        json.dump(variant, f)
+
+    es_port = h.free_port()
+    with h.Server(["eventserver", "--ip", "127.0.0.1",
+                   "--port", str(es_port)], env, es_port) as es:
+        status, body = es.post(
+            f"/batch/events.json?accessKey={access_key}", _clique_events())
+        assert status == 200
+        assert all(item["status"] == 201 for item in body)
+
+    out = h.pio(["train", "--engine-dir", engine_dir], env,
+                timeout=600).stdout
+    assert "Training completed" in out
+
+    dp_port = h.free_port()
+    with h.Server(["deploy", "--engine-dir", engine_dir, "--ip",
+                   "127.0.0.1", "--port", str(dp_port)], env, dp_port) as dp:
+        status, body = dp.post("/queries.json", {"user": "u0", "num": 4})
+        assert status == 200, body
+        items = [s["item"] for s in body["itemScores"]]
+        assert len(items) == 4
+        # the learned embedding space separates the cliques
+        assert all(int(i[1:]) % 2 == 0 for i in items), body
+
+        status, body = dp.post("/queries.json", {"user": "u1", "num": 4})
+        assert status == 200
+        assert all(int(s["item"][1:]) % 2 == 1
+                   for s in body["itemScores"]), body
