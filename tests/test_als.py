@@ -219,6 +219,10 @@ class TestBucketedLayout:
         rr = rng.uniform(1, 5, len(uu)).astype(np.float32)
         coo = RatingsCOO(uu, ii, rr, n_u, n_i)
         p = ALSParams(rank=4, iterations=3, reg=0.1, seed=2)
+        # include a dense head so the fallback's dense branch is covered
+        monkeypatch.setattr(als_mod, "_DENSE_MIN_COUNT", 8)
+        prep = als_mod.als_prepare(coo)
+        assert prep.u_side.dense is not None and prep.u_side.dense.nb > 0
         U_m, V_m = als_mod.als_train(coo, p)
         monkeypatch.setattr(als_mod, "_SOLVE_BUF_MB", 0)
         als_mod._compiled_bucketed.cache_clear()
