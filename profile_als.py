@@ -1,18 +1,47 @@
-"""Profile the ALS training program on the real chip (VERDICT r2 ask #4).
+"""Profile / A-B the ALS training program on the real chip.
 
-Runs the ML-20M-shaped synthetic train (same protocol as bench.py),
-captures a JAX profiler trace of the warm run, and prints phase timings.
-Artifact: docs/perf/ trace + summary (committed for the judge).
+Two modes:
+
+- default: run the ML-20M-shape train (bench.py protocol), print phase
+  timings, and capture a JAX profiler trace of a short warm run —
+  the artifact behind docs/perf/als_trace_analysis.md.
+- ``--ab``: run the optimization matrix and print one line per
+  configuration — the decision data for flipping defaults:
+    * baseline (materialized solve pass, XLA recursion, f32 gathers)
+    * PIO_PALLAS_SOLVE=1 (VMEM-resident Pallas solve kernel)
+    * in-body solves (no solve-buffer materialization)
+    * bf16 gathers
 """
 
 import argparse
 import glob
-import gzip
-import json
 import os
 import time
 
 import numpy as np
+
+
+def _measure(prep, params, label):
+    from predictionio_tpu.models import als
+    from bench import V5E_PEAK_BF16, _train_flops
+
+    als._compiled_bucketed.cache_clear()
+    t0 = time.perf_counter()
+    U, V = als.als_train_prepared(prep, params)
+    t_cold = time.perf_counter() - t0
+    warms = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        U, V = als.als_train_prepared(prep, params)
+        warms.append(time.perf_counter() - t0)
+    t_warm = min(warms)
+    assert np.isfinite(U).all() and np.isfinite(V).all()
+    flops = _train_flops(prep, params.rank, params.iterations)
+    thr = prep.nnz * params.iterations / t_warm / 1e6
+    print(f"{label:34} cold={t_cold:7.1f}s warm={t_warm:6.2f}s "
+          f"thr={thr:7.1f}M/s mfu_wall={flops / t_warm / V5E_PEAK_BF16:.4f}",
+          flush=True)
+    return t_warm
 
 
 def main():
@@ -20,13 +49,14 @@ def main():
     ap.add_argument("--nnz", type=int, default=20_000_000)
     ap.add_argument("--rank", type=int, default=64)
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--ab", action="store_true",
+                    help="run the optimization A/B matrix")
     ap.add_argument("--trace-dir", default="/tmp/als_trace")
-    ap.add_argument("--trace-iters", type=int, default=2,
-                    help="iterations in the traced run (trace size)")
+    ap.add_argument("--trace-iters", type=int, default=2)
     args = ap.parse_args()
 
-    from bench import synthetic_ml20m, _train_flops, _train_bytes, \
-        V5E_PEAK_BF16
+    from bench import synthetic_ml20m
+    from predictionio_tpu.models import als
     from predictionio_tpu.models.als import (ALSParams, RatingsCOO,
                                              als_prepare,
                                              als_train_prepared)
@@ -39,27 +69,39 @@ def main():
     t0 = time.perf_counter()
     prep = als_prepare(coo)
     print(f"prepare_sec={time.perf_counter() - t0:.3f}", flush=True)
+    for side, nm in ((prep.u_side, "u"), (prep.i_side, "i")):
+        print(f"  {nm}: dense nb={side.dense.nb if side.dense else 0} "
+              f"buckets={[(b.C, b.nb) for b in side.buckets]}", flush=True)
 
     params = ALSParams(rank=args.rank, iterations=args.iters, reg=0.05,
                        seed=1)
-    t0 = time.perf_counter()
-    U, V = als_train_prepared(prep, params)
-    t_total = time.perf_counter() - t0
-    print(f"train_sec_incl_compile={t_total:.3f}", flush=True)
+
+    if args.ab:
+        _measure(prep, params, "baseline (materialized, XLA solve)")
+        os.environ["PIO_PALLAS_SOLVE"] = "1"
+        _measure(prep, params, "pallas VMEM solve")
+        del os.environ["PIO_PALLAS_SOLVE"]
+        saved = als._SOLVE_BUF_MB
+        als._SOLVE_BUF_MB = 0
+        _measure(prep, params, "in-body solves (no solve buffer)")
+        os.environ["PIO_PALLAS_SOLVE"] = "1"
+        _measure(prep, params, "in-body + pallas solve")
+        del os.environ["PIO_PALLAS_SOLVE"]
+        als._SOLVE_BUF_MB = saved
+        p16 = ALSParams(rank=args.rank, iterations=args.iters, reg=0.05,
+                        seed=1, bf16_gather=True)
+        _measure(prep, p16, "bf16 gathers")
+        os.environ["PIO_PALLAS_SOLVE"] = "1"
+        _measure(prep, p16, "bf16 gathers + pallas solve")
+        del os.environ["PIO_PALLAS_SOLVE"]
+        return
 
     t0 = time.perf_counter()
     U, V = als_train_prepared(prep, params)
-    t_warm = time.perf_counter() - t0
-    flops = _train_flops(prep, args.rank, args.iters)
-    print(f"train_sec_warm={t_warm:.3f}", flush=True)
-    print(f"throughput={coo.nnz * args.iters / t_warm / 1e6:.1f}M "
-          f"rating-updates/s", flush=True)
-    print(f"mfu={flops / t_warm / V5E_PEAK_BF16:.4f}", flush=True)
-    print(f"hbm_gbps={_train_bytes(prep, args.rank, args.iters) / t_warm / 1e9:.1f}",
+    print(f"train_sec_incl_compile={time.perf_counter() - t0:.3f}",
           flush=True)
-    assert np.isfinite(U).all() and np.isfinite(V).all()
+    _measure(prep, params, "warm")
 
-    # traced run: fewer iterations to keep the trace readable
     import jax
 
     tparams = ALSParams(rank=args.rank, iterations=args.trace_iters,
