@@ -196,6 +196,55 @@ class LogisticRegressionAlgorithm(Algorithm):
         return {"label": float(label)}
 
 
+@dataclass
+class RFAlgoParams:
+    """MLlib RandomForest knob names where they map (numTrees,
+    maxDepth); thresholds/featureFrac drive the oblivious-tree
+    discretization (models/forest.py)."""
+
+    num_trees: int = 16
+    max_depth: int = 5
+    n_thresholds: int = 16
+    feature_frac: float = 0.7
+    seed: int = 0
+
+
+class RandomForestAlgorithm(Algorithm):
+    """The reference template's RandomForest variant (SURVEY.md §2c
+    config 2), as TPU-vectorized oblivious trees — handles the
+    non-linear boundaries NB and logistic regression cannot."""
+
+    ParamsClass = RFAlgoParams
+
+    def sanity_check(self, data: LabeledData) -> None:
+        if len(data.y) == 0:
+            raise ValueError("empty training data")
+
+    def train(self, ctx: WorkflowContext, pd: LabeledData) -> ClassificationModel:
+        from predictionio_tpu.models.forest import ForestParams, forest_train
+
+        p: RFAlgoParams = self.params
+        m = forest_train(pd.X, pd.y, ForestParams(
+            n_trees=p.num_trees, max_depth=p.max_depth,
+            n_thresholds=p.n_thresholds, feature_frac=p.feature_frac,
+            seed=p.seed), mesh=ctx.mesh)
+        return ClassificationModel(
+            "rf", pd.attrs, feats=m.feats, thrs=m.thrs,
+            leaf_probs=m.leaf_probs,
+            n_classes=np.asarray([m.n_classes]))
+
+    def predict(self, model: ClassificationModel, query: Dict[str, Any]) -> Dict[str, Any]:
+        from predictionio_tpu.models.forest import (ForestModel,
+                                                    forest_predict_proba)
+
+        fm = ForestModel(model.arrays["feats"], model.arrays["thrs"],
+                         model.arrays["leaf_probs"],
+                         int(model.arrays["n_classes"][0]))
+        probs = forest_predict_proba(fm, model.features(query))[0]
+        return {"label": float(np.argmax(probs)),
+                "probs": {str(c): float(p) for c, p in enumerate(probs)}}
+
+
 def engine_factory() -> Engine:
     return Engine(
         data_source_cls=ClassificationDataSource,
@@ -203,6 +252,7 @@ def engine_factory() -> Engine:
         algorithm_cls_map={
             "naive": NaiveBayesAlgorithm,
             "lr": LogisticRegressionAlgorithm,
+            "forest": RandomForestAlgorithm,
         },
         serving_cls=FirstServing,
     )

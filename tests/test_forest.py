@@ -1,0 +1,88 @@
+"""Random forest (oblivious trees) — the classification template's
+MLlib-RandomForest variant (SURVEY.md §2c config 2)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models.forest import (
+    ForestParams,
+    forest_predict,
+    forest_predict_proba,
+    forest_train,
+)
+
+
+@pytest.fixture(scope="module")
+def xor_data():
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-1, 1, (1200, 4)).astype(np.float32)
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(np.int64)
+    return X, y
+
+
+class TestForest:
+    def test_learns_xor(self, xor_data):
+        """The boundary NB/logreg cannot represent."""
+        X, y = xor_data
+        m = forest_train(X[:900], y[:900],
+                         ForestParams(n_trees=16, max_depth=4, seed=1))
+        acc = (forest_predict(m, X[900:]) == y[900:]).mean()
+        assert acc > 0.85, acc
+
+    def test_multiclass_and_probs(self, xor_data):
+        X, _ = xor_data
+        y3 = (X[:, 2] > 0.3).astype(np.int64) + \
+            (X[:, 2] > -0.3).astype(np.int64)
+        m = forest_train(X[:900], y3[:900],
+                         ForestParams(n_trees=8, max_depth=3, seed=2))
+        acc = (forest_predict(m, X[900:]) == y3[900:]).mean()
+        assert acc > 0.9, acc
+        probs = forest_predict_proba(m, X[900:905])
+        assert probs.shape == (5, 3)
+        np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-5)
+
+    def test_deterministic_per_seed(self, xor_data):
+        X, y = xor_data
+        p = ForestParams(n_trees=4, max_depth=3, seed=5)
+        m1 = forest_train(X[:300], y[:300], p)
+        m2 = forest_train(X[:300], y[:300], p)
+        np.testing.assert_array_equal(m1.feats, m2.feats)
+        np.testing.assert_array_equal(m1.leaf_probs, m2.leaf_probs)
+
+    def test_single_class_degenerate(self):
+        X = np.random.default_rng(1).uniform(0, 1, (50, 3)).astype(np.float32)
+        y = np.zeros(50, np.int64)
+        m = forest_train(X, y, ForestParams(n_trees=2, max_depth=2))
+        assert (forest_predict(m, X) == 0).all()
+
+
+class TestTemplateVariant:
+    def test_forest_algorithm_roundtrip(self):
+        """Train through the template Algorithm + predict after the
+        default pickle persistence round trip."""
+        import pickle
+
+        from predictionio_tpu.controller.base import WorkflowContext
+        from predictionio_tpu.templates.classification.engine import (
+            LabeledData,
+            RandomForestAlgorithm,
+            RFAlgoParams,
+        )
+
+        rng = np.random.default_rng(3)
+        X = rng.uniform(-1, 1, (600, 3)).astype(np.float32)
+        y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(np.int32)
+        algo = RandomForestAlgorithm(RFAlgoParams(num_trees=12, max_depth=4,
+                                                  seed=4))
+        model = algo.train(WorkflowContext(), LabeledData(
+            X, y, ["attr0", "attr1", "attr2"]))
+        model = pickle.loads(pickle.dumps(model))
+        hits = 0
+        probes = [(0.5, 0.5, 0.1, 0), (-0.5, 0.5, 0.1, 1),
+                  (0.5, -0.5, 0.1, 1), (-0.5, -0.5, 0.1, 0)]
+        for a0, a1, a2, want in probes:
+            out = algo.predict(model, {"attr0": a0, "attr1": a1,
+                                       "attr2": a2})
+            assert set(out) == {"label", "probs"}
+            hits += out["label"] == want
+        assert hits >= 3, probes
