@@ -161,13 +161,37 @@ def chol_solve_batched(A, b, platform=None):
 
     from predictionio_tpu import ops
 
-    # PIO_PALLAS_SOLVE=1 opts in (correct under the Mosaic interpreter
-    # and in tests; stays off by default until the compiled kernel has
-    # been timed against the XLA recursion on real silicon)
-    if (A.ndim == 3 and A.shape[0] >= 256 and ops.use_pallas(platform)
-            and os.environ.get("PIO_PALLAS_SOLVE") == "1"):
-        return chol_solve_pallas(A, b)
+    # PIO_PALLAS_SOLVE: "0" forces the XLA recursion, "1" forces the
+    # kernel; unset → use the kernel on TPU if the one-time preflight
+    # (compile + solve a tiny identity batch on the real device)
+    # succeeds — a Mosaic regression then degrades to the XLA path
+    # instead of failing the training program.
+    flag = os.environ.get("PIO_PALLAS_SOLVE", "")
+    if A.ndim == 3 and A.shape[0] >= 256 and ops.use_pallas(platform):
+        if flag == "1" or (flag != "0" and _pallas_solve_preflight()):
+            return chol_solve_pallas(A, b)
     return _chol_solve(A, b)
+
+
+_PALLAS_PREFLIGHT: dict = {}
+
+
+def _pallas_solve_preflight() -> bool:
+    """Compile + run the kernel once on a tiny batch (cached)."""
+    if "ok" not in _PALLAS_PREFLIGHT:
+        try:
+            import numpy as _np
+
+            A = _np.broadcast_to(_np.eye(8, dtype=_np.float32),
+                                 (256, 8, 8)).copy()
+            b = _np.ones((256, 8), _np.float32)
+            x = _np.asarray(chol_solve_pallas(jnp.asarray(A),
+                                              jnp.asarray(b)))
+            _PALLAS_PREFLIGHT["ok"] = bool(
+                _np.allclose(x, b, rtol=1e-5, atol=1e-6))
+        except Exception:
+            _PALLAS_PREFLIGHT["ok"] = False
+    return _PALLAS_PREFLIGHT["ok"]
 
 
 # -- Pallas VMEM-resident blocked solve ---------------------------------------
