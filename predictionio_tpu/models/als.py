@@ -15,6 +15,13 @@ solves — SURVEY.md §2d P2). The TPU-first redesign:
   systolic-array work with **no scatter anywhere** (TPU scatter-add of
   row partials measured ~40% of the iteration in the round-1
   padded-row design).
+- The power-law HEAD goes denser still: entities with count ≥
+  n_other/14 (see ``_DENSE_RATIO``) skip gathering entirely — their
+  normal equations are plain GEMMs of dense per-entity weight rows
+  against the other side's factor outer products (the ~280 heaviest
+  ML-20M entities hold ~65% of padded slots, and their gathers
+  measured ~70% of the Gram phase at the ~140 GB/s XLA row-gather
+  ceiling).
 - Buckets stream through ``lax.scan`` in fixed-size slabs, emitting
   ridged normal equations into ONE solve buffer; a single chunked scan
   solves everything with one instance of the **block-recursive batched
