@@ -30,8 +30,12 @@ import numpy as np
 
 from predictionio_tpu.controller import (
     Algorithm,
+    AverageMetric,
     DataSource,
     Engine,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
     FirstServing,
     IdentityPreparator,
     WorkflowContext,
@@ -78,6 +82,25 @@ class SeqDataSource(DataSource):
         seqs = {u: [i for _, i in sorted(evs, key=lambda t: t[0])]
                 for u, evs in per_user.items()}
         return TrainingData(p.app_name, seqs)
+
+    def read_eval(self, ctx: WorkflowContext):
+        """Leave-one-out next-item evaluation (the standard SASRec
+        protocol): each user's LAST item is held out; the query replays
+        the remaining history through the anonymous-session path, so
+        eval needs no serving-time storage."""
+        td = self.read_training(ctx)
+        train_seqs: Dict[str, List[str]] = {}
+        qa = []
+        for u, seq in td.sequences.items():
+            if len(seq) >= 3:
+                train_seqs[u] = seq[:-1]
+                qa.append(({"history": seq[:-1], "num": 10}, seq[-1]))
+            else:
+                train_seqs[u] = seq
+        if not qa:
+            raise ValueError(
+                "no user has a sequence of length ≥ 3 to hold out")
+        return [(TrainingData(td.app_name, train_seqs), {"fold": 0}, qa)]
 
 
 @dataclass
@@ -214,3 +237,55 @@ def engine_factory() -> Engine:
         algorithm_cls_map={"seqrec": SeqRecAlgorithm},
         serving_cls=FirstServing,
     )
+
+
+# -- evaluation (pio eval out of the box) -------------------------------------
+
+
+class HitRate(AverageMetric):
+    """1 if the held-out item appears in the top-k, else 0 — averaged
+    over users (hit rate @ k, the SASRec leave-one-out metric)."""
+
+    def __init__(self, k: int = 10) -> None:
+        self.k = k
+
+    def calculate_one(self, query, predicted, actual) -> float:
+        items = [s["item"] for s in predicted.get("itemScores", [])][: self.k]
+        return 1.0 if actual in items else 0.0
+
+    @property
+    def header(self) -> str:
+        return f"HitRate@{self.k}"
+
+
+class SeqRecEvaluation(Evaluation):
+    engine_factory = staticmethod(engine_factory)
+    metric = HitRate(10)
+    other_metrics = (HitRate(1),)
+
+
+def _candidate(app_name: str, hidden: int) -> EngineParams:
+    return EngineParams(
+        data_source_params=DataSourceParams(app_name=app_name),
+        algorithms_params=[("seqrec", SeqRecAlgorithmParams(
+            hidden=hidden, num_blocks=1, num_heads=2, seq_len=32,
+            epochs=30))],
+    )
+
+
+class DefaultGrid(EngineParamsGenerator):
+    """Two hidden-size candidates. App name from $PIO_EVAL_APP_NAME
+    (edit or subclass for real use — the reference's generators
+    hardcode the app name the same way):
+
+        PIO_EVAL_APP_NAME=MyApp pio eval \\
+          predictionio_tpu.templates.sequentialrec.engine:SeqRecEvaluation \\
+          predictionio_tpu.templates.sequentialrec.engine:DefaultGrid
+    """
+
+    @property
+    def engine_params_list(self):
+        import os
+
+        app = os.environ.get("PIO_EVAL_APP_NAME", "MyApp1")
+        return [_candidate(app, 32), _candidate(app, 64)]

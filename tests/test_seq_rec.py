@@ -147,3 +147,31 @@ class TestTemplate:
         res = deployed.query({"history": ["i0", "i1", "i2"], "num": 1,
                               "blackList": ["i3"]})
         assert res["itemScores"][0]["item"] != "i3"
+
+    def test_leave_one_out_evaluation(self, storage, seq_app):
+        """read_eval + HitRate through the MetricEvaluator: the cyclic
+        data is perfectly predictable, so hit rate @ 10 over an 8-item
+        catalog must be high."""
+        from predictionio_tpu.controller.base import WorkflowContext
+        from predictionio_tpu.controller.evaluation import MetricEvaluator
+        from predictionio_tpu.templates.sequentialrec.engine import (
+            DataSourceParams,
+            HitRate,
+            SeqRecAlgorithmParams,
+            SeqRecEvaluation,
+            engine_factory,
+        )
+        from predictionio_tpu.controller.engine import EngineParams
+
+        ctx = WorkflowContext(storage=storage)
+        candidates = [EngineParams(
+            data_source_params=DataSourceParams(app_name="SeqApp"),
+            algorithms_params=[("seqrec", SeqRecAlgorithmParams(
+                hidden=h, num_blocks=1, num_heads=2, seq_len=16,
+                epochs=30, lr=0.003))]) for h in (16, 32)]
+        ev = SeqRecEvaluation()
+        res = MetricEvaluator(ev.metric, ev.other_metrics).evaluate(
+            ctx, engine_factory(), candidates)
+        assert len(res.candidates) == 2
+        assert res.best_score > 0.6, res.best_score
+        assert ev.metric.header == "HitRate@10"
