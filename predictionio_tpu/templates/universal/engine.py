@@ -25,8 +25,12 @@ import numpy as np
 
 from predictionio_tpu.controller import (
     Algorithm,
+    AverageMetric,
     DataSource,
     Engine,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
     FirstServing,
     IdentityPreparator,
     WorkflowContext,
@@ -67,6 +71,32 @@ class URDataSource(DataSource):
             raise ValueError(
                 f"no primary event {p.event_names[0]!r} found; import events first")
         return TrainingData(p.app_name, per)
+
+    def read_eval(self, ctx: WorkflowContext):
+        """Leave-one-out over the PRIMARY event (the Universal
+        Recommender's standard offline protocol): each user's last
+        conversion is held out; the trained model's stored user
+        history then reflects only the remaining events, so the plain
+        ``{"user": u}`` query evaluates honestly."""
+        td = self.read_training(ctx)
+        primary = self.params.event_names[0]
+        pairs = td.events[primary]          # event-time order
+        last: Dict[str, int] = {}
+        count: Dict[str, int] = {}
+        for idx, (u, _) in enumerate(pairs):
+            last[u] = idx
+            count[u] = count.get(u, 0) + 1
+        held = {idx: u for u, idx in last.items() if count[u] >= 2}
+        train_pairs = [pr for idx, pr in enumerate(pairs)
+                       if idx not in held]
+        qa = [({"user": pairs[idx][0], "num": 10}, pairs[idx][1])
+              for idx in sorted(held)]
+        if not qa:
+            raise ValueError(
+                "no user has ≥ 2 primary events to hold one out")
+        events = dict(td.events)
+        events[primary] = train_pairs
+        return [(TrainingData(td.app_name, events), {"fold": 0}, qa)]
 
 
 @dataclass
@@ -191,3 +221,43 @@ def engine_factory() -> Engine:
         algorithm_cls_map={"ur": URAlgorithm},
         serving_cls=FirstServing,
     )
+
+
+# -- evaluation (pio eval out of the box; the UR ecosystem's MAP@k) -----------
+
+
+class MAPatK(AverageMetric):
+    """Mean average precision @ k with ONE held-out relevant item:
+    1/rank if it appears in the top-k, else 0 — the UR's standard
+    offline metric under leave-one-out."""
+
+    def __init__(self, k: int = 10) -> None:
+        self.k = k
+
+    def calculate_one(self, query, predicted, actual) -> float:
+        items = [s["item"] for s in predicted.get("itemScores", [])][: self.k]
+        return 1.0 / (items.index(actual) + 1) if actual in items else 0.0
+
+    @property
+    def header(self) -> str:
+        return f"MAP@{self.k}"
+
+
+class UREvaluation(Evaluation):
+    engine_factory = staticmethod(engine_factory)
+    metric = MAPatK(10)
+    other_metrics = (MAPatK(1),)
+
+
+class DefaultGrid(EngineParamsGenerator):
+    """LLR-threshold candidates; app name via $PIO_EVAL_APP_NAME."""
+
+    @property
+    def engine_params_list(self):
+        import os
+
+        app = os.environ.get("PIO_EVAL_APP_NAME", "MyApp1")
+        return [EngineParams(
+            data_source_params=DataSourceParams(app_name=app),
+            algorithms_params=[("ur", URAlgorithmParams(
+                llr_threshold=t))]) for t in (0.0, 2.0)]

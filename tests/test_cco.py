@@ -167,3 +167,31 @@ class TestUniversalTemplate:
         # cold start returns popular items, not nothing
         res_cold = deployed.query({"user": "nobody", "num": 3})
         assert len(res_cold["itemScores"]) == 3
+
+    def test_leave_one_out_evaluation(self, storage):
+        """read_eval + MAP@k through the MetricEvaluator: held-out
+        conversions come from the user's own clique, so scores must
+        beat random (expected MAP@10 of random over 10 items ≈ 0.29)."""
+        from predictionio_tpu.controller.base import WorkflowContext
+        from predictionio_tpu.controller.evaluation import MetricEvaluator
+        from predictionio_tpu.templates.universal.engine import (
+            DataSourceParams,
+            UREvaluation,
+            URAlgorithmParams,
+            engine_factory,
+        )
+        from predictionio_tpu.controller.engine import EngineParams
+
+        seed_ur(storage)
+        ctx = WorkflowContext(storage=storage)
+        candidates = [EngineParams(
+            data_source_params=DataSourceParams(app_name="URApp"),
+            algorithms_params=[("ur", URAlgorithmParams(
+                max_indicators_per_item=5, llr_threshold=t))])
+            for t in (0.0, 1.0)]
+        ev = UREvaluation()
+        res = MetricEvaluator(ev.metric, ev.other_metrics).evaluate(
+            ctx, engine_factory(), candidates)
+        assert len(res.candidates) == 2
+        assert res.best_score > 0.35, res.best_score
+        assert ev.metric.header == "MAP@10"
