@@ -19,8 +19,12 @@ import numpy as np
 
 from predictionio_tpu.controller import (
     Algorithm,
+    AverageMetric,
     DataSource,
     Engine,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
     FirstServing,
     IdentityPreparator,
     WorkflowContext,
@@ -71,6 +75,34 @@ class TTDataSource(DataSource):
         if data.n_events == 0:
             raise ValueError("no interaction events found")
         return TrainingData(data, stream=p.stream_chunk > 0)
+
+    def read_eval(self, ctx: WorkflowContext):
+        """Leave-one-out retrieval evaluation: each user's LAST
+        interaction is held out of training and must be retrieved by
+        the ``{"user": u}`` query (recall@k under one relevant item)."""
+        from predictionio_tpu.data.pipeline import InteractionData
+
+        td = self.read_training(ctx)
+        u, i, v = td.interactions.arrays()
+        last: Dict[int, int] = {}
+        cnt: Dict[int, int] = {}
+        for idx, uu in enumerate(u.tolist()):
+            last[uu] = idx
+            cnt[uu] = cnt.get(uu, 0) + 1
+        held = sorted(idx for uu, idx in last.items() if cnt[uu] >= 2)
+        if not held:
+            raise ValueError("no user has ≥ 2 interactions to hold out")
+        keep = np.ones(len(u), bool)
+        keep[held] = False
+        uk, ik, vk = u[keep], i[keep], v[keep]
+        reduced = InteractionData(
+            td.interactions.user_ids, td.interactions.item_ids,
+            lambda: iter([(uk, ik, vk)]), int(len(uk)))
+        inv_u = td.interactions.user_ids.inverse()
+        inv_i = td.interactions.item_ids.inverse()
+        qa = [({"user": inv_u[int(u[idx])], "num": 10},
+               inv_i[int(i[idx])]) for idx in held]
+        return [(TrainingData(reduced, stream=False), {"fold": 0}, qa)]
 
 
 @dataclass
@@ -176,3 +208,42 @@ def engine_factory() -> Engine:
         algorithm_cls_map={"twotower": TwoTowerAlgorithm},
         serving_cls=FirstServing,
     )
+
+
+# -- evaluation (pio eval out of the box) -------------------------------------
+
+
+class RecallAtK(AverageMetric):
+    """With one held-out relevant item, recall@k = hit rate @ k."""
+
+    def __init__(self, k: int = 10) -> None:
+        self.k = k
+
+    def calculate_one(self, query, predicted, actual) -> float:
+        items = [s["item"] for s in predicted.get("itemScores", [])][: self.k]
+        return 1.0 if actual in items else 0.0
+
+    @property
+    def header(self) -> str:
+        return f"Recall@{self.k}"
+
+
+class TTEvaluation(Evaluation):
+    engine_factory = staticmethod(engine_factory)
+    metric = RecallAtK(10)
+    other_metrics = (RecallAtK(1),)
+
+
+class DefaultGrid(EngineParamsGenerator):
+    """Embedding-width candidates; app name via $PIO_EVAL_APP_NAME."""
+
+    @property
+    def engine_params_list(self):
+        import os
+
+        app = os.environ.get("PIO_EVAL_APP_NAME", "MyApp1")
+        return [EngineParams(
+            data_source_params=DataSourceParams(app_name=app),
+            algorithms_params=[("twotower", TTAlgorithmParams(
+                embed_dim=d, out_dim=d, hidden=[2 * d], batch_size=256,
+                epochs=30))]) for d in (16, 32)]
