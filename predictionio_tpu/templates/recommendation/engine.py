@@ -25,7 +25,11 @@ from predictionio_tpu.controller import (
     Algorithm,
     DataSource,
     Engine,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
     FirstServing,
+    Metric,
     Preparator,
     WorkflowContext,
 )
@@ -236,3 +240,51 @@ def engine_factory() -> Engine:
         algorithm_cls_map={"als": ALSAlgorithm},
         serving_cls=FirstServing,
     )
+
+
+# -- evaluation (pio eval out of the box) -------------------------------------
+
+
+class NegRMSE(Metric):
+    """-RMSE of predicted vs held-out ratings over the eval folds
+    (higher is better, so the evaluator's argmax picks the lowest
+    error). Cold (user, item) pairs — unknown to the trained fold —
+    are skipped, the OptionAverageMetric convention."""
+
+    higher_is_better = True
+
+    def calculate(self, ctx, eval_data):
+        import math
+
+        errs = []
+        for _, qpa in eval_data:
+            for q, p, a in qpa:
+                scores = p.get("itemScores", [])
+                if scores and scores[0].get("score") is not None:
+                    errs.append((float(scores[0]["score"]) - float(a)) ** 2)
+        return (-math.sqrt(sum(errs) / len(errs)) if errs
+                else float("nan"))
+
+    @property
+    def header(self) -> str:
+        return "NegRMSE"
+
+
+class RecEvaluation(Evaluation):
+    engine_factory = staticmethod(engine_factory)
+    metric = NegRMSE()
+
+
+class DefaultGrid(EngineParamsGenerator):
+    """Rank/λ candidates over 2 folds; app via $PIO_EVAL_APP_NAME."""
+
+    @property
+    def engine_params_list(self):
+        import os
+
+        app = os.environ.get("PIO_EVAL_APP_NAME", "MyApp1")
+        return [EngineParams(
+            data_source_params=DataSourceParams(app_name=app, eval_k=2),
+            algorithms_params=[("als", ALSAlgorithmParams(
+                rank=r, num_iterations=8, lambda_=lam, seed=3))])
+            for r in (8, 16) for lam in (0.01, 0.1)]

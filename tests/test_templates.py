@@ -126,3 +126,51 @@ class TestECommerce:
             target_entity_type="item", target_entity_id=first), app.id)
         res2 = deployed.query({"user": "u1", "num": 3})
         assert first not in {s["item"] for s in res2["itemScores"]}
+
+
+class TestRecommendationEvaluation:
+    def test_neg_rmse_grid(self, storage):
+        """Built-in RecEvaluation: rate events with a planted structure
+        evaluate at a sane (finite, sub-rating-scale) RMSE across the
+        rank/λ grid."""
+        from predictionio_tpu.controller.base import WorkflowContext
+        from predictionio_tpu.controller.engine import EngineParams
+        from predictionio_tpu.controller.evaluation import MetricEvaluator
+        from predictionio_tpu.templates.recommendation.engine import (
+            ALSAlgorithmParams,
+            DataSourceParams,
+            RecEvaluation,
+            engine_factory,
+        )
+
+        app = storage.meta.create_app("RecEvalApp")
+        storage.events.init_channel(app.id)
+        rng = np.random.default_rng(6)
+        k_true = 3
+        Ut = rng.normal(size=(30, k_true))
+        Vt = rng.normal(size=(20, k_true))
+        evs = []
+        for u in range(30):
+            for i in range(20):
+                if rng.random() < 0.6:
+                    r = float(np.clip(Ut[u] @ Vt[i] + 3.0, 1, 5))
+                    evs.append(Event(
+                        event="rate", entity_type="user", entity_id=f"u{u}",
+                        target_entity_type="item", target_entity_id=f"i{i}",
+                        properties={"rating": r}))
+        storage.events.insert_batch(evs, app.id)
+
+        ctx = WorkflowContext(storage=storage)
+        candidates = [EngineParams(
+            data_source_params=DataSourceParams(app_name="RecEvalApp",
+                                                eval_k=2),
+            algorithms_params=[("als", ALSAlgorithmParams(
+                rank=r, num_iterations=8, lambda_=lam, seed=3))])
+            for r in (4, 8) for lam in (0.05,)]
+        ev = RecEvaluation()
+        res = MetricEvaluator(ev.metric).evaluate(
+            ctx, engine_factory(), candidates)
+        assert len(res.candidates) == 2
+        assert np.isfinite(res.best_score)
+        assert -2.0 < res.best_score < 0.0, res.best_score
+        assert ev.metric.header == "NegRMSE"
