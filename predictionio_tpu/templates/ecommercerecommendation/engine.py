@@ -28,8 +28,12 @@ import numpy as np
 
 from predictionio_tpu.controller import (
     Algorithm,
+    AverageMetric,
     DataSource,
     Engine,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
     FirstServing,
     IdentityPreparator,
     WorkflowContext,
@@ -54,6 +58,28 @@ class TrainingData:
 
 class ECommDataSource(DataSource):
     ParamsClass = DataSourceParams
+
+    def read_eval(self, ctx: WorkflowContext):
+        """Leave-one-out over interactions: each user's LAST pair is
+        held out and must be retrieved by the plain user query. Eval
+        candidates must set ``unseenOnly: false`` — live seen-item
+        exclusion reads the event store, which still contains the
+        held-out event."""
+        td = self.read_training(ctx)
+        last = {}
+        cnt = {}
+        for idx, (u, _i, _w) in enumerate(td.interactions):
+            last[u] = idx
+            cnt[u] = cnt.get(u, 0) + 1
+        held = sorted(idx for u, idx in last.items() if cnt[u] >= 2)
+        if not held:
+            raise ValueError("no user has >= 2 interactions to hold out")
+        keep = [pr for idx, pr in enumerate(td.interactions)
+                if idx not in set(held)]
+        qa = [({"user": td.interactions[idx][0], "num": 10},
+               td.interactions[idx][1]) for idx in held]
+        return [(TrainingData(td.app_name, keep, td.item_categories),
+                 {"fold": 0}, qa)]
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
         p: DataSourceParams = self.params
@@ -226,3 +252,40 @@ def engine_factory() -> Engine:
         algorithm_cls_map={"ecomm": ECommAlgorithm},
         serving_cls=FirstServing,
     )
+
+
+# -- evaluation (pio eval out of the box) -------------------------------------
+
+
+class HitRateAtK(AverageMetric):
+    def __init__(self, k: int = 10) -> None:
+        self.k = k
+
+    def calculate_one(self, query, predicted, actual) -> float:
+        items = [s["item"] for s in predicted.get("itemScores", [])][: self.k]
+        return 1.0 if actual in items else 0.0
+
+    @property
+    def header(self) -> str:
+        return f"HitRate@{self.k}"
+
+
+class ECommEvaluation(Evaluation):
+    engine_factory = staticmethod(engine_factory)
+    metric = HitRateAtK(10)
+
+
+class DefaultGrid(EngineParamsGenerator):
+    """rank/alpha candidates; unseenOnly stays FALSE for eval (see
+    read_eval); app via $PIO_EVAL_APP_NAME."""
+
+    @property
+    def engine_params_list(self):
+        import os
+
+        app = os.environ.get("PIO_EVAL_APP_NAME", "MyApp1")
+        return [EngineParams(
+            data_source_params=DataSourceParams(app_name=app),
+            algorithms_params=[("ecomm", ECommAlgorithmParams(
+                rank=r, num_iterations=10, alpha=a, unseen_only=False))])
+            for r in (8, 16) for a in (1.0,)]

@@ -174,3 +174,32 @@ class TestRecommendationEvaluation:
         assert np.isfinite(res.best_score)
         assert -2.0 < res.best_score < 0.0, res.best_score
         assert ev.metric.header == "NegRMSE"
+
+
+class TestECommEvaluation:
+    def test_hit_rate_grid(self, storage):
+        """Built-in ECommEvaluation over clique data: the held-out
+        interaction comes from the user's own clique → hit rate @ 10
+        over a 20-item catalog must beat random (0.5)."""
+        from predictionio_tpu.controller.base import WorkflowContext
+        from predictionio_tpu.controller.engine import EngineParams
+        from predictionio_tpu.controller.evaluation import MetricEvaluator
+        from predictionio_tpu.templates.ecommercerecommendation.engine import (
+            DataSourceParams,
+            ECommAlgorithmParams,
+            ECommEvaluation,
+            engine_factory,
+        )
+
+        seed_views(storage, "EcEvalApp", with_buys=True)
+        ctx = WorkflowContext(storage=storage)
+        candidates = [EngineParams(
+            data_source_params=DataSourceParams(app_name="EcEvalApp"),
+            algorithms_params=[("ecomm", ECommAlgorithmParams(
+                rank=r, num_iterations=10, unseen_only=False))])
+            for r in (8, 16)]
+        ev = ECommEvaluation()
+        res = MetricEvaluator(ev.metric).evaluate(
+            ctx, engine_factory(), candidates)
+        assert len(res.candidates) == 2
+        assert res.best_score > 0.5, res.best_score
