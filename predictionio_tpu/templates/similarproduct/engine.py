@@ -22,8 +22,12 @@ import numpy as np
 
 from predictionio_tpu.controller import (
     Algorithm,
+    AverageMetric,
     DataSource,
     Engine,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
     FirstServing,
     IdentityPreparator,
     WorkflowContext,
@@ -70,6 +74,29 @@ class SimilarProductDataSource(DataSource):
                 p.app_name, "item", storage=ctx.storage).items()
         }
         return TrainingData(views, cats)
+
+    def read_eval(self, ctx: WorkflowContext):
+        """Item-to-item retrieval protocol: each user's LAST viewed
+        item is held out; the query carries the user's remaining items
+        and the held-out one must rank in the top-k similars."""
+        td = self.read_training(ctx)
+        last = {}
+        cnt = {}
+        for idx, (u, _i) in enumerate(td.views):
+            last[u] = idx
+            cnt[u] = cnt.get(u, 0) + 1
+        held = sorted(idx for u, idx in last.items() if cnt[u] >= 3)
+        if not held:
+            raise ValueError("no user has >= 3 views to hold one out")
+        held_set = set(held)
+        keep = [pr for idx, pr in enumerate(td.views)
+                if idx not in held_set]
+        by_user = {}
+        for u, i in keep:
+            by_user.setdefault(u, []).append(i)
+        qa = [({"items": by_user[td.views[idx][0]], "num": 10},
+               td.views[idx][1]) for idx in held]
+        return [(TrainingData(keep, td.item_categories), {"fold": 0}, qa)]
 
 
 @dataclass
@@ -174,3 +201,38 @@ def engine_factory() -> Engine:
         algorithm_cls_map={"als": ALSAlgorithm},
         serving_cls=FirstServing,
     )
+
+
+# -- evaluation (pio eval out of the box) -------------------------------------
+
+
+class HitRateAtK(AverageMetric):
+    def __init__(self, k: int = 10) -> None:
+        self.k = k
+
+    def calculate_one(self, query, predicted, actual) -> float:
+        items = [s["item"] for s in predicted.get("itemScores", [])][: self.k]
+        return 1.0 if actual in items else 0.0
+
+    @property
+    def header(self) -> str:
+        return f"HitRate@{self.k}"
+
+
+class SPEvaluation(Evaluation):
+    engine_factory = staticmethod(engine_factory)
+    metric = HitRateAtK(10)
+
+
+class DefaultGrid(EngineParamsGenerator):
+    """Rank candidates; app via $PIO_EVAL_APP_NAME."""
+
+    @property
+    def engine_params_list(self):
+        import os
+
+        app = os.environ.get("PIO_EVAL_APP_NAME", "MyApp1")
+        return [EngineParams(
+            data_source_params=DataSourceParams(app_name=app),
+            algorithms_params=[("als", ALSAlgorithmParams(rank=r))])
+            for r in (8, 16)]
