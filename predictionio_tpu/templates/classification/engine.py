@@ -21,6 +21,10 @@ from typing import Any, Dict, List
 import numpy as np
 
 from predictionio_tpu.controller import (
+    AverageMetric,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
     Algorithm,
     DataSource,
     Engine,
@@ -256,3 +260,41 @@ def engine_factory() -> Engine:
         },
         serving_cls=FirstServing,
     )
+
+
+# -- evaluation (pio eval out of the box) -------------------------------------
+
+
+class Accuracy(AverageMetric):
+    """Fraction of held-out rows labeled correctly."""
+
+    def calculate_one(self, query, predicted, actual) -> float:
+        return 1.0 if float(predicted.get("label", float("nan"))) == \
+            float(actual) else 0.0
+
+
+class ClsEvaluation(Evaluation):
+    engine_factory = staticmethod(engine_factory)
+    metric = Accuracy()
+
+
+class DefaultGrid(EngineParamsGenerator):
+    """NB smoothing vs logistic vs forest, 2 folds; app via
+    $PIO_EVAL_APP_NAME."""
+
+    @property
+    def engine_params_list(self):
+        import os
+
+        app = os.environ.get("PIO_EVAL_APP_NAME", "MyApp2")
+        ds = DataSourceParams(app_name=app, eval_k=2)
+        return [
+            EngineParams(data_source_params=ds,
+                         algorithms_params=[("naive", NBAlgoParams(lambda_=lam))])
+            for lam in (0.5, 1.0)
+        ] + [
+            EngineParams(data_source_params=ds,
+                         algorithms_params=[("lr", LRAlgoParams())]),
+            EngineParams(data_source_params=ds,
+                         algorithms_params=[("forest", RFAlgoParams())]),
+        ]

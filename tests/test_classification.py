@@ -147,3 +147,57 @@ class TestClassificationTemplate:
         _, result = run_evaluation(Ev(), candidates, storage=storage,
                                    use_mesh=False)
         assert result.best_score > 0.9
+
+
+class TestEvaluation:
+    def test_accuracy_grid_across_algorithms(self, storage):
+        """Built-in ClsEvaluation: NB / logistic / forest candidates
+        over 2 folds on composition-separated data — all should score
+        well and the evaluator must pick a finite best."""
+        import numpy as np
+
+        from predictionio_tpu.controller.base import WorkflowContext
+        from predictionio_tpu.controller.engine import EngineParams
+        from predictionio_tpu.controller.evaluation import MetricEvaluator
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.templates.classification.engine import (
+            ClsEvaluation,
+            DataSourceParams,
+            LRAlgoParams,
+            NBAlgoParams,
+            RFAlgoParams,
+            engine_factory,
+        )
+
+        app = storage.meta.create_app("ClsEvalApp")
+        storage.events.init_channel(app.id)
+        rng = np.random.default_rng(5)
+        evs = []
+        for i in range(80):
+            label = i % 2
+            heavy, light = (8, 1) if label == 0 else (1, 8)
+            evs.append(Event(
+                event="$set", entity_type="user", entity_id=f"u{i}",
+                properties={"attr0": int(heavy + rng.integers(0, 3)),
+                            "attr1": int(light + rng.integers(0, 3)),
+                            "attr2": int(rng.integers(1, 3)),
+                            "label": label}))
+        storage.events.insert_batch(evs, app.id)
+
+        ctx = WorkflowContext(storage=storage)
+        ds = DataSourceParams(app_name="ClsEvalApp", eval_k=2)
+        candidates = [
+            EngineParams(data_source_params=ds,
+                         algorithms_params=[("naive", NBAlgoParams())]),
+            EngineParams(data_source_params=ds,
+                         algorithms_params=[("lr", LRAlgoParams())]),
+            EngineParams(data_source_params=ds,
+                         algorithms_params=[("forest", RFAlgoParams(
+                             num_trees=8, max_depth=3))]),
+        ]
+        ev = ClsEvaluation()
+        res = MetricEvaluator(ev.metric).evaluate(
+            ctx, engine_factory(), candidates)
+        assert len(res.candidates) == 3
+        assert res.best_score > 0.9, res.best_score
+        assert all(s > 0.7 for _, s, _ in res.candidates), res.candidates
