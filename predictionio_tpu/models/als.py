@@ -150,6 +150,14 @@ _SOLVE_BUF_MB = int(os.environ.get("PIO_ALS_SOLVE_BUF_MB", "4096"))
 # uniform bucket path.
 _DENSE_RATIO = 1.0 / 14.0
 _DENSE_MIN_COUNT = 256
+# Cap on the dense head's total weight-row bytes (w_cnt + w_val, 8
+# bytes per (entity, other) cell, held on host AND device). The head
+# pays off because a power-law tail keeps it to a few hundred entities;
+# a distribution with MANY just-over-threshold entities would otherwise
+# grow it without bound (~2 GB/side at 20M nnz worst case — ADVICE r3).
+# Entities over the cap spill to the seg/ladder bucket path, which is
+# always correct, just gather-bound.
+_DENSE_HEAD_MB = 2048
 
 
 @dataclass
@@ -247,6 +255,12 @@ def _merge_bounds(counts_sorted_list, n_other: int) -> tuple:
     """
     thresh = max(_DENSE_MIN_COUNT, int(_DENSE_RATIO * n_other))
     nb_dense = max(int((c >= thresh).sum()) for c in counts_sorted_list)
+    # byte-cap the head (PIO_ALS_DENSE_HEAD_MB, see _DENSE_HEAD_MB):
+    # counts are sorted descending, so truncating keeps the heaviest —
+    # highest-payoff — entities and spills the rest to the buckets below
+    head_mb = int(os.environ.get("PIO_ALS_DENSE_HEAD_MB",
+                                 str(_DENSE_HEAD_MB)))
+    nb_dense = min(nb_dense, (head_mb << 20) // max(1, 8 * n_other))
     nb_seg = max(int((c[nb_dense:] > _C_MAX).sum())
                  for c in counts_sorted_list)
     rows_cap = 0
@@ -499,12 +513,63 @@ def als_train(
                               checkpoint_every=checkpoint_every)
 
 
-def _make_half(k: int, reg: float, implicit: bool, alpha: float,
-               weighted_reg: bool, pvary=None, platform=None,
-               bf16_gather: bool = False):
+def als_train_many(
+    coo: RatingsCOO,
+    params_list,
+    mesh=None,
+) -> list:
+    """Train one (U, V) per params on the SAME ratings — the `pio eval`
+    grid fan-out (SURVEY.md §2d P4; reference behavior: MLlib grids
+    re-run ALS per candidate from scratch).
+
+    Costs shared across the grid:
+    - the bucketed host layout is prepared ONCE (``als_prepare`` /
+      ``als_prepare_sharded``) and its device upload is cached per
+      device/mesh (``device_buffers``);
+    - candidates differing only in ``reg``/``alpha`` share ONE compiled
+      executable — both enter the kernel as traced scalars — so the
+      canonical regularization grid compiles the train program once.
+      Distinct ``rank``/``iterations``/``implicit``/``weighted_reg``
+      still compile per distinct value (they change program shape or
+      structure), amortized by ``_compiled_bucketed``'s lru_cache and
+      the persistent XLA cache.
+    """
+    params_list = list(params_list)
+    if mesh is not None and np.prod(mesh.devices.shape) > 1:
+        from predictionio_tpu.models.als_sharded import (
+            als_prepare_sharded,
+            als_train_sharded_prepared,
+        )
+
+        sprep = als_prepare_sharded(coo, int(np.prod(mesh.devices.shape)))
+        return [als_train_sharded_prepared(sprep, p, mesh)
+                for p in params_list]
+    device = mesh.devices.flat[0] if mesh is not None else None
+    prep = als_prepare(coo)
+    return [als_train_prepared(prep, p, device=device)
+            for p in params_list]
+
+
+def _make_half(k: int, implicit: bool, weighted_reg: bool, pvary=None,
+               platform=None, bf16_gather: bool = False,
+               precision: str = "high"):
     """Build the half-step program shared by the single-device and
-    sharded (shard_map) paths: ``half(F_other, bufs, geometry)`` — one
-    full re-solve of one side's factors from the other side's.
+    sharded (shard_map) paths:
+    ``half(F_other, bufs, geometry, reg, alpha)`` — one full re-solve
+    of one side's factors from the other side's.
+
+    ``reg`` and ``alpha`` are TRACED scalar inputs: they enter the
+    kernel only as multiplies, so an eval grid over regularization (the
+    canonical ALS grid) shares ONE compiled executable across
+    candidates instead of paying a full XLA compile per reg value.
+    ``implicit`` and ``weighted_reg`` stay Python-static — they change
+    the program's structure, not its constants.
+
+    ``precision`` selects the Gram-einsum MXU precision: "high"
+    (default, 3-pass) or "highest" (6-pass) via ``PIO_ALS_PRECISION``
+    — CPU CI ignores the precision argument entirely, so the knob
+    exists to let an on-device run A/B the two modes when triaging a
+    numerical regression (ADVICE r3).
 
     Per bucket, per slab (a ``lax.scan`` step): gather the (slab, C, k)
     factor block, one batched weighted-Gram einsum (MXU), add ridge +
@@ -528,12 +593,20 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
 
     pv = pvary if pvary is not None else (lambda x: x)
     eye = jnp.eye(k, dtype=jnp.float32)
+    prec = (jax.lax.Precision.HIGHEST if precision == "highest"
+            else jax.lax.Precision.HIGH)
 
     from predictionio_tpu.ops.cholesky import chol_solve_batched as _csb
 
     chol_solve_batched = functools.partial(_csb, platform=platform)
 
+    # reg/alpha are bound per trace by ``half`` (traced scalars shared
+    # by every helper below via this cell — threading them through five
+    # helper signatures would obscure the kernel structure)
+    _ra: dict = {}
+
     def weights(v_s, m_s):
+        alpha = _ra["alpha"]
         if implicit:
             return (alpha * v_s) * m_s, (1.0 + alpha * v_s) * m_s
         return m_s, v_s * m_s
@@ -568,13 +641,14 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
         wo, wb = weights(v_s, m_s)
         H = jnp.concatenate([wo[..., None] * F, wb[..., None]], axis=-1)
         return jnp.einsum("nck,ncl->nkl", F, H,
-                          precision=jax.lax.Precision.HIGH,
+                          precision=prec,
                           preferred_element_type=jnp.float32)
 
     def ridge(A, cnt_s, G):
+        reg = _ra["reg"]
         if implicit:
             A = A + G[None, :, :]
-        lam = reg * cnt_s if weighted_reg else jnp.full_like(cnt_s, reg)
+        lam = reg * cnt_s if weighted_reg else reg * jnp.ones_like(cnt_s)
         lam = jnp.where(cnt_s > 0, jnp.maximum(lam, 1e-8), 1.0)
         return A + lam[:, None, None] * eye
 
@@ -591,7 +665,7 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
             oi_s, v_s, m_s, seg_s, off_s = chunk
             Ab_r = row_grams(F_g, oi_s, v_s, m_s)   # (slab, k, k+1)
             Ab_l = jnp.einsum("ne,nkm->ekm", seg_s, Ab_r,
-                              precision=jax.lax.Precision.HIGH,
+                              precision=prec,
                               preferred_element_type=jnp.float32)
             blk = jax.lax.dynamic_slice(Ab_e, (off_s, 0, 0),
                                         (slab, k, k + 1))
@@ -612,6 +686,7 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
         no scan: pure MXU work."""
         w_cnt, w_val, cnt = dbuf
         if implicit:
+            alpha = _ra["alpha"]
             wo_m, wb_m = alpha * w_val, w_cnt + alpha * w_val
         else:
             wo_m, wb_m = w_cnt, w_val
@@ -619,11 +694,11 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
         FF = (F_other[:, :, None] * F_other[:, None, :]).reshape(
             n_other, k * k)
         A = jnp.einsum("nc,cm->nm", wo_m, FF,
-                       precision=jax.lax.Precision.HIGH,
+                       precision=prec,
                        preferred_element_type=jnp.float32
                        ).reshape(-1, k, k)
         b = jnp.einsum("nc,ck->nk", wb_m, F_other,
-                       precision=jax.lax.Precision.HIGH,
+                       precision=prec,
                        preferred_element_type=jnp.float32)
         return ridge(A, cnt, G), b
 
@@ -692,7 +767,11 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
         # forced (merged) boundaries can exceed n_self; extras are zeros
         return out[:n_self] if total > n_self else out
 
-    def half(F_other, bufs_side, geometry):
+    def half(F_other, bufs_side, geometry, reg, alpha):
+        # bind the traced scalars for every helper above; pv marks them
+        # device-varying under shard_map (they arrive replicated)
+        _ra["reg"] = pv(jnp.asarray(reg, jnp.float32))
+        _ra["alpha"] = pv(jnp.asarray(alpha, jnp.float32))
         n_self, dense_geom, bucket_geoms = geometry
         dense_buf, bufs = bufs_side
         # bf16 gather mode: ONE cast pass per half-step; every bucket
@@ -702,7 +781,7 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
         G = None
         if implicit:
             G = jnp.einsum("nk,nl->kl", F_other, F_other,
-                           precision=jax.lax.Precision.HIGH,
+                           precision=prec,
                            preferred_element_type=jnp.float32)
         # spans in the solve buffer: the dense head and seg buckets
         # emit nb exact rows once, regular buckets their padded slabs
@@ -753,35 +832,46 @@ def _make_half(k: int, reg: float, implicit: bool, alpha: float,
     return half
 
 
+def _gram_precision() -> str:
+    """Gram-einsum precision mode from ``PIO_ALS_PRECISION`` ("high"
+    default; "highest" restores the 6-pass MXU mode for on-device
+    numerical triage — see ``_make_half``)."""
+    return os.environ.get("PIO_ALS_PRECISION", "high").lower()
+
+
 @functools.lru_cache(maxsize=8)
 def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
-                       rank: int, iterations: int, reg: float,
-                       implicit: bool, alpha: float, weighted_reg: bool,
+                       rank: int, iterations: int,
+                       implicit: bool, weighted_reg: bool,
                        platform: Optional[str] = None,
-                       bf16_gather: bool = False):
+                       bf16_gather: bool = False,
+                       precision: str = "high"):
     """Build + jit the full single-device training program for one
     problem geometry (two `_make_half` programs under one iteration
-    scan). Caching on geometry means `pio eval` grid candidates that
-    share shapes recompile only when rank/iterations change."""
+    scan). ``reg`` and ``alpha`` are traced inputs of the returned
+    ``train(u_bufs, i_bufs, V0p, reg, alpha)``, so a `pio eval` grid
+    over regularization/alpha shares ONE executable; candidates
+    recompile only when rank/iterations (or the implicit/weighted_reg
+    program structure) change."""
     import jax
     import jax.numpy as jnp
 
     k = rank
-    half = _make_half(k, float(reg), bool(implicit), float(alpha),
-                      bool(weighted_reg), platform=platform,
-                      bf16_gather=bf16_gather)
+    half = _make_half(k, bool(implicit), bool(weighted_reg),
+                      platform=platform, bf16_gather=bf16_gather,
+                      precision=precision)
 
-    def train(u_bufs, i_bufs, V0p):
+    def train(u_bufs, i_bufs, V0p, reg, alpha):
         if iterations == 0:
             # U-recovery program: derive U from already-converged V (the
             # resume path when a run died between its final checkpoint
             # and model persistence)
-            return half(V0p, u_bufs, geom_u), V0p
+            return half(V0p, u_bufs, geom_u, reg, alpha), V0p
 
         def step(carry, _):
             U, V = carry
-            U = half(V, u_bufs, geom_u)
-            V = half(U, i_bufs, geom_i)
+            U = half(V, u_bufs, geom_u, reg, alpha)
+            V = half(U, i_bufs, geom_i, reg, alpha)
             return (U, V), None
 
         U0 = jnp.zeros((n_users, k), jnp.float32)
@@ -833,49 +923,49 @@ def als_train_prepared(prep: ALSPrepared, p: ALSParams, device=None,
         return _compiled_bucketed(
             prep.u_side.geometry, prep.i_side.geometry,
             prep.n_users, prep.n_items,
-            p.rank, n_iters, float(p.reg), bool(p.implicit),
-            float(p.alpha), bool(p.weighted_reg), platform,
-            bool(p.bf16_gather))
+            p.rank, n_iters, bool(p.implicit),
+            bool(p.weighted_reg), platform,
+            bool(p.bf16_gather), _gram_precision())
+
+    reg_a = np.float32(p.reg)
+    alpha_a = np.float32(p.alpha)
 
     start = 0
     V0 = init_factors(prep.n_items, p.rank, p.seed)[prep.i_side.perm]
     U0 = None  # restored U (only consumed when start == iterations)
-    if checkpointer is not None:
-        step = checkpointer.latest_step()
-        if step is not None:
-            template = {"U": np.zeros((prep.n_users, p.rank), np.float32),
-                        "V": np.zeros_like(V0)}
-            try:
-                state = checkpointer.restore(step, template=template)
-                okay = all(np.asarray(state[k]).shape == template[k].shape
-                           for k in template)
-            except Exception:
-                okay = False
-            if okay:
-                V0 = np.asarray(state["V"])
-                U0 = np.asarray(state["U"])
-                start = min(int(step), p.iterations)
-            else:
-                # stale checkpoints (different geometry/rank) fall back
-                # to a fresh start — and the dir must be WIPED, else
-                # the fresh run's lower step numbers stay shadowed by
-                # the stale latest_step and every future resume
-                # restores the bad checkpoint again
-                checkpointer.clear()
+    if checkpointer is not None and checkpointer.latest_step() is not None:
+        from predictionio_tpu.utils.checkpoint import CheckpointGeometryError
+
+        template = {"U": np.zeros((prep.n_users, p.rank), np.float32),
+                    "V": np.zeros_like(V0)}
+        try:
+            state, step = checkpointer.restore_latest_compatible(template)
+            V0 = np.asarray(state["V"])
+            U0 = np.asarray(state["U"])
+            start = min(int(step), p.iterations)
+        except CheckpointGeometryError:
+            # CONFIRMED stale (different geometry/rank): fresh start,
+            # and the dir must be WIPED, else the fresh run's lower
+            # step numbers stay shadowed by the stale latest_step and
+            # every future resume restores the bad checkpoint again.
+            # Transient read errors propagate instead — wiping on those
+            # would destroy valid checkpoints (ADVICE r3).
+            checkpointer.clear()
 
     if start >= p.iterations and U0 is not None:
         # died between the final checkpoint and model persistence: the
         # train is already done, nothing to recompute
         U, V = U0, V0
     elif checkpointer is None or checkpoint_every <= 0:
-        U, V = compiled(p.iterations - start)(u_bufs, i_bufs, put(V0))
+        U, V = compiled(p.iterations - start)(u_bufs, i_bufs, put(V0),
+                                              reg_a, alpha_a)
     else:
         V = put(V0)
         U = None
         it = start
         while it < p.iterations:
             n = min(checkpoint_every, p.iterations - it)
-            U, V = compiled(n)(u_bufs, i_bufs, V)
+            U, V = compiled(n)(u_bufs, i_bufs, V, reg_a, alpha_a)
             it += n
             checkpointer.save(it, {"U": np.asarray(U), "V": np.asarray(V)})
         assert U is not None  # start < iterations here, loop ran

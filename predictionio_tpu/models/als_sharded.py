@@ -201,8 +201,12 @@ def als_prepare_sharded(coo: RatingsCOO, n_dev: int) -> ALSShardedPrepared:
 
 @functools.lru_cache(maxsize=8)
 def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,
-                      reg: float, implicit: bool, alpha: float,
-                      weighted_reg: bool, bf16_gather: bool = False):
+                      implicit: bool, weighted_reg: bool,
+                      bf16_gather: bool = False, precision: str = "high"):
+    """``reg``/``alpha`` are traced scalar inputs of the returned
+    program (replicated into the shard_map body), so an eval grid over
+    regularization shares one sharded executable — the cache keys only
+    on geometry + program structure (see als._compiled_bucketed)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -212,12 +216,12 @@ def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,
     shard_map = get_shard_map()
     k = rank
     block_u = geom_u[0]
-    half = _make_half(k, reg, implicit, alpha, weighted_reg,
+    half = _make_half(k, implicit, weighted_reg,
                       pvary=lambda x: pvary(x, "data"),
                       platform=mesh.devices.flat[0].platform,
-                      bf16_gather=bf16_gather)
+                      bf16_gather=bf16_gather, precision=precision)
 
-    def body(u_bufs, i_bufs, V0_l):
+    def body(u_bufs, i_bufs, V0_l, reg, alpha):
         # inside shard_map the stacked arrays arrive with a local
         # leading device dim of 1 → squeeze it
         def squeeze(side):
@@ -231,9 +235,9 @@ def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,
         def step(carry, _):
             U_l, V_l = carry
             V_full = jax.lax.all_gather(V_l, "data", tiled=True)
-            U_l = half(V_full, u_l, geom_u)
+            U_l = half(V_full, u_l, geom_u, reg, alpha)
             U_full = jax.lax.all_gather(U_l, "data", tiled=True)
-            V_l = half(U_full, i_l, geom_i)
+            V_l = half(U_full, i_l, geom_i, reg, alpha)
             return (U_l, V_l), None
 
         U0 = pvary(jnp.zeros((block_u, k), jnp.float32), "data")
@@ -261,7 +265,7 @@ def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(side_specs(geom_u), side_specs(geom_i),
-                  P("data", None)),
+                  P("data", None), P(), P()),
         out_specs=(P("data", None), P("data", None)),
     )
     return jax.jit(fn)
@@ -281,10 +285,12 @@ def als_train_sharded_prepared(
             f"layout was prepared for {n_dev} devices but the mesh has "
             f"{int(np.prod(mesh.devices.shape))}")
 
+    from predictionio_tpu.models.als import _gram_precision
+
     train = _compiled_sharded(
         mesh, prep.geom_u, prep.geom_i,
-        p.rank, p.iterations, float(p.reg), bool(p.implicit),
-        float(p.alpha), bool(p.weighted_reg), bool(p.bf16_gather))
+        p.rank, p.iterations, bool(p.implicit),
+        bool(p.weighted_reg), bool(p.bf16_gather), _gram_precision())
 
     # inputs are placed directly onto the mesh with their shard_map
     # layouts (cached per mesh) — never through the default backend
@@ -301,7 +307,7 @@ def als_train_sharded_prepared(
         for d in range(n_dev)])
     V0 = jax.device_put(V0p, NamedSharding(mesh, P("data", None)))
 
-    U, V = train(u_bufs, i_bufs, V0)
+    U, V = train(u_bufs, i_bufs, V0, np.float32(p.reg), np.float32(p.alpha))
 
     def fetch(x):
         # multi-host: the result spans non-addressable devices — gather

@@ -255,32 +255,27 @@ def seq_rec_train(sequences, n_items: int, p: SeqRecParams, mesh=None,
     # checkpointed path: epoch blocks between saves; params + optimizer
     # state fully determine the remainder (batches are fixed per seed),
     # so resume reproduces the uninterrupted run
-    from predictionio_tpu.utils.checkpoint import TrainCheckpointer
+    from predictionio_tpu.utils.checkpoint import (CheckpointGeometryError,
+                                                   TrainCheckpointer)
 
     ckpt = TrainCheckpointer(p.checkpoint_dir)
     start = 0
-    latest = ckpt.latest_step()
-    if latest is not None:
+    if ckpt.latest_step() is not None:
         template = {"params": jax.tree.map(np.asarray, params),
                     "opt_state": jax.tree.map(np.asarray, opt_state)}
         try:
-            state = ckpt.restore(latest, template=template)
-            # Orbax restores arrays of a DIFFERENT shape into a
-            # concrete template without raising — validate explicitly
-            chex_ok = all(
-                np.asarray(a).shape == np.asarray(b).shape
-                for a, b in zip(jax.tree.leaves(state),
-                                jax.tree.leaves(template)))
-            if not chex_ok:
-                raise ValueError("checkpoint geometry mismatch")
+            # newest→oldest walk: a crash-truncated newest save falls
+            # back to the previous good step instead of a full retrain
+            state, latest = ckpt.restore_latest_compatible(template)
             params = jax.tree.map(jnp.asarray, state["params"])
             opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
             start = min(int(latest), p.epochs)
-        except Exception:
-            # stale/incompatible (or crash-truncated) checkpoint →
-            # fresh start; WIPE the dir, else the fresh run's lower
-            # step numbers stay shadowed by the stale latest_step and
-            # every future resume restores the bad checkpoint again
+        except CheckpointGeometryError:
+            # CONFIRMED stale (different geometry) → fresh start; WIPE
+            # the dir, else the fresh run's lower step numbers stay
+            # shadowed by the stale latest_step and every future resume
+            # restores the bad checkpoint again. Transient read errors
+            # propagate — wiping on those destroys valid checkpoints.
             ckpt.clear()
     loss_parts = []
     epoch = start

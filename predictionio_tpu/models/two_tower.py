@@ -159,23 +159,21 @@ def two_tower_train(
         from predictionio_tpu.utils.checkpoint import TrainCheckpointer
 
         ckpt = TrainCheckpointer(p.checkpoint_dir)
-        latest = ckpt.latest_step()
-        if latest is not None:
+        if ckpt.latest_step() is not None:
+            from predictionio_tpu.utils.checkpoint import (
+                CheckpointGeometryError,
+            )
+
             try:
                 template = {"variables": variables, "opt_state": opt_state}
-                state = ckpt.restore(template=template)
-                # Orbax restores differently-shaped arrays into a
-                # concrete template without raising — validate
-                if not all(np.asarray(a).shape == np.asarray(b).shape
-                           for a, b in zip(jax.tree_util.tree_leaves(state),
-                                           jax.tree_util.tree_leaves(template))):
-                    raise ValueError("checkpoint geometry mismatch")
+                state, latest = ckpt.restore_latest_compatible(template)
                 variables, opt_state = state["variables"], state["opt_state"]
                 start_epoch = latest
-            except Exception:
-                # stale/incompatible checkpoint (e.g. different tower
-                # geometry) → fresh start; wipe so the stale
-                # latest_step can't shadow this run's saves
+            except CheckpointGeometryError:
+                # CONFIRMED stale (e.g. different tower geometry) →
+                # fresh start; wipe so the stale latest_step can't
+                # shadow this run's saves. Transient read errors
+                # propagate — wiping would destroy valid checkpoints.
                 ckpt.clear()
 
     last_loss = None

@@ -170,6 +170,18 @@ def chol_solve_batched(A, b, platform=None):
     if A.ndim == 3 and A.shape[0] >= 256 and ops.use_pallas(platform):
         if flag == "1" or (flag != "0" and _pallas_solve_preflight()):
             return chol_solve_pallas(A, b)
+    elif flag == "1":
+        # The flag promises "force the kernel" — an A/B run that
+        # silently measured the XLA path instead would be dishonest.
+        import warnings
+
+        reason = (f"batch rank {A.ndim} != 3" if A.ndim != 3
+                  else f"batch {A.shape[0]} < 256" if A.shape[0] < 256
+                  else f"platform {platform or 'default'} is not TPU")
+        warnings.warn(
+            f"PIO_PALLAS_SOLVE=1 set but the Pallas solve kernel cannot "
+            f"dispatch ({reason}); falling back to the XLA path",
+            RuntimeWarning, stacklevel=2)
     return _chol_solve(A, b)
 
 

@@ -74,8 +74,9 @@ class ECommDataSource(DataSource):
         held = sorted(idx for u, idx in last.items() if cnt[u] >= 2)
         if not held:
             raise ValueError("no user has >= 2 interactions to hold out")
+        held_set = set(held)
         keep = [pr for idx, pr in enumerate(td.interactions)
-                if idx not in set(held)]
+                if idx not in held_set]
         qa = [({"user": td.interactions[idx][0], "num": 10},
                td.interactions[idx][1]) for idx in held]
         return [(TrainingData(td.app_name, keep, td.item_categories),

@@ -176,8 +176,8 @@ class ALSAlgorithm(Algorithm):
         if not data.ratings:
             raise ValueError("empty TrainingData.ratings")
 
-    def train(self, ctx: WorkflowContext, pd: TrainingData) -> ALSModel:
-        p: ALSAlgorithmParams = self.params
+    @staticmethod
+    def _to_coo(pd: TrainingData):
         user_ids = BiMap.string_int(r.user for r in pd.ratings)
         item_ids = BiMap.string_int(r.item for r in pd.ratings)
         coo = RatingsCOO(
@@ -190,14 +190,37 @@ class ALSAlgorithm(Algorithm):
             n_users=len(user_ids),
             n_items=len(item_ids),
         )
+        return coo, user_ids, item_ids
+
+    @staticmethod
+    def _als_params(p: ALSAlgorithmParams) -> ALSParams:
+        return ALSParams(
+            rank=p.rank, iterations=p.num_iterations, reg=p.lambda_,
+            implicit=p.implicit_prefs, alpha=p.alpha,
+            seed=0 if p.seed is None else p.seed,
+            bf16_gather=p.bf16_gather,
+        )
+
+    @classmethod
+    def train_many(cls, ctx: WorkflowContext, pd: TrainingData,
+                   params_list) -> List[ALSModel]:
+        """Grid fan-out (`pio eval`): the id maps + bucketed layout
+        build once, and candidates differing only in lambda/alpha share
+        one compiled executable (reg/alpha are traced scalars — see
+        models/als.als_train_many). SURVEY.md §2d P4."""
+        from predictionio_tpu.models.als import als_train_many
+
+        coo, user_ids, item_ids = cls._to_coo(pd)
+        results = als_train_many(
+            coo, [cls._als_params(p) for p in params_list], mesh=ctx.mesh)
+        return [ALSModel(U, V, user_ids, item_ids) for U, V in results]
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> ALSModel:
+        p: ALSAlgorithmParams = self.params
+        coo, user_ids, item_ids = self._to_coo(pd)
         U, V = als_train(
             coo,
-            ALSParams(
-                rank=p.rank, iterations=p.num_iterations, reg=p.lambda_,
-                implicit=p.implicit_prefs, alpha=p.alpha,
-                seed=0 if p.seed is None else p.seed,
-                bf16_gather=p.bf16_gather,
-            ),
+            self._als_params(p),
             mesh=ctx.mesh,
             # restart-from-checkpoint (run_train --resume): save V every
             # checkpoint_every iterations under the workflow's ckpt dir

@@ -14,7 +14,14 @@ retained. State must be a pytree of arrays plus ints/floats.
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
+
+
+class CheckpointGeometryError(Exception):
+    """Every stored checkpoint restored cleanly but with shapes that do
+    not match the requested template — the directory holds state from a
+    run with different geometry (rank/width/etc.). This is the one case
+    where wiping the directory is safe and correct."""
 
 
 class TrainCheckpointer:
@@ -43,8 +50,15 @@ class TrainCheckpointer:
     def save(self, step: int, state: Any) -> None:
         import orbax.checkpoint as ocp
 
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
         self._mgr.wait_until_finished()
+        if saved is False:
+            # Orbax declines silently (e.g. the step dir already
+            # exists); treating that as success would drop training
+            # progress on the floor — resume would restore older state
+            raise RuntimeError(
+                f"checkpoint save at step {step} under {self.directory} "
+                f"was skipped by the manager (step already present?)")
 
     def restore(self, step: Optional[int] = None,
                 template: Optional[Any] = None) -> Any:
@@ -61,15 +75,132 @@ class TrainCheckpointer:
                 step, args=ocp.args.StandardRestore(template))
         return self._mgr.restore(step)
 
+    def restore_latest_compatible(
+            self, template: Any) -> Tuple[Any, int]:
+        """Restore the newest step whose shapes match ``template``.
+
+        Walks steps newest→oldest so a save truncated by the crash
+        being recovered from falls back to the previous good step.
+        Returns ``(state, step)``. Raises:
+
+        - ``FileNotFoundError`` — no checkpoints exist;
+        - ``CheckpointGeometryError`` — every step restored cleanly but
+          with mismatched shapes (confirmed stale geometry from an
+          earlier run: the caller should ``clear()`` so the stale
+          ``latest_step`` cannot shadow the fresh run's saves);
+        - the underlying read error otherwise — a transient failure
+          (IO hiccup, interrupted read) must NOT be treated as
+          staleness: the checkpoints stay intact for the next attempt
+          instead of being wiped into a silent full retrain.
+        """
+        import jax
+        import numpy as np
+
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        # Stage-1 comparison is a sorted shape MULTISET: the template
+        # may be a typed pytree (namedtuple optimizer states) whose
+        # flatten order differs from the plain-dict tree Orbax metadata
+        # returns. Stage 3 below re-checks positionally.
+        t_shapes = sorted(tuple(np.asarray(leaf).shape)
+                          for leaf in jax.tree.leaves(template))
+        mismatches = 0
+        last_err: Optional[Exception] = None
+        import orbax.checkpoint as ocp
+
+        reader = ocp.StandardCheckpointer()
+        for step in steps:
+            # Stage 1 — compare saved SHAPES from checkpoint metadata
+            # (no payload read): mismatch here is confirmed staleness,
+            # cheap and unaffected by IO flakiness on the data files.
+            # (Read directly off the step dir: CheckpointManager's
+            # item_metadata returns None from a fresh manager that has
+            # not yet seen the item's handler.)
+            try:
+                meta = reader.metadata(
+                    os.path.join(self.directory, str(step), "default"))
+                item_meta = getattr(meta, "item_metadata", meta)
+                if item_meta is None:
+                    # structure present but the step metadata is gone —
+                    # a torn/corrupted step, not stale geometry
+                    raise OSError(
+                        f"checkpoint step {step} under {self.directory} "
+                        f"has unreadable metadata (torn save?)")
+                m_shapes = sorted(tuple(getattr(leaf, "shape", ()) or ())
+                                  for leaf in jax.tree.leaves(item_meta))
+            except Exception as exc:  # noqa: BLE001 — per-step fallback
+                last_err = exc
+                continue
+            if m_shapes != t_shapes:
+                mismatches += 1
+                continue
+            # Stage 2 — shapes agree: actually read the payload. A
+            # failure here is a torn/corrupt save or IO error, never
+            # geometry.
+            try:
+                state = self.restore(step, template=template)
+            except Exception as exc:  # noqa: BLE001 — per-step fallback
+                last_err = exc
+                continue
+            # belt + braces: Orbax restores differently-shaped arrays
+            # into a concrete template without raising. POSITIONAL
+            # comparison here — ``state`` shares the template's tree
+            # structure, so leaf order matches, and a permutation of
+            # the template's shapes (e.g. swapped tower embeddings)
+            # must count as a mismatch, not slip through a multiset.
+            s_leaves = jax.tree.leaves(state)
+            t_leaves = jax.tree.leaves(template)
+            if (len(s_leaves) != len(t_leaves)
+                    or any(np.asarray(a).shape != np.asarray(b).shape
+                           for a, b in zip(s_leaves, t_leaves))):
+                mismatches += 1
+                continue
+            # Prune the newer steps we skipped (torn or stale): Orbax's
+            # save() silently no-ops (returns False) on an existing
+            # step dir, so leaving them would mean the resumed run's
+            # progress at those steps never persists and every future
+            # resume falls back to this same older step again.
+            newer = [s for s in steps if s > step]
+            if newer:
+                import shutil
+
+                for bad in newer:
+                    try:
+                        self._mgr.delete(bad)
+                    except Exception:  # noqa: BLE001 — torn step dirs
+                        shutil.rmtree(
+                            os.path.join(self.directory, str(bad)),
+                            ignore_errors=True)
+                # restart the manager so its in-memory step cache
+                # cannot keep serving the pruned steps
+                self._mgr.close()
+                self._mgr = ocp.CheckpointManager(
+                    self.directory,
+                    options=ocp.CheckpointManagerOptions(
+                        max_to_keep=self._keep),
+                )
+            return state, int(step)
+        if last_err is None and mismatches > 0:
+            raise CheckpointGeometryError(
+                f"all {mismatches} checkpoint step(s) under "
+                f"{self.directory} have shapes incompatible with the "
+                f"requested template")
+        # At least one step failed to even read. Surface it rather than
+        # destroy possibly-valid state; an operator can clear() (or
+        # delete the dir) if the data really is gone.
+        raise last_err  # type: ignore[misc]
+
     def clear(self) -> None:
         """Delete every checkpoint and start the manager over.
 
-        Used when a restore fails (stale geometry from an earlier run,
-        or a save truncated by the crash being recovered from): the
-        fresh run's saves restart at low step numbers, and Orbax's
-        ``latest_step`` would keep pointing at the stale higher step —
-        every later resume would restore the bad checkpoint again and
-        silently retrain from scratch forever."""
+        Only call this on *confirmed* staleness
+        (``CheckpointGeometryError``): the fresh run's saves restart at
+        low step numbers, and Orbax's ``latest_step`` would keep
+        pointing at the stale higher step — every later resume would
+        restore the bad checkpoint again and silently retrain from
+        scratch forever. Never call it on transient read errors; that
+        destroys valid checkpoints."""
         import shutil
 
         import orbax.checkpoint as ocp
