@@ -6,7 +6,10 @@ the whole event log in one process's memory. This framework's round-2
 read path materialized every event as a Python object in a list before
 converting — ~1 KB per event of transient host memory, and a hard
 ceiling at host RAM (SURVEY.md §2d C4 asks for the opposite: chunked
-host→HBM ``device_put``, double-buffered).
+host→HBM ``device_put``, double-buffered). As of round 4 every
+ALS-family template (recommendation, similarproduct, ecommerce) and
+two-tower reads through this module; the per-event object lists are
+gone from the training path.
 
 Three layers, each usable alone:
 
@@ -140,6 +143,39 @@ def read_interactions(
             yield u[keep], i[keep], vals[keep]
 
     return InteractionData(user_ids, item_ids, chunk_factory, n_events)
+
+
+def subset_columnar(
+    mask: np.ndarray,
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    user_ids: BiMap,
+    item_ids: BiMap,
+    *values: np.ndarray,
+) -> tuple:
+    """Rows where ``mask`` holds, with both vocabularies TRIMMED to the
+    entities present and the index columns re-mapped to the trimmed
+    maps. The eval-fold primitive shared by the ALS-family templates:
+    a training fold must NOT know the held-out fold's cold users/items
+    (they would score 0.0 instead of being skipped by the
+    OptionAverageMetric convention).
+
+    Returns ``(user_idx, item_idx, user_ids, item_ids, *values)`` with
+    each extra ``values`` column masked alongside.
+    """
+    uu, ii = user_idx[mask], item_idx[mask]
+    uniq_u = np.unique(uu)
+    uniq_i = np.unique(ii)
+    lut_u = np.full(len(user_ids), -1, np.int32)
+    lut_u[uniq_u] = np.arange(len(uniq_u), dtype=np.int32)
+    lut_i = np.full(len(item_ids), -1, np.int32)
+    lut_i[uniq_i] = np.arange(len(uniq_i), dtype=np.int32)
+    u_inv = user_ids.inverse()
+    i_inv = item_ids.inverse()
+    return (lut_u[uu], lut_i[ii],
+            BiMap({u_inv[int(u)]: int(j) for j, u in enumerate(uniq_u)}),
+            BiMap({i_inv[int(i)]: int(j) for j, i in enumerate(uniq_i)}),
+            *(v[mask] for v in values))
 
 
 class DevicePrefetcher:

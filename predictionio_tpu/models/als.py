@@ -1048,6 +1048,25 @@ def _gather_score_topk(U, Vp, user_ids, *, k: int, n_valid: int,
     return packed[..., :k], packed[..., k:].astype(np.int32)
 
 
+_SERVE_MIN_ITEMS = 2048
+
+
+def maybe_resident_scorer(U, V, cached=None):
+    """Serving-path policy shared by the ALS-family templates: a lazy
+    device-resident :class:`ResidentScorer` for production-size
+    catalogs (≥ ``_SERVE_MIN_ITEMS`` items), None (→ host numpy
+    scoring) below that, where a matvec beats a device dispatch and
+    tests/demos stay free of compile time. ``PIO_ALS_SERVE`` overrides:
+    "host" forces None, "device" forces a scorer. Pass the previous
+    return value as ``cached`` so the scorer is built once per model.
+    """
+    mode = os.environ.get("PIO_ALS_SERVE", "auto")
+    if mode == "host" or (mode == "auto"
+                          and V.shape[0] < _SERVE_MIN_ITEMS):
+        return None
+    return cached if cached is not None else ResidentScorer(U, V)
+
+
 class ResidentScorer:
     """Serving-time scorer with factors resident on device.
 

@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import io
 import pickle
-from collections import Counter
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set
 
@@ -51,9 +50,42 @@ class DataSourceParams:
 
 @dataclass
 class TrainingData:
+    """Columnar, index-mapped (user, item, weight) interactions
+    (streaming read — ``data/pipeline.read_interactions``; O(chunk +
+    vocab) transient host memory, event order preserved for the
+    leave-one-out eval split). ``interactions`` materializes string
+    tuples lazily for small-data consumers."""
+
     app_name: str
-    interactions: List[tuple]  # (user, item, weight)
+    user_idx: np.ndarray   # int32 [n], event order
+    item_idx: np.ndarray   # int32 [n]
+    weight: np.ndarray     # float32 [n] (buys count harder)
+    user_ids: BiMap
+    item_ids: BiMap
     item_categories: Dict[str, List[str]]
+
+    @property
+    def n(self) -> int:
+        return int(self.user_idx.shape[0])
+
+    @property
+    def interactions(self) -> List[tuple]:
+        u_inv = self.user_ids.inverse()
+        i_inv = self.item_ids.inverse()
+        return [(u_inv[int(u)], i_inv[int(i)], float(w))
+                for u, i, w in zip(self.user_idx, self.item_idx,
+                                   self.weight)]
+
+    def subset(self, mask: np.ndarray) -> "TrainingData":
+        """Rows where ``mask`` holds, vocabularies trimmed (eval-fold
+        cold-entity rule — see ``data/pipeline.subset_columnar``)."""
+        from predictionio_tpu.data.pipeline import subset_columnar
+
+        uu, ii, u_ids, i_ids, ww = subset_columnar(
+            mask, self.user_idx, self.item_idx,
+            self.user_ids, self.item_ids, self.weight)
+        return TrainingData(self.app_name, uu, ii, ww, u_ids, i_ids,
+                            self.item_categories)
 
 
 class ECommDataSource(DataSource):
@@ -66,41 +98,40 @@ class ECommDataSource(DataSource):
         exclusion reads the event store, which still contains the
         held-out event."""
         td = self.read_training(ctx)
-        last = {}
-        cnt = {}
-        for idx, (u, _i, _w) in enumerate(td.interactions):
-            last[u] = idx
-            cnt[u] = cnt.get(u, 0) + 1
-        held = sorted(idx for u, idx in last.items() if cnt[u] >= 2)
-        if not held:
+        n_u = len(td.user_ids)
+        counts = np.bincount(td.user_idx, minlength=n_u)
+        last_row = np.full(n_u, -1, np.int64)
+        last_row[td.user_idx] = np.arange(td.n)  # later rows overwrite
+        held = np.sort(last_row[(last_row >= 0) & (counts >= 2)])
+        if held.size == 0:
             raise ValueError("no user has >= 2 interactions to hold out")
-        held_set = set(held)
-        keep = [pr for idx, pr in enumerate(td.interactions)
-                if idx not in held_set]
-        qa = [({"user": td.interactions[idx][0], "num": 10},
-               td.interactions[idx][1]) for idx in held]
-        return [(TrainingData(td.app_name, keep, td.item_categories),
-                 {"fold": 0}, qa)]
+        keep_mask = np.ones(td.n, bool)
+        keep_mask[held] = False
+        u_inv = td.user_ids.inverse()
+        i_inv = td.item_ids.inverse()
+        qa = [({"user": u_inv[int(td.user_idx[j])], "num": 10},
+               i_inv[int(td.item_idx[j])]) for j in held]
+        return [(td.subset(keep_mask), {"fold": 0}, qa)]
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        from predictionio_tpu.data.pipeline import read_interactions
+
         p: DataSourceParams = self.params
-        inter = []
-        for e in event_store.find(
-            p.app_name, entity_type="user", target_entity_type="item",
-            event_names=p.event_names, storage=ctx.storage,
-        ):
-            if e.target_entity_id is None:
-                continue
-            weight = 4.0 if e.event == "buy" else 1.0  # buys count harder
-            inter.append((e.entity_id, e.target_entity_id, weight))
-        if not inter:
+        data = read_interactions(
+            lambda: event_store.find(
+                p.app_name, entity_type="user", target_entity_type="item",
+                event_names=p.event_names, storage=ctx.storage),
+            value_fn=lambda e: 4.0 if e.event == "buy" else 1.0)
+        uu, ii, ww = data.arrays()
+        if uu.size == 0:
             raise ValueError("no view/buy events found")
         cats = {
             entity_id: list(props.get("categories") or [])
             for entity_id, props in event_store.aggregate_properties(
                 p.app_name, "item", storage=ctx.storage).items()
         }
-        return TrainingData(p.app_name, inter, cats)
+        return TrainingData(p.app_name, uu, ii, ww,
+                            data.user_ids, data.item_ids, cats)
 
 
 @dataclass
@@ -129,6 +160,15 @@ class ECommModel:
         self.popularity = popularity  # per item index, for cold start
         self.app_name = app_name
         self.params = params
+        self._scorer = None
+
+    def _device_scorer(self):
+        """Lazy device-resident scorer for production-size catalogs
+        (shared policy: ``models/als.maybe_resident_scorer``)."""
+        from predictionio_tpu.models.als import maybe_resident_scorer
+
+        self._scorer = maybe_resident_scorer(self.U, self.V, self._scorer)
+        return self._scorer
 
     # -- live lookups (host-side, storage at serving time) --------------------
 
@@ -163,9 +203,12 @@ class ECommModel:
 
         uidx = self.user_ids.get(user)
         if uidx is not None:
-            top, scores = recommend(self.U, self.V, uidx,
-                                    min(len(self.item_ids),
-                                        num + len(banned) + 50))
+            fetch = min(len(self.item_ids), num + len(banned) + 50)
+            scorer = self._device_scorer()
+            if scorer is not None:
+                top, scores = scorer.recommend(uidx, fetch)
+            else:
+                top, scores = recommend(self.U, self.V, uidx, fetch)
             ranked = [(self._inv[int(i)], float(s)) for i, s in zip(top, scores)]
         else:
             # cold start: popularity fallback (reference behavior)
@@ -191,28 +234,29 @@ class ECommAlgorithm(Algorithm):
     ParamsClass = ECommAlgorithmParams
 
     def sanity_check(self, data: TrainingData) -> None:
-        if not data.interactions:
+        if data.n == 0:
             raise ValueError("empty interactions")
 
     def train(self, ctx: WorkflowContext, pd: TrainingData) -> ECommModel:
         p: ECommAlgorithmParams = self.params
-        user_ids = BiMap.string_int(u for u, _, _ in pd.interactions)
-        item_ids = BiMap.string_int(i for _, i, _ in pd.interactions)
-        agg: Counter = Counter()
-        for u, i, w in pd.interactions:
-            agg[(user_ids[u], item_ids[i])] += w
-        uu = np.fromiter((k[0] for k in agg), np.int32, len(agg))
-        ii = np.fromiter((k[1] for k in agg), np.int32, len(agg))
-        vv = np.fromiter(agg.values(), np.float32, len(agg))
-        coo = RatingsCOO(uu, ii, vv, len(user_ids), len(item_ids))
+        # weight aggregation by linearized (user, item) pair — the
+        # vectorized Counter (no per-event Python objects)
+        n_items = len(pd.item_ids)
+        lin = pd.user_idx.astype(np.int64) * n_items + pd.item_idx
+        uniq, inv = np.unique(lin, return_inverse=True)
+        vv = np.bincount(inv, weights=pd.weight).astype(np.float32)
+        ii = (uniq % n_items).astype(np.int32)
+        coo = RatingsCOO((uniq // n_items).astype(np.int32), ii, vv,
+                         len(pd.user_ids), n_items)
         U, V = als_train(
             coo,
             ALSParams(rank=p.rank, iterations=p.num_iterations, reg=p.lambda_,
                       implicit=True, alpha=p.alpha,
                       seed=0 if p.seed is None else p.seed),
             mesh=ctx.mesh)
-        popularity = np.bincount(ii, weights=vv, minlength=len(item_ids))
-        return ECommModel(U, V, user_ids, item_ids, pd.item_categories,
+        popularity = np.bincount(ii, weights=vv, minlength=n_items)
+        return ECommModel(U, V, pd.user_ids, pd.item_ids,
+                          pd.item_categories,
                           popularity.astype(np.float32), pd.app_name, p)
 
     def predict(self, model: ECommModel, query: Dict[str, Any]) -> Dict[str, Any]:

@@ -53,7 +53,58 @@ class Rating:
 
 @dataclass
 class TrainingData:
-    ratings: List[Rating]
+    """Columnar, index-mapped interactions + id vocabularies.
+
+    Built by the STREAMING read path (``data/pipeline.read_interactions``
+    — the RDD-partition read analogue, SURVEY.md §3.1/§2d C4): the read
+    holds O(chunk + vocabulary) transient host memory instead of the
+    round-2 ~1 KB/event ``List[Rating]`` materialization; what remains
+    is the 12 B/event columnar result ALS consumes directly.
+
+    ``ratings`` materializes Rating objects lazily for small-data
+    consumers (tests, debugging) — avoid it on large datasets.
+    """
+
+    user_idx: np.ndarray   # int32 [n]
+    item_idx: np.ndarray   # int32 [n]
+    rating: np.ndarray     # float32 [n]
+    user_ids: BiMap
+    item_ids: BiMap
+
+    @property
+    def n(self) -> int:
+        return int(self.user_idx.shape[0])
+
+    @property
+    def ratings(self) -> List[Rating]:
+        u_inv = self.user_ids.inverse()
+        i_inv = self.item_ids.inverse()
+        return [Rating(u_inv[int(u)], i_inv[int(i)], float(r))
+                for u, i, r in zip(self.user_idx, self.item_idx,
+                                   self.rating)]
+
+    @classmethod
+    def from_ratings(cls, ratings: List[Rating]) -> "TrainingData":
+        user_ids = BiMap.string_int(r.user for r in ratings)
+        item_ids = BiMap.string_int(r.item for r in ratings)
+        return cls(
+            np.fromiter((user_ids[r.user] for r in ratings), np.int32,
+                        len(ratings)),
+            np.fromiter((item_ids[r.item] for r in ratings), np.int32,
+                        len(ratings)),
+            np.fromiter((r.rating for r in ratings), np.float32,
+                        len(ratings)),
+            user_ids, item_ids)
+
+    def subset(self, mask: np.ndarray) -> "TrainingData":
+        """Rows where ``mask`` holds, vocabularies trimmed (eval-fold
+        cold-entity rule — see ``data/pipeline.subset_columnar``)."""
+        from predictionio_tpu.data.pipeline import subset_columnar
+
+        uu, ii, u_ids, i_ids, rr = subset_columnar(
+            mask, self.user_idx, self.item_idx,
+            self.user_ids, self.item_ids, self.rating)
+        return TrainingData(uu, ii, rr, u_ids, i_ids)
 
 
 @dataclass
@@ -72,47 +123,59 @@ class DataSourceParams:
 class RecDataSource(SelfCleaningDataSource, DataSource):
     ParamsClass = DataSourceParams
 
-    def _read_ratings(self, ctx: WorkflowContext) -> List[Rating]:
+    def _read(self, ctx: WorkflowContext) -> TrainingData:
+        """Stream the event store into columnar TrainingData — two
+        passes over ``find()`` (vocabulary, then data), O(chunk) Event
+        objects alive at any moment (``data/pipeline``)."""
+        from predictionio_tpu.data.pipeline import read_interactions
+
         p: DataSourceParams = self.params
-        out: List[Rating] = []
-        for e in event_store.find(
-            p.app_name,
-            entity_type="user",
-            target_entity_type="item",
-            event_names=p.event_names,
-            storage=ctx.storage,
-        ):
+
+        def value(e) -> Optional[float]:
             if e.event == "rate":
                 try:
-                    r = float(e.properties["rating"])
+                    return float(e.properties["rating"])
                 except (KeyError, TypeError, ValueError):
-                    continue
-            else:  # implicit positive event ("buy")
-                r = p.buy_rating
-            assert e.target_entity_id is not None
-            out.append(Rating(e.entity_id, e.target_entity_id, r))
-        return out
+                    return None  # malformed rating: skip the event
+            return p.buy_rating  # implicit positive event ("buy")
+
+        data = read_interactions(
+            lambda: event_store.find(
+                p.app_name,
+                entity_type="user",
+                target_entity_type="item",
+                event_names=p.event_names,
+                storage=ctx.storage,
+            ),
+            value_fn=value,
+        )
+        uu, ii, rr = data.arrays()
+        return TrainingData(uu, ii, rr, data.user_ids, data.item_ids)
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
         self.clean(ctx, self.params.app_name)
-        ratings = self._read_ratings(ctx)
-        if not ratings:
+        td = self._read(ctx)
+        if td.n == 0:
             raise ValueError(
                 "no rate/buy events found; import events before `pio train`")
-        return TrainingData(ratings)
+        return td
 
     def read_eval(self, ctx: WorkflowContext):
         p: DataSourceParams = self.params
         if p.eval_k <= 0:
             raise ValueError("set dataSourceParams.evalK > 0 to evaluate")
-        ratings = self._read_ratings(ctx)
+        td = self._read(ctx)
         rng = np.random.default_rng(p.eval_seed)
-        fold_of = rng.integers(0, p.eval_k, size=len(ratings))
+        fold_of = rng.integers(0, p.eval_k, size=td.n)
+        u_inv = td.user_ids.inverse()
+        i_inv = td.item_ids.inverse()
         folds = []
         for f in range(p.eval_k):
-            train = TrainingData([r for r, g in zip(ratings, fold_of) if g != f])
-            test = [r for r, g in zip(ratings, fold_of) if g == f]
-            qa = [({"user": r.user, "item": r.item, "num": 1}, r.rating) for r in test]
+            train = td.subset(fold_of != f)
+            test = np.nonzero(fold_of == f)[0]
+            qa = [({"user": u_inv[int(td.user_idx[j])],
+                    "item": i_inv[int(td.item_idx[j])], "num": 1},
+                   float(td.rating[j])) for j in test]
             folds.append((train, {"fold": f}, qa))
         return folds
 
@@ -141,7 +204,17 @@ class ALSAlgorithmParams:
 
 
 class ALSModel:
-    """Resident serving model: factor matrices + id↔index BiMaps."""
+    """Resident serving model: factor matrices + id↔index BiMaps.
+
+    Serving is DEVICE-RESIDENT for production-size catalogs: the first
+    query builds a lazy :class:`~predictionio_tpu.models.als.ResidentScorer`
+    (U and V live in HBM across requests; each query is one fused
+    gather→score→top-k dispatch with a single packed fetch — the
+    reference keeps MatrixFactorizationModel in JVM heap, [U] MLlib
+    recommendProducts). Tiny catalogs score host-side instead; policy
+    + ``PIO_ALS_SERVE`` override live in
+    ``models/als.maybe_resident_scorer`` (shared with e-commerce).
+    """
 
     def __init__(self, U: np.ndarray, V: np.ndarray,
                  user_ids: BiMap, item_ids: BiMap) -> None:
@@ -150,12 +223,23 @@ class ALSModel:
         self.user_ids = user_ids
         self.item_ids = item_ids
         self._item_inv = item_ids.inverse()
+        self._scorer = None
+
+    def _device_scorer(self):
+        from predictionio_tpu.models.als import maybe_resident_scorer
+
+        self._scorer = maybe_resident_scorer(self.U, self.V, self._scorer)
+        return self._scorer
 
     def recommend_products(self, user: str, num: int) -> List[Dict[str, Any]]:
         uidx = self.user_ids.get(user)
         if uidx is None:
             return []
-        top, scores = recommend(self.U, self.V, uidx, num)
+        scorer = self._device_scorer()
+        if scorer is not None:
+            top, scores = scorer.recommend(uidx, num)
+        else:
+            top, scores = recommend(self.U, self.V, uidx, num)
         return [
             {"item": self._item_inv[int(i)], "score": float(s)}
             for i, s in zip(top, scores)
@@ -173,24 +257,21 @@ class ALSAlgorithm(Algorithm):
     ParamsClass = ALSAlgorithmParams
 
     def sanity_check(self, data: TrainingData) -> None:
-        if not data.ratings:
-            raise ValueError("empty TrainingData.ratings")
+        if data.n == 0:
+            raise ValueError("empty TrainingData")
 
     @staticmethod
     def _to_coo(pd: TrainingData):
-        user_ids = BiMap.string_int(r.user for r in pd.ratings)
-        item_ids = BiMap.string_int(r.item for r in pd.ratings)
+        # the streaming read already index-mapped everything: this is a
+        # zero-copy repackaging, not a conversion
         coo = RatingsCOO(
-            user_idx=np.fromiter((user_ids[r.user] for r in pd.ratings),
-                                 np.int32, len(pd.ratings)),
-            item_idx=np.fromiter((item_ids[r.item] for r in pd.ratings),
-                                 np.int32, len(pd.ratings)),
-            rating=np.fromiter((r.rating for r in pd.ratings),
-                               np.float32, len(pd.ratings)),
-            n_users=len(user_ids),
-            n_items=len(item_ids),
+            user_idx=pd.user_idx,
+            item_idx=pd.item_idx,
+            rating=pd.rating,
+            n_users=len(pd.user_ids),
+            n_items=len(pd.item_ids),
         )
-        return coo, user_ids, item_ids
+        return coo, pd.user_ids, pd.item_ids
 
     @staticmethod
     def _als_params(p: ALSAlgorithmParams) -> ALSParams:

@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import io
 import pickle
-from collections import Counter
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -50,53 +49,86 @@ class DataSourceParams:
 
 @dataclass
 class TrainingData:
-    views: List[tuple]             # (user, item) pairs
+    """Columnar, index-mapped view events (streaming read — see
+    ``data/pipeline.read_interactions``; O(chunk + vocab) transient
+    host memory, event ORDER preserved for the last-view eval split).
+    ``views`` materializes (user, item) string pairs lazily for
+    small-data consumers."""
+
+    user_idx: np.ndarray   # int32 [n], event order
+    item_idx: np.ndarray   # int32 [n]
+    user_ids: BiMap
+    item_ids: BiMap
     item_categories: Dict[str, List[str]]  # from $set item properties
+
+    @property
+    def n(self) -> int:
+        return int(self.user_idx.shape[0])
+
+    @property
+    def views(self) -> List[tuple]:
+        u_inv = self.user_ids.inverse()
+        i_inv = self.item_ids.inverse()
+        return [(u_inv[int(u)], i_inv[int(i)])
+                for u, i in zip(self.user_idx, self.item_idx)]
+
+    def subset(self, mask: np.ndarray) -> "TrainingData":
+        """Rows where ``mask`` holds, vocabularies trimmed (eval-fold
+        cold-entity rule — see ``data/pipeline.subset_columnar``)."""
+        from predictionio_tpu.data.pipeline import subset_columnar
+
+        uu, ii, u_ids, i_ids = subset_columnar(
+            mask, self.user_idx, self.item_idx,
+            self.user_ids, self.item_ids)
+        return TrainingData(uu, ii, u_ids, i_ids, self.item_categories)
 
 
 class SimilarProductDataSource(DataSource):
     ParamsClass = DataSourceParams
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        from predictionio_tpu.data.pipeline import read_interactions
+
         p: DataSourceParams = self.params
-        views = [
-            (e.entity_id, e.target_entity_id)
-            for e in event_store.find(
+        data = read_interactions(
+            lambda: event_store.find(
                 p.app_name, entity_type="user", target_entity_type="item",
-                event_names=p.event_names, storage=ctx.storage)
-            if e.target_entity_id is not None
-        ]
-        if not views:
+                event_names=p.event_names, storage=ctx.storage))
+        uu, ii, _ones = data.arrays()
+        if uu.size == 0:
             raise ValueError("no view events found; import events before training")
         cats = {
             entity_id: list(props.get("categories") or [])
             for entity_id, props in event_store.aggregate_properties(
                 p.app_name, "item", storage=ctx.storage).items()
         }
-        return TrainingData(views, cats)
+        return TrainingData(uu, ii, data.user_ids, data.item_ids, cats)
 
     def read_eval(self, ctx: WorkflowContext):
         """Item-to-item retrieval protocol: each user's LAST viewed
         item is held out; the query carries the user's remaining items
         and the held-out one must rank in the top-k similars."""
         td = self.read_training(ctx)
-        last = {}
-        cnt = {}
-        for idx, (u, _i) in enumerate(td.views):
-            last[u] = idx
-            cnt[u] = cnt.get(u, 0) + 1
-        held = sorted(idx for u, idx in last.items() if cnt[u] >= 3)
-        if not held:
+        n_u = len(td.user_ids)
+        counts = np.bincount(td.user_idx, minlength=n_u)
+        last_row = np.full(n_u, -1, np.int64)
+        last_row[td.user_idx] = np.arange(td.n)  # later rows overwrite
+        held = np.sort(last_row[(last_row >= 0) & (counts >= 3)])
+        if held.size == 0:
             raise ValueError("no user has >= 3 views to hold one out")
-        held_set = set(held)
-        keep = [pr for idx, pr in enumerate(td.views)
-                if idx not in held_set]
-        by_user = {}
-        for u, i in keep:
-            by_user.setdefault(u, []).append(i)
-        qa = [({"items": by_user[td.views[idx][0]], "num": 10},
-               td.views[idx][1]) for idx in held]
-        return [(TrainingData(keep, td.item_categories), {"fold": 0}, qa)]
+        keep_mask = np.ones(td.n, bool)
+        keep_mask[held] = False
+        u_inv = td.user_ids.inverse()
+        i_inv = td.item_ids.inverse()
+        held_users = set(td.user_idx[held].tolist())
+        by_user: Dict[int, List[str]] = {}
+        for u, i in zip(td.user_idx[keep_mask].tolist(),
+                        td.item_idx[keep_mask].tolist()):
+            if u in held_users:
+                by_user.setdefault(u, []).append(i_inv[i])
+        qa = [({"items": by_user[int(td.user_idx[j])], "num": 10},
+               i_inv[int(td.item_idx[j])]) for j in held]
+        return [(td.subset(keep_mask), {"fold": 0}, qa)]
 
 
 @dataclass
@@ -149,25 +181,27 @@ class ALSAlgorithm(Algorithm):
     ParamsClass = ALSAlgorithmParams
 
     def sanity_check(self, data: TrainingData) -> None:
-        if not data.views:
+        if data.n == 0:
             raise ValueError("empty view data")
 
     def train(self, ctx: WorkflowContext, pd: TrainingData) -> SimilarProductModel:
         p: ALSAlgorithmParams = self.params
-        user_ids = BiMap.string_int(u for u, _ in pd.views)
-        item_ids = BiMap.string_int(i for _, i in pd.views)
-        counts = Counter((user_ids[u], item_ids[i]) for u, i in pd.views)
-        uu = np.fromiter((k[0] for k in counts), np.int32, len(counts))
-        ii = np.fromiter((k[1] for k in counts), np.int32, len(counts))
-        vv = np.fromiter(counts.values(), np.float32, len(counts))
-        coo = RatingsCOO(uu, ii, vv, len(user_ids), len(item_ids))
+        # repeat-view counts by linearized (user, item) pair — the
+        # vectorized Counter (no per-event Python objects)
+        n_items = len(pd.item_ids)
+        lin = pd.user_idx.astype(np.int64) * n_items + pd.item_idx
+        uniq, cnt = np.unique(lin, return_counts=True)
+        coo = RatingsCOO((uniq // n_items).astype(np.int32),
+                         (uniq % n_items).astype(np.int32),
+                         cnt.astype(np.float32),
+                         len(pd.user_ids), n_items)
         _, V = als_train(
             coo,
             ALSParams(rank=p.rank, iterations=p.num_iterations, reg=p.lambda_,
                       implicit=True, alpha=p.alpha,
                       seed=0 if p.seed is None else p.seed),
             mesh=ctx.mesh)
-        return SimilarProductModel(V, item_ids, pd.item_categories)
+        return SimilarProductModel(V, pd.item_ids, pd.item_categories)
 
     def predict(self, model: SimilarProductModel, query: Dict[str, Any]) -> Dict[str, Any]:
         return {"itemScores": model.query(

@@ -81,6 +81,74 @@ class TestReadInteractions:
         assert max(sizes) <= 4 and sum(sizes) == 23
 
 
+class TestTemplateStreamingReads:
+    """VERDICT r3 #4: the ALS-family templates read via the streaming
+    pipeline — O(chunk + vocab) transient host memory, no per-event
+    Python Rating/tuple objects."""
+
+    @staticmethod
+    def _synthetic_find(n_events, n_users=500, n_items=300):
+        from predictionio_tpu.data.event import Event
+
+        def find(*_a, **_k):
+            rng = np.random.default_rng(0)
+            for k in range(n_events):
+                u = int(rng.integers(0, n_users))
+                i = int(rng.integers(0, n_items))
+                yield Event(event="rate", entity_type="user",
+                            entity_id=f"u{u}", target_entity_type="item",
+                            target_entity_id=f"i{i}",
+                            properties={"rating": float(1 + k % 5)})
+        return find
+
+    def test_recommendation_read_is_o_chunk(self, monkeypatch):
+        """100k synthetic events through RecDataSource._read: peak
+        traced allocation stays within a few chunk-sizes (~MBs), far
+        under the ~1 KB/event of the old List[Rating] path (~100 MB)."""
+        import tracemalloc
+
+        import predictionio_tpu.templates.recommendation.engine as rec
+
+        monkeypatch.setattr(rec.event_store, "find",
+                            self._synthetic_find(100_000))
+        # the lazy Rating compat path must never run during the read
+        monkeypatch.setattr(
+            rec, "Rating",
+            lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError("streaming read materialized a Rating")))
+        ds = rec.RecDataSource(rec.DataSourceParams(app_name="x"))
+        from predictionio_tpu.controller.base import WorkflowContext
+
+        tracemalloc.start()
+        td = ds._read(WorkflowContext(storage=None))
+        _cur, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        assert td.n == 100_000
+        assert td.rating.dtype == np.float32
+        # columnar result ≈ 1.2 MB; chunk lists + vocab add a few MB.
+        # The old path held ~100k Event + 100k Rating objects (>100 MB).
+        assert peak < 40 * 1024 * 1024, f"peak {peak/1e6:.1f} MB"
+
+    def test_recommendation_streaming_matches_list_path(self, monkeypatch):
+        """Index-mapped output equals the naive list-built reference."""
+        import predictionio_tpu.templates.recommendation.engine as rec
+        from predictionio_tpu.controller.base import WorkflowContext
+
+        find = self._synthetic_find(2_000, n_users=40, n_items=30)
+        monkeypatch.setattr(rec.event_store, "find", find)
+        ds = rec.RecDataSource(rec.DataSourceParams(app_name="x"))
+        td = ds._read(WorkflowContext(storage=None))
+
+        ref = [(e.entity_id, e.target_entity_id,
+                float(e.properties["rating"])) for e in find()]
+        assert td.n == len(ref)
+        u_inv = td.user_ids.inverse()
+        i_inv = td.item_ids.inverse()
+        got = [(u_inv[int(u)], i_inv[int(i)], float(r))
+               for u, i, r in zip(td.user_idx, td.item_idx, td.rating)]
+        assert got == ref
+
+
 class TestDevicePrefetcher:
     def test_order_and_device_placement(self):
         import jax

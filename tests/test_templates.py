@@ -128,6 +128,46 @@ class TestECommerce:
         assert first not in {s["item"] for s in res2["itemScores"]}
 
 
+class TestDeviceResidentServing:
+    """ALSModel serves device-resident for production-size catalogs,
+    host-side for tiny ones; PIO_ALS_SERVE overrides (VERDICT r3 #6 —
+    the docs' ResidentScorer claim is now the template's real path)."""
+
+    def _model(self, n_items):
+        from predictionio_tpu.templates.recommendation.engine import ALSModel
+        from predictionio_tpu.utils.bimap import BiMap
+
+        rng = np.random.default_rng(0)
+        U = rng.standard_normal((10, 4)).astype(np.float32)
+        V = rng.standard_normal((n_items, 4)).astype(np.float32)
+        return ALSModel(U, V, BiMap({str(i): i for i in range(10)}),
+                        BiMap({str(i): i for i in range(n_items)}))
+
+    def test_auto_policy(self, monkeypatch):
+        monkeypatch.delenv("PIO_ALS_SERVE", raising=False)
+        assert self._model(64)._device_scorer() is None
+        big = self._model(4096)
+        assert big._device_scorer() is not None
+        # scorer is built once and reused across queries
+        assert big._device_scorer() is big._device_scorer()
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("PIO_ALS_SERVE", "host")
+        assert self._model(4096)._device_scorer() is None
+        monkeypatch.setenv("PIO_ALS_SERVE", "device")
+        assert self._model(64)._device_scorer() is not None
+
+    def test_device_and_host_paths_agree(self, monkeypatch):
+        m = self._model(4096)
+        monkeypatch.setenv("PIO_ALS_SERVE", "host")
+        host = m.recommend_products("3", 5)
+        monkeypatch.setenv("PIO_ALS_SERVE", "device")
+        dev = m.recommend_products("3", 5)
+        assert [s["item"] for s in host] == [s["item"] for s in dev]
+        np.testing.assert_allclose([s["score"] for s in host],
+                                   [s["score"] for s in dev], rtol=1e-5)
+
+
 class TestRecommendationEvaluation:
     def test_neg_rmse_grid(self, storage):
         """Built-in RecEvaluation: rate events with a planted structure
