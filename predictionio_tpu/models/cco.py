@@ -17,6 +17,16 @@ TPU-first redesign:
   by a per-row ``top_k`` — one fused XLA kernel per block.
 - Output: per-item indicator lists (item → correlated items), the same
   shape the reference indexed into Elasticsearch.
+
+Catalog scale: the dense count matrix C is (n_a, n_b) f32 — 40 GB at
+100k×100k, far past HBM. Above ``CCOParams.dense_c_max_mb`` the
+computation switches to the SPARSE path (r4): co-occurrence counts by
+vectorized per-user pair expansion + ``np.unique`` (C has only
+``Σ_u p_u·s_u`` live entries — ~5M at 1M events, not n_a·n_b), LLR as
+elementwise vector math over those entries, per-row top-k by lexsort.
+Both paths share the Mahout downsampling convention
+(``max_interactions_per_user``, reference maxNumInteractions) that
+bounds a heavy user's quadratic pair contribution.
 """
 
 from __future__ import annotations
@@ -33,6 +43,37 @@ class CCOParams:
     llr_threshold: float = 0.0
     user_chunk: int = 2048
     row_block: int = 4096
+    # Mahout maxNumInteractions: cap a user's interactions per event
+    # type (deterministic subsample). A user with p primary and s
+    # secondary interactions contributes p·s co-occurrence pairs, so an
+    # uncapped power-law head costs quadratic pairs AND adds little
+    # signal (Mahout's rationale).
+    max_interactions_per_user: int = 500
+    # Crossover to the sparse path: if the dense (n_a, n_b) f32 count
+    # matrix would exceed this, co-occurrence runs sparse (see module
+    # docstring). 1 GB keeps the MXU path for catalogs to ~16k×16k.
+    dense_c_max_mb: int = 1024
+
+
+def _downsample_per_user(users: np.ndarray, items: np.ndarray,
+                         cap: int, seed: int = 0
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """Cap each user's interactions at ``cap`` by deterministic
+    subsample (vectorized; order not preserved)."""
+    if cap <= 0 or users.size <= cap:
+        return users, items
+    counts = np.bincount(users)
+    if counts.max(initial=0) <= cap:
+        return users, items
+    # random priority per event, keep a user's `cap` smallest
+    rng = np.random.default_rng(seed)
+    pri = rng.random(users.size)
+    order = np.lexsort((pri, users))          # group by user, random within
+    us = users[order]
+    within = np.arange(users.size) - np.concatenate(
+        ([0], np.cumsum(np.bincount(us))))[us]
+    keep = order[within < cap]
+    return users[keep], items[keep]
 
 
 def _csr_from_pairs(users: np.ndarray, items: np.ndarray, n_users: int,
@@ -78,6 +119,105 @@ def _cooccurrence(primary: Tuple[np.ndarray, np.ndarray],
         C = acc(C, slab(p_indptr, p_idx, start, stop, n_a),
                 slab(s_indptr, s_idx, start, stop, n_b))
     return np.asarray(C)
+
+
+def _cooccurrence_sparse(primary: Tuple[np.ndarray, np.ndarray],
+                         secondary: Tuple[np.ndarray, np.ndarray],
+                         n_users: int, n_b: int
+                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Sparse C = PᵀS: only the live entries, by vectorized per-user
+    pair expansion. Returns (rows, cols, counts) with rows ascending.
+
+    Per user u the pairs are the cross product of u's primary items and
+    u's secondary items — Σ p_u·s_u pairs total (downsampling bounds
+    the per-user quadratic term). Expansion is pure index arithmetic:
+    no Python loop over users, one ``np.unique`` per pair-budget chunk,
+    one final merge."""
+    p_indptr, s_indptr = primary[0], secondary[0]
+    p_idx, s_idx = primary[1], secondary[1]
+    # Chunk by PAIR budget, not user count: per-user cost here is
+    # p_u·s_u (up to cap² = 250k at the default downsampling cap), so a
+    # user-count chunk of cap-heavy users would expand tens of GB of
+    # index arrays at once (r4 review). ~8M pairs ≈ 300 MB transient.
+    all_pairs = (np.diff(p_indptr) * np.diff(s_indptr)).astype(np.int64)
+    cum = np.concatenate(([0], np.cumsum(all_pairs)))
+    budget = max(8_000_000, int(all_pairs.max(initial=0)))
+    bounds = [0]
+    while bounds[-1] < n_users:
+        nxt = int(np.searchsorted(cum, cum[bounds[-1]] + budget,
+                                  side="right")) - 1
+        bounds.append(max(nxt, bounds[-1] + 1))
+    parts = []
+    for start, stop in zip(bounds[:-1], bounds[1:]):
+        p_cnt = np.diff(p_indptr[start:stop + 1])
+        s_cnt = np.diff(s_indptr[start:stop + 1])
+        pairs = p_cnt * s_cnt
+        total = int(pairs.sum())
+        if total == 0:
+            continue
+        seg = np.repeat(np.arange(stop - start), pairs)  # chunk-local user
+        starts = np.concatenate(([0], np.cumsum(pairs)))
+        within = np.arange(total, dtype=np.int64) - starts[seg]
+        p_lo = p_indptr[start:stop][seg] + within // s_cnt[seg]
+        s_lo = s_indptr[start:stop][seg] + within % s_cnt[seg]
+        lin = p_idx[p_lo].astype(np.int64) * n_b + s_idx[s_lo]
+        uniq, cnt = np.unique(lin, return_counts=True)
+        parts.append((uniq, cnt.astype(np.float32)))
+    if not parts:
+        return (np.zeros(0, np.int32), np.zeros(0, np.int32),
+                np.zeros(0, np.float32))
+    lin = np.concatenate([u for u, _ in parts])
+    cnt = np.concatenate([c for _, c in parts])
+    uniq, inv = np.unique(lin, return_inverse=True)
+    counts = np.bincount(inv, weights=cnt).astype(np.float32)
+    return ((uniq // n_b).astype(np.int32), (uniq % n_b).astype(np.int32),
+            counts)
+
+
+def _llr_values(k11, rc, cc, n_users: int) -> np.ndarray:
+    """Dunning LLR for sparse entries (same math as the dense block)."""
+    k11 = k11.astype(np.float64)
+    k12 = np.maximum(rc - k11, 0.0)
+    k21 = np.maximum(cc - k11, 0.0)
+    k22 = np.maximum(n_users - k11 - k12 - k21, 0.0)
+
+    def xlogx(x):
+        return np.where(x > 0, x * np.log(np.where(x > 0, x, 1.0)), 0.0)
+
+    rowe = xlogx(k11 + k12) + xlogx(k21 + k22)
+    cole = xlogx(k11 + k21) + xlogx(k12 + k22)
+    mate = xlogx(k11) + xlogx(k12) + xlogx(k21) + xlogx(k22)
+    return (2.0 * (mate - rowe - cole
+                   + xlogx(np.float64(n_users)))).astype(np.float32)
+
+
+def _llr_topk_sparse(rows: np.ndarray, cols: np.ndarray,
+                     counts: np.ndarray, row_counts: np.ndarray,
+                     col_counts: np.ndarray, n_users: int, n_a: int,
+                     n_b: int, k: int, threshold: float,
+                     same_space: bool) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-row top-k over the sparse LLR entries (lexsort, no dense C).
+    Output matches :func:`_llr_topk`'s shape contract: (n_a, k) index
+    and value arrays, missing entries at llr -inf / index 0."""
+    k = min(k, n_b)
+    if same_space and rows.size:
+        keep = rows != cols
+        rows, cols, counts = rows[keep], cols[keep], counts[keep]
+    llr = _llr_values(counts, row_counts[rows], col_counts[cols], n_users)
+    ok = llr >= threshold
+    rows, cols, llr = rows[ok], cols[ok], llr[ok]
+    out_i = np.zeros((n_a, k), np.int32)
+    out_v = np.full((n_a, k), -np.inf, np.float32)
+    if rows.size:
+        order = np.lexsort((-llr, rows))
+        rs, cs, vs = rows[order], cols[order], llr[order]
+        starts = np.zeros(n_a + 1, np.int64)
+        np.cumsum(np.bincount(rs, minlength=n_a), out=starts[1:])
+        within = np.arange(rs.size) - starts[rs]
+        keep = within < k
+        out_i[rs[keep], within[keep]] = cs[keep]
+        out_v[rs[keep], within[keep]] = vs[keep]
+    return out_i, out_v
 
 
 def _llr_topk(C: np.ndarray, row_counts: np.ndarray, col_counts: np.ndarray,
@@ -145,22 +285,35 @@ def cco_indicators(
     Returns ``{event: (indices [n_items_primary, k], llr scores)}``.
     """
     p = params or CCOParams()
+    cap = p.max_interactions_per_user
+    raw_primary = primary_pairs  # identity check below predates capping
+    primary_pairs = _downsample_per_user(*primary_pairs, cap)
     prim = _csr_from_pairs(*primary_pairs, n_users, n_items_primary)
     prim_item_counts = np.bincount(prim[1], minlength=n_items_primary).astype(np.float32)
 
     out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
     for name, (eu, ei) in event_pairs.items():
         n_b = n_items_by_event[name]
+        same = (name == "__primary__") or (n_b == n_items_primary and
+                                           np.array_equal(ei, raw_primary[1]) and
+                                           np.array_equal(eu, raw_primary[0]))
+        eu, ei = _downsample_per_user(eu, ei, cap)
         sec = _csr_from_pairs(eu, ei, n_users, n_b)
         sec_item_counts = np.bincount(sec[1], minlength=n_b).astype(np.float32)
-        C = _cooccurrence(prim, sec, n_users, n_items_primary, n_b,
-                          p.user_chunk)
-        same = (name == "__primary__") or (n_b == n_items_primary and
-                                           np.array_equal(ei, primary_pairs[1]) and
-                                           np.array_equal(eu, primary_pairs[0]))
-        idxs, vals = _llr_topk(C, prim_item_counts, sec_item_counts, n_users,
-                               p.max_indicators_per_item, p.llr_threshold,
-                               p.row_block, same)
+        if n_items_primary * n_b * 4 > p.dense_c_max_mb << 20:
+            # catalog too large for a dense (n_a, n_b) C — sparse path
+            rows, cols, cnts = _cooccurrence_sparse(
+                prim, sec, n_users, n_b)
+            idxs, vals = _llr_topk_sparse(
+                rows, cols, cnts, prim_item_counts, sec_item_counts,
+                n_users, n_items_primary, n_b,
+                p.max_indicators_per_item, p.llr_threshold, same)
+        else:
+            C = _cooccurrence(prim, sec, n_users, n_items_primary, n_b,
+                              p.user_chunk)
+            idxs, vals = _llr_topk(C, prim_item_counts, sec_item_counts,
+                                   n_users, p.max_indicators_per_item,
+                                   p.llr_threshold, p.row_block, same)
         out[name] = (idxs, vals)
     return out
 

@@ -366,21 +366,37 @@ def cmd_run(args: argparse.Namespace) -> None:
 
 
 def cmd_shell(args: argparse.Namespace) -> None:
-    """Interactive REPL with the framework pre-imported (reference:
-    `pio-shell` opens a spark-shell with PIO on the classpath)."""
+    """Interactive REPL with the framework pre-loaded (reference:
+    `pio-shell --with-pyspark` opens a REPL with a live SparkSession
+    and PIO on the classpath; here the session analogue is the storage
+    + pypio bridge, initialized before the prompt)."""
     import code
 
     import predictionio_tpu
     from predictionio_tpu.data import store
 
-    banner = (f"predictionio_tpu {__version__} shell\n"
-              "preloaded: predictionio_tpu, storage (Storage), store "
-              "(PEventStore/LEventStore API)")
-    code.interact(banner=banner, local={
+    local = {
         "predictionio_tpu": predictionio_tpu,
         "storage": get_storage(),
         "store": store,
-    })
+    }
+    # pypio preloaded and initialized, like the reference shell's ready
+    # SparkSession — find_events()/pd DataFrames work at the prompt
+    pypio_line = "pypio unavailable (import failed)"
+    try:
+        import pypio
+
+        pypio.init()
+        local["pypio"] = pypio
+        pypio_line = ("pypio (initialized: pypio.find_events('<app>') "
+                      "-> DataFrame)")
+    except Exception as e:  # noqa: BLE001 — shell must still open
+        pypio_line = f"pypio unavailable ({e})"
+    banner = (f"predictionio_tpu {__version__} shell\n"
+              "preloaded: predictionio_tpu, storage (Storage), store "
+              f"(PEventStore/LEventStore API), {pypio_line}\n"
+              'try: store.find("MyApp1", limit=3)')
+    code.interact(banner=banner, local=local)
 
 
 # -- parser -------------------------------------------------------------------

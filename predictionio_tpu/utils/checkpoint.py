@@ -110,6 +110,10 @@ class TrainCheckpointer:
         import orbax.checkpoint as ocp
 
         reader = ocp.StandardCheckpointer()
+        # steps proven stale or torn — and ONLY those — may be pruned
+        # after a successful fallback; a step skipped on a possibly
+        # transient error must survive (it may be the best checkpoint)
+        prunable: set = set()
         for step in steps:
             # Stage 1 — compare saved SHAPES from checkpoint metadata
             # (no payload read): mismatch here is confirmed staleness,
@@ -124,6 +128,7 @@ class TrainCheckpointer:
                 if item_meta is None:
                     # structure present but the step metadata is gone —
                     # a torn/corrupted step, not stale geometry
+                    prunable.add(step)
                     raise OSError(
                         f"checkpoint step {step} under {self.directory} "
                         f"has unreadable metadata (torn save?)")
@@ -134,6 +139,7 @@ class TrainCheckpointer:
                 continue
             if m_shapes != t_shapes:
                 mismatches += 1
+                prunable.add(step)
                 continue
             # Stage 2 — shapes agree: actually read the payload. A
             # failure here is a torn/corrupt save or IO error, never
@@ -156,12 +162,15 @@ class TrainCheckpointer:
                            for a, b in zip(s_leaves, t_leaves))):
                 mismatches += 1
                 continue
-            # Prune the newer steps we skipped (torn or stale): Orbax's
+            # Prune newer steps PROVEN torn or stale-geometry: Orbax's
             # save() silently no-ops (returns False) on an existing
             # step dir, so leaving them would mean the resumed run's
             # progress at those steps never persists and every future
-            # resume falls back to this same older step again.
-            newer = [s for s in steps if s > step]
+            # resume falls back to this same older step again. Steps
+            # skipped on other (possibly transient) errors are NOT
+            # deleted — they may be valid; a later save colliding with
+            # one raises loudly in ``save`` instead of losing data.
+            newer = [s for s in steps if s > step and s in prunable]
             if newer:
                 import shutil
 

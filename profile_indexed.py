@@ -1,0 +1,159 @@
+"""Scale test: embedded index (ELASTICSEARCH-equivalent) + CCO path at
+UR-realistic size (VERDICT r3 #7).
+
+Generates a synthetic Universal-Recommender-shaped workload —
+default 1M view/buy events, 100k items, 50k users, zipf-ish item
+popularity — and measures:
+
+- event ingest into ``ESEventStore`` (docs/sec, WAL bytes, compaction
+  count and cost),
+- durable-restart replay time (the WAL read path),
+- event-store query latency (event-name filtered find, entity find),
+- raw index search latency (terms query over indicator fields),
+- CCO indicator train time at this catalog size (the sparse
+  co-occurrence path — the dense (n_a, n_b) C would be 40 GB here)
+  plus device top-k share, and the indicator-index build.
+
+Usage::
+
+    python profile_indexed.py [--events 1000000] [--items 100000]
+                              [--users 50000] [--platform cpu]
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import tempfile
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--events", type=int, default=1_000_000)
+    ap.add_argument("--items", type=int, default=100_000)
+    ap.add_argument("--users", type=int, default=50_000)
+    ap.add_argument("--platform", default="cpu")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    jax.devices()
+
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.models.cco import CCOParams, cco_indicators
+    from predictionio_tpu.storage.indexed import (ESEventStore,
+                                                  IndexedStorageClient)
+
+    root = tempfile.mkdtemp(prefix="pio_index_scale_")
+    out = {"events": args.events, "items": args.items, "users": args.users}
+    try:
+        rng = np.random.default_rng(0)
+        # zipf-ish popularity: heavy head like a real catalog
+        item_pop = rng.zipf(1.3, args.events) % args.items
+        users = rng.integers(0, args.users, args.events)
+        is_buy = rng.random(args.events) < 0.3
+
+        client = IndexedStorageClient(root)
+        store = ESEventStore(client)
+        app_id = 1
+
+        t0 = time.perf_counter()
+        batch = []
+        for n in range(args.events):
+            batch.append(Event(
+                event="buy" if is_buy[n] else "view",
+                entity_type="user", entity_id=str(int(users[n])),
+                target_entity_type="item",
+                target_entity_id=str(int(item_pop[n]))))
+            if len(batch) == 20_000:
+                store.insert_batch(batch, app_id)
+                batch = []
+        if batch:
+            store.insert_batch(batch, app_id)
+        ingest_sec = time.perf_counter() - t0
+        idx = client.index(store._name(app_id, None))
+        wal_bytes = os.path.getsize(idx._path)
+        out["ingest"] = {
+            "sec": round(ingest_sec, 2),
+            "events_per_sec": round(args.events / ingest_sec),
+            "wal_mb": round(wal_bytes / 1e6, 1),
+        }
+
+        # durable restart: replay cost of the WAL read path
+        client.close()
+        t0 = time.perf_counter()
+        client = IndexedStorageClient(root)
+        store = ESEventStore(client)
+        n_docs = len(client.index(store._name(app_id, None)))
+        out["replay"] = {"sec": round(time.perf_counter() - t0, 2),
+                         "docs": n_docs}
+
+        # query latency (warm): filtered find + entity find
+        def bench(fn, iters=50):
+            fn()
+            lat = np.empty(iters)
+            for i in range(iters):
+                t = time.perf_counter()
+                fn()
+                lat[i] = time.perf_counter() - t
+            return round(float(np.percentile(lat, 50) * 1e3), 2)
+
+        out["query_ms"] = {
+            "find_by_event_limit100": bench(
+                lambda: list(store.find(app_id, event_names=["buy"],
+                                        limit=100))),
+            "find_by_entity": bench(
+                lambda: list(store.find(app_id, entity_type="user",
+                                        entity_id="42", limit=100))),
+        }
+
+        # CCO at this catalog size (sparse path: dense C would be
+        # items² × 4B = 40 GB at the default geometry)
+        uu = users.astype(np.int32)
+        ii = item_pop.astype(np.int32)
+        prim = (uu[is_buy], ii[is_buy])
+        sec = (uu, ii)
+        t0 = time.perf_counter()
+        indicators = cco_indicators(
+            prim, {"buy": prim, "view": sec}, args.users, args.items,
+            {"buy": args.items, "view": args.items},
+            CCOParams(max_indicators_per_item=50))
+        cco_sec = time.perf_counter() - t0
+        out["cco"] = {
+            "sec": round(cco_sec, 2),
+            "nnz_primary": int(prim[0].size),
+            "indicators_per_item": int(
+                np.isfinite(indicators["buy"][1]).sum(1).mean()),
+        }
+
+        # indicator index build (the trained-model → queryable-index
+        # step the reference does into Elasticsearch)
+        from predictionio_tpu.storage.indexed import index_indicators
+        from predictionio_tpu.utils.bimap import BiMap
+
+        t0 = time.perf_counter()
+        index_indicators(client, "ur_indicators", indicators,
+                         item_ids=BiMap({str(i): i
+                                         for i in range(args.items)}))
+        out["index_indicators_sec"] = round(time.perf_counter() - t0, 2)
+        ind_idx = client.index("ur_indicators")
+        out["indicator_search_ms"] = bench(
+            lambda: ind_idx.search(
+                should=[("buy", str(int(ii[0])), 1.0)], size=50))
+        client.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    print(json.dumps({"metric": "indexed_cco_scale", **out}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,209 @@
+"""Serving-latency decomposition for recommendation top-10.
+
+The driver metric's second half (BASELINE.md) is predict p50 over
+`POST /queries.json`. A single number hides where the time goes, so
+this harness measures the three layers separately (all warm, ML-20M
+geometry factors):
+
+1. ``device_ms``  — the fused gather→score→top-k program + one packed
+   fetch (``models/als.py ResidentScorer``), the only part that
+   changes with the accelerator.
+2. ``host_ms``    — ``DeployedEngine.query()``: the REAL deploy path
+   (model lookup, BiMap id translation, serving wrapper) around
+   layer 1, no HTTP.
+3. ``http_ms``    — end-to-end ``POST /queries.json`` against a live
+   ``EngineServer`` on 127.0.0.1 (layers 1+2 plus JSON codec and the
+   asyncio HTTP stack).
+
+The model is fabricated at ML-20M shape (synthetic factors persisted
+through the template's own ``save_model`` and a real EngineInstance
+row) so the measurement drives the genuine serving path without a
+20M-event ingest. Layer shares are reported as p50/p99 and the derived
+``http_overhead_ms = http − host`` and ``host_overhead_ms = host −
+device``.
+
+Usage::
+
+    python profile_serving.py [--queries 2000] [--platform cpu|tpu]
+
+Prints ONE JSON line. On this image's tunneled TPU every device→host
+fetch after the first pays a ~66 ms relay round trip (BASELINE.md
+note) — run with ``--platform cpu`` for the HTTP/host shares and on a
+directly-attached chip for the device share.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import pickle
+import threading
+import time
+
+import numpy as np
+
+
+def fabricate_instance(storage, n_users: int, n_items: int, rank: int):
+    """Persist a synthetic ALS model + COMPLETED EngineInstance the way
+    `pio train` would, so prepare_deploy loads the real thing."""
+    from predictionio_tpu.storage.meta import EngineInstance
+    from predictionio_tpu.templates.recommendation.engine import (
+        ALSAlgorithm,
+        ALSAlgorithmParams,
+        ALSModel,
+    )
+    from predictionio_tpu.utils.bimap import BiMap
+    from predictionio_tpu.data.event import utcnow
+
+    rng = np.random.default_rng(0)
+    U = (rng.standard_normal((n_users, rank)) / np.sqrt(rank)).astype(
+        np.float32)
+    V = (rng.standard_normal((n_items, rank)) / np.sqrt(rank)).astype(
+        np.float32)
+    user_ids = BiMap({str(i): i for i in range(n_users)})
+    item_ids = BiMap({str(i): i for i in range(n_items)})
+    model = ALSModel(U, V, user_ids, item_ids)
+    algo = ALSAlgorithm(ALSAlgorithmParams(rank=rank))
+    blob = algo.save_model(model, None)
+
+    factory = "predictionio_tpu.templates.recommendation.engine:engine_factory"
+    ei = EngineInstance(
+        id="profile-serving", status="COMPLETED",
+        start_time=utcnow(), end_time=utcnow(),
+        engine_factory=factory, engine_variant="", batch="",
+        env={}, mesh_conf={},
+        data_source_params=json.dumps({"appName": "ProfileApp"}),
+        preparator_params="{}",
+        algorithms_params=json.dumps(
+            [{"name": "als", "params": {"rank": rank}}]),
+        serving_params="{}")
+    storage.meta.insert_engine_instance(ei)
+    storage.models.put(ei.id, pickle.dumps([blob]))
+    return factory
+
+
+def measure(fn, iters: int, warmup: int = 20):
+    for _ in range(warmup):
+        fn()
+    lat = np.empty(iters)
+    for i in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        lat[i] = time.perf_counter() - t0
+    return (float(np.percentile(lat, 50) * 1e3),
+            float(np.percentile(lat, 99) * 1e3))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--queries", type=int, default=2000)
+    ap.add_argument("--platform", default="cpu",
+                    help="jax platform (cpu|tpu); cpu isolates the "
+                         "HTTP/host shares from the tunnel round-trip")
+    ap.add_argument("--n-users", type=int, default=138493)
+    ap.add_argument("--n-items", type=int, default=26744)
+    ap.add_argument("--rank", type=int, default=64)
+    ap.add_argument("--port", type=int, default=8971)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    jax.devices()  # fail fast if the platform is unreachable
+
+    from predictionio_tpu.core.workflow import prepare_deploy
+    from predictionio_tpu.data.events import MemoryEventStore
+    from predictionio_tpu.models.als import ResidentScorer
+    from predictionio_tpu.server.engine_server import EngineServer
+    from predictionio_tpu.storage.meta import MetaStore
+    from predictionio_tpu.storage.models import MemoryModelStore
+    from predictionio_tpu.storage.registry import (Storage, StorageConfig,
+                                                   set_storage)
+
+    st = Storage(StorageConfig(metadata_type="MEMORY",
+                               eventdata_type="MEMORY",
+                               modeldata_type="MEMORY"))
+    st._meta = MetaStore(":memory:")
+    st._events = MemoryEventStore()
+    st._models = MemoryModelStore()
+    set_storage(st)
+
+    factory = fabricate_instance(st, args.n_users, args.n_items, args.rank)
+    rng = np.random.default_rng(1)
+    users = rng.integers(0, args.n_users, args.queries)
+
+    # 1. device: fused gather→score→top-k + packed fetch
+    deployed = prepare_deploy(engine_factory=factory, storage=st)
+    model = deployed.models[0]
+    scorer = ResidentScorer(model.U, model.V)
+    it = iter(np.resize(users, args.queries + 200))
+    dev_p50, dev_p99 = measure(lambda: scorer.recommend(int(next(it)), 10),
+                               args.queries)
+
+    # 2. host: the real deploy path, no HTTP
+    it2 = iter(np.resize(users, args.queries + 200))
+    host_p50, host_p99 = measure(
+        lambda: deployed.query({"user": str(int(next(it2))), "num": 10}),
+        args.queries)
+
+    # 3. http: live EngineServer on localhost
+    server = EngineServer(engine_factory=factory, storage=st,
+                          host="127.0.0.1", port=args.port)
+    loop_box = {}
+
+    def run():
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        loop_box["loop"] = loop
+        loop.run_until_complete(server.serve_forever())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", args.port,
+                                              timeout=2)
+            conn.request("GET", "/")
+            conn.getresponse().read()
+            break
+        except OSError:
+            time.sleep(0.2)
+    else:
+        raise TimeoutError("engine server did not come up")
+
+    conn = http.client.HTTPConnection("127.0.0.1", args.port, timeout=10)
+    it3 = iter(np.resize(users, args.queries + 200))
+
+    def http_query():
+        body = json.dumps({"user": str(int(next(it3))), "num": 10})
+        conn.request("POST", "/queries.json", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        assert resp.status == 200, data[:200]
+
+    http_p50, http_p99 = measure(http_query, args.queries)
+    loop_box["loop"].call_soon_threadsafe(server.http.request_shutdown)
+    t.join(timeout=5)
+
+    print(json.dumps({
+        "metric": "predict_latency_decomposition",
+        "geometry": {"n_users": args.n_users, "n_items": args.n_items,
+                     "rank": args.rank},
+        "platform": jax.default_backend(),
+        "queries": args.queries,
+        "device_ms": {"p50": round(dev_p50, 4), "p99": round(dev_p99, 4)},
+        "host_ms": {"p50": round(host_p50, 4), "p99": round(host_p99, 4)},
+        "http_ms": {"p50": round(http_p50, 4), "p99": round(http_p99, 4)},
+        "host_overhead_ms": round(host_p50 - dev_p50, 4),
+        "http_overhead_ms": round(http_p50 - host_p50, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -72,6 +72,51 @@ class TestCCO:
         vi, vv = out["view"]
         assert vi[0, 0] == 3 and np.isfinite(vv[0, 0])  # D indicates A
 
+    def test_sparse_path_matches_dense(self):
+        """r4: catalogs past dense_c_max_mb run the sparse
+        co-occurrence + lexsort top-k; on the same data it must
+        reproduce the dense MXU path exactly."""
+        rng = np.random.default_rng(5)
+        n_users, n_a, nnz = 60, 40, 700
+        pu = rng.integers(0, n_users, nnz).astype(np.int32)
+        pi = rng.integers(0, n_a, nnz).astype(np.int32)
+        vu = rng.integers(0, n_users, nnz).astype(np.int32)
+        vi = rng.integers(0, 25, nnz).astype(np.int32)
+        pairs_p = (pu, pi)
+        pairs_v = (vu, vi)
+        kw = dict(max_indicators_per_item=4, llr_threshold=0.0)
+        dense = cco_indicators(pairs_p, {"p": pairs_p, "v": pairs_v},
+                               n_users, n_a, {"p": n_a, "v": 25},
+                               CCOParams(**kw))
+        sparse = cco_indicators(pairs_p, {"p": pairs_p, "v": pairs_v},
+                                n_users, n_a, {"p": n_a, "v": 25},
+                                CCOParams(**kw, dense_c_max_mb=0))
+        for name in ("p", "v"):
+            di, dv = dense[name]
+            si, sv = sparse[name]
+            # values agree everywhere (f32 vs f64 math: loose rtol);
+            # indices agree wherever values are distinct enough to
+            # have a unique order
+            np.testing.assert_allclose(
+                np.where(np.isfinite(dv), dv, -1.0),
+                np.where(np.isfinite(sv), sv, -1.0), rtol=1e-4, atol=1e-4)
+            distinct = np.isfinite(dv) & (np.abs(
+                dv - np.roll(dv, 1, axis=1)) > 1e-3)
+            assert (di[distinct] == si[distinct]).all()
+
+    def test_downsampling_caps_heavy_users(self):
+        from predictionio_tpu.models.cco import _downsample_per_user
+
+        u = np.concatenate([np.zeros(1000, np.int32),
+                            np.ones(5, np.int32)])
+        i = np.arange(1005).astype(np.int32) % 50
+        du, di = _downsample_per_user(u, i, cap=100)
+        assert (du == 0).sum() == 100
+        assert (du == 1).sum() == 5
+        # deterministic
+        du2, _ = _downsample_per_user(u, i, cap=100)
+        np.testing.assert_array_equal(du, du2)
+
     def test_score_user(self):
         idxs = np.array([[1, 2], [0, 2], [0, 1]], np.int32)
         vals = np.array([[5.0, -np.inf], [3.0, 1.0], [-np.inf, -np.inf]], np.float32)

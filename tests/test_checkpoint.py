@@ -103,6 +103,38 @@ class TestRestoreLatestCompatible:
             assert step == 2
             np.testing.assert_array_equal(state["x"], [22.0])
 
+    def test_transiently_unreadable_newer_step_not_pruned(self, tmp_path,
+                                                          monkeypatch):
+        """r4 review: a newer step skipped on a TRANSIENT metadata
+        error must survive the fallback — deleting it would destroy a
+        valid checkpoint (only proven-torn/stale steps are pruned)."""
+        import orbax.checkpoint as ocp
+
+        d = str(tmp_path / "ck")
+        with TrainCheckpointer(d) as ck:
+            ck.save(1, {"x": np.asarray([1.0], np.float32)})
+            ck.save(2, {"x": np.asarray([2.0], np.float32)})
+
+        orig = ocp.StandardCheckpointer.metadata
+
+        def flaky(self, path, *a, **k):
+            if "/2/" in str(path) or str(path).endswith("2/default"):
+                raise OSError("NFS hiccup")
+            return orig(self, path, *a, **k)
+
+        monkeypatch.setattr(ocp.StandardCheckpointer, "metadata", flaky)
+        with TrainCheckpointer(d) as ck:
+            state, step = ck.restore_latest_compatible(
+                {"x": np.zeros(1, np.float32)})
+            assert step == 1  # fell back past the flaky step
+        monkeypatch.undo()
+        # step 2 survived: the next (healthy) resume restores it
+        with TrainCheckpointer(d) as ck:
+            state, step = ck.restore_latest_compatible(
+                {"x": np.zeros(1, np.float32)})
+            assert step == 2
+            np.testing.assert_array_equal(state["x"], [2.0])
+
     def test_permuted_shapes_rejected_positionally(self, tmp_path):
         """r4 review: a checkpoint whose leaf shapes are a PERMUTATION
         of the template's (e.g. swapped tower embeddings) must raise
