@@ -748,12 +748,16 @@ def index_indicators(client: IndexedStorageClient, index_name: str,
     idx = client.index(index_name)
     inv = item_ids.inverse()
     n = len(item_ids)
+    docs = []
     for i in range(n):
         doc: Dict[str, Any] = {"item": inv[i]}
         for event, (idxs, vals) in indicators.items():
             doc[event] = [inv[int(j)] for j, v in zip(idxs[i], vals[i])
                           if np.isfinite(v)]
-        idx.index(inv[i], doc)
+        docs.append((inv[i], doc))
+    # one WAL append for the whole model (per-doc flush measured ~8×
+    # slower at 100k items — see index_batch)
+    idx.index_batch(docs)
     return idx
 
 

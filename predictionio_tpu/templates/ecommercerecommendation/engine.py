@@ -237,27 +237,51 @@ class ECommAlgorithm(Algorithm):
         if data.n == 0:
             raise ValueError("empty interactions")
 
-    def train(self, ctx: WorkflowContext, pd: TrainingData) -> ECommModel:
-        p: ECommAlgorithmParams = self.params
+    @staticmethod
+    def _to_coo(pd: TrainingData) -> RatingsCOO:
         # weight aggregation by linearized (user, item) pair — the
         # vectorized Counter (no per-event Python objects)
         n_items = len(pd.item_ids)
         lin = pd.user_idx.astype(np.int64) * n_items + pd.item_idx
         uniq, inv = np.unique(lin, return_inverse=True)
         vv = np.bincount(inv, weights=pd.weight).astype(np.float32)
-        ii = (uniq % n_items).astype(np.int32)
-        coo = RatingsCOO((uniq // n_items).astype(np.int32), ii, vv,
-                         len(pd.user_ids), n_items)
-        U, V = als_train(
-            coo,
-            ALSParams(rank=p.rank, iterations=p.num_iterations, reg=p.lambda_,
-                      implicit=True, alpha=p.alpha,
-                      seed=0 if p.seed is None else p.seed),
-            mesh=ctx.mesh)
-        popularity = np.bincount(ii, weights=vv, minlength=n_items)
+        return RatingsCOO((uniq // n_items).astype(np.int32),
+                          (uniq % n_items).astype(np.int32), vv,
+                          len(pd.user_ids), n_items)
+
+    @staticmethod
+    def _als_params(p: ECommAlgorithmParams) -> ALSParams:
+        return ALSParams(rank=p.rank, iterations=p.num_iterations,
+                         reg=p.lambda_, implicit=True, alpha=p.alpha,
+                         seed=0 if p.seed is None else p.seed)
+
+    def _model(self, pd: TrainingData, coo: RatingsCOO, U, V,
+               p: ECommAlgorithmParams) -> ECommModel:
+        popularity = np.bincount(coo.item_idx, weights=coo.rating,
+                                 minlength=len(pd.item_ids))
         return ECommModel(U, V, pd.user_ids, pd.item_ids,
                           pd.item_categories,
                           popularity.astype(np.float32), pd.app_name, p)
+
+    @classmethod
+    def train_many(cls, ctx: WorkflowContext, pd: TrainingData,
+                   params_list) -> List[ECommModel]:
+        """Grid fan-out: one COO + prepared layout for every candidate;
+        lambda/alpha-only candidates share a compiled executable
+        (models/als.als_train_many)."""
+        from predictionio_tpu.models.als import als_train_many
+
+        coo = cls._to_coo(pd)
+        results = als_train_many(
+            coo, [cls._als_params(p) for p in params_list], mesh=ctx.mesh)
+        return [cls(p)._model(pd, coo, U, V, p)
+                for p, (U, V) in zip(params_list, results)]
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> ECommModel:
+        p: ECommAlgorithmParams = self.params
+        coo = self._to_coo(pd)
+        U, V = als_train(coo, self._als_params(p), mesh=ctx.mesh)
+        return self._model(pd, coo, U, V, p)
 
     def predict(self, model: ECommModel, query: Dict[str, Any]) -> Dict[str, Any]:
         return {"itemScores": model.query(

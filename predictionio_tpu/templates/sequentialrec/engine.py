@@ -67,20 +67,30 @@ class SeqDataSource(DataSource):
     ParamsClass = DataSourceParams
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        """Stream events into columnar (user, item) arrays (O(chunk)
+        transient Event objects — ``data/pipeline``), then one STABLE
+        sort by user groups each user's items. Time order inside each
+        group comes for free: the EventStore.find contract is
+        "ordered by eventTime asc", and a stable sort preserves it."""
+        from predictionio_tpu.data.pipeline import read_interactions
+
         p: DataSourceParams = self.params
-        per_user: Dict[str, List[tuple]] = {}
-        for e in event_store.find(
-            p.app_name, entity_type="user", target_entity_type="item",
-            event_names=p.event_names, storage=ctx.storage,
-        ):
-            if e.target_entity_id is None:
-                continue
-            per_user.setdefault(e.entity_id, []).append(
-                (e.event_time, e.target_entity_id))
-        if not per_user:
+        data = read_interactions(
+            lambda: event_store.find(
+                p.app_name, entity_type="user", target_entity_type="item",
+                event_names=p.event_names, storage=ctx.storage))
+        uu, ii, _ones = data.arrays()
+        if uu.size == 0:
             raise ValueError("no interaction events found")
-        seqs = {u: [i for _, i in sorted(evs, key=lambda t: t[0])]
-                for u, evs in per_user.items()}
+        order = np.argsort(uu, kind="stable")
+        uu, ii = uu[order], ii[order]
+        i_inv = data.item_ids.inverse()
+        u_inv = data.user_ids.inverse()
+        seqs: Dict[str, List[str]] = {}
+        bounds = np.concatenate(
+            ([0], np.nonzero(np.diff(uu))[0] + 1, [uu.size]))
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            seqs[u_inv[int(uu[lo])]] = [i_inv[int(j)] for j in ii[lo:hi]]
         return TrainingData(p.app_name, seqs)
 
     def read_eval(self, ctx: WorkflowContext):

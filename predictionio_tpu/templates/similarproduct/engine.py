@@ -184,23 +184,42 @@ class ALSAlgorithm(Algorithm):
         if data.n == 0:
             raise ValueError("empty view data")
 
-    def train(self, ctx: WorkflowContext, pd: TrainingData) -> SimilarProductModel:
-        p: ALSAlgorithmParams = self.params
+    @staticmethod
+    def _to_coo(pd: TrainingData) -> RatingsCOO:
         # repeat-view counts by linearized (user, item) pair — the
         # vectorized Counter (no per-event Python objects)
         n_items = len(pd.item_ids)
         lin = pd.user_idx.astype(np.int64) * n_items + pd.item_idx
         uniq, cnt = np.unique(lin, return_counts=True)
-        coo = RatingsCOO((uniq // n_items).astype(np.int32),
-                         (uniq % n_items).astype(np.int32),
-                         cnt.astype(np.float32),
-                         len(pd.user_ids), n_items)
-        _, V = als_train(
-            coo,
-            ALSParams(rank=p.rank, iterations=p.num_iterations, reg=p.lambda_,
-                      implicit=True, alpha=p.alpha,
-                      seed=0 if p.seed is None else p.seed),
-            mesh=ctx.mesh)
+        return RatingsCOO((uniq // n_items).astype(np.int32),
+                          (uniq % n_items).astype(np.int32),
+                          cnt.astype(np.float32),
+                          len(pd.user_ids), n_items)
+
+    @staticmethod
+    def _als_params(p: ALSAlgorithmParams) -> ALSParams:
+        return ALSParams(rank=p.rank, iterations=p.num_iterations,
+                         reg=p.lambda_, implicit=True, alpha=p.alpha,
+                         seed=0 if p.seed is None else p.seed)
+
+    @classmethod
+    def train_many(cls, ctx: WorkflowContext, pd: TrainingData,
+                   params_list) -> List[SimilarProductModel]:
+        """Grid fan-out: one COO + prepared layout for every candidate;
+        lambda/alpha-only candidates share a compiled executable
+        (models/als.als_train_many)."""
+        from predictionio_tpu.models.als import als_train_many
+
+        coo = cls._to_coo(pd)
+        results = als_train_many(
+            coo, [cls._als_params(p) for p in params_list], mesh=ctx.mesh)
+        return [SimilarProductModel(V, pd.item_ids, pd.item_categories)
+                for _, V in results]
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> SimilarProductModel:
+        p: ALSAlgorithmParams = self.params
+        _, V = als_train(self._to_coo(pd), self._als_params(p),
+                         mesh=ctx.mesh)
         return SimilarProductModel(V, pd.item_ids, pd.item_categories)
 
     def predict(self, model: SimilarProductModel, query: Dict[str, Any]) -> Dict[str, Any]:

@@ -161,7 +161,8 @@ class TrainCheckpointer:
                     or any(np.asarray(a).shape != np.asarray(b).shape
                            for a, b in zip(s_leaves, t_leaves))):
                 mismatches += 1
-                continue
+                prunable.add(step)  # restored cleanly, shapes wrong —
+                continue            # confirmed stale, same as stage 1
             # Prune newer steps PROVEN torn or stale-geometry: Orbax's
             # save() silently no-ops (returns False) on an existing
             # step dir, so leaving them would mean the resumed run's

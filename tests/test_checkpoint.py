@@ -151,6 +151,27 @@ class TestRestoreLatestCompatible:
                     {"a": np.zeros((64, 4), np.float32),
                      "b": np.zeros((128, 4), np.float32)})
 
+    def test_permuted_newer_step_pruned_after_fallback(self, tmp_path):
+        """r4 review: a newer step that restores cleanly but with
+        PERMUTED shapes is confirmed stale — after falling back it must
+        be pruned so the resumed run's save at that step lands."""
+        d = str(tmp_path / "ck")
+        good = {"a": np.ones((4, 2), np.float32),
+                "b": np.ones((8, 2), np.float32)}
+        swapped = {"a": np.ones((8, 2), np.float32),
+                   "b": np.ones((4, 2), np.float32)}
+        with TrainCheckpointer(d) as ck:
+            ck.save(1, good)
+            ck.save(2, swapped)  # stale geometry, same shape multiset
+        with TrainCheckpointer(d) as ck:
+            state, step = ck.restore_latest_compatible(good)
+            assert step == 1
+            ck.save(2, {"a": good["a"] * 2, "b": good["b"]})  # must land
+        with TrainCheckpointer(d) as ck:
+            state, step = ck.restore_latest_compatible(good)
+            assert step == 2
+            np.testing.assert_array_equal(state["a"], good["a"] * 2)
+
     def test_transient_error_propagates_and_preserves_dir(self, tmp_path,
                                                           monkeypatch):
         """An IO hiccup on EVERY read must surface the error and leave
