@@ -285,13 +285,31 @@ def cco_indicators(
     Returns ``{event: (indices [n_items_primary, k], llr scores)}``.
     """
     p = params or CCOParams()
-    cap = p.max_interactions_per_user
+    return _cco_run(primary_pairs, event_pairs, n_users, n_items_primary,
+                    n_items_by_event, p, [p])[0]
+
+
+def _cco_run(primary_pairs, event_pairs, n_users: int,
+             n_items_primary: int, n_items_by_event: Dict[str, int],
+             shared_p: CCOParams, consumers: Sequence[CCOParams]
+             ) -> List[Dict[str, Tuple[np.ndarray, np.ndarray]]]:
+    """Shared-count pipeline: the EXPENSIVE stage (downsampling, CSR,
+    per-event co-occurrence counts) runs once, driven by ``shared_p``'s
+    count-stage knobs; each consumer in ``consumers`` then pays only
+    its own LLR/top-k (``llr_threshold``/``max_indicators_per_item``
+    never touch the counts). One event's count matrix is alive at a
+    time — every consumer reduces it to top-k before the next event's
+    counts are built, so peak memory matches the single-candidate
+    pre-split behavior (one dense C, not n_events of them)."""
+    cap = shared_p.max_interactions_per_user
     raw_primary = primary_pairs  # identity check below predates capping
     primary_pairs = _downsample_per_user(*primary_pairs, cap)
     prim = _csr_from_pairs(*primary_pairs, n_users, n_items_primary)
-    prim_item_counts = np.bincount(prim[1], minlength=n_items_primary).astype(np.float32)
+    prim_item_counts = np.bincount(
+        prim[1], minlength=n_items_primary).astype(np.float32)
 
-    out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    outs: List[Dict[str, Tuple[np.ndarray, np.ndarray]]] = \
+        [{} for _ in consumers]
     for name, (eu, ei) in event_pairs.items():
         n_b = n_items_by_event[name]
         same = (name == "__primary__") or (n_b == n_items_primary and
@@ -300,22 +318,56 @@ def cco_indicators(
         eu, ei = _downsample_per_user(eu, ei, cap)
         sec = _csr_from_pairs(eu, ei, n_users, n_b)
         sec_item_counts = np.bincount(sec[1], minlength=n_b).astype(np.float32)
-        if n_items_primary * n_b * 4 > p.dense_c_max_mb << 20:
+        if n_items_primary * n_b * 4 > shared_p.dense_c_max_mb << 20:
             # catalog too large for a dense (n_a, n_b) C — sparse path
-            rows, cols, cnts = _cooccurrence_sparse(
-                prim, sec, n_users, n_b)
-            idxs, vals = _llr_topk_sparse(
-                rows, cols, cnts, prim_item_counts, sec_item_counts,
-                n_users, n_items_primary, n_b,
-                p.max_indicators_per_item, p.llr_threshold, same)
+            rows, cols, cnts = _cooccurrence_sparse(prim, sec, n_users,
+                                                    n_b)
+            for p, out in zip(consumers, outs):
+                out[name] = _llr_topk_sparse(
+                    rows, cols, cnts, prim_item_counts, sec_item_counts,
+                    n_users, n_items_primary, n_b,
+                    p.max_indicators_per_item, p.llr_threshold, same)
         else:
             C = _cooccurrence(prim, sec, n_users, n_items_primary, n_b,
-                              p.user_chunk)
-            idxs, vals = _llr_topk(C, prim_item_counts, sec_item_counts,
-                                   n_users, p.max_indicators_per_item,
-                                   p.llr_threshold, p.row_block, same)
-        out[name] = (idxs, vals)
-    return out
+                              shared_p.user_chunk)
+            for p, out in zip(consumers, outs):
+                out[name] = _llr_topk(
+                    C, prim_item_counts, sec_item_counts, n_users,
+                    p.max_indicators_per_item, p.llr_threshold,
+                    p.row_block, same)
+            del C  # freed before the next event's counts are built
+    return outs
+
+
+def cco_indicators_many(
+    primary_pairs: Tuple[np.ndarray, np.ndarray],
+    event_pairs: Dict[str, Tuple[np.ndarray, np.ndarray]],
+    n_users: int,
+    n_items_primary: int,
+    n_items_by_event: Dict[str, int],
+    params_list: Sequence[CCOParams],
+) -> List[Dict[str, Tuple[np.ndarray, np.ndarray]]]:
+    """Indicator sets for SEVERAL candidates on the same data — the
+    `pio eval` grid fan-out. Candidates sharing the count-stage params
+    (downsampling cap, user chunking, dense/sparse crossover) compute
+    the co-occurrence counts ONCE; each pays only its own LLR/top-k.
+    Results in input order."""
+    out: List[Optional[Dict]] = [None] * len(params_list)
+    groups: Dict[tuple, List[int]] = {}
+    for i, p in enumerate(params_list):
+        # ONLY the knobs that change the counts; row_block merely
+        # blocks the per-candidate top-k and must not split a group
+        key = (p.user_chunk, p.max_interactions_per_user,
+               p.dense_c_max_mb)
+        groups.setdefault(key, []).append(i)
+    for idxs in groups.values():
+        results = _cco_run(primary_pairs, event_pairs, n_users,
+                           n_items_primary, n_items_by_event,
+                           params_list[idxs[0]],
+                           [params_list[i] for i in idxs])
+        for i, res in zip(idxs, results):
+            out[i] = res
+    return out  # type: ignore[return-value]
 
 
 def score_user(

@@ -171,11 +171,20 @@ class URAlgorithm(Algorithm):
     ParamsClass = URAlgorithmParams
 
     def sanity_check(self, data: TrainingData) -> None:
-        if not any(data.events.values()):
+        if not data.events:
             raise ValueError("no events")
+        primary = next(iter(data.events))
+        if not data.events[primary]:
+            # the trainer drops empty event lists, so an empty PRIMARY
+            # would otherwise KeyError deep inside train/train_many —
+            # degenerate candidates must fail here (controller contract)
+            raise ValueError(
+                f"no events for the primary event {primary!r}")
 
-    def train(self, ctx: WorkflowContext, pd: TrainingData) -> URModel:
-        p: URAlgorithmParams = self.params
+    @staticmethod
+    def _prepare(pd: TrainingData):
+        """The candidate-independent half of training: id maps,
+        index-mapped event pairs, per-user history, popularity."""
         primary = next(iter(pd.events))
         all_users = (u for pairs in pd.events.values() for u, _ in pairs)
         all_items = (i for pairs in pd.events.values() for _, i in pairs)
@@ -187,21 +196,51 @@ class URAlgorithm(Algorithm):
             return (np.asarray([user_ids[u] for u, _ in pairs], np.int32),
                     np.asarray([item_ids[i] for _, i in pairs], np.int32))
 
-        event_pairs = {name: to_idx(pairs) for name, pairs in pd.events.items()
-                       if pairs}
-        indicators = cco_indicators(
-            event_pairs[primary], event_pairs, len(user_ids), n_items,
-            {name: n_items for name in event_pairs},
-            CCOParams(max_indicators_per_item=p.max_indicators_per_item,
-                      llr_threshold=p.llr_threshold))
-
+        event_pairs = {name: to_idx(pairs)
+                       for name, pairs in pd.events.items() if pairs}
         user_history: Dict[str, Dict[str, List[int]]] = {}
         for name, pairs in pd.events.items():
             for u, i in pairs:
                 user_history.setdefault(u, {}).setdefault(name, []).append(
                     item_ids[i])
-        pu, pi = event_pairs[primary]
+        _pu, pi = event_pairs[primary]
         popularity = np.bincount(pi, minlength=n_items).astype(np.float32)
+        return (primary, user_ids, item_ids, n_items, event_pairs,
+                user_history, popularity)
+
+    @staticmethod
+    def _cco_params(p: URAlgorithmParams) -> CCOParams:
+        return CCOParams(max_indicators_per_item=p.max_indicators_per_item,
+                         llr_threshold=p.llr_threshold)
+
+    @classmethod
+    def train_many(cls, ctx: WorkflowContext, pd: TrainingData,
+                   params_list) -> List[URModel]:
+        """Grid fan-out (`pio eval`): the id maps, event pairs and —
+        crucially — the co-occurrence COUNT matrices are computed once;
+        each candidate pays only its own LLR threshold + top-k
+        (models/cco.cco_indicators_many). The canonical UR grid over
+        llr_threshold shares everything expensive."""
+        from predictionio_tpu.models.cco import cco_indicators_many
+
+        (primary, user_ids, item_ids, n_items, event_pairs,
+         user_history, popularity) = cls._prepare(pd)
+        many = cco_indicators_many(
+            event_pairs[primary], event_pairs, len(user_ids), n_items,
+            {name: n_items for name in event_pairs},
+            [cls._cco_params(p) for p in params_list])
+        return [URModel(ind, user_history, item_ids, primary, p,
+                        popularity)
+                for p, ind in zip(params_list, many)]
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> URModel:
+        p: URAlgorithmParams = self.params
+        (primary, user_ids, item_ids, n_items, event_pairs,
+         user_history, popularity) = self._prepare(pd)
+        indicators = cco_indicators(
+            event_pairs[primary], event_pairs, len(user_ids), n_items,
+            {name: n_items for name in event_pairs},
+            self._cco_params(p))
         return URModel(indicators, user_history, item_ids, primary, p,
                        popularity)
 

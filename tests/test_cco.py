@@ -117,6 +117,37 @@ class TestCCO:
         du2, _ = _downsample_per_user(u, i, cap=100)
         np.testing.assert_array_equal(du, du2)
 
+    def test_indicators_many_shares_count_stage(self, monkeypatch):
+        """r4: a grid over llr_threshold/k must compute the
+        co-occurrence counts ONCE and match per-candidate one-shot
+        results exactly."""
+        import predictionio_tpu.models.cco as cco_mod
+        from predictionio_tpu.models.cco import cco_indicators_many
+
+        rng = np.random.default_rng(4)
+        n_users, n_items, nnz = 50, 30, 600
+        pairs = (rng.integers(0, n_users, nnz).astype(np.int32),
+                 rng.integers(0, n_items, nnz).astype(np.int32))
+        grid = [CCOParams(max_indicators_per_item=k, llr_threshold=t)
+                for k in (3, 5) for t in (0.0, 1.0)]
+
+        calls = {"n": 0}
+        orig = cco_mod._cooccurrence
+
+        def counting(*a, **k):
+            calls["n"] += 1
+            return orig(*a, **k)
+
+        monkeypatch.setattr(cco_mod, "_cooccurrence", counting)
+        many = cco_indicators_many(pairs, {"p": pairs}, n_users, n_items,
+                                   {"p": n_items}, grid)
+        assert calls["n"] == 1, "counts must be computed once per grid"
+        for p, got in zip(grid, many):
+            ref = cco_indicators(pairs, {"p": pairs}, n_users, n_items,
+                                 {"p": n_items}, p)
+            np.testing.assert_array_equal(got["p"][0], ref["p"][0])
+            np.testing.assert_array_equal(got["p"][1], ref["p"][1])
+
     def test_score_user(self):
         idxs = np.array([[1, 2], [0, 2], [0, 1]], np.int32)
         vals = np.array([[5.0, -np.inf], [3.0, 1.0], [-np.inf, -np.inf]], np.float32)
@@ -187,6 +218,23 @@ def seed_ur(storage, app_name="URApp"):
                                  target_entity_id=f"i{i}"))
     storage.events.insert_batch(evs, app.id)
     return app
+
+
+class TestURSanity:
+    def test_empty_primary_fails_in_sanity(self):
+        """r4 review: an empty-but-present PRIMARY event list must fail
+        at sanity_check, not KeyError inside the (possibly stacked)
+        trainer."""
+        from predictionio_tpu.templates.universal.engine import (
+            TrainingData,
+            URAlgorithm,
+            URAlgorithmParams,
+        )
+
+        algo = URAlgorithm(URAlgorithmParams())
+        td = TrainingData("app", {"buy": [], "view": [("u", "i")]})
+        with pytest.raises(ValueError, match="primary"):
+            algo.sanity_check(td)
 
 
 class TestUniversalTemplate:
