@@ -215,6 +215,21 @@ def main() -> None:
     mfu_device = flops / t_dev / (V5E_PEAK_BF16 * n_chips)
     hbm_gbps = _train_bytes(prep, args.rank, args.iters) / t_dev / 1e9
 
+    # r4 grid contract on hardware: 3 extra reg candidates on the SAME
+    # prep must pay ZERO compiles (reg is a traced scalar) — wall time
+    # ≈ 3 × train_sec_warm. Measured here so the BENCH file carries the
+    # proof without a separate harness run.
+    from predictionio_tpu.models import als as als_mod
+
+    grid_info = als_mod._compiled_bucketed.cache_info()
+    t3 = time.perf_counter()
+    for reg in (0.01, 0.1, 1.0):
+        als_train_prepared(prep, ALSParams(
+            rank=args.rank, iterations=args.iters, reg=reg, seed=1))
+    t_grid3 = time.perf_counter() - t3
+    grid_compiles = (als_mod._compiled_bucketed.cache_info().misses
+                     - grid_info.misses)
+
     # second driver metric (BASELINE.md): predict p50, recommendation
     # top-10 from the resident model — the engine-server hot path minus
     # HTTP framing. Sequential single-query calls, warm.
@@ -270,6 +285,10 @@ def main() -> None:
             "mfu_device": round(mfu_device, 4),
             "model_tflops": round(flops / 1e12, 2),
             "hbm_gbps": round(hbm_gbps, 1),
+            # reg-grid contract: 3 extra reg candidates on the same
+            # prep; must show 0 extra compiles (traced scalars, r4)
+            "grid_reg3_sec": round(t_grid3, 3),
+            "grid_reg3_extra_compiles": int(grid_compiles),
             "predict_p50_ms": round(p50_ms, 3),
             "predict_p99_ms": round(p99_ms, 3),
             "predict_p50_device_ms": round(p50_dev_ms, 4),
