@@ -1,0 +1,65 @@
+"""Shared plumbing for the profile_*.py harnesses: in-memory Storage
+wiring and running an asyncio HTTP server (Event/Engine Server) on a
+background thread with readiness polling and clean shutdown."""
+
+from __future__ import annotations
+
+import http.client
+import threading
+import time
+from contextlib import contextmanager
+
+
+def make_memory_storage():
+    """A fresh all-in-memory Storage installed as process default."""
+    from predictionio_tpu.data.events import MemoryEventStore
+    from predictionio_tpu.storage.meta import MetaStore
+    from predictionio_tpu.storage.models import MemoryModelStore
+    from predictionio_tpu.storage.registry import (Storage, StorageConfig,
+                                                   set_storage)
+
+    st = Storage(StorageConfig(metadata_type="MEMORY",
+                               eventdata_type="MEMORY",
+                               modeldata_type="MEMORY"))
+    st._meta = MetaStore(":memory:")
+    st._events = MemoryEventStore()
+    st._models = MemoryModelStore()
+    set_storage(st)
+    return st
+
+
+@contextmanager
+def server_thread(server, port: int, timeout: float = 15.0):
+    """Run an Event/Engine Server's asyncio loop on a daemon thread,
+    wait for `GET /` to answer, yield, then shut it down."""
+    loop_box = {}
+
+    def run():
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        loop_box["loop"] = loop
+        loop.run_until_complete(server.serve_forever())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+            try:
+                conn.request("GET", "/")
+                conn.getresponse().read()
+                break
+            finally:
+                conn.close()
+        except OSError:
+            time.sleep(0.2)
+    else:
+        raise TimeoutError("server did not come up")
+    try:
+        yield
+    finally:
+        loop_box["loop"].call_soon_threadsafe(server.http.request_shutdown)
+        t.join(timeout=5)

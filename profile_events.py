@@ -1,0 +1,140 @@
+"""Event-ingestion throughput/latency for the Event Server.
+
+Completes the per-surface perf evidence set (train: bench.py; predict:
+profile_serving.py; index/CCO: profile_indexed.py): measures the
+reference's headline ingestion surface — `POST /events.json` — end to
+end over HTTP against a live EventServer, plus the batch API and the
+filtered read path.
+
+Measured layers (all warm, persistent connection):
+
+- ``single_post``  — one event per POST (auth, validation, insert)
+- ``batch_post``   — POST /batch/events.json with 50-event payloads
+                     (the API's documented maximum per request)
+- ``get_find``     — GET /events.json?limit=100 filtered reads
+
+Usage::
+
+    python profile_events.py [--events 5000] [--storage memory|sqlite]
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import tempfile
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--events", type=int, default=5000)
+    ap.add_argument("--storage", default="memory",
+                    choices=["memory", "sqlite"])
+    ap.add_argument("--port", type=int, default=8791)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # no accelerator needed
+
+    from profile_common import make_memory_storage, server_thread
+    from predictionio_tpu.server.event_server import EventServer
+    from predictionio_tpu.storage.registry import (Storage, StorageConfig,
+                                                   set_storage)
+
+    if args.storage == "memory":
+        st = make_memory_storage()
+    else:
+        home = tempfile.mkdtemp(prefix="pio_events_bench_")
+        st = Storage(StorageConfig(home=home))
+        set_storage(st)
+    app = st.meta.create_app("EventsBench")
+    st.events.init_channel(app.id)
+    key = st.meta.create_access_key(app.id).key
+
+    server = EventServer(storage=st, host="127.0.0.1", port=args.port)
+    with server_thread(server, args.port):
+        conn = http.client.HTTPConnection("127.0.0.1", args.port,
+                                          timeout=10)
+        rng = np.random.default_rng(0)
+
+        def event(n):
+            return {"event": "view", "entityType": "user",
+                    "entityId": str(int(rng.integers(0, 1000))),
+                    "targetEntityType": "item",
+                    "targetEntityId": str(int(rng.integers(0, 500))),
+                    "properties": {"n": int(n)}}
+
+        # single-event POSTs
+        n_single = args.events
+        lat = np.empty(n_single)
+        for i in range(n_single):
+            body = json.dumps(event(i))
+            t0 = time.perf_counter()
+            conn.request("POST", f"/events.json?accessKey={key}", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            lat[i] = time.perf_counter() - t0
+            assert resp.status == 201, data[:200]
+        single = {
+            "p50_ms": round(float(np.percentile(lat, 50) * 1e3), 3),
+            "p99_ms": round(float(np.percentile(lat, 99) * 1e3), 3),
+            "events_per_sec": round(n_single / float(lat.sum())),
+        }
+
+        # batch POSTs (50 per request — the API max); throughput only
+        # counts if every PER-ITEM status is 201, not just the outer 200
+        n_batches = max(1, args.events // 50)
+        t0 = time.perf_counter()
+        for b in range(n_batches):
+            body = json.dumps([event(b * 50 + j) for j in range(50)])
+            conn.request("POST", f"/batch/events.json?accessKey={key}",
+                         body, {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            assert resp.status == 200, data[:200]
+            items = json.loads(data)
+            bad = [it for it in items if it.get("status") != 201]
+            assert not bad, f"batch items failed: {bad[:3]}"
+        batch_sec = time.perf_counter() - t0
+        batch = {
+            "events_per_sec": round(n_batches * 50 / batch_sec),
+            "batches": n_batches,
+        }
+
+        # filtered reads
+        def read_once():
+            conn.request(
+                "GET",
+                f"/events.json?accessKey={key}&event=view&limit=100")
+            resp = conn.getresponse()
+            data = resp.read()
+            assert resp.status == 200, data[:200]
+
+        read_once()
+        rlat = np.empty(50)
+        for i in range(50):
+            t0 = time.perf_counter()
+            read_once()
+            rlat[i] = time.perf_counter() - t0
+        reads = {"p50_ms": round(float(np.percentile(rlat, 50) * 1e3), 3)}
+
+    print(json.dumps({
+        "metric": "event_ingest",
+        "storage": args.storage,
+        "single_post": single,
+        "batch_post": batch,
+        "get_find_limit100": reads,
+        "total_events": n_single + n_batches * 50,
+    }))
+
+
+if __name__ == "__main__":
+    main()

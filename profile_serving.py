@@ -38,7 +38,6 @@ import argparse
 import http.client
 import json
 import pickle
-import threading
 import time
 
 import numpy as np
@@ -113,22 +112,12 @@ def main() -> None:
         jax.config.update("jax_platforms", args.platform)
     jax.devices()  # fail fast if the platform is unreachable
 
+    from profile_common import make_memory_storage
     from predictionio_tpu.core.workflow import prepare_deploy
-    from predictionio_tpu.data.events import MemoryEventStore
     from predictionio_tpu.models.als import ResidentScorer
     from predictionio_tpu.server.engine_server import EngineServer
-    from predictionio_tpu.storage.meta import MetaStore
-    from predictionio_tpu.storage.models import MemoryModelStore
-    from predictionio_tpu.storage.registry import (Storage, StorageConfig,
-                                                   set_storage)
 
-    st = Storage(StorageConfig(metadata_type="MEMORY",
-                               eventdata_type="MEMORY",
-                               modeldata_type="MEMORY"))
-    st._meta = MetaStore(":memory:")
-    st._events = MemoryEventStore()
-    st._models = MemoryModelStore()
-    set_storage(st)
+    st = make_memory_storage()
 
     factory = fabricate_instance(st, args.n_users, args.n_items, args.rank)
     rng = np.random.default_rng(1)
@@ -149,47 +138,24 @@ def main() -> None:
         args.queries)
 
     # 3. http: live EngineServer on localhost
+    from profile_common import server_thread
+
     server = EngineServer(engine_factory=factory, storage=st,
                           host="127.0.0.1", port=args.port)
-    loop_box = {}
+    with server_thread(server, args.port):
+        conn = http.client.HTTPConnection("127.0.0.1", args.port,
+                                          timeout=10)
+        it3 = iter(np.resize(users, args.queries + 200))
 
-    def run():
-        import asyncio
+        def http_query():
+            body = json.dumps({"user": str(int(next(it3))), "num": 10})
+            conn.request("POST", "/queries.json", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            assert resp.status == 200, data[:200]
 
-        loop = asyncio.new_event_loop()
-        asyncio.set_event_loop(loop)
-        loop_box["loop"] = loop
-        loop.run_until_complete(server.serve_forever())
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    deadline = time.time() + 15
-    while time.time() < deadline:
-        try:
-            conn = http.client.HTTPConnection("127.0.0.1", args.port,
-                                              timeout=2)
-            conn.request("GET", "/")
-            conn.getresponse().read()
-            break
-        except OSError:
-            time.sleep(0.2)
-    else:
-        raise TimeoutError("engine server did not come up")
-
-    conn = http.client.HTTPConnection("127.0.0.1", args.port, timeout=10)
-    it3 = iter(np.resize(users, args.queries + 200))
-
-    def http_query():
-        body = json.dumps({"user": str(int(next(it3))), "num": 10})
-        conn.request("POST", "/queries.json", body,
-                     {"Content-Type": "application/json"})
-        resp = conn.getresponse()
-        data = resp.read()
-        assert resp.status == 200, data[:200]
-
-    http_p50, http_p99 = measure(http_query, args.queries)
-    loop_box["loop"].call_soon_threadsafe(server.http.request_shutdown)
-    t.join(timeout=5)
+        http_p50, http_p99 = measure(http_query, args.queries)
 
     print(json.dumps({
         "metric": "predict_latency_decomposition",
