@@ -167,6 +167,40 @@ class TestDeviceResidentServing:
         np.testing.assert_allclose([s["score"] for s in host],
                                    [s["score"] for s in dev], rtol=1e-5)
 
+    def test_batch_predict_one_dispatch_matches_per_query(self,
+                                                          monkeypatch):
+        """The micro-batching serving path: one device dispatch for all
+        top-k queries, per-query fallback for rating shapes and cold
+        users, identical results either way."""
+        from predictionio_tpu.templates.recommendation.engine import (
+            ALSAlgorithm,
+            ALSAlgorithmParams,
+        )
+
+        m = self._model(4096)
+        algo = ALSAlgorithm(ALSAlgorithmParams())
+        queries = [{"user": "1", "num": 4}, {"user": "nobody", "num": 3},
+                   {"user": "2", "item": "7"}, {"user": "3", "num": 2}]
+        monkeypatch.setenv("PIO_ALS_SERVE", "device")
+        calls = {"n": 0}
+        orig = type(m._device_scorer()).recommend_batch
+
+        def counting(self_, user_ids, num, exclude=None):
+            calls["n"] += 1
+            return orig(self_, user_ids, num, exclude)
+
+        monkeypatch.setattr(type(m._device_scorer()), "recommend_batch",
+                            counting)
+        batched = algo.batch_predict(m, queries)
+        assert calls["n"] == 1, "all top-k queries must share one dispatch"
+        single = [algo.predict(m, q) for q in queries]
+        for b, s in zip(batched, single):
+            assert [x["item"] for x in b["itemScores"]] == \
+                [x["item"] for x in s["itemScores"]]
+            np.testing.assert_allclose(
+                [x["score"] for x in b["itemScores"]],
+                [x["score"] for x in s["itemScores"]], rtol=1e-5)
+
 
 class TestRecommendationEvaluation:
     def test_neg_rmse_grid(self, storage):
