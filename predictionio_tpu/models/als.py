@@ -1137,8 +1137,20 @@ class ResidentScorer:
         while k < want:
             k *= 2
         k = min(k, self.n_items)
-        vals, idx = self._topk(user_ids, k)
-        vals, idx = np.asarray(vals), np.asarray(idx)
+        # bucket the BATCH dimension too: the micro-batcher produces
+        # every size from 1..max_batch, and an unpadded B would compile
+        # a program per distinct size (measured: 172 ms p99 under 8
+        # concurrent clients vs ~7 ms once warm — r4). Pad rows reuse
+        # user 0 and are sliced off after the dispatch.
+        B = len(user_ids)
+        Bp = 1
+        while Bp < B:
+            Bp *= 2
+        ids = np.asarray(user_ids, np.int32)
+        if Bp != B:
+            ids = np.concatenate([ids, np.zeros(Bp - B, np.int32)])
+        vals, idx = self._topk(ids, k)
+        vals, idx = np.asarray(vals)[:B], np.asarray(idx)[:B]
         out = []
         for row in range(len(user_ids)):
             iv, vv = idx[row], vals[row]

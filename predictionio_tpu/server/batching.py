@@ -34,14 +34,23 @@ class MicroBatcher:
         self.max_wait = max_wait_ms / 1e3
         self._queue: asyncio.Queue = asyncio.Queue()
         self._worker: Optional[asyncio.Task] = None
-        # dedicated executor: the shared to_thread pool can be saturated
-        # by blocked request handlers, which would deadlock the very
-        # dispatch those handlers are waiting on
-        self._executor = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="pio-batcher")
+        self._executor: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
         self.batches = 0      # observability: dispatches issued
         self.submitted = 0    # queries accepted
         self.isolations = 0   # failed batches re-run query-by-query
+
+    def _get_executor(self) -> concurrent.futures.ThreadPoolExecutor:
+        # dedicated executor: the shared to_thread pool can be saturated
+        # by blocked request handlers, which would deadlock the very
+        # dispatch those handlers are waiting on. Created lazily (and
+        # re-created after stop()) so a server that shuts down and
+        # serves again — supervisor restart, repeated run() — gets a
+        # live pool instead of 500ing every batched query (r4 review).
+        if self._executor is None:
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="pio-batcher")
+        return self._executor
 
     def _ensure_worker(self) -> None:
         if self._worker is None or self._worker.done():
@@ -82,7 +91,7 @@ class MicroBatcher:
             loop = asyncio.get_running_loop()
             try:
                 results = await loop.run_in_executor(
-                    self._executor, self.fn_batch, queries)
+                    self._get_executor(), self.fn_batch, queries)
                 if len(results) != len(queries):
                     raise RuntimeError(
                         f"batch fn returned {len(results)} results for "
@@ -102,7 +111,7 @@ class MicroBatcher:
                         continue
                     try:
                         r = await loop.run_in_executor(
-                            self._executor, self.fn_batch, [q])
+                            self._get_executor(), self.fn_batch, [q])
                         if len(r) != 1:
                             raise RuntimeError(
                                 f"batch fn returned {len(r)} results for "
@@ -119,7 +128,11 @@ class MicroBatcher:
                     fut.set_result(r)
 
     def stop(self) -> None:
+        """Cancel the collector and release the executor. The batcher
+        stays usable: the next submit() restarts both."""
         if self._worker is not None:
             self._worker.cancel()
             self._worker = None
-        self._executor.shutdown(wait=False)
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+            self._executor = None

@@ -268,7 +268,16 @@ class EngineServer:
     # -- lifecycle -------------------------------------------------------------
 
     async def serve_forever(self) -> None:
-        await self.http.serve_forever()
+        try:
+            await self.http.serve_forever()
+        finally:
+            # the batcher's collector task must die BEFORE the loop
+            # closes: a pending queue.get() getter cancelled at
+            # interpreter teardown touches the closed loop and raises
+            # "Event loop is closed" (surfaced by the r4 concurrency
+            # harness)
+            if self._batcher is not None:
+                self._batcher.stop()
 
     def run(self) -> None:
         asyncio.run(self.serve_forever())

@@ -104,6 +104,10 @@ def main() -> None:
     ap.add_argument("--n-items", type=int, default=26744)
     ap.add_argument("--rank", type=int, default=64)
     ap.add_argument("--port", type=int, default=8971)
+    ap.add_argument("--concurrency", type=int, default=0,
+                    help="also measure N parallel HTTP clients against "
+                         "a --batching server (micro-batcher + "
+                         "one-dispatch batch_predict path)")
     args = ap.parse_args()
 
     import jax
@@ -157,6 +161,70 @@ def main() -> None:
 
         http_p50, http_p99 = measure(http_query, args.queries)
 
+    batched = None
+    if args.concurrency > 0:
+        # concurrent clients against a --batching server: the
+        # MicroBatcher coalesces in-flight queries and batch_predict
+        # serves each batch in ONE device dispatch
+        import threading
+
+        server2 = EngineServer(engine_factory=factory, storage=st,
+                               host="127.0.0.1", port=args.port + 1,
+                               batching=True)
+        with server_thread(server2, args.port + 1):
+            per_client = max(50, args.queries // args.concurrency)
+            lats: list = [[] for _ in range(args.concurrency)]
+            errors: list = []
+
+            def client(ci):
+                try:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", args.port + 1, timeout=10)
+                    rng_c = np.random.default_rng(ci)
+                    for _ in range(per_client):
+                        u = int(rng_c.integers(0, args.n_users))
+                        body = json.dumps({"user": str(u), "num": 10})
+                        t0 = time.perf_counter()
+                        conn.request("POST", "/queries.json", body,
+                                     {"Content-Type": "application/json"})
+                        resp = conn.getresponse()
+                        data = resp.read()
+                        dt = time.perf_counter() - t0
+                        assert resp.status == 200, data[:200]
+                        lats[ci].append(dt)  # only successes count
+                    conn.close()
+                except BaseException as e:  # surface after join
+                    errors.append((ci, e))
+
+            # warm pass: the first concurrent burst compiles the
+            # power-of-two batch-size buckets once (production pays
+            # this once per deploy); measure the steady state
+            def burst():
+                threads = [threading.Thread(target=client, args=(ci,))
+                           for ci in range(args.concurrency)]
+                t0 = time.perf_counter()
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                if errors:
+                    raise RuntimeError(
+                        f"{len(errors)} client(s) failed; first: "
+                        f"{errors[0]}")
+                return time.perf_counter() - t0
+
+            burst()
+            lats[:] = [[] for _ in range(args.concurrency)]
+            wall = burst()
+            flat = np.asarray([x for l in lats for x in l])
+            batched = {
+                "clients": args.concurrency,
+                "queries": int(flat.size),
+                "p50_ms": round(float(np.percentile(flat, 50) * 1e3), 4),
+                "p99_ms": round(float(np.percentile(flat, 99) * 1e3), 4),
+                "queries_per_sec": round(flat.size / wall),
+            }
+
     print(json.dumps({
         "metric": "predict_latency_decomposition",
         "geometry": {"n_users": args.n_users, "n_items": args.n_items,
@@ -168,6 +236,7 @@ def main() -> None:
         "http_ms": {"p50": round(http_p50, 4), "p99": round(http_p99, 4)},
         "host_overhead_ms": round(host_p50 - dev_p50, 4),
         "http_overhead_ms": round(http_p50 - host_p50, 4),
+        **({"batching_concurrent": batched} if batched else {}),
     }))
 
 

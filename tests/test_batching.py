@@ -30,6 +30,23 @@ class TestMicroBatcher:
         assert run(main()) == 70
         assert calls == [[7]]
 
+    def test_usable_after_stop(self):
+        """r4 review: a server that shuts down and serves again reuses
+        its batcher — stop() must leave it restartable, not 500 every
+        batched query on a dead executor."""
+        def fn(qs):
+            return [q * 2 for q in qs]
+
+        async def main():
+            mb = MicroBatcher(fn, max_batch=8, max_wait_ms=1.0)
+            a = await mb.submit(1)
+            mb.stop()
+            b = await mb.submit(2)  # restarts worker + executor
+            mb.stop()
+            return a, b
+
+        assert run(main()) == (2, 4)
+
     def test_concurrent_queries_coalesce(self):
         calls = []
 
