@@ -106,6 +106,27 @@ class InteractionData:
         return np.concatenate(us), np.concatenate(is_), np.concatenate(vs)
 
 
+def _vocab_add(vocab: Dict[str, int], keys) -> None:
+    """First-seen dense index assignment (shared vocabulary pass)."""
+    for k in keys:
+        if k not in vocab:
+            vocab[k] = len(vocab)
+
+
+def _map_chunk(users: Dict[str, int], items: Dict[str, int],
+               ents, tgts) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Map one chunk's string ids through the vocabularies. Events
+    ingested AFTER the vocabulary pass may carry unknown ids (training
+    against a live store re-runs find() per pass); they are skipped,
+    not crashed on — the next train picks them up. Returns
+    ``(user_idx, item_idx, keep_mask)`` so callers can mask parallel
+    value columns."""
+    u = np.asarray([users.get(x, -1) for x in ents], np.int32)
+    i = np.asarray([items.get(x, -1) for x in tgts], np.int32)
+    keep = (u >= 0) & (i >= 0)
+    return u[keep], i[keep], keep
+
+
 def read_interactions(
     find: Callable[[], Iterator],
     chunk_size: int = 65536,
@@ -122,27 +143,76 @@ def read_interactions(
     items: Dict[str, int] = {}
     n_events = 0
     for ents, tgts, _vals in iter_columnar(find(), chunk_size, value_fn):
-        for u in ents:
-            if u not in users:
-                users[u] = len(users)
-        for i in tgts:
-            if i not in items:
-                items[i] = len(items)
+        _vocab_add(users, ents)
+        _vocab_add(items, tgts)
         n_events += len(ents)
     user_ids = BiMap(users)
     item_ids = BiMap(items)
 
     def chunk_factory():
-        # events ingested AFTER the vocabulary pass may carry unknown
-        # ids (training against a live store re-runs find() per epoch);
-        # they are skipped, not crashed on — the next train picks them up
         for ents, tgts, vals in iter_columnar(find(), chunk_size, value_fn):
-            u = np.asarray([user_ids.get(x, -1) for x in ents], np.int32)
-            i = np.asarray([item_ids.get(x, -1) for x in tgts], np.int32)
-            keep = (u >= 0) & (i >= 0)
-            yield u[keep], i[keep], vals[keep]
+            u, i, keep = _map_chunk(users, items, ents, tgts)
+            yield u, i, vals[keep]
 
     return InteractionData(user_ids, item_ids, chunk_factory, n_events)
+
+
+def read_event_groups(
+    find: Callable[[], Iterator],
+    names: Sequence[str],
+    chunk_size: int = 65536,
+) -> Tuple[Dict[str, Tuple[np.ndarray, np.ndarray]], BiMap, BiMap]:
+    """Multi-event streaming read with ONE SHARED vocabulary pair —
+    the Universal-Recommender shape: several named event streams over
+    the same user/item spaces, index-mapped consistently.
+
+    ``find`` is a zero-argument callable returning a FRESH iterator
+    over ALL the named events (two combined scans total — vocabulary
+    pass + data pass — demuxed by ``e.event``; per-name finds would
+    cost 2·N scans of the log). Returns ``({name: (user_idx,
+    item_idx)}, user_ids, item_ids)`` with ids assigned in
+    encounter order. Memory is O(chunk + vocabulary) transient plus
+    the 8 B/event columnar outputs."""
+    wanted = set(names)
+    users: Dict[str, int] = {}
+    items: Dict[str, int] = {}
+    for e in find():
+        if e.target_entity_id is None or e.event not in wanted:
+            continue
+        if e.entity_id not in users:
+            users[e.entity_id] = len(users)
+        if e.target_entity_id not in items:
+            items[e.target_entity_id] = len(items)
+    user_ids = BiMap(users)
+    item_ids = BiMap(items)
+
+    bufs: Dict[str, Tuple[List[str], List[str]]] = \
+        {n: ([], []) for n in names}
+    parts: Dict[str, Tuple[list, list]] = {n: ([], []) for n in names}
+
+    def flush(name: str) -> None:
+        ents, tgts = bufs[name]
+        if ents:
+            u, i, _keep = _map_chunk(users, items, ents, tgts)
+            parts[name][0].append(u)
+            parts[name][1].append(i)
+            bufs[name] = ([], [])
+
+    for e in find():
+        if e.target_entity_id is None or e.event not in wanted:
+            continue
+        ents, tgts = bufs[e.event]
+        ents.append(e.entity_id)
+        tgts.append(e.target_entity_id)
+        if len(ents) == chunk_size:
+            flush(e.event)
+    out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    for n in names:
+        flush(n)
+        us, is_ = parts[n]
+        out[n] = ((np.concatenate(us) if us else np.zeros(0, np.int32)),
+                  (np.concatenate(is_) if is_ else np.zeros(0, np.int32)))
+    return out, user_ids, item_ids
 
 
 def subset_columnar(

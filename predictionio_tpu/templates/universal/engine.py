@@ -18,8 +18,9 @@ the item's own-event indicators (similar items by LLR).
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -50,27 +51,86 @@ class DataSourceParams:
 
 @dataclass
 class TrainingData:
+    """Columnar multi-event interactions with SHARED vocabularies
+    (streaming read — ``data/pipeline.read_event_groups``; O(chunk +
+    vocab) transient host memory, event order preserved per stream).
+    ``events`` materializes the legacy ``{name: [(user, item), …]}``
+    string shape on first access (cached) for small-data consumers
+    and tests."""
+
     app_name: str
-    # per event name: list of (user, item)
-    events: Dict[str, List[tuple]]
+    pairs: Dict[str, Tuple[np.ndarray, np.ndarray]]  # name → (uu, ii)
+    user_ids: BiMap
+    item_ids: BiMap
+
+    @functools.cached_property
+    def events(self) -> Dict[str, List[tuple]]:
+        u_inv = self.user_ids.inverse()
+        i_inv = self.item_ids.inverse()
+        return {name: [(u_inv[int(u)], i_inv[int(i)])
+                       for u, i in zip(uu, ii)]
+                for name, (uu, ii) in self.pairs.items()}
+
+    @classmethod
+    def from_events(cls, app_name: str,
+                    events: Dict[str, List[tuple]]) -> "TrainingData":
+        """Build from the legacy string-pair shape (tests/helpers)."""
+        user_ids = BiMap.string_int(
+            u for prs in events.values() for u, _ in prs)
+        item_ids = BiMap.string_int(
+            i for prs in events.values() for _, i in prs)
+        pairs = {
+            name: (np.asarray([user_ids[u] for u, _ in prs], np.int32),
+                   np.asarray([item_ids[i] for _, i in prs], np.int32))
+            for name, prs in events.items()}
+        return cls(app_name, pairs, user_ids, item_ids)
+
+    def subset_primary(self, primary: str,
+                       keep_mask: np.ndarray) -> "TrainingData":
+        """Drop primary rows where ``keep_mask`` is False and TRIM the
+        shared vocabularies to entities still present in ANY event —
+        an eval fold must not know held-out-only entities (they fall
+        back to popularity at query time, the cold path)."""
+        pairs = dict(self.pairs)
+        uu, ii = pairs[primary]
+        pairs[primary] = (uu[keep_mask], ii[keep_mask])
+        all_u = [p[0] for p in pairs.values() if p[0].size]
+        all_i = [p[1] for p in pairs.values() if p[1].size]
+        used_u = (np.unique(np.concatenate(all_u)) if all_u
+                  else np.zeros(0, np.int64))
+        used_i = (np.unique(np.concatenate(all_i)) if all_i
+                  else np.zeros(0, np.int64))
+        lut_u = np.full(len(self.user_ids), -1, np.int32)
+        lut_u[used_u] = np.arange(len(used_u), dtype=np.int32)
+        lut_i = np.full(len(self.item_ids), -1, np.int32)
+        lut_i[used_i] = np.arange(len(used_i), dtype=np.int32)
+        u_inv = self.user_ids.inverse()
+        i_inv = self.item_ids.inverse()
+        return TrainingData(
+            self.app_name,
+            {name: (lut_u[p[0]], lut_i[p[1]])
+             for name, p in pairs.items()},
+            BiMap({u_inv[int(u)]: int(j) for j, u in enumerate(used_u)}),
+            BiMap({i_inv[int(i)]: int(j) for j, i in enumerate(used_i)}))
 
 
 class URDataSource(DataSource):
     ParamsClass = DataSourceParams
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        from predictionio_tpu.data.pipeline import read_event_groups
+
         p: DataSourceParams = self.params
-        per: Dict[str, List[tuple]] = {name: [] for name in p.event_names}
-        for e in event_store.find(
-            p.app_name, entity_type="user", target_entity_type="item",
-            event_names=p.event_names, storage=ctx.storage,
-        ):
-            if e.target_entity_id is not None:
-                per[e.event].append((e.entity_id, e.target_entity_id))
-        if not per[p.event_names[0]]:
+        pairs, user_ids, item_ids = read_event_groups(
+            lambda: event_store.find(
+                p.app_name, entity_type="user",
+                target_entity_type="item", event_names=p.event_names,
+                storage=ctx.storage),
+            p.event_names)
+        if pairs[p.event_names[0]][0].size == 0:
             raise ValueError(
                 f"no primary event {p.event_names[0]!r} found; import events first")
-        return TrainingData(p.app_name, per)
+        return TrainingData(p.app_name, pairs, user_ids, item_ids)
 
     def read_eval(self, ctx: WorkflowContext):
         """Leave-one-out over the PRIMARY event (the Universal
@@ -80,23 +140,22 @@ class URDataSource(DataSource):
         ``{"user": u}`` query evaluates honestly."""
         td = self.read_training(ctx)
         primary = self.params.event_names[0]
-        pairs = td.events[primary]          # event-time order
-        last: Dict[str, int] = {}
-        count: Dict[str, int] = {}
-        for idx, (u, _) in enumerate(pairs):
-            last[u] = idx
-            count[u] = count.get(u, 0) + 1
-        held = {idx: u for u, idx in last.items() if count[u] >= 2}
-        train_pairs = [pr for idx, pr in enumerate(pairs)
-                       if idx not in held]
-        qa = [({"user": pairs[idx][0], "num": 10}, pairs[idx][1])
-              for idx in sorted(held)]
-        if not qa:
+        uu, ii = td.pairs[primary]          # event-time order
+        n_u = len(td.user_ids)
+        counts = np.bincount(uu, minlength=n_u)
+        last_row = np.full(n_u, -1, np.int64)
+        last_row[uu] = np.arange(uu.size)   # later rows overwrite
+        held = np.sort(last_row[(last_row >= 0) & (counts >= 2)])
+        if held.size == 0:
             raise ValueError(
                 "no user has ≥ 2 primary events to hold one out")
-        events = dict(td.events)
-        events[primary] = train_pairs
-        return [(TrainingData(td.app_name, events), {"fold": 0}, qa)]
+        keep_mask = np.ones(uu.size, bool)
+        keep_mask[held] = False
+        u_inv = td.user_ids.inverse()
+        i_inv = td.item_ids.inverse()
+        qa = [({"user": u_inv[int(uu[j])], "num": 10}, i_inv[int(ii[j])])
+              for j in held]
+        return [(td.subset_primary(primary, keep_mask), {"fold": 0}, qa)]
 
 
 @dataclass
@@ -171,38 +230,42 @@ class URAlgorithm(Algorithm):
     ParamsClass = URAlgorithmParams
 
     def sanity_check(self, data: TrainingData) -> None:
-        if not data.events:
+        if not data.pairs:
             raise ValueError("no events")
-        primary = next(iter(data.events))
-        if not data.events[primary]:
-            # the trainer drops empty event lists, so an empty PRIMARY
-            # would otherwise KeyError deep inside train/train_many —
-            # degenerate candidates must fail here (controller contract)
+        primary = next(iter(data.pairs))
+        if data.pairs[primary][0].size == 0:
+            # the trainer drops empty event streams, so an empty
+            # PRIMARY would otherwise KeyError deep inside
+            # train/train_many — degenerate candidates must fail here
+            # (controller contract)
             raise ValueError(
                 f"no events for the primary event {primary!r}")
 
     @staticmethod
     def _prepare(pd: TrainingData):
-        """The candidate-independent half of training: id maps,
-        index-mapped event pairs, per-user history, popularity."""
-        primary = next(iter(pd.events))
-        all_users = (u for pairs in pd.events.values() for u, _ in pairs)
-        all_items = (i for pairs in pd.events.values() for _, i in pairs)
-        user_ids = BiMap.string_int(all_users)
-        item_ids = BiMap.string_int(all_items)
+        """The candidate-independent half of training: event pairs
+        (already index-mapped by the streaming read), per-user history,
+        popularity."""
+        primary = next(iter(pd.pairs))
+        user_ids, item_ids = pd.user_ids, pd.item_ids
         n_items = len(item_ids)
-
-        def to_idx(pairs):
-            return (np.asarray([user_ids[u] for u, _ in pairs], np.int32),
-                    np.asarray([item_ids[i] for _, i in pairs], np.int32))
-
-        event_pairs = {name: to_idx(pairs)
-                       for name, pairs in pd.events.items() if pairs}
+        event_pairs = {name: p for name, p in pd.pairs.items()
+                       if p[0].size}
+        # per-user per-event item history (string user keys — query
+        # lookups come in as strings), grouped vectorized: stable sort
+        # by user preserves each stream's event-time order
+        u_inv = user_ids.inverse()
         user_history: Dict[str, Dict[str, List[int]]] = {}
-        for name, pairs in pd.events.items():
-            for u, i in pairs:
-                user_history.setdefault(u, {}).setdefault(name, []).append(
-                    item_ids[i])
+        for name, (uu, ii) in event_pairs.items():
+            order = np.argsort(uu, kind="stable")
+            us, is_ = uu[order], ii[order]
+            bounds = np.concatenate(
+                ([0], np.nonzero(np.diff(us))[0] + 1, [us.size]))
+            for lo, hi in zip(bounds[:-1], bounds[1:]):
+                if hi > lo:
+                    user_history.setdefault(
+                        u_inv[int(us[lo])], {})[name] = \
+                        [int(j) for j in is_[lo:hi]]
         _pu, pi = event_pairs[primary]
         popularity = np.bincount(pi, minlength=n_items).astype(np.float32)
         return (primary, user_ids, item_ids, n_items, event_pairs,

@@ -232,7 +232,8 @@ class TestURSanity:
         )
 
         algo = URAlgorithm(URAlgorithmParams())
-        td = TrainingData("app", {"buy": [], "view": [("u", "i")]})
+        td = TrainingData.from_events(
+            "app", {"buy": [], "view": [("u", "i")]})
         with pytest.raises(ValueError, match="primary"):
             algo.sanity_check(td)
 
