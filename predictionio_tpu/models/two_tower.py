@@ -11,6 +11,7 @@ full item-embedding table with one MXU matmul + top_k.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -62,6 +63,57 @@ def _towers(n_users: int, n_items: int, p: TwoTowerParams):
     return Tower(n_users, p), Tower(n_items, p)
 
 
+@functools.lru_cache(maxsize=8)
+def _compiled_train_epoch(n_users: int, n_items: int, embed_dim: int,
+                          hidden: Tuple[int, ...], out_dim: int):
+    """Geometry-keyed training program. ``learning_rate`` rides INSIDE
+    the optimizer state (``optax.inject_hyperparams``) and
+    ``temperature`` is a traced scalar argument, so eval-grid
+    candidates differing only in those share one executable — and
+    repeated train calls at one geometry stop re-tracing (the previous
+    per-call ``@jax.jit`` closure compiled every call).
+
+    Returns ``(user_tower, item_tower, opt, train_epoch)`` with
+    ``train_epoch(variables, opt_state, users_e, items_e, temperature)``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    geom = TwoTowerParams(embed_dim=embed_dim, hidden=list(hidden),
+                          out_dim=out_dim)
+    user_tower, item_tower = _towers(n_users, n_items, geom)
+    # the init value is a placeholder: the caller sets
+    # opt_state.hyperparams["learning_rate"] per candidate
+    opt = optax.inject_hyperparams(optax.adam)(learning_rate=1e-3)
+
+    def loss_fn(variables, bu, bi, temperature):
+        uvv, ivv = variables
+        ue = user_tower.apply(uvv, bu)          # (B, D)
+        ie = item_tower.apply(ivv, bi)          # (B, D)
+        logits = (ue @ ie.T) / temperature      # in-batch negatives
+        labels = jnp.arange(bu.shape[0])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    @jax.jit
+    def train_epoch(variables, opt_state, users_e, items_e, temperature):
+        def step(carry, batch):
+            variables, opt_state = carry
+            bu, bi = batch
+            loss, grads = jax.value_and_grad(loss_fn)(
+                variables, bu, bi, temperature)
+            updates, opt_state = opt.update(grads, opt_state)
+            variables = optax.apply_updates(variables, updates)
+            return (variables, opt_state), loss
+
+        (variables, opt_state), losses = jax.lax.scan(
+            step, (variables, opt_state), (users_e, items_e))
+        return variables, opt_state, losses.mean()
+
+    return user_tower, item_tower, opt, train_epoch
+
+
 def two_tower_train(
     user_idx: np.ndarray, item_idx: np.ndarray,
     n_users: int, n_items: int,
@@ -86,39 +138,19 @@ def two_tower_train(
     one extra counting pass."""
     import jax
     import jax.numpy as jnp
-    import optax
 
     p = params
-    user_tower, item_tower = _towers(n_users, n_items, p)
+    user_tower, item_tower, opt, epoch_fn = _compiled_train_epoch(
+        n_users, n_items, p.embed_dim, tuple(p.hidden), p.out_dim)
     rng = jax.random.PRNGKey(p.seed)
     ru, ri = jax.random.split(rng)
     uv = user_tower.init(ru, jnp.zeros((1,), jnp.int32))
     iv = item_tower.init(ri, jnp.zeros((1,), jnp.int32))
+    temperature = jnp.float32(p.temperature)
 
-    opt = optax.adam(p.learning_rate)
-
-    def loss_fn(variables, bu, bi):
-        uvv, ivv = variables
-        ue = user_tower.apply(uvv, bu)          # (B, D)
-        ie = item_tower.apply(ivv, bi)          # (B, D)
-        logits = (ue @ ie.T) / p.temperature    # in-batch negatives
-        labels = jnp.arange(bu.shape[0])
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, labels).mean()
-
-    @jax.jit
     def train_epoch(variables, opt_state, users_e, items_e):
-        def step(carry, batch):
-            variables, opt_state = carry
-            bu, bi = batch
-            loss, grads = jax.value_and_grad(loss_fn)(variables, bu, bi)
-            updates, opt_state = opt.update(grads, opt_state)
-            variables = optax.apply_updates(variables, updates)
-            return (variables, opt_state), loss
-
-        (variables, opt_state), losses = jax.lax.scan(
-            step, (variables, opt_state), (users_e, items_e))
-        return variables, opt_state, losses.mean()
+        return epoch_fn(variables, opt_state, users_e, items_e,
+                        temperature)
 
     n = len(user_idx)
     if pair_chunks is not None and n == 0:
@@ -142,6 +174,9 @@ def two_tower_train(
     n_batches = max(1, n // B)
     variables = (uv, iv)
     opt_state = opt.init(variables)
+    # the candidate's learning rate enters THROUGH the optimizer state
+    # (a traced leaf), not the compiled program
+    opt_state.hyperparams["learning_rate"] = jnp.float32(p.learning_rate)
 
     if n_dev > 1:
         from jax.sharding import NamedSharding, PartitionSpec
@@ -169,6 +204,11 @@ def two_tower_train(
                 state, latest = ckpt.restore_latest_compatible(template)
                 variables, opt_state = state["variables"], state["opt_state"]
                 start_epoch = latest
+                # THIS run's learning rate wins over the checkpointed
+                # one — a restart that lowers lr to anneal must not
+                # silently train at the old rate (r4 review)
+                opt_state.hyperparams["learning_rate"] = \
+                    jnp.float32(p.learning_rate)
             except CheckpointGeometryError:
                 # CONFIRMED stale (e.g. different tower geometry) →
                 # fresh start; wipe so the stale latest_step can't

@@ -259,6 +259,30 @@ class TestTwoTowerResume:
                                        rtol=1e-5, atol=1e-6)
 
 
+    def test_resume_honors_new_learning_rate(self, tmp_path):
+        """r4: lr lives in the optimizer state now — a restart that
+        changes learning_rate must train at the NEW rate, not the
+        checkpointed one. lr=0 on resume ⇒ params must not move."""
+        from predictionio_tpu.models.two_tower import (
+            TwoTowerParams,
+            two_tower_train,
+        )
+
+        u, i, nu, ni = self._pairs()
+        base = dict(embed_dim=16, hidden=[32], out_dim=16, batch_size=64,
+                    seed=3)
+        ckdir = str(tmp_path / "ck")
+        frozen = two_tower_train(u, i, nu, ni, TwoTowerParams(
+            **base, epochs=2, learning_rate=0.01, checkpoint_dir=ckdir))
+        resumed = two_tower_train(u, i, nu, ni, TwoTowerParams(
+            **base, epochs=4, learning_rate=0.0, checkpoint_dir=ckdir))
+        import jax
+
+        for a, b in zip(jax.tree.leaves(frozen), jax.tree.leaves(resumed)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6)
+
+
 class TestSeqRecResume:
     def _seqs(self, n_users=30, n_items=20, seed=2):
         rng = np.random.default_rng(seed)

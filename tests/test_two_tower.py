@@ -48,6 +48,33 @@ class TestTwoTowerModel:
         acc = self._retrieval_accuracy(uv, embeds, p)
         assert acc > 0.8, acc
 
+    def test_lr_temperature_grid_shares_executable(self, clique_pairs):
+        """r4: learning_rate rides in the optimizer state and
+        temperature is traced, so candidates differing only in those
+        share one geometry-keyed compiled program."""
+        import predictionio_tpu.models.two_tower as tt
+
+        u, i = clique_pairs
+        nu, ni = 40, 20
+        base = dict(embed_dim=8, hidden=[16], out_dim=8, batch_size=64,
+                    epochs=2, seed=3)
+        tt._compiled_train_epoch.cache_clear()
+        outs = []
+        for lr, temp in ((0.01, 0.1), (0.05, 0.1), (0.01, 0.5)):
+            outs.append(tt.two_tower_train(
+                u, i, nu, ni, tt.TwoTowerParams(
+                    **base, learning_rate=lr, temperature=temp)))
+        info = tt._compiled_train_epoch.cache_info()
+        assert info.misses == 1, \
+            f"lr/temperature grid built {info.misses} programs"
+        # the hyperparameters genuinely reach the program
+        import jax
+
+        a = jax.tree.leaves(outs[0][0])[0]
+        b = jax.tree.leaves(outs[1][0])[0]
+        c = jax.tree.leaves(outs[2][0])[0]
+        assert not np.allclose(a, b) and not np.allclose(a, c)
+
     def test_mesh_training_runs(self, clique_pairs, cpu_mesh):
         us, its = clique_pairs
         p = TwoTowerParams(embed_dim=8, out_dim=8, hidden=[16], epochs=3,
