@@ -950,6 +950,10 @@ def als_train_prepared(prep: ALSPrepared, p: ALSParams, device=None,
             # every future resume restores the bad checkpoint again.
             # Transient read errors propagate instead — wiping on those
             # would destroy valid checkpoints (ADVICE r3).
+            import warnings
+
+            warnings.warn(
+                "ALS checkpoints are stale (geometry/format change) — wiped; training restarts from scratch", RuntimeWarning)
             checkpointer.clear()
 
     if start >= p.iterations and U0 is not None:

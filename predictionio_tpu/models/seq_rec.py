@@ -134,7 +134,7 @@ def forward(params: Dict, seqs, p: SeqRecParams, mesh=None,
     return _ln(x, params["ln_f"]["g"], params["ln_f"]["b"]) * mask
 
 
-def _loss(params, seqs, targets, p: SeqRecParams, mesh=None):
+def _loss(params, seqs, targets, p: SeqRecParams, mesh=None, l2=None):
     """Mean masked cross-entropy of next-item prediction.
 
     targets[b, t] = seqs[b, t+1]-style shifted ids, 0 where padded.
@@ -148,8 +148,12 @@ def _loss(params, seqs, targets, p: SeqRecParams, mesh=None):
     tgt_logp = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     m = (targets > 0).astype(jnp.float32)
     loss = -(tgt_logp * m).sum() / jnp.maximum(m.sum(), 1.0)
-    if p.l2:
-        loss = loss + p.l2 * sum(
+    # l2 (when given) is a TRACED scalar — the compiled trainer passes
+    # it so an eval grid over regularization shares one executable;
+    # p.l2 is the Python-static path for direct callers
+    reg = p.l2 if l2 is None else l2
+    if l2 is not None or p.l2:
+        loss = loss + reg * sum(
             jnp.sum(w ** 2) for w in jax.tree.leaves(params))
     return loss
 
@@ -186,25 +190,41 @@ def make_training_batches(sequences, p: SeqRecParams, seed: int = 0
     return X.reshape(n_batches, bs, S), Y.reshape(n_batches, bs, S)
 
 
+def _make_tx():
+    """The optimizer, constructed ONE way everywhere so checkpointed
+    state and the compiled trainer always agree on structure.
+    learning_rate is a placeholder: callers set
+    ``opt_state.hyperparams["learning_rate"]`` per candidate."""
+    import optax
+
+    return optax.inject_hyperparams(optax.adam)(learning_rate=1e-3)
+
+
 @functools.lru_cache(maxsize=8)
 def _train_compiled(hidden: int, num_blocks: int, num_heads: int,
-                    seq_len: int, lr: float, epochs: int, l2: float,
-                    mesh=None):
-    """Jitted trainer keyed on hyperparameters (the geometry lives in the
-    traced array shapes) — `pio eval` candidates sharing shapes reuse it.
-    ``mesh`` routes attention through the sequence-parallel ring path."""
+                    seq_len: int, epochs: int, use_l2: bool, mesh=None):
+    """Jitted trainer keyed on GEOMETRY (array shapes are traced):
+    ``lr`` rides inside the optimizer state (optax.inject_hyperparams)
+    and ``l2`` is a traced scalar, so a `pio eval` grid over either
+    shares one executable. ``use_l2`` is static: the common l2=0 path
+    must not pay the full parameter-norm reduction for a multiply by a
+    traced zero. ``mesh`` routes attention through the
+    sequence-parallel ring path. Signature:
+    ``train(params, opt_state, X, Y, l2)``."""
     import jax
+
     import optax
 
     p = SeqRecParams(hidden=hidden, num_blocks=num_blocks,
-                     num_heads=num_heads, seq_len=seq_len, lr=lr, l2=l2)
-    tx = optax.adam(lr)
+                     num_heads=num_heads, seq_len=seq_len, l2=0.0)
+    tx = _make_tx()
 
-    def train(params, opt_state, X, Y):
+    def train(params, opt_state, X, Y, l2):
         def batch_step(carry, xy):
             params, opt_state = carry
-            loss, grads = jax.value_and_grad(_loss)(params, xy[0], xy[1], p,
-                                                    mesh)
+            loss, grads = jax.value_and_grad(_loss)(
+                params, xy[0], xy[1], p, mesh,
+                l2 if use_l2 else None)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return (params, opt_state), loss
@@ -241,15 +261,19 @@ def seq_rec_train(sequences, n_items: int, p: SeqRecParams, mesh=None,
         mesh = None
     X, Y = make_training_batches(sequences, p, seed=p.seed)
     params = jax.tree.map(jnp.asarray, init_params(n_items, p))
-    opt_state = optax.adam(p.lr).init(params)
 
     def compiled(n_epochs: int):
         return _train_compiled(p.hidden, p.num_blocks, p.num_heads,
-                               p.seq_len, float(p.lr), int(n_epochs),
-                               float(p.l2), mesh)
+                               p.seq_len, int(n_epochs), bool(p.l2), mesh)
+
+    opt_state = _make_tx().init(params)
+    # the candidate's lr enters THROUGH the optimizer state (a traced
+    # leaf); l2 is a traced argument — neither recompiles the program
+    opt_state.hyperparams["learning_rate"] = jnp.float32(p.lr)
+    l2 = jnp.float32(p.l2)
 
     if not p.checkpoint_dir:
-        params, _, losses = compiled(p.epochs)(params, opt_state, X, Y)
+        params, _, losses = compiled(p.epochs)(params, opt_state, X, Y, l2)
         return params, np.asarray(losses)
 
     # checkpointed path: epoch blocks between saves; params + optimizer
@@ -269,6 +293,9 @@ def seq_rec_train(sequences, n_items: int, p: SeqRecParams, mesh=None,
             state, latest = ckpt.restore_latest_compatible(template)
             params = jax.tree.map(jnp.asarray, state["params"])
             opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
+            # THIS run's lr wins over the checkpointed one (annealing
+            # restarts must not silently keep the old rate)
+            opt_state.hyperparams["learning_rate"] = jnp.float32(p.lr)
             start = min(int(latest), p.epochs)
         except CheckpointGeometryError:
             # CONFIRMED stale (different geometry) → fresh start; WIPE
@@ -276,12 +303,17 @@ def seq_rec_train(sequences, n_items: int, p: SeqRecParams, mesh=None,
             # shadowed by the stale latest_step and every future resume
             # restores the bad checkpoint again. Transient read errors
             # propagate — wiping on those destroys valid checkpoints.
+            import warnings
+
+            warnings.warn(
+                "seq_rec checkpoints are stale (geometry/format change) — wiped; training restarts from scratch",
+                RuntimeWarning)
             ckpt.clear()
     loss_parts = []
     epoch = start
     while epoch < p.epochs:
         n = min(max(1, p.checkpoint_every), p.epochs - epoch)
-        params, opt_state, losses = compiled(n)(params, opt_state, X, Y)
+        params, opt_state, losses = compiled(n)(params, opt_state, X, Y, l2)
         loss_parts.append(np.asarray(losses))
         epoch += n
         ckpt.save(epoch, {"params": jax.tree.map(np.asarray, params),

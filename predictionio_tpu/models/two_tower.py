@@ -214,6 +214,11 @@ def two_tower_train(
                 # fresh start; wipe so the stale latest_step can't
                 # shadow this run's saves. Transient read errors
                 # propagate — wiping would destroy valid checkpoints.
+                import warnings
+
+                warnings.warn(
+                    "two_tower checkpoints are stale (geometry/format change) — wiped; training restarts from scratch",
+                    RuntimeWarning)
                 ckpt.clear()
 
     last_loss = None

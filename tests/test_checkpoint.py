@@ -350,6 +350,29 @@ class TestSeqRecResume:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-5, atol=1e-6)
 
+    def test_resume_honors_new_learning_rate(self, tmp_path):
+        """r4: lr rides in the optimizer state — a restart with lr=0
+        must not move the checkpointed params (mirrors the two_tower
+        test; this is the seq_rec side of the same code path)."""
+        from predictionio_tpu.models.seq_rec import (
+            SeqRecParams,
+            seq_rec_train,
+        )
+
+        seqs, n_items = self._seqs()
+        base = dict(hidden=16, num_blocks=1, num_heads=2, seq_len=8,
+                    batch_size=16, seed=4)
+        ckdir = str(tmp_path / "ck")
+        frozen, _ = seq_rec_train(seqs, n_items, SeqRecParams(
+            **base, lr=1e-3, epochs=2, checkpoint_dir=ckdir))
+        resumed, _ = seq_rec_train(seqs, n_items, SeqRecParams(
+            **base, lr=0.0, epochs=4, checkpoint_dir=ckdir))
+        import jax
+
+        for a, b in zip(jax.tree.leaves(frozen), jax.tree.leaves(resumed)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6)
+
     def test_completed_run_restores_without_retraining(self, tmp_path):
         from predictionio_tpu.models.seq_rec import (
             SeqRecParams,

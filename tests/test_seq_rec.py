@@ -45,6 +45,31 @@ class TestModel:
             scores = seq_rec_scores(params, hist, hp)
             assert int(np.argmax(scores)) == want
 
+    def test_lr_l2_grid_shares_executable(self):
+        """r4: lr rides in the optimizer state and l2 is traced — lr
+        candidates share ONE compiled program; nonzero-l2 candidates
+        share a second (l2 on/off is static so the default l2=0 path
+        never pays the parameter-norm reduction)."""
+        import predictionio_tpu.models.seq_rec as sr
+
+        seqs, n = _cyclic_sequences()
+        sr._train_compiled.cache_clear()
+        outs = []
+        for lr, l2 in ((1e-3, 0.0), (5e-3, 0.0),      # share program 1
+                       (1e-3, 1e-3), (1e-3, 1e-2)):   # share program 2
+            cfg = dict(TINY)
+            cfg.update(lr=lr, l2=l2)
+            params, _ = seq_rec_train(seqs, n, SeqRecParams(**cfg))
+            outs.append(params)
+        info = sr._train_compiled.cache_info()
+        assert info.misses == 2, \
+            f"lr/l2 grid built {info.misses} programs (want 2: l2 off/on)"
+        import jax
+
+        a, b, c, d = (jax.tree.leaves(o)[0] for o in outs)
+        assert not np.allclose(a, b) and not np.allclose(a, c)
+        assert not np.allclose(c, d)
+
     def test_batching_shapes_and_padding(self):
         p = SeqRecParams(**{**TINY, "seq_len": 8, "batch_size": 4})
         X, Y = make_training_batches([[1, 2, 3], [4, 5], [6]], p)
