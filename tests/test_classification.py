@@ -37,6 +37,25 @@ class TestLogReg:
         acc = (logreg_predict(W, b, X) == y).mean()
         assert acc > 0.97, acc
 
+    def test_reg_lr_candidates_share_program(self, blobs):
+        """r4: data/reg/lr are jit ARGUMENTS (not closed-over
+        constants), so same-shape candidates — and fresh same-shape
+        datasets — reuse one compiled trainer."""
+        import predictionio_tpu.models.linear as lin
+
+        X, y = blobs
+        lin._compiled_logreg.cache_clear()
+        outs = []
+        for reg in (0.0, 1e-3, 1e-1):
+            outs.append(logreg_train(X, y, LogisticRegressionParams(
+                num_classes=3, iterations=20, reg=reg)))
+        # a fresh dataset with the SAME shapes must also reuse it
+        rng = np.random.default_rng(9)
+        logreg_train(X + rng.normal(0, 0.01, X.shape), y,
+                     LogisticRegressionParams(num_classes=3, iterations=20))
+        assert lin._compiled_logreg.cache_info().misses == 1
+        assert not np.allclose(outs[0][0], outs[2][0])  # reg reaches loss
+
     def test_adam_fallback(self, blobs):
         X, y = blobs
         W, b = logreg_train(X, y, LogisticRegressionParams(
@@ -45,12 +64,19 @@ class TestLogReg:
         assert (logreg_predict(W, b, X) == y).mean() > 0.95
 
     def test_mesh_data_parallel(self, blobs, cpu_mesh):
+        """Sharded and single-device training optimize the same loss.
+        With reg > 0 the optimum is unique (softmax CE alone is
+        shift-invariant in W's class columns), so converged parameters
+        agree; f32 reduction ORDER genuinely differs across shardings,
+        so bitwise equality is not the contract."""
         X, y = blobs
-        W1, b1 = logreg_train(X, y, LogisticRegressionParams(
-            num_classes=3, iterations=40))
-        W8, b8 = logreg_train(X, y, LogisticRegressionParams(
-            num_classes=3, iterations=40), mesh=cpu_mesh)
-        # same full-batch optimization → near-identical params
+        p = dict(num_classes=3, iterations=60, reg=1e-3)
+        W1, b1 = logreg_train(X, y, LogisticRegressionParams(**p))
+        W8, b8 = logreg_train(X, y, LogisticRegressionParams(**p),
+                              mesh=cpu_mesh)
+        # measured divergence at this setup is ~0 (the line searches
+        # coincide once the optimum is unique); 1e-3 leaves f32
+        # reduction-order headroom without masking a dropped-shard bug
         assert np.allclose(W1, W8, atol=1e-3), np.abs(W1 - W8).max()
         p1 = logreg_predict(W1, b1, X)
         p8 = logreg_predict(W8, b8, X)
