@@ -4,7 +4,6 @@ train through one stacked (vmapped) program — the reference's
 FastEvalEngine caching plus SURVEY.md §2d P4's TPU upgrade of the
 sequential grid."""
 
-import time
 
 import numpy as np
 import pytest
