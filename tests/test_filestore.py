@@ -184,3 +184,47 @@ def test_torn_tail_write_is_ignored(tmp_path, store):
     s3 = NativeEventLogStore(str(tmp_path / "log"))
     assert [e.event_id for e in s3.find(APP)] == ids + [new_id]
     s3.close()
+
+
+def test_quickstart_on_eventlog_storage(tmp_path):
+    """End-to-end train → query with EVENTDATA on the C++ event log —
+    the deployment docs recommend for bulk events (the SPI tests cover
+    the store alone; this proves the whole workflow path, env-config →
+    registry → native store → streaming read → ALS → serving)."""
+    import numpy as np
+
+    from predictionio_tpu.core.workflow import prepare_deploy, run_train
+    from predictionio_tpu.storage.registry import (Storage, StorageConfig,
+                                                   set_storage)
+    from tests.test_workflow import FACTORY, seed_ratings
+
+    cfg = StorageConfig.from_env({
+        "PIO_HOME": str(tmp_path),
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NATIVE",
+        "PIO_STORAGE_SOURCES_NATIVE_TYPE": "EVENTLOG",
+    })
+    assert cfg.eventdata_type == "EVENTLOG"
+    st = Storage(cfg)
+    set_storage(st)
+    built = False
+    try:
+        try:
+            st.events  # builds the C++ engine lazily
+            built = True
+        except RuntimeError as e:  # only the no-g++ signal may skip
+            pytest.skip(f"native engine unavailable: {e}")
+        seed_ratings(st)
+        run_train(FACTORY, variant={
+            "id": "elq", "engineFactory": FACTORY,
+            "datasource": {"params": {"appName": "TestApp"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 4, "numIterations": 3, "lambda": 0.05}}],
+        }, storage=st, use_mesh=False)
+        res = prepare_deploy(engine_factory=FACTORY,
+                             storage=st).query({"user": "0", "num": 3})
+        assert len(res["itemScores"]) == 3
+        assert np.isfinite([s["score"] for s in res["itemScores"]]).all()
+    finally:
+        if built:
+            st.events.close()
+        set_storage(None)
