@@ -1062,13 +1062,18 @@ def maybe_resident_scorer(U, V, cached=None):
     scoring) below that, where a matvec beats a device dispatch and
     tests/demos stay free of compile time. ``PIO_ALS_SERVE`` overrides:
     "host" forces None, "device" forces a scorer. Pass the previous
-    return value as ``cached`` so the scorer is built once per model.
+    return value as ``cached`` so the scorer is built once per model;
+    a cached scorer is reused only if it was built from these exact
+    U/V arrays (identity check) — a caller that retrains and swaps
+    factors gets a fresh scorer, never stale scores.
     """
     mode = os.environ.get("PIO_ALS_SERVE", "auto")
     if mode == "host" or (mode == "auto"
                           and V.shape[0] < _SERVE_MIN_ITEMS):
         return None
-    return cached if cached is not None else ResidentScorer(U, V)
+    if cached is not None and cached.built_from(U, V):
+        return cached
+    return ResidentScorer(U, V)
 
 
 class ResidentScorer:
@@ -1085,10 +1090,27 @@ class ResidentScorer:
 
     _TILE = 2048  # item-tile width of the streaming kernel
 
+    def built_from(self, U, V) -> bool:
+        """True iff this scorer was constructed from exactly these
+        host arrays (used by :func:`maybe_resident_scorer` to reuse
+        across calls without ever serving stale factors)."""
+        if self._source is None:
+            return False
+        return self._source[0]() is U and self._source[1]() is V
+
     def __init__(self, U: np.ndarray, V: np.ndarray):
         import jax
         import jax.numpy as jnp
 
+        # weak identity of the host arrays this scorer was built from,
+        # so maybe_resident_scorer can detect a factor swap after
+        # retrain (weakref, not id(): a freed array's address can be
+        # recycled by a new allocation)
+        import weakref
+        try:
+            self._source = (weakref.ref(U), weakref.ref(V))
+        except TypeError:  # non-weakref-able array-likes (e.g. lists)
+            self._source = None
         self.n_users, self.rank = U.shape
         self.n_items = V.shape[0]
         if self.n_items >= 1 << 24:

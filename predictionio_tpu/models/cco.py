@@ -123,7 +123,8 @@ def _cooccurrence(primary: Tuple[np.ndarray, np.ndarray],
 
 def _cooccurrence_sparse(primary: Tuple[np.ndarray, np.ndarray],
                          secondary: Tuple[np.ndarray, np.ndarray],
-                         n_users: int, n_b: int
+                         n_users: int, n_b: int,
+                         budget: int = 8_000_000,
                          ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Sparse C = PᵀS: only the live entries, by vectorized per-user
     pair expansion. Returns (rows, cols, counts) with rows ascending.
@@ -141,7 +142,11 @@ def _cooccurrence_sparse(primary: Tuple[np.ndarray, np.ndarray],
     # index arrays at once (r4 review). ~8M pairs ≈ 300 MB transient.
     all_pairs = (np.diff(p_indptr) * np.diff(s_indptr)).astype(np.int64)
     cum = np.concatenate(([0], np.cumsum(all_pairs)))
-    budget = max(8_000_000, int(all_pairs.max(initial=0)))
+    # FIXED budget: a user whose own pair count exceeds it (possible
+    # with downsampling disabled, cap<=0) is expanded in budget-sized
+    # sub-slices below rather than by inflating the budget to the max
+    # per-user count — the latter made transient memory unbounded
+    # (r4 advisor).
     bounds = [0]
     while bounds[-1] < n_users:
         nxt = int(np.searchsorted(cum, cum[bounds[-1]] + budget,
@@ -151,18 +156,29 @@ def _cooccurrence_sparse(primary: Tuple[np.ndarray, np.ndarray],
     for start, stop in zip(bounds[:-1], bounds[1:]):
         p_cnt = np.diff(p_indptr[start:stop + 1])
         s_cnt = np.diff(s_indptr[start:stop + 1])
-        pairs = p_cnt * s_cnt
+        pairs = (p_cnt * s_cnt).astype(np.int64)
         total = int(pairs.sum())
         if total == 0:
             continue
-        seg = np.repeat(np.arange(stop - start), pairs)  # chunk-local user
         starts = np.concatenate(([0], np.cumsum(pairs)))
-        within = np.arange(total, dtype=np.int64) - starts[seg]
-        p_lo = p_indptr[start:stop][seg] + within // s_cnt[seg]
-        s_lo = s_indptr[start:stop][seg] + within % s_cnt[seg]
-        lin = p_idx[p_lo].astype(np.int64) * n_b + s_idx[s_lo]
-        uniq, cnt = np.unique(lin, return_counts=True)
-        parts.append((uniq, cnt.astype(np.float32)))
+        for lo in range(0, total, budget):
+            hi = min(lo + budget, total)
+            if lo == 0 and hi == total:
+                # common case (one sub-slice per chunk): O(total)
+                # repeat beats the searchsorted mapping below
+                seg = np.repeat(np.arange(stop - start), pairs)
+                within = np.arange(total, dtype=np.int64) - starts[seg]
+            else:
+                gidx = np.arange(lo, hi, dtype=np.int64)
+                # side="right" maps each global pair index to its
+                # owning user, skipping zero-pair users' empty ranges
+                seg = np.searchsorted(starts, gidx, side="right") - 1
+                within = gidx - starts[seg]
+            p_lo = p_indptr[start:stop][seg] + within // s_cnt[seg]
+            s_lo = s_indptr[start:stop][seg] + within % s_cnt[seg]
+            lin = p_idx[p_lo].astype(np.int64) * n_b + s_idx[s_lo]
+            uniq, cnt = np.unique(lin, return_counts=True)
+            parts.append((uniq, cnt.astype(np.float32)))
     if not parts:
         return (np.zeros(0, np.int32), np.zeros(0, np.int32),
                 np.zeros(0, np.float32))

@@ -109,9 +109,12 @@ class EmbeddedIndex:
                 f.truncate(good_end)
 
     def _log(self, op: Dict[str, Any]) -> None:
+        self._log_line(json.dumps(op, separators=(",", ":")))
+
+    def _log_line(self, line: str) -> None:
         if self._wal is None:
             return
-        self._wal.write(json.dumps(op, separators=(",", ":")) + "\n")
+        self._wal.write(line + "\n")
         self._wal.flush()
         self._wal_ops += 1
         if self._wal_ops > 4 * max(len(self._docs), 64):
@@ -177,8 +180,13 @@ class EmbeddedIndex:
         """Upsert one document (ES index-by-id semantics)."""
         with self._lock:
             self._check_open()
+            # serialize before applying (same memory/WAL-sync argument
+            # as index_batch): a non-JSON-able doc must fail before it
+            # goes live in memory, or it silently vanishes on restart
+            line = json.dumps({"op": "index", "id": doc_id, "doc": doc},
+                              separators=(",", ":"))
             self._apply_index(doc_id, doc)
-            self._log({"op": "index", "id": doc_id, "doc": doc})
+            self._log_line(line)
 
     def index_batch(self, docs) -> None:
         """Upsert many documents with ONE WAL append + flush (the ES
@@ -187,12 +195,17 @@ class EmbeddedIndex:
         event scale run (r4)."""
         with self._lock:
             self._check_open()
-            lines = []
+            # serialize EVERY line before touching the in-memory index:
+            # if one doc is non-serializable, rejecting the whole batch
+            # up front keeps memory and WAL in sync (applying first
+            # would leave earlier docs live in memory but lost on
+            # restart, and desync the rest of the batch)
+            docs = list(docs)
+            lines = [json.dumps({"op": "index", "id": doc_id, "doc": doc},
+                                separators=(",", ":"))
+                     for doc_id, doc in docs]
             for doc_id, doc in docs:
                 self._apply_index(doc_id, doc)
-                lines.append(json.dumps(
-                    {"op": "index", "id": doc_id, "doc": doc},
-                    separators=(",", ":")))
             if self._wal is not None and lines:
                 self._wal.write("\n".join(lines) + "\n")
                 self._wal.flush()

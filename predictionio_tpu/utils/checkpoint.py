@@ -34,15 +34,29 @@ class TrainCheckpointer:
     """
 
     def __init__(self, directory: str, keep: int = 3) -> None:
-        import orbax.checkpoint as ocp
-
         self.directory = os.path.abspath(directory)
         self._keep = keep
+        self._reader = None  # lazy StandardCheckpointer, one per instance
         os.makedirs(self.directory, exist_ok=True)
-        self._mgr = ocp.CheckpointManager(
+        self._mgr = self._make_mgr()
+
+    def _make_mgr(self):
+        """SINGLE spelling of the manager options — __init__, clear()
+        and the prune-restart path all construct through here, so a
+        future option cannot silently fail to survive a restart."""
+        import orbax.checkpoint as ocp
+
+        return ocp.CheckpointManager(
             self.directory,
-            options=ocp.CheckpointManagerOptions(max_to_keep=keep),
+            options=ocp.CheckpointManagerOptions(max_to_keep=self._keep),
         )
+
+    def _metadata_reader(self):
+        import orbax.checkpoint as ocp
+
+        if self._reader is None:
+            self._reader = ocp.StandardCheckpointer()
+        return self._reader
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -107,9 +121,7 @@ class TrainCheckpointer:
                           for leaf in jax.tree.leaves(template))
         mismatches = 0
         last_err: Optional[Exception] = None
-        import orbax.checkpoint as ocp
-
-        reader = ocp.StandardCheckpointer()
+        reader = self._metadata_reader()
         # steps proven stale or torn — and ONLY those — may be pruned
         # after a successful fallback; a step skipped on a possibly
         # transient error must survive (it may be the best checkpoint)
@@ -185,11 +197,7 @@ class TrainCheckpointer:
                 # restart the manager so its in-memory step cache
                 # cannot keep serving the pruned steps
                 self._mgr.close()
-                self._mgr = ocp.CheckpointManager(
-                    self.directory,
-                    options=ocp.CheckpointManagerOptions(
-                        max_to_keep=self._keep),
-                )
+                self._mgr = self._make_mgr()
             return state, int(step)
         if last_err is None and mismatches > 0:
             raise CheckpointGeometryError(
@@ -213,18 +221,16 @@ class TrainCheckpointer:
         destroys valid checkpoints."""
         import shutil
 
-        import orbax.checkpoint as ocp
-
         self._mgr.close()
         shutil.rmtree(self.directory, ignore_errors=True)
         os.makedirs(self.directory, exist_ok=True)
-        self._mgr = ocp.CheckpointManager(
-            self.directory,
-            options=ocp.CheckpointManagerOptions(max_to_keep=self._keep),
-        )
+        self._mgr = self._make_mgr()
 
     def close(self) -> None:
         self._mgr.close()
+        if self._reader is not None:
+            self._reader.close()
+            self._reader = None
 
     def __enter__(self) -> "TrainCheckpointer":
         return self

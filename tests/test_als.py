@@ -401,6 +401,26 @@ class TestShardedParity:
         assert np.isfinite(U).all() and np.isfinite(V).all()
 
 
+class TestResidentScorerPolicy:
+    """r4 advisor: maybe_resident_scorer must never serve a cached
+    scorer built from different factor arrays (stale scores after a
+    retrain/swap)."""
+
+    def test_cache_reused_and_invalidated_on_swap(self, monkeypatch):
+        from predictionio_tpu.models.als import maybe_resident_scorer
+
+        monkeypatch.setenv("PIO_ALS_SERVE", "device")
+        rng = np.random.default_rng(0)
+        U1 = rng.normal(size=(6, 4)).astype(np.float32)
+        V1 = rng.normal(size=(8, 4)).astype(np.float32)
+        s1 = maybe_resident_scorer(U1, V1)
+        assert maybe_resident_scorer(U1, V1, s1) is s1  # same arrays → reuse
+        V2 = rng.normal(size=(8, 4)).astype(np.float32)
+        s2 = maybe_resident_scorer(U1, V2, s1)  # retrain swapped V
+        assert s2 is not s1
+        assert maybe_resident_scorer(U1, V2, s2) is s2
+
+
 class TestALSGrid:
     """VERDICT r3 #2: an eval grid over reg/alpha must share ONE
     compiled executable (reg/alpha are traced scalars)."""

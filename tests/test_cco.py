@@ -104,6 +104,30 @@ class TestCCO:
                 dv - np.roll(dv, 1, axis=1)) > 1e-3)
             assert (di[distinct] == si[distinct]).all()
 
+    def test_sparse_subchunks_heavy_user(self):
+        """r4 advisor: with downsampling off, one heavy user's pair
+        expansion must split across budget-sized sub-slices instead of
+        inflating the budget — tiny-budget output must equal the
+        one-shot result exactly."""
+        from predictionio_tpu.models.cco import _cooccurrence_sparse
+
+        rng = np.random.default_rng(7)
+        n_users, n_a, n_b = 12, 15, 11
+        # one "whale" (user 0 with 10×9 = 90 pairs) among light users
+        pu = np.concatenate([np.zeros(10, np.int32),
+                             rng.integers(1, n_users, 40).astype(np.int32)])
+        pi = rng.integers(0, n_a, 50).astype(np.int32)
+        su = np.concatenate([np.zeros(9, np.int32),
+                             rng.integers(1, n_users, 30).astype(np.int32)])
+        si = rng.integers(0, n_b, 39).astype(np.int32)
+        p = _csr_from_pairs(pu, pi, n_users, n_a)
+        s = _csr_from_pairs(su, si, n_users, n_b)
+        ref = _cooccurrence_sparse(p, s, n_users, n_b)
+        for budget in (7, 90, 91):  # < whale, == whale, > whale
+            got = _cooccurrence_sparse(p, s, n_users, n_b, budget=budget)
+            for a, b in zip(ref, got):
+                np.testing.assert_array_equal(a, b)
+
     def test_downsampling_caps_heavy_users(self):
         from predictionio_tpu.models.cco import _downsample_per_user
 

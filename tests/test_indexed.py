@@ -68,6 +68,27 @@ class TestDurability:
         assert idx2.get("b") == {"k": "w"}
         assert [h[0] for h in idx2.search(must=[("k", "w")])] == ["b"]
 
+    def test_batch_with_bad_doc_is_atomic(self, tmp_path):
+        """r4 advisor: a non-serializable doc anywhere in index_batch
+        must reject the WHOLE batch before any doc goes live in memory
+        — otherwise memory and WAL diverge and docs vanish on restart."""
+        p = str(tmp_path / "i.jsonl")
+        idx = EmbeddedIndex(p)
+        idx.index("keep", {"k": "v"})
+        with pytest.raises(TypeError):
+            idx.index_batch([("a", {"k": "1"}),
+                             ("bad", {"k": object()}),   # not JSON-able
+                             ("b", {"k": "2"})])
+        assert idx.get("a") is None and idx.get("b") is None
+        # single-doc path has the same contract
+        with pytest.raises(TypeError):
+            idx.index("solo", {"k": object()})
+        assert idx.get("solo") is None
+        idx.close()
+        idx2 = EmbeddedIndex(p)
+        assert idx2.get("keep") == {"k": "v"}
+        assert idx2.get("a") is None and idx2.get("b") is None
+
     def test_torn_tail_recovery(self, tmp_path):
         p = str(tmp_path / "i.jsonl")
         idx = EmbeddedIndex(p)
