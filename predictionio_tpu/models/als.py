@@ -497,14 +497,18 @@ def als_train(
 
     ``mesh`` (a jax.sharding.Mesh with a ``"data"`` axis) enables the
     sharded path; None runs single-device. ``checkpointer`` +
-    ``checkpoint_every`` enable mid-train checkpoint/resume on the
-    single-device path (see :func:`als_train_prepared`; the sharded
-    path's single fused scan has no mid-train host boundary to save at).
+    ``checkpoint_every`` enable mid-train checkpoint/resume on BOTH
+    paths: the single-device loop and the sharded trainer split their
+    iteration scan at block boundaries and save the factors after each
+    block (see :func:`als_train_prepared` /
+    :func:`als_sharded.als_train_sharded_prepared`).
     """
     if mesh is not None and np.prod(mesh.devices.shape) > 1:
         from predictionio_tpu.models.als_sharded import als_train_sharded
 
-        return als_train_sharded(coo, params, mesh)
+        return als_train_sharded(coo, params, mesh,
+                                 checkpointer=checkpointer,
+                                 checkpoint_every=checkpoint_every)
     # a 1-device mesh still pins the platform: run the single-device path
     # on THAT device, not wherever the default backend happens to live
     device = mesh.devices.flat[0] if mesh is not None else None

@@ -199,8 +199,8 @@ def als_prepare_sharded(coo: RatingsCOO, n_dev: int) -> ALSShardedPrepared:
                               block_u, block_i, u_sides, i_sides)
 
 
-@functools.lru_cache(maxsize=8)
-def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,
+@functools.lru_cache(maxsize=16)  # chunked checkpointing adds block-size
+def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,  # variants (full/block/remainder) per geometry
                       implicit: bool, weighted_reg: bool,
                       bf16_gather: bool = False, precision: str = "high"):
     """``reg``/``alpha`` are traced scalar inputs of the returned
@@ -231,6 +231,14 @@ def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,
 
         u_l = squeeze(u_bufs)
         i_l = squeeze(i_bufs)
+
+        if iterations == 0:
+            # match the single-device contract for iterations==0
+            # (als._compiled_bucketed): U solved from the initial V,
+            # not a zero-length scan's zeros. (The checkpoint-resume
+            # path restores U directly and never dispatches this.)
+            V_full = jax.lax.all_gather(V0_l, "data", tiled=True)
+            return half(V_full, u_l, geom_u, reg, alpha), V0_l
 
         def step(carry, _):
             U_l, V_l = carry
@@ -272,8 +280,30 @@ def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,
 
 
 def als_train_sharded_prepared(
-    prep: ALSShardedPrepared, p: ALSParams, mesh
+    prep: ALSShardedPrepared, p: ALSParams, mesh,
+    checkpointer=None, checkpoint_every: int = 0,
 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Train over the mesh; returns full (U, V) in original order.
+
+    With ``checkpointer`` + ``checkpoint_every > 0`` the fused
+    iteration scan is split at iteration boundaries: blocks of
+    ``checkpoint_every`` iterations run device-resident, and after each
+    block the (device-layout) factors are fetched and saved — the
+    SURVEY §5 restart-from-checkpoint contract on the multi-chip path,
+    where the failure unit is the whole slice. Exact by construction:
+    V fully determines the next iteration (each half-step recomputes U
+    from V), so resuming from a block boundary reproduces the
+    uninterrupted run. Checkpoints store the PERMUTED per-device layout
+    (deterministic for a given ratings matrix + device count); a resume
+    with a different rank or device count restores nothing and falls
+    back to a fresh start via the geometry protocol in
+    ``restore_latest_compatible``. Under multi-process meshes only
+    process 0 writes (every process restores).
+
+    Per-boundary cost: one extra program dispatch + a host fetch of
+    U and V + the Orbax write (measured on the 8-device CPU mesh —
+    see docs/perf.md).
+    """
     import jax
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
@@ -287,10 +317,11 @@ def als_train_sharded_prepared(
 
     from predictionio_tpu.models.als import _gram_precision
 
-    train = _compiled_sharded(
-        mesh, prep.geom_u, prep.geom_i,
-        p.rank, p.iterations, bool(p.implicit),
-        bool(p.weighted_reg), bool(p.bf16_gather), _gram_precision())
+    def compiled(n_iters: int):
+        return _compiled_sharded(
+            mesh, prep.geom_u, prep.geom_i,
+            p.rank, n_iters, bool(p.implicit),
+            bool(p.weighted_reg), bool(p.bf16_gather), _gram_precision())
 
     # inputs are placed directly onto the mesh with their shard_map
     # layouts (cached per mesh) — never through the default backend
@@ -305,9 +336,6 @@ def als_train_sharded_prepared(
     V0p = np.concatenate([
         V0g[d * block_i:(d + 1) * block_i][prep.i_sides[d].perm]
         for d in range(n_dev)])
-    V0 = jax.device_put(V0p, NamedSharding(mesh, P("data", None)))
-
-    U, V = train(u_bufs, i_bufs, V0, np.float32(p.reg), np.float32(p.alpha))
 
     def fetch(x):
         # multi-host: the result spans non-addressable devices — gather
@@ -324,15 +352,73 @@ def als_train_sharded_prepared(
                   for d in range(n_dev)]
         return np.concatenate(blocks)[:n]
 
-    return (unpermute(fetch(U), prep.u_sides, block_u, prep.n_users),
-            unpermute(fetch(V), prep.i_sides, block_i, prep.n_items))
+    v_spec = NamedSharding(mesh, P("data", None))
+    reg_a, alpha_a = np.float32(p.reg), np.float32(p.alpha)
+    is_writer = jax.process_index() == 0
+
+    # -- resume (mirrors als_train_prepared's protocol) ---------------------
+    start = 0
+    U_done = None  # restored U, consumed only when start == iterations
+    if checkpointer is not None and checkpointer.latest_step() is not None:
+        from predictionio_tpu.utils.checkpoint import CheckpointGeometryError
+
+        template = {"U": np.zeros((block_u * n_dev, p.rank), np.float32),
+                    "V": np.zeros_like(V0p)}
+        try:
+            state, step = checkpointer.restore_latest_compatible(template)
+            V0p = np.asarray(state["V"])
+            U_done = np.asarray(state["U"])
+            start = min(int(step), p.iterations)
+        except CheckpointGeometryError:
+            import warnings
+
+            warnings.warn(
+                "sharded ALS checkpoints are stale (geometry/layout "
+                "change) — wiped; training restarts from scratch",
+                RuntimeWarning)
+            # multi-process: one writer wipes the shared dir; a
+            # concurrent clear() from every process would race
+            # rmtree against manager re-init
+            if is_writer:
+                checkpointer.clear()
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices("als_ckpt_clear")
+
+    if start >= p.iterations and U_done is not None:
+        # died between the final checkpoint and model persistence
+        Uh, Vh = U_done, V0p
+    elif checkpointer is None or checkpoint_every <= 0:
+        V0 = jax.device_put(V0p, v_spec)
+        U, V = compiled(p.iterations - start)(u_bufs, i_bufs, V0,
+                                              reg_a, alpha_a)
+        Uh, Vh = fetch(U), fetch(V)
+    else:
+        V = jax.device_put(V0p, v_spec)
+        Uh = Vh = None
+        it = start
+        while it < p.iterations:
+            n = min(checkpoint_every, p.iterations - it)
+            U, V = compiled(n)(u_bufs, i_bufs, V, reg_a, alpha_a)
+            it += n
+            Uh, Vh = fetch(U), fetch(V)
+            if is_writer:
+                checkpointer.save(it, {"U": Uh, "V": Vh})
+        assert Uh is not None  # start < iterations here, loop ran
+
+    return (unpermute(Uh, prep.u_sides, block_u, prep.n_users),
+            unpermute(Vh, prep.i_sides, block_i, prep.n_items))
 
 
 def als_train_sharded(
-    coo: RatingsCOO, p: ALSParams, mesh
+    coo: RatingsCOO, p: ALSParams, mesh,
+    checkpointer=None, checkpoint_every: int = 0,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Train ALS over the mesh's ``data`` axis; returns full (U, V)."""
     n_dev = int(np.prod(mesh.devices.shape))
     if "data" not in mesh.axis_names:
         raise ValueError(f"mesh must have a 'data' axis, got {mesh.axis_names}")
-    return als_train_sharded_prepared(als_prepare_sharded(coo, n_dev), p, mesh)
+    return als_train_sharded_prepared(als_prepare_sharded(coo, n_dev), p, mesh,
+                                      checkpointer=checkpointer,
+                                      checkpoint_every=checkpoint_every)

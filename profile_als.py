@@ -44,16 +44,82 @@ def _measure(prep, params, label):
     return t_warm
 
 
+def _sharded_ckpt_overhead(args):
+    """Per-boundary cost of block-wise checkpointing on the sharded
+    path: straight fused run vs checkpoint_every=1 (one boundary per
+    iteration — worst case). Runs on a virtual 8-device CPU mesh so it
+    works chip-free; the number to record is (ckpt - straight)/nblocks.
+    """
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+    from jax.sharding import Mesh
+
+    from bench import synthetic_ml20m
+    from predictionio_tpu.models.als import ALSParams, RatingsCOO
+    from predictionio_tpu.models.als_sharded import (
+        als_prepare_sharded, als_train_sharded_prepared)
+    from predictionio_tpu.utils.checkpoint import TrainCheckpointer
+
+    mesh = Mesh(np.array(jax.devices()).reshape(-1), ("data",))
+    n_dev = int(np.prod(mesh.devices.shape))
+    users, items, ratings = synthetic_ml20m(args.nnz)
+    coo = RatingsCOO(users, items, ratings, 138_493, 26_744)
+    prep = als_prepare_sharded(coo, n_dev)
+    p = ALSParams(rank=args.rank, iterations=args.iters, reg=0.05, seed=1)
+
+    def run(ck=None, every=0):
+        t0 = time.perf_counter()
+        als_train_sharded_prepared(prep, p, mesh,
+                                   checkpointer=ck, checkpoint_every=every)
+        return time.perf_counter() - t0
+
+    run()  # compile
+    straight = min(run() for _ in range(2))
+    with tempfile.TemporaryDirectory() as td:
+        with TrainCheckpointer(os.path.join(td, "a")) as ck:
+            run(ck, 1)  # compile the 1-iter block program
+        times = []
+        for sub in ("b", "c"):
+            with TrainCheckpointer(os.path.join(td, sub)) as ck:
+                times.append(run(ck, 1))
+    ckpt = min(times)
+    per = (ckpt - straight) / p.iterations * 1000
+    print(f"sharded nnz={coo.nnz} rank={p.rank} iters={p.iterations} "
+          f"mesh={n_dev}dev", flush=True)
+    print(f"straight={straight:.2f}s blockwise(every=1)={ckpt:.2f}s "
+          f"per_boundary_overhead={per:.1f}ms", flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--nnz", type=int, default=20_000_000)
+    ap.add_argument("--nnz", type=int, default=None,
+                    help="ratings count (default 20M; 400k under "
+                         "--sharded-ckpt, which runs on CPU)")
     ap.add_argument("--rank", type=int, default=64)
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--ab", action="store_true",
                     help="run the optimization A/B matrix")
     ap.add_argument("--trace-dir", default="/tmp/als_trace")
     ap.add_argument("--trace-iters", type=int, default=2)
+    ap.add_argument("--sharded-ckpt", action="store_true",
+                    help="measure the per-boundary overhead of "
+                         "block-wise checkpointing on the sharded "
+                         "trainer (8-device CPU mesh)")
     args = ap.parse_args()
+
+    if args.sharded_ckpt:
+        if args.nnz is None:
+            args.nnz = 400_000  # CPU-mesh measurement, not TPU scale
+        _sharded_ckpt_overhead(args)
+        return
+    if args.nnz is None:
+        args.nnz = 20_000_000
 
     from bench import synthetic_ml20m
     from predictionio_tpu.models import als
