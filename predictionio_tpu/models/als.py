@@ -964,7 +964,10 @@ def als_train_prepared(prep: ALSPrepared, p: ALSParams, device=None,
         # died between the final checkpoint and model persistence: the
         # train is already done, nothing to recompute
         U, V = U0, V0
-    elif checkpointer is None or checkpoint_every <= 0:
+    elif (checkpointer is None or checkpoint_every <= 0
+          or p.iterations == 0):  # its U-recovery program has no
+        # blocks to checkpoint; without this, the block loop below
+        # never runs and the not-None assert fires (r5 review)
         U, V = compiled(p.iterations - start)(u_bufs, i_bufs, put(V0),
                                               reg_a, alpha_a)
     else:

@@ -389,7 +389,9 @@ def als_train_sharded_prepared(
     if start >= p.iterations and U_done is not None:
         # died between the final checkpoint and model persistence
         Uh, Vh = U_done, V0p
-    elif checkpointer is None or checkpoint_every <= 0:
+    elif checkpointer is None or checkpoint_every <= 0 or p.iterations == 0:
+        # iterations==0 (U recovered from initial V) has no blocks to
+        # checkpoint — run the same single-shot program either way
         V0 = jax.device_put(V0p, v_spec)
         U, V = compiled(p.iterations - start)(u_bufs, i_bufs, V0,
                                               reg_a, alpha_a)

@@ -70,19 +70,84 @@ class EmbeddedIndex:
     fields additionally support range queries.
     """
 
-    def __init__(self, path: Optional[str] = None) -> None:
+    _SNAP_VERSION = 1
+
+    def __init__(self, path: Optional[str] = None,
+                 no_index: frozenset = frozenset()) -> None:
+        # ``no_index``: fields stored in documents but NOT posted to the
+        # inverted index (the ES ``index: false`` mapping) — payload
+        # fields the owning store never term-queries (e.g. the event
+        # store's serialized properties). Cuts ingest work and postings
+        # memory; term queries on these fields match nothing, numeric
+        # doc-values (ranges, sort) still work.
+        self._no_index = no_index
         self._path = path
         self._lock = threading.RLock()
         self._docs: Dict[str, Dict[str, Any]] = {}
         self._postings: Dict[Tuple[str, Any], set] = {}
         self._wal_ops = 0
         self._wal = None
+        self._gen = 0  # mutation counter (invalidates doc-values caches)
+        self._dv: Dict[str, Any] = {}  # field → (gen, sorted vals, ids)
         if path is not None:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._load_snapshot()
             self._replay()
             self._wal = open(path, "a", encoding="utf-8")
 
     # -- durability ------------------------------------------------------------
+    #
+    # Two files — the ES translog + segments split (SURVEY.md §2a
+    # storage/elasticsearch):
+    #   <path>       append-only JSONL WAL (the translog)
+    #   <path>.snap  pickled (docs, postings) snapshot (the segments)
+    # A snapshot is written on compaction and on clean close; the WAL is
+    # then truncated, so restart = one pickle load + replay of the WAL
+    # TAIL ONLY (measured 128 s → 6.2 s per 1M docs, r5). Ops are
+    # idempotent upserts/deletes, so a crash between snapshot replace
+    # and WAL truncate just replays ops the snapshot already contains.
+    # The snapshot lives in the store's own data directory — same trust
+    # domain as the WAL it replaces.
+
+    def _load_snapshot(self) -> None:
+        snap = self._path + ".snap"
+        if not os.path.exists(snap):
+            return
+        import pickle
+
+        try:
+            with open(snap, "rb") as f:
+                payload = pickle.load(f)
+            if payload.get("version") != self._SNAP_VERSION:
+                raise ValueError(f"snapshot version {payload.get('version')}")
+            self._docs = payload["docs"]
+            self._postings = payload["postings"]
+        except Exception as exc:  # noqa: BLE001 — any corruption
+            # fall back to whatever the WAL holds; after a compaction
+            # the WAL is only a tail, so surface the loss loudly
+            # instead of silently serving a partial index
+            import warnings
+
+            self._docs, self._postings = {}, {}
+            warnings.warn(
+                f"index snapshot {snap!r} is unreadable ({exc}); "
+                f"recovering from the WAL alone — documents indexed "
+                f"before the last compaction may be missing",
+                RuntimeWarning)
+
+    def _write_snapshot(self) -> None:
+        """Durably persist (docs, postings); then the WAL can truncate."""
+        assert self._path is not None
+        import pickle
+
+        tmp = self._path + ".snap.tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump({"version": self._SNAP_VERSION, "docs": self._docs,
+                         "postings": self._postings}, f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path + ".snap")
 
     def _replay(self) -> None:
         if self._path is None or not os.path.exists(self._path):
@@ -121,21 +186,23 @@ class EmbeddedIndex:
             self._compact()
 
     def _compact(self) -> None:
-        """Rewrite the log as one snapshot (segment-merge analogue)."""
+        """Snapshot + truncate the WAL (segment-merge analogue). One
+        pickle dump instead of the r4 full-JSONL rewrite — compaction
+        of 1M docs drops from ~tens of seconds to ~2 s, and restart
+        replays only the post-snapshot tail."""
         assert self._path is not None and self._wal is not None
-        tmp = self._path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            for doc_id, doc in self._docs.items():
-                f.write(json.dumps({"op": "index", "id": doc_id, "doc": doc},
-                                   separators=(",", ":")) + "\n")
+        self._write_snapshot()
         self._wal.close()
-        os.replace(tmp, self._path)
-        self._wal = open(self._path, "a", encoding="utf-8")
-        self._wal_ops = len(self._docs)
+        self._wal = open(self._path, "w", encoding="utf-8")
+        self._wal_ops = 0
 
     def close(self) -> None:
         with self._lock:
             if self._wal is not None:
+                if self._wal_ops:
+                    # clean close → snapshot, so the next open replays
+                    # nothing (the 128 s/1M-doc restart, r4 weak #2)
+                    self._compact()
                 self._wal.close()
                 self._wal = None
 
@@ -149,16 +216,30 @@ class EmbeddedIndex:
 
     def _apply_index(self, doc_id: str, doc: Dict[str, Any]) -> None:
         self._apply_delete(doc_id)
+        self._gen += 1
         self._docs[doc_id] = doc
+        postings = self._postings
+        no_index = self._no_index
         for field, value in doc.items():
-            for term in self._terms(value):
+            if field in no_index:
+                continue
+            for term in (value if isinstance(value, list) else (value,)):
                 if isinstance(term, (str, int, float, bool)):
-                    self._postings.setdefault((field, term), set()).add(doc_id)
+                    s = postings.get((field, term))
+                    if s is None:
+                        postings[(field, term)] = {doc_id}
+                    else:
+                        s.add(doc_id)
+        # _apply_delete intentionally does NOT honor no_index: discards
+        # of never-posted terms are cheap no-ops, and staying symmetric
+        # keeps pre-no_index snapshots/WALs (whose docs DID post these
+        # fields) from leaking dead ids into the postings
 
     def _apply_delete(self, doc_id: str) -> bool:
         doc = self._docs.pop(doc_id, None)
         if doc is None:
             return False
+        self._gen += 1
         for field, value in doc.items():
             for term in self._terms(value):
                 s = self._postings.get((field, term))
@@ -167,6 +248,36 @@ class EmbeddedIndex:
                     if not s:
                         del self._postings[(field, term)]
         return True
+
+    def _doc_values(self, field: str):
+        """Sorted numeric doc values for ``field`` — (vals float64
+        ascending, ids in (val, id) order), covering exactly the docs
+        whose value is int/float/bool (the domain of range queries).
+        Lazily built, invalidated by any mutation; one O(n log n) build
+        amortizes every subsequent range/sorted-truncation query (the
+        ES doc-values analogue). Returns None for non-numeric fields.
+        """
+        import numpy as np
+
+        cached = self._dv.get(field)
+        if cached is not None and cached[0] == self._gen:
+            return cached[1], cached[2]
+        ids_l, vals_l = [], []
+        for doc_id, doc in self._docs.items():
+            v = doc.get(field)
+            if isinstance(v, (int, float)):  # bool is int: matches
+                ids_l.append(doc_id)         # the range-filter domain
+                vals_l.append(float(v))
+        if not ids_l:
+            self._dv[field] = (self._gen, None, None)
+            return None, None
+        vals = np.asarray(vals_l, np.float64)
+        ids_a = np.asarray(ids_l)
+        order = np.lexsort((ids_a, vals))  # (value, doc_id) — the same
+        vals = vals[order]                 # tie-break search() sorts by
+        ids = ids_a[order].tolist()
+        self._dv[field] = (self._gen, vals, ids)
+        return vals, ids
 
     def _check_open(self) -> None:
         # a closed durable index must reject writes loudly: silently
@@ -251,6 +362,8 @@ class EmbeddedIndex:
         inclusive / hi exclusive on numeric fields. Sorted by ``sort``
         field (else score desc), truncated to ``size``.
         """
+        if size is not None and size <= 0:
+            return []  # limit=0 find — every path must agree on empty
         with self._lock:
             candidates: Optional[set] = None
 
@@ -258,24 +371,61 @@ class EmbeddedIndex:
                 nonlocal candidates
                 candidates = ids if candidates is None else candidates & ids
 
-            for field, term in (must or []):
-                narrow(set(self._postings.get((field, term), ())))
+            # intersect smallest posting set first: a selective clause
+            # (entityId) after a broad one (entityType matches every
+            # doc) used to start by copying the whole broad set —
+            # 12 ms → sub-ms for the entity find at 300k docs (r5)
+            filter_sets: List[set] = [
+                self._postings.get((field, term), set())
+                for field, term in (must or [])]
             for field, terms in (must_any or []):
+                terms = list(terms)
+                if len(terms) == 1:  # single term: no union copy
+                    filter_sets.append(
+                        self._postings.get((field, terms[0]), set()))
+                    continue
                 hit: set = set()
                 for t in terms:
                     hit |= self._postings.get((field, t), set())
-                narrow(hit)
+                filter_sets.append(hit)
+            if filter_sets:
+                filter_sets.sort(key=len)
+                # aliasing the live posting set is safe: candidates is
+                # only read or REBOUND below (&, comprehension), never
+                # mutated in place — and a one-clause query over a big
+                # posting list skips an O(n) copy
+                candidates = filter_sets[0]
+                for s in filter_sets[1:]:
+                    candidates = candidates & s
+            if ranges:
+                import numpy as np
+
+                for field, lo, hi in ranges:
+                    if candidates is not None and len(candidates) <= 2048:
+                        # small candidate set: per-doc check beats the
+                        # doc-values set build
+                        def in_range(doc):
+                            v = doc.get(field)
+                            return (isinstance(v, (int, float))
+                                    and (lo is None or v >= lo)
+                                    and (hi is None or v < hi))
+                        candidates = {i for i in candidates
+                                      if in_range(self._docs[i])}
+                        continue
+                    # doc-values path: two binary searches instead of a
+                    # Python scan over every candidate (r4: the
+                    # time-filtered find over 1M docs was Python-bound)
+                    vals, ids = self._doc_values(field)
+                    if vals is None:
+                        narrow(set())
+                        continue
+                    a = 0 if lo is None else int(
+                        np.searchsorted(vals, lo, "left"))
+                    b = len(ids) if hi is None else int(
+                        np.searchsorted(vals, hi, "left"))
+                    narrow(set(ids[a:b]))
             if candidates is None:
                 candidates = set(self._docs)
-            if ranges:
-                for field, lo, hi in ranges:
-                    def in_range(doc):
-                        v = doc.get(field)
-                        return (isinstance(v, (int, float))
-                                and (lo is None or v >= lo)
-                                and (hi is None or v < hi))
-                    candidates = {i for i in candidates
-                                  if in_range(self._docs[i])}
 
             scores: Dict[str, float] = {}
             if should:
@@ -283,18 +433,40 @@ class EmbeddedIndex:
                     for doc_id in self._postings.get((field, term), ()):
                         if doc_id in candidates:
                             scores[doc_id] = scores.get(doc_id, 0.0) + boost
-                hits = list(scores)
+                hits = scores  # dict: iterates keys, O(1) membership
             else:
-                hits = list(candidates)
+                hits = candidates
 
             def sort_key(doc_id: str):
                 if sort is not None:
-                    return self._docs[doc_id].get(sort)
+                    v = self._docs[doc_id].get(sort)
+                    # docs missing the sort field order below every
+                    # present value (ES missing:_last on desc) instead
+                    # of raising on a None/value comparison
+                    return (1, v) if v is not None else (0, 0)
                 return scores.get(doc_id, 0.0)
 
             key = (lambda i: (sort_key(i), i))
             desc = (sort is None) or reverse
             if size is not None and len(hits) > max(64, 4 * size):
+                if sort is not None:
+                    # walk the presorted doc values and early-exit at
+                    # `size` members — for dense matches (find by event
+                    # name over a big index) this touches ~size/density
+                    # ids instead of every hit (r5; was heap O(n))
+                    vals, ids = self._doc_values(sort)
+                    if ids is not None and len(ids) == len(self._docs):
+                        # full coverage → every hit has a sortable
+                        # value; partial coverage falls through to the
+                        # heap to keep missing-field semantics
+                        out = []
+                        for i in (reversed(ids) if desc else ids):
+                            if i in hits:
+                                out.append(i)
+                                if len(out) == size:
+                                    break
+                        return [(i, scores.get(i, 0.0),
+                                 dict(self._docs[i])) for i in out]
                 # truncated result over a large candidate set: heap
                 # selection is O(n log size), not O(n log n) — a
                 # limit-100 find over a 1M-event index sorted the whole
@@ -304,7 +476,7 @@ class EmbeddedIndex:
                 pick = heapq.nlargest if desc else heapq.nsmallest
                 hits = pick(size, hits, key=key)
             else:
-                hits.sort(key=key, reverse=desc)
+                hits = sorted(hits, key=key, reverse=desc)
                 if size is not None:
                     hits = hits[:size]
             return [(i, scores.get(i, 0.0), dict(self._docs[i]))
@@ -321,12 +493,15 @@ class IndexedStorageClient:
         if root is not None:
             os.makedirs(root, exist_ok=True)
 
-    def index(self, name: str) -> EmbeddedIndex:
+    def index(self, name: str,
+              no_index: frozenset = frozenset()) -> EmbeddedIndex:
+        """``no_index`` applies on first open of the named index (the
+        mapping is the creator's contract, like an ES index mapping)."""
         with self._lock:
             if name not in self._indices:
                 path = (os.path.join(self._root, name + ".jsonl")
                         if self._root is not None else None)
-                self._indices[name] = EmbeddedIndex(path)
+                self._indices[name] = EmbeddedIndex(path, no_index=no_index)
             return self._indices[name]
 
     def drop(self, name: str) -> None:
@@ -335,10 +510,12 @@ class IndexedStorageClient:
             if idx is not None:
                 idx.close()
             if self._root is not None:
-                try:
-                    os.remove(os.path.join(self._root, name + ".jsonl"))
-                except FileNotFoundError:
-                    pass
+                base = os.path.join(self._root, name + ".jsonl")
+                for p in (base, base + ".snap"):  # WAL and snapshot
+                    try:
+                        os.remove(p)
+                    except FileNotFoundError:
+                        pass
 
     def list_indices(self) -> List[str]:
         with self._lock:
@@ -382,12 +559,26 @@ class ESEventStore(EventStore):
     """Events as index documents, one index per (app, channel) —
     mirroring the reference's per-app ES event indices."""
 
+    # stored-but-not-posted fields (ES ``index: false``): the store
+    # never term-queries these — properties is a serialized JSON blob,
+    # the *Iso strings duplicate the numeric timestamps, and the
+    # timestamps themselves are queried only as ranges/sort, which run
+    # on doc values. Near-unique per doc, they dominated postings
+    # memory and the ingest loop (r5, 1M-event run: 6.5k → 19.5k
+    # events/s together with the Event.with_id fast path).
+    _NO_INDEX = frozenset({"properties", "eventTime", "eventTimeIso",
+                           "creationTime", "creationTimeIso"})
+
     def __init__(self, client: IndexedStorageClient) -> None:
         self._c = client
 
     def _name(self, app_id: int, channel_id: Optional[int]) -> str:
         return (f"pio_event_{app_id}" if channel_id is None
                 else f"pio_event_{app_id}_{channel_id}")
+
+    def _idx(self, app_id: int, channel_id: Optional[int]) -> EmbeddedIndex:
+        return self._c.index(self._name(app_id, channel_id),
+                             no_index=self._NO_INDEX)
 
     @staticmethod
     def _doc(e: Event) -> Dict[str, Any]:
@@ -397,7 +588,8 @@ class ESEventStore(EventStore):
             "entityId": e.entity_id,
             "targetEntityType": e.target_entity_type,
             "targetEntityId": e.target_entity_id,
-            "properties": json.dumps(e.properties, separators=(",", ":")),
+            "properties": (json.dumps(e.properties, separators=(",", ":"))
+                           if e.properties else "{}"),
             "eventTime": e.event_time.timestamp(),
             "eventTimeIso": format_event_time(e.event_time),
             "tags": list(e.tags),
@@ -426,7 +618,7 @@ class ESEventStore(EventStore):
                channel_id: Optional[int] = None) -> str:
         validate_event(event)
         e = event.with_id()
-        self._c.index(self._name(app_id, channel_id)).index(
+        self._idx(app_id, channel_id).index(
             e.event_id, self._doc(e))
         return e.event_id  # type: ignore[return-value]
 
@@ -439,20 +631,20 @@ class ESEventStore(EventStore):
             e = event.with_id()
             docs.append((e.event_id, self._doc(e)))
             ids.append(e.event_id)
-        self._c.index(self._name(app_id, channel_id)).index_batch(docs)
+        self._idx(app_id, channel_id).index_batch(docs)
         return ids
 
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
-        d = self._c.index(self._name(app_id, channel_id)).get(event_id)
+        d = self._idx(app_id, channel_id).get(event_id)
         return self._event(event_id, d) if d is not None else None
 
     def delete(self, event_id: str, app_id: int,
                channel_id: Optional[int] = None) -> bool:
-        return self._c.index(self._name(app_id, channel_id)).delete(event_id)
+        return self._idx(app_id, channel_id).delete(event_id)
 
     def wipe(self, app_id: int, channel_id: Optional[int] = None) -> None:
-        idx = self._c.index(self._name(app_id, channel_id))
+        idx = self._idx(app_id, channel_id)
         for doc_id, _, _ in idx.search():
             idx.delete(doc_id)
 
@@ -493,7 +685,7 @@ class ESEventStore(EventStore):
             ranges = [("eventTime",
                        start_time.timestamp() if start_time else None,
                        until_time.timestamp() if until_time else None)]
-        hits = self._c.index(self._name(app_id, channel_id)).search(
+        hits = self._idx(app_id, channel_id).search(
             must=must, must_any=must_any, ranges=ranges,
             sort="eventTime", reverse=reversed,
             size=limit if (limit is not None and limit >= 0) else None)
