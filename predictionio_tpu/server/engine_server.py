@@ -52,7 +52,7 @@ class EngineServer:
         bind_retry_sec: float = 1.0,
         batching: bool = False,
         batch_max: int = 64,
-        batch_wait_ms: float = 2.0,
+        batch_wait_ms: float = 0.0,
     ) -> None:
         self.storage = storage or get_storage()
         self.engine_factory = engine_factory

@@ -463,7 +463,9 @@ def build_parser() -> argparse.ArgumentParser:
     dp.add_argument("--batching", action="store_true",
                     help="micro-batch concurrent queries into one dispatch")
     dp.add_argument("--batch-max", type=int, default=64)
-    dp.add_argument("--batch-wait-ms", type=float, default=2.0)
+    dp.add_argument("--batch-wait-ms", type=float, default=0.0,
+                    help="opt-in batch-formation wait; 0 = drain-only "
+                         "continuous batching (default)")
     dp.set_defaults(fn=cmd_deploy)
 
     ud = sub.add_parser("undeploy", help="stop a running engine server")

@@ -94,6 +94,35 @@ def measure(fn, iters: int, warmup: int = 20):
             float(np.percentile(lat, 99) * 1e3))
 
 
+def _client_proc(port: int, n_users: int, n: int, seed: int, outq) -> None:
+    """One closed-loop HTTP client in its own process (own GIL)."""
+    import http.client as hc
+    import json as _json
+    import time as _time
+
+    import numpy as _np
+
+    try:
+        conn = hc.HTTPConnection("127.0.0.1", port, timeout=10)
+        rng = _np.random.default_rng(seed)
+        lats = []
+        for _ in range(n):
+            body = _json.dumps(
+                {"user": str(int(rng.integers(0, n_users))), "num": 10})
+            t0 = _time.perf_counter()
+            conn.request("POST", "/queries.json", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            dt = _time.perf_counter() - t0
+            assert resp.status == 200, data[:200]
+            lats.append(dt)
+        conn.close()
+        outq.put(lats)
+    except BaseException as e:  # noqa: BLE001 — report, don't hang join
+        outq.put(f"client {seed}: {type(e).__name__}: {e}")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--queries", type=int, default=2000)
@@ -165,58 +194,56 @@ def main() -> None:
     if args.concurrency > 0:
         # concurrent clients against a --batching server: the
         # MicroBatcher coalesces in-flight queries and batch_predict
-        # serves each batch in ONE device dispatch
-        import threading
+        # serves each batch in ONE device dispatch. Clients run in
+        # SEPARATE PROCESSES: in-process client threads share the
+        # server's GIL and halve the apparent throughput (the r4
+        # harness measured the harness, not the server).
+        import multiprocessing as mp
 
         server2 = EngineServer(engine_factory=factory, storage=st,
                                host="127.0.0.1", port=args.port + 1,
                                batching=True)
         with server_thread(server2, args.port + 1):
             per_client = max(50, args.queries // args.concurrency)
-            lats: list = [[] for _ in range(args.concurrency)]
-            errors: list = []
+            ctx = mp.get_context("fork")
 
-            def client(ci):
-                try:
-                    conn = http.client.HTTPConnection(
-                        "127.0.0.1", args.port + 1, timeout=10)
-                    rng_c = np.random.default_rng(ci)
-                    for _ in range(per_client):
-                        u = int(rng_c.integers(0, args.n_users))
-                        body = json.dumps({"user": str(u), "num": 10})
-                        t0 = time.perf_counter()
-                        conn.request("POST", "/queries.json", body,
-                                     {"Content-Type": "application/json"})
-                        resp = conn.getresponse()
-                        data = resp.read()
-                        dt = time.perf_counter() - t0
-                        assert resp.status == 200, data[:200]
-                        lats[ci].append(dt)  # only successes count
-                    conn.close()
-                except BaseException as e:  # surface after join
-                    errors.append((ci, e))
+            def burst():
+                q: Any = ctx.Queue()
+                procs = [ctx.Process(target=_client_proc,
+                                     args=(args.port + 1, args.n_users,
+                                           per_client, ci, q))
+                         for ci in range(args.concurrency)]
+                t0 = time.perf_counter()
+                for p in procs:
+                    p.start()
+                # timeout + exitcode checks: a client killed by the
+                # kernel (OOM/SIGKILL) never puts — without these the
+                # harness would wedge silently
+                import queue as _queue
+
+                outs = []
+                for _ in procs:
+                    try:
+                        outs.append(q.get(timeout=120))
+                    except _queue.Empty:
+                        outs.append("client timed out (killed?)")
+                for p in procs:
+                    p.join(timeout=30)
+                    if p.exitcode not in (0, None):
+                        outs.append(f"client exit code {p.exitcode}")
+                wall = time.perf_counter() - t0
+                errs = [o for o in outs if isinstance(o, str)]
+                if errs:
+                    raise RuntimeError(
+                        f"{len(errs)} client(s) failed; first: {errs[0]}")
+                return wall, [x for o in outs for x in o]
 
             # warm pass: the first concurrent burst compiles the
             # power-of-two batch-size buckets once (production pays
             # this once per deploy); measure the steady state
-            def burst():
-                threads = [threading.Thread(target=client, args=(ci,))
-                           for ci in range(args.concurrency)]
-                t0 = time.perf_counter()
-                for th in threads:
-                    th.start()
-                for th in threads:
-                    th.join()
-                if errors:
-                    raise RuntimeError(
-                        f"{len(errors)} client(s) failed; first: "
-                        f"{errors[0]}")
-                return time.perf_counter() - t0
-
             burst()
-            lats[:] = [[] for _ in range(args.concurrency)]
-            wall = burst()
-            flat = np.asarray([x for l in lats for x in l])
+            wall, lat_all = burst()
+            flat = np.asarray(lat_all)
             batched = {
                 "clients": args.concurrency,
                 "queries": int(flat.size),
