@@ -138,7 +138,12 @@ def _backend_watchdog(seconds: float):
             print("bench.py: accelerator backend unreachable after "
                   f"{seconds:.0f}s (tunnel relay wedged?) — no "
                   "measurement possible; see the previous round's BENCH "
-                  "file for last good numbers", flush=True)
+                  "file for last good numbers. The tunnel has now been "
+                  "dead for rounds 3, 4 and 5; chip-free validation "
+                  "for r5 is in docs/perf.md (AOT compile vs a v5e "
+                  "topology, profile_aot.py) and the measurement "
+                  "sequence for a live chip is "
+                  "docs/perf/hardware_runbook.md", flush=True)
             os._exit(2)
 
     threading.Thread(target=fire, daemon=True).start()

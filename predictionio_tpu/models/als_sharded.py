@@ -297,8 +297,10 @@ def als_train_sharded_prepared(
     (deterministic for a given ratings matrix + device count); a resume
     with a different rank or device count restores nothing and falls
     back to a fresh start via the geometry protocol in
-    ``restore_latest_compatible``. Under multi-process meshes only
-    process 0 writes (every process restores).
+    ``restore_latest_compatible``. Checkpoint calls are COLLECTIVE
+    under multi-process meshes: every process calls save/clear
+    together (Orbax elects the writer and syncs internally;
+    ``TrainCheckpointer.clear`` wipes on process 0 and barriers).
 
     Per-boundary cost: one extra program dispatch + a host fetch of
     U and V + the Orbax write (measured on the 8-device CPU mesh —
@@ -354,7 +356,6 @@ def als_train_sharded_prepared(
 
     v_spec = NamedSharding(mesh, P("data", None))
     reg_a, alpha_a = np.float32(p.reg), np.float32(p.alpha)
-    is_writer = jax.process_index() == 0
 
     # -- resume (mirrors als_train_prepared's protocol) ---------------------
     start = 0
@@ -376,15 +377,10 @@ def als_train_sharded_prepared(
                 "sharded ALS checkpoints are stale (geometry/layout "
                 "change) — wiped; training restarts from scratch",
                 RuntimeWarning)
-            # multi-process: one writer wipes the shared dir; a
-            # concurrent clear() from every process would race
-            # rmtree against manager re-init
-            if is_writer:
-                checkpointer.clear()
-            if jax.process_count() > 1:
-                from jax.experimental import multihost_utils
-
-                multihost_utils.sync_global_devices("als_ckpt_clear")
+            # every process reads the same files → every process
+            # raises the same error → this is collective; clear()
+            # itself is multiprocess-safe (process 0 wipes, all sync)
+            checkpointer.clear()
 
     if start >= p.iterations and U_done is not None:
         # died between the final checkpoint and model persistence
@@ -405,8 +401,10 @@ def als_train_sharded_prepared(
             U, V = compiled(n)(u_bufs, i_bufs, V, reg_a, alpha_a)
             it += n
             Uh, Vh = fetch(U), fetch(V)
-            if is_writer:
-                checkpointer.save(it, {"U": Uh, "V": Vh})
+            # collective: Orbax's save syncs all processes and elects
+            # the writer itself — a process-0-only call deadlocks the
+            # others at the internal barrier
+            checkpointer.save(it, {"U": Uh, "V": Vh})
         assert Uh is not None  # start < iterations here, loop ran
 
     return (unpermute(Uh, prep.u_sides, block_u, prep.n_users),

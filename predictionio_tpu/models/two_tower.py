@@ -322,3 +322,17 @@ def two_tower_embed_items(item_variables, n_items: int,
 def two_tower_user_embed(user_variables, user_id: int, n_users: int,
                          params: TwoTowerParams) -> np.ndarray:
     return _tower_forward_np(user_variables, np.asarray([user_id]))[0]
+
+
+def two_tower_embed_users(user_variables, n_users: int,
+                          params: TwoTowerParams,
+                          chunk: int = 65536) -> np.ndarray:
+    """Precompute every user's embedding (r5). With both tables
+    materialized, two-tower serving rides the SAME device-resident
+    gather→score→top-k program as ALS (`models/als.ResidentScorer`) —
+    one dispatch per (micro-)batch instead of a host matvec per query.
+    Chunked so the intermediate activations stay bounded."""
+    return np.concatenate([
+        _tower_forward_np(user_variables, np.arange(lo, min(lo + chunk,
+                                                            n_users)))
+        for lo in range(0, n_users, chunk)])

@@ -58,6 +58,12 @@ class TrainCheckpointer:
             self._reader = ocp.StandardCheckpointer()
         return self._reader
 
+    @staticmethod
+    def _process_index() -> int:
+        import jax
+
+        return jax.process_index()
+
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
@@ -187,15 +193,26 @@ class TrainCheckpointer:
             if newer:
                 import shutil
 
-                for bad in newer:
-                    try:
-                        self._mgr.delete(bad)
-                    except Exception:  # noqa: BLE001 — torn step dirs
+                # process 0 prunes the shared dir; every process
+                # rebuilds its manager so no in-memory step cache keeps
+                # serving the pruned steps. Deliberately NO barrier
+                # here: this branch is entered per-process from local
+                # reads, and a process that restored cleanly (empty
+                # `newer`) would never reach it — a conditional barrier
+                # deadlocks exactly when reads diverge. Ordering is
+                # still safe multi-process: the next Orbax save is
+                # collective, so process 0's rmtree completes before
+                # any process can save. If processes DO restore
+                # different steps (one read a step the other pruned),
+                # the mismatched step numbers fail that collective save
+                # loudly — divergence is detected, not silent. Raw
+                # rmtree on purpose: mgr.delete has its own collective
+                # semantics that a proven-torn step dir can violate.
+                if self._process_index() == 0:
+                    for bad in newer:
                         shutil.rmtree(
                             os.path.join(self.directory, str(bad)),
                             ignore_errors=True)
-                # restart the manager so its in-memory step cache
-                # cannot keep serving the pruned steps
                 self._mgr.close()
                 self._mgr = self._make_mgr()
             return state, int(step)
@@ -218,11 +235,20 @@ class TrainCheckpointer:
         pointing at the stale higher step — every later resume would
         restore the bad checkpoint again and silently retrain from
         scratch forever. Never call it on transient read errors; that
-        destroys valid checkpoints."""
+        destroys valid checkpoints.
+
+        Multi-process JAX: call on EVERY process (each one proves the
+        same staleness from the same files); process 0 wipes, each
+        process rebuilds its manager. No barrier — a process that hit
+        a transient error instead of staleness raises rather than
+        calling clear(), and a barrier here would hang the survivors
+        against the dead process. The next Orbax save is collective,
+        which serializes the wipe before any new step is written."""
         import shutil
 
         self._mgr.close()
-        shutil.rmtree(self.directory, ignore_errors=True)
+        if self._process_index() == 0:
+            shutil.rmtree(self.directory, ignore_errors=True)
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = self._make_mgr()
 

@@ -208,10 +208,11 @@ def main() -> None:
             ctx = mp.get_context("fork")
 
             def burst():
-                q: Any = ctx.Queue()
+                q = ctx.Queue()
                 procs = [ctx.Process(target=_client_proc,
                                      args=(args.port + 1, args.n_users,
-                                           per_client, ci, q))
+                                           per_client, ci, q),
+                                     daemon=True)
                          for ci in range(args.concurrency)]
                 t0 = time.perf_counter()
                 for p in procs:
@@ -229,7 +230,11 @@ def main() -> None:
                         outs.append("client timed out (killed?)")
                 for p in procs:
                     p.join(timeout=30)
-                    if p.exitcode not in (0, None):
+                    if p.is_alive():  # stuck client: kill, don't hang
+                        p.terminate()
+                        p.join(timeout=10)
+                        outs.append("client stuck (terminated)")
+                    elif p.exitcode != 0:
                         outs.append(f"client exit code {p.exitcode}")
                 wall = time.perf_counter() - t0
                 errs = [o for o in outs if isinstance(o, str)]

@@ -122,6 +122,69 @@ class TestTwoTowerTemplate:
         assert deployed.query({"user": "nobody", "num": 3}) == {"itemScores": []}
 
 
+class TestDeviceServing:
+    def test_resident_scorer_matches_host_path(self, storage, monkeypatch):
+        """r5: with both towers materialized, two-tower serving rides
+        the shared ALS ResidentScorer — device and host paths must
+        rank identically, and batch_predict must serve a micro-batch
+        in ONE device dispatch."""
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.models import als as als_mod
+
+        app = storage.meta.create_app("TTDevApp")
+        storage.events.init_channel(app.id)
+        rng = np.random.default_rng(2)
+        evs = [Event(event="view", entity_type="user",
+                     entity_id=f"u{int(u)}", target_entity_type="item",
+                     target_entity_id=f"i{int(i)}")
+               for u, i in zip(rng.integers(0, 20, 300),
+                               rng.integers(0, 30, 300))]
+        storage.events.insert_batch(evs, app.id)
+        variant = {
+            "engineFactory": TT_FACTORY,
+            "datasource": {"params": {"appName": "TTDevApp"}},
+            "algorithms": [{"name": "twotower",
+                            "params": {"embedDim": 8, "outDim": 8,
+                                       "hidden": [16], "epochs": 5,
+                                       "batchSize": 64}}],
+        }
+        run_train(TT_FACTORY, variant=variant, storage=storage,
+                  use_mesh=False)
+
+        monkeypatch.setenv("PIO_ALS_SERVE", "host")
+        host = prepare_deploy(engine_factory=TT_FACTORY, storage=storage)
+        host_res = host.query({"user": "u3", "num": 5})
+
+        monkeypatch.setenv("PIO_ALS_SERVE", "device")
+        dev = prepare_deploy(engine_factory=TT_FACTORY, storage=storage)
+        dev_res = dev.query({"user": "u3", "num": 5})
+        assert [s["item"] for s in dev_res["itemScores"]] == \
+            [s["item"] for s in host_res["itemScores"]]
+        np.testing.assert_allclose(
+            [s["score"] for s in dev_res["itemScores"]],
+            [s["score"] for s in host_res["itemScores"]], rtol=1e-4)
+
+        # micro-batch path: one resident dispatch for the whole batch
+        calls = {"n": 0}
+        orig = als_mod.ResidentScorer.recommend_batch
+
+        def counting(self, *a, **k):
+            calls["n"] += 1
+            return orig(self, *a, **k)
+
+        monkeypatch.setattr(als_mod.ResidentScorer, "recommend_batch",
+                            counting)
+        batch = [{"user": f"u{u}", "num": 4} for u in range(6)] + \
+            [{"user": "nobody", "num": 4}]
+        outs = dev.batch_query(batch)
+        assert calls["n"] == 1
+        assert outs[-1] == {"itemScores": []}
+        for q, o in zip(batch[:-1], outs[:-1]):
+            single = dev.query(q)
+            assert [s["item"] for s in o["itemScores"]] == \
+                [s["item"] for s in single["itemScores"]]
+
+
 class TestEvaluation:
     def test_leave_one_out_recall(self, storage):
         """read_eval + Recall@k through the MetricEvaluator on
