@@ -138,12 +138,13 @@ def _backend_watchdog(seconds: float):
             print("bench.py: accelerator backend unreachable after "
                   f"{seconds:.0f}s (tunnel relay wedged?) — no "
                   "measurement possible; see the previous round's BENCH "
-                  "file for last good numbers. The tunnel has now been "
-                  "dead for rounds 3, 4 and 5; chip-free validation "
-                  "for r5 is in docs/perf.md (AOT compile vs a v5e "
-                  "topology, profile_aot.py) and the measurement "
-                  "sequence for a live chip is "
-                  "docs/perf/hardware_runbook.md", flush=True)
+                  "file for last good numbers (r5 measured on the v5e: "
+                  "docs/perf.md hardware A/B + bench tables). The "
+                  "wedged-relay outage previously ate rounds 3–4; "
+                  "chip-free validation is docs/perf.md 'AOT compile "
+                  "validation' (profile_aot.py) and the live-chip "
+                  "sequence is docs/perf/hardware_runbook.md",
+                  flush=True)
             os._exit(2)
 
     threading.Thread(target=fire, daemon=True).start()

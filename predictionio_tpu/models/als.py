@@ -1083,6 +1083,47 @@ def maybe_resident_scorer(U, V, cached=None):
     return ResidentScorer(U, V)
 
 
+def serve_topk_batch(scorer, user_ids, item_inv, queries, fallback,
+                     per_query=None):
+    """Serve a micro-batch of top-k queries in ONE device dispatch.
+
+    The shared implementation behind the templates' ``batch_predict``
+    (`pio deploy --batching`, batchpredict, evaluation — SURVEY §3.2
+    continuous-batching contract): collect every top-k-shaped query,
+    score them all through ``scorer.recommend_batch`` with a single
+    padded ``k = max(num)``, slice per row. Queries ``per_query``
+    flags (e.g. rating-prediction shapes) and unknown users fall back
+    without touching the device; ``scorer=None`` (host-path catalogs,
+    :func:`maybe_resident_scorer`) serves everything via ``fallback``.
+
+    ``user_ids``: str id → row index mapping (``.get``);
+    ``item_inv``: row index → item id; ``fallback``: per-query callable
+    returning a response dict.
+    """
+    if scorer is None:
+        return [fallback(q) for q in queries]
+    out = [None] * len(queries)
+    rows = []  # (out index, user row, num)
+    for i, q in enumerate(queries):
+        if per_query is not None and per_query(q):
+            out[i] = fallback(q)
+            continue
+        uidx = user_ids.get(str(q["user"]))
+        if uidx is None:
+            out[i] = {"itemScores": []}
+            continue
+        rows.append((i, uidx, int(q.get("num", 10))))
+    if rows:
+        k = max(n for _, _, n in rows)
+        res = scorer.recommend_batch(
+            np.asarray([u for _, u, _ in rows], np.int32), k)
+        for (i, _, n), (iv, vv) in zip(rows, res):
+            out[i] = {"itemScores": [
+                {"item": item_inv[int(j)], "score": float(s)}
+                for j, s in zip(iv[:n], vv[:n])]}
+    return out
+
+
 class ResidentScorer:
     """Serving-time scorer with factors resident on device.
 

@@ -300,7 +300,9 @@ def als_train_sharded_prepared(
     ``restore_latest_compatible``. Checkpoint calls are COLLECTIVE
     under multi-process meshes: every process calls save/clear
     together (Orbax elects the writer and syncs internally;
-    ``TrainCheckpointer.clear`` wipes on process 0 and barriers).
+    ``TrainCheckpointer.clear`` wipes on process 0 via an atomic
+    rename-to-tombstone — no barrier, see its docstring for why a
+    concurrent manager re-init on another process stays safe).
 
     Per-boundary cost: one extra program dispatch + a host fetch of
     U and V + the Orbax write (measured on the 8-device CPU mesh —

@@ -149,11 +149,18 @@ def chol_solve_batched(A, b, platform=None):
     A: (..., k, k) SPD (symmetric positive definite — ALS adds a ridge),
     b: (..., k) → x: (..., k). Any k ≥ 1.
 
-    On TPU (``platform="tpu"``, or the default backend when None) a
-    2-D batch dispatches to the Pallas VMEM-resident kernel
-    (:func:`chol_solve_pallas`); elsewhere the XLA block-recursive
-    path runs (internally padded to a power of two with an identity
-    block, which factors to itself and leaves the k×k solve untouched).
+    The default is the XLA block-recursive path (internally padded to
+    a power of two with an identity block, which factors to itself and
+    leaves the k×k solve untouched). ``PIO_PALLAS_SOLVE=1`` opts into
+    the Pallas VMEM-resident kernel (:func:`chol_solve_pallas`) on TPU;
+    ``PIO_PALLAS_SOLVE=auto`` restores the r4 behavior (kernel on TPU
+    behind a one-time on-device preflight with automatic XLA fallback).
+
+    Why XLA is the default (r5 A/B on the v5e, `profile_als.py --ab`):
+    the full ML-20M train measured warm 4.92 s with the XLA recursion
+    vs 9.78 s with the Pallas kernel — the VMEM solve halves the cold
+    compile (24.5 s vs 113 s) but loses 2× on execution on real
+    hardware, so it stays opt-in for compile-latency-sensitive runs.
     """
     A = jnp.asarray(A, jnp.float32)
     b = jnp.asarray(b, jnp.float32)
@@ -161,14 +168,9 @@ def chol_solve_batched(A, b, platform=None):
 
     from predictionio_tpu import ops
 
-    # PIO_PALLAS_SOLVE: "0" forces the XLA recursion, "1" forces the
-    # kernel; unset → use the kernel on TPU if the one-time preflight
-    # (compile + solve a tiny identity batch on the real device)
-    # succeeds — a Mosaic regression then degrades to the XLA path
-    # instead of failing the training program.
     flag = os.environ.get("PIO_PALLAS_SOLVE", "")
     if A.ndim == 3 and A.shape[0] >= 256 and ops.use_pallas(platform):
-        if flag == "1" or (flag != "0" and _pallas_solve_preflight()):
+        if flag == "1" or (flag == "auto" and _pallas_solve_preflight()):
             return chol_solve_pallas(A, b)
     elif flag == "1":
         # The flag promises "force the kernel" — an A/B run that

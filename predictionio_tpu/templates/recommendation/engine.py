@@ -322,33 +322,14 @@ class ALSAlgorithm(Algorithm):
     def batch_predict(self, model: ALSModel, queries) -> List[Dict[str, Any]]:
         """Micro-batched serving (`pio deploy --batching`, batchpredict,
         evaluation): all top-k-shaped queries in the batch score in ONE
-        device dispatch (`ResidentScorer.recommend_batch`) instead of
-        one per query — the SURVEY §3.2 continuous-batching contract.
+        device dispatch via the shared `models/als.serve_topk_batch`.
         Rating-prediction shapes and cold users fall back per-query."""
-        scorer = model._device_scorer()
-        if scorer is None:
-            return [self.predict(model, q) for q in queries]
-        out: List[Optional[Dict[str, Any]]] = [None] * len(queries)
-        rows = []  # (out index, user row, num)
-        for i, q in enumerate(queries):
-            if "item" in q:
-                out[i] = self.predict(model, q)
-                continue
-            uidx = model.user_ids.get(str(q["user"]))
-            if uidx is None:
-                out[i] = {"itemScores": []}
-                continue
-            rows.append((i, uidx, int(q.get("num", 10))))
-        if rows:
-            k = max(n for _, _, n in rows)
-            res = scorer.recommend_batch(
-                np.asarray([u for _, u, _ in rows], np.int32), k)
-            inv = model._item_inv
-            for (i, _, n), (iv, vv) in zip(rows, res):
-                out[i] = {"itemScores": [
-                    {"item": inv[int(j)], "score": float(s)}
-                    for j, s in zip(iv[:n], vv[:n])]}
-        return out  # type: ignore[return-value]
+        from predictionio_tpu.models.als import serve_topk_batch
+
+        return serve_topk_batch(
+            model._device_scorer(), model.user_ids, model._item_inv,
+            queries, fallback=lambda q: self.predict(model, q),
+            per_query=lambda q: "item" in q)
 
     # structured persistence: npz for factors (compact, zero-copy load)
     def save_model(self, model: ALSModel, instance_dir: Optional[str]) -> bytes:

@@ -214,30 +214,13 @@ class TwoTowerAlgorithm(Algorithm):
     def batch_predict(self, model: TwoTowerModel,
                       queries) -> List[Dict[str, Any]]:
         """Micro-batched serving (`pio deploy --batching`,
-        batchpredict): all queries in ONE device dispatch through the
-        shared resident scorer, mirroring the recommendation
-        template."""
-        scorer = model._device_scorer()
-        if scorer is None:
-            return [self.predict(model, q) for q in queries]
-        out: List[Optional[Dict[str, Any]]] = [None] * len(queries)
-        rows = []
-        for i, q in enumerate(queries):
-            uidx = model.user_ids.get(str(q["user"]))
-            if uidx is None:
-                out[i] = {"itemScores": []}
-                continue
-            rows.append((i, uidx, int(q.get("num", 10))))
-        if rows:
-            k = max(n for _, _, n in rows)
-            res = scorer.recommend_batch(
-                np.asarray([u for _, u, _ in rows], np.int32), k)
-            inv = model._inv
-            for (i, _, n), (iv2, vv2) in zip(rows, res):
-                out[i] = {"itemScores": [
-                    {"item": inv[int(j)], "score": float(s)}
-                    for j, s in zip(iv2[:n], vv2[:n])]}
-        return out  # type: ignore[return-value]
+        batchpredict): all queries in ONE device dispatch via the
+        shared `models/als.serve_topk_batch`."""
+        from predictionio_tpu.models.als import serve_topk_batch
+
+        return serve_topk_batch(
+            model._device_scorer(), model.user_ids, model._inv,
+            queries, fallback=lambda q: self.predict(model, q))
 
     def save_model(self, model: TwoTowerModel, instance_dir: Optional[str]) -> bytes:
         # user_embeds is NOT persisted: it is derivable from user_vars

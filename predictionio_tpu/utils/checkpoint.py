@@ -191,28 +191,29 @@ class TrainCheckpointer:
             # one raises loudly in ``save`` instead of losing data.
             newer = [s for s in steps if s > step and s in prunable]
             if newer:
-                import shutil
-
                 # process 0 prunes the shared dir; every process
                 # rebuilds its manager so no in-memory step cache keeps
                 # serving the pruned steps. Deliberately NO barrier
                 # here: this branch is entered per-process from local
                 # reads, and a process that restored cleanly (empty
                 # `newer`) would never reach it — a conditional barrier
-                # deadlocks exactly when reads diverge. Ordering is
-                # still safe multi-process: the next Orbax save is
-                # collective, so process 0's rmtree completes before
-                # any process can save. If processes DO restore
-                # different steps (one read a step the other pruned),
-                # the mismatched step numbers fail that collective save
-                # loudly — divergence is detected, not silent. Raw
-                # rmtree on purpose: mgr.delete has its own collective
-                # semantics that a proven-torn step dir can violate.
+                # deadlocks exactly when reads diverge. Instead each
+                # step dir is atomically RENAMED to a tombstone outside
+                # the managed directory before its contents are
+                # deleted, so a concurrent manager re-init on another
+                # process sees the step either whole or gone — never
+                # half-unlinked (the race a raw in-place rmtree has).
+                # If processes DO restore different steps (one read a
+                # step the other pruned), the mismatched step numbers
+                # fail the next collective save loudly — divergence is
+                # detected, not silent. Not mgr.delete on purpose: it
+                # has its own collective semantics that a proven-torn
+                # step dir can violate.
                 if self._process_index() == 0:
                     for bad in newer:
-                        shutil.rmtree(
+                        self._tombstone_delete(
                             os.path.join(self.directory, str(bad)),
-                            ignore_errors=True)
+                            f".pio-pruned-{bad}")
                 self._mgr.close()
                 self._mgr = self._make_mgr()
             return state, int(step)
@@ -242,15 +243,42 @@ class TrainCheckpointer:
         process rebuilds its manager. No barrier — a process that hit
         a transient error instead of staleness raises rather than
         calling clear(), and a barrier here would hang the survivors
-        against the dead process. The next Orbax save is collective,
-        which serializes the wipe before any new step is written."""
-        import shutil
-
+        against the dead process. The wipe is an atomic RENAME of the
+        whole directory to a tombstone (unlinking then happens under
+        the tombstone path no manager scans), so another process
+        re-initializing its manager mid-wipe sees either the old steps
+        or an empty directory — never a half-deleted tree. A process
+        whose manager caches the pre-wipe steps is harmless: saves
+        write explicit new step numbers, and the stale steps are gone
+        from disk for every future resume."""
         self._mgr.close()
         if self._process_index() == 0:
-            shutil.rmtree(self.directory, ignore_errors=True)
+            self._tombstone_delete(self.directory, ".pio-cleared")
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = self._make_mgr()
+
+    @staticmethod
+    def _tombstone_delete(path: str, tag: str) -> None:
+        """Atomically rename ``path`` out of scanned space, then delete.
+
+        The tombstone lives in the PARENT directory (Orbax managers
+        enumerate entries of the checkpoint root, and some versions
+        warn or choke on non-step names), suffixed with the pid so
+        repeated prunes of the same step never collide. Falls back to
+        in-place rmtree if the rename itself fails (e.g. the path is a
+        filesystem root or the parent is unwritable)."""
+        import shutil
+
+        if not os.path.exists(path):
+            return
+        parent = os.path.dirname(os.path.abspath(path)) or "."
+        tomb = os.path.join(parent, f"{tag}-{os.getpid()}")
+        try:
+            os.rename(path, tomb)
+        except OSError:
+            shutil.rmtree(path, ignore_errors=True)
+        else:
+            shutil.rmtree(tomb, ignore_errors=True)
 
     def close(self) -> None:
         self._mgr.close()

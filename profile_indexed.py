@@ -44,9 +44,15 @@ def main() -> None:
 
     import jax
 
-    if args.platform:
+    # "tpu" = "the accelerator": on this image the chip registers via
+    # the axon plugin, so forcing jax_platforms="tpu" fails — leave
+    # default resolution to find the device (see profile_serving.py).
+    if args.platform and args.platform != "tpu":
         jax.config.update("jax_platforms", args.platform)
     jax.devices()
+    if args.platform == "tpu" and jax.default_backend() == "cpu":
+        raise SystemExit("--platform tpu requested but only the CPU "
+                         "backend is available")
 
     from predictionio_tpu.data.event import Event
     from predictionio_tpu.models.cco import CCOParams, cco_indicators

@@ -141,9 +141,16 @@ def main() -> None:
 
     import jax
 
-    if args.platform:
+    # "tpu" means "the accelerator": on this image the chip registers
+    # through the axon plugin, so forcing jax_platforms="tpu" fails
+    # ("No jellyfish device found") — leave the default resolution to
+    # pick the device, then assert we didn't silently land on CPU.
+    if args.platform and args.platform != "tpu":
         jax.config.update("jax_platforms", args.platform)
     jax.devices()  # fail fast if the platform is unreachable
+    if args.platform == "tpu" and jax.default_backend() == "cpu":
+        raise SystemExit("--platform tpu requested but only the CPU "
+                         "backend is available")
 
     from profile_common import make_memory_storage
     from predictionio_tpu.core.workflow import prepare_deploy
