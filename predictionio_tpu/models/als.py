@@ -109,8 +109,16 @@ def init_factors(n: int, rank: int, seed: int) -> np.ndarray:
 # during training (so same-width entities are contiguous); factors are
 # un-permuted once at the end.
 
-_SLAB_ELEMS = 1 << 18   # slab_entities × width bound per scan step
-                        # (bounds the (slab, C, k) gather to ~64MB at k=64)
+_SLAB_ELEMS = int(os.environ.get("PIO_ALS_SLAB_ELEMS", str(1 << 20)))
+                        # slab_entities × width bound per scan step. The r5
+                        # trace showed the warm train latency-bound (~8.8k
+                        # device ops/iteration, HBM at 49 of 819 GB/s), so
+                        # bigger slabs = fewer, larger dispatches: 2^20
+                        # (~256 MB gather at k=64) measured 2.16 s vs 2.71 s
+                        # device-side for the ML-20M train against the r2-r4
+                        # 2^18 default (profile_als.py --tune on the v5e).
+                        # Env-tunable; layout parity across slab sizes is
+                        # tested (test_als.py::test_slab_size_parity).
 
 # Allowed padded widths. Round 2 used every power of two up to the
 # heaviest entity's count (8.4M!): 38 buckets across both sides, each
@@ -132,7 +140,7 @@ _C_MAX = _LADDER[-1]
 # ML-20M, k=64); catalogs where it would exceed the cap below fall back
 # to in-body solves (memory flat, compile slower, persistent cache
 # amortizes).
-_SOLVE_CHUNK = 4096
+_SOLVE_CHUNK = int(os.environ.get("PIO_ALS_SOLVE_CHUNK", "4096"))
 _SOLVE_BUF_MB = int(os.environ.get("PIO_ALS_SOLVE_BUF_MB", "4096"))
 
 # Dense-head crossover. The heaviest entities dominate padded slots

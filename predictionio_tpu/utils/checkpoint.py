@@ -257,22 +257,25 @@ class TrainCheckpointer:
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = self._make_mgr()
 
-    @staticmethod
-    def _tombstone_delete(path: str, tag: str) -> None:
+    def _tombstone_delete(self, path: str, tag: str) -> None:
         """Atomically rename ``path`` out of scanned space, then delete.
 
-        The tombstone lives in the PARENT directory (Orbax managers
-        enumerate entries of the checkpoint root, and some versions
-        warn or choke on non-step names), suffixed with the pid so
-        repeated prunes of the same step never collide. Falls back to
-        in-place rmtree if the rename itself fails (e.g. the path is a
-        filesystem root or the parent is unwritable)."""
+        The tombstone lives in the parent OF THE CHECKPOINT ROOT —
+        never inside the root itself: Orbax managers enumerate entries
+        of the root, and some versions warn or choke on non-step names,
+        so a pruned STEP dir renamed to ``<root>/.pio-pruned-…`` would
+        be visible to a concurrent manager re-init (and would persist
+        there if this process died before the rmtree). Suffixed with
+        the pid so repeated prunes of the same step never collide.
+        Falls back to in-place rmtree if the rename itself fails (e.g.
+        cross-device, or the tomb dir is unwritable)."""
         import shutil
 
         if not os.path.exists(path):
             return
-        parent = os.path.dirname(os.path.abspath(path)) or "."
-        tomb = os.path.join(parent, f"{tag}-{os.getpid()}")
+        root = os.path.abspath(self.directory)
+        tomb_dir = os.path.dirname(root) or "."
+        tomb = os.path.join(tomb_dir, f"{tag}-{os.getpid()}")
         try:
             os.rename(path, tomb)
         except OSError:

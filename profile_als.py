@@ -44,6 +44,86 @@ def _measure(prep, params, label):
     return t_warm
 
 
+def _measure_device(prep, params, label, repeats=3):
+    """Device-side warm time: run the compiled train and fetch ONE
+    scalar (U.sum()+V.sum()) instead of the 42 MB factor output — the
+    tunneled chip executes lazily and moves d2h bytes at ~20 MB/s, so
+    the big fetch adds ~4.7 s of pure image artifact and its variance
+    swamps 20% device-level wins."""
+    import jax.numpy as jnp
+
+    from predictionio_tpu.models import als
+
+    u_bufs, i_bufs = prep.device_buffers()
+    train = als._compiled_bucketed(
+        prep.u_side.geometry, prep.i_side.geometry,
+        prep.n_users, prep.n_items, params.rank, params.iterations,
+        bool(params.implicit), bool(params.weighted_reg),
+        None, bool(params.bf16_gather), als._gram_precision())
+    V0 = jnp.asarray(
+        als.init_factors(prep.n_items, params.rank, params.seed)[
+            prep.i_side.perm])
+    reg = np.float32(params.reg)
+    alpha = np.float32(params.alpha)
+
+    def once():
+        t0 = time.perf_counter()
+        U, V = train(u_bufs, i_bufs, V0, reg, alpha)
+        s = float(jnp.sum(U) + jnp.sum(V))   # 4-byte fetch forces exec
+        return time.perf_counter() - t0, s
+
+    t_cold, s = once()
+    assert np.isfinite(s), label
+    t_dev = min(once()[0] for _ in range(repeats))
+    thr = prep.nnz * params.iterations / t_dev / 1e6
+    print(f"{label:44} cold={t_cold:7.1f}s dev={t_dev:6.2f}s "
+          f"thr_dev={thr:7.1f}M/s", flush=True)
+    return t_dev
+
+
+def _tune(args):
+    """On-device A/B of the layout/solve knobs the r5 trace flagged:
+    the chunked solve pass (41 chunks x ~50 small ops each) and the
+    gather slab size. Prints one line per configuration; the winner
+    becomes the default."""
+    from bench import synthetic_ml20m
+    from predictionio_tpu.models import als
+    from predictionio_tpu.models.als import ALSParams, RatingsCOO
+    from predictionio_tpu.utils import compilecache
+
+    compilecache.enable()
+    users, items, ratings = synthetic_ml20m(args.nnz)
+    coo = RatingsCOO(users, items, ratings, 138_493, 26_744)
+    params = ALSParams(rank=args.rank, iterations=args.iters, reg=0.05,
+                       seed=1)
+
+    chunks = [int(c) for c in args.chunks.split(",")] if args.chunks else []
+    slabs = [int(s) for s in args.slabs.split(",")] if args.slabs else []
+    entry_chunk, entry_slab = als._SOLVE_CHUNK, als._SLAB_ELEMS
+    preps = {}
+
+    def prep_for(slab):
+        if slab not in preps:
+            als._SLAB_ELEMS = slab
+            preps[slab] = als.als_prepare(coo)
+        return preps[slab]
+
+    base_slab = als._SLAB_ELEMS
+    for chunk in chunks or [als._SOLVE_CHUNK]:
+        for slab in slabs or [base_slab]:
+            als._SOLVE_CHUNK = chunk
+            als._compiled_bucketed.cache_clear()
+            try:
+                _measure_device(prep_for(slab), params,
+                                f"chunk={chunk} slab={slab}")
+            except Exception as exc:  # OOM etc: report, keep going
+                print(f"chunk={chunk} slab={slab}: {type(exc).__name__}: "
+                      f"{str(exc)[:120]}", flush=True)
+    # restore the values in effect at entry (not re-spelled literals,
+    # which would silently revert a future default change — r5 review)
+    als._SOLVE_CHUNK, als._SLAB_ELEMS = entry_chunk, entry_slab
+
+
 def _sharded_ckpt_overhead(args):
     """Per-boundary cost of block-wise checkpointing on the sharded
     path: straight fused run vs checkpoint_every=1 (one boundary per
@@ -107,6 +187,15 @@ def main():
                     help="run the optimization A/B matrix")
     ap.add_argument("--trace-dir", default="/tmp/als_trace")
     ap.add_argument("--trace-iters", type=int, default=2)
+    ap.add_argument("--tune", action="store_true",
+                    help="on-device A/B of solve-chunk / slab-size "
+                         "knobs (device-side timing, scalar fetch)")
+    ap.add_argument("--chunks", default="",
+                    help="comma list of PIO_ALS_SOLVE_CHUNK values "
+                         "for --tune (default: current)")
+    ap.add_argument("--slabs", default="",
+                    help="comma list of PIO_ALS_SLAB_ELEMS values "
+                         "for --tune (default: current)")
     ap.add_argument("--sharded-ckpt", action="store_true",
                     help="measure the per-boundary overhead of "
                          "block-wise checkpointing on the sharded "
@@ -120,6 +209,16 @@ def main():
         return
     if args.nnz is None:
         args.nnz = 20_000_000
+
+    # every mode below is a CHIP measurement: abort (don't mislabel)
+    # if the backend silently fell back to CPU (r5 review)
+    from profile_common import resolve_platform
+
+    resolve_platform("")
+
+    if args.tune:
+        _tune(args)
+        return
 
     from bench import synthetic_ml20m
     from predictionio_tpu.models import als

@@ -31,19 +31,9 @@ def main() -> None:
     ap.add_argument("--platform", default="cpu")
     args = ap.parse_args()
 
-    import jax
+    from profile_common import make_memory_storage, resolve_platform
 
-    # "tpu" = "the accelerator": on this image the chip registers via
-    # the axon plugin, so forcing jax_platforms="tpu" fails — leave
-    # default resolution to find the device (see profile_serving.py).
-    if args.platform and args.platform != "tpu":
-        jax.config.update("jax_platforms", args.platform)
-    jax.devices()
-    if args.platform == "tpu" and jax.default_backend() == "cpu":
-        raise SystemExit("--platform tpu requested but only the CPU "
-                         "backend is available")
-
-    from profile_common import make_memory_storage
+    resolve_platform(args.platform)
     from profile_serving import fabricate_instance
     from predictionio_tpu.core.batchpredict import run_batch_predict
     from predictionio_tpu.core.workflow import prepare_deploy

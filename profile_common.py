@@ -10,6 +10,31 @@ import time
 from contextlib import contextmanager
 
 
+def resolve_platform(platform: str):
+    """Apply a profiler's ``--platform`` choice and fail fast.
+
+    ``cpu`` (or any non-tpu name) pins jax via config — the env var
+    JAX_PLATFORMS is decided by this image's sitecustomize before any
+    harness runs, so only the config route works. ``tpu`` (or "" =
+    image default where the harness expects the chip) must NOT be
+    forced by name — the chip registers via the experimental axon
+    plugin and ``jax_platforms="tpu"`` fails with "No jellyfish device
+    found" — so the default backend is left alone and the result is
+    checked: a wedged relay silently falling back to CPU must abort
+    the run, not record CPU numbers labeled as chip measurements."""
+    import jax
+
+    if platform and platform != "tpu":
+        jax.config.update("jax_platforms", platform)
+    jax.devices()  # fail fast if the platform is unreachable
+    if platform in ("", "tpu") and jax.default_backend() == "cpu":
+        raise SystemExit(
+            f"--platform {platform or 'default'} expects the chip but "
+            "only the CPU backend is available (wedged relay?) — "
+            "aborting rather than mislabeling CPU numbers")
+    return jax
+
+
 def make_memory_storage():
     """A fresh all-in-memory Storage installed as process default."""
     from predictionio_tpu.data.events import MemoryEventStore

@@ -42,17 +42,9 @@ def main() -> None:
     ap.add_argument("--platform", default="cpu")
     args = ap.parse_args()
 
-    import jax
+    from profile_common import resolve_platform
 
-    # "tpu" = "the accelerator": on this image the chip registers via
-    # the axon plugin, so forcing jax_platforms="tpu" fails — leave
-    # default resolution to find the device (see profile_serving.py).
-    if args.platform and args.platform != "tpu":
-        jax.config.update("jax_platforms", args.platform)
-    jax.devices()
-    if args.platform == "tpu" and jax.default_backend() == "cpu":
-        raise SystemExit("--platform tpu requested but only the CPU "
-                         "backend is available")
+    resolve_platform(args.platform)
 
     from predictionio_tpu.data.event import Event
     from predictionio_tpu.models.cco import CCOParams, cco_indicators

@@ -139,20 +139,9 @@ def main() -> None:
                          "one-dispatch batch_predict path)")
     args = ap.parse_args()
 
-    import jax
+    from profile_common import make_memory_storage, resolve_platform
 
-    # "tpu" means "the accelerator": on this image the chip registers
-    # through the axon plugin, so forcing jax_platforms="tpu" fails
-    # ("No jellyfish device found") — leave the default resolution to
-    # pick the device, then assert we didn't silently land on CPU.
-    if args.platform and args.platform != "tpu":
-        jax.config.update("jax_platforms", args.platform)
-    jax.devices()  # fail fast if the platform is unreachable
-    if args.platform == "tpu" and jax.default_backend() == "cpu":
-        raise SystemExit("--platform tpu requested but only the CPU "
-                         "backend is available")
-
-    from profile_common import make_memory_storage
+    jax = resolve_platform(args.platform)
     from predictionio_tpu.core.workflow import prepare_deploy
     from predictionio_tpu.models.als import ResidentScorer
     from predictionio_tpu.server.engine_server import EngineServer

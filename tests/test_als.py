@@ -264,6 +264,41 @@ class TestBucketedLayout:
         np.testing.assert_allclose(U_f, U_m, rtol=1e-4, atol=1e-5)
         np.testing.assert_allclose(V_f, V_m, rtol=1e-4, atol=1e-5)
 
+    def test_slab_size_parity(self, monkeypatch):
+        """The slab size (PIO_ALS_SLAB_ELEMS — an on-device tuning knob,
+        default 2^20 after the r5 v5e A/B) only re-batches rows into
+        scan steps; training results must be invariant to it. Small
+        ladder + tiny slabs force multi-slab scans on a small dataset,
+        covering regular AND segmented buckets."""
+        import predictionio_tpu.models.als as als_mod
+
+        monkeypatch.setattr(als_mod, "_LADDER", (2, 8))
+        monkeypatch.setattr(als_mod, "_C_MAX", 8)
+        rng = np.random.default_rng(11)
+        n_u, n_i = 40, 25
+        uu = (rng.zipf(1.3, 600) % n_u).astype(np.int32)
+        ii = (rng.zipf(1.3, 600) % n_i).astype(np.int32)
+        keep = np.unique(uu.astype(np.int64) * n_i + ii, return_index=True)[1]
+        uu, ii = uu[keep], ii[keep]
+        rr = rng.uniform(1, 5, len(uu)).astype(np.float32)
+        coo = RatingsCOO(uu, ii, rr, n_u, n_i)
+        p = ALSParams(rank=4, iterations=2, reg=0.1, seed=2)
+
+        results = []
+        for slab_elems in (16, 64, 1 << 20):
+            monkeypatch.setattr(als_mod, "_SLAB_ELEMS", slab_elems)
+            prep = als_mod.als_prepare(coo)
+            if slab_elems == 16:  # smallest: must actually multi-slab
+                assert any(b.n_slabs > 1 for b in prep.u_side.buckets)
+            results.append(als_mod.als_train_prepared(prep, p))
+        als_mod._compiled_bucketed.cache_clear()
+        # slab grouping changes f32 accumulation order in the seg
+        # aggregation → tiny drift; a layout bug would be order-1 off
+        (U0, V0), *rest = results
+        for U, V in rest:
+            np.testing.assert_allclose(U, U0, rtol=5e-4, atol=1e-5)
+            np.testing.assert_allclose(V, V0, rtol=5e-4, atol=1e-5)
+
     def test_default_ladder_matches_dense_reference(self):
         rng = np.random.default_rng(6)
         n_u, n_i = 30, 20
