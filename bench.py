@@ -307,6 +307,10 @@ def main() -> None:
             "predict_note": "end-to-end p50 bounded by tunnel round-trip "
                             "on this image; predict_p50_device_ms is the "
                             "measured device-program latency",
+            # layout knobs in effect (r5: slab default 2^20 after the
+            # on-device dispatch-granularity A/B — docs/perf.md)
+            "slab_elems": als_mod._SLAB_ELEMS,
+            "solve_chunk": als_mod._SOLVE_CHUNK,
             "backend": jax.default_backend(),
             "device": str(jax.devices()[0]),
         },

@@ -74,10 +74,21 @@ class Router:
 
     def route(self, method: str, pattern: str, handler: Handler) -> None:
         """Pattern supports ``{name}`` path params (one segment) and
-        ``{name+}`` (greedy, may span slashes)."""
-        escaped = re.escape(pattern).replace(r"\{", "{").replace(r"\}", "}")
-        rx = re.sub(r"\{(\w+)\+\}", r"(?P<\1>.+)", escaped)
-        rx = re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", rx)
+        ``{name+}`` (greedy, may span slashes).
+
+        Params are substituted BEFORE ``re.escape`` runs on the literal
+        parts: escaping first turned ``{path+}`` into ``{path\\+}``,
+        which neither substitution matched — every greedy route 404'd
+        (caught by the plugin-route tests)."""
+        parts = re.split(r"(\{\w+\+?\})", pattern)
+        rx = "".join(
+            # the capture group alternates literal/param parts: odd
+            # indices are params; prefix checks would misread literal
+            # brace text (e.g. "{b-c}") as a param and die in compile
+            re.escape(p) if i % 2 == 0
+            else (r"(?P<%s>.+)" % p[1:-2]) if p.endswith("+}")
+            else (r"(?P<%s>[^/]+)" % p[1:-1])
+            for i, p in enumerate(parts))
         self._routes.append((method.upper(), re.compile("^" + rx + "$"), handler))
 
     def match(self, method: str, path: str) -> Optional[Tuple[Handler, Dict[str, str]]]:

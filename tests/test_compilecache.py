@@ -1,0 +1,56 @@
+"""Persistent XLA compile cache wiring (utils/compilecache).
+
+The reference has no run-time compile step (Spark ships bytecode); here
+every ``pio train``'s wall-clock depends on this module wiring JAX's
+persistent cache correctly — a silent misconfiguration costs users the
+full XLA compile (73+ s at ML-20M geometry, docs/perf.md) on every run.
+"""
+
+import os
+
+import jax
+import pytest
+
+from predictionio_tpu.utils import compilecache
+
+
+@pytest.fixture(autouse=True)
+def _reset_enabled(monkeypatch):
+    """Each test sees a fresh module (enable() is once-per-process)."""
+    monkeypatch.setattr(compilecache, "_enabled", False)
+    yield
+
+
+def test_enable_points_jax_at_the_cache_dir(tmp_path, monkeypatch):
+    target = tmp_path / "xla_cache"
+    got = compilecache.enable(str(target))
+    assert got == str(target)
+    assert target.is_dir()
+    assert jax.config.jax_compilation_cache_dir == str(target)
+    # entries the ALS program sizes actually hit (default 60s/minsize
+    # would skip everything but the biggest program)
+    assert jax.config.jax_persistent_cache_min_compile_time_secs == 1.0
+    assert jax.config.jax_persistent_cache_min_entry_size_bytes == 0
+
+
+def test_env_off_disables(monkeypatch):
+    monkeypatch.setenv("PIO_XLA_CACHE_DIR", "off")
+    assert compilecache.enable() is None
+
+
+def test_env_dir_and_idempotency(tmp_path, monkeypatch):
+    target = tmp_path / "from_env"
+    monkeypatch.setenv("PIO_XLA_CACHE_DIR", str(target))
+    assert compilecache.enable() == str(target)
+    # second call is a no-op returning the same dir (config untouched)
+    before = jax.config.jax_compilation_cache_dir
+    assert compilecache.enable() == str(target)
+    assert jax.config.jax_compilation_cache_dir == before
+
+
+def test_defaults_under_pio_home(tmp_path, monkeypatch):
+    monkeypatch.delenv("PIO_XLA_CACHE_DIR", raising=False)
+    monkeypatch.setenv("PIO_HOME", str(tmp_path / "home"))
+    got = compilecache.enable()
+    assert got == os.path.join(str(tmp_path / "home"), "xla_cache")
+    assert os.path.isdir(got)

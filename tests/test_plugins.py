@@ -1,0 +1,192 @@
+"""Server plugin system tests (SURVEY.md §2a "Engine/Event server
+plugins" — reference: [U] core/.../workflow/EngineServerPlugin.scala +
+data/.../api/EventServerPlugin.scala, ServiceLoader-discovered; here
+discovery is programmatic registration or ``PIO_PLUGINS`` env specs).
+
+Covers the full plugin surface end to end over HTTP: event-server
+``input_blocker`` (rejects with 403 before storage) and
+``input_sniffer`` (observes accepted events only), engine-server
+``output_blocker`` (transforms every prediction), ``output_sniffer``,
+``/plugins.json`` listing and ``/plugins/<name>/<path>`` routes, plus
+the ``PIO_PLUGINS`` loading/validation rules.
+"""
+
+import sys
+import textwrap
+
+import pytest
+
+from predictionio_tpu.core import plugins as plugmod
+from predictionio_tpu.core.plugins import (
+    EngineServerPlugin,
+    EventServerPlugin,
+    engine_server_plugins,
+    event_server_plugins,
+    register_engine_plugin,
+    register_event_plugin,
+    reset_plugins,
+)
+from predictionio_tpu.core.workflow import run_train
+from predictionio_tpu.server.engine_server import EngineServer
+from predictionio_tpu.server.event_server import EventServer
+
+from test_servers import FACTORY, VARIANT, ServerThread, free_port, http
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    reset_plugins()
+    yield
+    reset_plugins()
+
+
+@pytest.fixture()
+def app(storage):
+    a = storage.meta.create_app("QuickApp")
+    storage.events.init_channel(a.id)
+    key = storage.meta.create_access_key(a.id)
+    return a, key
+
+
+class _Gate(EventServerPlugin):
+    """Blocks events named 'forbidden'; records what the sniffer sees."""
+
+    name = "gate"
+
+    def __init__(self):
+        self.sniffed = []
+
+    def input_blocker(self, event, app_id, channel_id):
+        if event.event == "forbidden":
+            return "forbidden event name"
+        return None
+
+    def input_sniffer(self, event, app_id, channel_id):
+        self.sniffed.append((event.event, app_id))
+
+
+class _Stamp(EngineServerPlugin):
+    """Stamps every prediction; counts sniffs; serves a route."""
+
+    name = "stamp"
+
+    def __init__(self):
+        self.sniffed = 0
+
+    def output_blocker(self, query, prediction):
+        if isinstance(prediction, dict):
+            return {**prediction, "stamped": True}
+        return prediction
+
+    def output_sniffer(self, query, prediction):
+        self.sniffed += 1
+
+    def handle_route(self, subpath, body):
+        return {"echo": subpath, "body": body}
+
+
+class TestEventServerPlugins:
+    def test_blocker_rejects_and_sniffer_observes(self, storage, app):
+        a, key = app
+        gate = _Gate()
+        port = free_port()
+        with ServerThread(EventServer(storage=storage, host="127.0.0.1",
+                                      port=port, plugins=[gate])):
+            base = f"http://127.0.0.1:{port}"
+            ok = {"event": "rate", "entityType": "user", "entityId": "u1",
+                  "targetEntityType": "item", "targetEntityId": "i1",
+                  "properties": {"rating": 4.0}}
+            code, body = http(
+                "POST", f"{base}/events.json?accessKey={key.key}", ok)
+            assert code == 201
+            bad = {**ok, "event": "forbidden"}
+            code, body = http(
+                "POST", f"{base}/events.json?accessKey={key.key}", bad)
+            assert code == 403 and "forbidden" in body["message"]
+        # blocked event never reached storage...
+        events = storage.events.find(a.id)
+        assert [e.event for e in events] == ["rate"]
+        # ...and the sniffer saw only the accepted one
+        assert gate.sniffed == [("rate", a.id)]
+
+
+class TestEngineServerPlugins:
+    def test_output_blocker_routes_and_listing(self, storage, app):
+        a, key = app
+        ev = storage.events
+        for u in range(12):
+            for i in range(10):
+                if (u + i) % 2 == 0:
+                    from predictionio_tpu.data.event import Event
+
+                    ev.insert(Event(
+                        event="rate", entity_type="user", entity_id=str(u),
+                        target_entity_type="item", target_entity_id=str(i),
+                        properties={"rating": 4.0}), a.id)
+        run_train(FACTORY, variant=VARIANT, storage=storage, use_mesh=False)
+        stamp = _Stamp()
+        port = free_port()
+        with ServerThread(EngineServer(
+                engine_factory=FACTORY, storage=storage, host="127.0.0.1",
+                port=port, plugins=[stamp])):
+            base = f"http://127.0.0.1:{port}"
+            code, pred = http("POST", f"{base}/queries.json",
+                              {"user": "2", "num": 3})
+            assert code == 200 and pred["stamped"] is True
+            assert stamp.sniffed == 1
+            code, listing = http("GET", f"{base}/plugins.json")
+            assert code == 200
+            assert "stamp" in listing["plugins"]["outputblockers"]
+            code, echoed = http("POST", f"{base}/plugins/stamp/sub/path",
+                                {"x": 1})
+            assert code == 200 and echoed == {"echo": "sub/path",
+                                              "body": {"x": 1}}
+            code, body = http("GET", f"{base}/plugins/nope/x")
+            assert code == 404
+
+
+class TestEnvDiscovery:
+    def test_pio_plugins_spec_loads_instance_and_class(
+            self, tmp_path, monkeypatch):
+        mod = tmp_path / "my_plugins.py"
+        mod.write_text(textwrap.dedent("""
+            from predictionio_tpu.core.plugins import (
+                EngineServerPlugin, EventServerPlugin)
+
+            class Gate(EventServerPlugin):
+                name = "env-gate"
+
+            plugin = Gate()          # instance attr (default name)
+
+            class Stamp(EngineServerPlugin):
+                name = "env-stamp"   # class attr: instantiated on load
+        """))
+        monkeypatch.syspath_prepend(str(tmp_path))
+        monkeypatch.setenv("PIO_PLUGINS", "my_plugins,my_plugins:Stamp")
+        try:
+            assert [p.name for p in event_server_plugins()] == ["env-gate"]
+            assert [p.name for p in engine_server_plugins()] == ["env-stamp"]
+            # discovery is once per process: mutating the env later
+            # does not re-run imports
+            monkeypatch.setenv("PIO_PLUGINS", "nonexistent_mod:x")
+            assert [p.name for p in event_server_plugins()] == ["env-gate"]
+        finally:
+            sys.modules.pop("my_plugins", None)
+
+    def test_bad_spec_raises(self, tmp_path, monkeypatch):
+        mod = tmp_path / "not_a_plugin.py"
+        mod.write_text("plugin = object()\n")
+        monkeypatch.syspath_prepend(str(tmp_path))
+        monkeypatch.setenv("PIO_PLUGINS", "not_a_plugin")
+        try:
+            with pytest.raises(TypeError):
+                event_server_plugins()
+        finally:
+            sys.modules.pop("not_a_plugin", None)
+
+    def test_programmatic_registration(self):
+        g, s = _Gate(), _Stamp()
+        register_event_plugin(g)
+        register_engine_plugin(s)
+        assert event_server_plugins() == [g]
+        assert engine_server_plugins() == [s]
