@@ -15,6 +15,8 @@ Measures, at ML-20M geometry (bench.py protocol):
 - single-device ALS train program (rank 64, 10 iters): lower + compile
   wall time, XLA-estimated flops;
 - the sharded 8-device ALS program over v5e:2x4;
+- the two-tower contrastive epoch program (batch 8192, 64→[128]→64);
+- the seq_rec ring-attention train program over the full topology;
 - the serving gather→score→top-k program.
 
 Prints ONE JSON line; see docs/perf.md "AOT compile validation".
@@ -159,6 +161,54 @@ def main() -> None:
         "lower_sec": round(st_lower, 2),
         "compile_sec": round(st_compile, 2),
         "xla_flops": scost.get("flops"),
+    }
+
+    # -- two-tower epoch program (the dense-matmul model family) ---------
+    import jax.numpy as jnp
+
+    from predictionio_tpu.models import two_tower as tt
+
+    user_tower, item_tower, opt, epoch_fn = tt._compiled_train_epoch(
+        138_493, 26_744, 64, (128,), 64)
+    rng = jax.random.PRNGKey(1)
+    ru, ri = jax.random.split(rng)
+    variables = (user_tower.init(ru, jnp.zeros((1,), jnp.int32)),
+                 item_tower.init(ri, jnp.zeros((1,), jnp.int32)))
+    opt_state = opt.init(variables)
+    tt_sds = _sds_tree(
+        (variables, opt_state,
+         np.zeros((100, 8192), np.int32), np.zeros((100, 8192), np.int32),
+         np.float32(0.1)),
+        lambda a: rep1)
+    t0 = time.perf_counter()
+    tt_compiled = epoch_fn.lower(*tt_sds).compile()
+    tt_cost = tt_compiled.cost_analysis() or {}
+    out["two_tower"] = {
+        "batch": 8192, "steps": 100, "dims": "64->[128]->64",
+        "lower_compile_sec": round(time.perf_counter() - t0, 2),
+        "xla_flops": tt_cost.get("flops"),
+    }
+
+    # -- seq_rec train program, ring attention over the topology ---------
+    from predictionio_tpu.models import seq_rec as sr
+
+    sp = sr.SeqRecParams()  # SASRec defaults: hidden 64, 2 blocks, seq 64
+    assert sp.seq_len % n_dev == 0, "ring path needs seq_len % n_dev == 0"
+    sr_params = sr.init_params(26_744, sp)
+    sr_opt = sr._make_tx().init(jax.tree.map(jnp.asarray, sr_params))
+    sr_train = sr._train_compiled(sp.hidden, sp.num_blocks, sp.num_heads,
+                                  sp.seq_len, 1, False, meshN)
+    sr_sds = _sds_tree(
+        (sr_params, sr_opt,
+         np.zeros((10, sp.batch_size, sp.seq_len), np.int32),
+         np.zeros((10, sp.batch_size, sp.seq_len), np.int32),
+         np.float32(0.0)),
+        lambda a: NamedSharding(meshN, P()))
+    t0 = time.perf_counter()
+    sr_train.lower(*sr_sds).compile()
+    out["seq_rec_ring"] = {
+        "n_devices": n_dev, "seq_len": sp.seq_len,
+        "lower_compile_sec": round(time.perf_counter() - t0, 2),
     }
 
     # -- serving program (gather → score → top-k, one dispatch) ----------

@@ -172,6 +172,35 @@ class TestEventServerAPI:
             assert http("POST", f"{base}/webhooks/nope.json?accessKey={key.key}",
                         {})[0] == 404
 
+    def test_mailchimp_form_webhook(self, storage, app):
+        """The FORM-kind connector branch: MailChimp posts urlencoded
+        ``data[...]`` keys, not JSON (reference: [U] data/.../webhooks/
+        mailchimp/MailChimpConnector.scala)."""
+        import urllib.parse
+        import urllib.request
+
+        a, key = app
+        port = free_port()
+        with ServerThread(EventServer(storage=storage, host="127.0.0.1",
+                                      port=port)):
+            base = f"http://127.0.0.1:{port}"
+            form = {"type": "subscribe", "fired_at": "2026-07-31 12:00:00",
+                    "data[email]": "ada@example.com", "data[id]": "x1",
+                    "data[list_id]": "L9"}
+            req = urllib.request.Request(
+                f"{base}/webhooks/mailchimp.json?accessKey={key.key}",
+                data=urllib.parse.urlencode(form).encode(),
+                headers={"Content-Type": "application/x-www-form-urlencoded"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 201
+            evs = list(storage.events.find(a.id, event_names=["subscribe"]))
+            assert len(evs) == 1
+            ev = evs[0]
+            assert ev.entity_id == "ada@example.com"
+            assert ev.properties["list_id"] == "L9"
+            assert ev.event_time.isoformat().startswith("2026-07-31T12:00:00")
+
 
 VARIANT = {
     "id": "default",
