@@ -138,6 +138,12 @@ def _sharded_ckpt_overhead(args):
     os.environ.setdefault(
         "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
+    # the 8-device shard_map programs take minutes of XLA-CPU compile
+    # on a 1-core box; persist them so repeat measurements pay once
+    from predictionio_tpu.utils import compilecache
+
+    compilecache.enable()
+
     from jax.sharding import Mesh
 
     from bench import synthetic_ml20m
