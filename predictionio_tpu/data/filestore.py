@@ -254,6 +254,82 @@ class NativeEventLogStore(EventStore):
             yield deserialize_payload(buf, pos, plen)
             pos += plen
 
+    def scan_columnar(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        value_key: Optional[str] = None,
+    ):
+        """Columnar training read: numpy arrays + deduped id tables,
+        no per-event Python objects (the HBase-scan→RDD[Rating]
+        analogue — the whole scan/parse/dedup runs in C++). Returns a
+        :class:`~predictionio_tpu.data.pipeline.ColumnarEvents`, or
+        None when the engine declines (>65535 distinct event names) —
+        callers fall back to the generic ``find()`` path.
+
+        ``value_key`` extracts one top-level numeric property per event
+        (the shared decimal grammar — numbers, bools, plain decimal
+        strings; NaN = absent/malformed, same drop rule as the generic
+        path's ``data/store._parse_value``) so rating-style reads
+        avoid a JSON pass in Python entirely.
+        """
+        import numpy as np
+
+        from predictionio_tpu.data.pipeline import ColumnarEvents
+
+        h = self._handle(app_id, channel_id)
+        out = ctypes.c_void_p()
+        names = ("\n".join(event_names).encode()
+                 if event_names is not None else None)
+        n = self._lib.pel_scan_columnar(
+            h,
+            _ts_us(start_time) if start_time else _UNBOUNDED_LO,
+            _ts_us(until_time) if until_time else _UNBOUNDED_HI,
+            entity_type.encode() if entity_type is not None else None,
+            target_entity_type.encode() if target_entity_type is not None
+            else None,
+            names,
+            value_key.encode() if value_key is not None else None,
+            ctypes.byref(out),
+        )
+        if n == -2:
+            return None  # engine declined; use the generic path
+        if n < 0:
+            raise IOError("event log columnar scan failed")
+        buf = self._take(out, n)
+
+        def table(off: int, count: int):
+            strs = []
+            for _ in range(count):
+                (sl,) = _U32.unpack_from(buf, off)
+                off += 4
+                strs.append(buf[off:off + sl].decode("utf-8"))
+                off += sl
+            return strs, off + (-off % 8)
+
+        ne, n_ent, n_tgt, n_nam = struct.unpack_from("<QQQQ", buf, 0)
+        off = 32
+        times = np.frombuffer(buf, "<i8", ne, off); off += 8 * ne
+        values = np.frombuffer(buf, "<f8", ne, off); off += 8 * ne
+        ent_idx = np.frombuffer(buf, "<u4", ne, off); off += 4 * ne
+        off += -off % 8
+        tgt_idx = np.frombuffer(buf, "<u4", ne, off); off += 4 * ne
+        off += -off % 8
+        name_idx = np.frombuffer(buf, "<u2", ne, off); off += 2 * ne
+        off += -off % 8
+        names_t, off = table(off, n_nam)
+        ents_t, off = table(off, n_ent)
+        tgts_t, off = table(off, n_tgt)
+        return ColumnarEvents(
+            entity_idx=ent_idx, target_idx=tgt_idx, name_idx=name_idx,
+            values=values, times_us=times,
+            entity_ids=ents_t, target_ids=tgts_t, names=names_t)
+
     # -- derived (native fold) ------------------------------------------------
 
     def aggregate_properties(

@@ -106,6 +106,86 @@ class InteractionData:
         return np.concatenate(us), np.concatenate(is_), np.concatenate(vs)
 
 
+class ColumnarEvents:
+    """One store scan as parallel numpy columns + deduped id tables —
+    what a native ``scan_columnar`` (EVENTLOG backend) returns. Index
+    arrays point into the id tables in FIRST-SEEN scan order, the same
+    order the two-pass Python reader assigns, so the two paths build
+    identical vocabularies."""
+
+    def __init__(self, entity_idx, target_idx, name_idx, values, times_us,
+                 entity_ids, target_ids, names) -> None:
+        self.entity_idx = entity_idx    # u32 [n]
+        self.target_idx = target_idx    # u32 [n]
+        self.name_idx = name_idx        # u16 [n] → names
+        self.values = values            # f64 [n], NaN = no value
+        self.times_us = times_us        # i64 [n]
+        self.entity_ids = entity_ids    # list[str]
+        self.target_ids = target_ids    # list[str]
+        self.names = names              # list[str]
+
+    @property
+    def n(self) -> int:
+        return int(self.entity_idx.shape[0])
+
+
+def interactions_from_columnar(
+    cols: ColumnarEvents,
+    value_spec: Optional[Dict[str, Any]] = None,
+    default_spec: Any = 1.0,
+    chunk_size: int = 65536,
+) -> InteractionData:
+    """Vectorized :class:`InteractionData` from a columnar scan.
+
+    ``value_spec`` maps event name → ``"prop"`` (use the scan's
+    extracted numeric property; non-finite drops the event, mirroring
+    the generic path's ``value_fn → None``) or a float constant.
+    Unlisted names take ``default_spec``. Vocabularies are re-densified
+    to kept events only (first-seen order), so the result is
+    indistinguishable from :func:`read_interactions` over ``find()``.
+    """
+    n = cols.n
+    vals = np.full(n, 1.0, np.float64)
+    keep = np.ones(n, bool)
+    finite = np.isfinite(cols.values)
+    for idx, name in enumerate(cols.names):
+        m = cols.name_idx == idx
+        spec = (value_spec or {}).get(name, default_spec)
+        if spec == "prop":
+            keep &= ~m | finite
+            vals = np.where(m, cols.values, vals)
+        else:
+            vals = np.where(m, float(spec), vals)
+
+    def densify(idx_arr: np.ndarray, table: List[str]):
+        """Trim the vocab to kept events, preserving first-seen order."""
+        uniq, first_pos = np.unique(idx_arr, return_index=True)
+        order = np.argsort(first_pos, kind="stable")
+        uniq = uniq[order]
+        remap = np.full(len(table), -1, np.int32)
+        remap[uniq] = np.arange(len(uniq), dtype=np.int32)
+        ids = [table[int(u)] for u in uniq]
+        return remap, BiMap({s: i for i, s in enumerate(ids)})
+
+    ent_kept = cols.entity_idx[keep]
+    tgt_kept = cols.target_idx[keep]
+    v_kept = vals[keep].astype(np.float32)
+    remap_e, user_ids = densify(ent_kept, cols.entity_ids)
+    remap_t, item_ids = densify(tgt_kept, cols.target_ids)
+    uu = remap_e[ent_kept]
+    ii = remap_t[tgt_kept]
+    n_events = int(uu.shape[0])
+
+    def chunk_factory():
+        for s in range(0, max(n_events, 1), chunk_size):
+            if s >= n_events:
+                return
+            yield (uu[s:s + chunk_size], ii[s:s + chunk_size],
+                   v_kept[s:s + chunk_size])
+
+    return InteractionData(user_ids, item_ids, chunk_factory, n_events)
+
+
 def _vocab_add(vocab: Dict[str, int], keys) -> None:
     """First-seen dense index assignment (shared vocabulary pass)."""
     for k in keys:

@@ -114,14 +114,14 @@ class ECommDataSource(DataSource):
         return [(td.subset(keep_mask), {"fold": 0}, qa)]
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
-        from predictionio_tpu.data.pipeline import read_interactions
+        from predictionio_tpu.data.store import read_training_interactions
 
         p: DataSourceParams = self.params
-        data = read_interactions(
-            lambda: event_store.find(
-                p.app_name, entity_type="user", target_entity_type="item",
-                event_names=p.event_names, storage=ctx.storage),
-            value_fn=lambda e: 4.0 if e.event == "buy" else 1.0)
+        data = read_training_interactions(
+            p.app_name, entity_type="user", target_entity_type="item",
+            event_names=p.event_names,
+            value_spec={"buy": 4.0}, default_spec=1.0,
+            storage=ctx.storage)
         uu, ii, ww = data.arrays()
         if uu.size == 0:
             raise ValueError("no view/buy events found")

@@ -124,30 +124,26 @@ class RecDataSource(SelfCleaningDataSource, DataSource):
     ParamsClass = DataSourceParams
 
     def _read(self, ctx: WorkflowContext) -> TrainingData:
-        """Stream the event store into columnar TrainingData — two
-        passes over ``find()`` (vocabulary, then data), O(chunk) Event
-        objects alive at any moment (``data/pipeline``)."""
-        from predictionio_tpu.data.pipeline import read_interactions
+        """Read the event store into columnar TrainingData. On the C++
+        EVENTLOG backend this is a native columnar scan (no per-event
+        Python objects — the rating extraction runs in C++); elsewhere
+        it streams ``find()`` in two passes with O(chunk) Event objects
+        alive at any moment (``data/store.read_training_interactions``).
+        "rate" events carry ``properties["rating"]`` (malformed → event
+        skipped); any other configured event is an implicit positive at
+        ``buy_rating``."""
+        from predictionio_tpu.data.store import read_training_interactions
 
         p: DataSourceParams = self.params
-
-        def value(e) -> Optional[float]:
-            if e.event == "rate":
-                try:
-                    return float(e.properties["rating"])
-                except (KeyError, TypeError, ValueError):
-                    return None  # malformed rating: skip the event
-            return p.buy_rating  # implicit positive event ("buy")
-
-        data = read_interactions(
-            lambda: event_store.find(
-                p.app_name,
-                entity_type="user",
-                target_entity_type="item",
-                event_names=p.event_names,
-                storage=ctx.storage,
-            ),
-            value_fn=value,
+        data = read_training_interactions(
+            p.app_name,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=p.event_names,
+            value_key="rating",
+            value_spec={"rate": "prop"},
+            default_spec=p.buy_rating,
+            storage=ctx.storage,
         )
         uu, ii, rr = data.arrays()
         return TrainingData(uu, ii, rr, data.user_ids, data.item_ids)

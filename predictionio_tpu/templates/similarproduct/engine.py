@@ -87,13 +87,12 @@ class SimilarProductDataSource(DataSource):
     ParamsClass = DataSourceParams
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
-        from predictionio_tpu.data.pipeline import read_interactions
+        from predictionio_tpu.data.store import read_training_interactions
 
         p: DataSourceParams = self.params
-        data = read_interactions(
-            lambda: event_store.find(
-                p.app_name, entity_type="user", target_entity_type="item",
-                event_names=p.event_names, storage=ctx.storage))
+        data = read_training_interactions(
+            p.app_name, entity_type="user", target_entity_type="item",
+            event_names=p.event_names, storage=ctx.storage)
         uu, ii, _ones = data.arrays()
         if uu.size == 0:
             raise ValueError("no view events found; import events before training")

@@ -65,14 +65,17 @@ class TTDataSource(DataSource):
         data chunked end-to-end (memory O(chunk + vocabulary), event
         logs larger than host RAM; the trainer double-buffers chunks
         into HBM)."""
-        from predictionio_tpu.data.pipeline import read_interactions
+        from predictionio_tpu.data.store import read_training_interactions
 
         p: DataSourceParams = self.params
-        data = read_interactions(
-            lambda: event_store.find(
-                p.app_name, entity_type="user", target_entity_type="item",
-                event_names=p.event_names, storage=ctx.storage),
-            chunk_size=p.stream_chunk or 65536)
+        data = read_training_interactions(
+            p.app_name, entity_type="user", target_entity_type="item",
+            event_names=p.event_names,
+            chunk_size=p.stream_chunk or 65536,
+            # explicit streaming request = log may exceed host RAM;
+            # honor O(chunk) over the materializing columnar fast path
+            prefer_streaming=p.stream_chunk > 0,
+            storage=ctx.storage)
         if data.n_events == 0:
             raise ValueError("no interaction events found")
         return TrainingData(data, stream=p.stream_chunk > 0)

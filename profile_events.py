@@ -181,7 +181,23 @@ def main() -> None:
         props = st.events.aggregate_properties(app.id, "user")
         agg_sec = time.perf_counter() - t0
 
+        # the r5 columnar training read (native on EVENTLOG, generic
+        # two-pass elsewhere) against the same events — what a `pio
+        # train` DataSource actually calls
+        from predictionio_tpu.data.store import read_training_interactions
+
+        t0 = time.perf_counter()
+        data = read_training_interactions(
+            "EventsBench", entity_type="user", target_entity_type="item",
+            event_names=["view"], storage=st)
+        tu, ti, tv = data.arrays()
+        columnar_sec = time.perf_counter() - t0
+
         out["bulk_import"] = {
+            "training_read_sec": round(columnar_sec, 2),
+            "training_read_events_per_sec": round(
+                max(data.n_events, 1) / columnar_sec),
+            "training_read_pairs": data.n_events,
             "events": args.bulk,
             "events_per_sec": round(args.bulk / bulk_sec),
             "full_scan_sec": round(scan_sec, 2),
