@@ -144,18 +144,16 @@ def interactions_from_columnar(
     to kept events only (first-seen order), so the result is
     indistinguishable from :func:`read_interactions` over ``find()``.
     """
-    n = cols.n
-    vals = np.full(n, 1.0, np.float64)
-    keep = np.ones(n, bool)
-    finite = np.isfinite(cols.values)
-    for idx, name in enumerate(cols.names):
-        m = cols.name_idx == idx
-        spec = (value_spec or {}).get(name, default_spec)
-        if spec == "prop":
-            keep &= ~m | finite
-            vals = np.where(m, cols.values, vals)
-        else:
-            vals = np.where(m, float(spec), vals)
+    # per-NAME lookup arrays, then one gather over name_idx — O(n),
+    # independent of how many distinct event names the log holds
+    specs = [(value_spec or {}).get(name, default_spec)
+             for name in cols.names]
+    is_prop = np.asarray([s == "prop" for s in specs], bool)
+    consts = np.asarray([1.0 if s == "prop" else float(s) for s in specs],
+                        np.float64)
+    prop_row = is_prop[cols.name_idx]
+    vals = np.where(prop_row, cols.values, consts[cols.name_idx])
+    keep = ~prop_row | np.isfinite(cols.values)
 
     def densify(idx_arr: np.ndarray, table: List[str]):
         """Trim the vocab to kept events, preserving first-seen order."""
@@ -235,6 +233,29 @@ def read_interactions(
             yield u, i, vals[keep]
 
     return InteractionData(user_ids, item_ids, chunk_factory, n_events)
+
+
+def event_groups_from_columnar(
+    cols: ColumnarEvents, names: Sequence[str],
+) -> Tuple[Dict[str, Tuple[np.ndarray, np.ndarray]], BiMap, BiMap]:
+    """Vectorized :func:`read_event_groups` result from a columnar
+    scan: demuxing by event name is a mask over ``name_idx``, and the
+    scan's first-seen id tables ARE the shared vocabulary pair (same
+    encounter order as the generic two-pass reader — no value policy
+    applies here, so no re-densify is needed)."""
+    pos = {n: i for i, n in enumerate(cols.names)}
+    out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    for n in names:
+        i = pos.get(n)
+        if i is None:
+            out[n] = (np.zeros(0, np.int32), np.zeros(0, np.int32))
+        else:
+            m = cols.name_idx == i
+            out[n] = (cols.entity_idx[m].astype(np.int32),
+                      cols.target_idx[m].astype(np.int32))
+    user_ids = BiMap({s: k for k, s in enumerate(cols.entity_ids)})
+    item_ids = BiMap({s: k for k, s in enumerate(cols.target_ids)})
+    return out, user_ids, item_ids
 
 
 def read_event_groups(

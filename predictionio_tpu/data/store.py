@@ -27,9 +27,23 @@ from predictionio_tpu.storage.registry import Storage, get_storage
 # The rating-value grammar shared with the native columnar scan
 # (eventlog.cc decimal_number_shape): JSON-style decimal numbers —
 # DELIBERATELY narrower than Python float() (no hex, no inf/nan
-# words, no underscore literals) so the native and generic training
-# reads keep/drop exactly the same events on every backend.
-_NUM_RE = _re.compile(r"[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?")
+# words, no underscore literals, ASCII digits only — the C++ side is
+# byte-oriented) so the native and generic training reads keep/drop
+# exactly the same events on every backend.
+_NUM_RE = _re.compile(r"[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?", _re.ASCII)
+
+
+def _native_scan(storage: Optional[Storage]):
+    """(scan_columnar, storage) when the configured event store
+    exposes the native columnar scan, else (None, None). Unconfigured
+    storage is not an error — the generic find() path resolves (or is
+    test-seamed) on its own."""
+    try:
+        st = storage or get_storage()
+        scan = getattr(st.events, "scan_columnar", None)
+    except Exception:
+        return None, None
+    return (scan, st) if scan is not None else (None, None)
 
 
 def _parse_value(v) -> Optional[float]:
@@ -40,7 +54,10 @@ def _parse_value(v) -> Optional[float]:
         return 1.0 if v else 0.0
     if isinstance(v, (int, float)):
         return float(v)
-    if isinstance(v, str) and _NUM_RE.fullmatch(v.strip(" \t")):
+    if isinstance(v, str) and _NUM_RE.fullmatch(v.strip(" ")):
+        # spaces only: the C++ scan sees control chars as their JSON
+        # escapes (a real tab arrives as \t bytes) and drops them —
+        # stripping them here would diverge
         return float(v)
     return None
 
@@ -149,14 +166,7 @@ def read_training_interactions(
     # (event log may exceed host RAM) — the columnar scan materializes
     # ~26 B/event host-side (50× less than Event objects, but not
     # O(chunk)), so honor the streaming contract over raw speed
-    scan = None
-    if not prefer_streaming:
-        try:
-            st = storage or get_storage()
-            scan = getattr(st.events, "scan_columnar", None)
-        except Exception:
-            scan = None  # unconfigured storage: the generic find()
-            # path below resolves (or is test-seamed) on its own
+    scan, st = (None, None) if prefer_streaming else _native_scan(storage)
     if scan is not None:
         app_id, channel_id = resolve_app_channel(app_name, channel_name, st)
         cols = scan(app_id, channel_id, start_time=start_time,
@@ -190,6 +200,40 @@ def read_training_interactions(
                   if (value_spec or value_key or default_spec != 1.0)
                   else None),
     )
+
+
+def read_training_event_groups(
+    app_name: str,
+    names: Sequence[str],
+    channel_name: Optional[str] = None,
+    entity_type: Optional[str] = "user",
+    target_entity_type: Optional[str] = "item",
+    chunk_size: int = 65536,
+    storage: Optional[Storage] = None,
+):
+    """Multi-event grouped read with one shared vocabulary pair (the
+    Universal-Recommender shape) — native columnar scan on stores that
+    expose it (demux by name is a numpy mask), the generic two-scan
+    :func:`~predictionio_tpu.data.pipeline.read_event_groups`
+    elsewhere. Returns ``({name: (user_idx, item_idx)}, user_ids,
+    item_ids)`` identically on both paths."""
+    from predictionio_tpu.data.pipeline import (event_groups_from_columnar,
+                                                read_event_groups)
+
+    scan, st = _native_scan(storage)
+    if scan is not None:
+        app_id, channel_id = resolve_app_channel(app_name, channel_name, st)
+        cols = scan(app_id, channel_id, entity_type=entity_type,
+                    target_entity_type=target_entity_type,
+                    event_names=list(names))
+        if cols is not None:
+            return event_groups_from_columnar(cols, names)
+    return read_event_groups(
+        lambda: find(
+            app_name, channel_name, entity_type=entity_type,
+            target_entity_type=target_entity_type,
+            event_names=list(names), storage=storage),
+        names, chunk_size=chunk_size)
 
 
 def find_by_entity(

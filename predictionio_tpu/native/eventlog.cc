@@ -700,11 +700,12 @@ double parse_number_token(std::string_view tok) {
     if (tok.size() < 2 || tok.back() != '"') return nan;
     tok = tok.substr(1, tok.size() - 2);
   }
-  // surrounding whitespace tolerated (float(" 4.5 ") parses)
-  while (!tok.empty() && (tok.front() == ' ' || tok.front() == '\t'))
-    tok.remove_prefix(1);
-  while (!tok.empty() && (tok.back() == ' ' || tok.back() == '\t'))
-    tok.remove_suffix(1);
+  // surrounding SPACES tolerated (float(" 4.5 ") parses). Spaces
+  // only: other whitespace inside a JSON string arrives here as its
+  // two-byte escape (\t, \n), which the shape check rejects — the
+  // Python side strips only spaces to match (store.py _parse_value).
+  while (!tok.empty() && tok.front() == ' ') tok.remove_prefix(1);
+  while (!tok.empty() && tok.back() == ' ') tok.remove_suffix(1);
   if (!decimal_number_shape(tok)) return nan;
   char buf[64];
   if (tok.size() >= sizeof(buf)) return nan;

@@ -33,7 +33,6 @@ from predictionio_tpu.controller import (
     Preparator,
     WorkflowContext,
 )
-from predictionio_tpu.data import store as event_store
 from predictionio_tpu.data.cleaning import SelfCleaningDataSource
 from predictionio_tpu.models.als import (
     ALSParams,

@@ -29,7 +29,6 @@ from predictionio_tpu.controller import (
     IdentityPreparator,
     WorkflowContext,
 )
-from predictionio_tpu.data import store as event_store
 from predictionio_tpu.models.two_tower import (
     TwoTowerParams,
     two_tower_embed_items,

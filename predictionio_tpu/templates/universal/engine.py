@@ -36,7 +36,6 @@ from predictionio_tpu.controller import (
     IdentityPreparator,
     WorkflowContext,
 )
-from predictionio_tpu.data import store as event_store
 from predictionio_tpu.models.cco import (CCOParams, CCOResidentScorer,
                                          cco_indicators)
 from predictionio_tpu.utils.bimap import BiMap
@@ -118,15 +117,11 @@ class URDataSource(DataSource):
     ParamsClass = DataSourceParams
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
-        from predictionio_tpu.data.pipeline import read_event_groups
+        from predictionio_tpu.data.store import read_training_event_groups
 
         p: DataSourceParams = self.params
-        pairs, user_ids, item_ids = read_event_groups(
-            lambda: event_store.find(
-                p.app_name, entity_type="user",
-                target_entity_type="item", event_names=p.event_names,
-                storage=ctx.storage),
-            p.event_names)
+        pairs, user_ids, item_ids = read_training_event_groups(
+            p.app_name, p.event_names, storage=ctx.storage)
         if pairs[p.event_names[0]][0].size == 0:
             raise ValueError(
                 f"no primary event {p.event_names[0]!r} found; import events first")

@@ -345,3 +345,27 @@ class TestColumnarScan:
         assert list(fast.item_ids) == list(generic.item_ids)
         for (a1, b1) in zip(fast.arrays(), generic.arrays()):
             assert (a1 == b1).all()
+
+    def test_event_groups_parity(self, store):
+        """Grouped multi-event read (Universal Recommender shape):
+        columnar demux must equal the generic two-scan reader — same
+        per-name pairs, same SHARED vocabulary pair, same order."""
+        from predictionio_tpu.data.pipeline import (
+            event_groups_from_columnar, read_event_groups)
+
+        self._mixed_workload(store)
+        names = ["rate", "buy", "view"]
+        cols = store.scan_columnar(
+            APP, entity_type="user", target_entity_type="item",
+            event_names=names)
+        f_pairs, f_u, f_i = event_groups_from_columnar(cols, names)
+        s_pairs, s_u, s_i = read_event_groups(
+            lambda: store.find(APP, entity_type="user",
+                               target_entity_type="item",
+                               event_names=names),
+            names)
+        assert list(f_u) == list(s_u) and list(f_i) == list(s_i)
+        for n in names:
+            assert (f_pairs[n][0] == s_pairs[n][0]).all(), n
+            assert (f_pairs[n][1] == s_pairs[n][1]).all(), n
+        assert f_pairs["view"][0].size == 1  # the one view event

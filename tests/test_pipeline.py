@@ -133,9 +133,10 @@ class TestTemplateStreamingReads:
         under the ~1 KB/event of the old List[Rating] path (~100 MB)."""
         import tracemalloc
 
+        import predictionio_tpu.data.store as data_store
         import predictionio_tpu.templates.recommendation.engine as rec
 
-        monkeypatch.setattr(rec.event_store, "find",
+        monkeypatch.setattr(data_store, "find",
                             self._synthetic_find(100_000))
         # the lazy Rating compat path must never run during the read
         monkeypatch.setattr(
@@ -157,11 +158,12 @@ class TestTemplateStreamingReads:
 
     def test_recommendation_streaming_matches_list_path(self, monkeypatch):
         """Index-mapped output equals the naive list-built reference."""
+        import predictionio_tpu.data.store as data_store
         import predictionio_tpu.templates.recommendation.engine as rec
         from predictionio_tpu.controller.base import WorkflowContext
 
         find = self._synthetic_find(2_000, n_users=40, n_items=30)
-        monkeypatch.setattr(rec.event_store, "find", find)
+        monkeypatch.setattr(data_store, "find", find)
         ds = rec.RecDataSource(rec.DataSourceParams(app_name="x"))
         td = ds._read(WorkflowContext(storage=None))
 
