@@ -182,6 +182,37 @@ class NativeEventLogStore(EventStore):
             raise IOError(f"event log append failed ({n}/{len(frames)})")
         return ids  # type: ignore[return-value]
 
+    def append_jsonl(
+        self, lines: bytes, n_lines: int, app_id: int,
+        channel_id: Optional[int] = None,
+    ) -> Tuple[int, List[int]]:
+        """Native NDJSON ingest (`pio import` hot path): parse + frame
+        + append entirely in C++ for lines matching the strict common
+        shape; returns ``(appended, fallback_line_numbers)`` — the
+        caller routes fallback lines (blank = skipped silently; hairy
+        OR invalid shapes) through ``Event.from_json`` + ``insert``,
+        which applies the full validation semantics. The C++ grammar
+        is strictly narrower than the Python parser, so the native
+        path can never accept what Python would reject.
+
+        Interleaving note: natively-accepted lines land before the
+        caller's fallback inserts; `find()` ordering is by
+        (eventTime, creationTime, seq), so only events with identical
+        timestamps down to the microsecond can observe the reorder.
+        """
+        import time as _time
+
+        h = self._handle(app_id, channel_id)
+        status = ctypes.create_string_buffer(n_lines)
+        now_us = int(_time.time() * 1e6)
+        seed = int.from_bytes(os.urandom(8), "little")
+        n = self._lib.pel_append_jsonl(
+            h, lines, len(lines), now_us, seed, status, n_lines, None)
+        if n < 0:
+            raise IOError("event log jsonl append failed")
+        fallback = [i for i in range(n_lines) if status.raw[i] == 1]
+        return int(n), fallback
+
     def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
         h = self._handle(app_id, channel_id)
         b = event_id.encode()

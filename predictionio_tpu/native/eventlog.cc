@@ -381,17 +381,15 @@ void pel_close(void* hv) {
   delete h;
 }
 
-// Append n framed records (concatenated, as produced by the Python
-// serializer). Returns number indexed, or -1 on IO error.
-int pel_append_batch(void* hv, const unsigned char* buf, long long len,
-                     int n) {
-  Handle* h = (Handle*)hv;
-  std::lock_guard<std::mutex> g(h->mu);
+namespace {
+// Write + index n framed records from an in-memory buffer (shared by
+// pel_append_batch and the native NDJSON import below).
+int append_frames(Handle* h, const unsigned char* buf, long long len,
+                  int n) {
   fseek(h->f, 0, SEEK_END);
   uint64_t base = (uint64_t)ftell(h->f);
   if (fwrite(buf, 1, (size_t)len, h->f) != (size_t)len) return -1;
   fflush(h->f);
-  // index from the in-memory buffer
   uint64_t off = 0;
   int done = 0;
   while (off + 5 <= (uint64_t)len && done < n) {
@@ -403,6 +401,16 @@ int pel_append_batch(void* hv, const unsigned char* buf, long long len,
     ++done;
   }
   return done;
+}
+}  // namespace
+
+// Append n framed records (concatenated, as produced by the Python
+// serializer). Returns number indexed, or -1 on IO error.
+int pel_append_batch(void* hv, const unsigned char* buf, long long len,
+                     int n) {
+  Handle* h = (Handle*)hv;
+  std::lock_guard<std::mutex> g(h->mu);
+  return append_frames(h, buf, len, n);
 }
 
 // Tombstone an id. Returns 1 if it existed, 0 otherwise, -1 on IO error.
@@ -766,6 +774,419 @@ void append_u64(std::string* out, uint64_t v) {
 }
 
 }  // namespace
+
+// ---------------- native NDJSON import (the `pio import` hot path) ------
+//
+// Parses newline-delimited event JSON (the reference wire shape) and
+// appends frames directly — no Python Event objects, no re-serialize.
+// STRICT fast grammar: a line is only consumed natively when every
+// part is the common shape (known keys, strict ISO-8601 eventTime,
+// validation rules pass trivially); anything unusual — including
+// anything INVALID — gets status 1 and the caller routes that line
+// through the Python `Event.from_json` path, which raises the proper
+// EventValidationError. So the native path can only ever accept what
+// Python would accept, never diverge on rejects.
+//
+// Per-line status (written to status_out, one byte per line):
+//   0 = appended natively, 1 = fallback to Python, 2 = blank line.
+
+namespace {
+
+// ---- strict RFC-8259 JSON validation --------------------------------
+//
+// skip_value/json_object_items are LENIENT walkers (fine for reading
+// back our own serializer's output); the import path must instead be
+// STRICTLY NARROWER than Python's json.loads — a line the validator
+// passes must be a line Python would parse identically. Rejections
+// fall back to Python (which raises the proper error), so being too
+// strict only costs speed, never correctness; being too loose would
+// persist garbage (r5 review: a raw '{"a":}' span poisoned every
+// later read of the namespace).
+
+size_t jv_ws(std::string_view s, size_t i) {
+  while (i < s.size() && (s[i] == ' ' || s[i] == '\t' || s[i] == '\n' ||
+                          s[i] == '\r'))
+    ++i;
+  return i;
+}
+
+size_t jv_string(std::string_view s, size_t i) {  // expects s[i] == '"'
+  ++i;
+  while (i < s.size()) {
+    unsigned char c = (unsigned char)s[i];
+    if (c == '"') return i + 1;
+    if (c == '\\') {
+      if (i + 1 >= s.size()) return std::string_view::npos;
+      char e = s[i + 1];
+      if (e == 'u') {
+        int v = hex4(s, i + 2);
+        if (v < 0) return std::string_view::npos;
+        i += 6;
+        // Surrogates must pair. json.loads ACCEPTS lone surrogates,
+        // but the Python import path then dies at utf-8 encode time —
+        // while json_unescape would emit raw surrogate bytes into the
+        // frame and poison every later read of the namespace (r5
+        // review). Reject → fall back → Python raises properly.
+        if (v >= 0xDC00 && v <= 0xDFFF) return std::string_view::npos;
+        if (v >= 0xD800 && v <= 0xDBFF) {
+          if (i + 6 > s.size() || s[i] != '\\' || s[i + 1] != 'u')
+            return std::string_view::npos;
+          int lo = hex4(s, i + 2);
+          if (lo < 0xDC00 || lo > 0xDFFF) return std::string_view::npos;
+          i += 6;
+        }
+      } else if (e == '"' || e == '\\' || e == '/' || e == 'b' ||
+                 e == 'f' || e == 'n' || e == 'r' || e == 't') {
+        i += 2;
+      } else {
+        return std::string_view::npos;
+      }
+    } else if (c < 0x20) {
+      return std::string_view::npos;  // raw control char: invalid JSON
+    } else {
+      ++i;
+    }
+  }
+  return std::string_view::npos;
+}
+
+size_t jv_number(std::string_view s, size_t i) {
+  size_t n = s.size();
+  if (i < n && s[i] == '-') ++i;
+  if (i >= n) return std::string_view::npos;
+  if (s[i] == '0') {
+    ++i;  // no leading zeros
+  } else if (s[i] >= '1' && s[i] <= '9') {
+    while (i < n && s[i] >= '0' && s[i] <= '9') ++i;
+  } else {
+    return std::string_view::npos;
+  }
+  if (i < n && s[i] == '.') {
+    ++i;
+    size_t d = 0;
+    while (i < n && s[i] >= '0' && s[i] <= '9') { ++i; ++d; }
+    if (d == 0) return std::string_view::npos;
+  }
+  if (i < n && (s[i] == 'e' || s[i] == 'E')) {
+    ++i;
+    if (i < n && (s[i] == '+' || s[i] == '-')) ++i;
+    size_t d = 0;
+    while (i < n && s[i] >= '0' && s[i] <= '9') { ++i; ++d; }
+    if (d == 0) return std::string_view::npos;
+  }
+  return i;
+}
+
+size_t json_validate(std::string_view s, size_t i, int depth = 0) {
+  constexpr size_t npos = std::string_view::npos;
+  if (depth > 64) return npos;  // Python's default recursion guard is
+  i = jv_ws(s, i);              // far higher; stricter is safe
+  if (i >= s.size()) return npos;
+  char c = s[i];
+  if (c == '"') return jv_string(s, i);
+  if (c == '{') {
+    i = jv_ws(s, i + 1);
+    if (i < s.size() && s[i] == '}') return i + 1;
+    for (;;) {
+      i = jv_ws(s, i);
+      if (i >= s.size() || s[i] != '"') return npos;
+      i = jv_string(s, i);
+      if (i == npos) return npos;
+      i = jv_ws(s, i);
+      if (i >= s.size() || s[i] != ':') return npos;
+      i = json_validate(s, i + 1, depth + 1);
+      if (i == npos) return npos;
+      i = jv_ws(s, i);
+      if (i >= s.size()) return npos;
+      if (s[i] == '}') return i + 1;
+      if (s[i] != ',') return npos;
+      ++i;
+    }
+  }
+  if (c == '[') {
+    i = jv_ws(s, i + 1);
+    if (i < s.size() && s[i] == ']') return i + 1;
+    for (;;) {
+      i = json_validate(s, i, depth + 1);
+      if (i == npos) return npos;
+      i = jv_ws(s, i);
+      if (i >= s.size()) return npos;
+      if (s[i] == ']') return i + 1;
+      if (s[i] != ',') return npos;
+      ++i;
+    }
+  }
+  if (s.compare(i, 4, "true") == 0) return i + 4;
+  if (s.compare(i, 5, "false") == 0) return i + 5;
+  if (s.compare(i, 4, "null") == 0) return i + 4;
+  if (c == '-' || (c >= '0' && c <= '9')) return jv_number(s, i);
+  return npos;  // incl. NaN/Infinity: Python accepts, we fall back
+}
+
+// Hinnant days-from-civil: days since 1970-01-01 for y-m-d.
+int64_t days_from_civil(int64_t y, unsigned m, unsigned d) {
+  y -= m <= 2;
+  const int64_t era = (y >= 0 ? y : y - 399) / 400;
+  const unsigned yoe = (unsigned)(y - era * 400);
+  const unsigned doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+  const unsigned doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  return era * 146097 + (int64_t)doe - 719468;
+}
+
+bool all_digits(std::string_view s) {
+  if (s.empty()) return false;
+  for (char c : s)
+    if (c < '0' || c > '9') return false;
+  return true;
+}
+
+int to_int(std::string_view s) {
+  int v = 0;
+  for (char c : s) v = v * 10 + (c - '0');
+  return v;
+}
+
+// Strict ISO-8601, the subset EVERY supported Python (>= 3.10, where
+// fromisoformat is narrowest) accepts: YYYY-MM-DD[T ]HH:MM:SS with an
+// optional .fff or .ffffff fraction (exactly 3 or 6 digits — 3.10
+// rejects other widths) and an optional Z or ±HH:MM offset (3.10
+// rejects ±HHMM/±HH). Anything else falls back to Python, which
+// applies the running interpreter's own rules.
+bool parse_iso8601_us(std::string_view s, int64_t* out_us) {
+  if (s.size() < 19) return false;
+  if (!all_digits(s.substr(0, 4)) || s[4] != '-' ||
+      !all_digits(s.substr(5, 2)) || s[7] != '-' ||
+      !all_digits(s.substr(8, 2)) || (s[10] != 'T' && s[10] != ' ') ||
+      !all_digits(s.substr(11, 2)) || s[13] != ':' ||
+      !all_digits(s.substr(14, 2)) || s[16] != ':' ||
+      !all_digits(s.substr(17, 2)))
+    return false;
+  int year = to_int(s.substr(0, 4)), mon = to_int(s.substr(5, 2)),
+      day = to_int(s.substr(8, 2)), hh = to_int(s.substr(11, 2)),
+      mm = to_int(s.substr(14, 2)), ss = to_int(s.substr(17, 2));
+  if (year < 1 || mon < 1 || mon > 12 || day < 1 || hh > 23 || mm > 59 ||
+      ss > 59)
+    return false;
+  // real calendar dates only — fromisoformat rejects 2026-02-30, and
+  // days_from_civil would silently normalize it (r5 review)
+  static const int mdays[12] = {31, 28, 31, 30, 31, 30,
+                                31, 31, 30, 31, 30, 31};
+  int dmax = mdays[mon - 1];
+  if (mon == 2 &&
+      (year % 4 == 0 && (year % 100 != 0 || year % 400 == 0)))
+    dmax = 29;
+  if (day > dmax) return false;
+  size_t i = 19;
+  int64_t frac_us = 0;
+  if (i < s.size() && s[i] == '.') {
+    ++i;
+    size_t f0 = i;
+    while (i < s.size() && s[i] >= '0' && s[i] <= '9') ++i;
+    size_t nd = i - f0;
+    if (nd != 3 && nd != 6) return false;  // the 3.10-safe widths
+    frac_us = to_int(s.substr(f0, nd));
+    for (size_t k = nd; k < 6; ++k) frac_us *= 10;
+  }
+  int64_t tz_off_s = 0;
+  if (i == s.size()) {
+    tz_off_s = 0;  // naive = UTC (parse_event_time semantics)
+  } else if (s[i] == 'Z' && i + 1 == s.size()) {
+    tz_off_s = 0;
+  } else if (s[i] == '+' || s[i] == '-') {
+    int sign = s[i] == '-' ? -1 : 1;
+    ++i;
+    // ±HH:MM only (3.10-safe; ±HHMM/±HH fall back)
+    if (i + 5 != s.size() || !all_digits(s.substr(i, 2)) ||
+        s[i + 2] != ':' || !all_digits(s.substr(i + 3, 2)))
+      return false;
+    int oh = to_int(s.substr(i, 2));
+    int om = to_int(s.substr(i + 3, 2));
+    if (oh > 23 || om > 59) return false;
+    tz_off_s = sign * (oh * 3600 + om * 60);
+    i += 5;
+  } else {
+    return false;
+  }
+  int64_t days = days_from_civil(year, (unsigned)mon, (unsigned)day);
+  *out_us =
+      ((days * 86400 + hh * 3600 + mm * 60 + ss) - tz_off_s) * 1000000 +
+      frac_us;
+  return true;
+}
+
+uint64_t splitmix64(uint64_t* st) {
+  uint64_t z = (*st += 0x9E3779B97F4A7C15ull);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
+
+void hex32(uint64_t a, uint64_t b, char out[32]) {
+  static const char* h = "0123456789abcdef";
+  for (int i = 0; i < 16; ++i) out[i] = h[(a >> (60 - 4 * i)) & 0xF];
+  for (int i = 0; i < 16; ++i) out[16 + i] = h[(b >> (60 - 4 * i)) & 0xF];
+}
+
+void frame_str(std::string* payload, std::string_view s) {
+  append_u32(payload, (uint32_t)s.size());
+  payload->append(s.data(), s.size());
+}
+
+}  // namespace
+
+long long pel_append_jsonl(void* hv, const char* buf, long long len,
+                           long long now_us, unsigned long long rng_seed,
+                           char* status_out, long long max_lines,
+                           char* ids_out /* 32 bytes per line or NULL */) {
+  Handle* h = (Handle*)hv;
+  std::lock_guard<std::mutex> g(h->mu);
+  std::string_view all(buf, (size_t)len);
+  std::string frames;
+  frames.reserve((size_t)len + (size_t)len / 4);
+  uint64_t rs = rng_seed ? rng_seed : 0x6a09e667f3bcc909ull;
+  long long line_no = 0;
+  long long appended = 0;
+  size_t pos = 0;
+  std::string payload, unesc[7];
+  while (pos <= all.size() && line_no < max_lines) {
+    size_t eol = all.find('\n', pos);
+    if (eol == std::string_view::npos) {
+      if (pos >= all.size()) break;
+      eol = all.size();
+    }
+    std::string_view line = all.substr(pos, eol - pos);
+    pos = eol + 1;
+    // trim whitespace
+    while (!line.empty() && (line.front() == ' ' || line.front() == '\r' ||
+                             line.front() == '\t'))
+      line.remove_prefix(1);
+    while (!line.empty() && (line.back() == ' ' || line.back() == '\r' ||
+                             line.back() == '\t'))
+      line.remove_suffix(1);
+    long long ln = line_no++;
+    if (ids_out) memset(ids_out + ln * 32, 0, 32);
+    if (line.empty()) {
+      status_out[ln] = 2;
+      continue;
+    }
+    // STRICT whole-line validation first: the line must be exactly one
+    // valid JSON value with nothing after it. Only then is the lenient
+    // span extraction below safe (on a valid line it is exact).
+    {
+      size_t e = json_validate(line, 0);
+      if (e == std::string_view::npos || jv_ws(line, e) != line.size()) {
+        status_out[ln] = 1;
+        continue;
+      }
+    }
+    // parse the top-level object into raw spans
+    std::vector<std::pair<std::string, std::string_view>> items;
+    if (!json_object_items(line, &items)) {
+      status_out[ln] = 1;
+      continue;
+    }
+    std::string_view ev, etype, eid, ttype, tid, props, tags, prid, evid,
+        etime, ctime;
+    bool ok = true, saw_ttype = false, saw_tid = false;
+    for (auto& kv : items) {
+      const std::string& k = kv.first;
+      std::string_view v = kv.second;
+      if (k == "event") ev = v;
+      else if (k == "entityType") etype = v;
+      else if (k == "entityId") eid = v;
+      else if (k == "targetEntityType") { ttype = v; saw_ttype = true; }
+      else if (k == "targetEntityId") { tid = v; saw_tid = true; }
+      else if (k == "properties") props = v;
+      else if (k == "tags") tags = v;
+      else if (k == "prId") prid = v;
+      else if (k == "eventId") evid = v;
+      else if (k == "eventTime") etime = v;
+      else if (k == "creationTime") ctime = v;  // export round-trips
+      // carry it (the reference's export format always writes it)
+      else { ok = false; break; }  // unknown key → proper Python error
+    }
+    // nulls / wrong types / reserved-$ events / empty requireds /
+    // target one-sided → all fall back (Python validates or rejects)
+    auto is_str = [](std::string_view v) {
+      return v.size() >= 2 && v.front() == '"' && v.back() == '"';
+    };
+    if (!ok || !is_str(ev) || !is_str(etype) || !is_str(eid) ||
+        (saw_ttype != saw_tid) ||
+        (saw_ttype && (!is_str(ttype) || !is_str(tid))) ||
+        (!props.empty() && (props.front() != '{')) ||
+        (!tags.empty() && (tags.front() != '[')) ||
+        (!prid.empty() && !is_str(prid)) ||
+        (!evid.empty() && !is_str(evid)) ||
+        (!etime.empty() && !is_str(etime)) ||
+        (!ctime.empty() && !is_str(ctime))) {
+      status_out[ln] = 1;
+      continue;
+    }
+    unesc[0] = json_unescape(ev);
+    unesc[1] = json_unescape(etype);
+    unesc[2] = json_unescape(eid);
+    unesc[3] = saw_ttype ? json_unescape(ttype) : std::string();
+    unesc[4] = saw_tid ? json_unescape(tid) : std::string();
+    unesc[5] = prid.empty() ? std::string() : json_unescape(prid);
+    unesc[6] = evid.empty() ? std::string() : json_unescape(evid);
+    if (unesc[0].empty() || unesc[1].empty() || unesc[2].empty() ||
+        unesc[0][0] == '$' ||  // reserved/$-validation: Python's job
+        (saw_ttype && (unesc[3].empty() || unesc[4].empty()))) {
+      status_out[ln] = 1;
+      continue;
+    }
+    auto parse_time_field = [](std::string_view tok, int64_t* out) {
+      std::string ts = json_unescape(tok);
+      // strip() semantics of parse_event_time
+      std::string_view tv(ts);
+      while (!tv.empty() && tv.front() == ' ') tv.remove_prefix(1);
+      while (!tv.empty() && tv.back() == ' ') tv.remove_suffix(1);
+      return parse_iso8601_us(tv, out);
+    };
+    int64_t t_us = now_us, c_us = now_us;
+    if (!etime.empty() && !parse_time_field(etime, &t_us)) {
+      status_out[ln] = 1;
+      continue;
+    }
+    if (!ctime.empty() && !parse_time_field(ctime, &c_us)) {
+      status_out[ln] = 1;
+      continue;
+    }
+    char idbuf[32];
+    std::string_view event_id;
+    if (!unesc[6].empty()) {
+      event_id = unesc[6];
+    } else {
+      hex32(splitmix64(&rs), splitmix64(&rs), idbuf);
+      event_id = std::string_view(idbuf, 32);
+    }
+    if (ids_out && event_id.size() == 32)
+      memcpy(ids_out + ln * 32, event_id.data(), 32);
+    payload.clear();
+    append_u64(&payload, (uint64_t)t_us);
+    append_u64(&payload, (uint64_t)c_us);
+    frame_str(&payload, event_id);
+    frame_str(&payload, unesc[0]);
+    frame_str(&payload, unesc[1]);
+    frame_str(&payload, unesc[2]);
+    frame_str(&payload, unesc[3]);
+    frame_str(&payload, unesc[4]);
+    frame_str(&payload, props.empty() ? std::string_view("{}") : props);
+    frame_str(&payload, tags.empty() ? std::string_view("[]") : tags);
+    frame_str(&payload, unesc[5]);
+    append_u32(&frames, (uint32_t)payload.size() + 1);
+    frames.push_back('\0');  // kind 0 = event
+    frames.append(payload);
+    status_out[ln] = 0;
+    ++appended;
+  }
+  if (appended) {
+    int done = append_frames(h, (const unsigned char*)frames.data(),
+                             (long long)frames.size(), (int)appended);
+    if (done != appended) return -1;
+  }
+  return appended;
+}
 
 long long pel_scan_columnar(void* hv, long long start_us, long long until_us,
                             const char* entity_type,

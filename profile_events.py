@@ -181,6 +181,31 @@ def main() -> None:
         props = st.events.aggregate_properties(app.id, "user")
         agg_sec = time.perf_counter() - t0
 
+        # the actual `pio import` surface: NDJSON lines through
+        # import_events (native C++ parse on EVENTLOG as of r5)
+        import io
+
+        from predictionio_tpu.tools.export_import import import_events
+
+        app2 = st.meta.create_app("EventsBenchImport")
+        st.events.init_channel(app2.id)
+        buf = io.StringIO()
+        for n in range(args.bulk):
+            if n % 100:
+                buf.write('{"event":"view","entityType":"user","entityId":"u%d",'
+                          '"targetEntityType":"item","targetEntityId":"i%d",'
+                          '"eventTime":"2026-03-01T00:00:00Z"}\n'
+                          % (int(uu[n]), int(ii[n])))
+            else:
+                buf.write('{"event":"$set","entityType":"user","entityId":"u%d",'
+                          '"properties":{"plan":"basic","n":%d}}\n'
+                          % (int(uu[n]), n))
+        buf.seek(0)
+        t0 = time.perf_counter()
+        n_imported = import_events(app2.id, buf, storage=st)
+        jsonl_sec = time.perf_counter() - t0
+        assert n_imported == args.bulk
+
         # the r5 columnar training read (native on EVENTLOG, generic
         # two-pass elsewhere) against the same events — what a `pio
         # train` DataSource actually calls
@@ -194,6 +219,8 @@ def main() -> None:
         columnar_sec = time.perf_counter() - t0
 
         out["bulk_import"] = {
+            "jsonl_import_sec": round(jsonl_sec, 2),
+            "jsonl_import_events_per_sec": round(args.bulk / jsonl_sec),
             "training_read_sec": round(columnar_sec, 2),
             "training_read_events_per_sec": round(
                 max(data.n_events, 1) / columnar_sec),

@@ -3,6 +3,7 @@ and the native $set/$unset/$delete fold vs the Python reference fold."""
 
 import datetime as dt
 import json
+import numpy as np
 
 import pytest
 
@@ -369,3 +370,199 @@ class TestColumnarScan:
             assert (f_pairs[n][0] == s_pairs[n][0]).all(), n
             assert (f_pairs[n][1] == s_pairs[n][1]).all(), n
         assert f_pairs["view"][0].size == 1  # the one view event
+
+
+class TestNativeJsonlImport:
+    """`pio import` NDJSON parity: the C++ fast path must produce
+    events FIELD-IDENTICAL to the Python Event.from_json path (modulo
+    generated eventId / creationTime), fall back on anything unusual,
+    and surface Python's validation errors for invalid lines."""
+
+    LINES = [
+        '{"event":"rate","entityType":"user","entityId":"u1",'
+        '"targetEntityType":"item","targetEntityId":"i1",'
+        '"properties":{"rating":4.5},"eventTime":"2026-01-02T03:04:05Z"}',
+        '',  # blank → skipped
+        '{"event":"buy","entityType":"user","entityId":"u\\u221e",'
+        '"targetEntityType":"item","targetEntityId":"i☂",'
+        '"eventTime":"2026-01-02T03:04:05.5+05:30"}',
+        '{"event":"view","entityType":"user","entityId":"u2",'
+        '"targetEntityType":"item","targetEntityId":"i2",'
+        '"eventTime":"2026-01-02T03:04:05.123456-08:00",'
+        '"tags":["a","b"],"prId":"pr-9"}',
+        '{"event":"note","entityType":"user","entityId":"u3",'
+        '"properties":{"nested":{"k":[1,2]},"s":"q\\"uote"},'
+        '"eventTime":"2026-01-02 03:04:05"}',  # space sep, no tz
+        '{"event":"$set","entityType":"user","entityId":"u4",'
+        '"properties":{"plan":"pro"},'
+        '"eventTime":"2026-01-03T00:00:00Z"}',  # $-event → fallback
+        '{"eventId":"deadbeefdeadbeefdeadbeefdeadbeef","event":"pin",'
+        '"entityType":"user","entityId":"u5",'
+        '"eventTime":"2026-01-04T00:00:00+00:00"}',
+    ]
+
+    def _import(self, store, text):
+        import io
+
+        from predictionio_tpu.tools.export_import import import_events
+
+        class _St:
+            events = store
+
+        return import_events(APP, io.StringIO(text), storage=_St())
+
+    def test_field_parity_with_python_path(self, store):
+        from predictionio_tpu.data.event import Event
+        import json as _json
+
+        n = self._import(store, "\n".join(self.LINES) + "\n")
+        assert n == 6  # 7 lines minus the blank
+        native = sorted(store.find(APP),
+                        key=lambda e: (e.event_time, e.event))
+        ref = sorted((Event.from_json(_json.loads(l))
+                      for l in self.LINES if l),
+                     key=lambda e: (e.event_time, e.event))
+        assert len(native) == len(ref) == 6
+        for a, b in zip(native, ref):
+            assert a.event == b.event
+            assert a.entity_type == b.entity_type
+            assert a.entity_id == b.entity_id
+            assert a.target_entity_type == b.target_entity_type
+            assert a.target_entity_id == b.target_entity_id
+            assert a.properties == b.properties
+            assert a.tags == b.tags
+            assert a.pr_id == b.pr_id
+            assert a.event_time == b.event_time  # µs-exact incl. tz
+        # explicit eventId preserved
+        assert store.get("deadbeefdeadbeefdeadbeefdeadbeef", APP) is not None
+        # every generated id is unique
+        ids = [e.event_id for e in native]
+        assert len(set(ids)) == len(ids)
+
+    def test_invalid_lines_raise_python_errors(self, store):
+        import json as _json
+
+        from predictionio_tpu.data.event import EventValidationError
+
+        with pytest.raises(EventValidationError):
+            self._import(store, '{"event":"x","entityType":"user",'
+                                '"entityId":"u","bogusField":1}\n')
+        with pytest.raises(EventValidationError):
+            self._import(store, '{"event":"x","entityType":"user"}\n')
+        with pytest.raises(EventValidationError):  # one-sided target
+            self._import(store, '{"event":"x","entityType":"u",'
+                                '"entityId":"1","targetEntityId":"i"}\n')
+        with pytest.raises(EventValidationError):  # bad timestamp
+            self._import(store, '{"event":"x","entityType":"u",'
+                                '"entityId":"1","eventTime":"yesterday"}\n')
+        # NOTHING the strict C++ grammar accepts may be a line Python
+        # rejects (r5 review: each of these was once natively accepted
+        # — the first POISONED every later read of the namespace)
+        for bad in (
+            '{"event":"e","entityType":"user","entityId":"u1",'
+            '"properties":{"a":}}',                    # malformed nested
+            '{"event":"e","entityType":"u","entityId":"a\\uZZZZ"}',
+            '{"event":"e","entityType":"user","entityId":"u1"}GARBAGE',
+            '{"event":"e","entityType":"u","entityId":"1" "prId":"x"}',
+            '{"event":"e","entityType":"u","entityId":"1",'
+            '"eventTime":"2026-02-30T00:00:00Z"}',     # nonexistent date
+            '{"event":"e","entityType":"u","entityId":"1",'
+            '"properties":{"n":01}}',                  # leading zero
+        ):
+            with pytest.raises((_json.JSONDecodeError,
+                                EventValidationError)):
+                self._import(store, bad + "\n")
+        # a LONE surrogate escape: json.loads accepts it but the
+        # Python serialize path dies at utf-8 encode — the native path
+        # must fall back (it once emitted raw surrogate bytes into the
+        # frame, making the whole namespace unreadable)
+        with pytest.raises(UnicodeEncodeError):
+            self._import(store, '{"event":"e","entityType":"u",'
+                                '"entityId":"a\\ud800"}\n')
+        # and the store must still read back cleanly afterwards
+        assert list(store.find(APP)) == []
+
+    def test_formfeed_only_lines_are_blank(self, store):
+        """Lines that strip() to empty but aren't space/tab (\\f, \\xa0)
+        were silently skipped by the legacy loop — same here."""
+        n = self._import(store,
+                         '{"event":"e","entityType":"u","entityId":"1"}\n'
+                         '\f\n\xa0\n'
+                         '{"event":"e","entityType":"u","entityId":"2"}\n')
+        assert n == 2
+        assert len(list(store.find(APP))) == 2
+
+    def test_py310_incompatible_timestamps_fall_back(self, store):
+        """Timestamp shapes Python 3.10's fromisoformat rejects (±HHMM
+        offset, 1-digit fraction) must NOT be consumed natively — on
+        this interpreter the fallback parses them, on 3.10 it raises;
+        either way the native path never decides."""
+        import json as _json
+
+        from predictionio_tpu.data.event import Event
+
+        lines = ['{"event":"e","entityType":"u","entityId":"1",'
+                 '"eventTime":"2026-01-02T03:04:05.5+05:30"}',
+                 '{"event":"e","entityType":"u","entityId":"2",'
+                 '"eventTime":"2026-01-02T03:04:05+0530"}']
+        n = self._import(store, "\n".join(lines) + "\n")
+        assert n == 2
+        got = sorted(store.find(APP), key=lambda e: e.entity_id)
+        ref = sorted((Event.from_json(_json.loads(l)) for l in lines),
+                     key=lambda e: e.entity_id)
+        for a, b in zip(got, ref):
+            assert a.event_time == b.event_time
+
+    def test_export_reimport_native_parity(self, store, tmp_path):
+        """Re-importing this tool's own export (every line carries
+        eventId + creationTime) must match what Event.from_json makes
+        of the same lines, field for field INCLUDING creationTime —
+        i.e. the export shape stays on the native path and parses
+        identically to the Python path."""
+        import io
+        import json as _json
+
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.filestore import NativeEventLogStore
+        from predictionio_tpu.tools.export_import import (export_events,
+                                                          import_events)
+
+        self._import(store, "\n".join(l for l in self.LINES if l))
+        out = io.StringIO()
+        export_events(APP, out, storage=type("S", (), {"events": store}))
+        lines = [l for l in out.getvalue().splitlines() if l]
+
+        store2 = NativeEventLogStore(str(tmp_path / "reimport"))
+        out.seek(0)
+        n = import_events(APP, out, storage=type("S", (), {"events": store2}))
+        assert n == len(lines) == 6
+        got = {e.event_id: e for e in store2.find(APP)}
+        ref = {e.event_id: e
+               for e in (Event.from_json(_json.loads(l)) for l in lines)}
+        assert got.keys() == ref.keys()
+        for k, a in got.items():
+            b = ref[k]
+            for f in ("event", "entity_type", "entity_id",
+                      "target_entity_type", "target_entity_id",
+                      "properties", "tags", "pr_id", "event_time",
+                      "creation_time"):
+                assert getattr(a, f) == getattr(b, f), (k, f)
+        store2.close()
+
+    def test_import_then_train_read(self, store):
+        """Imported events feed the columnar training read correctly."""
+        lines = []
+        for k in range(500):
+            lines.append(
+                '{"event":"rate","entityType":"user","entityId":"u%d",'
+                '"targetEntityType":"item","targetEntityId":"i%d",'
+                '"properties":{"rating":%d}}' % (k % 20, k % 12, k % 5 + 1))
+        n = self._import(store, "\n".join(lines))
+        assert n == 500
+        cols = store.scan_columnar(APP, entity_type="user",
+                                   target_entity_type="item",
+                                   event_names=["rate"],
+                                   value_key="rating")
+        assert cols.n == 500
+        assert np.isfinite(cols.values).all()
+        assert set(cols.names) == {"rate"}
