@@ -417,21 +417,11 @@ class SQLEventStore(EventStore):
                     return
                 raise
 
-    def find(
-        self,
-        app_id: int,
-        channel_id: Optional[int] = None,
-        start_time: Optional[_dt.datetime] = None,
-        until_time: Optional[_dt.datetime] = None,
-        entity_type: Optional[str] = None,
-        entity_id: Optional[str] = None,
-        event_names: Optional[Sequence[str]] = None,
-        target_entity_type: Optional[str] = None,
-        target_entity_id: Optional[str] = None,
-        limit: Optional[int] = None,
-        reversed: bool = False,
-    ) -> Iterator[Event]:
-        t = self._table(app_id, channel_id)
+    @staticmethod
+    def _where(start_time, until_time, entity_type, entity_id,
+               event_names, target_entity_type, target_entity_id):
+        """Shared filter→SQL mapping for find() and scan_columnar —
+        one copy, so the two read paths can never filter differently."""
         clauses, args = [], []
         if start_time is not None:
             clauses.append("eventTime >= ?")
@@ -454,12 +444,37 @@ class SQLEventStore(EventStore):
         if event_names is not None:
             clauses.append(f"event IN ({','.join('?' * len(event_names))})")
             args.extend(event_names)
+        return clauses, args
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        t = self._table(app_id, channel_id)
+        clauses, args = self._where(start_time, until_time, entity_type,
+                                    entity_id, event_names,
+                                    target_entity_type, target_entity_id)
         where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
         order = "DESC" if reversed else "ASC"
         lim = f" LIMIT {int(limit)}" if (limit is not None and limit >= 0) else ""
         cols = ",".join(_EVENT_COLS)
+        # trailing `id` makes the order TOTAL: (eventTime, creationTime)
+        # ties otherwise come back plan-dependent on server engines,
+        # and two differently-shaped SELECTs (find vs scan_columnar)
+        # could disagree — breaking first-seen vocabulary parity
         sql = (f"SELECT {cols} FROM {t}{where} "
-               f"ORDER BY eventTime {order}, creationTime {order}{lim}")
+               f"ORDER BY eventTime {order}, creationTime {order}, "
+               f"id {order}{lim}")
         c = self._conn()
         try:
             # a server-side cursor (psycopg2 named / pymysql SSCursor)
@@ -507,6 +522,102 @@ class SQLEventStore(EventStore):
                     self._d.recover(c)
 
         return stream()
+
+
+    def scan_columnar(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        value_key: Optional[str] = None,
+    ):
+        """Columnar training read for SQL backends (same contract as
+        the C++ EVENTLOG scan — `data/pipeline.ColumnarEvents`): SELECT
+        only the five columns training needs, accumulate straight into
+        index arrays + first-seen vocabularies, and parse a row's
+        properties JSON only when ``value_key`` is set and the text
+        can contain it — no Event objects, no datetime parsing, no
+        tags/prId decode. Value semantics are the shared grammar
+        (`data/store._parse_value` + isfinite), identical to both
+        other paths."""
+        import numpy as np
+
+        from predictionio_tpu.data.pipeline import ColumnarEvents
+        from predictionio_tpu.data.store import _parse_value
+
+        t = self._table(app_id, channel_id)
+        clauses, args = self._where(start_time, until_time, entity_type,
+                                    None, event_names,
+                                    target_entity_type, None)
+        clauses = ["targetEntityId IS NOT NULL",
+                   "targetEntityId != ''"] + clauses
+        sql = (f"SELECT event,entityId,targetEntityId,properties,eventTime "
+               f"FROM {t} WHERE {' AND '.join(clauses)} "
+               f"ORDER BY eventTime ASC, creationTime ASC, id ASC")
+        c = self._conn()
+        try:
+            cur = self._d.stream_cursor(c)
+            cur.execute(self._d.sql(sql), args)
+            rows = cur.fetchmany(8192)
+        except Exception as e:
+            if self._missing_table(c, e):
+                rows = []
+            else:
+                raise
+        ents: dict = {}
+        tgts: dict = {}
+        names: dict = {}
+        e_idx, t_idx, n_idx, vals, times = [], [], [], [], []
+        nan = float("nan")
+        # cheap pre-filter: most rows' properties are "{}" or lack the
+        # key entirely; only candidates pay a json.loads. Safe only for
+        # keys json.dumps stores verbatim — anything needing escapes
+        # (quotes, backslashes, non-ASCII under ensure_ascii) parses
+        # every non-empty row instead of silently missing the needle.
+        needle = None
+        if value_key:
+            plain = (value_key.isascii() and '"' not in value_key
+                     and "\\" not in value_key
+                     and all(c >= " " for c in value_key))  # json.dumps
+            # escapes control chars, so a literal-tab needle never hits
+            needle = f'"{value_key}"' if plain else ""
+        try:
+            while rows:
+                for name, ent, tgt, props, t_us in rows:
+                    e_idx.append(ents.setdefault(ent, len(ents)))
+                    t_idx.append(tgts.setdefault(tgt, len(tgts)))
+                    n_idx.append(names.setdefault(name, len(names)))
+                    times.append(t_us)
+                    v = nan
+                    if (needle is not None and props and props != "{}"
+                            and (needle == "" or needle in props)):
+                        try:
+                            pv = _parse_value(json.loads(props).get(value_key))
+                            if pv is not None:
+                                v = pv
+                        except ValueError:
+                            pass
+                    vals.append(v)
+                if len(names) > 65535:  # u16 name_idx would wrap:
+                    return None         # decline → generic path
+                rows = cur.fetchmany(8192)
+        finally:
+            try:
+                c.commit()  # end the read transaction (see find())
+            except Exception:
+                self._d.recover(c)
+        return ColumnarEvents(
+            entity_idx=np.asarray(e_idx, np.uint32),
+            target_idx=np.asarray(t_idx, np.uint32),
+            name_idx=np.asarray(n_idx, np.uint16),
+            values=np.asarray(vals, np.float64),
+            times_us=np.asarray(times, np.int64),
+            entity_ids=list(ents), target_ids=list(tgts),
+            names=list(names))
 
 
 class SqliteEventStore(SQLEventStore):

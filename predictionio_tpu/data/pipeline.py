@@ -57,7 +57,9 @@ def iter_columnar(
     tgts: List[str] = []
     vals: List[float] = []
     for e in events:
-        if e.target_entity_id is None:
+        # falsy (None or "") — the columnar scans treat an empty-string
+        # target as no target, and the paths must agree
+        if not e.target_entity_id:
             continue
         v = 1.0
         if value_fn is not None:
@@ -278,7 +280,7 @@ def read_event_groups(
     users: Dict[str, int] = {}
     items: Dict[str, int] = {}
     for e in find():
-        if e.target_entity_id is None or e.event not in wanted:
+        if not e.target_entity_id or e.event not in wanted:
             continue
         if e.entity_id not in users:
             users[e.entity_id] = len(users)
@@ -300,7 +302,7 @@ def read_event_groups(
             bufs[name] = ([], [])
 
     for e in find():
-        if e.target_entity_id is None or e.event not in wanted:
+        if not e.target_entity_id or e.event not in wanted:
             continue
         ents, tgts = bufs[e.event]
         ents.append(e.entity_id)

@@ -231,10 +231,32 @@ def test_quickstart_on_eventlog_storage(tmp_path):
         set_storage(None)
 
 
+@pytest.fixture(params=["eventlog", "sqlite"])
+def col_store(request, tmp_path):
+    """Both scan_columnar providers: the C++ EVENTLOG engine and the
+    SQL store (default SQLITE backend) — one parity contract."""
+    if request.param == "eventlog":
+        from predictionio_tpu.data.filestore import NativeEventLogStore
+
+        try:
+            s = NativeEventLogStore(str(tmp_path / "log"))
+        except RuntimeError as e:
+            pytest.skip(str(e))
+    else:
+        from predictionio_tpu.data.events import SqliteEventStore
+
+        s = SqliteEventStore(str(tmp_path / "ev.db"))
+        s.init_channel(APP)
+    yield s
+    s.close()
+
+
 class TestColumnarScan:
-    """The native columnar training read must be indistinguishable from
-    the generic two-pass Python reader over find() — same vocabularies
-    (content AND first-seen order), same arrays, same drop semantics."""
+    """The columnar training read (C++ EVENTLOG engine AND the SQL
+    store's SELECT-only variant) must be indistinguishable from the
+    generic two-pass Python reader over find() — same vocabularies
+    (content AND first-seen order), same arrays, same drop
+    semantics."""
 
     def _mixed_workload(self, store):
         rng_events = [
@@ -269,10 +291,11 @@ class TestColumnarScan:
                 target_entity_id=tgt, properties=props,
                 event_time=t0 + dt.timedelta(seconds=k)), APP)
 
-    def test_matches_generic_reader(self, store):
+    def test_matches_generic_reader(self, col_store):
         from predictionio_tpu.data.pipeline import (
             interactions_from_columnar, read_interactions)
 
+        store = col_store
         self._mixed_workload(store)
         spec = {"rate": "prop"}
         cols = store.scan_columnar(
@@ -304,9 +327,11 @@ class TestColumnarScan:
         assert (fu == su).all() and (fi == si).all()
         assert (fv == sv).all()
 
-    def test_store_entry_point_both_paths(self, store, storage):
-        """read_training_interactions: EVENTLOG takes the native path,
-        MEMORY takes the generic path, results identical."""
+    def test_store_entry_point_both_paths(self, col_store, storage):
+        """read_training_interactions dispatch: each scan_columnar
+        provider (EVENTLOG, SQLITE) takes the fast path through the
+        ENTRY POINT, MEMORY takes the generic path — identical."""
+        store = col_store
         from predictionio_tpu.data.store import read_training_interactions
 
         a = storage.meta.create_app("ColApp")
@@ -347,7 +372,8 @@ class TestColumnarScan:
         for (a1, b1) in zip(fast.arrays(), generic.arrays()):
             assert (a1 == b1).all()
 
-    def test_event_groups_parity(self, store):
+    def test_event_groups_parity(self, col_store):
+        store = col_store
         """Grouped multi-event read (Universal Recommender shape):
         columnar demux must equal the generic two-scan reader — same
         per-name pairs, same SHARED vocabulary pair, same order."""

@@ -138,6 +138,11 @@ class TestTemplateStreamingReads:
 
         monkeypatch.setattr(data_store, "find",
                             self._synthetic_find(100_000))
+        # pin the GENERIC streaming path: these tests measure ITS
+        # O(chunk) behavior (the default SQLITE backend would otherwise
+        # dispatch to its columnar scan and never hit the find seam)
+        monkeypatch.setattr(data_store, "_native_scan",
+                            lambda storage: (None, None))
         # the lazy Rating compat path must never run during the read
         monkeypatch.setattr(
             rec, "Rating",
@@ -164,6 +169,8 @@ class TestTemplateStreamingReads:
 
         find = self._synthetic_find(2_000, n_users=40, n_items=30)
         monkeypatch.setattr(data_store, "find", find)
+        monkeypatch.setattr(data_store, "_native_scan",
+                            lambda storage: (None, None))
         ds = rec.RecDataSource(rec.DataSourceParams(app_name="x"))
         td = ds._read(WorkflowContext(storage=None))
 
