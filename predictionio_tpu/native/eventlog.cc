@@ -25,7 +25,8 @@
 // Single-writer per file (like the reference's LocalFS model store);
 // in-process concurrency is guarded by a per-handle mutex.
 
-#include <unistd.h>  // truncate
+#include <sys/mman.h>  // mmap for bulk scans
+#include <unistd.h>    // truncate
 
 #include <algorithm>
 #include <cmath>
@@ -97,6 +98,39 @@ bool read_payload(Handle* h, const Rec& r, std::string* out) {
   if (fseek(h->f, (long)r.payload_off, SEEK_SET) != 0) return false;
   return fread(out->data(), 1, r.payload_len, h->f) == r.payload_len;
 }
+
+// RAII read-only mapping of the whole log for bulk scans: the
+// time-sorted index visits records in arbitrary FILE order, so the
+// per-record fseek+fread pair costs two syscalls per event — mapped,
+// a payload is just a pointer. Falls back to read_payload when mmap
+// is unavailable (empty file, exotic FS).
+struct LogMap {
+  const unsigned char* base = nullptr;
+  size_t len = 0;
+
+  explicit LogMap(Handle* h) {
+    if (!h->f) return;  // wipe-reopen failure leaves a null FILE*; the
+    // empty-index scan must stay a no-op, not a null deref
+    fflush(h->f);
+    long end = (fseek(h->f, 0, SEEK_END) == 0) ? ftell(h->f) : -1;
+    if (end <= 0) return;
+    void* p = mmap(nullptr, (size_t)end, PROT_READ, MAP_PRIVATE,
+                   fileno(h->f), 0);
+    if (p == MAP_FAILED) return;
+    base = (const unsigned char*)p;
+    len = (size_t)end;
+  }
+  ~LogMap() {
+    if (base) munmap((void*)base, len);
+  }
+  // payload view, or empty on out-of-range / no mapping
+  bool view(const Rec& r, std::string_view* out) const {
+    if (!base || r.payload_off + r.payload_len > len) return false;
+    *out = std::string_view((const char*)base + r.payload_off,
+                            r.payload_len);
+    return true;
+  }
+};
 
 void index_record(Handle* h, uint8_t kind, const unsigned char* payload,
                   uint32_t plen, uint64_t payload_off) {
@@ -1228,15 +1262,20 @@ long long pel_scan_columnar(void* hv, long long start_us, long long until_us,
   std::vector<double> values;
   std::vector<uint32_t> ent_idx, tgt_idx;
   std::vector<uint16_t> name_idx;
+  LogMap map(h);
   std::string payload;
   for (size_t idx : h->sorted) {
     const Rec& r = h->recs[idx];
     if (r.time_us < start_us || r.time_us >= until_us) continue;
-    if (!read_payload(h, r, &payload)) continue;
+    std::string_view pv;
+    if (!map.view(r, &pv)) {
+      if (!read_payload(h, r, &payload)) continue;
+      pv = payload;
+    }
     int64_t t, c;
     std::string_view s[9];
-    if (!parse_event((const unsigned char*)payload.data(),
-                     (uint32_t)payload.size(), &t, &c, s))
+    if (!parse_event((const unsigned char*)pv.data(),
+                     (uint32_t)pv.size(), &t, &c, s))
       continue;
     if (entity_type && s[2] != entity_type) continue;
     if (target_entity_type && s[4] != target_entity_type) continue;
