@@ -23,7 +23,13 @@
 //   - aggregate folds $set/$unset/$delete in that order
 //
 // Single-writer per file (like the reference's LocalFS model store);
-// in-process concurrency is guarded by a per-handle mutex.
+// in-process concurrency is guarded by a per-handle mutex. The file
+// model is SINGLE-PROCESS: bulk scans mmap the log, so an external
+// truncation mid-scan is a SIGBUS, not a short read — never run two
+// processes (or a concurrent manual truncate) against one namespace
+// file (the storage registry already hands each process its own
+// handle set; multi-process deployments put the Event Server in
+// front, as the reference does with HBase).
 
 #include <sys/mman.h>  // mmap for bulk scans
 #include <unistd.h>    // truncate
@@ -74,6 +80,23 @@ int64_t rd_i64(const unsigned char* p) {
   return (int64_t)v;
 }
 
+void append_padded(std::string* out) {
+  while (out->size() % 8) out->push_back('\0');
+}
+
+void append_u32(std::string* out, uint32_t v) {
+  unsigned char b[4] = {(unsigned char)(v & 0xff),
+                        (unsigned char)((v >> 8) & 0xff),
+                        (unsigned char)((v >> 16) & 0xff),
+                        (unsigned char)((v >> 24) & 0xff)};
+  out->append((char*)b, 4);
+}
+
+void append_u64(std::string* out, uint64_t v) {
+  for (int i = 0; i < 8; ++i) out->push_back((char)((v >> (8 * i)) & 0xff));
+}
+
+
 // Parse the 9 strings of an event payload into string_views over buf.
 // Returns false on corruption.
 bool parse_event(const unsigned char* buf, uint32_t len, int64_t* time_us,
@@ -94,6 +117,7 @@ bool parse_event(const unsigned char* buf, uint32_t len, int64_t* time_us,
 }
 
 bool read_payload(Handle* h, const Rec& r, std::string* out) {
+  if (!h->f) return false;  // failed wipe-reopen: skip, don't crash
   out->resize(r.payload_len);
   if (fseek(h->f, (long)r.payload_off, SEEK_SET) != 0) return false;
   return fread(out->data(), 1, r.payload_len, h->f) == r.payload_len;
@@ -541,16 +565,21 @@ long long pel_find(void* hv, long long start_us, long long until_us,
   }
   std::string result;
   long long matched = 0;
+  LogMap map(h);
   std::string payload;
   auto visit = [&](size_t idx) -> bool {  // returns false to stop
     if (limit >= 0 && matched >= limit) return false;  // incl. limit=0
     const Rec& r = h->recs[idx];
     if (r.time_us < start_us || r.time_us >= until_us) return true;
-    if (!read_payload(h, r, &payload)) return true;
+    std::string_view pv;
+    if (!map.view(r, &pv)) {
+      if (!read_payload(h, r, &payload)) return true;
+      pv = payload;
+    }
     int64_t t, c;
     std::string_view s[9];
-    if (!parse_event((const unsigned char*)payload.data(),
-                     (uint32_t)payload.size(), &t, &c, s))
+    if (!parse_event((const unsigned char*)pv.data(),
+                     (uint32_t)pv.size(), &t, &c, s))
       return true;
     if (entity_type && s[2] != entity_type) return true;
     if (entity_id && s[3] != entity_id) return true;
@@ -562,13 +591,8 @@ long long pel_find(void* hv, long long start_us, long long until_us,
         if (s[1] == n) { ok = true; break; }
       if (!ok) return true;
     }
-    uint32_t plen = (uint32_t)payload.size();
-    unsigned char lenb[4] = {(unsigned char)(plen & 0xff),
-                             (unsigned char)((plen >> 8) & 0xff),
-                             (unsigned char)((plen >> 16) & 0xff),
-                             (unsigned char)((plen >> 24) & 0xff)};
-    result.append((char*)lenb, 4);
-    result.append(payload);
+    append_u32(&result, (uint32_t)pv.size());
+    result.append(pv.data(), pv.size());
     ++matched;
     return !(limit >= 0 && matched >= limit);
   };
@@ -599,15 +623,20 @@ long long pel_aggregate(void* hv, const char* entity_type,
     int64_t first_us = 0, last_us = 0;
   };
   std::map<std::string, Ent> state;
+  LogMap map(h);
   std::string payload;
   for (size_t idx : h->sorted) {
     const Rec& r = h->recs[idx];
     if (r.time_us < start_us || r.time_us >= until_us) continue;
-    if (!read_payload(h, r, &payload)) continue;
+    std::string_view pv;
+    if (!map.view(r, &pv)) {
+      if (!read_payload(h, r, &payload)) continue;
+      pv = payload;
+    }
     int64_t t, c;
     std::string_view s[9];
-    if (!parse_event((const unsigned char*)payload.data(),
-                     (uint32_t)payload.size(), &t, &c, s))
+    if (!parse_event((const unsigned char*)pv.data(),
+                     (uint32_t)pv.size(), &t, &c, s))
       continue;
     if (entity_type && s[2] != entity_type) continue;
     std::string eid(s[3]);
@@ -789,22 +818,6 @@ double extract_number(std::string_view s, std::string_view key) {
     if (match) return parse_number_token(s.substr(i, ve - i));
     i = ve;
   }
-}
-
-void append_padded(std::string* out) {
-  while (out->size() % 8) out->push_back('\0');
-}
-
-void append_u32(std::string* out, uint32_t v) {
-  unsigned char b[4] = {(unsigned char)(v & 0xff),
-                        (unsigned char)((v >> 8) & 0xff),
-                        (unsigned char)((v >> 16) & 0xff),
-                        (unsigned char)((v >> 24) & 0xff)};
-  out->append((char*)b, 4);
-}
-
-void append_u64(std::string* out, uint64_t v) {
-  for (int i = 0; i < 8; ++i) out->push_back((char)((v >> (8 * i)) & 0xff));
 }
 
 }  // namespace
