@@ -285,6 +285,33 @@ class NativeEventLogStore(EventStore):
             yield deserialize_payload(buf, pos, plen)
             pos += plen
 
+    def iter_jsonl_chunks(
+        self, app_id: int, channel_id: Optional[int] = None,
+        chunk_events: int = 100_000,
+    ) -> Iterator[str]:
+        """Native `pio export`: stream the namespace as NDJSON text
+        chunks straight from C++ (Event.to_json_str key order;
+        json-loads-equal — raw property spans re-emit verbatim). The
+        cursor walks the time-sorted order; don't interleave writes."""
+        h = self._handle(app_id, channel_id)
+        cursor = 0
+        while True:
+            out = ctypes.c_void_p()
+            blob_len = ctypes.c_longlong()
+            visited = self._lib.pel_export_jsonl(
+                h, cursor, chunk_events, ctypes.byref(out),
+                ctypes.byref(blob_len))
+            if visited < 0:
+                raise IOError("event log export failed")
+            if visited == 0:
+                return  # cursor past the end; nothing was allocated
+            # visited ≠ emitted: a chunk of unreadable records yields
+            # an empty blob but the walk continues (r5 review)
+            text = self._take(out, blob_len.value).decode("utf-8")
+            if text:
+                yield text
+            cursor += visited
+
     def scan_columnar(
         self,
         app_id: int,

@@ -101,6 +101,11 @@ def eventlog_library() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong,
         ctypes.c_longlong, ctypes.c_ulonglong, ctypes.c_char_p,
         ctypes.c_longlong, ctypes.c_char_p]
+    lib.pel_export_jsonl.restype = ctypes.c_longlong
+    lib.pel_export_jsonl.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_longlong)]
     lib.pel_scan_columnar.restype = ctypes.c_longlong
     lib.pel_scan_columnar.argtypes = [
         ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,

@@ -1334,6 +1334,139 @@ long long pel_scan_columnar(void* hv, long long start_us, long long until_us,
   return *out ? (long long)blob.size() : -1;
 }
 
+// ---------------- native NDJSON export (`pio export`) -------------------
+//
+// The inverse of the import path: stream frames back out as event
+// wire JSON with zero per-event Python objects. Semantic parity with
+// Event.to_json_str — same key order, same millisecond-truncated
+// +00:00 timestamps — but json-loads-equal rather than byte-equal:
+// stored property spans re-emit verbatim (raw UTF-8 passes through
+// where Python's ensure_ascii would \u-escape; a "4.50" survives as
+// "4.50" instead of renormalizing to 4.5). Cursor API so 20M-event
+// exports stream in bounded chunks: events [cursor, cursor+max) of
+// the time-sorted order; the caller must not interleave writes
+// between calls (single importer process — the file-model contract).
+
+namespace {
+
+// Hinnant civil-from-days: inverse of days_from_civil.
+void civil_from_days(int64_t z, int64_t* y, unsigned* m, unsigned* d) {
+  z += 719468;
+  const int64_t era = (z >= 0 ? z : z - 146096) / 146097;
+  const unsigned doe = (unsigned)(z - era * 146097);
+  const unsigned yoe = (doe - doe / 1460 + doe / 36524 - doe / 146096) / 365;
+  const int64_t yr = (int64_t)yoe + era * 400;
+  const unsigned doy = doe - (365 * yoe + yoe / 4 - yoe / 100);
+  const unsigned mp = (5 * doy + 2) / 153;
+  *d = doy - (153 * mp + 2) / 5 + 1;
+  *m = mp + (mp < 10 ? 3 : -9);
+  *y = yr + (*m <= 2);
+}
+
+// format_event_time parity: ISO-8601, millisecond-TRUNCATED, +00:00.
+void append_iso_ms(std::string* out, int64_t us) {
+  int64_t days = us / 86400000000LL;
+  int64_t rem = us - days * 86400000000LL;
+  if (rem < 0) { rem += 86400000000LL; --days; }
+  int64_t y; unsigned mo, dd;
+  civil_from_days(days, &y, &mo, &dd);
+  unsigned hh = (unsigned)(rem / 3600000000LL);
+  unsigned mi = (unsigned)(rem / 60000000LL % 60);
+  unsigned ss = (unsigned)(rem / 1000000LL % 60);
+  unsigned ms = (unsigned)(rem / 1000LL % 1000);
+  char buf[48];
+  snprintf(buf, sizeof buf,
+           "%04lld-%02u-%02uT%02u:%02u:%02u.%03u+00:00",
+           (long long)y, mo, dd, hh, mi, ss, ms);
+  *out += buf;
+}
+
+void append_json_str(std::string* out, std::string_view s) {
+  *out += '"';
+  *out += json_escape(s);
+  *out += '"';
+}
+
+}  // namespace
+
+// Export events [cursor, cursor+max_events) of the sorted order as
+// NDJSON. Returns the number of index entries VISITED (0 = cursor
+// past the end — distinct from "visited but all unreadable", which
+// returns the count with an empty blob so the caller keeps walking),
+// -1 on error. *out is always malloc'd on success; blob byte length
+// via *out_len.
+long long pel_export_jsonl(void* hv, long long cursor,
+                           long long max_events, char** out,
+                           long long* out_len) {
+  Handle* h = (Handle*)hv;
+  std::lock_guard<std::mutex> g(h->mu);
+  ensure_sorted(h);
+  std::string blob;
+  LogMap map(h);
+  std::string payload;
+  long long end = (long long)h->sorted.size();
+  if (cursor < 0) cursor = 0;
+  long long stop = (max_events >= 0 && cursor + max_events < end)
+                       ? cursor + max_events : end;
+  if (cursor >= end) {  // past the end: nothing allocated, no leak
+    *out_len = 0;
+    return 0;
+  }
+  for (long long i = cursor; i < stop; ++i) {
+    const Rec& r = h->recs[h->sorted[(size_t)i]];
+    std::string_view pv;
+    if (!map.view(r, &pv)) {
+      if (!read_payload(h, r, &payload)) continue;
+      pv = payload;
+    }
+    int64_t t, c;
+    std::string_view s[9];
+    if (!parse_event((const unsigned char*)pv.data(), (uint32_t)pv.size(),
+                     &t, &c, s))
+      continue;
+    // Event.to_json key order exactly
+    blob += "{\"eventId\":";
+    append_json_str(&blob, s[0]);
+    blob += ",\"event\":";
+    append_json_str(&blob, s[1]);
+    blob += ",\"entityType\":";
+    append_json_str(&blob, s[2]);
+    blob += ",\"entityId\":";
+    append_json_str(&blob, s[3]);
+    // per-FIELD gating, matching Event.to_json's independent None
+    // checks (frame "" ↔ None) — degenerate half-present targets must
+    // export identically on both paths (r5 review)
+    if (!s[4].empty()) {
+      blob += ",\"targetEntityType\":";
+      append_json_str(&blob, s[4]);
+    }
+    if (!s[5].empty()) {
+      blob += ",\"targetEntityId\":";
+      append_json_str(&blob, s[5]);
+    }
+    blob += ",\"properties\":";
+    blob.append(s[6].empty() ? std::string_view("{}") : s[6]);
+    blob += ",\"eventTime\":\"";
+    append_iso_ms(&blob, t);
+    blob += '"';
+    if (!s[7].empty() && s[7] != "[]") {
+      blob += ",\"tags\":";
+      blob.append(s[7].data(), s[7].size());
+    }
+    if (!s[8].empty()) {
+      blob += ",\"prId\":";
+      append_json_str(&blob, s[8]);
+    }
+    blob += ",\"creationTime\":\"";
+    append_iso_ms(&blob, c);
+    blob += "\"}\n";
+  }
+  *out = dup_out(blob);
+  if (!*out) return -1;
+  *out_len = (long long)blob.size();
+  return stop - cursor;
+}
+
 void pel_free(char* p) { free(p); }
 
 }  // extern "C"

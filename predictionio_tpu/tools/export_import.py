@@ -28,6 +28,15 @@ def export_events(
     storage: Optional[Storage] = None,
 ) -> int:
     st = storage or get_storage()
+    iter_chunks = getattr(st.events, "iter_jsonl_chunks", None)
+    if iter_chunks is not None:
+        # native path: C++ emits the NDJSON text directly (same key
+        # order as Event.to_json_str, json-loads-equal lines)
+        n = 0
+        for chunk in iter_chunks(app_id, channel_id):
+            out.write(chunk)
+            n += chunk.count("\n")
+        return n
     n = 0
     for ev in st.events.find(app_id, channel_id):
         out.write(ev.to_json_str() + "\n")

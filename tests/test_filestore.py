@@ -592,3 +592,72 @@ class TestNativeJsonlImport:
         assert cols.n == 500
         assert np.isfinite(cols.values).all()
         assert set(cols.names) == {"rate"}
+
+
+class TestNativeJsonlExport:
+    """`pio export` native parity: every line must json-loads-equal
+    what Event.to_json_str would emit for the same event (key order,
+    ms-truncated +00:00 timestamps, omitted-empty fields), across the
+    cursor-chunk boundary."""
+
+    def test_loads_equal_with_python_export(self, store):
+        import io
+        import json as _json
+
+        from predictionio_tpu.tools.export_import import export_events
+
+        t0 = dt.datetime(2026, 2, 3, 4, 5, 6, 789123,
+                         tzinfo=dt.timezone.utc)
+        evs = [
+            Event(event="rate", entity_type="user", entity_id="u∞",
+                  target_entity_type="item", target_entity_id='i"q',
+                  properties={"rating": 4.5, "nested": {"a": [1, None]}},
+                  event_time=t0),
+            Event(event="note", entity_type="user", entity_id="u2",
+                  properties={}, tags=["a", "b\\c"], pr_id="pr-1",
+                  event_time=t0 + dt.timedelta(seconds=1)),
+            Event(event="plain", entity_type="t", entity_id="x",
+                  event_time=t0 + dt.timedelta(seconds=2,
+                                               microseconds=999)),
+        ]
+        store.insert_batch(evs, APP)
+
+        out = io.StringIO()
+        n = export_events(APP, out, storage=type("S", (), {"events": store}))
+        assert n == 3
+        native_lines = [l for l in out.getvalue().splitlines() if l]
+        ref_lines = [e.to_json_str() for e in store.find(APP)]
+        assert len(native_lines) == len(ref_lines) == 3
+        for a, b in zip(native_lines, ref_lines):
+            da, db = _json.loads(a), _json.loads(b)
+            assert da == db
+            # key ORDER parity too (consumers may stream-parse)
+            assert list(da) == list(db)
+
+    def test_chunk_boundary_and_reimport(self, store, tmp_path):
+        import io
+        import json as _json
+
+        from predictionio_tpu.data.filestore import NativeEventLogStore
+        from predictionio_tpu.tools.export_import import (export_events,
+                                                          import_events)
+
+        t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+        store.insert_batch(
+            [Event(event="e", entity_type="u", entity_id=str(k),
+                   target_entity_type="i", target_entity_id=str(k % 7),
+                   event_time=t0 + dt.timedelta(seconds=k))
+             for k in range(257)], APP)
+        chunks = list(store.iter_jsonl_chunks(APP, chunk_events=100))
+        assert len(chunks) == 3  # 100 + 100 + 57
+        text = "".join(chunks)
+        assert text.count("\n") == 257
+
+        s2 = NativeEventLogStore(str(tmp_path / "re"))
+        n = import_events(APP, io.StringIO(text),
+                          storage=type("S", (), {"events": s2}))
+        assert n == 257
+        a = [e.event_id for e in store.find(APP)]
+        b = [e.event_id for e in s2.find(APP)]
+        assert a == b
+        s2.close()
