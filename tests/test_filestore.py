@@ -670,18 +670,15 @@ def test_universal_workflow_on_eventlog(tmp_path):
     import numpy as np
 
     from predictionio_tpu.core.workflow import prepare_deploy, run_train
-    from predictionio_tpu.data.filestore import NativeEventLogStore
-    from predictionio_tpu.storage.meta import MetaStore
-    from predictionio_tpu.storage.models import MemoryModelStore
     from predictionio_tpu.storage.registry import (Storage, StorageConfig,
                                                    set_storage)
 
     st = Storage(StorageConfig(metadata_type="MEMORY",
-                               modeldata_type="MEMORY"))
-    st._meta = MetaStore(":memory:")
-    st._models = MemoryModelStore()
+                               modeldata_type="MEMORY",
+                               eventdata_type="EVENTLOG",
+                               home=str(tmp_path)))
     try:
-        st._events = NativeEventLogStore(str(tmp_path / "log"))
+        st.events  # builds the C++ engine (skip when no g++)
     except RuntimeError as e:
         pytest.skip(str(e))
     set_storage(st)
@@ -702,8 +699,12 @@ def test_universal_workflow_on_eventlog(tmp_path):
                    "eventNames": ["buy", "view", "like"]}},
                "algorithms": [{"name": "ur",
                                "params": {"maxIndicatorsPerItem": 20}}]}
-    iid = run_train(factory, variant=variant, storage=st, use_mesh=False)
-    eng = prepare_deploy(factory, instance_id=iid, storage=st)
-    out = eng.query({"user": "u3", "num": 5})
-    assert out["itemScores"], "UR query must return scored items"
-    st.events.close()
+    try:
+        iid = run_train(factory, variant=variant, storage=st,
+                        use_mesh=False)
+        eng = prepare_deploy(factory, instance_id=iid, storage=st)
+        out = eng.query({"user": "u3", "num": 5})
+        assert out["itemScores"], "UR query must return scored items"
+    finally:
+        st.events.close()
+        set_storage(None)
