@@ -218,9 +218,22 @@ def main() -> None:
         tu, ti, tv = data.arrays()
         columnar_sec = time.perf_counter() - t0
 
+        # the `pio export` surface (native C++ emit on EVENTLOG)
+        import os as _os
+
+        from predictionio_tpu.tools.export_import import export_events
+
+        with open(_os.devnull, "w") as devnull:
+            t0 = time.perf_counter()
+            n_exported = export_events(app2.id, devnull, storage=st)
+            export_sec = time.perf_counter() - t0
+        assert n_exported == args.bulk
+
         out["bulk_import"] = {
             "jsonl_import_sec": round(jsonl_sec, 2),
             "jsonl_import_events_per_sec": round(args.bulk / jsonl_sec),
+            "jsonl_export_sec": round(export_sec, 2),
+            "jsonl_export_events_per_sec": round(args.bulk / export_sec),
             "training_read_sec": round(columnar_sec, 2),
             "training_read_events_per_sec": round(
                 max(data.n_events, 1) / columnar_sec),
