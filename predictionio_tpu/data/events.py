@@ -544,10 +544,7 @@ class SQLEventStore(EventStore):
         tags/prId decode. Value semantics are the shared grammar
         (`data/store._parse_value` + isfinite), identical to both
         other paths."""
-        import numpy as np
-
-        from predictionio_tpu.data.pipeline import ColumnarEvents
-        from predictionio_tpu.data.store import _parse_value
+        from predictionio_tpu.data.pipeline import columnar_from_rows
 
         t = self._table(app_id, channel_id)
         clauses, args = self._where(start_time, until_time, entity_type,
@@ -568,56 +565,20 @@ class SQLEventStore(EventStore):
                 rows = []
             else:
                 raise
-        ents: dict = {}
-        tgts: dict = {}
-        names: dict = {}
-        e_idx, t_idx, n_idx, vals, times = [], [], [], [], []
-        nan = float("nan")
-        # cheap pre-filter: most rows' properties are "{}" or lack the
-        # key entirely; only candidates pay a json.loads. Safe only for
-        # keys json.dumps stores verbatim — anything needing escapes
-        # (quotes, backslashes, non-ASCII under ensure_ascii) parses
-        # every non-empty row instead of silently missing the needle.
-        needle = None
-        if value_key:
-            plain = (value_key.isascii() and '"' not in value_key
-                     and "\\" not in value_key
-                     and all(c >= " " for c in value_key))  # json.dumps
-            # escapes control chars, so a literal-tab needle never hits
-            needle = f'"{value_key}"' if plain else ""
-        try:
-            while rows:
-                for name, ent, tgt, props, t_us in rows:
-                    e_idx.append(ents.setdefault(ent, len(ents)))
-                    t_idx.append(tgts.setdefault(tgt, len(tgts)))
-                    n_idx.append(names.setdefault(name, len(names)))
-                    times.append(t_us)
-                    v = nan
-                    if (needle is not None and props and props != "{}"
-                            and (needle == "" or needle in props)):
-                        try:
-                            pv = _parse_value(json.loads(props).get(value_key))
-                            if pv is not None:
-                                v = pv
-                        except ValueError:
-                            pass
-                    vals.append(v)
-                if len(names) > 65535:  # u16 name_idx would wrap:
-                    return None         # decline → generic path
-                rows = cur.fetchmany(8192)
-        finally:
+
+        def row_iter():
+            nonlocal rows
             try:
-                c.commit()  # end the read transaction (see find())
-            except Exception:
-                self._d.recover(c)
-        return ColumnarEvents(
-            entity_idx=np.asarray(e_idx, np.uint32),
-            target_idx=np.asarray(t_idx, np.uint32),
-            name_idx=np.asarray(n_idx, np.uint16),
-            values=np.asarray(vals, np.float64),
-            times_us=np.asarray(times, np.int64),
-            entity_ids=list(ents), target_ids=list(tgts),
-            names=list(names))
+                while rows:
+                    yield from rows
+                    rows = cur.fetchmany(8192)
+            finally:
+                try:
+                    c.commit()  # end the read transaction (see find())
+                except Exception:
+                    self._d.recover(c)
+
+        return columnar_from_rows(row_iter(), value_key)
 
 
 class SqliteEventStore(SQLEventStore):

@@ -131,6 +131,76 @@ class ColumnarEvents:
         return int(self.entity_idx.shape[0])
 
 
+def columnar_from_rows(
+    rows: Iterator[Tuple[str, str, str, Optional[str], int]],
+    value_key: Optional[str] = None,
+) -> Optional[ColumnarEvents]:
+    """Shared Python-side columnar accumulator for stores without a
+    native scan engine (SQL, embedded index): consume
+    ``(event, entity_id, target_id, properties_json, time_us)`` rows in
+    scan order and build the :class:`ColumnarEvents` columns +
+    first-seen vocabularies. Rows must already be target-filtered.
+    ``value_key`` extraction applies the shared grammar
+    (`data/store._parse_value`); a cheap substring prefilter skips
+    `json.loads` for rows that cannot carry the key. Returns None when
+    >65535 distinct event names would overflow the u16 name column
+    (callers fall back to the generic reader)."""
+    import json
+
+    from predictionio_tpu.data.store import _parse_value
+
+    ents: Dict[str, int] = {}
+    tgts: Dict[str, int] = {}
+    names: Dict[str, int] = {}
+    e_idx: List[int] = []
+    t_idx: List[int] = []
+    n_idx: List[int] = []
+    vals: List[float] = []
+    times: List[int] = []
+    nan = float("nan")
+    needle = None
+    if value_key:
+        plain = (value_key.isascii() and '"' not in value_key
+                 and "\\" not in value_key
+                 and all(c >= " " for c in value_key))  # json.dumps
+        # escapes control chars, so a literal-tab needle never hits
+        needle = f'"{value_key}"' if plain else ""
+    try:
+        for name, ent, tgt, props, t_us in rows:
+            e_idx.append(ents.setdefault(ent, len(ents)))
+            t_idx.append(tgts.setdefault(tgt, len(tgts)))
+            n_idx.append(names.setdefault(name, len(names)))
+            times.append(t_us)
+            v = nan
+            if (needle is not None and props and props != "{}"
+                    and (needle == "" or needle in props)):
+                try:
+                    pv = _parse_value(json.loads(props).get(value_key))
+                    if pv is not None:
+                        v = pv
+                except ValueError:
+                    pass
+            vals.append(v)
+            if len(names) > 65535:  # u16 name_idx would wrap
+                return None
+    finally:
+        # the early None return must not abandon a generator mid-flight:
+        # the SQL row source ends its read transaction in ITS finally,
+        # which only runs when the generator closes — deterministically
+        # here, not at GC time (idle-in-transaction hazard)
+        closer = getattr(rows, "close", None)
+        if closer is not None:
+            closer()
+    return ColumnarEvents(
+        entity_idx=np.asarray(e_idx, np.uint32),
+        target_idx=np.asarray(t_idx, np.uint32),
+        name_idx=np.asarray(n_idx, np.uint16),
+        values=np.asarray(vals, np.float64),
+        times_us=np.asarray(times, np.int64),
+        entity_ids=list(ents), target_ids=list(tgts),
+        names=list(names))
+
+
 def interactions_from_columnar(
     cols: ColumnarEvents,
     value_spec: Optional[Dict[str, Any]] = None,

@@ -655,20 +655,12 @@ class ESEventStore(EventStore):
     def close(self) -> None:
         self._c.close()
 
-    def find(
-        self,
-        app_id: int,
-        channel_id: Optional[int] = None,
-        start_time: Optional[_dt.datetime] = None,
-        until_time: Optional[_dt.datetime] = None,
-        entity_type: Optional[str] = None,
-        entity_id: Optional[str] = None,
-        event_names: Optional[Sequence[str]] = None,
-        target_entity_type: Optional[str] = None,
-        target_entity_id: Optional[str] = None,
-        limit: Optional[int] = None,
-        reversed: bool = False,
-    ) -> Iterator[Event]:
+    @staticmethod
+    def _query(start_time, until_time, entity_type, entity_id,
+               event_names, target_entity_type, target_entity_id):
+        """Shared filter→search mapping for find() and scan_columnar —
+        one copy, so the two read paths (and therefore the columnar/
+        generic vocabulary orders) can never diverge."""
         must: List[Tuple[str, Any]] = []
         if entity_type is not None:
             must.append(("entityType", entity_type))
@@ -685,11 +677,68 @@ class ESEventStore(EventStore):
             ranges = [("eventTime",
                        start_time.timestamp() if start_time else None,
                        until_time.timestamp() if until_time else None)]
+        return must, must_any, ranges
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        must, must_any, ranges = self._query(
+            start_time, until_time, entity_type, entity_id, event_names,
+            target_entity_type, target_entity_id)
         hits = self._idx(app_id, channel_id).search(
             must=must, must_any=must_any, ranges=ranges,
             sort="eventTime", reverse=reversed,
             size=limit if (limit is not None and limit >= 0) else None)
         return iter([self._event(i, d) for i, _, d in hits])
+
+    def scan_columnar(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        value_key: Optional[str] = None,
+    ):
+        """Columnar training read over the index (same contract as the
+        EVENTLOG/SQL scans — `data/pipeline.ColumnarEvents`): the SAME
+        search the generic ``find()`` runs supplies the hits, so scan
+        order (hence vocabulary order) matches by construction, but no
+        Event objects, timestamp parses, or full-properties decodes
+        are built per doc."""
+        from predictionio_tpu.data.pipeline import columnar_from_rows
+
+        must, must_any, ranges = self._query(
+            start_time, until_time, entity_type, None, event_names,
+            target_entity_type, None)
+        hits = self._idx(app_id, channel_id).search(
+            must=must, must_any=must_any, ranges=ranges, sort="eventTime")
+
+        def rows():
+            for _i, _score, d in hits:
+                tgt = d.get("targetEntityId")
+                if not tgt:
+                    continue
+                # round, not truncate: the doc stores float seconds and
+                # int(x*1e6) lands 1 µs low for ~1% of values
+                yield (d["event"], d["entityId"], tgt,
+                       d.get("properties"),
+                       round(d["eventTime"] * 1e6))
+
+        return columnar_from_rows(rows(), value_key)
 
 
 # -- meta store ----------------------------------------------------------------

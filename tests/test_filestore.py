@@ -231,10 +231,11 @@ def test_quickstart_on_eventlog_storage(tmp_path):
         set_storage(None)
 
 
-@pytest.fixture(params=["eventlog", "sqlite"])
+@pytest.fixture(params=["eventlog", "sqlite", "indexed"])
 def col_store(request, tmp_path):
-    """Both scan_columnar providers: the C++ EVENTLOG engine and the
-    SQL store (default SQLITE backend) — one parity contract."""
+    """Every scan_columnar provider — the C++ EVENTLOG engine, the SQL
+    store (default SQLITE backend), and the embedded index — under one
+    parity contract."""
     if request.param == "eventlog":
         from predictionio_tpu.data.filestore import NativeEventLogStore
 
@@ -242,10 +243,16 @@ def col_store(request, tmp_path):
             s = NativeEventLogStore(str(tmp_path / "log"))
         except RuntimeError as e:
             pytest.skip(str(e))
-    else:
+    elif request.param == "sqlite":
         from predictionio_tpu.data.events import SqliteEventStore
 
         s = SqliteEventStore(str(tmp_path / "ev.db"))
+        s.init_channel(APP)
+    else:
+        from predictionio_tpu.storage.indexed import (ESEventStore,
+                                                      IndexedStorageClient)
+
+        s = ESEventStore(IndexedStorageClient(str(tmp_path / "idx")))
         s.init_channel(APP)
     yield s
     s.close()
