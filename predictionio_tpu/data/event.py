@@ -123,17 +123,33 @@ class Event:
         props = obj.get("properties") or {}
         if not isinstance(props, dict):
             raise EventValidationError("properties must be a JSON object")
+        def opt_str(field: str):
+            # empty string = absent: storage backends serialize None
+            # and "" identically (the frame/doc formats have no
+            # distinct null), so accepting "" stored backend-divergent
+            # events — '{"targetEntityType":"item","targetEntityId":""}'
+            # now fails the one-sided-target validation uniformly
+            # (found by the r5 import fuzz). Non-string values are a
+            # typed error, not a crash five layers down in the
+            # serializer.
+            v = obj.get(field)
+            if v is None or v == "":
+                return None
+            if not isinstance(v, str):
+                raise EventValidationError(f"{field} must be a string")
+            return v
+
         ev = cls(
             event=str(name),
             entity_type=str(entity_type),
             entity_id=str(entity_id),
-            target_entity_type=obj.get("targetEntityType"),
-            target_entity_id=obj.get("targetEntityId"),
+            target_entity_type=opt_str("targetEntityType"),
+            target_entity_id=opt_str("targetEntityId"),
             properties=dict(props),
             event_time=parse_event_time(obj["eventTime"]) if "eventTime" in obj and obj["eventTime"] is not None else utcnow(),
             tags=list(obj.get("tags") or []),
-            pr_id=obj.get("prId"),
-            event_id=obj.get("eventId"),
+            pr_id=opt_str("prId"),
+            event_id=opt_str("eventId"),
             creation_time=parse_event_time(obj["creationTime"]) if obj.get("creationTime") else utcnow(),
         )
         validate_event(ev)
@@ -186,6 +202,13 @@ def validate_event(ev: Event) -> None:
     if (ev.target_entity_type is None) != (ev.target_entity_id is None):
         raise EventValidationError(
             "targetEntityType and targetEntityId must be both present or both absent"
+        )
+    if ev.target_entity_type == "" or ev.target_entity_id == "":
+        # "" is indistinguishable from None in every storage format
+        # (frames/docs have no distinct null) — programmatic inserts
+        # must pass None for "no target", or the backends diverge
+        raise EventValidationError(
+            "target entity fields must be None when absent, not empty strings"
         )
 
 

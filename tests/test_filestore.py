@@ -715,3 +715,99 @@ def test_universal_workflow_on_eventlog(tmp_path):
     finally:
         st.events.close()
         set_storage(None)
+
+
+class TestImportFuzzParity:
+    """Randomized check of the strict-narrower contract: for ANY line,
+    if the native path consumed it, the Python path must also accept
+    it and produce the same event fields; if Python rejects a line,
+    the native path must not have consumed it. Seeded → deterministic."""
+
+    def test_random_event_lines(self, store, tmp_path):
+        import io
+        import json as _json
+        import random
+
+        from predictionio_tpu.data.event import (Event,
+                                                  EventValidationError)
+        from predictionio_tpu.data.filestore import NativeEventLogStore
+        from predictionio_tpu.tools.export_import import import_events
+
+        rnd = random.Random(77)
+        names = ["rate", "buy", "$set", "$unset", "$delete", "e-x", "вид"]
+        ids = ["u1", "ü", "a b", 'q"t', "x\\y", "", "0", "日本", "a\tb"]
+        props_pool = [{}, {"rating": 4.5}, {"rating": "3"},
+                      {"rating": "bad"}, {"n": {"d": [1, None]}},
+                      {"s": 'esc"\\'}, {"rating": True}]
+        times = ["2026-01-02T03:04:05Z", "2026-01-02T03:04:05.123Z",
+                 "2026-13-01T00:00:00Z", "2026-02-30T00:00:00Z",
+                 "2026-01-02 03:04:05", "bogus", "2026-01-02T03:04:05+0230",
+                 "2026-01-02T03:04:05.123456-08:00", None]
+        lines = []
+        for k in range(400):
+            d = {"event": rnd.choice(names),
+                 "entityType": rnd.choice(["user", "item", ""]),
+                 "entityId": rnd.choice(ids)}
+            if rnd.random() < 0.7:
+                d["targetEntityType"] = rnd.choice(["item", ""])
+                d["targetEntityId"] = rnd.choice(ids)
+            elif rnd.random() < 0.2:
+                d["targetEntityId"] = "half"   # one-sided
+            if rnd.random() < 0.6:
+                d["properties"] = rnd.choice(props_pool)
+            t = rnd.choice(times)
+            if t is not None:
+                d["eventTime"] = t
+            if rnd.random() < 0.3:
+                d["prId"] = rnd.choice(["pr-1", "", 5, "ü"])
+            if rnd.random() < 0.2:
+                d["eventId"] = rnd.choice(
+                    ["deadbeefdeadbeefdeadbeefdeadbeef", "", 0, "short"])
+            if rnd.random() < 0.2:
+                d["tags"] = rnd.choice([[], ["a"], ["a", 'b"c']])
+            if rnd.random() < 0.15:
+                d["creationTime"] = rnd.choice(
+                    ["2026-01-01T00:00:00.500Z", "nope", ""])
+            if rnd.random() < 0.1:
+                d["bogus"] = 1
+            line = _json.dumps(d, ensure_ascii=rnd.random() < 0.5)
+            if rnd.random() < 0.05:
+                line = line + "garbage"          # corrupt some lines
+            lines.append((line, d))
+
+        for i, (line, d) in enumerate(lines):
+            s = NativeEventLogStore(str(tmp_path / f"fz{i}"))
+            try:
+                # what does Python say?
+                try:
+                    ref = Event.from_json(_json.loads(line))
+                except (ValueError, EventValidationError):
+                    ref = None
+                try:
+                    n = import_events(APP, io.StringIO(line + "\n"),
+                                      storage=type("S", (),
+                                                   {"events": s}))
+                except (ValueError, EventValidationError,
+                        _json.JSONDecodeError):
+                    n = -1  # import raised (must mean Python rejects)
+                if ref is None:
+                    assert n <= 0, (line, "native accepted what "
+                                          "Python rejects")
+                else:
+                    assert n == 1, (line, "both should accept")
+                    got = next(iter(s.find(APP)))
+                    assert got.event == ref.event, line
+                    assert got.entity_id == ref.entity_id, line
+                    assert got.target_entity_type == \
+                        ref.target_entity_type, line
+                    assert got.target_entity_id == \
+                        ref.target_entity_id, line
+                    assert got.properties == ref.properties, line
+                    assert got.tags == ref.tags, line
+                    assert got.pr_id == ref.pr_id, line
+                    if "eventTime" in d:
+                        assert got.event_time == ref.event_time, line
+                    if d.get("creationTime"):
+                        assert got.creation_time == ref.creation_time, line
+            finally:
+                s.close()
