@@ -1,0 +1,28 @@
+"""predictionio_tpu — a TPU-native machine-learning server.
+
+A ground-up reimplementation of the capability surface of Apache
+PredictionIO (the reference, ``machinelearn/PredictionIO``): the DASE
+engine contract (DataSource / Algorithm / Serving / Evaluation), an
+event-ingestion REST server with apps, access keys, channels and
+webhooks, a ``pio``-style CLI, pluggable event/meta/model storage, and
+low-latency query serving — with the Spark/MLlib compute substrate
+replaced by JAX/XLA on TPU (pjit + shard_map over a device mesh, ICI
+collectives instead of shuffle, Pallas kernels for the hot ops).
+
+Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
+
+- ``data``       — event model + event stores (reference: data/src/.../data/storage, [U] unverified)
+- ``storage``    — meta + model stores and the backend registry
+- ``controller`` — the user-facing DASE API (reference: core/.../controller)
+- ``core``       — train/eval workflow orchestration (reference: core/.../workflow)
+- ``models``     — JAX implementations of the algorithm library (reference: Spark MLlib)
+- ``ops``        — TPU kernels and numeric helpers (segment ops, batched PSD solves, top-k)
+- ``parallel``   — mesh construction, shardings, multi-host init (reference: Spark scheduler/shuffle)
+- ``server``     — event server (:7070) and engine server (:8000)
+- ``tools``      — the ``pio`` CLI, export/import, dashboard
+- ``templates``  — built-in engine templates (reference: examples/scala-parallel-*)
+"""
+
+from predictionio_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,64 @@
+"""The DASE controller API — what engine templates program against.
+
+Equivalent of the reference's controller package (reference: [U]
+core/src/main/scala/org/apache/predictionio/controller/ — unverified,
+SURVEY.md §2a). The reference split every role three ways (P / P2L / L)
+because Spark forced a distinction between RDD-valued and local-valued
+stages; here there is a single spelling of each role with **P2L
+semantics**: data flows in as host-side Python/numpy structures, an
+Algorithm's ``train`` returns a *local* model (ideally a pytree of
+jax.Arrays living in HBM), and ``predict`` is a local call suitable for
+a resident serving process. Distribution happens *inside* ``train`` via
+the mesh in :class:`WorkflowContext`, not by typing the stages
+differently.
+"""
+
+from predictionio_tpu.controller.base import (
+    Params,
+    WorkflowContext,
+    params_from_json,
+    params_to_json,
+)
+from predictionio_tpu.controller.components import (
+    Algorithm,
+    DataSource,
+    FirstServing,
+    IdentityPreparator,
+    Preparator,
+    Serving,
+)
+from predictionio_tpu.controller.engine import Engine, EngineFactory, EngineParams
+from predictionio_tpu.controller.evaluation import (
+    AverageMetric,
+    Evaluation,
+    EngineParamsGenerator,
+    Metric,
+    MetricEvaluator,
+    OptionAverageMetric,
+    SumMetric,
+    ZeroMetric,
+)
+
+__all__ = [
+    "Params",
+    "WorkflowContext",
+    "params_from_json",
+    "params_to_json",
+    "DataSource",
+    "Preparator",
+    "IdentityPreparator",
+    "Algorithm",
+    "Serving",
+    "FirstServing",
+    "Engine",
+    "EngineFactory",
+    "EngineParams",
+    "Evaluation",
+    "EngineParamsGenerator",
+    "Metric",
+    "AverageMetric",
+    "OptionAverageMetric",
+    "SumMetric",
+    "ZeroMetric",
+    "MetricEvaluator",
+]

@@ -1,0 +1,125 @@
+"""Params plumbing + the workflow context.
+
+``Params`` replaces the reference's ``Params`` marker trait +
+``JsonExtractor`` (reference: [U] core/.../controller/Params.scala,
+core/.../workflow/JsonExtractor.scala — unverified): template parameter
+classes are plain dataclasses; :func:`params_from_json` builds one from
+an ``engine.json`` params block, accepting both snake_case and the
+reference's camelCase key spellings (and ``lambda`` for ``lambda_``,
+since the reference's ALS template uses the raw word).
+
+``WorkflowContext`` replaces ``SparkContext`` as the thing handed to
+every DASE stage: it carries the device mesh (or None for single-device
+runs), the storage handle, and workflow options — the TPU-run analogue
+of the reference's ``WorkflowContext``/``WorkflowParams``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from dataclasses import dataclass, field, is_dataclass
+from typing import Any, Dict, Optional, Type, TypeVar
+
+from predictionio_tpu.storage.registry import Storage, get_storage
+
+
+class Params:
+    """Marker base for template parameter dataclasses (optional — any
+    dataclass works)."""
+
+
+P = TypeVar("P")
+
+_CAMEL_RE = re.compile(r"(?<!^)(?=[A-Z])")
+
+
+def _snake(name: str) -> str:
+    return _CAMEL_RE.sub("_", name).lower()
+
+
+def params_from_json(cls: Type[P], obj: Optional[Dict[str, Any]]) -> P:
+    """Instantiate a params dataclass from a JSON dict.
+
+    Key resolution order: exact field name → camelCase→snake_case
+    normalization → trailing-underscore escape for Python keywords
+    (``lambda`` → ``lambda_``). Unknown keys raise, mirroring the strict
+    mode of the reference's JsonExtractor.
+    """
+    obj = obj or {}
+    if not is_dataclass(cls):
+        # tolerate templates using plain dicts for params
+        if cls in (dict, Dict):  # type: ignore[comparison-overlap]
+            return dict(obj)  # type: ignore[return-value]
+        return cls(**obj)  # type: ignore[call-arg]
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs: Dict[str, Any] = {}
+    for key, value in obj.items():
+        cand = None
+        if key in fields:
+            cand = key
+        else:
+            sk = _snake(key)
+            if sk in fields:
+                cand = sk
+            elif sk + "_" in fields:  # e.g. lambda -> lambda_
+                cand = sk + "_"
+        if cand is None:
+            raise ValueError(
+                f"unknown parameter {key!r} for {cls.__name__}; "
+                f"known: {sorted(fields)}")
+        kwargs[cand] = value
+    return cls(**kwargs)  # type: ignore[call-arg]
+
+
+def params_to_json(params: Any) -> Dict[str, Any]:
+    if params is None:
+        return {}
+    if is_dataclass(params) and not isinstance(params, type):
+        return dataclasses.asdict(params)
+    if isinstance(params, dict):
+        return dict(params)
+    raise TypeError(f"cannot serialize params of type {type(params).__name__}")
+
+
+@dataclass
+class WorkflowContext:
+    """Carried through every DASE stage (the SparkContext analogue).
+
+    ``mesh`` is a ``jax.sharding.Mesh`` (or None → single device / auto).
+    Algorithms decide how to lay out arrays over it; stages that don't
+    touch devices ignore it. ``storage`` gives data sources and
+    serving-time business rules access to the event/meta/model repos.
+    """
+
+    storage: Storage = field(default_factory=get_storage)
+    mesh: Optional[Any] = None  # jax.sharding.Mesh; Any to keep jax import lazy
+    batch: str = ""
+    verbose: int = 0
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+    # per-phase wall-clock seconds, filled by Engine.train/eval
+    # (SURVEY.md §5 "per-phase timing log")
+    timings: Dict[str, float] = field(default_factory=dict)
+    instance_id: str = ""
+    # mid-train checkpoint/resume (SURVEY.md §5): run_train points this
+    # at a per-(factory, variant) directory; iterative algorithms ask
+    # for a named sub-checkpointer and save every N steps. On --resume
+    # the directory is kept and restore-latest continues the run.
+    checkpoint_dir: Optional[str] = None
+
+    def log(self, msg: str) -> None:
+        if self.verbose:
+            print(f"[workflow {self.instance_id or '-'}] {msg}", flush=True)
+
+    def checkpointer(self, name: str):
+        """A TrainCheckpointer under ``checkpoint_dir/name`` (None when
+        checkpointing is off for this run)."""
+        if not self.checkpoint_dir:
+            return None
+        import os
+
+        from predictionio_tpu.utils.checkpoint import TrainCheckpointer
+
+        return TrainCheckpointer(os.path.join(self.checkpoint_dir, name))

@@ -1,0 +1,8 @@
+from predictionio_tpu.core.workflow import (
+    run_train,
+    run_evaluation,
+    prepare_deploy,
+    DeployedEngine,
+)
+
+__all__ = ["run_train", "run_evaluation", "prepare_deploy", "DeployedEngine"]

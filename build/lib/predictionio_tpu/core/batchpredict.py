@@ -1,0 +1,49 @@
+"""Batch prediction: queries JSONL → predictions JSONL.
+
+Reference: [U] core/.../workflow/BatchPredict.scala (spark-submit main
+reading/writing text files through broadcast models; unverified,
+SURVEY.md §3.5). Here the deployed model is already resident; queries
+stream through ``DeployedEngine.batch_query`` in fixed-size batches so
+algorithms that override ``batch_predict`` can score a whole batch on
+device per dispatch.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, TextIO
+
+from predictionio_tpu.core.workflow import DeployedEngine, prepare_deploy
+from predictionio_tpu.storage.registry import Storage
+
+BATCH = 1024
+
+
+def run_batch_predict(
+    deployed: DeployedEngine,
+    src: TextIO,
+    out: TextIO,
+    batch_size: int = BATCH,
+) -> int:
+    n = 0
+    batch = []
+
+    def flush() -> None:
+        nonlocal n
+        if not batch:
+            return
+        for q, p in zip(batch, deployed.batch_query(batch)):
+            out.write(json.dumps({"query": q, "prediction": p},
+                                 separators=(",", ":")) + "\n")
+        n += len(batch)
+        batch.clear()
+
+    for line in src:
+        line = line.strip()
+        if not line:
+            continue
+        batch.append(json.loads(line))
+        if len(batch) >= batch_size:
+            flush()
+    flush()
+    return n

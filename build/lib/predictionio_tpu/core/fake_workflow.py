@@ -1,0 +1,58 @@
+"""FakeWorkflow: run an arbitrary function through the workflow shell.
+
+Reference: [U] core/.../workflow/FakeWorkflow.scala (unverified,
+SURVEY.md §2a) — lets tests and evaluation tricks execute a bare
+``SparkContext ⇒ Unit`` with the full workflow bracketing (instance row,
+status transitions, context construction) but no DASE components. Here
+the function takes the :class:`WorkflowContext` (mesh + storage), and
+the run is recorded as an EngineInstance with factory "fake" so the
+meta-store lifecycle is exercised identically to a real train.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any, Callable, Optional
+
+from predictionio_tpu.controller.base import WorkflowContext
+from predictionio_tpu.data.event import utcnow
+from predictionio_tpu.parallel.mesh import MeshConfig, make_mesh
+from predictionio_tpu.storage.meta import EngineInstance
+from predictionio_tpu.storage.registry import Storage, get_storage
+
+
+def fake_run(
+    fn: Callable[[WorkflowContext], Any],
+    storage: Optional[Storage] = None,
+    use_mesh: bool = False,
+    verbose: int = 0,
+    label: str = "fake",
+) -> Any:
+    """Execute ``fn(ctx)`` under workflow bracketing; returns its result.
+    The EngineInstance row ends COMPLETED or FAILED like a real train."""
+    storage = storage or get_storage()
+    instance_id = storage.meta.new_instance_id()
+    ei = EngineInstance(
+        id=instance_id, status="INIT", start_time=utcnow(), end_time=None,
+        engine_factory=f"fake:{label}", engine_variant="", batch=label,
+        env={}, mesh_conf={}, data_source_params="{}",
+        preparator_params="{}", algorithms_params="[]", serving_params="{}",
+    )
+    storage.meta.insert_engine_instance(ei)
+    mesh = make_mesh(MeshConfig()) if use_mesh else None
+    ctx = WorkflowContext(storage=storage, mesh=mesh, verbose=verbose,
+                          instance_id=instance_id)
+    try:
+        ei.status = "TRAINING"
+        storage.meta.update_engine_instance(ei)
+        result = fn(ctx)
+        ei.status = "COMPLETED"
+        ei.end_time = utcnow()
+        storage.meta.update_engine_instance(ei)
+        return result
+    except Exception:
+        ei.status = "FAILED"
+        ei.end_time = utcnow()
+        storage.meta.update_engine_instance(ei)
+        traceback.print_exc()
+        raise

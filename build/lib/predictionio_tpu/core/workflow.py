@@ -1,0 +1,309 @@
+"""Train / evaluation / deploy workflows.
+
+Equivalent of the reference's CreateWorkflow + CoreWorkflow +
+CreateServer.prepareDeploy (reference: [U] core/.../workflow/
+{CreateWorkflow,CoreWorkflow,CreateServer}.scala — unverified, SURVEY.md
+§3.1–3.2), minus the process gymnastics: where the reference execs
+``spark-submit`` and stands up a SparkContext, we build a
+:class:`WorkflowContext` with a device mesh in-process.
+
+Train lifecycle (meta-store contract preserved):
+INIT row → TRAINING → engine.train → persist per-algorithm models →
+COMPLETED (or FAILED). Deploy loads the latest COMPLETED instance for
+(engine_factory, variant-id).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import traceback
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.controller.base import WorkflowContext, params_to_json
+from predictionio_tpu.controller.engine import (
+    Engine,
+    EngineFactory,
+    EngineParams,
+    load_variant,
+)
+from predictionio_tpu.controller.evaluation import Evaluation, MetricEvaluatorResult
+from predictionio_tpu.data.event import utcnow
+from predictionio_tpu.parallel.mesh import MeshConfig, make_mesh
+from predictionio_tpu.storage.meta import EngineInstance, EvaluationInstance
+from predictionio_tpu.storage.registry import Storage, get_storage
+
+
+def _algorithms_params_json(engine_params: EngineParams) -> str:
+    return json.dumps([
+        {"name": n, "params": params_to_json(p)}
+        for n, p in engine_params.algorithms_params
+    ])
+
+
+def _build_context(
+    storage: Storage,
+    mesh_conf: Optional[Dict[str, Any]],
+    verbose: int,
+    instance_id: str,
+    use_mesh: bool,
+    checkpoint_dir: Optional[str] = None,
+) -> WorkflowContext:
+    mesh = None
+    if use_mesh:
+        mesh = make_mesh(MeshConfig.from_json(mesh_conf))
+    return WorkflowContext(
+        storage=storage, mesh=mesh, verbose=verbose, instance_id=instance_id,
+        checkpoint_dir=checkpoint_dir,
+    )
+
+
+def _ckpt_root(storage: Storage, engine_factory: str, variant_id: str) -> str:
+    safe = "".join(ch if ch.isalnum() else "_"
+                   for ch in f"{engine_factory}_{variant_id}")
+    return os.path.join(storage.config.home, "train_ckpt", safe)
+
+
+def run_train(
+    engine_factory: str,
+    variant: Optional[Dict[str, Any]] = None,
+    variant_path: Optional[str] = None,
+    engine_params: Optional[EngineParams] = None,
+    storage: Optional[Storage] = None,
+    verbose: int = 0,
+    use_mesh: bool = True,
+    batch: str = "",
+    resume: bool = False,
+) -> str:
+    """Train and persist one engine instance; returns its id.
+
+    Exactly one of ``variant``/``variant_path``/``engine_params`` supplies
+    parameters (variant = parsed engine.json dict). ``resume=True``
+    (``pio train --resume``) keeps the per-(factory, variant) checkpoint
+    directory from an interrupted run so iterative trainers restore the
+    latest mid-train checkpoint and continue; by default a fresh run
+    clears it (SURVEY.md §5 checkpoint/resume).
+    """
+    from predictionio_tpu.parallel import distributed
+    from predictionio_tpu.utils import compilecache
+
+    compilecache.enable()
+
+    # Multi-host (SURVEY.md §2d P5): when the PIO_* rendezvous vars are
+    # set (or a Cloud-TPU slice announces itself), every host runs this
+    # same function in lockstep — jax.distributed rendezvous here, the
+    # coordinator mints the instance id and owns all meta/model writes,
+    # barriers keep hosts aligned around training.
+    multi = distributed.initialize()
+    coord = distributed.is_coordinator()
+
+    storage = storage or get_storage()
+    engine = EngineFactory.create(engine_factory)
+    if variant_path is not None:
+        variant = load_variant(variant_path)
+    variant = variant or {}
+    if engine_params is None:
+        engine_params = engine.params_from_variant(variant)
+
+    instance_id = storage.meta.new_instance_id() if coord else ""
+    if multi:
+        instance_id = distributed.broadcast_string(instance_id)
+    mesh_conf = variant.get("meshConf") or variant.get("sparkConf") or {}
+    ei = EngineInstance(
+        id=instance_id,
+        status="INIT",
+        start_time=utcnow(),
+        end_time=None,
+        engine_factory=engine_factory,
+        engine_variant=str(variant.get("id", "")),
+        batch=batch or str(variant.get("description", "")),
+        env={},
+        mesh_conf=mesh_conf,
+        data_source_params=json.dumps(params_to_json(engine_params.data_source_params)),
+        preparator_params=json.dumps(params_to_json(engine_params.preparator_params)),
+        algorithms_params=_algorithms_params_json(engine_params),
+        serving_params=json.dumps(params_to_json(engine_params.serving_params)),
+    )
+    if coord:
+        storage.meta.insert_engine_instance(ei)
+    ckpt_root = _ckpt_root(storage, engine_factory, ei.engine_variant)
+    if coord and not resume:
+        shutil.rmtree(ckpt_root, ignore_errors=True)
+    if multi:
+        distributed.barrier("pio_ckpt_ready")
+    ctx = _build_context(storage, mesh_conf, verbose, instance_id, use_mesh,
+                         checkpoint_dir=ckpt_root)
+    try:
+        ei.status = "TRAINING"
+        if coord:
+            storage.meta.update_engine_instance(ei)
+        # tracing hook (SURVEY.md §5): PIO_PROFILE_DIR=<dir> wraps the
+        # train in a JAX profiler trace (xplane → Perfetto/TensorBoard)
+        profile_dir = os.environ.get("PIO_PROFILE_DIR")
+        if profile_dir:
+            import jax
+
+            with jax.profiler.trace(profile_dir):
+                models = engine.train(ctx, engine_params)
+        else:
+            models = engine.train(ctx, engine_params)
+        if ctx.timings:
+            phases = ", ".join(f"{k}={v:.3f}s"
+                               for k, v in ctx.timings.items())
+            ctx.log(f"train phases: {phases}")
+        if multi:
+            distributed.barrier("pio_train_done")
+
+        # persist per-algorithm models (coordinator only under multi-host:
+        # the trained arrays are replicated, one writer suffices)
+        if coord:
+            instance_dir = storage.models.model_dir(instance_id)
+            blobs: List[Optional[bytes]] = []
+            for (name, algo), model in zip(
+                    engine.make_algorithms(engine_params), models):
+                algo_dir = None
+                if instance_dir is not None:
+                    algo_dir = os.path.join(instance_dir, name)
+                    os.makedirs(algo_dir, exist_ok=True)
+                blobs.append(algo.save_model(model, algo_dir))
+            storage.models.put(instance_id, pickle.dumps(blobs))
+
+            ei.status = "COMPLETED"
+            ei.end_time = utcnow()
+            storage.meta.update_engine_instance(ei)
+            # the run completed: its mid-train checkpoints are consumed
+            shutil.rmtree(ckpt_root, ignore_errors=True)
+        if multi:
+            distributed.barrier("pio_persist_done")
+        return instance_id
+    except Exception:
+        ei.status = "FAILED"
+        ei.end_time = utcnow()
+        if coord:
+            storage.meta.update_engine_instance(ei)
+        traceback.print_exc()
+        raise
+
+
+@dataclass
+class DeployedEngine:
+    """A trained engine loaded for serving: the resident-model bundle."""
+
+    engine: Engine
+    engine_params: EngineParams
+    algorithms: List[Tuple[str, Any]]  # (name, Algorithm instance)
+    models: List[Any]
+    serving: Any
+    instance: EngineInstance
+
+    def query(self, query: Any) -> Any:
+        q = self.serving.supplement(query)
+        preds = [algo.predict(model, q)
+                 for (_, algo), model in zip(self.algorithms, self.models)]
+        return self.serving.serve(q, preds)
+
+    def batch_query(self, queries: Sequence[Any]) -> List[Any]:
+        qs = [self.serving.supplement(q) for q in queries]
+        per_algo = [algo.batch_predict(model, qs)
+                    for (_, algo), model in zip(self.algorithms, self.models)]
+        return [
+            self.serving.serve(q, [preds[i] for preds in per_algo])
+            for i, q in enumerate(qs)
+        ]
+
+
+def prepare_deploy(
+    engine_factory: Optional[str] = None,
+    instance_id: Optional[str] = None,
+    storage: Optional[Storage] = None,
+    variant_id: str = "",
+) -> DeployedEngine:
+    """Load the latest COMPLETED instance (or a specific one) for serving
+    (reference: CreateServer / engine.prepareDeploy, SURVEY.md §3.2)."""
+    from predictionio_tpu.utils import compilecache
+
+    compilecache.enable()
+    storage = storage or get_storage()
+    if instance_id is not None:
+        ei = storage.meta.get_engine_instance(instance_id)
+        if ei is None:
+            raise ValueError(f"engine instance {instance_id!r} not found")
+    else:
+        if engine_factory is None:
+            raise ValueError("need engine_factory or instance_id")
+        ei = storage.meta.get_latest_completed_engine_instance(engine_factory, variant_id)
+        if ei is None:
+            raise ValueError(
+                f"no COMPLETED engine instance for {engine_factory!r}; "
+                "run `pio train` first")
+
+    engine = EngineFactory.create(ei.engine_factory)
+    # Rebuild EngineParams from the instance's recorded JSON
+    variant = {
+        "datasource": {"params": json.loads(ei.data_source_params)},
+        "preparator": {"params": json.loads(ei.preparator_params)},
+        "algorithms": json.loads(ei.algorithms_params),
+        "serving": {"params": json.loads(ei.serving_params)},
+    }
+    engine_params = engine.params_from_variant(variant)
+    algorithms = engine.make_algorithms(engine_params)
+
+    raw = storage.models.get(ei.id)
+    if raw is None:
+        raise ValueError(f"no model blob for instance {ei.id}")
+    blobs: List[Optional[bytes]] = pickle.loads(raw)
+    instance_dir = storage.models.model_dir(ei.id)
+    models = []
+    for (name, algo), blob in zip(algorithms, blobs):
+        algo_dir = os.path.join(instance_dir, name) if instance_dir else None
+        algo.set_serving_context(storage)
+        models.append(algo.load_model(blob, algo_dir))
+    serving = engine.serving_cls(engine_params.serving_params)
+    return DeployedEngine(
+        engine=engine, engine_params=engine_params, algorithms=algorithms,
+        models=models, serving=serving, instance=ei)
+
+
+def run_evaluation(
+    evaluation: Evaluation,
+    candidates: Sequence[EngineParams],
+    storage: Optional[Storage] = None,
+    verbose: int = 0,
+    use_mesh: bool = True,
+    evaluation_class: str = "",
+    generator_class: str = "",
+) -> Tuple[str, MetricEvaluatorResult]:
+    """Grid-search evaluation; persists an EvaluationInstance row the
+    dashboard renders (reference: EvaluationWorkflow, SURVEY.md §3.4)."""
+    from predictionio_tpu.utils import compilecache
+
+    compilecache.enable()
+    storage = storage or get_storage()
+    instance_id = storage.meta.new_instance_id()
+    vi = EvaluationInstance(
+        id=instance_id, status="EVALUATING", start_time=utcnow(), end_time=None,
+        evaluation_class=evaluation_class or type(evaluation).__name__,
+        engine_params_generator_class=generator_class,
+        batch="", env={},
+    )
+    storage.meta.insert_evaluation_instance(vi)
+    ctx = _build_context(storage, None, verbose, instance_id, use_mesh)
+    try:
+        result = evaluation.run(ctx, candidates)
+        assert evaluation.metric is not None
+        vi.status = "EVALCOMPLETED"
+        vi.end_time = utcnow()
+        vi.evaluator_results = (
+            f"best {evaluation.metric.header} = {result.best_score:.6f} "
+            f"(candidate {result.best_index} of {len(result.candidates)})")
+        vi.evaluator_results_json = result.to_json()
+        storage.meta.update_evaluation_instance(vi)
+        return instance_id, result
+    except Exception:
+        vi.status = "FAILED"
+        vi.end_time = utcnow()
+        storage.meta.update_evaluation_instance(vi)
+        raise

@@ -1,0 +1,15 @@
+from predictionio_tpu.data.event import (
+    Event,
+    PropertyMap,
+    aggregate_properties,
+    validate_event,
+    RESERVED_EVENTS,
+)
+
+__all__ = [
+    "Event",
+    "PropertyMap",
+    "aggregate_properties",
+    "validate_event",
+    "RESERVED_EVENTS",
+]

@@ -1,0 +1,26 @@
+"""e2 — engine-helper library (reference: [U] e2/src/main/scala/org/
+apache/predictionio/e2/engine/, unverified, SURVEY.md §2a).
+
+Pure helper models usable from any engine template without the full
+DASE machinery: a categorical Naive Bayes over string features, a
+Markov-chain transition model, and an external-process engine bridge
+(the inverse of the reference's ``PythonEngine``: there, a JVM framework
+shells out to Python; here, a Python framework shells out to anything).
+"""
+
+from predictionio_tpu.e2.external import ExternalAlgorithm
+from predictionio_tpu.e2.markov import MarkovChainModel, markov_chain_train
+from predictionio_tpu.e2.naivebayes import (
+    CategoricalNaiveBayesModel,
+    LabeledPoint,
+    categorical_naive_bayes_train,
+)
+
+__all__ = [
+    "LabeledPoint",
+    "CategoricalNaiveBayesModel",
+    "categorical_naive_bayes_train",
+    "MarkovChainModel",
+    "markov_chain_train",
+    "ExternalAlgorithm",
+]

@@ -1,0 +1,72 @@
+"""First-order Markov chain over an integer state space.
+
+Reference: [U] e2/.../engine/MarkovChain.scala (unverified, SURVEY.md
+§2a) — builds row-normalized transition probabilities from a sparse
+count matrix and answers "top-K most likely next states".
+
+TPU mapping: transition counting is a segment-sum over flattened
+(from, to) pairs (``ops.segment.segment_sum``), normalization and the
+top-K scan are jitted; the model keeps the dense (S, S) transition
+matrix resident as a jax.Array when S is modest (item-to-item
+navigation graphs), with a host dict fallback for very large sparse
+spaces left to callers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.ops.segment import segment_sum
+
+
+@dataclass
+class MarkovChainModel:
+    """Row-stochastic transition matrix (rows with no observations are
+    all-zero, matching the reference's sparse behavior)."""
+
+    transitions: np.ndarray  # (S, S) float32
+    n_states: int
+
+    def transition_prob(self, from_state: int, to_state: int) -> float:
+        return float(self.transitions[from_state, to_state])
+
+    def predict_top_k(self, from_state: int, k: int) -> List[Tuple[int, float]]:
+        """Top-K next states by probability (reference: MarkovChain
+        top-K). Host-side numpy: a single (S,) row's top-k is µs work —
+        a device dispatch per serving call would dominate it."""
+        row = self.transitions[from_state]
+        k = min(k, self.n_states)
+        idx = np.argpartition(-row, k - 1)[:k]
+        idx = idx[np.argsort(-row[idx], kind="stable")]
+        return [(int(i), float(row[i])) for i in idx if row[i] > 0.0]
+
+
+def markov_chain_train(
+    pairs: Sequence[Tuple[int, int]], n_states: int,
+) -> MarkovChainModel:
+    """Count (from, to) transitions and row-normalize."""
+    import jax.numpy as jnp
+
+    if n_states <= 0:
+        raise ValueError("n_states must be positive")
+    if n_states > 46_340:
+        # S*S must fit int32 (JAX x32 mode) — and a dense (S, S) f32
+        # matrix past this point is >8 GB anyway; shard or sparsify
+        # externally for larger state spaces
+        raise ValueError(
+            f"n_states={n_states} too large for the dense transition "
+            "matrix (max 46340)")
+    arr = np.asarray(pairs, np.int32).reshape(-1, 2)
+    if arr.size and (arr.min() < 0 or arr.max() >= n_states):
+        raise ValueError("state id out of range")
+    flat = arr[:, 0].astype(np.int32) * n_states + arr[:, 1]
+    counts = segment_sum(
+        jnp.ones((len(flat),), jnp.float32), jnp.asarray(flat),
+        n_states * n_states,
+    ).reshape(n_states, n_states)
+    row_tot = counts.sum(axis=1, keepdims=True)
+    probs = jnp.where(row_tot > 0, counts / jnp.maximum(row_tot, 1.0), 0.0)
+    return MarkovChainModel(np.asarray(probs, np.float32), n_states)

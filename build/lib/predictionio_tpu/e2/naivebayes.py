@@ -1,0 +1,135 @@
+"""Categorical Naive Bayes over string-valued features.
+
+Reference: [U] e2/.../engine/CategoricalNaiveBayes.scala (unverified,
+SURVEY.md §2a) — trains from ``LabeledPoint(label, features:
+Array[String])`` where feature *position* is the variable and the string
+is its category; the model exposes per-label priors and per-(position,
+value) likelihoods, a ``logScore`` with a pluggable default for unseen
+values, and ``predict`` = argmax label.
+
+TPU mapping: after host-side vocabulary indexing (BiMap per position),
+the count aggregation — one (n, C) one-hot ``Yᵀ`` against a per-position
+(n, Vp) one-hot — is a batched MXU matmul, the same shape of compute as
+:mod:`predictionio_tpu.models.naive_bayes` but per feature position.
+Vocabularies are small; scoring stays host-side numpy for O(µs) serving.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.utils.bimap import BiMap
+
+
+@dataclass
+class LabeledPoint:
+    """A training example: string label + positional string features."""
+
+    label: str
+    features: Sequence[str]
+
+
+@dataclass
+class CategoricalNaiveBayesModel:
+    """priors[label] = log P(label); likelihoods[label][pos][value] =
+    log P(value at pos | label)."""
+
+    priors: Dict[str, float]
+    likelihoods: Dict[str, List[Dict[str, float]]]
+    #: per-position smoothing floor used for values never seen with a label
+    min_log_likelihood: Dict[str, List[float]] = field(default_factory=dict)
+
+    def log_score(
+        self,
+        point: LabeledPoint,
+        default_likelihood: Optional[Callable[[List[float]], float]] = None,
+    ) -> Optional[float]:
+        """Log joint score of ``point`` under its label, or None if the
+        label is unknown. ``default_likelihood`` maps the position's
+        known log-likelihood values to a score for an unseen value
+        (reference default: -inf → None propagation; ours returns the
+        smoothed floor unless overridden)."""
+        if point.label not in self.priors:
+            return None
+        pos_tables = self.likelihoods[point.label]
+        total = self.priors[point.label]
+        for pos, value in enumerate(point.features):
+            table = pos_tables[pos]
+            if value in table:
+                total += table[value]
+            elif default_likelihood is not None:
+                total += default_likelihood(list(table.values()))
+            else:
+                total += self.min_log_likelihood[point.label][pos]
+        return total
+
+    def predict(self, features: Sequence[str]) -> str:
+        """argmax over labels of log_score (reference: predict)."""
+        best_label, best = "", -math.inf
+        for label in self.priors:
+            score = self.log_score(LabeledPoint(label, features))
+            if score is not None and score > best:
+                best_label, best = label, score
+        return best_label
+
+
+def categorical_naive_bayes_train(
+    points: Sequence[LabeledPoint], smoothing: float = 1.0,
+) -> CategoricalNaiveBayesModel:
+    """Count-and-normalize with additive smoothing.
+
+    The per-position count matrices are computed as one-hot matmuls on
+    the accelerator (MXU-friendly); tables are then pulled host-side
+    into dicts for serving.
+    """
+    if not points:
+        raise ValueError("categorical_naive_bayes_train: no training points")
+    n_pos = len(points[0].features)
+    for p in points:
+        if len(p.features) != n_pos:
+            raise ValueError("all points must have the same number of features")
+
+    labels = BiMap.string_int(sorted({p.label for p in points}))
+    pos_vocabs = [
+        BiMap.string_int(sorted({p.features[i] for p in points}))
+        for i in range(n_pos)
+    ]
+    y = np.asarray([labels[p.label] for p in points], np.int32)
+    C = len(labels.keys())
+
+    import jax
+    import jax.numpy as jnp
+
+    Y = jax.nn.one_hot(jnp.asarray(y), C, dtype=jnp.float32)  # (n, C)
+    label_counts = np.asarray(Y.sum(axis=0))                   # (C,)
+
+    count_mats: List[np.ndarray] = []
+    for i, vocab in enumerate(pos_vocabs):
+        xi = np.asarray([vocab[p.features[i]] for p in points], np.int32)
+        Xi = jax.nn.one_hot(jnp.asarray(xi), len(vocab.keys()),
+                            dtype=jnp.float32)                 # (n, Vp)
+        count_mats.append(np.asarray(Y.T @ Xi))                # (C, Vp) matmul
+
+    n = float(len(points))
+    priors = {lab: math.log(label_counts[idx] / n)
+              for lab, idx in labels.to_dict().items()}
+    likelihoods: Dict[str, List[Dict[str, float]]] = {}
+    floors: Dict[str, List[float]] = {}
+    for lab, ci in labels.to_dict().items():
+        tables, lab_floors = [], []
+        for i, vocab in enumerate(pos_vocabs):
+            Vp = len(vocab.keys())
+            denom = label_counts[ci] + smoothing * Vp
+            table = {
+                val: math.log((count_mats[i][ci, vi] + smoothing) / denom)
+                for val, vi in vocab.to_dict().items()
+            }
+            tables.append(table)
+            lab_floors.append(math.log(smoothing / denom))
+        likelihoods[lab] = tables
+        floors[lab] = lab_floors
+    return CategoricalNaiveBayesModel(priors, likelihoods, floors)

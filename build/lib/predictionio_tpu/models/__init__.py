@@ -1,0 +1,8 @@
+"""JAX implementations of the algorithm library.
+
+These replace Spark MLlib in the reference (reference: MLlib ALS /
+LogisticRegressionWithLBFGS / NaiveBayes used by the engine templates,
+SURVEY.md §2c). Everything here is mesh-aware: pass a
+``jax.sharding.Mesh`` to shard the computation over devices with ICI
+collectives; pass None to run on one chip.
+"""

@@ -1,0 +1,1270 @@
+"""Alternating Least Squares matrix factorization on TPU.
+
+Replaces Spark MLlib's ALS (reference behavior: [U]
+org.apache.spark.mllib.recommendation.ALS used by the recommendation /
+similar-product / e-commerce templates; block-partitioned factor
+matrices, shuffle-joined rating blocks, per-row normal-equation Cholesky
+solves — SURVEY.md §2d P2). The TPU-first redesign:
+
+- Ratings are **bucketed by entity** — entities sorted by rating
+  count, each padded to a ladder width C (capped at 8K; heavier
+  entities are segmented across rows), and same-width entities batched
+  into dense ``(nb, C)`` blocks. This is the sparsity-to-MXU bridge:
+  each entity's normal equations ``A_e = Σ v vᵀ`` are ONE batch
+  element of a dense batched weighted Gram ``(C×k)ᵀdiag(w)(C×k)`` —
+  systolic-array work with **no scatter anywhere** (TPU scatter-add of
+  row partials measured ~40% of the iteration in the round-1
+  padded-row design).
+- The power-law HEAD goes denser still: entities with count ≥
+  n_other/14 (see ``_DENSE_RATIO``) skip gathering entirely — their
+  normal equations are plain GEMMs of dense per-entity weight rows
+  against the other side's factor outer products (the ~280 heaviest
+  ML-20M entities hold ~65% of padded slots, and their gathers
+  measured ~70% of the Gram phase at the ~140 GB/s XLA row-gather
+  ceiling).
+- Buckets stream through ``lax.scan`` in fixed-size slabs, emitting
+  ridged normal equations into ONE solve buffer; a single chunked scan
+  solves everything with one instance of the **block-recursive batched
+  Cholesky built from batched matmuls**
+  (:mod:`predictionio_tpu.ops.cholesky`) — replacing MLlib's per-row
+  LAPACK ``dppsv`` calls (~18× faster on TPU than XLA's sequential
+  ``cholesky`` lowering at ML-20M batch sizes, and a single Cholesky
+  graph instance keeps XLA compile bounded).
+- The whole training run (iterations × two half-steps) is ONE jitted
+  ``lax.scan``: no host round-trips. Layout construction
+  (:func:`als_prepare`) is a separate host-side step — the analogue of
+  MLlib's InBlock build — done once per dataset and reused.
+- With a mesh (:mod:`predictionio_tpu.models.als_sharded`): entities are
+  range-partitioned across devices, each device runs this same bucketed
+  program on its block, and one ``all_gather`` per half-step replaces
+  the reference's shuffle.
+
+Supports explicit feedback and implicit feedback (Hu-Koren-Volinsky
+confidence weighting, MLlib's ``trainImplicit`` analogue) and MLlib's
+weighted-λ regularization (λ scaled by each entity's rating count).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class RatingsCOO:
+    """Host-side ratings in COO form with dense entity indices."""
+
+    user_idx: np.ndarray  # int32 [nnz]
+    item_idx: np.ndarray  # int32 [nnz]
+    rating: np.ndarray    # float32 [nnz]
+    n_users: int
+    n_items: int
+
+    @property
+    def nnz(self) -> int:
+        return int(self.user_idx.shape[0])
+
+
+@dataclass
+class ALSParams:
+    rank: int = 10
+    iterations: int = 10
+    reg: float = 0.01          # MLlib's `lambda`
+    implicit: bool = False     # MLlib trainImplicit
+    alpha: float = 1.0         # implicit confidence scale
+    weighted_reg: bool = True  # ALS-WR: λ·n_e scaling (MLlib behavior)
+    seed: int = 0
+    # opt-in: gather factors in bfloat16 (halves the dominant HBM
+    # traffic — the gather measured ~140 GB/s effective and ~60% of
+    # device time); the Gram einsum accumulates f32. Costs ~1e-2
+    # relative factor error (measured) — fine for recommendation
+    # ranking, off by default for reference-grade numerics.
+    bf16_gather: bool = False
+
+
+
+
+
+def init_factors(n: int, rank: int, seed: int) -> np.ndarray:
+    """Deterministic host-side factor init shared by the single-device and
+    sharded paths (so their iterates are bitwise-comparable)."""
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((n, rank)) / np.sqrt(rank)).astype(np.float32)
+
+
+
+# -- bucketed layout ----------------------------------------------------------
+#
+# Round 1's padded-row layout paid one sorted scatter-add of ~nnz/W row
+# partials per half-step; TPU scatter measured ~140-200 ms per ML-20M
+# half-step — comparable to all the matmul work combined. Bucketing
+# entities by padded rating count instead makes each entity's normal
+# equations ONE batch element of a dense batched Gram — no scatter
+# anywhere. This is the "bucketed/padded rating blocks" design SURVEY.md
+# §7 anticipated. Entities live in count-descending permuted order
+# during training (so same-width entities are contiguous); factors are
+# un-permuted once at the end.
+
+_SLAB_ELEMS = int(os.environ.get("PIO_ALS_SLAB_ELEMS", str(1 << 20)))
+                        # slab_entities × width bound per scan step. The r5
+                        # trace showed the warm train latency-bound (~8.8k
+                        # device ops/iteration, HBM at 49 of 819 GB/s), so
+                        # bigger slabs = fewer, larger dispatches: 2^20
+                        # (~256 MB gather at k=64) measured 2.16 s vs 2.71 s
+                        # device-side for the ML-20M train against the r2-r4
+                        # 2^18 default (profile_als.py --tune on the v5e).
+                        # Env-tunable; layout parity across slab sizes is
+                        # tested (test_als.py::test_slab_size_parity).
+
+# Allowed padded widths. Round 2 used every power of two up to the
+# heaviest entity's count (8.4M!): 38 buckets across both sides, each
+# inlining its own copy of the solve — 219k lines of StableHLO, 111 s
+# of tracing + 291 s of XLA compile at ML-20M geometry — and the
+# super-C_MAX buckets alone held ~25M padded slots (more than nnz).
+# A ×4 ladder capped at 8 K bounds the program at ≤7 buckets per side;
+# entities heavier than the cap are segmented across rows instead
+# (see _bucket_side), which is also strictly less gather work.
+_LADDER = (8, 32, 128, 512, 2048, 8192)
+_C_MAX = _LADDER[-1]
+
+# Solve-pass shape: normal equations from every bucket are written into
+# one (N, k, k) device buffer and solved by a single lax.scan in chunks
+# of this many systems — so the whole program contains exactly ONE
+# instance of the block-recursive Cholesky graph. Solving inside each
+# bucket body (round 2) inlined that graph 38× → 219k lines of HLO and
+# 258 s of XLA compile. The buffer costs N·k²·4 bytes (2.7 GB at
+# ML-20M, k=64); catalogs where it would exceed the cap below fall back
+# to in-body solves (memory flat, compile slower, persistent cache
+# amortizes).
+_SOLVE_CHUNK = int(os.environ.get("PIO_ALS_SOLVE_CHUNK", "4096"))
+_SOLVE_BUF_MB = int(os.environ.get("PIO_ALS_SOLVE_BUF_MB", "4096"))
+
+# Dense-head crossover. The heaviest entities dominate padded slots
+# under a power law (ML-20M shape: the >8K-rating "seg" entities are
+# ~280 of 165K yet hold ~65% of all padded slots, and their gathers
+# measured ~70% of the whole Gram phase at ~140 GB/s effective — the
+# XLA row-gather ceiling). For an entity with C rating slots the
+# gather-path cost is ~C·256B at that ceiling, while a DENSE weight
+# row over the whole other side costs ~n_other·k(k+1) MXU flops via
+# one GEMM against the other side's factor outer products (no gather
+# at all). Measured crossover on v5e: C ≳ n_other/14. Entities above
+# it form the "dense head": per-entity (multiplicity, rating-sum)
+# rows over the full other side, normal equations by plain GEMM.
+# _DENSE_MIN_COUNT keeps tiny problems (tests, small apps) on the
+# uniform bucket path.
+_DENSE_RATIO = 1.0 / 14.0
+_DENSE_MIN_COUNT = 256
+# Cap on the dense head's total weight-row bytes (w_cnt + w_val, 8
+# bytes per (entity, other) cell, held on host AND device). The head
+# pays off because a power-law tail keeps it to a few hundred entities;
+# a distribution with MANY just-over-threshold entities would otherwise
+# grow it without bound (~2 GB/side at 20M nnz worst case — ADVICE r3).
+# Entities over the cap spill to the seg/ladder bucket path, which is
+# always correct, just gather-bound.
+_DENSE_HEAD_MB = 2048
+
+
+@dataclass
+class _Bucket:
+    """Entities sharing one padded width C, sliced into scan slabs.
+
+    Two row↔entity regimes:
+    - ``seg is None``: one row per entity (``counts`` is per-row,
+      shaped (n_slabs, slab)).
+    - ``seg`` set (the single heavy bucket, entities with more than
+      ``_C_MAX`` ratings): each entity spans several width-C rows.
+      Rows are entity-sorted, so a slab of S rows touches ≤ S
+      CONSECUTIVE entities; ``seg`` is the (n_slabs, slab, slab)
+      SLAB-LOCAL one-hot row→entity matrix (entity index relative to
+      ``seg_off`` for that slab) that aggregates per-row partial Grams
+      into per-entity normal equations with ONE batched matmul per slab
+      (MXU work, no scatter). Slab-local keeps ``seg`` at R×slab floats
+      — a dense (R, nb) matrix would grow quadratically with the number
+      of heavy entities. ``counts`` is per-entity, shaped (nb,).
+    """
+
+    C: int
+    nb: int        # real entity count
+    slab: int
+    n_slabs: int
+    other_idx: np.ndarray  # (n_slabs, slab, C) int32 — PERMUTED other pos
+    vals: np.ndarray       # (n_slabs, slab, C) f32
+    mask: np.ndarray       # (n_slabs, slab, C) f32
+    counts: np.ndarray     # see class docstring
+    seg: Optional[np.ndarray] = None
+    seg_off: Optional[np.ndarray] = None  # (n_slabs,) int32 first entity
+
+    @property
+    def geometry(self) -> Tuple[int, int, int, int, bool]:
+        return (self.C, self.nb, self.slab, self.n_slabs,
+                self.seg is not None)
+
+
+@dataclass
+class _DenseHead:
+    """The heaviest entities (see ``_DENSE_RATIO``): per-entity dense
+    weight rows over the FULL other side. ``w_cnt[e, o]`` is the
+    multiplicity of the (e, o) pair (0 almost everywhere), ``w_val``
+    the rating sum — together they express exactly the same normal
+    equations as the bucketed slots, as two GEMMs with no gather."""
+
+    nb: int
+    n_other: int
+    w_cnt: np.ndarray   # (nb, n_other) f32
+    w_val: np.ndarray   # (nb, n_other) f32
+    counts: np.ndarray  # (nb,) f32 — rating count (ridge weighting)
+
+    @property
+    def geometry(self) -> Tuple[int, int]:
+        return (self.nb, self.n_other)
+
+
+@dataclass
+class _BucketSide:
+    """One half-step orientation: self entities bucketed, other side
+    referenced by permuted position. ``dense`` (optional) covers the
+    heaviest entities — permuted positions [0, dense.nb) — with the
+    remaining entities in ``buckets``."""
+
+    n: int
+    perm: np.ndarray       # position p → original entity id
+    inv_perm: np.ndarray   # original entity id → position
+    buckets: list
+    dense: Optional[_DenseHead] = None
+
+    @property
+    def geometry(self):
+        return (self.n,
+                self.dense.geometry if self.dense is not None else None,
+                tuple(b.geometry for b in self.buckets))
+
+
+def _perm_by_count_desc(counts: np.ndarray):
+    perm = np.argsort(-counts, kind="stable").astype(np.int32)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(len(perm), dtype=np.int32)
+    return perm, inv
+
+
+def _merge_bounds(counts_sorted_list, n_other: int) -> tuple:
+    """Common bucket boundaries for one or many count-desc-sorted count
+    vectors: ``(nb_dense, (nb_seg, n_slabs_seg), ((width, nb), … desc))``.
+
+    For the sharded path every device must run the SAME program, so
+    boundaries are the elementwise max over the devices' natural
+    boundaries. Placing a lighter entity in a wider bucket (or the
+    dense head) is always safe (capacity ≥ count — see the argument in
+    ``_bucket_side``), so max-merging never breaks a device, only pads
+    it.
+    """
+    thresh = max(_DENSE_MIN_COUNT, int(_DENSE_RATIO * n_other))
+    nb_dense = max(int((c >= thresh).sum()) for c in counts_sorted_list)
+    # byte-cap the head (PIO_ALS_DENSE_HEAD_MB, see _DENSE_HEAD_MB):
+    # counts are sorted descending, so truncating keeps the heaviest —
+    # highest-payoff — entities and spills the rest to the buckets below
+    head_mb = int(os.environ.get("PIO_ALS_DENSE_HEAD_MB",
+                                 str(_DENSE_HEAD_MB)))
+    nb_dense = min(nb_dense, (head_mb << 20) // max(1, 8 * n_other))
+    nb_seg = max(int((c[nb_dense:] > _C_MAX).sum())
+                 for c in counts_sorted_list)
+    rows_cap = 0
+    if nb_seg:
+        for c in counts_sorted_list:
+            seg_c = c[nb_dense:nb_dense + nb_seg]
+            rows = int(((seg_c + _C_MAX - 1) // _C_MAX).sum())
+            rows_cap = max(rows_cap, rows, 1)
+    ladder = np.asarray(_LADDER, np.int64)
+    nbs: dict = {}
+    for c in counts_sorted_list:
+        rest = c[nb_dense + nb_seg:]
+        rest = rest[rest > 0]
+        if rest.size:
+            w, n = np.unique(ladder[np.searchsorted(ladder, rest)],
+                             return_counts=True)
+            for wi, ni in zip(w, n):
+                nbs[int(wi)] = max(nbs.get(int(wi), 0), int(ni))
+    regs = tuple(sorted(nbs.items(), reverse=True))
+    return (nb_dense, (nb_seg, rows_cap), regs)
+
+
+def _bucket_side(idx_self, idx_other_pos, vals, n_self, counts,
+                 perm, inv_perm, n_other=None, bounds=None) -> _BucketSide:
+    """Bucket one orientation. ``idx_other_pos`` must already be mapped
+    to the other side's factor-row positions; ``counts/perm/inv_perm``
+    come from :func:`_perm_by_count_desc` on this side's counts;
+    ``n_other`` is the other side's factor-row count (the width of
+    dense-head weight rows — the gathered factor matrix height).
+
+    ``bounds`` forces common bucket boundaries (sharded path: the
+    max-merge over all devices, so every device traces one program).
+    Forced boundaries are safe: the entity at permuted position p has
+    count ≤ every entity before it, and merged boundaries only ever
+    move p into the dense head or a bucket at least as wide as its
+    natural one — so capacity C ≥ count always holds.
+    """
+    if n_other is None:
+        n_other = (int(idx_other_pos.max()) + 1 if idx_other_pos.size
+                   else 1)
+    nnz = idx_self.shape[0]
+    pos = inv_perm[idx_self]
+    order = np.argsort(pos, kind="stable")
+    ps, o, v = pos[order], idx_other_pos[order], vals[order]
+    counts_perm = counts[perm].astype(np.int64)
+    starts = np.zeros(n_self + 1, np.int64)
+    np.cumsum(counts_perm, out=starts[1:])
+    within = (np.arange(nnz, dtype=np.int64) - starts[ps]).astype(np.int64)
+
+    if bounds is None:
+        bounds = _merge_bounds([counts_perm], n_other)
+    nb_dense, (nb_seg, rows_cap), regs = bounds
+
+    # dense head: heaviest entities (permuted positions [0, nb_dense))
+    # as dense weight rows — see _DENSE_RATIO
+    dense = None
+    if nb_dense:
+        hi = int(starts[min(nb_dense, n_self)])
+        # bincount over linearized (entity, other) indices: np.add.at
+        # is an unbuffered scalar scatter, ~50-100× slower over the
+        # millions of nnz the dense head holds
+        lin = ps[:hi].astype(np.int64) * n_other + o[:hi]
+        size = nb_dense * n_other
+        w_cnt = np.bincount(lin, minlength=size).astype(
+            np.float32).reshape(nb_dense, n_other)
+        w_val = np.bincount(lin, weights=v[:hi], minlength=size).astype(
+            np.float32).reshape(nb_dense, n_other)
+        cnts = np.zeros(nb_dense, np.float32)
+        real = min(nb_dense, n_self)
+        cnts[:real] = counts_perm[:real]
+        dense = _DenseHead(nb_dense, n_other, w_cnt, w_val, cnts)
+        # rebase the remainder so the seg/ladder code below sees a
+        # self-contained problem over positions [nb_dense, n_self)
+        ps = ps[hi:] - nb_dense
+        o, v, within = o[hi:], v[hi:], within[hi:]
+        counts_perm = counts_perm[nb_dense:]
+        starts = starts[nb_dense:] - hi
+        n_self_rest = max(n_self - nb_dense, 0)
+    else:
+        n_self_rest = n_self
+    buckets = []
+
+    # heavy entities (count > _C_MAX): one SEGMENTED bucket — each
+    # entity spans ceil(count/C) rows of width C; the one-hot ``seg``
+    # matrix aggregates row partials per entity inside the compiled
+    # program. Entities are count-descending, so these are the first
+    # positions after the dense head and the output concatenation order
+    # is preserved.
+    if nb_seg:
+        C = _C_MAX
+        cnts = counts_perm[:nb_seg]
+        rows_per = (cnts + C - 1) // C  # forced-in light entities: 1 row
+        row_starts = np.zeros(nb_seg + 1, np.int64)
+        np.cumsum(rows_per, out=row_starts[1:])
+        n_rows = int(row_starts[-1])
+        # slab capped at the (merged) row count: padding a small bucket
+        # to a full 64MB slab made every tiny block solve tens of
+        # thousands of identity systems
+        slab = max(1, min(_SLAB_ELEMS // C, rows_cap))
+        n_slabs = -(-rows_cap // slab)
+        assert n_rows <= n_slabs * slab
+        R = n_slabs * slab
+        oi = np.zeros((R, C), np.int32)
+        vv = np.zeros((R, C), np.float32)
+        mm = np.zeros((R, C), np.float32)
+        hi = int(starts[nb_seg])
+        row = row_starts[ps[:hi]] + within[:hi] // C
+        col = within[:hi] % C
+        oi[row, col] = o[:hi]
+        vv[row, col] = v[:hi]
+        mm[row, col] = 1.0
+        row_ent = np.repeat(np.arange(nb_seg), rows_per)
+        # slab-local one-hot: entity index relative to the slab's first
+        # entity (rows are entity-sorted → ≤ slab consecutive entities)
+        if n_rows:
+            seg_off = row_ent[np.minimum(np.arange(n_slabs) * slab,
+                                         n_rows - 1)].astype(np.int32)
+            local = row_ent - seg_off[np.arange(n_rows) // slab]
+            seg = np.zeros((R, slab), np.float32)
+            seg[np.arange(n_rows), local] = 1.0  # pad rows stay all-zero
+        else:  # a device with no ratings in the (forced) seg range
+            seg_off = np.zeros(n_slabs, np.int32)
+            seg = np.zeros((R, slab), np.float32)
+        buckets.append(_Bucket(
+            C, nb_seg, slab, n_slabs,
+            oi.reshape(n_slabs, slab, C),
+            vv.reshape(n_slabs, slab, C),
+            mm.reshape(n_slabs, slab, C),
+            cnts.astype(np.float32),
+            seg=seg.reshape(n_slabs, slab, slab),
+            seg_off=seg_off))
+
+    # the rest: one row per entity, padded to the bucket width
+    e = nb_seg
+    for C, nb in regs:
+        slab = max(1, min(_SLAB_ELEMS // C, nb))
+        n_slabs = -(-nb // slab)
+        nb_pad = n_slabs * slab
+        oi = np.zeros((nb_pad, C), np.int32)
+        vv = np.zeros((nb_pad, C), np.float32)
+        mm = np.zeros((nb_pad, C), np.float32)
+        # forced boundaries may extend past this device's entities
+        e_end = min(e + nb, n_self_rest)
+        lo, hi = int(starts[min(e, n_self_rest)]), int(starts[e_end])
+        row = (ps[lo:hi] - e).astype(np.int64)
+        col = within[lo:hi]
+        oi[row, col] = o[lo:hi]
+        vv[row, col] = v[lo:hi]
+        mm[row, col] = 1.0
+        cnt = np.zeros(nb_pad, np.float32)
+        cnt[: max(e_end - e, 0)] = counts_perm[e:e_end]
+        buckets.append(_Bucket(
+            C, nb, slab, n_slabs,
+            oi.reshape(n_slabs, slab, C),
+            vv.reshape(n_slabs, slab, C),
+            mm.reshape(n_slabs, slab, C),
+            cnt.reshape(n_slabs, slab)))
+        e += nb
+    return _BucketSide(n_self, perm, inv_perm, buckets, dense=dense)
+
+
+@dataclass
+class ALSPrepared:
+    """Host-side prepared training layout (the analogue of MLlib ALS's
+    InBlock construction — built once per dataset, reused across train
+    calls; `bench.py` times training only, per BASELINE.md's
+    "excluding data prep" protocol)."""
+
+    n_users: int
+    n_items: int
+    nnz: int
+    u_side: _BucketSide
+    i_side: _BucketSide
+    _device_bufs: Optional[dict] = None
+
+    @property
+    def geometry(self):
+        return (self.u_side.geometry, self.i_side.geometry)
+
+    def device_buffers(self, device=None):
+        """Bucket arrays as device arrays (cached per device across
+        train calls — a reused prep may be trained on different pinned
+        devices, e.g. a `pio eval` grid over 1-device meshes)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._device_bufs is None:
+            self._device_bufs = {}
+        if device not in self._device_bufs:
+            def put(a):
+                return (jnp.asarray(a) if device is None
+                        else jax.device_put(a, device))
+
+            def side_bufs(side):
+                dense = (() if side.dense is None else
+                         (put(side.dense.w_cnt), put(side.dense.w_val),
+                          put(side.dense.counts)))
+                return (dense, tuple(
+                    tuple((put(b.other_idx), put(b.vals), put(b.mask),
+                           put(b.counts))
+                          + ((put(b.seg), put(b.seg_off))
+                             if b.seg is not None else ())
+                          for b in side.buckets)))
+
+            self._device_bufs[device] = (side_bufs(self.u_side),
+                                         side_bufs(self.i_side))
+        return self._device_bufs[device]
+
+
+def als_prepare(coo: RatingsCOO) -> ALSPrepared:
+    """Build the bucketed layout for single-device training."""
+    cnt_u = np.bincount(coo.user_idx, minlength=coo.n_users)
+    cnt_i = np.bincount(coo.item_idx, minlength=coo.n_items)
+    perm_u, inv_u = _perm_by_count_desc(cnt_u)
+    perm_i, inv_i = _perm_by_count_desc(cnt_i)
+    u_side = _bucket_side(coo.user_idx, inv_i[coo.item_idx], coo.rating,
+                          coo.n_users, cnt_u, perm_u, inv_u,
+                          n_other=coo.n_items)
+    i_side = _bucket_side(coo.item_idx, inv_u[coo.user_idx], coo.rating,
+                          coo.n_items, cnt_i, perm_i, inv_i,
+                          n_other=coo.n_users)
+    return ALSPrepared(coo.n_users, coo.n_items, coo.nnz, u_side, i_side)
+
+
+
+def als_train(
+    coo: RatingsCOO,
+    params: ALSParams,
+    mesh=None,
+    checkpointer=None,
+    checkpoint_every: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Train ALS; returns (U [n_users,k], V [n_items,k]) as numpy arrays.
+
+    ``mesh`` (a jax.sharding.Mesh with a ``"data"`` axis) enables the
+    sharded path; None runs single-device. ``checkpointer`` +
+    ``checkpoint_every`` enable mid-train checkpoint/resume on BOTH
+    paths: the single-device loop and the sharded trainer split their
+    iteration scan at block boundaries and save the factors after each
+    block (see :func:`als_train_prepared` /
+    :func:`als_sharded.als_train_sharded_prepared`).
+    """
+    if mesh is not None and np.prod(mesh.devices.shape) > 1:
+        from predictionio_tpu.models.als_sharded import als_train_sharded
+
+        return als_train_sharded(coo, params, mesh,
+                                 checkpointer=checkpointer,
+                                 checkpoint_every=checkpoint_every)
+    # a 1-device mesh still pins the platform: run the single-device path
+    # on THAT device, not wherever the default backend happens to live
+    device = mesh.devices.flat[0] if mesh is not None else None
+    return als_train_prepared(als_prepare(coo), params, device=device,
+                              checkpointer=checkpointer,
+                              checkpoint_every=checkpoint_every)
+
+
+def als_train_many(
+    coo: RatingsCOO,
+    params_list,
+    mesh=None,
+) -> list:
+    """Train one (U, V) per params on the SAME ratings — the `pio eval`
+    grid fan-out (SURVEY.md §2d P4; reference behavior: MLlib grids
+    re-run ALS per candidate from scratch).
+
+    Costs shared across the grid:
+    - the bucketed host layout is prepared ONCE (``als_prepare`` /
+      ``als_prepare_sharded``) and its device upload is cached per
+      device/mesh (``device_buffers``);
+    - candidates differing only in ``reg``/``alpha`` share ONE compiled
+      executable — both enter the kernel as traced scalars — so the
+      canonical regularization grid compiles the train program once.
+      Distinct ``rank``/``iterations``/``implicit``/``weighted_reg``
+      still compile per distinct value (they change program shape or
+      structure), amortized by ``_compiled_bucketed``'s lru_cache and
+      the persistent XLA cache.
+    """
+    params_list = list(params_list)
+    if mesh is not None and np.prod(mesh.devices.shape) > 1:
+        from predictionio_tpu.models.als_sharded import (
+            als_prepare_sharded,
+            als_train_sharded_prepared,
+        )
+
+        sprep = als_prepare_sharded(coo, int(np.prod(mesh.devices.shape)))
+        return [als_train_sharded_prepared(sprep, p, mesh)
+                for p in params_list]
+    device = mesh.devices.flat[0] if mesh is not None else None
+    prep = als_prepare(coo)
+    return [als_train_prepared(prep, p, device=device)
+            for p in params_list]
+
+
+def _make_half(k: int, implicit: bool, weighted_reg: bool, pvary=None,
+               platform=None, bf16_gather: bool = False,
+               precision: str = "high"):
+    """Build the half-step program shared by the single-device and
+    sharded (shard_map) paths:
+    ``half(F_other, bufs, geometry, reg, alpha)`` — one full re-solve
+    of one side's factors from the other side's.
+
+    ``reg`` and ``alpha`` are TRACED scalar inputs: they enter the
+    kernel only as multiplies, so an eval grid over regularization (the
+    canonical ALS grid) shares ONE compiled executable across
+    candidates instead of paying a full XLA compile per reg value.
+    ``implicit`` and ``weighted_reg`` stay Python-static — they change
+    the program's structure, not its constants.
+
+    ``precision`` selects the Gram-einsum MXU precision: "high"
+    (default, 3-pass) or "highest" (6-pass) via ``PIO_ALS_PRECISION``
+    — CPU CI ignores the precision argument entirely, so the knob
+    exists to let an on-device run A/B the two modes when triaging a
+    numerical regression (ADVICE r3).
+
+    Per bucket, per slab (a ``lax.scan`` step): gather the (slab, C, k)
+    factor block, one batched weighted-Gram einsum (MXU), add ridge +
+    implicit term; all buckets emit their k×k systems into one solve
+    buffer and a single chunked scan solves the whole side with ONE
+    instance of the block-recursive batched Cholesky (compile-time
+    bound — see ``_SOLVE_CHUNK``). No scatter anywhere in the program.
+    Catalogs too large for the solve buffer solve inside each bucket
+    body instead (memory flat in catalog size).
+
+    ``pvary`` marks created constants as varying over the mesh axis
+    when tracing inside ``shard_map`` (vma typing); identity otherwise.
+    ``platform`` is the platform the trace will RUN on (mesh/device
+    platform — may differ from the default backend): it routes the
+    solve to the Pallas VMEM kernel on TPU, XLA elsewhere.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    pv = pvary if pvary is not None else (lambda x: x)
+    eye = jnp.eye(k, dtype=jnp.float32)
+    prec = (jax.lax.Precision.HIGHEST if precision == "highest"
+            else jax.lax.Precision.HIGH)
+
+    from predictionio_tpu.ops.cholesky import chol_solve_batched as _csb
+
+    chol_solve_batched = functools.partial(_csb, platform=platform)
+
+    # reg/alpha are bound per trace by ``half`` (traced scalars shared
+    # by every helper below via this cell — threading them through five
+    # helper signatures would obscure the kernel structure)
+    _ra: dict = {}
+
+    def weights(v_s, m_s):
+        alpha = _ra["alpha"]
+        if implicit:
+            return (alpha * v_s) * m_s, (1.0 + alpha * v_s) * m_s
+        return m_s, v_s * m_s
+
+    def row_grams(F_other, oi_s, v_s, m_s):
+        """One slab's per-row normal-equation partials on the MXU.
+
+        A and b are built by ONE packed einsum: H = [w_o·F | w_b] is a
+        (slab, C, k+1) block, and F'H = [A | b]. Computing b separately
+        ("nc,nck->nk") lowered to a VPU multiply-reduce that measured
+        ~45 ms/iteration at ML-20M — pure overhead next to the A matmul
+        the MXU was already doing; packed, it is one extra MXU column.
+
+        HIGH (3-pass bf16 ≈ f32): normal equations need f32-grade MXU
+        passes — single-pass bf16 Gram error is ~3e-1 vs 6e-5 (see
+        ops/gram.py) and the Cholesky solve amplifies it. HIGHEST
+        (6-pass) halves MXU throughput for precision ALS cannot use:
+        measured iterate divergence HIGH-vs-HIGHEST after 10 iterations
+        is ~1e-4 relative — f32 solve noise level, far inside the
+        parity-test tolerances."""
+        F = F_other[oi_s]                               # (slab, C, k)
+        if bf16_gather:
+            # F_other arrives pre-cast to bf16 (one pass per half
+            # step); weights round to bf16 and the MXU runs a single
+            # pass with f32 accumulation
+            wo, wb = weights(v_s, m_s)
+            H = jnp.concatenate(
+                [(wo[..., None] * F).astype(jnp.bfloat16),
+                 wb[..., None].astype(jnp.bfloat16)], axis=-1)
+            return jnp.einsum("nck,ncl->nkl", F, H,
+                              preferred_element_type=jnp.float32)
+        wo, wb = weights(v_s, m_s)
+        H = jnp.concatenate([wo[..., None] * F, wb[..., None]], axis=-1)
+        return jnp.einsum("nck,ncl->nkl", F, H,
+                          precision=prec,
+                          preferred_element_type=jnp.float32)
+
+    def ridge(A, cnt_s, G):
+        reg = _ra["reg"]
+        if implicit:
+            A = A + G[None, :, :]
+        lam = reg * cnt_s if weighted_reg else reg * jnp.ones_like(cnt_s)
+        lam = jnp.where(cnt_s > 0, jnp.maximum(lam, 1e-8), 1.0)
+        return A + lam[:, None, None] * eye
+
+    def seg_equations(F_g, buf, nb, slab, G):
+        """Heavy bucket: entities span rows; each slab aggregates its
+        per-row partials into ≤ slab consecutive entities with one
+        (slab, slab) × (slab, k·(k+1)) matmul (slab-local one-hot, no
+        scatter), accumulated into the per-entity buffer at the slab's
+        entity offset. Buffer is over-allocated by one slab so the
+        update-slice never clamps."""
+        oi, vv, mm, cnt, seg, seg_off = buf
+
+        def seg_body(Ab_e, chunk):
+            oi_s, v_s, m_s, seg_s, off_s = chunk
+            Ab_r = row_grams(F_g, oi_s, v_s, m_s)   # (slab, k, k+1)
+            Ab_l = jnp.einsum("ne,nkm->ekm", seg_s, Ab_r,
+                              precision=prec,
+                              preferred_element_type=jnp.float32)
+            blk = jax.lax.dynamic_slice(Ab_e, (off_s, 0, 0),
+                                        (slab, k, k + 1))
+            Ab_e = jax.lax.dynamic_update_slice(Ab_e, blk + Ab_l,
+                                                (off_s, 0, 0))
+            return Ab_e, None
+
+        init = pv(jnp.zeros((nb + slab, k, k + 1), jnp.float32))
+        Ab_e, _ = jax.lax.scan(seg_body, init, (oi, vv, mm, seg, seg_off))
+        return ridge(Ab_e[:nb, :, :k], cnt, G), Ab_e[:nb, :, k]
+
+    def dense_equations(F_other, dbuf, G):
+        """Dense head: normal equations for the heaviest entities as
+        two GEMMs over the FULL other side — A rows against the factor
+        outer products, b rows against the factors — replacing the
+        gathered seg path that measured ~70% of the Gram phase at
+        ML-20M (~280 entities holding ~65% of padded slots). No gather,
+        no scan: pure MXU work."""
+        w_cnt, w_val, cnt = dbuf
+        if implicit:
+            alpha = _ra["alpha"]
+            wo_m, wb_m = alpha * w_val, w_cnt + alpha * w_val
+        else:
+            wo_m, wb_m = w_cnt, w_val
+        n_other = F_other.shape[0]
+        FF = (F_other[:, :, None] * F_other[:, None, :]).reshape(
+            n_other, k * k)
+        A = jnp.einsum("nc,cm->nm", wo_m, FF,
+                       precision=prec,
+                       preferred_element_type=jnp.float32
+                       ).reshape(-1, k, k)
+        b = jnp.einsum("nc,ck->nk", wb_m, F_other,
+                       precision=prec,
+                       preferred_element_type=jnp.float32)
+        return ridge(A, cnt, G), b
+
+    def half_materialized(F_other, F_g, dense_buf, bufs, geometry, G,
+                          spans, chunk, n_chunks):
+        """Two-phase half-step: the dense head and every bucket emit
+        (ridged) normal equations, concatenated into one solve buffer a
+        single chunked scan then solves — ONE Cholesky instance in the
+        program. Emitting via scan ``ys`` (not a carried buffer updated
+        with dynamic_update_slice) matters: the carry pattern measured
+        +116 ms per ML-20M half-step in buffer copies."""
+        N_pad = n_chunks * chunk
+        n_self, dense_geom, bucket_geoms = geometry
+        A_parts, b_parts = [], []
+        if dense_geom is not None:
+            A_d, b_d = dense_equations(F_other, dense_buf, G)
+            A_parts.append(A_d)
+            b_parts.append(b_d)
+        F_other = F_g  # buckets below gather from the cast copy
+        for (C, nb, slab, n_slabs, is_seg), buf in zip(bucket_geoms, bufs):
+            if is_seg:
+                A_e, b_e = seg_equations(F_other, buf, nb, slab, G)
+                A_parts.append(A_e)
+                b_parts.append(b_e)
+            else:
+                oi, vv, mm, cnt = buf
+
+                def body(_, chunk):
+                    oi_s, v_s, m_s, cnt_s = chunk
+                    Ab = row_grams(F_other, oi_s, v_s, m_s)
+                    return None, (ridge(Ab[..., :k], cnt_s, G), Ab[..., k])
+
+                if n_slabs == 1:
+                    A, b = body(None, (oi[0], vv[0], mm[0], cnt[0]))[1]
+                else:
+                    _, (A, b) = jax.lax.scan(body, None, (oi, vv, mm, cnt))
+                    A = A.reshape(-1, k, k)
+                    b = b.reshape(-1, k)
+                A_parts.append(A)
+                b_parts.append(b)
+        if sum(spans) < N_pad:  # tail pad: identity systems, x = 0
+            A_parts.append(pv(jnp.zeros((N_pad - sum(spans), k, k),
+                                        jnp.float32) + eye))
+            b_parts.append(pv(jnp.zeros((N_pad - sum(spans), k),
+                                        jnp.float32)))
+        A_all = jnp.concatenate(A_parts) if len(A_parts) > 1 else A_parts[0]
+        b_all = jnp.concatenate(b_parts) if len(b_parts) > 1 else b_parts[0]
+        if n_chunks == 1:
+            x_all = chol_solve_batched(A_all, b_all)
+        else:
+            _, xc = jax.lax.scan(
+                lambda _, ab: (None, chol_solve_batched(*ab)), None,
+                (A_all.reshape(n_chunks, chunk, k, k),
+                 b_all.reshape(n_chunks, chunk, k)))
+            x_all = xc.reshape(N_pad, k)
+        outs, off, total = [], 0, 0
+        nbs = ([dense_geom[0]] if dense_geom is not None else []) + \
+            [nb for (C, nb, slab, n_slabs, is_seg) in bucket_geoms]
+        for nb, span in zip(nbs, spans):
+            outs.append(x_all[off:off + nb])
+            off += span
+            total += nb
+        if total < n_self:  # zero-rating tail entities → zero factors
+            outs.append(pv(jnp.zeros((n_self - total, k), jnp.float32)))
+        out = jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+        # forced (merged) boundaries can exceed n_self; extras are zeros
+        return out[:n_self] if total > n_self else out
+
+    def half(F_other, bufs_side, geometry, reg, alpha):
+        # bind the traced scalars for every helper above; pv marks them
+        # device-varying under shard_map (they arrive replicated)
+        _ra["reg"] = pv(jnp.asarray(reg, jnp.float32))
+        _ra["alpha"] = pv(jnp.asarray(alpha, jnp.float32))
+        n_self, dense_geom, bucket_geoms = geometry
+        dense_buf, bufs = bufs_side
+        # bf16 gather mode: ONE cast pass per half-step; every bucket
+        # gather then moves half the bytes (dense head and the implicit
+        # Gram stay f32)
+        F_g = (F_other.astype(jnp.bfloat16) if bf16_gather else F_other)
+        G = None
+        if implicit:
+            G = jnp.einsum("nk,nl->kl", F_other, F_other,
+                           precision=prec,
+                           preferred_element_type=jnp.float32)
+        # spans in the solve buffer: the dense head and seg buckets
+        # emit nb exact rows once, regular buckets their padded slabs
+        spans = ([dense_geom[0]] if dense_geom is not None else []) + \
+            [nb if is_seg else n_slabs * slab
+             for (C, nb, slab, n_slabs, is_seg) in bucket_geoms]
+        # solve chunk shrinks for small sides (sharded per-device
+        # blocks) so the floor isn't thousands of padded identity solves
+        chunk = min(_SOLVE_CHUNK, max(256, -(-sum(spans) // 256) * 256))
+        n_chunks = max(1, -(-sum(spans) // chunk))
+        if n_chunks * chunk * k * k * 4 <= _SOLVE_BUF_MB << 20:
+            return half_materialized(F_other, F_g, dense_buf, bufs,
+                                     geometry, G, spans, chunk, n_chunks)
+        # huge catalog: solve inside each bucket body (memory flat in
+        # catalog size; compiles one Cholesky per bucket)
+        outs = []
+        total = 0
+        if dense_geom is not None:
+            A_d, b_d = dense_equations(F_other, dense_buf, G)
+            outs.append(chol_solve_batched(A_d, b_d))
+            total += dense_geom[0]
+        for (C, nb, slab, n_slabs, is_seg), buf in zip(bucket_geoms, bufs):
+            if is_seg:
+                A_e, b_e = seg_equations(F_g, buf, nb, slab, G)
+                x = chol_solve_batched(A_e, b_e)
+            else:
+                oi, vv, mm, cnt = buf
+
+                def body(_, chunk):
+                    oi_s, v_s, m_s, cnt_s = chunk
+                    Ab = row_grams(F_g, oi_s, v_s, m_s)
+                    return None, chol_solve_batched(
+                        ridge(Ab[..., :k], cnt_s, G), Ab[..., k])
+
+                if n_slabs == 1:
+                    x = body(None, (oi[0], vv[0], mm[0], cnt[0]))[1]
+                else:
+                    _, xs = jax.lax.scan(body, None, (oi, vv, mm, cnt))
+                    x = xs.reshape(-1, k)
+                x = x[:nb]
+            outs.append(x)
+            total += nb
+        if total < n_self:  # zero-rating tail entities → zero factors
+            outs.append(pv(jnp.zeros((n_self - total, k), jnp.float32)))
+        out = jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+        return out[:n_self] if total > n_self else out
+
+    return half
+
+
+def _gram_precision() -> str:
+    """Gram-einsum precision mode from ``PIO_ALS_PRECISION`` ("high"
+    default; "highest" restores the 6-pass MXU mode for on-device
+    numerical triage — see ``_make_half``)."""
+    return os.environ.get("PIO_ALS_PRECISION", "high").lower()
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
+                       rank: int, iterations: int,
+                       implicit: bool, weighted_reg: bool,
+                       platform: Optional[str] = None,
+                       bf16_gather: bool = False,
+                       precision: str = "high"):
+    """Build + jit the full single-device training program for one
+    problem geometry (two `_make_half` programs under one iteration
+    scan). ``reg`` and ``alpha`` are traced inputs of the returned
+    ``train(u_bufs, i_bufs, V0p, reg, alpha)``, so a `pio eval` grid
+    over regularization/alpha shares ONE executable; candidates
+    recompile only when rank/iterations (or the implicit/weighted_reg
+    program structure) change."""
+    import jax
+    import jax.numpy as jnp
+
+    k = rank
+    half = _make_half(k, bool(implicit), bool(weighted_reg),
+                      platform=platform, bf16_gather=bf16_gather,
+                      precision=precision)
+
+    def train(u_bufs, i_bufs, V0p, reg, alpha):
+        if iterations == 0:
+            # U-recovery program: derive U from already-converged V (the
+            # resume path when a run died between its final checkpoint
+            # and model persistence)
+            return half(V0p, u_bufs, geom_u, reg, alpha), V0p
+
+        def step(carry, _):
+            U, V = carry
+            U = half(V, u_bufs, geom_u, reg, alpha)
+            V = half(U, i_bufs, geom_i, reg, alpha)
+            return (U, V), None
+
+        U0 = jnp.zeros((n_users, k), jnp.float32)
+        (U, V), _ = jax.lax.scan(step, (U0, V0p), None, length=iterations)
+        return U, V
+
+    return jax.jit(train)
+
+
+@functools.lru_cache(maxsize=1)
+def _unpermute_pack():
+    import jax
+    import jax.numpy as jnp
+
+    def f(U, V, inv_u, inv_v):
+        return jnp.concatenate([jnp.take(U, inv_u, axis=0),
+                                jnp.take(V, inv_v, axis=0)], axis=0)
+
+    return jax.jit(f)
+
+
+def als_train_prepared(prep: ALSPrepared, p: ALSParams, device=None,
+                       checkpointer=None, checkpoint_every: int = 0,
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Train from a prepared layout; returns (U, V) in ORIGINAL entity
+    order as numpy arrays.
+
+    With ``checkpointer`` + ``checkpoint_every > 0`` the iteration loop
+    runs in blocks of ``checkpoint_every`` iterations, saving the
+    (permuted) V factors after each block — an interrupted train
+    restarted with the same checkpointer resumes from the newest block
+    and produces the same result as an uninterrupted run (V fully
+    determines the next iteration; U is recomputed from V). This is the
+    SURVEY §5 restart-from-checkpoint contract; the checkpoint cadence
+    costs one extra dispatch + a host fetch of V per block.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def put(a):
+        return jnp.asarray(a) if device is None else jax.device_put(a, device)
+
+    u_bufs, i_bufs = prep.device_buffers(device)
+
+    platform = (device.platform if device is not None
+                else jax.default_backend())
+
+    def compiled(n_iters: int):
+        return _compiled_bucketed(
+            prep.u_side.geometry, prep.i_side.geometry,
+            prep.n_users, prep.n_items,
+            p.rank, n_iters, bool(p.implicit),
+            bool(p.weighted_reg), platform,
+            bool(p.bf16_gather), _gram_precision())
+
+    reg_a = np.float32(p.reg)
+    alpha_a = np.float32(p.alpha)
+
+    start = 0
+    V0 = init_factors(prep.n_items, p.rank, p.seed)[prep.i_side.perm]
+    U0 = None  # restored U (only consumed when start == iterations)
+    if checkpointer is not None and checkpointer.latest_step() is not None:
+        from predictionio_tpu.utils.checkpoint import CheckpointGeometryError
+
+        template = {"U": np.zeros((prep.n_users, p.rank), np.float32),
+                    "V": np.zeros_like(V0)}
+        try:
+            state, step = checkpointer.restore_latest_compatible(template)
+            V0 = np.asarray(state["V"])
+            U0 = np.asarray(state["U"])
+            start = min(int(step), p.iterations)
+        except CheckpointGeometryError:
+            # CONFIRMED stale (different geometry/rank): fresh start,
+            # and the dir must be WIPED, else the fresh run's lower
+            # step numbers stay shadowed by the stale latest_step and
+            # every future resume restores the bad checkpoint again.
+            # Transient read errors propagate instead — wiping on those
+            # would destroy valid checkpoints (ADVICE r3).
+            import warnings
+
+            warnings.warn(
+                "ALS checkpoints are stale (geometry/format change) — wiped; training restarts from scratch", RuntimeWarning)
+            checkpointer.clear()
+
+    if start >= p.iterations and U0 is not None:
+        # died between the final checkpoint and model persistence: the
+        # train is already done, nothing to recompute
+        U, V = U0, V0
+    elif (checkpointer is None or checkpoint_every <= 0
+          or p.iterations == 0):  # its U-recovery program has no
+        # blocks to checkpoint; without this, the block loop below
+        # never runs and the not-None assert fires (r5 review)
+        U, V = compiled(p.iterations - start)(u_bufs, i_bufs, put(V0),
+                                              reg_a, alpha_a)
+    else:
+        V = put(V0)
+        U = None
+        it = start
+        while it < p.iterations:
+            n = min(checkpoint_every, p.iterations - it)
+            U, V = compiled(n)(u_bufs, i_bufs, V, reg_a, alpha_a)
+            it += n
+            checkpointer.save(it, {"U": np.asarray(U), "V": np.asarray(V)})
+        assert U is not None  # start < iterations here, loop ran
+    # un-permute to original entity order ON DEVICE and fetch U and V as
+    # ONE packed array: each device→host fetch is a full round trip
+    # (~66 ms over a tunneled chip), and the device does the
+    # fancy-index copy faster than the host would
+    packed = np.asarray(_unpermute_pack()(
+        put(U), put(V), put(prep.u_side.inv_perm),
+        put(prep.i_side.inv_perm)))
+    return packed[:prep.n_users], packed[prep.n_users:]
+
+
+def _als_train_single(coo: RatingsCOO, p: ALSParams,
+                      device=None) -> Tuple[np.ndarray, np.ndarray]:
+    return als_train_prepared(als_prepare(coo), p, device=device)
+
+
+# -- scoring ------------------------------------------------------------------
+
+
+def predict_ratings(U: np.ndarray, V: np.ndarray, users: np.ndarray,
+                    items: np.ndarray) -> np.ndarray:
+    """r̂ for (user, item) pairs."""
+    return np.einsum("nk,nk->n", U[users], V[items])
+
+
+def recommend(
+    U: np.ndarray, V: np.ndarray, user: int, num: int,
+    exclude: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Top-``num`` items for one user → (item_indices, scores)."""
+    scores = V @ U[user]
+    if exclude is not None and exclude.size:
+        scores = scores.copy()
+        scores[exclude] = -np.inf
+    num = min(num, scores.shape[0])
+    top = np.argpartition(-scores, num - 1)[:num]
+    top = top[np.argsort(-scores[top])]
+    return top, scores[top]
+
+
+def _gather_score_topk_impl(U, Vp, user_ids, k: int, n_valid: int,
+                            pallas: bool, tile: int):
+    import jax.numpy as jnp
+
+    from predictionio_tpu import ops
+
+    Q = U[user_ids]
+    if pallas:
+        vals, idx = ops.score_topk(Q, Vp, k, tile=tile, n_valid=n_valid)
+    else:
+        vals, idx = ops.score_topk_xla(Q, Vp, k, n_valid=n_valid)
+    # pack (vals, idx) into ONE output array: each device→host fetch is
+    # a full round trip (~66ms each over a tunneled chip), so a query
+    # must fetch exactly once. Item indices are exact in f32 (< 2^24).
+    return jnp.concatenate([vals, idx.astype(jnp.float32)], axis=-1)
+
+
+@functools.lru_cache(maxsize=1)
+def _gather_score_topk_jit():
+    import jax
+
+    return jax.jit(_gather_score_topk_impl,
+                   static_argnames=("k", "n_valid", "pallas", "tile"))
+
+
+def _gather_score_topk(U, Vp, user_ids, *, k: int, n_valid: int,
+                       pallas: bool, tile: int):
+    """The p50-critical serving program: gather + score + top-k as ONE
+    compiled dispatch, ONE packed host fetch. Eager composition here
+    costs a host↔device round trip per op — measured 158ms p50 over the
+    tunneled chip vs single-digit ms for the fused dispatch; a second
+    output fetch would double the floor again."""
+    import jax.numpy as jnp
+
+    packed = np.asarray(_gather_score_topk_jit()(
+        U, Vp, jnp.asarray(user_ids, jnp.int32), k=k, n_valid=n_valid,
+        pallas=pallas, tile=tile))
+    return packed[..., :k], packed[..., k:].astype(np.int32)
+
+
+_SERVE_MIN_ITEMS = 2048
+
+
+def maybe_resident_scorer(U, V, cached=None):
+    """Serving-path policy shared by the ALS-family templates: a lazy
+    device-resident :class:`ResidentScorer` for production-size
+    catalogs (≥ ``_SERVE_MIN_ITEMS`` items), None (→ host numpy
+    scoring) below that, where a matvec beats a device dispatch and
+    tests/demos stay free of compile time. ``PIO_ALS_SERVE`` overrides:
+    "host" forces None, "device" forces a scorer. Pass the previous
+    return value as ``cached`` so the scorer is built once per model;
+    a cached scorer is reused only if it was built from these exact
+    U/V arrays (identity check) — a caller that retrains and swaps
+    factors gets a fresh scorer, never stale scores.
+    """
+    mode = os.environ.get("PIO_ALS_SERVE", "auto")
+    if mode == "host" or (mode == "auto"
+                          and V.shape[0] < _SERVE_MIN_ITEMS):
+        return None
+    if cached is not None and cached.built_from(U, V):
+        return cached
+    return ResidentScorer(U, V)
+
+
+def serve_topk_batch(scorer, user_ids, item_inv, queries, fallback,
+                     per_query=None):
+    """Serve a micro-batch of top-k queries in ONE device dispatch.
+
+    The shared implementation behind the templates' ``batch_predict``
+    (`pio deploy --batching`, batchpredict, evaluation — SURVEY §3.2
+    continuous-batching contract): collect every top-k-shaped query,
+    score them all through ``scorer.recommend_batch`` with a single
+    padded ``k = max(num)``, slice per row. Queries ``per_query``
+    flags (e.g. rating-prediction shapes) and unknown users fall back
+    without touching the device; ``scorer=None`` (host-path catalogs,
+    :func:`maybe_resident_scorer`) serves everything via ``fallback``.
+
+    ``user_ids``: str id → row index mapping (``.get``);
+    ``item_inv``: row index → item id; ``fallback``: per-query callable
+    returning a response dict.
+    """
+    if scorer is None:
+        return [fallback(q) for q in queries]
+    out = [None] * len(queries)
+    rows = []  # (out index, user row, num)
+    for i, q in enumerate(queries):
+        if per_query is not None and per_query(q):
+            out[i] = fallback(q)
+            continue
+        uidx = user_ids.get(str(q["user"]))
+        if uidx is None:
+            out[i] = {"itemScores": []}
+            continue
+        rows.append((i, uidx, int(q.get("num", 10))))
+    if rows:
+        k = max(n for _, _, n in rows)
+        res = scorer.recommend_batch(
+            np.asarray([u for _, u, _ in rows], np.int32), k)
+        for (i, _, n), (iv, vv) in zip(rows, res):
+            out[i] = {"itemScores": [
+                {"item": item_inv[int(j)], "score": float(s)}
+                for j, s in zip(iv[:n], vv[:n])]}
+    return out
+
+
+class ResidentScorer:
+    """Serving-time scorer with factors resident on device.
+
+    The reference's serving path keeps the ``MatrixFactorizationModel``
+    in JVM heap and scores per query ([U] MLlib
+    ``recommendProducts`` — SURVEY.md §3.2). Here U and V live in HBM
+    across requests; each query is one compiled score→top-k program
+    (streaming Pallas kernel on TPU, dense XLA fallback elsewhere).
+    Exclusions are handled by over-fetching a padded k (bucketed to
+    limit recompiles) and filtering host-side.
+    """
+
+    _TILE = 2048  # item-tile width of the streaming kernel
+
+    def built_from(self, U, V) -> bool:
+        """True iff this scorer was constructed from exactly these
+        host arrays (used by :func:`maybe_resident_scorer` to reuse
+        across calls without ever serving stale factors)."""
+        if self._source is None:
+            return False
+        return self._source[0]() is U and self._source[1]() is V
+
+    def __init__(self, U: np.ndarray, V: np.ndarray):
+        import jax
+        import jax.numpy as jnp
+
+        # weak identity of the host arrays this scorer was built from,
+        # so maybe_resident_scorer can detect a factor swap after
+        # retrain (weakref, not id(): a freed array's address can be
+        # recycled by a new allocation)
+        import weakref
+        try:
+            self._source = (weakref.ref(U), weakref.ref(V))
+        except TypeError:  # non-weakref-able array-likes (e.g. lists)
+            self._source = None
+        self.n_users, self.rank = U.shape
+        self.n_items = V.shape[0]
+        if self.n_items >= 1 << 24:
+            # packed single-fetch output carries indices in f32 (exact
+            # integers only below 2^24)
+            raise ValueError("ResidentScorer supports catalogs < 2^24 items")
+        self._U = jax.device_put(jnp.asarray(U, jnp.float32))
+        # ONE resident copy, padded once at load to the streaming
+        # kernel's tile; both scoring paths mask the pad rows
+        pad = -self.n_items % self._TILE
+        Vp = np.concatenate([V, np.zeros((pad, self.rank), V.dtype)]) if pad else V
+        self._V_padded = jax.device_put(jnp.asarray(Vp, jnp.float32))
+
+    def _topk(self, user_ids, k: int):
+        from predictionio_tpu import ops
+
+        # The streaming kernel pays off once the (B, n_items) score
+        # matrix is too big to live cheaply in HBM between the matmul
+        # and the top_k; below that XLA's fused path wins (measured on
+        # v5e: XLA 1.5ms vs Pallas 2.8ms at B=32, N=27k).
+        # k > 1024 would unroll the kernel's selection loop too far —
+        # XLA's top_k handles large k better.
+        pallas = (ops.use_pallas() and k <= 1024
+                  and len(user_ids) * self.n_items > 64_000_000)
+        return _gather_score_topk(
+            self._U, self._V_padded, user_ids, k=k, n_valid=self.n_items,
+            pallas=pallas, tile=self._TILE)
+
+    def recommend_batch(
+        self, user_ids: np.ndarray, num: int,
+        exclude: Optional[list] = None,
+    ) -> list:
+        """Top-``num`` per user → list of (item_indices, scores) pairs.
+
+        ``exclude[i]`` is an optional array of item indices to drop for
+        user i (seen-item / constraint filtering, e-commerce template);
+        ``exclude`` itself or any entry may be None/empty.
+        """
+        import jax.numpy as jnp
+
+        if not exclude:
+            exclude = [None] * len(user_ids)
+        exclude = [np.asarray([] if e is None else e, np.int32)
+                   for e in exclude]
+        max_ex = max((e.size for e in exclude), default=0)
+        # bucket k to powers of two (bounds recompiles); over-fetch for
+        # exclusions but never more than the catalog
+        want = min(num + max_ex, self.n_items)
+        k = 16
+        while k < want:
+            k *= 2
+        k = min(k, self.n_items)
+        # bucket the BATCH dimension too: the micro-batcher produces
+        # every size from 1..max_batch, and an unpadded B would compile
+        # a program per distinct size (measured: 172 ms p99 under 8
+        # concurrent clients vs ~7 ms once warm — r4). Pad rows reuse
+        # user 0 and are sliced off after the dispatch.
+        B = len(user_ids)
+        Bp = 1
+        while Bp < B:
+            Bp *= 2
+        ids = np.asarray(user_ids, np.int32)
+        if Bp != B:
+            ids = np.concatenate([ids, np.zeros(Bp - B, np.int32)])
+        vals, idx = self._topk(ids, k)
+        vals, idx = np.asarray(vals)[:B], np.asarray(idx)[:B]
+        out = []
+        for row in range(len(user_ids)):
+            iv, vv = idx[row], vals[row]
+            if exclude[row].size:
+                keep = ~np.isin(iv, exclude[row])
+                iv, vv = iv[keep], vv[keep]
+            out.append((iv[:num], vv[:num]))
+        return out
+
+    def recommend(self, user: int, num: int,
+                  exclude: Optional[np.ndarray] = None):
+        [(iv, vv)] = self.recommend_batch(
+            np.asarray([user]), num,
+            [np.asarray(exclude if exclude is not None else [], np.int32)])
+        return iv, vv
+
+
+def similar_items(
+    V: np.ndarray, item_indices: np.ndarray, num: int,
+    exclude_self: bool = True,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Top-``num`` items by cosine similarity to the given items' mean
+    direction (similar-product template behavior)."""
+    norms = np.linalg.norm(V, axis=1, keepdims=True)
+    Vn = V / np.maximum(norms, 1e-12)
+    q = Vn[item_indices].mean(axis=0)
+    qn = q / max(np.linalg.norm(q), 1e-12)
+    scores = Vn @ qn
+    if exclude_self:
+        scores = scores.copy()
+        scores[item_indices] = -np.inf
+    num = min(num, scores.shape[0])
+    top = np.argpartition(-scores, num - 1)[:num]
+    top = top[np.argsort(-scores[top])]
+    return top, scores[top]

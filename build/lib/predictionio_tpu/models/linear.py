@@ -1,0 +1,212 @@
+"""Logistic regression (multinomial) on TPU.
+
+Replaces MLlib's ``LogisticRegressionWithLBFGS`` used by the reference's
+classification template (SURVEY.md §2c). Optimizer: optax L-BFGS when
+available (the MLlib-equivalent), falling back to Adam. Full-batch
+training under one jit; with a mesh the batch is sharded over the
+``data`` axis and XLA inserts the gradient ``psum`` from the sharding
+annotations — the pjit replacement for MLlib's ``treeAggregate``
+(SURVEY.md §2d P1).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class LogisticRegressionParams:
+    num_classes: int = 2
+    iterations: int = 100
+    reg: float = 0.0           # L2
+    learning_rate: float = 0.1  # used by the adam fallback
+    optimizer: str = "lbfgs"   # "lbfgs" | "adam"
+    seed: int = 0
+
+
+def _device_put_batch(X: np.ndarray, y: np.ndarray, mesh):
+    """Shard the batch over the mesh's data axis (replicated without one)."""
+    import jax
+    import jax.numpy as jnp
+
+    if mesh is None or int(np.prod(mesh.devices.shape)) <= 1:
+        return jnp.asarray(X), jnp.asarray(y)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    n_dev = int(np.prod(mesh.devices.shape))
+    pad = (-len(y)) % n_dev
+    if pad:  # pad with weight-0 rows? simpler: repeat last row; the loss
+        # normalizes by true n via a mask
+        X = np.concatenate([X, np.zeros((pad,) + X.shape[1:], X.dtype)])
+        y = np.concatenate([y, np.zeros(pad, y.dtype)])
+    sx = NamedSharding(mesh, PartitionSpec("data", None))
+    sy = NamedSharding(mesh, PartitionSpec("data"))
+    return jax.device_put(X, sx), jax.device_put(y, sy)
+
+
+def _optimize(loss_fn, W0, b0, lr, iterations: int, use_lbfgs: bool):
+    """The shared optimization harness: scan `iterations` steps of
+    lbfgs (MLlib-equivalent) or adam over ``loss_fn``. ``lr`` is a
+    traced scalar — optax composes it as a multiplier, so it rides
+    through the compiled program (lbfgs line-searches and ignores it).
+    """
+    import jax
+    import optax
+
+    opt = optax.lbfgs() if use_lbfgs else optax.adam(lr)
+
+    def step(carry, _):
+        wb, state = carry
+        loss, grads = jax.value_and_grad(loss_fn)(wb)
+        if use_lbfgs:
+            updates, state = opt.update(
+                grads, state, wb, value=loss, grad=grads,
+                value_fn=loss_fn)
+        else:
+            updates, state = opt.update(grads, state)
+        wb = optax.apply_updates(wb, updates)
+        return (wb, state), loss
+
+    wb0 = (W0, b0)
+    (wb, _), losses = jax.lax.scan(
+        step, (wb0, opt.init(wb0)), None, length=iterations)
+    return wb, losses
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_logreg(iterations: int, use_lbfgs: bool):
+    """Geometry-free compiled trainer: the data, initial weights, mask
+    bound, reg and learning rate are all ARGUMENTS (shapes key jit's
+    own cache), so same-shape datasets and same-shape grid candidates
+    share one executable — and the batch is no longer baked into the
+    program as a constant (the previous per-call closure re-traced and
+    re-embedded X on every call)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def run(Xd, yd, W0, b0, n_real, reg, lr):
+        mask = jnp.arange(Xd.shape[0]) < n_real
+
+        def loss_fn(wb):
+            W, b = wb
+            logits = Xd @ W + b
+            ll = optax.softmax_cross_entropy_with_integer_labels(logits, yd)
+            ll = jnp.where(mask, ll, 0.0).sum() / n_real
+            return ll + 0.5 * reg * (W * W).sum()
+
+        return _optimize(loss_fn, W0, b0, lr, iterations, use_lbfgs)
+
+    return jax.jit(run)
+
+
+def logreg_train(
+    X: np.ndarray, y: np.ndarray, params: LogisticRegressionParams, mesh=None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Train; returns (W [d, C], b [C])."""
+    import jax.numpy as jnp
+    import optax
+
+    n, d = X.shape
+    C = params.num_classes
+    n_real = n
+    Xd, yd = _device_put_batch(X.astype(np.float32), y.astype(np.int32), mesh)
+
+    run = _compiled_logreg(
+        int(params.iterations),
+        params.optimizer == "lbfgs" and hasattr(optax, "lbfgs"))
+    (W, b), _losses = run(Xd, yd,
+                          jnp.zeros((d, C), jnp.float32),
+                          jnp.zeros((C,), jnp.float32),
+                          jnp.int32(n_real),
+                          jnp.float32(params.reg),
+                          jnp.float32(params.learning_rate))
+    return np.asarray(W), np.asarray(b)
+
+
+def logreg_train_many(
+    X: np.ndarray, y: np.ndarray,
+    params_list: Sequence[LogisticRegressionParams], mesh=None,
+) -> list:
+    """Train k candidates on the SAME batch — the `pio eval` grid
+    fan-out (SURVEY.md §2d P4). Candidates sharing geometry (classes,
+    iterations, optimizer) differ only in continuous hyperparameters
+    (reg, learning rate), so they STACK: one ``vmap``-ed program runs
+    the whole grid in a single dispatch. (Since r4 the sequential path
+    also compiles once — reg/lr are traced there too — so stacking's
+    remaining win is one device run instead of k, which is where the
+    wall-clock goes on small classification batches.) Mixed geometries
+    fall back per group; order is preserved. Returns ``[(W, b), ...]``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    out: list = [None] * len(params_list)
+    groups: dict = {}
+    for i, p in enumerate(params_list):
+        groups.setdefault(
+            (p.num_classes, p.iterations, p.optimizer), []).append(i)
+    for (C, iters, optname), idxs in groups.items():
+        if len(idxs) == 1 or (mesh is not None
+                              and int(np.prod(mesh.devices.shape)) > 1):
+            # sharded batches keep the un-vmapped path (vmap over a
+            # sharded axis would need a 2D mesh); single candidates
+            # gain nothing from stacking
+            for i in idxs:
+                out[i] = logreg_train(X, y, params_list[i], mesh)
+            continue
+        n, d = X.shape
+        Xd, yd = jnp.asarray(X, jnp.float32), jnp.asarray(y, jnp.int32)
+        regs = jnp.asarray([params_list[i].reg for i in idxs], jnp.float32)
+        lrs = jnp.asarray([params_list[i].learning_rate for i in idxs],
+                          jnp.float32)
+        run = _compiled_logreg_many(
+            int(iters), optname == "lbfgs" and hasattr(optax, "lbfgs"))
+        Ws, bs = run(regs, lrs, Xd, yd,
+                     jnp.zeros((d, C), jnp.float32),
+                     jnp.zeros((C,), jnp.float32))
+        Ws, bs = np.asarray(Ws), np.asarray(bs)
+        for j, i in enumerate(idxs):
+            out[i] = (Ws[j], bs[j])
+    return out
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_logreg_many(iterations: int, use_lbfgs: bool):
+    """The stacked (vmapped) grid trainer, cached like
+    :func:`_compiled_logreg` — data enters as arguments, not closed-over
+    constants, so re-running a grid on fresh data reuses the program."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def train_one(reg, lr, Xd, yd, W0, b0):
+        def loss_fn(wb):
+            W, b = wb
+            logits = Xd @ W + b
+            ll = optax.softmax_cross_entropy_with_integer_labels(
+                logits, yd).mean()
+            return ll + 0.5 * reg * (W * W).sum()
+
+        wb, _losses = _optimize(loss_fn, W0, b0, lr, iterations, use_lbfgs)
+        return wb
+
+    return jax.jit(jax.vmap(train_one,
+                            in_axes=(0, 0, None, None, None, None)))
+
+
+def logreg_predict(W: np.ndarray, b: np.ndarray, X: np.ndarray) -> np.ndarray:
+    """Class indices for rows of X."""
+    return np.argmax(X @ W + b, axis=-1)
+
+
+def logreg_predict_proba(W: np.ndarray, b: np.ndarray, X: np.ndarray) -> np.ndarray:
+    z = X @ W + b
+    z = z - z.max(axis=-1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=-1, keepdims=True)

@@ -1,0 +1,67 @@
+"""Naive Bayes (multinomial / bernoulli) on TPU.
+
+Replaces MLlib's ``NaiveBayes`` used by the reference's classification
+template (SURVEY.md §2c). The per-class aggregation — MLlib's
+``aggregateByKey`` over label keys — becomes a single one-hot matmul
+``Yᵀ X`` on the MXU; smoothing and log-normalization follow MLlib's
+formulas (λ additive smoothing).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import numpy as np
+
+
+@dataclass
+class NaiveBayesParams:
+    lambda_: float = 1.0
+    model_type: str = "multinomial"  # or "bernoulli"
+    num_classes: int = 0  # 0 → infer from labels
+
+
+def nb_train(
+    X: np.ndarray, y: np.ndarray, params: NaiveBayesParams, mesh=None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Train; returns (log_prior [C], log_theta [C, d])."""
+    import jax
+    import jax.numpy as jnp
+
+    C = params.num_classes or int(y.max()) + 1
+    d = X.shape[1]
+    lam = params.lambda_
+    bern = params.model_type == "bernoulli"
+
+    @jax.jit
+    def fit(Xd, yd):
+        Xb = (Xd > 0).astype(jnp.float32) if bern else Xd
+        Y = jax.nn.one_hot(yd, C, dtype=jnp.float32)  # (n, C)
+        class_count = Y.sum(axis=0)                    # (C,)
+        feat_sum = Y.T @ Xb                            # (C, d) — MXU matmul
+        log_prior = jnp.log(class_count + lam) - jnp.log(
+            class_count.sum() + C * lam)
+        if bern:
+            # P(feature on | class), complement handled at predict time
+            log_theta = (jnp.log(feat_sum + lam)
+                         - jnp.log(class_count[:, None] + 2.0 * lam))
+        else:
+            log_theta = (jnp.log(feat_sum + lam)
+                         - jnp.log(feat_sum.sum(axis=1, keepdims=True) + d * lam))
+        return log_prior, log_theta
+
+    lp, lt = fit(jnp.asarray(X, jnp.float32), jnp.asarray(y, jnp.int32))
+    return np.asarray(lp), np.asarray(lt)
+
+
+def nb_predict(log_prior: np.ndarray, log_theta: np.ndarray, X: np.ndarray,
+               model_type: str = "multinomial") -> np.ndarray:
+    if model_type == "bernoulli":
+        Xb = (X > 0).astype(np.float32)
+        theta = np.exp(log_theta)
+        log_neg = np.log1p(-np.clip(theta, 1e-12, 1 - 1e-12))
+        scores = Xb @ log_theta.T + (1.0 - Xb) @ log_neg.T + log_prior
+    else:
+        scores = X @ log_theta.T + log_prior
+    return np.argmax(scores, axis=-1)

@@ -1,0 +1,338 @@
+"""Two-tower retrieval model (flax + optax), data-parallel over the mesh.
+
+The deep-retrieval target of BASELINE.json (config 5) — not present in
+the reference (SURVEY.md §2c lists it as the new-framework extension):
+user and item ID-embedding towers with MLP heads, trained with in-batch
+sampled-softmax contrastive loss. TPU mapping: batches are sharded over
+the ``data`` mesh axis (XLA inserts the gradient all-reduce), embeddings
+and MLP weights replicated; serving scores a user embedding against the
+full item-embedding table with one MXU matmul + top_k.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class TwoTowerParams:
+    embed_dim: int = 32
+    hidden: List[int] = field(default_factory=lambda: [64])
+    out_dim: int = 32
+    batch_size: int = 1024
+    epochs: int = 5
+    learning_rate: float = 0.01
+    temperature: float = 0.1
+    seed: int = 0
+    # mid-train checkpoint/resume (SURVEY.md §5): save full state every
+    # N epochs; a restarted train with the same dir resumes at the
+    # newest epoch. None disables.
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 1
+    # streaming path: total pair count from the reader's vocabulary
+    # pass (avoids an extra counting pass over the event log)
+    n_pairs: int = 0
+
+
+def _towers(n_users: int, n_items: int, p: TwoTowerParams):
+    import flax.linen as nn
+
+    class Tower(nn.Module):
+        vocab: int
+        p: TwoTowerParams
+
+        @nn.compact
+        def __call__(self, ids):
+            x = nn.Embed(self.vocab, self.p.embed_dim,
+                         embedding_init=nn.initializers.normal(0.05))(ids)
+            for h in self.p.hidden:
+                x = nn.relu(nn.Dense(h)(x))
+            x = nn.Dense(self.p.out_dim)(x)
+            # L2-normalized embeddings → cosine retrieval
+            return x / (np.float32(1e-8) + jnp_norm(x))
+
+    def jnp_norm(x):
+        import jax.numpy as jnp
+
+        return jnp.linalg.norm(x, axis=-1, keepdims=True)
+
+    return Tower(n_users, p), Tower(n_items, p)
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled_train_epoch(n_users: int, n_items: int, embed_dim: int,
+                          hidden: Tuple[int, ...], out_dim: int):
+    """Geometry-keyed training program. ``learning_rate`` rides INSIDE
+    the optimizer state (``optax.inject_hyperparams``) and
+    ``temperature`` is a traced scalar argument, so eval-grid
+    candidates differing only in those share one executable — and
+    repeated train calls at one geometry stop re-tracing (the previous
+    per-call ``@jax.jit`` closure compiled every call).
+
+    Returns ``(user_tower, item_tower, opt, train_epoch)`` with
+    ``train_epoch(variables, opt_state, users_e, items_e, temperature)``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    geom = TwoTowerParams(embed_dim=embed_dim, hidden=list(hidden),
+                          out_dim=out_dim)
+    user_tower, item_tower = _towers(n_users, n_items, geom)
+    # the init value is a placeholder: the caller sets
+    # opt_state.hyperparams["learning_rate"] per candidate
+    opt = optax.inject_hyperparams(optax.adam)(learning_rate=1e-3)
+
+    def loss_fn(variables, bu, bi, temperature):
+        uvv, ivv = variables
+        ue = user_tower.apply(uvv, bu)          # (B, D)
+        ie = item_tower.apply(ivv, bi)          # (B, D)
+        logits = (ue @ ie.T) / temperature      # in-batch negatives
+        labels = jnp.arange(bu.shape[0])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    @jax.jit
+    def train_epoch(variables, opt_state, users_e, items_e, temperature):
+        def step(carry, batch):
+            variables, opt_state = carry
+            bu, bi = batch
+            loss, grads = jax.value_and_grad(loss_fn)(
+                variables, bu, bi, temperature)
+            updates, opt_state = opt.update(grads, opt_state)
+            variables = optax.apply_updates(variables, updates)
+            return (variables, opt_state), loss
+
+        (variables, opt_state), losses = jax.lax.scan(
+            step, (variables, opt_state), (users_e, items_e))
+        return variables, opt_state, losses.mean()
+
+    return user_tower, item_tower, opt, train_epoch
+
+
+def two_tower_train(
+    user_idx: np.ndarray, item_idx: np.ndarray,
+    n_users: int, n_items: int,
+    params: TwoTowerParams, mesh=None,
+    pair_chunks: Optional[Any] = None,
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Train on positive (user, item) pairs; returns (user_variables,
+    item_variables) flax param pytrees (host numpy).
+
+    ``pair_chunks`` (a zero-arg callable returning an iterator of
+    (user_idx, item_idx, …) numpy chunks, e.g.
+    ``InteractionData.chunks``) selects the STREAMING input path: each
+    epoch re-streams the chunks through a
+    :class:`~predictionio_tpu.data.pipeline.DevicePrefetcher`
+    (double-buffered host→HBM) and shuffles WITHIN chunks — event logs
+    larger than host RAM train, at the cost of chunk-local instead of
+    global shuffling (the standard streaming trade-off; pass the whole
+    dataset as one chunk to recover exact global-shuffle semantics).
+    Sub-batch remainders carry into the next chunk. ``user_idx``/
+    ``item_idx`` may then be empty; the pair count comes from
+    ``params.n_pairs`` (the reader's vocabulary pass) or, failing that,
+    one extra counting pass."""
+    import jax
+    import jax.numpy as jnp
+
+    p = params
+    user_tower, item_tower, opt, epoch_fn = _compiled_train_epoch(
+        n_users, n_items, p.embed_dim, tuple(p.hidden), p.out_dim)
+    rng = jax.random.PRNGKey(p.seed)
+    ru, ri = jax.random.split(rng)
+    uv = user_tower.init(ru, jnp.zeros((1,), jnp.int32))
+    iv = item_tower.init(ri, jnp.zeros((1,), jnp.int32))
+    temperature = jnp.float32(p.temperature)
+
+    def train_epoch(variables, opt_state, users_e, items_e):
+        return epoch_fn(variables, opt_state, users_e, items_e,
+                        temperature)
+
+    n = len(user_idx)
+    if pair_chunks is not None and n == 0:
+        if p.n_pairs:
+            n = p.n_pairs  # caller already counted (vocabulary pass)
+        else:
+            n = sum(len(c[0]) for c in pair_chunks())
+    if n < 2:
+        raise ValueError("two-tower training needs at least 2 positive pairs "
+                         "(in-batch negatives)")
+    n_dev = 1
+    if mesh is not None and int(np.prod(mesh.devices.shape)) > 1:
+        n_dev = int(np.prod(mesh.devices.shape))
+    B = min(p.batch_size, n)
+    if n_dev > 1:
+        # batch axis is sharded over the mesh → must divide evenly
+        B = max(n_dev, (B // n_dev) * n_dev)
+        if B > n:  # too few pairs to fill one sharded batch → run unsharded
+            n_dev = 1
+            B = min(p.batch_size, n)
+    n_batches = max(1, n // B)
+    variables = (uv, iv)
+    opt_state = opt.init(variables)
+    # the candidate's learning rate enters THROUGH the optimizer state
+    # (a traced leaf), not the compiled program
+    opt_state.hyperparams["learning_rate"] = jnp.float32(p.learning_rate)
+
+    if n_dev > 1:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        batch_sharding = NamedSharding(mesh, PartitionSpec(None, "data"))
+    else:
+        batch_sharding = None
+
+    # mid-train checkpoint/resume: per-epoch RNG is seeded by epoch index
+    # so a resumed run replays the exact batch permutations a straight
+    # run would have used
+    start_epoch = 0
+    ckpt = None
+    if p.checkpoint_dir:
+        from predictionio_tpu.utils.checkpoint import TrainCheckpointer
+
+        ckpt = TrainCheckpointer(p.checkpoint_dir)
+        if ckpt.latest_step() is not None:
+            from predictionio_tpu.utils.checkpoint import (
+                CheckpointGeometryError,
+            )
+
+            try:
+                template = {"variables": variables, "opt_state": opt_state}
+                state, latest = ckpt.restore_latest_compatible(template)
+                variables, opt_state = state["variables"], state["opt_state"]
+                start_epoch = latest
+                # THIS run's learning rate wins over the checkpointed
+                # one — a restart that lowers lr to anneal must not
+                # silently train at the old rate (r4 review)
+                opt_state.hyperparams["learning_rate"] = \
+                    jnp.float32(p.learning_rate)
+            except CheckpointGeometryError:
+                # CONFIRMED stale (e.g. different tower geometry) →
+                # fresh start; wipe so the stale latest_step can't
+                # shadow this run's saves. Transient read errors
+                # propagate — wiping would destroy valid checkpoints.
+                import warnings
+
+                warnings.warn(
+                    "two_tower checkpoints are stale (geometry/format change) — wiped; training restarts from scratch",
+                    RuntimeWarning)
+                ckpt.clear()
+
+    last_loss = None
+    for epoch in range(start_epoch, p.epochs):
+        if pair_chunks is not None:
+            # streaming path (SURVEY §2d C4): shuffle within each chunk,
+            # reshape to scan batches, and let the prefetcher decode +
+            # device_put the NEXT chunk while this one trains
+            from predictionio_tpu.data.pipeline import DevicePrefetcher
+
+            erng = np.random.default_rng(p.seed + epoch)
+
+            # fixed-size (G, B) step groups: one dispatch and one
+            # device_put per G steps, so the depth-2 prefetcher buffers
+            # ~2·G steps of work and chunk decode genuinely overlaps
+            # compute (per-(1, B)-step yields shrank the window to ~2
+            # sub-millisecond steps — the device stalled at every chunk
+            # boundary). Remainders carry across chunks; the tail that
+            # can't fill a group trains as (1, B) steps — exactly TWO
+            # compiled shapes regardless of chunk geometry.
+            G = max(1, 65536 // B)
+
+            def host_batches():
+                carry_u = np.zeros(0, np.int32)
+                carry_i = np.zeros(0, np.int32)
+                for chunk in pair_chunks():
+                    u_c = np.concatenate([carry_u, np.asarray(chunk[0],
+                                                              np.int32)])
+                    i_c = np.concatenate([carry_i, np.asarray(chunk[1],
+                                                              np.int32)])
+                    g = len(u_c) // (G * B)
+                    if g == 0:
+                        carry_u, carry_i = u_c, i_c
+                        continue
+                    cperm = erng.permutation(len(u_c))
+                    take, rest = cperm[: g * G * B], cperm[g * G * B:]
+                    carry_u, carry_i = u_c[rest], i_c[rest]
+                    ub = u_c[take].reshape(g, G, B)
+                    ib = i_c[take].reshape(g, G, B)
+                    for j in range(g):
+                        yield ub[j], ib[j]
+                m = len(carry_u) // B
+                if m:
+                    cperm = erng.permutation(len(carry_u))[: m * B]
+                    ub = carry_u[cperm].reshape(m, B)
+                    ib = carry_i[cperm].reshape(m, B)
+                    for j in range(m):
+                        yield ub[j:j + 1], ib[j:j + 1]
+
+            steps = 0
+            with DevicePrefetcher(host_batches(),
+                                  sharding=batch_sharding) as pf:
+                for ue, ie in pf:
+                    variables, opt_state, last_loss = train_epoch(
+                        variables, opt_state, ue, ie)
+                    steps += int(ue.shape[0])
+            if steps == 0:
+                raise ValueError(
+                    f"streaming train performed zero steps: {n} pairs "
+                    f"never filled one batch of {B}; lower batch_size")
+        else:
+            perm = np.random.default_rng(p.seed + epoch).permutation(n)[: n_batches * B]
+            ue = user_idx[perm].reshape(n_batches, B).astype(np.int32)
+            ie = item_idx[perm].reshape(n_batches, B).astype(np.int32)
+            if batch_sharding is not None:
+                ue = jax.device_put(ue, batch_sharding)
+                ie = jax.device_put(ie, batch_sharding)
+            variables, opt_state, last_loss = train_epoch(
+                variables, opt_state, jnp.asarray(ue), jnp.asarray(ie))
+        if ckpt is not None and (epoch + 1) % max(1, p.checkpoint_every) == 0:
+            ckpt.save(epoch + 1, {"variables": jax.tree.map(np.asarray, variables),
+                                  "opt_state": jax.tree.map(np.asarray, opt_state)})
+    if ckpt is not None:
+        ckpt.close()
+    uvv, ivv = variables
+    return (jax.tree.map(np.asarray, uvv), jax.tree.map(np.asarray, ivv))
+
+
+def _tower_forward_np(variables, ids: np.ndarray) -> np.ndarray:
+    """Numpy replay of the tower forward pass (Embed → Dense+relu… → Dense
+    → L2 normalize). Serving stays off the accelerator: a per-query tower
+    pass is a handful of tiny GEMVs — host numpy beats a device dispatch
+    on p50 and keeps serving alive when no accelerator is attached."""
+    p = variables["params"]
+    x = np.asarray(p["Embed_0"]["embedding"])[ids]
+    dense_names = sorted((k for k in p if k.startswith("Dense_")),
+                         key=lambda k: int(k.split("_")[1]))
+    for j, name in enumerate(dense_names):
+        x = x @ np.asarray(p[name]["kernel"]) + np.asarray(p[name]["bias"])
+        if j < len(dense_names) - 1:
+            x = np.maximum(x, 0.0)
+    return x / (1e-8 + np.linalg.norm(x, axis=-1, keepdims=True))
+
+
+def two_tower_embed_items(item_variables, n_items: int,
+                          params: TwoTowerParams) -> np.ndarray:
+    """Precompute the full item-embedding table for serving."""
+    return _tower_forward_np(item_variables, np.arange(n_items))
+
+
+def two_tower_user_embed(user_variables, user_id: int, n_users: int,
+                         params: TwoTowerParams) -> np.ndarray:
+    return _tower_forward_np(user_variables, np.asarray([user_id]))[0]
+
+
+def two_tower_embed_users(user_variables, n_users: int,
+                          params: TwoTowerParams,
+                          chunk: int = 65536) -> np.ndarray:
+    """Precompute every user's embedding (r5). With both tables
+    materialized, two-tower serving rides the SAME device-resident
+    gather→score→top-k program as ALS (`models/als.ResidentScorer`) —
+    one dispatch per (micro-)batch instead of a host matvec per query.
+    Chunked so the intermediate activations stay bounded."""
+    return np.concatenate([
+        _tower_forward_np(user_variables, np.arange(lo, min(lo + chunk,
+                                                            n_users)))
+        for lo in range(0, n_users, chunk)])

@@ -1,0 +1,44 @@
+"""TPU kernels and compute primitives for the hot ops.
+
+The reference delegates its hot math to Spark MLlib → netlib BLAS
+(SURVEY.md §2b); here the equivalents are XLA programs plus hand-written
+Pallas TPU kernels for the ops where fusion/streaming matters:
+
+- :mod:`.gram` — batched weighted Gram accumulation (the ALS inner op).
+- :mod:`.topk` — streaming score+top-k over item tiles (serving path).
+- :mod:`.segment` — segment reductions (Naive Bayes, CCO counts).
+
+Every Pallas kernel has an XLA fallback; ``use_pallas()`` decides by
+backend (compiled on TPU, XLA elsewhere, interpret-mode in tests).
+"""
+
+from predictionio_tpu.ops.gram import rows_gram, rows_gram_xla
+from predictionio_tpu.ops.segment import segment_count, segment_mean, segment_sum
+from predictionio_tpu.ops.topk import score_topk, score_topk_xla
+
+
+def use_pallas(platform=None) -> bool:
+    """Compiled Pallas kernels only make sense on real TPU backends.
+
+    ``platform`` is the platform the trace will actually run on (pass
+    the mesh's / target device's ``.platform``); when None the default
+    backend decides — callers compiling for an explicit device or mesh
+    must pass it, because ``jax.default_backend()`` can differ from the
+    execution platform (e.g. CPU mesh under a tunneled-TPU backend).
+    ``PIO_NO_PALLAS=1`` forces the XLA fallbacks (A/B benching, triage).
+    """
+    import os
+
+    if os.environ.get("PIO_NO_PALLAS"):
+        return False
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    return platform == "tpu"
+
+
+__all__ = [
+    "rows_gram", "rows_gram_xla", "score_topk", "score_topk_xla",
+    "segment_sum", "segment_count", "segment_mean", "use_pallas",
+]

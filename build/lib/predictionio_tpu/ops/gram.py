@@ -1,0 +1,98 @@
+"""Batched weighted Gram accumulation — the ALS inner op, as a Pallas kernel.
+
+NOTE: since the bucketed-layout rework, ALS training builds its Grams
+with plain XLA einsums inside ``models/als.py _make_half`` (XLA fuses
+the weighting there); this kernel is kept as the Pallas reference
+implementation of the fused weighted Gram (exercised by tests/test_ops)
+for when a hand-fused variant is needed again.
+
+Per padded rating row r:
+
+    A_r = Fᵣᵀ · diag(w_outer[r]) · Fᵣ     (k×k)
+    b_r = Fᵣᵀ · w_b[r]                    (k)
+
+where ``F_g[r] = F_other[other_idx[r]]`` is the (W, k) gathered factor
+block. This replaces MLlib ALS's per-row BLAS ``dspr``/LAPACK ``dppsv``
+normal-equation builds (reference: [U] mllib ALS NormalEquation — see
+SURVEY.md §2d P2) with MXU work: two dot_generals per row block, the
+weighting fused into the same kernel so the weighted copy of F never
+round-trips through HBM.
+
+Grid: one program per block of RB rows. All operands stream through
+VMEM via BlockSpec pipelining (double-buffered by the Pallas runtime).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def rows_gram_xla(F_g, w_outer, w_b):
+    """XLA fallback: (R,W,k),(R,W),(R,W) → A (R,k,k), b (R,k)."""
+    A = jnp.einsum("rw,rwk,rwl->rkl", w_outer, F_g, F_g,
+                   preferred_element_type=jnp.float32)
+    b = jnp.einsum("rw,rwk->rk", w_b, F_g,
+                   preferred_element_type=jnp.float32)
+    return A, b
+
+
+def _gram_kernel(Fg_ref, wo_ref, wb_ref, A_ref, b_ref, *, block_rows: int):
+    # Mosaic has no batched dot_general — unroll the block into per-row
+    # 2D (k,W)x(W,k) MXU dots. block_rows is small and static.
+    for r in range(block_rows):
+        F = Fg_ref[r]                      # (W, k)
+        Fw = F * wo_ref[r][:, None]        # VPU; fused, never hits HBM
+        A_ref[r] = jax.lax.dot_general(
+            Fw, F, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)  # f32 normal equations
+            # (+13% kernel time over bf16, err 6e-5 vs 3e-1; ALS solves
+            # are sensitive to Gram precision)
+        b_ref[r] = jnp.sum(F * wb_ref[r][:, None], axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def rows_gram(F_g, w_outer, w_b, *, block_rows: int = 8,
+              interpret: bool = False):
+    """Pallas fused weighted-Gram: same contract as :func:`rows_gram_xla`.
+
+    ``interpret=True`` runs the Mosaic interpreter (CPU tests).
+    """
+    R, W, k = F_g.shape
+    if R % block_rows != 0:
+        block_rows = 1 if R == 0 else next(
+            b for b in (8, 4, 2, 1) if R % b == 0)
+    grid = (R // block_rows,)
+    return pl.pallas_call(
+        functools.partial(_gram_kernel, block_rows=block_rows),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, W, k), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_rows, W), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_rows, W), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((block_rows, k, k), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_rows, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((R, k, k), jnp.float32),
+            jax.ShapeDtypeStruct((R, k), jnp.float32),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * R * W * k * (k + 1),
+            bytes_accessed=4 * (R * W * k + 2 * R * W + R * k * k + R * k),
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(F_g, w_outer, w_b)

@@ -1,0 +1,37 @@
+"""Segment reductions — the RDD groupBy/reduceByKey replacement.
+
+The reference's per-key aggregations ride Spark's shuffle ([U]
+PairRDDFunctions — SURVEY.md §2d P1). On TPU the same reductions are
+scatter-add programs XLA lowers to dense compute; indices sorted
+host-side let the scatter assert sortedness and skip the hash pass.
+These are the grouping primitives offered to DASE template authors and
+used by the e2 helpers (categorical NB class/feature counts, Markov
+chain transition counts); the core models that can express their
+aggregation as a one-hot matmul (models/naive_bayes.py) deliberately do
+that instead — matmuls beat scatters on the MXU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def segment_sum(data, segment_ids, num_segments: int, *, sorted_ids: bool = False):
+    """Sum ``data`` rows into ``num_segments`` buckets by ``segment_ids``."""
+    shape = (num_segments,) + data.shape[1:]
+    return jnp.zeros(shape, data.dtype).at[segment_ids].add(
+        data, indices_are_sorted=sorted_ids)
+
+
+def segment_count(segment_ids, num_segments: int, *, sorted_ids: bool = False):
+    """Occurrence count per segment id."""
+    return jnp.zeros((num_segments,), jnp.int32).at[segment_ids].add(
+        1, indices_are_sorted=sorted_ids)
+
+
+def segment_mean(data, segment_ids, num_segments: int, *, sorted_ids: bool = False):
+    """Per-segment mean with empty segments → 0."""
+    s = segment_sum(data, segment_ids, num_segments, sorted_ids=sorted_ids)
+    c = segment_count(segment_ids, num_segments, sorted_ids=sorted_ids)
+    c = jnp.maximum(c, 1).astype(s.dtype)
+    return s / c.reshape((-1,) + (1,) * (s.ndim - 1))

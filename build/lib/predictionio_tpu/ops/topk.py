@@ -1,0 +1,129 @@
+"""Streaming score→top-k over item tiles — the serving hot path kernel.
+
+Recommendation serving scores a query batch against the full item-factor
+matrix and keeps the top-k: ``scores = Q Vᵀ`` is (B, n_items) — at
+ML-20M scale that is a 100+MB intermediate per batch that XLA would
+materialize in HBM between the matmul and the top_k (reference serving
+does the same dense score in JVM memory: [U] MLlib
+``MatrixFactorizationModel.recommendProducts`` — SURVEY.md §3.2).
+
+This kernel tiles the item axis: each grid step does one (B,d)×(d,T)
+matmul on the MXU and folds the tile into a running (B, k) best-list in
+VMEM scratch, so HBM traffic is just Q + V + the (B,k) result. The
+running merge uses only max/min reductions (no sort/top_k primitive —
+portable Mosaic).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -3.0e38  # finite "-inf" (python float so the kernel doesn't capture a traced constant)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_valid"))
+def score_topk_xla(Q, V, k: int, n_valid: int = 0):
+    """XLA fallback: full (B, N) score matrix then lax.top_k.
+
+    ``n_valid``: real row count when V carries tail padding (lets a
+    caller share one padded resident copy with :func:`score_topk`).
+    Jitted: the serving path must be ONE dispatch — eager ops each pay
+    a host→device round trip (brutal over a tunneled chip).
+    """
+    scores = jnp.dot(Q, V.T, preferred_element_type=jnp.float32,
+                     precision=jax.lax.Precision.HIGHEST)
+    if n_valid and n_valid < V.shape[0]:
+        col = jnp.arange(V.shape[0])[None, :]
+        scores = jnp.where(col < n_valid, scores, _NEG)
+    vals, idx = jax.lax.top_k(scores, k)
+    return vals, idx.astype(jnp.int32)
+
+
+def _topk_kernel(Q_ref, V_ref, vals_ref, idx_ref, best_v, best_i,
+                 *, k: int, tile: int, n_items: int):
+    step = pl.program_id(0)
+    n_steps = pl.num_programs(0)
+
+    @pl.when(step == 0)
+    def _():
+        best_v[:] = jnp.full_like(best_v, _NEG)
+        best_i[:] = jnp.zeros_like(best_i)
+
+    B = Q_ref.shape[0]
+    scores = jax.lax.dot_general(              # (B, T) on the MXU
+        Q_ref[:], V_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)   # f32 scores → stable ranking
+    col = jax.lax.broadcasted_iota(jnp.int32, (B, tile), 1) + step * tile
+    scores = jnp.where(col < n_items, scores, _NEG)  # mask tail padding
+
+    cand_v = jnp.concatenate([best_v[:], scores], axis=1)        # (B, k+T)
+    cand_i = jnp.concatenate([best_i[:], col], axis=1)
+    pos = jax.lax.broadcasted_iota(jnp.int32, cand_v.shape, 1)
+    BIG = jnp.int32(2**30)
+
+    # k rounds of (max, first-argmax-by-min-position, knock out) — selection
+    # via pure max/min reductions, k is small and static.
+    for j in range(k):
+        m = jnp.max(cand_v, axis=1)                               # (B,)
+        hit = cand_v == m[:, None]
+        p = jnp.min(jnp.where(hit, pos, BIG), axis=1)             # (B,)
+        sel = pos == p[:, None]
+        best_v[:, j] = m
+        best_i[:, j] = jnp.sum(jnp.where(sel, cand_i, 0), axis=1)
+        cand_v = jnp.where(sel, _NEG, cand_v)
+
+    @pl.when(step == n_steps - 1)
+    def _():
+        vals_ref[:] = best_v[:]
+        idx_ref[:] = best_i[:]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "tile", "n_valid", "interpret"))
+def score_topk(Q, V, k: int, *, tile: int = 512, n_valid: int = 0,
+               interpret: bool = False):
+    """(B,d),(N,d) → top-k (vals (B,k), idx (B,k)) of Q·Vᵀ, streamed.
+
+    Pass a pre-padded V (rows a multiple of ``tile``) with ``n_valid``
+    set to the real item count to avoid a per-call pad of the factor
+    matrix on the serving hot path.
+    """
+    B, d = Q.shape
+    N = n_valid or V.shape[0]
+    n_pad = -V.shape[0] % tile
+    if n_pad:
+        V = jnp.concatenate([V, jnp.zeros((n_pad, d), V.dtype)], axis=0)
+    grid = (V.shape[0] // tile,)
+    kern = functools.partial(_topk_kernel, k=k, tile=tile, n_items=N)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((B, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((B, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, k), jnp.float32),
+            jax.ShapeDtypeStruct((B, k), jnp.int32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((B, k), jnp.float32),
+            pltpu.VMEM((B, k), jnp.int32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * B * d * V.shape[0] + 2 * B * k * V.shape[0],
+            bytes_accessed=4 * (B * d + V.shape[0] * d + 2 * B * k),
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(Q, V)

@@ -1,0 +1,16 @@
+from predictionio_tpu.parallel.mesh import (
+    MeshConfig,
+    make_mesh,
+    shard_batch,
+    replicated,
+)
+from predictionio_tpu.parallel.ring_attention import (
+    attention_reference,
+    ring_attention,
+)
+from predictionio_tpu.parallel.ulysses import ulysses_attention
+
+__all__ = [
+    "MeshConfig", "make_mesh", "shard_batch", "replicated",
+    "attention_reference", "ring_attention", "ulysses_attention",
+]

@@ -1,0 +1,156 @@
+"""Multi-host distributed runtime: the communication-backend shell.
+
+The reference's distributed story is Spark's driver/executor control
+plane over netty RPC plus the shuffle service (SURVEY.md §2d P5/C1-C2).
+The TPU-native equivalent is the JAX multi-controller model: one Python
+process per host, rendezvoused over DCN by ``jax.distributed``, with
+**no** driver/worker asymmetry inside compiled regions — collectives
+ride ICI within a slice and DCN across slices. This module is the thin
+shell around that: env-driven initialization, barriers, and the
+host-local vs global device split that data loading needs.
+
+Single-process runs (including CI and the 1-chip bench) skip
+initialization entirely — every helper degrades to the trivial case.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional
+
+_initialized = False
+
+
+@dataclass
+class DistributedConfig:
+    """Rendezvous parameters, usually from the environment.
+
+    Env (same spirit as the reference's PIO_* + Spark master env):
+    ``PIO_COORDINATOR_ADDRESS`` (host:port of process 0),
+    ``PIO_NUM_PROCESSES``, ``PIO_PROCESS_ID``. On Cloud TPU VMs all
+    three are optional — jax.distributed auto-discovers from metadata.
+    """
+
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+
+    @classmethod
+    def from_env(cls) -> "DistributedConfig":
+        e = os.environ
+
+        def num(k: str) -> Optional[int]:
+            return int(e[k]) if k in e else None
+
+        return cls(
+            coordinator_address=e.get("PIO_COORDINATOR_ADDRESS"),
+            num_processes=num("PIO_NUM_PROCESSES"),
+            process_id=num("PIO_PROCESS_ID"),
+        )
+
+    @property
+    def requested(self) -> bool:
+        return self.coordinator_address is not None
+
+
+def _on_multihost_tpu() -> bool:
+    """True when the Cloud-TPU environment itself announces multiple
+    workers (auto-discovery then needs no PIO_* vars)."""
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hosts.split(",") if h.strip()]) > 1:
+        return True
+    return bool(os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
+
+
+def initialize(config: Optional[DistributedConfig] = None) -> bool:
+    """``jax.distributed.initialize``: explicitly when the PIO_* rendezvous
+    vars are set, auto-discovered (argless) when the Cloud-TPU env
+    announces a multi-host slice, otherwise a no-op. Idempotent.
+    Returns True when running multi-process."""
+    global _initialized
+    import jax
+
+    config = config or DistributedConfig.from_env()
+    if _initialized:
+        return jax.process_count() > 1
+    if config.requested:
+        jax.distributed.initialize(
+            coordinator_address=config.coordinator_address,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+        )
+        _initialized = True
+    elif _on_multihost_tpu():
+        jax.distributed.initialize()  # TPU-metadata auto-discovery
+        _initialized = True
+    return jax.process_count() > 1
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    return process_index() == 0
+
+
+def local_devices() -> List:
+    """Devices attached to THIS host (addressable)."""
+    import jax
+
+    return jax.local_devices()
+
+
+def global_devices() -> List:
+    import jax
+
+    return jax.devices()
+
+
+def barrier(name: str = "pio_barrier") -> None:
+    """Cross-host sync point (no-op single-process)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def broadcast_from_coordinator(pytree):
+    """Replicate host-local data from process 0 to all hosts (the
+    reference's torrent-broadcast analogue at the control-plane level)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return pytree
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(pytree)
+
+
+def broadcast_string(s: str, max_len: int = 256) -> str:
+    """Broadcast a short string (e.g. the engine-instance id minted by
+    the coordinator) to every process."""
+    import jax
+    import numpy as np
+
+    if jax.process_count() <= 1:
+        return s
+    buf = np.zeros(max_len, np.uint8)
+    raw = s.encode()
+    if len(raw) > max_len:
+        raise ValueError(f"string longer than {max_len} bytes")
+    buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+    out = np.asarray(broadcast_from_coordinator(buf))
+    return bytes(out).rstrip(b"\x00").decode()

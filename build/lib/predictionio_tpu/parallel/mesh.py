@@ -1,0 +1,145 @@
+"""Device mesh construction + sharding helpers.
+
+This module replaces the reference's entire Spark control plane — the
+driver/executor topology, shuffle, and broadcast (reference: Spark
+scheduler + netty transport; SURVEY.md §2d) — with the JAX SPMD model:
+pick a :class:`jax.sharding.Mesh`, annotate shardings, and let XLA emit
+ICI collectives. ``mesh_conf`` blocks in engine.json (the analogue of
+the reference's ``sparkConf`` passthrough) resolve here.
+
+Axis conventions used across the framework:
+
+- ``"data"``  — batch / nnz-parallel axis (DP; ALS rating shards,
+  two-tower batch shards)
+- ``"model"`` — parameter-parallel axis (sharded embedding tables /
+  factor matrices when they outgrow one chip's HBM)
+
+Single-process multi-chip and multi-host (``jax.distributed``) both
+yield the same mesh; tests force 8 virtual CPU devices (conftest).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class MeshConfig:
+    """Parsed ``mesh_conf``/``meshConf`` block of engine.json.
+
+    ``{"mesh": {"data": 8}}`` → 1-D 8-way data parallel;
+    ``{"mesh": {"data": 4, "model": 2}}`` → 2-D. Empty → all local
+    devices on the ``data`` axis.
+    """
+
+    axes: Dict[str, int] = field(default_factory=dict)
+    # allow fewer devices than requested (clamp) — useful for CI
+    allow_smaller: bool = True
+
+    @classmethod
+    def from_json(cls, obj: Optional[Dict[str, Any]]) -> "MeshConfig":
+        obj = obj or {}
+        axes = {str(k): int(v) for k, v in (obj.get("mesh") or {}).items()}
+        return cls(axes=axes, allow_smaller=bool(obj.get("allowSmaller", True)))
+
+
+def make_mesh(config: Optional[MeshConfig] = None, devices: Optional[Sequence[Any]] = None):
+    """Build a Mesh per config over the available devices.
+
+    ``PIO_MESH_PLATFORM`` (e.g. ``cpu``) selects which platform's devices
+    back the mesh — the CI hook that swaps the TPU slice for the virtual
+    8-device CPU platform (SURVEY.md §4).
+    """
+    import os
+
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        platform = os.environ.get("PIO_MESH_PLATFORM") or None
+        devices = platform_devices(platform)
+    devs = list(devices)
+    config = config or MeshConfig()
+    axes = dict(config.axes)
+    if not axes:
+        axes = {"data": len(devs)}
+    want = int(np.prod(list(axes.values())))
+    if want > len(devs):
+        if not config.allow_smaller:
+            raise ValueError(f"mesh needs {want} devices, have {len(devs)}")
+        # clamp the largest axis down to what's available
+        biggest = max(axes, key=lambda k: axes[k])
+        other = want // axes[biggest]
+        axes[biggest] = max(1, len(devs) // other)
+        want = int(np.prod(list(axes.values())))
+    grid = np.array(devs[:want]).reshape(tuple(axes.values()))
+    return Mesh(grid, tuple(axes.keys()))
+
+
+def platform_devices(platform: Optional[str] = None):
+    """``jax.devices(platform)`` that tolerates an unavailable default
+    backend.
+
+    jax initializes *every* platform named in JAX_PLATFORMS before
+    returning any of them; on this image a tunneled-TPU ("axon") claim
+    failure would then break CPU-mesh runs too. If init fails and a
+    specific platform was requested, restrict jax to that platform and
+    retry.
+    """
+    import jax
+
+    try:
+        return jax.devices(platform) if platform else jax.devices()
+    except RuntimeError:
+        if not platform:
+            raise
+        jax.config.update("jax_platforms", platform)
+        return jax.devices(platform)
+
+
+def get_shard_map():
+    """jax version compat: shard_map moved out of experimental in 0.6."""
+    try:
+        from jax import shard_map as _sm
+
+        return _sm.shard_map if hasattr(_sm, "shard_map") else _sm
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map
+
+
+def pvary(x, axis: str):
+    """Mark ``x`` varying over ``axis`` (vma typing for scan/fori carries
+    inside shard_map). pcast on new jax, pvary on older."""
+    import jax
+
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis, to="varying")
+    return jax.lax.pvary(x, axis)
+
+
+def replicated(mesh) -> Any:
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_batch(mesh, axis: str = "data") -> Any:
+    """Sharding for a leading-batch-dim array."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def device_count() -> int:
+    import jax
+
+    return jax.device_count()

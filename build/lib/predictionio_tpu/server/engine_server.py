@@ -1,0 +1,283 @@
+"""Engine Server: low-latency query serving on :8000.
+
+Reference: [U] core/.../workflow/CreateServer.scala (MasterActor +
+akka-http; unverified, SURVEY.md §3.2). Routes preserved:
+
+- ``POST /queries.json`` → prediction JSON (the p50-critical path)
+- ``GET  /``             → engine status JSON
+- ``GET  /reload``       → hot-swap to the latest COMPLETED instance
+- ``GET  /stop``         → shut the server down
+- ``GET  /plugins.json`` + ``/plugins/{name}/{path}`` → plugin surface
+
+TPU-first serving design: the model stays resident (factor matrices /
+params as device arrays), prediction runs on a worker thread pool so the
+asyncio loop never blocks on device dispatch, and the optional feedback
+loop posts served (query, prediction, prId) back to the event store —
+the reference's feedback mechanism — without touching the hot path
+(fire-and-forget task).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import uuid
+from typing import Any, Dict, List, Optional
+
+from predictionio_tpu.core.plugins import engine_server_plugins
+from predictionio_tpu.core.workflow import DeployedEngine, prepare_deploy
+from predictionio_tpu.data.event import Event, utcnow
+from predictionio_tpu.server.http import HTTPServer, Request, Response, Router
+from predictionio_tpu.storage.registry import Storage, get_storage
+
+
+class EngineServer:
+    def __init__(
+        self,
+        engine_factory: Optional[str] = None,
+        instance_id: Optional[str] = None,
+        storage: Optional[Storage] = None,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        variant_id: str = "",
+        feedback: bool = False,
+        feedback_app_name: Optional[str] = None,
+        feedback_url: Optional[str] = None,
+        feedback_access_key: Optional[str] = None,
+        feedback_channel: Optional[str] = None,
+        event_sink: Optional[Any] = None,
+        plugins: Optional[List[Any]] = None,
+        ssl_context: Optional[Any] = None,
+        bind_retries: int = 3,
+        bind_retry_sec: float = 1.0,
+        batching: bool = False,
+        batch_max: int = 64,
+        batch_wait_ms: float = 0.0,
+    ) -> None:
+        self.storage = storage or get_storage()
+        self.engine_factory = engine_factory
+        self.variant_id = variant_id
+        self.feedback = feedback or bool(feedback_url) or event_sink is not None
+        self.feedback_app_name = feedback_app_name
+        self._event_sink = event_sink
+        if self._event_sink is None and feedback_url:
+            # the reference contract: feedback goes through the Event
+            # Server's authenticated HTTP API (SURVEY.md §3.2), the only
+            # path that works when event storage is remote to this host
+            from predictionio_tpu.server.eventsink import HTTPEventSink
+
+            if not feedback_access_key:
+                raise ValueError("feedback_url requires feedback_access_key")
+            self._event_sink = HTTPEventSink(
+                feedback_url, feedback_access_key, feedback_channel)
+        self.plugins = plugins if plugins is not None else engine_server_plugins()
+        self.deployed: DeployedEngine = prepare_deploy(
+            engine_factory=engine_factory, instance_id=instance_id,
+            storage=self.storage, variant_id=variant_id)
+        self.start_time = utcnow()
+        self.query_count = 0
+        from predictionio_tpu.utils.metrics import REGISTRY
+
+        self._m_queries = REGISTRY.counter(
+            "pio_engine_queries_total", "Queries served", ("status",))
+        self._m_latency = REGISTRY.histogram(
+            "pio_engine_query_seconds", "Query latency (handler, seconds)")
+        self._m_feedback = REGISTRY.counter(
+            "pio_engine_feedback_total", "Feedback events sent", ("status",))
+        self._feedback_pool = None
+        self._feedback_inflight = 0
+        self._batcher = None
+        if batching:
+            from predictionio_tpu.server.batching import MicroBatcher
+
+            # bind late so /reload hot-swaps reach the batcher too
+            self._batcher = MicroBatcher(
+                lambda qs: self.deployed.batch_query(qs),
+                max_batch=batch_max, max_wait_ms=batch_wait_ms)
+        router = Router()
+        router.route("POST", "/queries.json", self._queries)
+        router.route("GET", "/", self._status)
+        router.route("GET", "/reload", self._reload)
+        router.route("GET", "/stop", self._stop)
+        router.route("GET", "/metrics", self._metrics)
+        router.route("GET", "/plugins.json", self._plugins_list)
+        router.route("GET", "/plugins/{name}/{path+}", self._plugin_route)
+        router.route("POST", "/plugins/{name}/{path+}", self._plugin_route)
+        if ssl_context is None:
+            from predictionio_tpu.server.ssl_config import ssl_context_from_env
+            ssl_context = ssl_context_from_env()
+        self.http = HTTPServer(router, host, port,
+                               ssl_context=ssl_context,
+                               bind_retries=bind_retries,
+                               bind_retry_sec=bind_retry_sec)
+
+    # -- handlers --------------------------------------------------------------
+
+    async def _queries(self, req: Request) -> Response:
+        import time
+
+        t0 = time.perf_counter()
+        try:
+            query = req.json()
+        except json.JSONDecodeError as e:
+            self._m_queries.inc(("400",))
+            return Response.json({"message": f"invalid JSON: {e}"}, status=400)
+        if query is None:
+            self._m_queries.inc(("400",))
+            return Response.json({"message": "empty query"}, status=400)
+        try:
+            if self._batcher is not None:
+                prediction = await self._batcher.submit(query)
+            else:
+                prediction = await asyncio.to_thread(self.deployed.query, query)
+        except (ValueError, KeyError, TypeError) as e:
+            # malformed/invalid query (bad fields, unknown entity, wrong types)
+            self._m_queries.inc(("400",))
+            return Response.json(
+                {"message": f"query failed: {type(e).__name__}: {e}"}, status=400)
+        except Exception as e:
+            # internal fault; retryable, so 500 (the reference returns
+            # 500 on server faults). Micro-batch failures are isolated
+            # per-query by the batcher, so a malformed query still
+            # surfaces as its own ValueError → 400 above.
+            self._m_queries.inc(("500",))
+            return Response.json(
+                {"message": f"server error: {type(e).__name__}: {e}"}, status=500)
+        self._m_queries.inc(("200",))
+        self._m_latency.observe(time.perf_counter() - t0)
+        for p in self.plugins:
+            prediction = p.output_blocker(query, prediction)
+            p.output_sniffer(query, prediction)
+        self.query_count += 1
+        if self.feedback:
+            pr_id = uuid.uuid4().hex
+            if isinstance(prediction, dict):
+                prediction = {**prediction, "prId": pr_id}
+            self._submit_feedback(query, prediction, pr_id)
+        return Response.json(prediction)
+
+    def _submit_feedback(self, query: Any, prediction: Any,
+                         pr_id: str) -> None:
+        """Queue feedback on a DEDICATED small executor — a slow or down
+        Event Server (HTTP sink blocks up to its timeout) must not eat
+        the shared to_thread pool that query handling runs on. Bounded:
+        past 256 in flight, feedback drops (counted), serving doesn't."""
+        import concurrent.futures
+
+        if self._feedback_pool is None:
+            self._feedback_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="pio-feedback")
+        if self._feedback_inflight >= 256:
+            self._m_feedback.inc(("dropped",))
+            return
+        self._feedback_inflight += 1
+
+        def run():
+            try:
+                self._record_feedback(query, prediction, pr_id)
+            finally:
+                self._feedback_inflight -= 1
+
+        self._feedback_pool.submit(run)
+
+    def _sink(self):
+        if self._event_sink is None:
+            # no Event Server configured: fall back to the in-process
+            # write against the app named in the trained instance's
+            # data-source params
+            from predictionio_tpu.server.eventsink import DirectEventSink
+
+            app_name = self.feedback_app_name
+            if not app_name:
+                dsp = json.loads(self.deployed.instance.data_source_params)
+                app_name = dsp.get("app_name") or dsp.get("appName")
+            if not app_name:
+                return None
+            self._event_sink = DirectEventSink(self.storage, app_name)
+        return self._event_sink
+
+    def _record_feedback(self, query: Any, prediction: Any, pr_id: str) -> None:
+        """Feedback loop: served predictions become 'predict' events
+        tagged with prId, delivered through the configured sink —
+        the Event Server's authenticated HTTP API when a feedback URL
+        is set (reference: CreateServer feedback, SURVEY.md §3.2), else
+        a direct local write."""
+        try:
+            sink = self._sink()
+            if sink is None:
+                return
+            sink.send(Event(
+                event="predict",
+                entity_type="pio_pr", entity_id=pr_id,
+                properties={"query": query, "prediction": prediction},
+                pr_id=pr_id,
+            ))
+            self._m_feedback.inc(("ok",))
+        except Exception:
+            self._m_feedback.inc(("error",))  # never breaks serving
+
+    async def _status(self, req: Request) -> Response:
+        ei = self.deployed.instance
+        return Response.json({
+            "status": "alive",
+            "engineFactory": ei.engine_factory,
+            "engineInstanceId": ei.id,
+            "engineVariant": ei.engine_variant,
+            "startTime": self.start_time.isoformat(timespec="milliseconds"),
+            "queryCount": self.query_count,
+            "algorithms": [name for name, _ in self.deployed.algorithms],
+        })
+
+    async def _reload(self, req: Request) -> Response:
+        """Hot-swap to the latest COMPLETED instance (reference: /reload)."""
+        factory = self.engine_factory or self.deployed.instance.engine_factory
+        try:
+            new = await asyncio.to_thread(
+                prepare_deploy, factory, None, self.storage, self.variant_id)
+        except Exception as e:
+            return Response.json({"message": f"reload failed: {e}"}, status=500)
+        self.deployed = new
+        return Response.json({"message": "Reloaded",
+                              "engineInstanceId": new.instance.id})
+
+    async def _stop(self, req: Request) -> Response:
+        asyncio.get_running_loop().call_later(0.05, self.http.request_shutdown)
+        return Response.json({"message": "Shutting down"})
+
+    async def _metrics(self, req: Request) -> Response:
+        from predictionio_tpu.utils.metrics import REGISTRY
+
+        return Response.text(REGISTRY.render(),
+                             content_type="text/plain; version=0.0.4")
+
+    async def _plugins_list(self, req: Request) -> Response:
+        return Response.json({"plugins": {
+            "outputblockers": [p.name for p in self.plugins],
+            "outputsniffers": [p.name for p in self.plugins],
+        }})
+
+    async def _plugin_route(self, req: Request) -> Response:
+        name = req.path_params["name"]
+        for p in self.plugins:
+            if p.name == name:
+                body = req.json() if req.body else None
+                out = p.handle_route(req.path_params["path"], body)
+                return Response.json(out)
+        return Response.json({"message": f"no plugin {name!r}"}, status=404)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def serve_forever(self) -> None:
+        try:
+            await self.http.serve_forever()
+        finally:
+            # the batcher's collector task must die BEFORE the loop
+            # closes: a pending queue.get() getter cancelled at
+            # interpreter teardown touches the closed loop and raises
+            # "Event loop is closed" (surfaced by the r4 concurrency
+            # harness)
+            if self._batcher is not None:
+                self._batcher.stop()
+
+    def run(self) -> None:
+        asyncio.run(self.serve_forever())

@@ -1,0 +1,327 @@
+"""Event Server: the ingestion REST API on :7070.
+
+API contract preserved from the reference (reference: [U]
+data/.../api/EventServer.scala — unverified, SURVEY.md §3.3):
+
+- ``POST /events.json?accessKey=K[&channel=C]`` → 201 ``{"eventId": …}``
+- ``POST /batch/events.json`` — ≤ 50 events, per-item status array
+- ``GET  /events.json`` — filters: startTime/untilTime/entityType/
+  entityId/event/targetEntityType/targetEntityId/limit/reversed
+- ``GET|DELETE /events/{id}.json``
+- ``GET /`` → ``{"status": "alive"}``
+- ``GET /stats.json`` (when started with stats=True)
+- ``POST|GET /webhooks/{connector}.json`` — 3rd-party payload translation
+
+Auth: access key via ``accessKey`` query param or ``Authorization``
+header; keys may restrict permitted event names. Channel by name via
+``channel`` param (must exist).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime as _dt
+import threading
+from collections import Counter
+from typing import Any, Dict, List, Optional, Tuple
+
+from predictionio_tpu.data.event import (
+    Event,
+    EventValidationError,
+    parse_event_time,
+    utcnow,
+)
+from predictionio_tpu.server.http import HTTPServer, Request, Response, Router
+from predictionio_tpu.storage.registry import Storage, get_storage
+
+BATCH_LIMIT = 50
+DEFAULT_FIND_LIMIT = 20
+
+
+class Stats:
+    """Per-app event-type/status counters since server start
+    (reference: Stats/StatsActor behind /stats.json)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.start_time = utcnow()
+        self._counts: Counter = Counter()  # (app_id, event_name, status)
+
+    def record(self, app_id: int, event_name: str, status: int) -> None:
+        with self._lock:
+            self._counts[(app_id, event_name, status)] += 1
+
+    def to_json(self) -> Dict[str, Any]:
+        with self._lock:
+            per_app: Dict[int, List[Dict[str, Any]]] = {}
+            for (app_id, name, status), n in sorted(self._counts.items()):
+                per_app.setdefault(app_id, []).append(
+                    {"event": name, "status": status, "count": n})
+        return {
+            "startTime": self.start_time.isoformat(timespec="milliseconds"),
+            "appStats": [
+                {"appId": app_id, "events": evs} for app_id, evs in per_app.items()
+            ],
+        }
+
+
+class EventServer:
+    def __init__(
+        self,
+        storage: Optional[Storage] = None,
+        host: str = "0.0.0.0",
+        port: int = 7070,
+        stats: bool = False,
+        plugins: Optional[List[Any]] = None,
+        ssl_context: Optional[Any] = None,
+        bind_retries: int = 3,
+        bind_retry_sec: float = 1.0,
+    ) -> None:
+        self.storage = storage or get_storage()
+        self.stats = Stats() if stats else None
+        self.plugins = plugins if plugins is not None else _discover_plugins()
+        from predictionio_tpu.utils.metrics import REGISTRY
+
+        self._m_events = REGISTRY.counter(
+            "pio_events_ingested_total", "Events accepted/rejected",
+            ("app_id", "status"))
+        self._m_insert = REGISTRY.histogram(
+            "pio_event_insert_seconds", "Single-event insert latency")
+        router = Router()
+        router.route("GET", "/", self._status)
+        router.route("GET", "/metrics", self._metrics)
+        router.route("POST", "/events.json", self._post_event)
+        router.route("GET", "/events.json", self._get_events)
+        router.route("POST", "/batch/events.json", self._post_batch)
+        router.route("GET", "/events/{eid}.json", self._get_event)
+        router.route("DELETE", "/events/{eid}.json", self._delete_event)
+        router.route("GET", "/stats.json", self._get_stats)
+        router.route("POST", "/webhooks/{connector}.json", self._webhook)
+        router.route("GET", "/webhooks/{connector}.json", self._webhook_probe)
+        if ssl_context is None:
+            from predictionio_tpu.server.ssl_config import ssl_context_from_env
+            ssl_context = ssl_context_from_env()
+        self.http = HTTPServer(router, host, port,
+                               ssl_context=ssl_context,
+                               bind_retries=bind_retries,
+                               bind_retry_sec=bind_retry_sec)
+
+    # -- auth ------------------------------------------------------------------
+
+    def _auth(self, req: Request) -> Tuple[Optional[Tuple[int, Optional[int], List[str]]], Optional[Response]]:
+        """Returns ((app_id, channel_id, allowed_events), None) or (None, error)."""
+        key = req.param("accessKey")
+        if not key:
+            auth = req.headers.get("authorization", "")
+            # reference SDKs use HTTP basic with the key as username; also
+            # accept a bare "Bearer <key>"
+            if auth.startswith("Bearer "):
+                key = auth[7:].strip()
+            elif auth.startswith("Basic "):
+                import base64
+                try:
+                    key = base64.b64decode(auth[6:]).decode().split(":")[0]
+                except Exception:
+                    key = None
+        if not key:
+            return None, Response.json(
+                {"message": "Missing accessKey."}, status=401)
+        ak = self.storage.meta.get_access_key(key)
+        if ak is None:
+            return None, Response.json(
+                {"message": "Invalid accessKey."}, status=401)
+        channel_id: Optional[int] = None
+        channel = req.param("channel")
+        if channel:
+            ch = self.storage.meta.get_channel_by_name(ak.app_id, channel)
+            if ch is None:
+                return None, Response.json(
+                    {"message": f"Invalid channel {channel!r}."}, status=400)
+            channel_id = ch.id
+        return (ak.app_id, channel_id, ak.events), None
+
+    def _check_permitted(self, allowed: List[str], name: str) -> bool:
+        return not allowed or name in allowed
+
+    # -- handlers --------------------------------------------------------------
+
+    async def _status(self, req: Request) -> Response:
+        return Response.json({"status": "alive"})
+
+    def _insert_one(self, obj: Any, app_id: int, channel_id: Optional[int],
+                    allowed: List[str]) -> Tuple[int, Dict[str, Any]]:
+        import time
+
+        t0 = time.perf_counter()
+        try:
+            ev = Event.from_json(obj)
+        except EventValidationError as e:
+            self._m_events.inc((app_id, 400))
+            return 400, {"message": str(e)}
+        if not self._check_permitted(allowed, ev.event):
+            self._m_events.inc((app_id, 403))
+            return 403, {"message": f"event {ev.event!r} not permitted by this key"}
+        for p in self.plugins:
+            verdict = p.input_blocker(ev, app_id, channel_id)
+            if verdict is not None:
+                self._m_events.inc((app_id, 403))
+                return 403, {"message": verdict}
+        eid = self.storage.events.insert(ev, app_id, channel_id)
+        for p in self.plugins:
+            p.input_sniffer(ev, app_id, channel_id)
+        if self.stats:
+            self.stats.record(app_id, ev.event, 201)
+        self._m_events.inc((app_id, 201))
+        self._m_insert.observe(time.perf_counter() - t0)
+        return 201, {"eventId": eid}
+
+    async def _metrics(self, req: Request) -> Response:
+        from predictionio_tpu.utils.metrics import REGISTRY
+
+        return Response.text(REGISTRY.render(),
+                             content_type="text/plain; version=0.0.4")
+
+    async def _post_event(self, req: Request) -> Response:
+        auth, err = self._auth(req)
+        if err:
+            return err
+        app_id, channel_id, allowed = auth
+        status, body = await asyncio.to_thread(
+            self._insert_one, req.json(), app_id, channel_id, allowed)
+        return Response.json(body, status=status)
+
+    async def _post_batch(self, req: Request) -> Response:
+        auth, err = self._auth(req)
+        if err:
+            return err
+        app_id, channel_id, allowed = auth
+        payload = req.json()
+        if not isinstance(payload, list):
+            return Response.json({"message": "batch body must be a JSON array"},
+                                 status=400)
+        if len(payload) > BATCH_LIMIT:
+            return Response.json(
+                {"message": f"Batch request must have at most {BATCH_LIMIT} events"},
+                status=400)
+
+        def run() -> List[Dict[str, Any]]:
+            results = []
+            for obj in payload:
+                status, body = self._insert_one(obj, app_id, channel_id, allowed)
+                results.append({"status": status, **body})
+            return results
+
+        return Response.json(await asyncio.to_thread(run))
+
+    async def _get_events(self, req: Request) -> Response:
+        auth, err = self._auth(req)
+        if err:
+            return err
+        app_id, channel_id, _ = auth
+        try:
+            start = parse_event_time(req.param("startTime")) if req.param("startTime") else None
+            until = parse_event_time(req.param("untilTime")) if req.param("untilTime") else None
+        except EventValidationError as e:
+            return Response.json({"message": str(e)}, status=400)
+        limit_s = req.param("limit")
+        try:
+            limit = int(limit_s) if limit_s else DEFAULT_FIND_LIMIT
+        except ValueError:
+            return Response.json({"message": f"invalid limit {limit_s!r}"}, status=400)
+        event_name = req.param("event")
+
+        def run():
+            return [e.to_json() for e in self.storage.events.find(
+                app_id, channel_id,
+                start_time=start, until_time=until,
+                entity_type=req.param("entityType"),
+                entity_id=req.param("entityId"),
+                event_names=[event_name] if event_name else None,
+                target_entity_type=req.param("targetEntityType"),
+                target_entity_id=req.param("targetEntityId"),
+                limit=(None if limit == -1 else limit),
+                reversed=req.param("reversed") in ("true", "1"),
+            )]
+
+        out = await asyncio.to_thread(run)
+        return Response.json(out)
+
+    async def _get_event(self, req: Request) -> Response:
+        auth, err = self._auth(req)
+        if err:
+            return err
+        app_id, channel_id, _ = auth
+        ev = await asyncio.to_thread(
+            self.storage.events.get, req.path_params["eid"], app_id, channel_id)
+        if ev is None:
+            return Response.json({"message": "Not Found"}, status=404)
+        return Response.json(ev.to_json())
+
+    async def _delete_event(self, req: Request) -> Response:
+        auth, err = self._auth(req)
+        if err:
+            return err
+        app_id, channel_id, _ = auth
+        ok = await asyncio.to_thread(
+            self.storage.events.delete, req.path_params["eid"], app_id, channel_id)
+        if not ok:
+            return Response.json({"message": "Not Found"}, status=404)
+        return Response.json({"message": "Found"})
+
+    async def _get_stats(self, req: Request) -> Response:
+        if self.stats is None:
+            return Response.json(
+                {"message": "stats not enabled; start eventserver with --stats"},
+                status=404)
+        return Response.json(self.stats.to_json())
+
+    async def _webhook(self, req: Request) -> Response:
+        from predictionio_tpu.data.webhooks import get_connector
+
+        auth, err = self._auth(req)
+        if err:
+            return err
+        app_id, channel_id, allowed = auth
+        name = req.path_params["connector"]
+        conn = get_connector(name)
+        if conn is None:
+            return Response.json(
+                {"message": f"unknown webhook connector {name!r}"}, status=404)
+        try:
+            if conn.kind == "form":
+                import urllib.parse as up
+                form = {k: v[0] for k, v in up.parse_qs(req.body.decode()).items()}
+                obj = conn.to_event_json(form)
+            else:
+                obj = conn.to_event_json(req.json())
+        except Exception as e:
+            return Response.json({"message": f"connector error: {e}"}, status=400)
+        status, body = await asyncio.to_thread(
+            self._insert_one, obj, app_id, channel_id, allowed)
+        return Response.json(body, status=status)
+
+    async def _webhook_probe(self, req: Request) -> Response:
+        from predictionio_tpu.data.webhooks import get_connector
+
+        _, err = self._auth(req)
+        if err:
+            return err
+        name = req.path_params["connector"]
+        if get_connector(name) is None:
+            return Response.json(
+                {"message": f"unknown webhook connector {name!r}"}, status=404)
+        return Response.json({"connector": name, "status": "ready"})
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def serve_forever(self) -> None:
+        await self.http.serve_forever()
+
+    def run(self) -> None:
+        asyncio.run(self.serve_forever())
+
+
+def _discover_plugins() -> List[Any]:
+    from predictionio_tpu.core.plugins import event_server_plugins
+
+    return event_server_plugins()

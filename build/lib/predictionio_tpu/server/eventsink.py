@@ -1,0 +1,78 @@
+"""Feedback event sinks: how the Engine Server reports served
+predictions back as events.
+
+The reference's engine server posts feedback through the Event Server's
+authenticated HTTP API (reference: [U] core/.../workflow/CreateServer
+feedback with ``eventServerIp``/``eventServerPort`` + ``accessKey`` —
+unverified, SURVEY.md §3.2) — NOT by writing the event store directly,
+because event storage is generally remote to the serving host and the
+access key enforces the app's write contract. The sink is injectable:
+
+- :class:`HTTPEventSink` — the reference-faithful path: ``POST
+  {url}/events.json?accessKey=…[&channel=…]``. Default when a feedback
+  URL is configured.
+- :class:`DirectEventSink` — in-process write into the local storage
+  (single-box deployments with no Event Server running).
+
+Sinks run off the serving hot path (fire-and-forget worker thread) and
+must never raise into the caller; failures are counted, not fatal.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from abc import ABC, abstractmethod
+from typing import Any, Dict, Optional
+
+from predictionio_tpu.data.event import Event
+
+
+class EventSink(ABC):
+    """Delivers one feedback event; raises on failure (the caller
+    counts and swallows — feedback must never break serving)."""
+
+    @abstractmethod
+    def send(self, event: Event) -> None:
+        ...
+
+
+class HTTPEventSink(EventSink):
+    """Authenticated POST to an Event Server's ``/events.json``."""
+
+    def __init__(self, url: str, access_key: str,
+                 channel: Optional[str] = None,
+                 timeout: float = 5.0) -> None:
+        self.url = url.rstrip("/")
+        self.access_key = access_key
+        self.channel = channel
+        self.timeout = timeout
+
+    def send(self, event: Event) -> None:
+        qs: Dict[str, str] = {"accessKey": self.access_key}
+        if self.channel:
+            qs["channel"] = self.channel
+        req = urllib.request.Request(
+            f"{self.url}/events.json?{urllib.parse.urlencode(qs)}",
+            data=json.dumps(event.to_json()).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            if resp.status not in (200, 201):
+                raise RuntimeError(f"event server returned {resp.status}")
+
+
+class DirectEventSink(EventSink):
+    """In-process write (no Event Server between serving and storage)."""
+
+    def __init__(self, storage: Any, app_name: str) -> None:
+        self.storage = storage
+        self.app_name = app_name
+
+    def send(self, event: Event) -> None:
+        app = self.storage.meta.get_app_by_name(self.app_name)
+        if app is None:
+            raise ValueError(f"no app named {self.app_name!r}")
+        self.storage.events.insert(event, app.id)

@@ -1,0 +1,231 @@
+"""Minimal asyncio HTTP/1.1 server.
+
+Replaces the reference's akka-http layer (reference: [U] akka-http routes
+in data/.../api/EventServer.scala and core/.../workflow/CreateServer.scala).
+Deliberately dependency-free: the environment bakes no aiohttp, and the
+serving hot path wants a thin, predictable stack (parse → dict → handler
+→ JSON) under the p50 target. Supports keep-alive, content-length
+bodies, and a tiny router with path parameters (``/events/{id}.json``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+import traceback
+import urllib.parse
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+
+MAX_BODY = 64 * 1024 * 1024
+MAX_HEADER = 64 * 1024
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    query: Dict[str, List[str]]
+    headers: Dict[str, str]
+    body: bytes
+    path_params: Dict[str, str] = field(default_factory=dict)
+
+    def param(self, name: str, default: Optional[str] = None) -> Optional[str]:
+        vals = self.query.get(name)
+        return vals[0] if vals else default
+
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        return json.loads(self.body.decode("utf-8"))
+
+
+@dataclass
+class Response:
+    status: int = 200
+    body: bytes = b""
+    content_type: str = "application/json; charset=utf-8"
+    headers: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def json(cls, obj: Any, status: int = 200) -> "Response":
+        return cls(status=status,
+                   body=json.dumps(obj, separators=(",", ":")).encode("utf-8"))
+
+    @classmethod
+    def text(cls, s: str, status: int = 200, content_type: str = "text/plain") -> "Response":
+        return cls(status=status, body=s.encode("utf-8"), content_type=content_type)
+
+
+Handler = Callable[[Request], Awaitable[Response]]
+
+_REASONS = {
+    200: "OK", 201: "Created", 400: "Bad Request", 401: "Unauthorized",
+    403: "Forbidden", 404: "Not Found", 405: "Method Not Allowed",
+    413: "Payload Too Large", 500: "Internal Server Error",
+}
+
+
+class Router:
+    def __init__(self) -> None:
+        # (method, regex, param names, handler)
+        self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+
+    def route(self, method: str, pattern: str, handler: Handler) -> None:
+        """Pattern supports ``{name}`` path params (one segment) and
+        ``{name+}`` (greedy, may span slashes).
+
+        Params are substituted BEFORE ``re.escape`` runs on the literal
+        parts: escaping first turned ``{path+}`` into ``{path\\+}``,
+        which neither substitution matched — every greedy route 404'd
+        (caught by the plugin-route tests)."""
+        parts = re.split(r"(\{\w+\+?\})", pattern)
+        rx = "".join(
+            # the capture group alternates literal/param parts: odd
+            # indices are params; prefix checks would misread literal
+            # brace text (e.g. "{b-c}") as a param and die in compile
+            re.escape(p) if i % 2 == 0
+            else (r"(?P<%s>.+)" % p[1:-2]) if p.endswith("+}")
+            else (r"(?P<%s>[^/]+)" % p[1:-1])
+            for i, p in enumerate(parts))
+        self._routes.append((method.upper(), re.compile("^" + rx + "$"), handler))
+
+    def match(self, method: str, path: str) -> Optional[Tuple[Handler, Dict[str, str]]]:
+        for m, rx, h in self._routes:
+            g = rx.match(path)
+            if g and m == method.upper():
+                return h, g.groupdict()
+        return None
+
+
+class HTTPServer:
+    def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 8000,
+                 ssl_context: Optional[Any] = None,
+                 bind_retries: int = 0, bind_retry_sec: float = 1.0) -> None:
+        self.router = router
+        self.host = host
+        self.port = port
+        #: optional ssl.SSLContext (see server.ssl_config) → HTTPS
+        self.ssl_context = ssl_context
+        #: port-in-use bind retry (the reference's MasterActor retries
+        #: the bind while the previous instance shuts down)
+        self.bind_retries = bind_retries
+        self.bind_retry_sec = bind_retry_sec
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._shutdown = asyncio.Event()
+
+    async def _read_request(self, reader: asyncio.StreamReader) -> Optional[Request]:
+        try:
+            head = await reader.readuntil(b"\r\n\r\n")
+        except (asyncio.IncompleteReadError, asyncio.LimitOverrunError):
+            return None
+        if len(head) > MAX_HEADER:
+            return None
+        lines = head.decode("latin-1").split("\r\n")
+        try:
+            method, target, _version = lines[0].split(" ", 2)
+        except ValueError:
+            return None
+        headers: Dict[str, str] = {}
+        for line in lines[1:]:
+            if ":" in line:
+                k, v = line.split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        try:
+            length = int(headers.get("content-length", "0") or "0")
+        except ValueError:
+            return None
+        if length < 0 or length > MAX_BODY:
+            return None
+        body = await reader.readexactly(length) if length else b""
+        parsed = urllib.parse.urlsplit(target)
+        return Request(
+            method=method.upper(),
+            path=parsed.path,
+            query=urllib.parse.parse_qs(parsed.query),
+            headers=headers,
+            body=body,
+        )
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            while not self._shutdown.is_set():
+                req = await self._read_request(reader)
+                if req is None:
+                    break
+                resp = await self._dispatch(req)
+                keep = req.headers.get("connection", "keep-alive").lower() != "close"
+                payload = (
+                    f"HTTP/1.1 {resp.status} {_REASONS.get(resp.status, '')}\r\n"
+                    f"Content-Type: {resp.content_type}\r\n"
+                    f"Content-Length: {len(resp.body)}\r\n"
+                    + "".join(f"{k}: {v}\r\n" for k, v in resp.headers.items())
+                    + f"Connection: {'keep-alive' if keep else 'close'}\r\n\r\n"
+                ).encode("latin-1") + resp.body
+                writer.write(payload)
+                await writer.drain()
+                if not keep:
+                    break
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _dispatch(self, req: Request) -> Response:
+        found = self.router.match(req.method, req.path)
+        if found is None:
+            return Response.json({"message": "Not Found"}, status=404)
+        handler, params = found
+        req.path_params = params
+        try:
+            return await handler(req)
+        except json.JSONDecodeError as e:
+            return Response.json({"message": f"invalid JSON: {e}"}, status=400)
+        except Exception:
+            traceback.print_exc()
+            return Response.json({"message": "Internal Server Error"}, status=500)
+
+    async def start(self) -> None:
+        import errno
+
+        attempt = 0
+        while True:
+            try:
+                self._server = await asyncio.start_server(
+                    self._handle_conn, self.host, self.port,
+                    ssl=self.ssl_context)
+                return
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE or attempt >= self.bind_retries:
+                    raise
+                attempt += 1
+                await asyncio.sleep(self.bind_retry_sec)
+
+    @property
+    def bound_port(self) -> int:
+        """Actual listening port (use with ``port=0`` in tests)."""
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        assert self._server is not None
+        async with self._server:
+            await self._shutdown.wait()
+
+    async def stop(self) -> None:
+        self._shutdown.set()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    def request_shutdown(self) -> None:
+        self._shutdown.set()
+        if self._server is not None:
+            self._server.close()

@@ -1,0 +1,25 @@
+from predictionio_tpu.storage.meta import (
+    App,
+    AccessKey,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+    MetaStore,
+)
+from predictionio_tpu.storage.models import ModelStore, LocalFSModelStore
+from predictionio_tpu.storage.registry import Storage, StorageConfig, get_storage, set_storage
+
+__all__ = [
+    "App",
+    "AccessKey",
+    "Channel",
+    "EngineInstance",
+    "EvaluationInstance",
+    "MetaStore",
+    "ModelStore",
+    "LocalFSModelStore",
+    "Storage",
+    "StorageConfig",
+    "get_storage",
+    "set_storage",
+]

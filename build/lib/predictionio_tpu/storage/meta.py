@@ -1,0 +1,405 @@
+"""Meta-data store: apps, access keys, channels, engine & evaluation instances.
+
+Equivalent of the reference's meta repos (reference: [U] data/.../storage/
+{Apps,AccessKeys,Channels,EngineInstances,EvaluationInstances}.scala —
+unverified, SURVEY.md §2a), collapsed onto a single SQLite database. The
+record shapes mirror the reference's case classes so the CLI verbs
+(``pio app new``, ``pio accesskey list``, …) and the servers behave
+identically; ``spark_conf`` in the reference's ``EngineInstance`` becomes
+``mesh_conf`` (the pjit mesh / compile options used for the run).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import secrets
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from predictionio_tpu.data.event import format_event_time, parse_event_time, utcnow
+
+
+@dataclass
+class App:
+    id: int
+    name: str
+    description: str = ""
+
+
+@dataclass
+class AccessKey:
+    key: str
+    app_id: int
+    events: List[str] = field(default_factory=list)  # empty = all events permitted
+
+
+@dataclass
+class Channel:
+    id: int
+    name: str
+    app_id: int
+
+
+@dataclass
+class EngineInstance:
+    """One train run's record; serving loads the latest COMPLETED one."""
+
+    id: str
+    status: str  # INIT | TRAINING | COMPLETED | FAILED
+    start_time: _dt.datetime
+    end_time: Optional[_dt.datetime]
+    engine_factory: str  # "module.path:factory_callable"
+    engine_variant: str
+    batch: str
+    env: Dict[str, str]
+    mesh_conf: Dict[str, Any]
+    data_source_params: str
+    preparator_params: str
+    algorithms_params: str
+    serving_params: str
+
+
+@dataclass
+class EvaluationInstance:
+    id: str
+    status: str
+    start_time: _dt.datetime
+    end_time: Optional[_dt.datetime]
+    evaluation_class: str
+    engine_params_generator_class: str
+    batch: str
+    env: Dict[str, str]
+    evaluator_results: str = ""        # human-readable summary
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""   # structured per-candidate scores
+
+
+def _schema(d) -> List[str]:
+    """Per-dialect DDL: autoincrement spelling and index-able string
+    types come from the dialect (MySQL cannot PK/UNIQUE a bare TEXT)."""
+    return [
+        f"""CREATE TABLE IF NOT EXISTS apps (
+            id {d.autoinc_pk},
+            name {d.str_type} UNIQUE NOT NULL,
+            description TEXT NOT NULL
+        )""",
+        f"""CREATE TABLE IF NOT EXISTS access_keys (
+            accesskey {d.key_type} PRIMARY KEY,
+            appid INTEGER NOT NULL,
+            events TEXT NOT NULL
+        )""",
+        f"""CREATE TABLE IF NOT EXISTS channels (
+            id {d.autoinc_pk},
+            name {d.str_type} NOT NULL,
+            appid INTEGER NOT NULL,
+            UNIQUE(name, appid)
+        )""",
+        f"""CREATE TABLE IF NOT EXISTS engine_instances (
+            id {d.key_type} PRIMARY KEY,
+            status TEXT NOT NULL,
+            startTime TEXT NOT NULL,
+            endTime TEXT,
+            engineFactory TEXT NOT NULL,
+            engineVariant TEXT NOT NULL,
+            batch TEXT NOT NULL,
+            env TEXT NOT NULL,
+            meshConf TEXT NOT NULL,
+            dataSourceParams TEXT NOT NULL,
+            preparatorParams TEXT NOT NULL,
+            algorithmsParams TEXT NOT NULL,
+            servingParams TEXT NOT NULL
+        )""",
+        f"""CREATE TABLE IF NOT EXISTS evaluation_instances (
+            id {d.key_type} PRIMARY KEY,
+            status TEXT NOT NULL,
+            startTime TEXT NOT NULL,
+            endTime TEXT,
+            evaluationClass TEXT NOT NULL,
+            engineParamsGeneratorClass TEXT NOT NULL,
+            batch TEXT NOT NULL,
+            env TEXT NOT NULL,
+            evaluatorResults TEXT NOT NULL,
+            evaluatorResultsHTML TEXT NOT NULL,
+            evaluatorResultsJSON TEXT NOT NULL
+        )""",
+    ]
+
+
+_EI_COLS = ("id", "status", "startTime", "endTime", "engineFactory",
+            "engineVariant", "batch", "env", "meshConf", "dataSourceParams",
+            "preparatorParams", "algorithmsParams", "servingParams")
+_VI_COLS = ("id", "status", "startTime", "endTime", "evaluationClass",
+            "engineParamsGeneratorClass", "batch", "env", "evaluatorResults",
+            "evaluatorResultsHTML", "evaluatorResultsJSON")
+
+
+class MetaStore:
+    """SQL-backed meta store. Defaults to SQLite (':memory:' for tests);
+    any :mod:`predictionio_tpu.storage.sqldialect` dialect (PGSQL/MYSQL)
+    plugs in via ``dialect=`` — the JDBC-meta-repos parity path."""
+
+    def __init__(self, path: str = ":memory:", dialect=None) -> None:
+        from predictionio_tpu.storage.sqldialect import SqliteDialect
+
+        self._path = path
+        self._d = dialect if dialect is not None else SqliteDialect(path)
+        self._conns = self._d.thread_conns()
+        self._lock = threading.RLock()
+        self._init_schema()
+
+    def _conn(self):
+        return self._conns.get()
+
+    def _sql(self, q: str) -> str:
+        return self._d.sql(q)
+
+    def _init_schema(self) -> None:
+        with self._lock:
+            c = self._conn()
+            cur = c.cursor()
+            for stmt in _schema(self._d):
+                cur.execute(stmt)
+            c.commit()
+
+    # -- statement helpers -----------------------------------------------------
+    #
+    # Reads COMMIT too: server engines run every statement inside a
+    # transaction on the cached per-thread connection — without ending
+    # it, MySQL REPEATABLE READ pins a snapshot forever (stale reads)
+    # and PostgreSQL sits idle-in-transaction. Any failure rolls the
+    # connection back so it stays usable (PostgreSQL aborts the open
+    # transaction on error).
+
+    def _q(self, q: str, args: tuple = ()) -> List[tuple]:
+        c = self._conn()
+        try:
+            cur = c.cursor()
+            cur.execute(self._sql(q), args)
+            rows = cur.fetchall()
+            c.commit()
+            return rows
+        except Exception:
+            self._d.recover(c)
+            raise
+
+    def _q1(self, q: str, args: tuple = ()) -> Optional[tuple]:
+        rows = self._q(q, args)
+        return rows[0] if rows else None
+
+    def _x(self, q: str, args: tuple = ()) -> int:
+        with self._lock:
+            c = self._conn()
+            try:
+                cur = c.cursor()
+                cur.execute(self._sql(q), args)
+                c.commit()
+                return cur.rowcount
+            except Exception:
+                self._d.recover(c)
+                raise
+
+    # -- apps ------------------------------------------------------------------
+
+    def create_app(self, name: str, description: str = "") -> App:
+        with self._lock:
+            c = self._conn()
+            try:
+                rid = self._d.insert_returning_id(
+                    c, "INSERT INTO apps(name, description) VALUES (?,?)",
+                    (name, description))
+                c.commit()
+            except Exception:
+                self._d.recover(c)  # duplicate-name race must not poison
+                raise               # this thread's cached connection
+            return App(id=rid, name=name, description=description)
+
+    def get_app(self, app_id: int) -> Optional[App]:
+        row = self._q1("SELECT id,name,description FROM apps WHERE id=?",
+                       (app_id,))
+        return App(*row) if row else None
+
+    def get_app_by_name(self, name: str) -> Optional[App]:
+        row = self._q1("SELECT id,name,description FROM apps WHERE name=?",
+                       (name,))
+        return App(*row) if row else None
+
+    def list_apps(self) -> List[App]:
+        return [App(*r) for r in self._q(
+            "SELECT id,name,description FROM apps ORDER BY id")]
+
+    def delete_app(self, app_id: int) -> bool:
+        with self._lock:
+            c = self._conn()
+            try:
+                cur = c.cursor()
+                cur.execute(self._sql("DELETE FROM apps WHERE id=?"),
+                            (app_id,))
+                existed = cur.rowcount > 0
+                cur.execute(self._sql("DELETE FROM access_keys WHERE appid=?"),
+                            (app_id,))
+                cur.execute(self._sql("DELETE FROM channels WHERE appid=?"),
+                            (app_id,))
+                c.commit()
+            except Exception:
+                self._d.recover(c)
+                raise
+            return existed
+
+    # -- access keys -----------------------------------------------------------
+
+    def create_access_key(
+        self, app_id: int, events: Optional[List[str]] = None, key: Optional[str] = None
+    ) -> AccessKey:
+        key = key or secrets.token_urlsafe(48)
+        self._x("INSERT INTO access_keys(accesskey, appid, events) VALUES (?,?,?)",
+                (key, app_id, json.dumps(events or [])))
+        return AccessKey(key=key, app_id=app_id, events=events or [])
+
+    def get_access_key(self, key: str) -> Optional[AccessKey]:
+        row = self._q1(
+            "SELECT accesskey,appid,events FROM access_keys "
+            "WHERE accesskey=?", (key,))
+        return AccessKey(row[0], row[1], json.loads(row[2])) if row else None
+
+    def list_access_keys(self, app_id: Optional[int] = None) -> List[AccessKey]:
+        if app_id is None:
+            rows = self._q("SELECT accesskey,appid,events FROM access_keys")
+        else:
+            rows = self._q(
+                "SELECT accesskey,appid,events FROM access_keys WHERE appid=?",
+                (app_id,))
+        return [AccessKey(r[0], r[1], json.loads(r[2])) for r in rows]
+
+    def delete_access_key(self, key: str) -> bool:
+        return self._x("DELETE FROM access_keys WHERE accesskey=?",
+                       (key,)) > 0
+
+    # -- channels --------------------------------------------------------------
+
+    def create_channel(self, app_id: int, name: str) -> Channel:
+        with self._lock:
+            c = self._conn()
+            try:
+                rid = self._d.insert_returning_id(
+                    c, "INSERT INTO channels(name, appid) VALUES (?,?)",
+                    (name, app_id))
+                c.commit()
+            except Exception:
+                self._d.recover(c)
+                raise
+            return Channel(id=rid, name=name, app_id=app_id)
+
+    def get_channel_by_name(self, app_id: int, name: str) -> Optional[Channel]:
+        row = self._q1(
+            "SELECT id,name,appid FROM channels WHERE appid=? AND name=?",
+            (app_id, name))
+        return Channel(*row) if row else None
+
+    def list_channels(self, app_id: int) -> List[Channel]:
+        return [Channel(*r) for r in self._q(
+            "SELECT id,name,appid FROM channels WHERE appid=? ORDER BY id",
+            (app_id,))]
+
+    def delete_channel(self, channel_id: int) -> bool:
+        return self._x("DELETE FROM channels WHERE id=?", (channel_id,)) > 0
+
+    # -- engine instances ------------------------------------------------------
+
+    def insert_engine_instance(self, ei: EngineInstance) -> None:
+        self._x(
+            self._d.upsert("engine_instances", _EI_COLS, "id"),
+            (
+                ei.id, ei.status, format_event_time(ei.start_time),
+                format_event_time(ei.end_time) if ei.end_time else None,
+                ei.engine_factory, ei.engine_variant, ei.batch,
+                json.dumps(ei.env), json.dumps(ei.mesh_conf),
+                ei.data_source_params, ei.preparator_params,
+                ei.algorithms_params, ei.serving_params,
+            ),
+        )
+
+    @staticmethod
+    def _ei_from_row(r) -> EngineInstance:
+        return EngineInstance(
+            id=r[0], status=r[1],
+            start_time=parse_event_time(r[2]),
+            end_time=parse_event_time(r[3]) if r[3] else None,
+            engine_factory=r[4], engine_variant=r[5], batch=r[6],
+            env=json.loads(r[7]), mesh_conf=json.loads(r[8]),
+            data_source_params=r[9], preparator_params=r[10],
+            algorithms_params=r[11], serving_params=r[12],
+        )
+
+    def get_engine_instance(self, instance_id: str) -> Optional[EngineInstance]:
+        row = self._q1(
+            f"SELECT {','.join(_EI_COLS)} FROM engine_instances WHERE id=?",
+            (instance_id,))
+        return self._ei_from_row(row) if row else None
+
+    def update_engine_instance(self, ei: EngineInstance) -> None:
+        self.insert_engine_instance(ei)
+
+    def get_latest_completed_engine_instance(
+        self, engine_factory: str, engine_variant: str = ""
+    ) -> Optional[EngineInstance]:
+        """Reference semantics: deploy loads the latest COMPLETED instance
+        for (engineFactory, variant) ([U] EngineInstances.getLatestCompleted)."""
+        q = (f"SELECT {','.join(_EI_COLS)} FROM engine_instances "
+             "WHERE status='COMPLETED' AND engineFactory=?")
+        args: List[Any] = [engine_factory]
+        if engine_variant:
+            q += " AND engineVariant=?"
+            args.append(engine_variant)
+        q += " ORDER BY startTime DESC LIMIT 1"
+        row = self._q1(q, tuple(args))
+        return self._ei_from_row(row) if row else None
+
+    def list_engine_instances(self) -> List[EngineInstance]:
+        return [self._ei_from_row(r) for r in self._q(
+            f"SELECT {','.join(_EI_COLS)} FROM engine_instances "
+            "ORDER BY startTime DESC")]
+
+    # -- evaluation instances --------------------------------------------------
+
+    def insert_evaluation_instance(self, vi: EvaluationInstance) -> None:
+        self._x(
+            self._d.upsert("evaluation_instances", _VI_COLS, "id"),
+            (
+                vi.id, vi.status, format_event_time(vi.start_time),
+                format_event_time(vi.end_time) if vi.end_time else None,
+                vi.evaluation_class, vi.engine_params_generator_class,
+                vi.batch, json.dumps(vi.env), vi.evaluator_results,
+                vi.evaluator_results_html, vi.evaluator_results_json,
+            ),
+        )
+
+    @staticmethod
+    def _vi_from_row(r) -> EvaluationInstance:
+        return EvaluationInstance(
+            id=r[0], status=r[1],
+            start_time=parse_event_time(r[2]),
+            end_time=parse_event_time(r[3]) if r[3] else None,
+            evaluation_class=r[4], engine_params_generator_class=r[5],
+            batch=r[6], env=json.loads(r[7]), evaluator_results=r[8],
+            evaluator_results_html=r[9], evaluator_results_json=r[10],
+        )
+
+    def get_evaluation_instance(self, instance_id: str) -> Optional[EvaluationInstance]:
+        row = self._q1(
+            f"SELECT {','.join(_VI_COLS)} FROM evaluation_instances "
+            "WHERE id=?", (instance_id,))
+        return self._vi_from_row(row) if row else None
+
+    def update_evaluation_instance(self, vi: EvaluationInstance) -> None:
+        self.insert_evaluation_instance(vi)
+
+    def list_evaluation_instances(self) -> List[EvaluationInstance]:
+        return [self._vi_from_row(r) for r in self._q(
+            f"SELECT {','.join(_VI_COLS)} FROM evaluation_instances "
+            "ORDER BY startTime DESC")]
+
+    def new_instance_id(self) -> str:
+        return utcnow().strftime("%Y%m%d%H%M%S") + "-" + secrets.token_hex(4)

@@ -1,0 +1,169 @@
+"""Model blob stores.
+
+Equivalent of the reference's ``Models`` repo + LocalFS/HDFS/S3 blob
+backends (reference: [U] data/.../storage/Models.scala, storage/localfs/
+LocalFSModels.scala — unverified, SURVEY.md §2a). A "model" here is an
+opaque byte blob keyed by engine-instance id; algorithms that want
+structured checkpointing (e.g. Orbax for large factor matrices) persist
+through :class:`DirModelStore`-style per-instance directories instead,
+the analogue of the reference's ``PersistentModel`` escape hatch.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+
+class ModelStore(ABC):
+    @abstractmethod
+    def put(self, instance_id: str, blob: bytes) -> None: ...
+
+    @abstractmethod
+    def get(self, instance_id: str) -> Optional[bytes]: ...
+
+    @abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+    @abstractmethod
+    def list_ids(self) -> List[str]: ...
+
+    def model_dir(self, instance_id: str) -> Optional[str]:
+        """Directory for structured per-instance artifacts (PersistentModel
+        analogue); None when the backend has no filesystem locality."""
+        return None
+
+
+class MemoryModelStore(ModelStore):
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._blobs: dict[str, bytes] = {}
+
+    def put(self, instance_id: str, blob: bytes) -> None:
+        with self._lock:
+            self._blobs[instance_id] = blob
+
+    def get(self, instance_id: str) -> Optional[bytes]:
+        return self._blobs.get(instance_id)
+
+    def delete(self, instance_id: str) -> bool:
+        with self._lock:
+            return self._blobs.pop(instance_id, None) is not None
+
+    def list_ids(self) -> List[str]:
+        return sorted(self._blobs)
+
+
+class SQLModelStore(ModelStore):
+    """Model blobs in a SQL table (reference: [U] storage/jdbc/
+    JDBCModels.scala — ``pio_model_data`` with a blob column). Works
+    with any :mod:`predictionio_tpu.storage.sqldialect` dialect; used
+    by the PGSQL/MYSQL sources so a pure-SQL deployment needs no shared
+    filesystem for models."""
+
+    _TABLE = "pio_model_data"
+
+    def __init__(self, dialect) -> None:
+        self._d = dialect
+        self._conns = dialect.thread_conns()
+        self._lock = threading.Lock()
+        c = self._conns.get()
+        c.cursor().execute(
+            f"""CREATE TABLE IF NOT EXISTS {self._TABLE} (
+                id {dialect.key_type} PRIMARY KEY,
+                model {dialect.blob_type} NOT NULL
+            )""")
+        c.commit()
+
+    def put(self, instance_id: str, blob: bytes) -> None:
+        with self._lock:
+            c = self._conns.get()
+            c.cursor().execute(
+                self._d.sql(self._d.upsert(self._TABLE, ("id", "model"), "id")),
+                (instance_id, self._d.binary(blob)))
+            c.commit()
+
+    def get(self, instance_id: str) -> Optional[bytes]:
+        c = self._conns.get()
+        try:
+            cur = c.cursor()
+            cur.execute(self._d.sql(
+                f"SELECT model FROM {self._TABLE} WHERE id=?"),
+                (instance_id,))
+            row = cur.fetchone()
+            c.commit()  # end the read transaction on server engines
+        except Exception:
+            self._d.recover(c)
+            raise
+        return bytes(row[0]) if row else None
+
+    def delete(self, instance_id: str) -> bool:
+        with self._lock:
+            c = self._conns.get()
+            cur = c.cursor()
+            cur.execute(self._d.sql(
+                f"DELETE FROM {self._TABLE} WHERE id=?"), (instance_id,))
+            c.commit()
+            return cur.rowcount > 0
+
+    def list_ids(self) -> List[str]:
+        c = self._conns.get()
+        try:
+            cur = c.cursor()
+            cur.execute(f"SELECT id FROM {self._TABLE} ORDER BY id")
+            rows = cur.fetchall()
+            c.commit()
+        except Exception:
+            self._d.recover(c)
+            raise
+        return [r[0] for r in rows]
+
+
+class LocalFSModelStore(ModelStore):
+    """Blobs under ``<root>/<instance_id>/model.bin`` (reference default:
+    ``~/.pio_store/models``); the per-instance directory doubles as the
+    structured-artifact (Orbax checkpoint) location."""
+
+    def __init__(self, root: str) -> None:
+        self._root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _dir(self, instance_id: str) -> str:
+        safe = instance_id.replace("/", "_")
+        return os.path.join(self._root, safe)
+
+    def put(self, instance_id: str, blob: bytes) -> None:
+        d = self._dir(instance_id)
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, ".model.bin.tmp")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, os.path.join(d, "model.bin"))
+
+    def get(self, instance_id: str) -> Optional[bytes]:
+        p = os.path.join(self._dir(instance_id), "model.bin")
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return f.read()
+
+    def delete(self, instance_id: str) -> bool:
+        d = self._dir(instance_id)
+        if os.path.isdir(d):
+            shutil.rmtree(d)
+            return True
+        return False
+
+    def list_ids(self) -> List[str]:
+        return sorted(
+            d for d in os.listdir(self._root)
+            if os.path.isdir(os.path.join(self._root, d))
+        )
+
+    def model_dir(self, instance_id: str) -> str:
+        d = self._dir(instance_id)
+        os.makedirs(d, exist_ok=True)
+        return d

@@ -1,0 +1,235 @@
+"""Backend registry: env-driven selection of meta/event/model stores.
+
+Equivalent of the reference's ``Storage`` object (reference: [U]
+data/.../storage/Storage.scala — unverified, SURVEY.md §2a), which reads
+``PIO_STORAGE_REPOSITORIES_{METADATA,EVENTDATA,MODELDATA}_{NAME,SOURCE}``
+and ``PIO_STORAGE_SOURCES_<S>_{TYPE,...}`` env vars and reflectively
+loads backend jars. Here backends register by TYPE name in a plain dict
+(extensible via ``register_event_backend`` — the Python-entry-points
+replacement for JVM reflection), and the same env var names are honored
+for drop-in familiarity.
+
+Defaults (no env set): everything under ``$PIO_HOME or ~/.pio_store`` —
+SQLite meta DB, SQLITE events, LOCALFS models.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from predictionio_tpu.data.events import EventStore, MemoryEventStore, SqliteEventStore
+from predictionio_tpu.storage.meta import MetaStore
+from predictionio_tpu.storage.models import LocalFSModelStore, MemoryModelStore, ModelStore
+
+
+def pio_home() -> str:
+    return os.environ.get("PIO_HOME") or os.path.join(
+        os.path.expanduser("~"), ".pio_store"
+    )
+
+
+@dataclass
+class StorageConfig:
+    """Resolved storage configuration (one 'source' per repository).
+
+    ``sources`` holds every configured source's extra settings
+    (``PIO_STORAGE_SOURCES_<NAME>_<KEY>`` → ``sources[NAME][KEY]``) and
+    ``*_source`` records which named source backs each repository, so a
+    backend factory can read ITS source's settings instead of scanning
+    the environment (two S3 sources must not shadow each other).
+    """
+
+    metadata_type: str = "SQLITE"
+    eventdata_type: str = "SQLITE"
+    modeldata_type: str = "LOCALFS"
+    metadata_source: str = ""
+    eventdata_source: str = ""
+    modeldata_source: str = ""
+    sources: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    home: str = field(default_factory=pio_home)
+
+    def source_properties(self, repo: str) -> Dict[str, str]:
+        """Settings of the source backing ``repo`` ('METADATA', …)."""
+        name = getattr(self, f"{repo.lower()}_source", "")
+        return self.sources.get(name, {})
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None) -> "StorageConfig":
+        e = dict(os.environ if env is None else env)
+
+        def repo_source(repo: str) -> str:
+            return e.get(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "")
+
+        # Source names may contain underscores (e.g. MY_PG), and so may
+        # setting keys (BUCKET_NAME). Candidate names come from the
+        # repository SOURCE declarations plus every *_TYPE key; each env
+        # var then binds to the LONGEST candidate name prefixing it.
+        prefix = "PIO_STORAGE_SOURCES_"
+        rests = [k[len(prefix):] for k in e if k.startswith(prefix)]
+        names = {repo_source(r) for r in ("METADATA", "EVENTDATA", "MODELDATA")}
+        names |= {r[: -len("_TYPE")] for r in rests if r.endswith("_TYPE")}
+        names.discard("")
+        sources: Dict[str, Dict[str, str]] = {}
+        for rest in rests:
+            owner = max((n for n in names if rest.startswith(n + "_")),
+                        key=len, default="")
+            if owner:
+                sources.setdefault(owner, {})[rest[len(owner) + 1:]] = \
+                    e[prefix + rest]
+
+        def source_type(repo: str, default: str) -> str:
+            src = repo_source(repo)
+            if src:
+                return sources.get(src, {}).get("TYPE", default).upper()
+            return default
+
+        return cls(
+            metadata_type=source_type("METADATA", "SQLITE"),
+            eventdata_type=source_type("EVENTDATA", "SQLITE"),
+            modeldata_type=source_type("MODELDATA", "LOCALFS"),
+            metadata_source=repo_source("METADATA"),
+            eventdata_source=repo_source("EVENTDATA"),
+            modeldata_source=repo_source("MODELDATA"),
+            sources=sources,
+            home=e.get("PIO_HOME", pio_home()),
+        )
+
+
+_EVENT_BACKENDS: Dict[str, Callable[[StorageConfig], EventStore]] = {}
+_MODEL_BACKENDS: Dict[str, Callable[[StorageConfig], ModelStore]] = {}
+_META_BACKENDS: Dict[str, Callable[[StorageConfig], MetaStore]] = {}
+
+
+def register_event_backend(name: str, factory: Callable[[StorageConfig], EventStore]) -> None:
+    _EVENT_BACKENDS[name.upper()] = factory
+
+
+def register_model_backend(name: str, factory: Callable[[StorageConfig], ModelStore]) -> None:
+    _MODEL_BACKENDS[name.upper()] = factory
+
+
+def register_meta_backend(name: str, factory: Callable[[StorageConfig], MetaStore]) -> None:
+    _META_BACKENDS[name.upper()] = factory
+
+
+register_event_backend("MEMORY", lambda cfg: MemoryEventStore())
+register_event_backend(
+    "SQLITE",
+    lambda cfg: SqliteEventStore(
+        os.path.join(_ensure(cfg.home), "events.db")),
+)
+def _eventlog_factory(cfg: "StorageConfig") -> EventStore:
+    # lazy import: building the C++ engine only happens when selected
+    from predictionio_tpu.data.filestore import NativeEventLogStore
+
+    return NativeEventLogStore(os.path.join(_ensure(cfg.home), "eventlog"))
+
+
+register_event_backend("EVENTLOG", _eventlog_factory)
+register_model_backend("MEMORY", lambda cfg: MemoryModelStore())
+register_model_backend(
+    "LOCALFS", lambda cfg: LocalFSModelStore(os.path.join(_ensure(cfg.home), "models"))
+)
+register_meta_backend("MEMORY", lambda cfg: MetaStore(":memory:"))
+register_meta_backend(
+    "SQLITE", lambda cfg: MetaStore(os.path.join(_ensure(cfg.home), "meta.db"))
+)
+
+# network backends (S3/HDFS model stores, gated SQL servers) register
+# their TYPE names here; their drivers bind lazily at first use
+from predictionio_tpu.storage import remote as _remote  # noqa: E402
+
+_remote.register_all()
+
+# the embedded indexed store registers the reference's ELASTICSEARCH type
+from predictionio_tpu.storage import indexed as _indexed  # noqa: E402
+
+_indexed.register_all()
+
+
+def _ensure(home: str) -> str:
+    os.makedirs(home, exist_ok=True)
+    return home
+
+
+class Storage:
+    """Aggregated handle on the three repositories (lazy singletons)."""
+
+    def __init__(self, config: Optional[StorageConfig] = None) -> None:
+        self.config = config or StorageConfig.from_env()
+        self._lock = threading.Lock()
+        self._meta: Optional[MetaStore] = None
+        self._events: Optional[EventStore] = None
+        self._models: Optional[ModelStore] = None
+
+    @property
+    def meta(self) -> MetaStore:
+        with self._lock:
+            if self._meta is None:
+                try:
+                    factory = _META_BACKENDS[self.config.metadata_type]
+                except KeyError:
+                    raise KeyError(
+                        f"unknown METADATA backend {self.config.metadata_type!r}; "
+                        f"registered: {sorted(_META_BACKENDS)}")
+                self._meta = factory(self.config)
+            return self._meta
+
+    @property
+    def events(self) -> EventStore:
+        with self._lock:
+            if self._events is None:
+                try:
+                    factory = _EVENT_BACKENDS[self.config.eventdata_type]
+                except KeyError:
+                    raise KeyError(
+                        f"unknown EVENTDATA backend {self.config.eventdata_type!r}; "
+                        f"registered: {sorted(_EVENT_BACKENDS)}")
+                self._events = factory(self.config)
+            return self._events
+
+    @property
+    def models(self) -> ModelStore:
+        with self._lock:
+            if self._models is None:
+                try:
+                    factory = _MODEL_BACKENDS[self.config.modeldata_type]
+                except KeyError:
+                    raise KeyError(
+                        f"unknown MODELDATA backend {self.config.modeldata_type!r}; "
+                        f"registered: {sorted(_MODEL_BACKENDS)}")
+                self._models = factory(self.config)
+            return self._models
+
+    def verify(self) -> Dict[str, str]:
+        """Connectivity check for `pio status` (reference: Storage.verifyAllDataObjects)."""
+        out = {}
+        self.meta.list_apps()
+        out["metadata"] = self.config.metadata_type
+        self.events.init_channel(0)
+        out["eventdata"] = self.config.eventdata_type
+        self.models.list_ids()
+        out["modeldata"] = self.config.modeldata_type
+        return out
+
+
+_default: Optional[Storage] = None
+_default_lock = threading.Lock()
+
+
+def get_storage() -> Storage:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Storage()
+        return _default
+
+
+def set_storage(storage: Optional[Storage]) -> None:
+    """Override the process-wide storage (tests, embedded use)."""
+    global _default
+    with _default_lock:
+        _default = storage

@@ -1,0 +1,203 @@
+"""Network storage backends: S3 / HDFS model stores, SQL servers.
+
+The reference shipped six network backends (HBase, JDBC, Elasticsearch,
+HDFS, LocalFS, S3 — SURVEY.md §2a). These register their TYPE names
+with factories that bind lazily: each store is a full implementation
+that connects when its driver (boto3 / pyarrow+libhdfs / psycopg2 /
+pymysql) is present and raises :class:`StorageClientError` with install
+instructions when not. The PGSQL/MYSQL types run the shared SQL store
+implementations (events, meta, model blobs) on their engine's dialect —
+see :mod:`predictionio_tpu.storage.sqldialect`.
+
+Config (same env scheme as every backend, reference pio-env.sh names):
+
+    PIO_STORAGE_SOURCES_<S>_TYPE=S3|HDFS|PGSQL|MYSQL
+    PIO_STORAGE_SOURCES_<S>_BUCKET_NAME / _BASE_PATH   (S3)
+    PIO_STORAGE_SOURCES_<S>_HOSTS / _PORTS / _PATH     (HDFS)
+    PIO_STORAGE_SOURCES_<S>_URL / _USERNAME / _PASSWORD (SQL)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Optional
+
+from predictionio_tpu.storage.models import ModelStore
+
+
+class StorageClientError(RuntimeError):
+    """Backend selected but unusable (missing driver / bad config) —
+    reference: StorageClientException."""
+
+
+def _source_env(key: str, default: str = "") -> str:
+    # any source name may carry the setting; first match wins. Source
+    # names are discovered from their (mandatory) _TYPE key, so names
+    # with underscores (MY_PG) resolve too — and because the name is
+    # matched as a whole, *_BASE_PATH can never shadow a lookup of PATH.
+    names = [m.group(1) for k in os.environ
+             if (m := re.match(r"^PIO_STORAGE_SOURCES_(.+)_TYPE$", k))]
+    for name in names:
+        v = os.environ.get(f"PIO_STORAGE_SOURCES_{name}_{key}")
+        if v is not None:
+            return v
+    return default
+
+
+class S3ModelStore(ModelStore):
+    """Model blobs on S3 (reference: [U] storage/s3/ S3Models).
+
+    ``props`` = the backing source's settings (StorageConfig
+    ``source_properties``); direct construction may pass bucket/base
+    explicitly or fall back to a single-source env scan.
+    """
+
+    def __init__(self, bucket: Optional[str] = None,
+                 base_path: Optional[str] = None,
+                 props: Optional[dict] = None) -> None:
+        try:
+            import boto3  # type: ignore[import-not-found]
+        except ImportError as e:
+            raise StorageClientError(
+                "MODELDATA type S3 requires the boto3 driver "
+                "(pip install boto3)") from e
+        props = props or {}
+        self.bucket = (bucket or props.get("BUCKET_NAME")
+                       or _source_env("BUCKET_NAME"))
+        if not self.bucket:
+            raise StorageClientError(
+                "S3 model store needs PIO_STORAGE_SOURCES_<S>_BUCKET_NAME")
+        self.base = (base_path or props.get("BASE_PATH")
+                     or _source_env("BASE_PATH", "pio_models")).strip("/")
+        self._s3 = boto3.client("s3")
+
+    def _key(self, instance_id: str) -> str:
+        return f"{self.base}/{instance_id}.bin"
+
+    def put(self, instance_id: str, blob: bytes) -> None:
+        self._s3.put_object(Bucket=self.bucket, Key=self._key(instance_id),
+                            Body=blob)
+
+    def get(self, instance_id: str) -> Optional[bytes]:
+        try:
+            r = self._s3.get_object(Bucket=self.bucket,
+                                    Key=self._key(instance_id))
+        except self._s3.exceptions.NoSuchKey:
+            return None
+        return r["Body"].read()
+
+    def delete(self, instance_id: str) -> bool:
+        self._s3.delete_object(Bucket=self.bucket, Key=self._key(instance_id))
+        return True
+
+    def list_ids(self) -> List[str]:
+        out, token = [], None
+        while True:
+            kw = {"Bucket": self.bucket, "Prefix": self.base + "/"}
+            if token:
+                kw["ContinuationToken"] = token
+            r = self._s3.list_objects_v2(**kw)
+            out += [o["Key"][len(self.base) + 1:-4]
+                    for o in r.get("Contents", ())
+                    if o["Key"].endswith(".bin")]
+            if not r.get("IsTruncated"):
+                return out
+            token = r.get("NextContinuationToken")
+
+
+class HDFSModelStore(ModelStore):
+    """Model blobs on HDFS via pyarrow (reference: [U] storage/hdfs/
+    HDFSModels). Needs libhdfs (a Hadoop install) at runtime."""
+
+    def __init__(self, host: Optional[str] = None, port: Optional[int] = None,
+                 path: Optional[str] = None,
+                 props: Optional[dict] = None) -> None:
+        try:
+            from pyarrow import fs
+        except ImportError as e:  # pragma: no cover - pyarrow is baked in
+            raise StorageClientError(
+                "MODELDATA type HDFS requires pyarrow") from e
+        props = props or {}
+        host = host or props.get("HOSTS") or _source_env("HOSTS", "default")
+        port = port if port is not None else int(
+            props.get("PORTS") or _source_env("PORTS", "8020"))
+        self.root = (path or props.get("PATH")
+                     or _source_env("PATH", "/pio_models")).rstrip("/")
+        try:
+            self._fs = fs.HadoopFileSystem(host, port)
+        except Exception as e:
+            raise StorageClientError(
+                f"cannot reach HDFS at {host}:{port} (libhdfs present?): {e}"
+            ) from e
+
+    def _key(self, instance_id: str) -> str:
+        return f"{self.root}/{instance_id}.bin"
+
+    def put(self, instance_id: str, blob: bytes) -> None:
+        from pyarrow import fs
+
+        self._fs.create_dir(self.root, recursive=True)
+        with self._fs.open_output_stream(self._key(instance_id)) as f:
+            f.write(blob)
+
+    def get(self, instance_id: str) -> Optional[bytes]:
+        from pyarrow import fs
+
+        info = self._fs.get_file_info(self._key(instance_id))
+        if info.type == fs.FileType.NotFound:
+            return None
+        with self._fs.open_input_stream(self._key(instance_id)) as f:
+            return f.read()
+
+    def delete(self, instance_id: str) -> bool:
+        from pyarrow import fs
+
+        info = self._fs.get_file_info(self._key(instance_id))
+        if info.type == fs.FileType.NotFound:
+            return False
+        self._fs.delete_file(self._key(instance_id))
+        return True
+
+    def list_ids(self) -> List[str]:
+        from pyarrow import fs
+
+        sel = fs.FileSelector(self.root, allow_not_found=True)
+        return [i.base_name[:-4] for i in self._fs.get_file_info(sel)
+                if i.base_name.endswith(".bin")]
+
+
+def _sql_dialect(type_name: str, cfg, repo: str):
+    """Dialect for a SQL-server source; raises StorageClientError with
+    install instructions when the DB-API driver is absent."""
+    from predictionio_tpu.storage.sqldialect import dialect_for
+
+    return dialect_for(type_name, cfg.source_properties(repo), "")
+
+
+def register_all() -> None:
+    from predictionio_tpu.storage import registry as reg
+    from predictionio_tpu.data.events import SQLEventStore
+    from predictionio_tpu.storage.meta import MetaStore
+    from predictionio_tpu.storage.models import SQLModelStore
+
+    reg.register_model_backend(
+        "S3", lambda cfg: S3ModelStore(
+            props=cfg.source_properties("MODELDATA")))
+    reg.register_model_backend(
+        "HDFS", lambda cfg: HDFSModelStore(
+            props=cfg.source_properties("MODELDATA")))
+    # SQL-server backends (reference: [U] storage/jdbc/ — every repo type
+    # on PostgreSQL/MySQL). The shared SQL store implementations run on
+    # the engine's dialect; the reference's pio-env idiom points all
+    # three repositories at the same SQL source.
+    for t in ("PGSQL", "MYSQL"):
+        reg.register_event_backend(
+            t, lambda cfg, _t=t: SQLEventStore(
+                _sql_dialect(_t, cfg, "EVENTDATA")))
+        reg.register_meta_backend(
+            t, lambda cfg, _t=t: MetaStore(
+                dialect=_sql_dialect(_t, cfg, "METADATA")))
+        reg.register_model_backend(
+            t, lambda cfg, _t=t: SQLModelStore(
+                _sql_dialect(_t, cfg, "MODELDATA")))

@@ -1,0 +1,20 @@
+"""Built-in engine templates.
+
+Equivalent of the reference's ``examples/scala-parallel-*`` templates
+(SURVEY.md §2c) — the behavioral test suite of the framework. Each
+template module exposes ``engine_factory()`` plus its DASE component
+classes, and ships an ``engine.json`` the CLI can copy into a new
+engine directory (``pio template new <name> <dir>``).
+"""
+
+# grown as templates land; `pio template list` reflects exactly this dict
+TEMPLATES = {
+    "recommendation": "predictionio_tpu.templates.recommendation.engine",
+    "classification": "predictionio_tpu.templates.classification.engine",
+    "similarproduct": "predictionio_tpu.templates.similarproduct.engine",
+    "ecommercerecommendation": "predictionio_tpu.templates.ecommercerecommendation.engine",
+    "universal": "predictionio_tpu.templates.universal.engine",
+    "twotower": "predictionio_tpu.templates.twotower.engine",
+    "sequentialrec": "predictionio_tpu.templates.sequentialrec.engine",
+    "vanilla": "predictionio_tpu.templates.vanilla.engine",
+}

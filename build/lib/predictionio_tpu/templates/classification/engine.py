@@ -1,0 +1,300 @@
+"""Classification template: NaiveBayes / LogisticRegression.
+
+Behavioral equivalent of the reference's classification template
+(reference: [U] examples/scala-parallel-classification/ — DataSource
+reads ``$set`` user properties (attr0..attrN doubles + integer label)
+into LabeledPoints; algorithms: MLlib NaiveBayes and
+LogisticRegressionWithLBFGS; SURVEY.md §2c). Wire shapes preserved:
+
+    POST /queries.json  {"attr0": 2.0, "attr1": 0.0, "attr2": 0.0}
+    → {"label": 0.0}
+
+Compute: :mod:`predictionio_tpu.models.naive_bayes` /
+:mod:`predictionio_tpu.models.linear` (JAX, mesh-aware DP).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    AverageMetric,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    WorkflowContext,
+)
+from predictionio_tpu.data import store as event_store
+from predictionio_tpu.models.linear import (
+    LogisticRegressionParams,
+    logreg_predict,
+    logreg_train,
+)
+from predictionio_tpu.models.naive_bayes import NaiveBayesParams, nb_predict, nb_train
+
+
+@dataclass
+class DataSourceParams:
+    app_name: str = ""
+    attrs: List[str] = field(default_factory=lambda: ["attr0", "attr1", "attr2"])
+    label: str = "label"
+    entity_type: str = "user"
+    eval_k: int = 0
+    eval_seed: int = 3
+
+
+@dataclass
+class LabeledData:
+    X: np.ndarray  # (n, d) float32
+    y: np.ndarray  # (n,) int32
+    attrs: List[str]
+
+
+class ClassificationDataSource(DataSource):
+    ParamsClass = DataSourceParams
+
+    def _read(self, ctx: WorkflowContext) -> LabeledData:
+        p: DataSourceParams = self.params
+        snap = event_store.aggregate_properties(
+            p.app_name, p.entity_type, storage=ctx.storage)
+        rows, labels = [], []
+        for _, props in snap.items():
+            try:
+                feats = [float(props[a]) for a in p.attrs]
+                label = int(float(props[p.label]))
+            except (KeyError, TypeError, ValueError):
+                continue
+            rows.append(feats)
+            labels.append(label)
+        if not rows:
+            raise ValueError(
+                f"no entities with properties {p.attrs + [p.label]} found; "
+                "$set them before `pio train`")
+        return LabeledData(np.asarray(rows, np.float32),
+                           np.asarray(labels, np.int32), list(p.attrs))
+
+    def read_training(self, ctx: WorkflowContext) -> LabeledData:
+        return self._read(ctx)
+
+    def read_eval(self, ctx: WorkflowContext):
+        p: DataSourceParams = self.params
+        if p.eval_k <= 0:
+            raise ValueError("set dataSourceParams.evalK > 0 to evaluate")
+        data = self._read(ctx)
+        rng = np.random.default_rng(p.eval_seed)
+        fold_of = rng.integers(0, p.eval_k, size=len(data.y))
+        folds = []
+        for f in range(p.eval_k):
+            tr = fold_of != f
+            te = fold_of == f
+            td = LabeledData(data.X[tr], data.y[tr], data.attrs)
+            qa = [
+                (dict(zip(data.attrs, map(float, row))), float(label))
+                for row, label in zip(data.X[te], data.y[te])
+            ]
+            folds.append((td, {"fold": f}, qa))
+        return folds
+
+
+class ClassificationModel:
+    def __init__(self, kind: str, attrs: List[str], **arrays) -> None:
+        self.kind = kind
+        self.attrs = attrs
+        self.arrays = arrays
+
+    def features(self, query: Dict[str, Any]) -> np.ndarray:
+        return np.asarray([[float(query.get(a, 0.0)) for a in self.attrs]],
+                          np.float32)
+
+
+@dataclass
+class NBAlgoParams:
+    lambda_: float = 1.0
+    model_type: str = "multinomial"
+
+
+class NaiveBayesAlgorithm(Algorithm):
+    ParamsClass = NBAlgoParams
+
+    def sanity_check(self, data: LabeledData) -> None:
+        if len(data.y) == 0:
+            raise ValueError("empty training data")
+
+    def train(self, ctx: WorkflowContext, pd: LabeledData) -> ClassificationModel:
+        p: NBAlgoParams = self.params
+        lp, lt = nb_train(pd.X, pd.y,
+                          NaiveBayesParams(lambda_=p.lambda_,
+                                           model_type=p.model_type),
+                          mesh=ctx.mesh)
+        return ClassificationModel("nb", pd.attrs, log_prior=lp, log_theta=lt,
+                                   model_type=np.asarray([p.model_type == "bernoulli"]))
+
+    def predict(self, model: ClassificationModel, query: Dict[str, Any]) -> Dict[str, Any]:
+        kind = "bernoulli" if model.arrays["model_type"][0] else "multinomial"
+        label = nb_predict(model.arrays["log_prior"], model.arrays["log_theta"],
+                           model.features(query), kind)[0]
+        return {"label": float(label)}
+
+
+@dataclass
+class LRAlgoParams:
+    num_classes: int = 2
+    iterations: int = 100
+    reg: float = 0.0
+    optimizer: str = "lbfgs"
+
+
+class LogisticRegressionAlgorithm(Algorithm):
+    ParamsClass = LRAlgoParams
+
+    def sanity_check(self, data: LabeledData) -> None:
+        if len(data.y) == 0:
+            raise ValueError("empty training data")
+
+    def train(self, ctx: WorkflowContext, pd: LabeledData) -> ClassificationModel:
+        p: LRAlgoParams = self.params
+        num_classes = max(p.num_classes, int(pd.y.max()) + 1)
+        W, b = logreg_train(
+            pd.X, pd.y,
+            LogisticRegressionParams(num_classes=num_classes,
+                                     iterations=p.iterations, reg=p.reg,
+                                     optimizer=p.optimizer),
+            mesh=ctx.mesh)
+        return ClassificationModel("lr", pd.attrs, W=W, b=b)
+
+    @classmethod
+    def train_many(cls, ctx: WorkflowContext, pd: LabeledData,
+                   params_list) -> List[ClassificationModel]:
+        """Grid-search fan-out: same-geometry candidates (differing in
+        reg) train as ONE vmapped program (SURVEY.md §2d P4).
+
+        num_classes resolves PER CANDIDATE exactly as ``train`` does —
+        a candidate's model must not depend on which other candidates
+        share the grid (logreg_train_many groups by geometry, so mixed
+        num_classes simply land in different stacks)."""
+        from predictionio_tpu.models.linear import logreg_train_many
+
+        data_classes = int(pd.y.max()) + 1
+        wbs = logreg_train_many(
+            pd.X, pd.y,
+            [LogisticRegressionParams(
+                num_classes=max(p.num_classes, data_classes),
+                iterations=p.iterations, reg=p.reg,
+                optimizer=p.optimizer)
+             for p in params_list],
+            mesh=ctx.mesh)
+        return [ClassificationModel("lr", pd.attrs, W=W, b=b)
+                for W, b in wbs]
+
+    def predict(self, model: ClassificationModel, query: Dict[str, Any]) -> Dict[str, Any]:
+        label = logreg_predict(model.arrays["W"], model.arrays["b"],
+                               model.features(query))[0]
+        return {"label": float(label)}
+
+
+@dataclass
+class RFAlgoParams:
+    """MLlib RandomForest knob names where they map (numTrees,
+    maxDepth); thresholds/featureFrac drive the oblivious-tree
+    discretization (models/forest.py)."""
+
+    num_trees: int = 16
+    max_depth: int = 5
+    n_thresholds: int = 16
+    feature_frac: float = 0.7
+    seed: int = 0
+
+
+class RandomForestAlgorithm(Algorithm):
+    """The reference template's RandomForest variant (SURVEY.md §2c
+    config 2), as TPU-vectorized oblivious trees — handles the
+    non-linear boundaries NB and logistic regression cannot."""
+
+    ParamsClass = RFAlgoParams
+
+    def sanity_check(self, data: LabeledData) -> None:
+        if len(data.y) == 0:
+            raise ValueError("empty training data")
+
+    def train(self, ctx: WorkflowContext, pd: LabeledData) -> ClassificationModel:
+        from predictionio_tpu.models.forest import ForestParams, forest_train
+
+        p: RFAlgoParams = self.params
+        m = forest_train(pd.X, pd.y, ForestParams(
+            n_trees=p.num_trees, max_depth=p.max_depth,
+            n_thresholds=p.n_thresholds, feature_frac=p.feature_frac,
+            seed=p.seed), mesh=ctx.mesh)
+        return ClassificationModel(
+            "rf", pd.attrs, feats=m.feats, thrs=m.thrs,
+            leaf_probs=m.leaf_probs,
+            n_classes=np.asarray([m.n_classes]))
+
+    def predict(self, model: ClassificationModel, query: Dict[str, Any]) -> Dict[str, Any]:
+        from predictionio_tpu.models.forest import (ForestModel,
+                                                    forest_predict_proba)
+
+        fm = ForestModel(model.arrays["feats"], model.arrays["thrs"],
+                         model.arrays["leaf_probs"],
+                         int(model.arrays["n_classes"][0]))
+        probs = forest_predict_proba(fm, model.features(query))[0]
+        return {"label": float(np.argmax(probs)),
+                "probs": {str(c): float(p) for c, p in enumerate(probs)}}
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_cls=ClassificationDataSource,
+        preparator_cls=IdentityPreparator,
+        algorithm_cls_map={
+            "naive": NaiveBayesAlgorithm,
+            "lr": LogisticRegressionAlgorithm,
+            "forest": RandomForestAlgorithm,
+        },
+        serving_cls=FirstServing,
+    )
+
+
+# -- evaluation (pio eval out of the box) -------------------------------------
+
+
+class Accuracy(AverageMetric):
+    """Fraction of held-out rows labeled correctly."""
+
+    def calculate_one(self, query, predicted, actual) -> float:
+        return 1.0 if float(predicted.get("label", float("nan"))) == \
+            float(actual) else 0.0
+
+
+class ClsEvaluation(Evaluation):
+    engine_factory = staticmethod(engine_factory)
+    metric = Accuracy()
+
+
+class DefaultGrid(EngineParamsGenerator):
+    """NB smoothing vs logistic vs forest, 2 folds; app via
+    $PIO_EVAL_APP_NAME."""
+
+    @property
+    def engine_params_list(self):
+        import os
+
+        app = os.environ.get("PIO_EVAL_APP_NAME", "MyApp2")
+        ds = DataSourceParams(app_name=app, eval_k=2)
+        return [
+            EngineParams(data_source_params=ds,
+                         algorithms_params=[("naive", NBAlgoParams(lambda_=lam))])
+            for lam in (0.5, 1.0)
+        ] + [
+            EngineParams(data_source_params=ds,
+                         algorithms_params=[("lr", LRAlgoParams())]),
+            EngineParams(data_source_params=ds,
+                         algorithms_params=[("forest", RFAlgoParams())]),
+        ]

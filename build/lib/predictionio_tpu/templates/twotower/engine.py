@@ -1,0 +1,297 @@
+"""Two-Tower deep retrieval template.
+
+The new-framework extension target (BASELINE.json config 5; absent in
+the reference — SURVEY.md §2c): flax user/item towers trained with
+in-batch contrastive loss on positive interaction events, served by
+cosine retrieval over the precomputed item-embedding table.
+
+    POST /queries.json {"user": "u1", "num": 4}
+    → {"itemScores": [{"item": "i2", "score": 0.93}, ...]}
+"""
+
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    AverageMetric,
+    DataSource,
+    Engine,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
+    FirstServing,
+    IdentityPreparator,
+    WorkflowContext,
+)
+from predictionio_tpu.models.two_tower import (
+    TwoTowerParams,
+    two_tower_embed_items,
+    two_tower_embed_users,
+    two_tower_train,
+    two_tower_user_embed,
+)
+from predictionio_tpu.utils.bimap import BiMap
+
+
+@dataclass
+class DataSourceParams:
+    app_name: str = ""
+    event_names: List[str] = field(default_factory=lambda: ["view", "buy"])
+    # >0 selects the streaming read path with this chunk size (events
+    # per columnar chunk); 0 materializes pairs in host RAM
+    stream_chunk: int = 0
+
+
+@dataclass
+class TrainingData:
+    interactions: Any   # data.pipeline.InteractionData
+    stream: bool = False  # True → trainer consumes chunks, not arrays
+
+
+class TTDataSource(DataSource):
+    ParamsClass = DataSourceParams
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        """Columnar read through the streaming pipeline in BOTH modes
+        (SURVEY §2d C4) — ~1/50th the transient memory of building a
+        Python pair list. ``stream_chunk > 0`` additionally keeps the
+        data chunked end-to-end (memory O(chunk + vocabulary), event
+        logs larger than host RAM; the trainer double-buffers chunks
+        into HBM)."""
+        from predictionio_tpu.data.store import read_training_interactions
+
+        p: DataSourceParams = self.params
+        data = read_training_interactions(
+            p.app_name, entity_type="user", target_entity_type="item",
+            event_names=p.event_names,
+            chunk_size=p.stream_chunk or 65536,
+            # explicit streaming request = log may exceed host RAM;
+            # honor O(chunk) over the materializing columnar fast path
+            prefer_streaming=p.stream_chunk > 0,
+            storage=ctx.storage)
+        if data.n_events == 0:
+            raise ValueError("no interaction events found")
+        return TrainingData(data, stream=p.stream_chunk > 0)
+
+    def read_eval(self, ctx: WorkflowContext):
+        """Leave-one-out retrieval evaluation: each user's LAST
+        interaction is held out of training and must be retrieved by
+        the ``{"user": u}`` query (recall@k under one relevant item)."""
+        from predictionio_tpu.data.pipeline import InteractionData
+
+        td = self.read_training(ctx)
+        u, i, v = td.interactions.arrays()
+        last: Dict[int, int] = {}
+        cnt: Dict[int, int] = {}
+        for idx, uu in enumerate(u.tolist()):
+            last[uu] = idx
+            cnt[uu] = cnt.get(uu, 0) + 1
+        held = sorted(idx for uu, idx in last.items() if cnt[uu] >= 2)
+        if not held:
+            raise ValueError("no user has ≥ 2 interactions to hold out")
+        keep = np.ones(len(u), bool)
+        keep[held] = False
+        uk, ik, vk = u[keep], i[keep], v[keep]
+        reduced = InteractionData(
+            td.interactions.user_ids, td.interactions.item_ids,
+            lambda: iter([(uk, ik, vk)]), int(len(uk)))
+        inv_u = td.interactions.user_ids.inverse()
+        inv_i = td.interactions.item_ids.inverse()
+        qa = [({"user": inv_u[int(u[idx])], "num": 10},
+               inv_i[int(i[idx])]) for idx in held]
+        return [(TrainingData(reduced, stream=False), {"fold": 0}, qa)]
+
+
+@dataclass
+class TTAlgorithmParams:
+    embed_dim: int = 32
+    out_dim: int = 32
+    hidden: List[int] = field(default_factory=lambda: [64])
+    batch_size: int = 1024
+    epochs: int = 5
+    learning_rate: float = 0.01
+    temperature: float = 0.1
+    seed: int = 0
+    # mid-train checkpoint/resume (Orbax); None disables
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 1
+
+
+class TwoTowerModel:
+    def __init__(self, user_vars, item_embeds: np.ndarray, user_ids: BiMap,
+                 item_ids: BiMap, params: TwoTowerParams,
+                 user_embeds: Optional[np.ndarray] = None) -> None:
+        self.user_vars = user_vars
+        self.item_embeds = item_embeds
+        self.user_ids = user_ids
+        self.item_ids = item_ids
+        self._inv = item_ids.inverse()
+        self.params = params
+        # both towers materialized → serving rides the SAME
+        # device-resident gather→score→top-k program as the ALS family
+        # (r5); load_model recomputes this from user_vars, so it is
+        # None only for hand-built models
+        self.user_embeds = user_embeds
+        self._scorer = None
+
+    def _device_scorer(self):
+        """Lazy shared-policy resident scorer (models/als).
+        Retrieval here IS the ALS serving shape: U @ V.T + top-k."""
+        if self.user_embeds is None:
+            return None
+        from predictionio_tpu.models.als import maybe_resident_scorer
+
+        self._scorer = maybe_resident_scorer(
+            self.user_embeds, self.item_embeds, self._scorer)
+        return self._scorer
+
+    def recommend(self, user: str, num: int) -> List[Dict[str, Any]]:
+        uidx = self.user_ids.get(user)
+        if uidx is None:
+            return []
+        scorer = self._device_scorer()
+        if scorer is not None:
+            iv, vv = scorer.recommend(uidx, num)
+            return [{"item": self._inv[int(i)], "score": float(s)}
+                    for i, s in zip(iv, vv)]
+        ue = (self.user_embeds[uidx] if self.user_embeds is not None else
+              two_tower_user_embed(self.user_vars, uidx,
+                                   len(self.user_ids), self.params))
+        scores = self.item_embeds @ ue
+        num = min(num, scores.shape[0])
+        top = np.argpartition(-scores, num - 1)[:num]
+        top = top[np.argsort(-scores[top])]
+        return [{"item": self._inv[int(i)], "score": float(scores[i])}
+                for i in top]
+
+
+class TwoTowerAlgorithm(Algorithm):
+    ParamsClass = TTAlgorithmParams
+
+    def sanity_check(self, data: TrainingData) -> None:
+        if data.interactions is None or data.interactions.n_events == 0:
+            raise ValueError("empty training pairs")
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> TwoTowerModel:
+        p: TTAlgorithmParams = self.params
+        user_ids = pd.interactions.user_ids
+        item_ids = pd.interactions.item_ids
+        if pd.stream:
+            uidx = np.zeros(0, np.int32)
+            iidx = np.zeros(0, np.int32)
+        else:
+            uidx, iidx, _ = pd.interactions.arrays()
+        # explicit checkpoint_dir param wins; else the workflow's
+        # per-run checkpoint dir enables restart-from-checkpoint
+        ckpt_dir = p.checkpoint_dir
+        if ckpt_dir is None and ctx.checkpoint_dir:
+            import os
+
+            ckpt_dir = os.path.join(ctx.checkpoint_dir, "two_tower")
+        tp = TwoTowerParams(
+            embed_dim=p.embed_dim, hidden=list(p.hidden), out_dim=p.out_dim,
+            batch_size=p.batch_size, epochs=p.epochs,
+            learning_rate=p.learning_rate, temperature=p.temperature,
+            seed=p.seed, checkpoint_dir=ckpt_dir,
+            checkpoint_every=p.checkpoint_every,
+            n_pairs=pd.interactions.n_events)
+        uv, iv = two_tower_train(
+            uidx, iidx, len(user_ids), len(item_ids), tp, mesh=ctx.mesh,
+            pair_chunks=(pd.interactions.chunks if pd.stream else None))
+        item_embeds = two_tower_embed_items(iv, len(item_ids), tp)
+        user_embeds = two_tower_embed_users(uv, len(user_ids), tp)
+        return TwoTowerModel(uv, item_embeds, user_ids, item_ids, tp,
+                             user_embeds=user_embeds)
+
+    def predict(self, model: TwoTowerModel, query: Dict[str, Any]) -> Dict[str, Any]:
+        return {"itemScores": model.recommend(str(query["user"]),
+                                              int(query.get("num", 10)))}
+
+    def batch_predict(self, model: TwoTowerModel,
+                      queries) -> List[Dict[str, Any]]:
+        """Micro-batched serving (`pio deploy --batching`,
+        batchpredict): all queries in ONE device dispatch via the
+        shared `models/als.serve_topk_batch`."""
+        from predictionio_tpu.models.als import serve_topk_batch
+
+        return serve_topk_batch(
+            model._device_scorer(), model.user_ids, model._inv,
+            queries, fallback=lambda q: self.predict(model, q))
+
+    def save_model(self, model: TwoTowerModel, instance_dir: Optional[str]) -> bytes:
+        # user_embeds is NOT persisted: it is derivable from user_vars
+        # in one chunked numpy pass (~35 MB saved per ML-20M blob) and
+        # recomputing on load also upgrades pre-r5 blobs to the
+        # device-resident serving path
+        return pickle.dumps({
+            "user_vars": model.user_vars,
+            "item_embeds": model.item_embeds,
+            "user_ids": model.user_ids.to_dict(),
+            "item_ids": model.item_ids.to_dict(),
+            "params": model.params,
+        })
+
+    def load_model(self, blob: Optional[bytes], instance_dir: Optional[str]) -> TwoTowerModel:
+        assert blob is not None
+        d = pickle.loads(blob)
+        user_ids = BiMap(d["user_ids"])
+        return TwoTowerModel(d["user_vars"], d["item_embeds"],
+                             user_ids, BiMap(d["item_ids"]),
+                             d["params"],
+                             user_embeds=two_tower_embed_users(
+                                 d["user_vars"], len(user_ids),
+                                 d["params"]))
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_cls=TTDataSource,
+        preparator_cls=IdentityPreparator,
+        algorithm_cls_map={"twotower": TwoTowerAlgorithm},
+        serving_cls=FirstServing,
+    )
+
+
+# -- evaluation (pio eval out of the box) -------------------------------------
+
+
+class RecallAtK(AverageMetric):
+    """With one held-out relevant item, recall@k = hit rate @ k."""
+
+    def __init__(self, k: int = 10) -> None:
+        self.k = k
+
+    def calculate_one(self, query, predicted, actual) -> float:
+        items = [s["item"] for s in predicted.get("itemScores", [])][: self.k]
+        return 1.0 if actual in items else 0.0
+
+    @property
+    def header(self) -> str:
+        return f"Recall@{self.k}"
+
+
+class TTEvaluation(Evaluation):
+    engine_factory = staticmethod(engine_factory)
+    metric = RecallAtK(10)
+    other_metrics = (RecallAtK(1),)
+
+
+class DefaultGrid(EngineParamsGenerator):
+    """Embedding-width candidates; app name via $PIO_EVAL_APP_NAME."""
+
+    @property
+    def engine_params_list(self):
+        import os
+
+        app = os.environ.get("PIO_EVAL_APP_NAME", "MyApp1")
+        return [EngineParams(
+            data_source_params=DataSourceParams(app_name=app),
+            algorithms_params=[("twotower", TTAlgorithmParams(
+                embed_dim=d, out_dim=d, hidden=[2 * d], batch_size=256,
+                epochs=30))]) for d in (16, 32)]

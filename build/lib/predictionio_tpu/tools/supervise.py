@@ -1,0 +1,244 @@
+"""Process supervision for the long-lived servers.
+
+The reference's engine server runs under a ``MasterActor`` that
+supervises bind failures and restarts, and ``pio-daemon`` /
+``pio-start-all`` daemonize the services (reference: [U]
+core/.../workflow/CreateServer.scala MasterActor, bin/pio-daemon —
+unverified, SURVEY.md §2a CreateServer, §5 failure detection). Here the
+equivalent is split the unix way:
+
+- bind-retry lives in the servers themselves
+  (:class:`predictionio_tpu.server.http.HTTPServer` ``bind_retries``);
+- crash restart + liveness live in this :class:`Supervisor`, a small
+  process supervisor the ``pio daemon`` verb (and ``bin/pio-daemon``)
+  wrap around any server verb:
+
+  * restarts the child when it exits unexpectedly, with exponential
+    backoff that resets after a stable period;
+  * optional HTTP health checks (``GET health_url`` expecting < 500)
+    — a wedged-but-alive server gets killed and restarted;
+  * a restart budget within a rolling window, so a crash loop ends in
+    a loud failure instead of a silent hot loop;
+  * clean SIGTERM/SIGINT forwarding and a pidfile for stop scripts.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional, Sequence
+
+
+def _log(*args) -> None:
+    # flush per line: under `pio-daemon`'s redirected stdout, plain print
+    # is block-buffered and restart events would not reach the log until
+    # the buffer fills
+    print(*args, flush=True)
+
+
+class Supervisor:
+    def __init__(
+        self,
+        argv: Sequence[str],
+        health_url: Optional[str] = None,
+        health_interval: float = 5.0,
+        health_timeout: float = 3.0,
+        health_grace: float = 10.0,
+        max_restarts: int = 10,
+        restart_window: float = 600.0,
+        backoff: float = 1.0,
+        backoff_max: float = 30.0,
+        pidfile: Optional[str] = None,
+        log=_log,
+    ) -> None:
+        self.argv = list(argv)
+        self.health_url = health_url
+        self.health_interval = health_interval
+        self.health_timeout = health_timeout
+        self.health_grace = health_grace
+        self.max_restarts = max_restarts
+        self.restart_window = restart_window
+        self.backoff = backoff
+        self.backoff_max = backoff_max
+        self.pidfile = pidfile
+        self.log = log
+        self._child: Optional[subprocess.Popen] = None
+        self._stopping = False
+        self.restarts = 0
+        self._restart_times: List[float] = []
+
+    # -- child lifecycle -------------------------------------------------------
+
+    def _spawn(self) -> None:
+        self._child = subprocess.Popen(self.argv)
+        self.log(f"[supervise] started pid {self._child.pid}: "
+                 f"{' '.join(self.argv)}")
+
+    def _terminate_child(self, grace: float = 10.0) -> None:
+        child = self._child
+        if child is None or child.poll() is not None:
+            return
+        child.terminate()
+        try:
+            child.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            child.wait()
+
+    def _healthy(self) -> bool:
+        assert self.health_url is not None
+        try:
+            with urllib.request.urlopen(self.health_url,
+                                        timeout=self.health_timeout) as r:
+                return r.status < 500
+        except urllib.error.HTTPError as e:
+            return e.code < 500
+        except Exception:
+            return False
+
+    def _budget_exceeded(self, now: float) -> bool:
+        self._restart_times = [t for t in self._restart_times
+                               if now - t <= self.restart_window]
+        return len(self._restart_times) >= self.max_restarts
+
+    # -- main loop -------------------------------------------------------------
+
+    def run(self) -> int:
+        """Supervise until stopped; returns the exit code to propagate
+        (0 on clean stop, 1 when the restart budget is exhausted)."""
+        if self.pidfile:
+            os.makedirs(os.path.dirname(self.pidfile) or ".", exist_ok=True)
+            with open(self.pidfile, "w") as f:
+                f.write(str(os.getpid()))
+
+        def on_signal(signum, frame):
+            self._stopping = True
+            self._terminate_child()
+
+        old = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                old[sig] = signal.signal(sig, on_signal)
+            except ValueError:
+                pass  # not the main thread (tests drive stop() directly)
+
+        try:
+            self._spawn()
+            started = time.monotonic()
+            last_health = started
+            cur_backoff = self.backoff
+            while not self._stopping:
+                code = self._child.poll() if self._child else None
+                now = time.monotonic()
+                restart = False
+                if code is not None:
+                    if self._stopping:
+                        break
+                    if code == 0:
+                        # a clean exit is a finished job, not a crash —
+                        # restarting it (e.g. `pio daemon -- train`) would
+                        # re-run a successful run until the budget ran out
+                        self.log("[supervise] child exited cleanly; done")
+                        return 0
+                    self.log(f"[supervise] child exited with {code}")
+                    restart = True
+                elif (self.health_url is not None
+                      and now - started > self.health_grace
+                      and now - last_health >= self.health_interval):
+                    last_health = now
+                    if not self._healthy():
+                        self.log("[supervise] health check failed; "
+                                 "restarting child")
+                        self._terminate_child()
+                        restart = True
+                if restart:
+                    if self._budget_exceeded(now):
+                        self.log(f"[supervise] {self.max_restarts} restarts "
+                                 f"within {self.restart_window:.0f}s — "
+                                 "giving up")
+                        return 1
+                    self._restart_times.append(now)
+                    self.restarts += 1
+                    time.sleep(cur_backoff)
+                    cur_backoff = min(cur_backoff * 2, self.backoff_max)
+                    self._spawn()
+                    started = time.monotonic()
+                    last_health = started
+                else:
+                    if (self._child is not None
+                            and now - started > 2 * max(self.backoff, 1.0)):
+                        cur_backoff = self.backoff  # stable → reset backoff
+                    time.sleep(0.2)
+            self._terminate_child()
+            return 0
+        finally:
+            for sig, handler in old.items():
+                signal.signal(sig, handler)
+            if self.pidfile:
+                try:
+                    os.remove(self.pidfile)
+                except FileNotFoundError:
+                    pass
+
+    def stop(self) -> None:
+        self._stopping = True
+        self._terminate_child()
+
+
+def normalize_command(command: Sequence[str]) -> List[str]:
+    """Resolve the supervised command line: drop the one leading ``--``
+    argparse leaves in REMAINDER, and route bare verbs through this
+    interpreter's CLI (``eventserver --port 7070`` →
+    ``python -m predictionio_tpu.tools.cli eventserver --port 7070``)."""
+    cmd = list(command)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        return cmd
+    head = os.path.basename(cmd[0])
+    if cmd[0] != sys.executable and not head.startswith("python"):
+        cmd = [sys.executable, "-m", "predictionio_tpu.tools.cli"] + cmd
+    return cmd
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="pio daemon",
+        description="supervise a pio server verb (crash restart, "
+                    "health checks, pidfile)")
+    ap.add_argument("--pidfile")
+    ap.add_argument("--health-url",
+                    help="GET this URL periodically; a non-responsive or "
+                         ">=500 child is restarted")
+    ap.add_argument("--health-interval", type=float, default=5.0)
+    ap.add_argument("--health-grace", type=float, default=30.0,
+                    help="seconds after (re)start before health checks "
+                         "begin — must exceed the server's worst-case "
+                         "startup (model load + first compile)")
+    ap.add_argument("--max-restarts", type=int, default=10)
+    ap.add_argument("--restart-window", type=float, default=600.0)
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="the pio verb to supervise, e.g. "
+                         "eventserver --port 7070")
+    args = ap.parse_args(argv)
+    cmd = normalize_command(args.command)
+    if not cmd:
+        ap.error("no command given")
+    sup = Supervisor(cmd, health_url=args.health_url,
+                     health_interval=args.health_interval,
+                     health_grace=args.health_grace,
+                     max_restarts=args.max_restarts,
+                     restart_window=args.restart_window,
+                     pidfile=args.pidfile)
+    return sup.run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

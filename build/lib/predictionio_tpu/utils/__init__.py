@@ -1,0 +1,3 @@
+from predictionio_tpu.utils.bimap import BiMap
+
+__all__ = ["BiMap"]

@@ -1,0 +1,296 @@
+"""Mid-training checkpoint/resume on Orbax (SURVEY.md §5).
+
+The reference's recovery unit is a completed EngineInstance — it has no
+mid-train checkpoints and relies on Spark task retry. On TPU the
+failure unit is the whole slice, so the survey mandates "training
+restart from latest checkpoint (Orbax)": training loops save their
+full state (model + optimizer + step) every N steps and a restarted
+job resumes from the newest step instead of from scratch.
+
+Layout: ``<dir>/<step>/`` per step (Orbax-managed), newest ``keep``
+retained. State must be a pytree of arrays plus ints/floats.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+
+class CheckpointGeometryError(Exception):
+    """Every stored checkpoint restored cleanly but with shapes that do
+    not match the requested template — the directory holds state from a
+    run with different geometry (rank/width/etc.). This is the one case
+    where wiping the directory is safe and correct."""
+
+
+class TrainCheckpointer:
+    """Thin wrapper over ``orbax.checkpoint.CheckpointManager``.
+
+    >>> ckpt = TrainCheckpointer(dir_, keep=3)
+    >>> start = ckpt.latest_step()                  # None on fresh start
+    >>> state = ckpt.restore(template=state) if start is not None else state
+    >>> ckpt.save(step, state); ...; ckpt.close()
+    """
+
+    def __init__(self, directory: str, keep: int = 3) -> None:
+        self.directory = os.path.abspath(directory)
+        self._keep = keep
+        self._reader = None  # lazy StandardCheckpointer, one per instance
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = self._make_mgr()
+
+    def _make_mgr(self):
+        """SINGLE spelling of the manager options — __init__, clear()
+        and the prune-restart path all construct through here, so a
+        future option cannot silently fail to survive a restart."""
+        import orbax.checkpoint as ocp
+
+        return ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=self._keep),
+        )
+
+    def _metadata_reader(self):
+        import orbax.checkpoint as ocp
+
+        if self._reader is None:
+            self._reader = ocp.StandardCheckpointer()
+        return self._reader
+
+    @staticmethod
+    def _process_index() -> int:
+        import jax
+
+        return jax.process_index()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def save(self, step: int, state: Any) -> None:
+        import orbax.checkpoint as ocp
+
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
+        self._mgr.wait_until_finished()
+        if saved is False:
+            # Orbax declines silently (e.g. the step dir already
+            # exists); treating that as success would drop training
+            # progress on the floor — resume would restore older state
+            raise RuntimeError(
+                f"checkpoint save at step {step} under {self.directory} "
+                f"was skipped by the manager (step already present?)")
+
+    def restore(self, step: Optional[int] = None,
+                template: Optional[Any] = None) -> Any:
+        """Restore ``step`` (default: latest). ``template`` is a pytree
+        with the target structure/dtypes (abstract or concrete)."""
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if template is not None:
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(template))
+        return self._mgr.restore(step)
+
+    def restore_latest_compatible(
+            self, template: Any) -> Tuple[Any, int]:
+        """Restore the newest step whose shapes match ``template``.
+
+        Walks steps newest→oldest so a save truncated by the crash
+        being recovered from falls back to the previous good step.
+        Returns ``(state, step)``. Raises:
+
+        - ``FileNotFoundError`` — no checkpoints exist;
+        - ``CheckpointGeometryError`` — every step restored cleanly but
+          with mismatched shapes (confirmed stale geometry from an
+          earlier run: the caller should ``clear()`` so the stale
+          ``latest_step`` cannot shadow the fresh run's saves);
+        - the underlying read error otherwise — a transient failure
+          (IO hiccup, interrupted read) must NOT be treated as
+          staleness: the checkpoints stay intact for the next attempt
+          instead of being wiped into a silent full retrain.
+        """
+        import jax
+        import numpy as np
+
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        # Stage-1 comparison is a sorted shape MULTISET: the template
+        # may be a typed pytree (namedtuple optimizer states) whose
+        # flatten order differs from the plain-dict tree Orbax metadata
+        # returns. Stage 3 below re-checks positionally.
+        t_shapes = sorted(tuple(np.asarray(leaf).shape)
+                          for leaf in jax.tree.leaves(template))
+        mismatches = 0
+        last_err: Optional[Exception] = None
+        reader = self._metadata_reader()
+        # steps proven stale or torn — and ONLY those — may be pruned
+        # after a successful fallback; a step skipped on a possibly
+        # transient error must survive (it may be the best checkpoint)
+        prunable: set = set()
+        for step in steps:
+            # Stage 1 — compare saved SHAPES from checkpoint metadata
+            # (no payload read): mismatch here is confirmed staleness,
+            # cheap and unaffected by IO flakiness on the data files.
+            # (Read directly off the step dir: CheckpointManager's
+            # item_metadata returns None from a fresh manager that has
+            # not yet seen the item's handler.)
+            try:
+                meta = reader.metadata(
+                    os.path.join(self.directory, str(step), "default"))
+                item_meta = getattr(meta, "item_metadata", meta)
+                if item_meta is None:
+                    # structure present but the step metadata is gone —
+                    # a torn/corrupted step, not stale geometry
+                    prunable.add(step)
+                    raise OSError(
+                        f"checkpoint step {step} under {self.directory} "
+                        f"has unreadable metadata (torn save?)")
+                m_shapes = sorted(tuple(getattr(leaf, "shape", ()) or ())
+                                  for leaf in jax.tree.leaves(item_meta))
+            except Exception as exc:  # noqa: BLE001 — per-step fallback
+                last_err = exc
+                continue
+            if m_shapes != t_shapes:
+                mismatches += 1
+                prunable.add(step)
+                continue
+            # Stage 2 — shapes agree: actually read the payload. A
+            # failure here is a torn/corrupt save or IO error, never
+            # geometry.
+            try:
+                state = self.restore(step, template=template)
+            except Exception as exc:  # noqa: BLE001 — per-step fallback
+                last_err = exc
+                continue
+            # belt + braces: Orbax restores differently-shaped arrays
+            # into a concrete template without raising. POSITIONAL
+            # comparison here — ``state`` shares the template's tree
+            # structure, so leaf order matches, and a permutation of
+            # the template's shapes (e.g. swapped tower embeddings)
+            # must count as a mismatch, not slip through a multiset.
+            s_leaves = jax.tree.leaves(state)
+            t_leaves = jax.tree.leaves(template)
+            if (len(s_leaves) != len(t_leaves)
+                    or any(np.asarray(a).shape != np.asarray(b).shape
+                           for a, b in zip(s_leaves, t_leaves))):
+                mismatches += 1
+                prunable.add(step)  # restored cleanly, shapes wrong —
+                continue            # confirmed stale, same as stage 1
+            # Prune newer steps PROVEN torn or stale-geometry: Orbax's
+            # save() silently no-ops (returns False) on an existing
+            # step dir, so leaving them would mean the resumed run's
+            # progress at those steps never persists and every future
+            # resume falls back to this same older step again. Steps
+            # skipped on other (possibly transient) errors are NOT
+            # deleted — they may be valid; a later save colliding with
+            # one raises loudly in ``save`` instead of losing data.
+            newer = [s for s in steps if s > step and s in prunable]
+            if newer:
+                # process 0 prunes the shared dir; every process
+                # rebuilds its manager so no in-memory step cache keeps
+                # serving the pruned steps. Deliberately NO barrier
+                # here: this branch is entered per-process from local
+                # reads, and a process that restored cleanly (empty
+                # `newer`) would never reach it — a conditional barrier
+                # deadlocks exactly when reads diverge. Instead each
+                # step dir is atomically RENAMED to a tombstone outside
+                # the managed directory before its contents are
+                # deleted, so a concurrent manager re-init on another
+                # process sees the step either whole or gone — never
+                # half-unlinked (the race a raw in-place rmtree has).
+                # If processes DO restore different steps (one read a
+                # step the other pruned), the mismatched step numbers
+                # fail the next collective save loudly — divergence is
+                # detected, not silent. Not mgr.delete on purpose: it
+                # has its own collective semantics that a proven-torn
+                # step dir can violate.
+                if self._process_index() == 0:
+                    for bad in newer:
+                        self._tombstone_delete(
+                            os.path.join(self.directory, str(bad)),
+                            f".pio-pruned-{bad}")
+                self._mgr.close()
+                self._mgr = self._make_mgr()
+            return state, int(step)
+        if last_err is None and mismatches > 0:
+            raise CheckpointGeometryError(
+                f"all {mismatches} checkpoint step(s) under "
+                f"{self.directory} have shapes incompatible with the "
+                f"requested template")
+        # At least one step failed to even read. Surface it rather than
+        # destroy possibly-valid state; an operator can clear() (or
+        # delete the dir) if the data really is gone.
+        raise last_err  # type: ignore[misc]
+
+    def clear(self) -> None:
+        """Delete every checkpoint and start the manager over.
+
+        Only call this on *confirmed* staleness
+        (``CheckpointGeometryError``): the fresh run's saves restart at
+        low step numbers, and Orbax's ``latest_step`` would keep
+        pointing at the stale higher step — every later resume would
+        restore the bad checkpoint again and silently retrain from
+        scratch forever. Never call it on transient read errors; that
+        destroys valid checkpoints.
+
+        Multi-process JAX: call on EVERY process (each one proves the
+        same staleness from the same files); process 0 wipes, each
+        process rebuilds its manager. No barrier — a process that hit
+        a transient error instead of staleness raises rather than
+        calling clear(), and a barrier here would hang the survivors
+        against the dead process. The wipe is an atomic RENAME of the
+        whole directory to a tombstone (unlinking then happens under
+        the tombstone path no manager scans), so another process
+        re-initializing its manager mid-wipe sees either the old steps
+        or an empty directory — never a half-deleted tree. A process
+        whose manager caches the pre-wipe steps is harmless: saves
+        write explicit new step numbers, and the stale steps are gone
+        from disk for every future resume."""
+        self._mgr.close()
+        if self._process_index() == 0:
+            self._tombstone_delete(self.directory, ".pio-cleared")
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = self._make_mgr()
+
+    def _tombstone_delete(self, path: str, tag: str) -> None:
+        """Atomically rename ``path`` out of scanned space, then delete.
+
+        The tombstone lives in the parent OF THE CHECKPOINT ROOT —
+        never inside the root itself: Orbax managers enumerate entries
+        of the root, and some versions warn or choke on non-step names,
+        so a pruned STEP dir renamed to ``<root>/.pio-pruned-…`` would
+        be visible to a concurrent manager re-init (and would persist
+        there if this process died before the rmtree). Suffixed with
+        the pid so repeated prunes of the same step never collide.
+        Falls back to in-place rmtree if the rename itself fails (e.g.
+        cross-device, or the tomb dir is unwritable)."""
+        import shutil
+
+        if not os.path.exists(path):
+            return
+        root = os.path.abspath(self.directory)
+        tomb_dir = os.path.dirname(root) or "."
+        tomb = os.path.join(tomb_dir, f"{tag}-{os.getpid()}")
+        try:
+            os.rename(path, tomb)
+        except OSError:
+            shutil.rmtree(path, ignore_errors=True)
+        else:
+            shutil.rmtree(tomb, ignore_errors=True)
+
+    def close(self) -> None:
+        self._mgr.close()
+        if self._reader is not None:
+            self._reader.close()
+            self._reader = None
+
+    def __enter__(self) -> "TrainCheckpointer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

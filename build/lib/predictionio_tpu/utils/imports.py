@@ -1,0 +1,25 @@
+"""Spec-string resolution: ``"module.path:attr"`` → object.
+
+The Python replacement for the reference's reflective class loading
+(WorkflowUtils.getEngine etc.). Shared by the CLI, EngineFactory, and
+the plugin loader so error behavior stays uniform.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any
+
+
+def resolve_spec(spec: str) -> Any:
+    if ":" in spec:
+        mod_name, attr = spec.split(":", 1)
+    else:
+        mod_name, _, attr = spec.rpartition(".")
+    if not mod_name or not attr:
+        raise ImportError(f"bad spec {spec!r}; expected 'module.path:attr'")
+    mod = importlib.import_module(mod_name)
+    try:
+        return getattr(mod, attr)
+    except AttributeError as e:
+        raise ImportError(f"{mod_name!r} has no attribute {attr!r}") from e

@@ -1,0 +1,135 @@
+"""Prometheus-style metrics (observability parity, SURVEY.md §5).
+
+The reference exposed log4j logs, the Event Server ``/stats.json``
+counters, and the Spark UI; the survey's mandate for the new framework
+is "structlog + Prometheus endpoint + the same /stats.json contract".
+This module is the Prometheus half: dependency-free counters and
+histograms plus the text exposition format, served at ``/metrics`` on
+both the event server and the engine server.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Counter:
+    def __init__(self, name: str, help: str,
+                 labelnames: Sequence[str] = ()) -> None:
+        self.name, self.help = name, help
+        self.labelnames = tuple(labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, labels: Sequence[str] = (), n: float = 1.0) -> None:
+        key = tuple(str(l) for l in labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
+            out.append(f"{self.name}{_labels(self.labelnames, key)} {_num(v)}")
+        return out
+
+
+class Histogram:
+    def __init__(self, name: str, help: str,
+                 buckets: Sequence[float] = _DEFAULT_BUCKETS) -> None:
+        self.name, self.help = name, help
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +inf tail
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            counts, total_sum = list(self._counts), self._sum
+        cum = 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            out.append(f'{self.name}_bucket{{le="{_num(b)}"}} {cum}')
+        cum += counts[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        out.append(f"{self.name}_sum {_num(total_sum)}")
+        out.append(f"{self.name}_count {cum}")
+        return out
+
+
+class Registry:
+    """Get-or-create by name: re-instantiating a server must reuse the
+    existing metric family — duplicate families are a Prometheus scrape
+    error and would split counts between live and dead instances."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help: str,
+                labelnames: Sequence[str] = ()) -> Counter:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Counter(name, help, labelnames)
+            elif not isinstance(m, Counter):
+                raise ValueError(f"metric {name!r} already a {type(m).__name__}")
+            elif m.labelnames != tuple(labelnames):
+                raise ValueError(
+                    f"metric {name!r} already registered with labels "
+                    f"{m.labelnames}, requested {tuple(labelnames)}")
+            return m
+
+    def histogram(self, name: str, help: str,
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Histogram(
+                    name, help, buckets or _DEFAULT_BUCKETS)
+            elif not isinstance(m, Histogram):
+                raise ValueError(f"metric {name!r} already a {type(m).__name__}")
+            elif buckets is not None and m.buckets != tuple(sorted(buckets)):
+                raise ValueError(
+                    f"metric {name!r} already registered with buckets "
+                    f"{m.buckets}, requested {tuple(sorted(buckets))}")
+            return m
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            lines += m.render()  # type: ignore[attr-defined]
+        return "\n".join(lines) + "\n"
+
+
+def _labels(names: Sequence[str], values: Sequence[str]) -> str:
+    if not names:
+        return ""
+    pairs = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + pairs + "}"
+
+
+def _num(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+REGISTRY = Registry()

@@ -1,0 +1,24 @@
+"""pypio — the Python data-science bridge, API-compatible in spirit
+with the reference's ``python/pypio`` (reference: [U] python/pypio/ —
+py4j bridge exposing PEventStore and cleanup hooks to PySpark/Jupyter;
+SURVEY.md §2a "pypio").
+
+The reference needed a JVM gateway because its data layer was Scala;
+here the framework is Python-native, so the bridge is a thin veneer
+that returns **pandas DataFrames** (the PySpark-DataFrame analogue)
+over the same storage the servers use:
+
+    import pypio
+    pypio.init()                       # bind storage from PIO_* env
+    df = pypio.find_events("MyApp")    # events as a DataFrame
+    props = pypio.data.PEventStore.aggregate_properties("MyApp", "user")
+
+Works in Jupyter against a live event store while the event server is
+ingesting (SQLite WAL / native log are multi-process readable).
+"""
+
+from pypio import data, utils, workflow
+from pypio.pypio import find_events, init, load_model, save_model, stop
+
+__all__ = ["init", "stop", "find_events", "save_model", "load_model",
+           "data", "workflow", "utils"]

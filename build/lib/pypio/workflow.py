@@ -1,0 +1,49 @@
+"""pypio.workflow — cleanup hooks (reference: [U]
+python/pypio/workflow/__init__.py ``CleanupFunctions``: callables a
+Python engine registers to run after training — e.g. event-window
+compaction)."""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+
+class CleanupFunctions:
+    """Register post-train cleanup callables; ``run()`` executes them in
+    registration order (the reference invoked them from the PySpark
+    workflow before SparkSession shutdown)."""
+
+    _fns: List[Callable[[], None]] = []
+
+    @classmethod
+    def add(cls, fn: Callable[[], None]) -> None:
+        cls._fns.append(fn)
+
+    @classmethod
+    def run(cls) -> None:
+        for fn in list(cls._fns):
+            fn()
+
+    @classmethod
+    def clear(cls) -> None:
+        cls._fns.clear()
+
+
+def clean_events(app_name: str, keep_days: int = 30,
+                 remove_duplicates: bool = True,
+                 compress_properties: bool = True):
+    """Convenience wrapper over the framework's SelfCleaningDataSource
+    machinery: compact an app's event log from a notebook. Returns the
+    {"kept", "dropped", "compacted"} counts."""
+    import datetime as dt
+
+    from predictionio_tpu.data.cleaning import EventWindow, clean_persisted_events
+    from pypio.pypio import _st
+
+    return clean_persisted_events(
+        app_name,
+        window=EventWindow(duration=dt.timedelta(days=keep_days),
+                           remove_duplicates=remove_duplicates,
+                           compress_properties=compress_properties),
+        storage=_st(),
+    )
