@@ -116,8 +116,18 @@ class TestMixin:
                                       "removeDuplicates": True,
                                       "compressProperties": True}}
 
-        storage.events.insert(ev("rate", "u1", 10, target="i1"), app.id)
-        storage.events.insert(ev("rate", "u1", 1, target="i2"), app.id)
+        # the mixin cleans against REAL wall-clock now (no injection
+        # point — matching production), so these events must be
+        # relative to real now, not the fixture's fixed NOW: with the
+        # fixed date this test became a time bomb that started failing
+        # the moment wall-clock crossed NOW - 3 days + 1 day
+        real_now = dt.datetime.now(UTC)
+        for days_ago, target in ((10, "i1"), (1, "i2")):
+            storage.events.insert(
+                Event(event="rate", entity_type="user", entity_id="u1",
+                      target_entity_type="item", target_entity_id=target,
+                      event_time=real_now - dt.timedelta(days=days_ago)),
+                app.id)
         ds = DS()
         w = ds.event_window()
         assert w and w.remove_duplicates and w.compress_properties
