@@ -77,6 +77,7 @@ def run_train(
     use_mesh: bool = True,
     batch: str = "",
     resume: bool = False,
+    scan_cache: Optional[bool] = None,
 ) -> str:
     """Train and persist one engine instance; returns its id.
 
@@ -86,7 +87,13 @@ def run_train(
     directory from an interrupted run so iterative trainers restore the
     latest mid-train checkpoint and continue; by default a fresh run
     clears it (SURVEY.md §5 checkpoint/resume).
+
+    ``scan_cache`` pins the columnar snapshot cache for this run:
+    False = full rescan (``pio train --no-scan-cache`` — the escape
+    hatch when a cached read is suspect), True = force-enable, None =
+    the process default (``PIO_SCAN_CACHE`` env, on by default).
     """
+    from predictionio_tpu.data.store import set_scan_cache
     from predictionio_tpu.parallel import distributed
     from predictionio_tpu.utils import compilecache
 
@@ -136,6 +143,8 @@ def run_train(
         distributed.barrier("pio_ckpt_ready")
     ctx = _build_context(storage, mesh_conf, verbose, instance_id, use_mesh,
                          checkpoint_dir=ckpt_root)
+    _prev_scan_cache = (set_scan_cache(scan_cache)
+                        if scan_cache is not None else None)
     try:
         ei.status = "TRAINING"
         if coord:
@@ -186,6 +195,9 @@ def run_train(
             storage.meta.update_engine_instance(ei)
         traceback.print_exc()
         raise
+    finally:
+        if scan_cache is not None:
+            set_scan_cache(_prev_scan_cache)
 
 
 @dataclass

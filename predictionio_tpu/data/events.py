@@ -41,6 +41,11 @@ from predictionio_tpu.data.event import (
 class EventStore(ABC):
     """Backend SPI for event storage (one namespace per app/channel)."""
 
+    # Stable identity of the backing data for the snapshot cache
+    # (e.g. an absolute path or DSN). None ⇒ no durable identity
+    # (in-memory stores) ⇒ scans are never snapshot-cached.
+    cache_identity: Optional[str] = None
+
     # -- lifecycle -------------------------------------------------------------
 
     def init_channel(self, app_id: int, channel_id: Optional[int] = None) -> None:
@@ -101,6 +106,17 @@ class EventStore(ABC):
         means no limit (the HTTP layer applies its default of 20;
         ``limit=-1`` from the wire also means unlimited).
         """
+
+    def creation_stats(
+        self, app_id: int, channel_id: Optional[int] = None,
+        until_us: Optional[int] = None,
+    ) -> Optional[Tuple[int, Optional[int]]]:
+        """(live event count, max creationTime epoch-µs) over the
+        namespace, optionally restricted to creationTime ≤ ``until_us``
+        — the snapshot cache's watermark/invalidation probe. Returns
+        ``(0, None)`` for an empty namespace and None when the backend
+        cannot answer cheaply (caching is then skipped)."""
+        return None
 
     # -- derived ---------------------------------------------------------------
 
@@ -297,6 +313,8 @@ class SQLEventStore(EventStore):
             d.create_index(c, f"{t}_time", t, "eventTime")
             d.create_index(c, f"{t}_entity", t, "entityType, entityId")
             d.create_index(c, f"{t}_name", t, "event")
+            # delta scans + watermark probes (snapshot cache)
+            d.create_index(c, f"{t}_ctime", t, "creationTime")
             c.commit()
             self._known.add((t, id(c)))
 
@@ -534,6 +552,8 @@ class SQLEventStore(EventStore):
         target_entity_type: Optional[str] = None,
         event_names: Optional[Sequence[str]] = None,
         value_key: Optional[str] = None,
+        created_after_us: Optional[int] = None,
+        created_until_us: Optional[int] = None,
     ):
         """Columnar training read for SQL backends (same contract as
         the C++ EVENTLOG scan — `data/pipeline.ColumnarEvents`): SELECT
@@ -543,13 +563,23 @@ class SQLEventStore(EventStore):
         can contain it — no Event objects, no datetime parsing, no
         tags/prId decode. Value semantics are the shared grammar
         (`data/store._parse_value` + isfinite), identical to both
-        other paths."""
+        other paths.
+
+        ``created_after_us`` (exclusive) / ``created_until_us``
+        (inclusive) bound creationTime — the snapshot cache's delta
+        window, pushed down onto the ``{t}_ctime`` index."""
         from predictionio_tpu.data.pipeline import columnar_from_rows
 
         t = self._table(app_id, channel_id)
         clauses, args = self._where(start_time, until_time, entity_type,
                                     None, event_names,
                                     target_entity_type, None)
+        if created_after_us is not None:
+            clauses.append("creationTime > ?")
+            args.append(int(created_after_us))
+        if created_until_us is not None:
+            clauses.append("creationTime <= ?")
+            args.append(int(created_until_us))
         clauses = ["targetEntityId IS NOT NULL",
                    "targetEntityId != ''"] + clauses
         sql = (f"SELECT event,entityId,targetEntityId,properties,eventTime "
@@ -579,6 +609,37 @@ class SQLEventStore(EventStore):
                     self._d.recover(c)
 
         return columnar_from_rows(row_iter(), value_key)
+
+    @property
+    def cache_identity(self) -> Optional[str]:  # type: ignore[override]
+        return getattr(self._d, "cache_identity", None)
+
+    def creation_stats(
+        self, app_id: int, channel_id: Optional[int] = None,
+        until_us: Optional[int] = None,
+    ) -> Optional[Tuple[int, Optional[int]]]:
+        t = self._table(app_id, channel_id)
+        where = ""
+        args: Tuple = ()
+        if until_us is not None:
+            where = " WHERE creationTime <= ?"
+            args = (int(until_us),)
+        c = self._conn()
+        try:
+            cur = c.cursor()
+            cur.execute(self._d.sql(
+                f"SELECT COUNT(*), MAX(creationTime) FROM {t}{where}"),
+                args)
+            row = cur.fetchone()
+            c.commit()  # end the read transaction (see find())
+        except Exception as e:
+            if self._missing_table(c, e):
+                return (0, None)
+            raise
+        count = int(row[0]) if row and row[0] is not None else 0
+        if count == 0:
+            return (0, None)
+        return (count, int(row[1]))
 
 
 class SqliteEventStore(SQLEventStore):

@@ -113,6 +113,12 @@ class NativeEventLogStore(EventStore):
         os.makedirs(directory, exist_ok=True)
         self._handles: Dict[Tuple[int, Optional[int]], int] = {}
         self._lock = threading.RLock()
+        # snapshot-cache key component: same directory ⇒ same log
+        self.cache_identity = "eventlog:" + os.path.abspath(directory)
+        # floor for append_jsonl's defaulted timestamps — a chunk
+        # reserves [now_us, now_us + n_lines) so consecutive chunks
+        # never interleave even when the wall clock stalls or steps back
+        self._now_floor = 0
 
     # -- plumbing ----------------------------------------------------------
 
@@ -199,12 +205,22 @@ class NativeEventLogStore(EventStore):
         caller's fallback inserts; `find()` ordering is by
         (eventTime, creationTime, seq), so only events with identical
         timestamps down to the microsecond can observe the reorder.
+
+        Lines without their own eventTime/creationTime default to
+        ``now_us + line_index`` (assigned in C++), so within-chunk
+        arrival order survives the time sort and creationTime
+        watermarks are strictly monotonic; the store-level floor below
+        extends that guarantee across chunks.
         """
         import time as _time
 
         h = self._handle(app_id, channel_id)
         status = ctypes.create_string_buffer(n_lines)
         now_us = int(_time.time() * 1e6)
+        with self._lock:
+            if now_us < self._now_floor:
+                now_us = self._now_floor
+            self._now_floor = now_us + n_lines
         seed = int.from_bytes(os.urandom(8), "little")
         n = self._lib.pel_append_jsonl(
             h, lines, len(lines), now_us, seed, status, n_lines, None)
@@ -322,6 +338,8 @@ class NativeEventLogStore(EventStore):
         target_entity_type: Optional[str] = None,
         event_names: Optional[Sequence[str]] = None,
         value_key: Optional[str] = None,
+        created_after_us: Optional[int] = None,
+        created_until_us: Optional[int] = None,
     ):
         """Columnar training read: numpy arrays + deduped id tables,
         no per-event Python objects (the HBase-scan→RDD[Rating]
@@ -335,6 +353,10 @@ class NativeEventLogStore(EventStore):
         strings; NaN = absent/malformed, same drop rule as the generic
         path's ``data/store._parse_value``) so rating-style reads
         avoid a JSON pass in Python entirely.
+
+        ``created_after_us`` (exclusive) / ``created_until_us``
+        (inclusive) bound creationTime — the snapshot cache's delta
+        window, filtered off the in-memory index in C++.
         """
         import numpy as np
 
@@ -348,6 +370,10 @@ class NativeEventLogStore(EventStore):
             h,
             _ts_us(start_time) if start_time else _UNBOUNDED_LO,
             _ts_us(until_time) if until_time else _UNBOUNDED_HI,
+            created_after_us if created_after_us is not None
+            else _UNBOUNDED_LO,
+            created_until_us if created_until_us is not None
+            else _UNBOUNDED_HI,
             entity_type.encode() if entity_type is not None else None,
             target_entity_type.encode() if target_entity_type is not None
             else None,
@@ -387,6 +413,22 @@ class NativeEventLogStore(EventStore):
             entity_idx=ent_idx, target_idx=tgt_idx, name_idx=name_idx,
             values=values, times_us=times,
             entity_ids=ents_t, target_ids=tgts_t, names=names_t)
+
+    def creation_stats(
+        self, app_id: int, channel_id: Optional[int] = None,
+        until_us: Optional[int] = None,
+    ) -> Optional[Tuple[int, Optional[int]]]:
+        """(live count, max creationTime µs) with creationTime ≤
+        ``until_us`` — the snapshot cache's watermark/invalidation
+        probe, answered from the in-memory index with no payload IO."""
+        h = self._handle(app_id, channel_id)
+        max_out = ctypes.c_longlong(0)
+        n = self._lib.pel_creation_stats(
+            h, until_us if until_us is not None else _UNBOUNDED_HI,
+            ctypes.byref(max_out))
+        if n <= 0:
+            return (0, None)
+        return (int(n), int(max_out.value))
 
     # -- derived (native fold) ------------------------------------------------
 

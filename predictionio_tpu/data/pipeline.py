@@ -201,6 +201,62 @@ def columnar_from_rows(
         names=list(names))
 
 
+def concat_columnar(
+    base: ColumnarEvents, delta: ColumnarEvents,
+) -> Optional[ColumnarEvents]:
+    """Append a delta scan to a base scan, remapping the delta's id
+    tables into the base's.
+
+    Correctness contract (what the snapshot cache relies on): if every
+    delta event sorts strictly AFTER every base event in the store's
+    scan order, the result is identical — arrays and vocabularies — to
+    one cold scan over base∪delta, because first-seen order over the
+    concatenation equals first-seen over base followed by first-seen
+    over the delta's unseen ids. The cache layer enforces that
+    precondition (it rejects deltas whose min eventTime ties or
+    precedes the base's max) before calling this.
+
+    Returns None when the merged name table would overflow the u16
+    ``name_idx`` column, mirroring :func:`columnar_from_rows`.
+    """
+    if delta.n == 0:
+        return base
+    if base.n == 0:
+        return delta
+
+    def merge(base_tab: List[str],
+              delta_tab: List[str]) -> Tuple[List[str], np.ndarray]:
+        pos = {s: i for i, s in enumerate(base_tab)}
+        merged = list(base_tab)
+        lut = np.empty(len(delta_tab), np.int64)
+        for j, s in enumerate(delta_tab):
+            i = pos.get(s)
+            if i is None:
+                i = len(merged)
+                pos[s] = i
+                merged.append(s)
+            lut[j] = i
+        return merged, lut
+
+    ents, lut_e = merge(base.entity_ids, delta.entity_ids)
+    tgts, lut_t = merge(base.target_ids, delta.target_ids)
+    names, lut_n = merge(base.names, delta.names)
+    if len(names) > 65535:
+        return None
+    return ColumnarEvents(
+        entity_idx=np.concatenate(
+            [base.entity_idx,
+             lut_e[delta.entity_idx].astype(np.uint32)]),
+        target_idx=np.concatenate(
+            [base.target_idx,
+             lut_t[delta.target_idx].astype(np.uint32)]),
+        name_idx=np.concatenate(
+            [base.name_idx, lut_n[delta.name_idx].astype(np.uint16)]),
+        values=np.concatenate([base.values, delta.values]),
+        times_us=np.concatenate([base.times_us, delta.times_us]),
+        entity_ids=ents, target_ids=tgts, names=names)
+
+
 def interactions_from_columnar(
     cols: ColumnarEvents,
     value_spec: Optional[Dict[str, Any]] = None,

@@ -1,0 +1,249 @@
+"""Columnar snapshot cache: persisted ``ColumnarEvents`` + watermark.
+
+The reference's training contract re-reads the FULL event history on
+every ``pio train`` (PAPER.md §0: PEventStore → RDD per invocation).
+For the steady-state retrain loop over a mostly-append-only log that
+makes train startup O(total events) forever. This module is the disk
+layer of the incremental scan cache that turns it into O(events since
+last train):
+
+- a **snapshot** is one ``ColumnarEvents`` (the arrays
+  ``data/pipeline.columnar_from_rows`` builds) persisted as an ``.npz``
+  next to a small JSON **manifest**;
+- the manifest carries a **watermark** — the maximum ``creationTime``
+  (epoch µs) the snapshot covers, taken from the store BEFORE the
+  building scan started — plus the live-event count at that watermark
+  and the hash of the filter key;
+- on the next train, ``data/store.py`` loads the snapshot, asks the
+  backend to scan only ``creationTime > watermark`` (predicate pushed
+  down into C++/SQL/doc-values), and concatenates the delta
+  (:func:`data.pipeline.concat_columnar`).
+
+Invalidation rules (any failure falls back to a full rescan — the
+cache can cost a rebuild, never correctness):
+
+- manifest missing/unreadable, schema version bump, filter-key hash
+  mismatch, npz corrupt/truncated, or array lengths disagreeing with
+  the manifest;
+- the live-event count at the old watermark no longer matches the
+  manifest (events were deleted, or arrived bearing creationTimes at
+  or below the watermark);
+- the delta contains an event whose eventTime is ≤ the snapshot's
+  maximum (out-of-order append: concatenation would not reproduce the
+  (eventTime, creationTime, id) scan order);
+- ``startTime``/``untilTime`` filters bypass the cache entirely (a
+  time-windowed read is not the repeat-train shape).
+
+Cache keys hash the full filter tuple PLUS a backend-provided
+``cache_identity`` string (e.g. the sqlite path), so two stores that
+happen to share an app id can never serve each other's snapshots.
+Files live under ``<storage home>/scan_cache/`` (override with
+``PIO_SCAN_CACHE_DIR``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import time
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+SCHEMA_VERSION = 1
+
+# watermark of an empty namespace: below every real creationTime, and
+# matching the native scan's unbounded sentinel so `creation > W`
+# selects everything and `creation <= W` selects nothing
+EMPTY_WATERMARK = -(2**62)
+
+_ARRAY_FIELDS = ("entity_idx", "target_idx", "name_idx", "values",
+                 "times_us")
+_TABLE_FIELDS = ("entity_ids", "target_ids", "names")
+_DTYPES = {"entity_idx": "uint32", "target_idx": "uint32",
+           "name_idx": "uint16", "values": "float64",
+           "times_us": "int64"}
+
+
+@dataclass
+class SnapshotManifest:
+    """The validity contract of one persisted snapshot."""
+
+    schema: int
+    filter_hash: str
+    watermark_us: int
+    pre_count: int  # live events with creationTime <= watermark_us
+    n_rows: int     # rows in the npz arrays (post-filter)
+    created_at: float
+
+
+def cache_dir(storage) -> str:
+    """Snapshot directory for a Storage (env-overridable)."""
+    override = os.environ.get("PIO_SCAN_CACHE_DIR")
+    if override:
+        return override
+    return os.path.join(storage.config.home, "scan_cache")
+
+
+def filter_fingerprint(
+    identity: str,
+    app_id: int,
+    channel_id: Optional[int],
+    entity_type: Optional[str],
+    target_entity_type: Optional[str],
+    event_names: Optional[Sequence[str]],
+    value_key: Optional[str],
+) -> str:
+    """Hash of (store identity, namespace, scan filters) — the cache
+    key. Hashed rather than embedded so arbitrary ids/filters can't
+    produce unbounded or path-hostile filenames."""
+    payload = json.dumps(
+        {"identity": identity, "app": app_id, "channel": channel_id,
+         "entity_type": entity_type,
+         "target_entity_type": target_entity_type,
+         "event_names": (list(event_names)
+                         if event_names is not None else None),
+         "value_key": value_key},
+        sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()
+
+
+def _paths(directory: str, fingerprint: str) -> Tuple[str, str]:
+    base = os.path.join(directory, f"snap_{fingerprint}")
+    return base + ".npz", base + ".json"
+
+
+def _table_array(strings) -> np.ndarray:
+    # numpy U-dtype: fixed-width unicode, loadable without pickle
+    if len(strings):
+        return np.asarray(list(strings), dtype=np.str_)
+    return np.empty(0, dtype="U1")
+
+
+def save_snapshot(
+    directory: str,
+    fingerprint: str,
+    cols,
+    watermark_us: int,
+    pre_count: int,
+) -> bool:
+    """Persist ``cols`` + manifest atomically (tmp file + rename; the
+    manifest lands LAST, so a manifest's presence implies a complete
+    npz). Returns False instead of raising — a full disk or read-only
+    cache dir must never fail the training read it rides on."""
+    npz_path, man_path = _paths(directory, fingerprint)
+    try:
+        os.makedirs(directory, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(
+                    f,
+                    entity_idx=np.ascontiguousarray(cols.entity_idx),
+                    target_idx=np.ascontiguousarray(cols.target_idx),
+                    name_idx=np.ascontiguousarray(cols.name_idx),
+                    values=np.ascontiguousarray(cols.values),
+                    times_us=np.ascontiguousarray(cols.times_us),
+                    entity_ids=_table_array(cols.entity_ids),
+                    target_ids=_table_array(cols.target_ids),
+                    names=_table_array(cols.names))
+            os.replace(tmp, npz_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return _write_manifest(man_path, fingerprint, watermark_us,
+                               pre_count, cols.n)
+    except Exception:
+        return False
+
+
+def update_manifest(
+    directory: str,
+    fingerprint: str,
+    watermark_us: int,
+    pre_count: int,
+    n_rows: int,
+) -> bool:
+    """Advance the watermark of an existing snapshot whose arrays are
+    unchanged (an empty delta still moves the watermark forward, so
+    later delta scans stay O(new events) instead of re-walking the
+    whole post-watermark window)."""
+    _npz, man_path = _paths(directory, fingerprint)
+    try:
+        return _write_manifest(man_path, fingerprint, watermark_us,
+                               pre_count, n_rows)
+    except Exception:
+        return False
+
+
+def _write_manifest(man_path: str, fingerprint: str, watermark_us: int,
+                    pre_count: int, n_rows: int) -> bool:
+    doc = {"schema": SCHEMA_VERSION, "filter": fingerprint,
+           "watermark_us": int(watermark_us), "pre_count": int(pre_count),
+           "n_rows": int(n_rows), "created_at": time.time()}
+    tmp = man_path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, separators=(",", ":"))
+    os.replace(tmp, man_path)
+    return True
+
+
+def load_snapshot(directory: str, fingerprint: str):
+    """Load and validate one snapshot.
+
+    Returns ``(ColumnarEvents, SnapshotManifest)``, or None on ANY
+    defect — missing files, unreadable JSON, schema/filter mismatch,
+    corrupt or truncated npz, wrong dtypes, or lengths that disagree
+    with the manifest. Callers treat None as a cold cache."""
+    from predictionio_tpu.data.pipeline import ColumnarEvents
+
+    npz_path, man_path = _paths(directory, fingerprint)
+    try:
+        with open(man_path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        if (doc.get("schema") != SCHEMA_VERSION
+                or doc.get("filter") != fingerprint):
+            return None
+        man = SnapshotManifest(
+            schema=int(doc["schema"]), filter_hash=doc["filter"],
+            watermark_us=int(doc["watermark_us"]),
+            pre_count=int(doc["pre_count"]), n_rows=int(doc["n_rows"]),
+            created_at=float(doc.get("created_at", 0.0)))
+        with np.load(npz_path, allow_pickle=False) as z:
+            arrays = {}
+            for k in _ARRAY_FIELDS:
+                a = z[k]
+                if (a.ndim != 1 or a.shape[0] != man.n_rows
+                        or a.dtype != np.dtype(_DTYPES[k])):
+                    return None
+                arrays[k] = a
+            tables = {}
+            for k in _TABLE_FIELDS:
+                t = z[k]
+                if t.ndim != 1 or t.dtype.kind != "U":
+                    return None
+                tables[k] = t.tolist()
+        # index columns must point inside their tables, or downstream
+        # vectorized gathers would read garbage
+        for idx_k, tab_k in (("entity_idx", "entity_ids"),
+                             ("target_idx", "target_ids"),
+                             ("name_idx", "names")):
+            a = arrays[idx_k]
+            if a.size and int(a.max()) >= len(tables[tab_k]):
+                return None
+        cols = ColumnarEvents(
+            entity_idx=arrays["entity_idx"],
+            target_idx=arrays["target_idx"],
+            name_idx=arrays["name_idx"], values=arrays["values"],
+            times_us=arrays["times_us"],
+            entity_ids=tables["entity_ids"],
+            target_ids=tables["target_ids"], names=tables["names"])
+        return cols, man
+    except Exception:
+        return None

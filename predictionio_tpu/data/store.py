@@ -18,11 +18,30 @@ from __future__ import annotations
 
 import datetime as _dt
 import math as _math
+import os as _os
 import re as _re
+import time as _time
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 from predictionio_tpu.data.event import Event, PropertyMap
 from predictionio_tpu.storage.registry import Storage, get_storage
+from predictionio_tpu.utils.metrics import REGISTRY as _REGISTRY
+
+_SNAP_HITS = _REGISTRY.counter(
+    "pio_snapshot_cache_hits_total",
+    "Training columnar scans served from the snapshot cache")
+_SNAP_MISSES = _REGISTRY.counter(
+    "pio_snapshot_cache_misses_total",
+    "Training columnar scans that fell back to a full rescan",
+    labelnames=("reason",))
+_SNAP_DELTA_ROWS = _REGISTRY.counter(
+    "pio_snapshot_delta_rows_total",
+    "Rows appended to snapshots by incremental delta scans")
+_SCAN_SECONDS = _REGISTRY.histogram(
+    "pio_columnar_scan_seconds",
+    "Wall time of columnar training reads (cached or not)",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0,
+             2.5, 5.0, 10.0, 30.0, 60.0, 120.0))
 
 # The rating-value grammar shared with the native columnar scan
 # (eventlog.cc decimal_number_shape): JSON-style decimal numbers —
@@ -44,6 +63,174 @@ def _native_scan(storage: Optional[Storage]):
     except Exception:
         return None, None
     return (scan, st) if scan is not None else (None, None)
+
+
+# -- snapshot cache -----------------------------------------------------------
+#
+# Repeat `pio train` over a mostly-append-only log should cost O(new
+# events), not O(event log) (ISSUE 1 / docs/perf.md "Incremental
+# columnar snapshot cache"). The policy layer lives here; the disk
+# format in data/snapshot.py; the per-backend creationTime predicate
+# pushdown in the stores' scan_columnar/creation_stats.
+
+_scan_cache_override: Optional[bool] = None
+
+# Rewriting the snapshot npz costs O(snapshot); a steady-state warm
+# read must not pay it for a tiny delta. The snapshot is recompacted
+# only once the delta reaches 1/_COMPACT_FACTOR of its size — below
+# that the old snapshot (and watermark) stay put and the next train
+# re-scans the same still-small delta.
+_COMPACT_FACTOR = 8
+
+
+def set_scan_cache(enabled: Optional[bool]) -> Optional[bool]:
+    """Process-wide snapshot-cache toggle; returns the previous value
+    so callers (run_train's --no-scan-cache plumbing) can restore it.
+    None defers to the ``PIO_SCAN_CACHE`` env var (default on)."""
+    global _scan_cache_override
+    prev = _scan_cache_override
+    _scan_cache_override = enabled
+    return prev
+
+
+def scan_cache_enabled() -> bool:
+    if _scan_cache_override is not None:
+        return _scan_cache_override
+    return _os.environ.get("PIO_SCAN_CACHE", "1").strip().lower() not in (
+        "0", "false", "no", "off")
+
+
+def _cached_scan(
+    scan,
+    st: Storage,
+    app_id: int,
+    channel_id: Optional[int],
+    entity_type: Optional[str],
+    target_entity_type: Optional[str],
+    event_names: Optional[Sequence[str]],
+    value_key: Optional[str],
+):
+    """Snapshot-cached columnar scan: load the persisted ColumnarEvents
+    for this (store, namespace, filter) key, scan only
+    ``creationTime > watermark``, and concatenate. Any doubt — missing
+    or corrupt snapshot, deleted events, creationTimes at/below the
+    watermark, out-of-order eventTimes in the delta, a backend that
+    cannot answer the watermark probe — falls back to a full rescan
+    (and re-primes the cache). Returns whatever contract ``scan`` has:
+    a ColumnarEvents, or None when the backend declines columnar.
+
+    Concurrency: the watermark is taken BEFORE any scan starts and
+    every scan is bounded ``creationTime <= watermark``, so events
+    ingested DURING the scan are neither half-seen now nor skipped
+    later — the result is a consistent point-in-time read at the
+    watermark, and the next train's delta picks up the remainder.
+    """
+    from predictionio_tpu.data import snapshot as _snap
+    from predictionio_tpu.data.pipeline import concat_columnar
+
+    events = st.events
+    identity = getattr(events, "cache_identity", None)
+    stats_fn = getattr(events, "creation_stats", None)
+    stats = stats_fn(app_id, channel_id) if stats_fn is not None else None
+    if identity is None or stats is None:
+        _SNAP_MISSES.inc(("unsupported",))
+        return scan(app_id, channel_id, entity_type=entity_type,
+                    target_entity_type=target_entity_type,
+                    event_names=event_names, value_key=value_key)
+
+    count_now, max_c = stats
+    watermark = max_c if count_now else _snap.EMPTY_WATERMARK
+    directory = _snap.cache_dir(st)
+    key = _snap.filter_fingerprint(
+        identity, app_id, channel_id, entity_type, target_entity_type,
+        event_names, value_key)
+
+    loaded = _snap.load_snapshot(directory, key)
+    if loaded is not None:
+        cols0, man = loaded
+        # count(creation ≤ old watermark) must still equal what the
+        # snapshot saw: a lower count means deletions, a higher one
+        # means events arrived bearing creationTimes inside the
+        # already-covered window — either way the delta can't see them
+        at_w = events.creation_stats(app_id, channel_id,
+                                     until_us=man.watermark_us)
+        if at_w is not None and at_w[0] == man.pre_count:
+            delta = scan(app_id, channel_id, entity_type=entity_type,
+                         target_entity_type=target_entity_type,
+                         event_names=event_names, value_key=value_key,
+                         created_after_us=man.watermark_us,
+                         created_until_us=watermark)
+            if delta is not None:
+                if delta.n == 0:
+                    _SNAP_HITS.inc()
+                    if watermark > man.watermark_us:
+                        _snap.update_manifest(directory, key, watermark,
+                                              count_now, cols0.n)
+                    return cols0
+                # scan order is (eventTime, creationTime, id): appending
+                # is only order-preserving when every delta event sorts
+                # strictly after the snapshot's last (strict, because
+                # eventTime ties break by fields the two scans can't
+                # compare across the boundary)
+                if (cols0.n == 0
+                        or int(delta.times_us.min())
+                        > int(cols0.times_us.max())):
+                    merged = concat_columnar(cols0, delta)
+                    if merged is not None:
+                        _SNAP_HITS.inc()
+                        _SNAP_DELTA_ROWS.inc(n=delta.n)
+                        if delta.n * _COMPACT_FACTOR >= cols0.n:
+                            _snap.save_snapshot(directory, key, merged,
+                                                watermark, count_now)
+                        return merged
+                    _SNAP_MISSES.inc(("overflow",))
+                else:
+                    _SNAP_MISSES.inc(("out_of_order",))
+            else:
+                _SNAP_MISSES.inc(("declined",))
+        else:
+            _SNAP_MISSES.inc(("mutated",))
+    else:
+        _SNAP_MISSES.inc(("cold",))
+
+    cols = scan(app_id, channel_id, entity_type=entity_type,
+                target_entity_type=target_entity_type,
+                event_names=event_names, value_key=value_key,
+                created_until_us=watermark)
+    if cols is not None:
+        _snap.save_snapshot(directory, key, cols, watermark, count_now)
+    return cols
+
+
+def _scan_with_cache(
+    scan,
+    st: Storage,
+    app_id: int,
+    channel_id: Optional[int],
+    start_time: Optional[_dt.datetime],
+    until_time: Optional[_dt.datetime],
+    entity_type: Optional[str],
+    target_entity_type: Optional[str],
+    event_names: Optional[Sequence[str]],
+    value_key: Optional[str],
+):
+    """Route one columnar scan through the snapshot cache when
+    eligible; always record scan wall time. Time-windowed reads
+    (start/until) bypass the cache entirely — a window is not the
+    repeat-train shape, and a windowed snapshot would go stale as the
+    window slides."""
+    t0 = _time.perf_counter()
+    try:
+        if (start_time is not None or until_time is not None
+                or not scan_cache_enabled()):
+            return scan(app_id, channel_id, start_time=start_time,
+                        until_time=until_time, entity_type=entity_type,
+                        target_entity_type=target_entity_type,
+                        event_names=event_names, value_key=value_key)
+        return _cached_scan(scan, st, app_id, channel_id, entity_type,
+                            target_entity_type, event_names, value_key)
+    finally:
+        _SCAN_SECONDS.observe(_time.perf_counter() - t0)
 
 
 def _parse_value(v) -> Optional[float]:
@@ -169,10 +356,9 @@ def read_training_interactions(
     scan, st = (None, None) if prefer_streaming else _native_scan(storage)
     if scan is not None:
         app_id, channel_id = resolve_app_channel(app_name, channel_name, st)
-        cols = scan(app_id, channel_id, start_time=start_time,
-                    until_time=until_time, entity_type=entity_type,
-                    target_entity_type=target_entity_type,
-                    event_names=event_names, value_key=value_key)
+        cols = _scan_with_cache(
+            scan, st, app_id, channel_id, start_time, until_time,
+            entity_type, target_entity_type, event_names, value_key)
         if cols is not None:
             return interactions_from_columnar(cols, value_spec,
                                               default_spec,
@@ -223,9 +409,9 @@ def read_training_event_groups(
     scan, st = _native_scan(storage)
     if scan is not None:
         app_id, channel_id = resolve_app_channel(app_name, channel_name, st)
-        cols = scan(app_id, channel_id, entity_type=entity_type,
-                    target_entity_type=target_entity_type,
-                    event_names=list(names))
+        cols = _scan_with_cache(
+            scan, st, app_id, channel_id, None, None,
+            entity_type, target_entity_type, list(names), None)
         if cols is not None:
             return event_groups_from_columnar(cols, names)
     return read_event_groups(

@@ -525,6 +525,25 @@ long long pel_count(void* hv) {
   return (long long)h->by_id.size();
 }
 
+// Live-event creationTime statistics for the snapshot cache: count of
+// alive records with creation_us <= until_us, and their max
+// creation_us via *max_out (untouched when the count is 0). The walk
+// reads only the in-memory index — no payload IO.
+long long pel_creation_stats(void* hv, long long until_us,
+                             long long* max_out) {
+  Handle* h = (Handle*)hv;
+  std::lock_guard<std::mutex> g(h->mu);
+  long long count = 0;
+  int64_t max_c = 0;
+  for (const Rec& r : h->recs) {
+    if (!r.alive || r.creation_us > until_us) continue;
+    if (count == 0 || r.creation_us > max_c) max_c = r.creation_us;
+    ++count;
+  }
+  if (count && max_out) *max_out = (long long)max_c;
+  return count;
+}
+
 // Fetch one framed record by id into *out (malloc'd). Returns byte
 // length, 0 if missing, -1 on error.
 long long pel_get(void* hv, const char* id, int idlen, char** out) {
@@ -720,6 +739,10 @@ long long pel_aggregate(void* hv, const char* entity_type,
 // strings, and booleans parse; anything else (or absent) is NaN and
 // the caller applies its per-event-name policy. Events with an empty
 // targetEntityId are skipped (training pairs need both sides).
+// created_after_us/created_until_us bound creationTime (exclusive
+// lower / inclusive upper; pass the ±2^62 sentinels for unbounded) —
+// the snapshot cache's delta predicate, evaluated on the in-memory
+// index before any payload read.
 //
 // Blob layout (little-endian; every section 8-byte aligned):
 //   u64 n_events, u64 n_entities, u64 n_targets, u64 n_names
@@ -934,11 +957,23 @@ size_t json_validate(std::string_view s, size_t i, int depth = 0) {
   if (c == '{') {
     i = jv_ws(s, i + 1);
     if (i < s.size() && s[i] == '}') return i + 1;
+    // Duplicate keys make the fast paths diverge from Python:
+    // json.loads keeps the LAST value while span/number extraction
+    // (json_object_items, extract_number) takes the FIRST. Reject the
+    // whole line so it falls back to Python, whose dict round-trip
+    // normalizes the duplicates away. Keys compare UNESCAPED — an
+    // escaped and a literal spelling of one char are the same dict key.
+    std::vector<std::string> seen_keys;
     for (;;) {
       i = jv_ws(s, i);
       if (i >= s.size() || s[i] != '"') return npos;
+      size_t key_start = i;
       i = jv_string(s, i);
       if (i == npos) return npos;
+      std::string key = json_unescape(s.substr(key_start, i - key_start));
+      for (const std::string& k : seen_keys)
+        if (k == key) return npos;
+      seen_keys.push_back(std::move(key));
       i = jv_ws(s, i);
       if (i >= s.size() || s[i] != ':') return npos;
       i = json_validate(s, i + 1, depth + 1);
@@ -1190,7 +1225,11 @@ long long pel_append_jsonl(void* hv, const char* buf, long long len,
       while (!tv.empty() && tv.back() == ' ') tv.remove_suffix(1);
       return parse_iso8601_us(tv, out);
     };
-    int64_t t_us = now_us, c_us = now_us;
+    // per-line default timestamps: now_us + line index, so a chunk of
+    // defaulted lines keeps its within-chunk arrival order under the
+    // (eventTime, creationTime, seq) sort and creationTime watermarks
+    // advance strictly monotonically across chunks
+    int64_t t_us = now_us + ln, c_us = now_us + ln;
     if (!etime.empty() && !parse_time_field(etime, &t_us)) {
       status_out[ln] = 1;
       continue;
@@ -1236,6 +1275,8 @@ long long pel_append_jsonl(void* hv, const char* buf, long long len,
 }
 
 long long pel_scan_columnar(void* hv, long long start_us, long long until_us,
+                            long long created_after_us,
+                            long long created_until_us,
                             const char* entity_type,
                             const char* target_entity_type,
                             const char* event_names, const char* value_key,
@@ -1280,6 +1321,12 @@ long long pel_scan_columnar(void* hv, long long start_us, long long until_us,
   for (size_t idx : h->sorted) {
     const Rec& r = h->recs[idx];
     if (r.time_us < start_us || r.time_us >= until_us) continue;
+    // creationTime window (delta scans for the snapshot cache):
+    // exclusive lower / inclusive upper, straight off the index — no
+    // payload read for records outside the window
+    if (r.creation_us <= created_after_us ||
+        r.creation_us > created_until_us)
+      continue;
     std::string_view pv;
     if (!map.view(r, &pv)) {
       if (!read_payload(h, r, &payload)) continue;

@@ -51,7 +51,7 @@ from predictionio_tpu.data.event import (
     utcnow,
     validate_event,
 )
-from predictionio_tpu.data.events import EventStore
+from predictionio_tpu.data.events import EventStore, _ts as _ts_us
 from predictionio_tpu.storage.meta import (
     AccessKey,
     App,
@@ -278,6 +278,31 @@ class EmbeddedIndex:
         ids = ids_a[order].tolist()
         self._dv[field] = (self._gen, vals, ids)
         return vals, ids
+
+    def numeric_stats(
+        self, field: str, until: Optional[float] = None,
+    ) -> Optional[Tuple[int, Optional[int]]]:
+        """(count, max as int) over docs whose ``field`` ≤ ``until``
+        (no bound when None) — the snapshot cache's watermark probe,
+        answered from the sorted doc values with two binary searches.
+
+        Returns ``(0, None)`` for an empty index and None when ANY doc
+        lacks a numeric value for ``field`` (incomplete coverage: a
+        count over the indexed subset would silently miss documents,
+        so callers must treat the stat as unanswerable)."""
+        import numpy as np
+
+        with self._lock:
+            if not self._docs:
+                return (0, None)
+            vals, _ids = self._doc_values(field)
+            if vals is None or len(vals) != len(self._docs):
+                return None
+            k = (len(vals) if until is None
+                 else int(np.searchsorted(vals, until, "right")))
+            if k == 0:
+                return (0, None)
+            return (k, int(vals[k - 1]))
 
     def _check_open(self) -> None:
         # a closed durable index must reject writes loudly: silently
@@ -567,7 +592,8 @@ class ESEventStore(EventStore):
     # memory and the ingest loop (r5, 1M-event run: 6.5k → 19.5k
     # events/s together with the Event.with_id fast path).
     _NO_INDEX = frozenset({"properties", "eventTime", "eventTimeIso",
-                           "creationTime", "creationTimeIso"})
+                           "creationTime", "creationTimeIso",
+                           "eventTimeUs", "creationTimeUs"})
 
     def __init__(self, client: IndexedStorageClient) -> None:
         self._c = client
@@ -596,6 +622,11 @@ class ESEventStore(EventStore):
             "prId": e.pr_id,
             "creationTime": e.creation_time.timestamp(),
             "creationTimeIso": format_event_time(e.creation_time),
+            # exact integer epoch-µs: the float-second fields above are
+            # lossy (≈0.5 µs spacing), so columnar times_us and the
+            # snapshot cache's creationTime watermark read these
+            "eventTimeUs": _ts_us(e.event_time),
+            "creationTimeUs": _ts_us(e.creation_time),
         }
 
     @staticmethod
@@ -712,18 +743,39 @@ class ESEventStore(EventStore):
         target_entity_type: Optional[str] = None,
         event_names: Optional[Sequence[str]] = None,
         value_key: Optional[str] = None,
+        created_after_us: Optional[int] = None,
+        created_until_us: Optional[int] = None,
     ):
         """Columnar training read over the index (same contract as the
         EVENTLOG/SQL scans — `data/pipeline.ColumnarEvents`): the SAME
         search the generic ``find()`` runs supplies the hits, so scan
         order (hence vocabulary order) matches by construction, but no
         Event objects, timestamp parses, or full-properties decodes
-        are built per doc."""
+        are built per doc.
+
+        ``times_us`` comes from the exact integer ``eventTimeUs`` field
+        (falling back to the rounded float-second field for documents
+        written before it existed), so it is bit-identical to the
+        EVENTLOG/SQL scans. ``created_after_us`` (exclusive) /
+        ``created_until_us`` (inclusive) bound ``creationTimeUs`` — the
+        snapshot cache's delta window, run on doc values; documents
+        without the field never match a bounded scan, which is why the
+        cache also requires :meth:`creation_stats` coverage."""
         from predictionio_tpu.data.pipeline import columnar_from_rows
 
         must, must_any, ranges = self._query(
             start_time, until_time, entity_type, None, event_names,
             target_entity_type, None)
+        if created_after_us is not None or created_until_us is not None:
+            # search ranges are lo-inclusive / hi-exclusive over exact
+            # integer µs, so shift both bounds up by one
+            ranges = list(ranges or [])
+            ranges.append((
+                "creationTimeUs",
+                created_after_us + 1 if created_after_us is not None
+                else None,
+                created_until_us + 1 if created_until_us is not None
+                else None))
         hits = self._idx(app_id, channel_id).search(
             must=must, must_any=must_any, ranges=ranges, sort="eventTime")
 
@@ -732,13 +784,35 @@ class ESEventStore(EventStore):
                 tgt = d.get("targetEntityId")
                 if not tgt:
                     continue
-                # round, not truncate: the doc stores float seconds and
-                # int(x*1e6) lands 1 µs low for ~1% of values
+                t_us = d.get("eventTimeUs")
+                if t_us is None:
+                    # pre-eventTimeUs doc: float seconds only. round,
+                    # not truncate — int(x*1e6) lands 1 µs low for ~1%
+                    # of values
+                    t_us = round(d["eventTime"] * 1e6)
                 yield (d["event"], d["entityId"], tgt,
-                       d.get("properties"),
-                       round(d["eventTime"] * 1e6))
+                       d.get("properties"), int(t_us))
 
         return columnar_from_rows(rows(), value_key)
+
+    @property
+    def cache_identity(self) -> Optional[str]:  # type: ignore[override]
+        root = getattr(self._c, "_root", None)
+        if root is None:
+            return None  # in-memory client: nothing durable to key on
+        return "es:" + os.path.abspath(root)
+
+    def creation_stats(
+        self, app_id: int, channel_id: Optional[int] = None,
+        until_us: Optional[int] = None,
+    ) -> Optional[Tuple[int, Optional[int]]]:
+        """Watermark probe over exact ``creationTimeUs`` doc values.
+        None (cache disabled) when any document predates the field —
+        a bounded delta scan could not see those docs."""
+        stats = self._idx(app_id, channel_id).numeric_stats(
+            "creationTimeUs",
+            until=float(until_us) if until_us is not None else None)
+        return stats
 
 
 # -- meta store ----------------------------------------------------------------

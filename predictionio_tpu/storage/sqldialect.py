@@ -42,6 +42,9 @@ class SQLDialect(ABC):
     key_type: str = "TEXT"             # string type usable as PK / index
     str_type: str = "TEXT"             # string type for indexed columns
     blob_type: str = "BLOB"
+    # Stable identity of the backing database for the snapshot cache;
+    # None ⇒ scans through this dialect are never snapshot-cached
+    cache_identity: Optional[str] = None
 
     # -- connections -----------------------------------------------------------
 
@@ -135,6 +138,10 @@ class SqliteDialect(SQLDialect):
 
     def __init__(self, path: str) -> None:
         self.path = path
+        if path != ":memory:":
+            import os
+
+            self.cache_identity = "sqlite:" + os.path.abspath(path)
 
     def connect(self):
         import sqlite3
@@ -233,6 +240,9 @@ class PostgresDialect(SQLDialect):
                 "(pip install psycopg2-binary)") from e
         self._psycopg2 = psycopg2
         self._conninfo = _server_props(props or {}, 5432, "postgresql")
+        ci = self._conninfo
+        self.cache_identity = (
+            f"pgsql://{ci['host']}:{ci['port']}/{ci['database']}")
 
     def connect(self):
         ci = self._conninfo
@@ -288,6 +298,9 @@ class MySQLDialect(SQLDialect):
                 "(pip install pymysql)") from e
         self._pymysql = pymysql
         self._conninfo = _server_props(props or {}, 3306, "mysql")
+        ci = self._conninfo
+        self.cache_identity = (
+            f"mysql://{ci['host']}:{ci['port']}/{ci['database']}")
 
     def connect(self):
         ci = self._conninfo

@@ -180,6 +180,7 @@ def cmd_train(args: argparse.Namespace) -> None:
         use_mesh=not args.no_mesh,
         batch=args.batch or "",
         resume=bool(getattr(args, "resume", False)),
+        scan_cache=False if getattr(args, "no_scan_cache", False) else None,
     )
     print(f"[info] Training completed. Engine instance: {instance_id}")
 
@@ -443,6 +444,9 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--resume", action="store_true",
                     help="resume an interrupted train from its latest "
                          "mid-train checkpoint")
+    tr.add_argument("--no-scan-cache", action="store_true",
+                    help="bypass the columnar snapshot cache and rescan "
+                         "the full event log")
     tr.set_defaults(fn=cmd_train)
 
     dp = sub.add_parser("deploy", help="serve the latest trained instance")

@@ -1,0 +1,154 @@
+"""Snapshot-cache speedup for the repeat-`pio train` scan (chip-free).
+
+Measures the tentpole claim behind docs/perf.md "Incremental columnar
+snapshot cache": a warm train over a mostly-append-only log should cost
+O(new events), not O(event log). Builds a synthetic 1M-event EVENTLOG
+namespace via the native NDJSON ingest, then times the full
+``read_training_interactions`` call three ways:
+
+- ``cold``  — cache disabled: the status-quo full C++ rescan every
+              train pays today;
+- ``prime`` — first cached read: full rescan + snapshot write;
+- ``warm``  — after appending a 1k-event delta: snapshot load + delta
+              scan + concat, the steady-state retrain read.
+
+The headline ratio compares the SCAN layer (``_scan_with_cache``, the
+surface the cache replaces — the same span pio_columnar_scan_seconds
+measures); end-to-end ``read_training_interactions`` times are also
+reported, diluted by the interaction-building pass both paths share.
+Verifies warm == cold array-for-array before reporting, and prints ONE
+JSON line with the times and the cold/warm scan ratio.
+
+Usage::
+
+    python profile_snapshot.py [--events 1000000] [--delta 1000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+
+def _lines(lo: int, hi: int) -> bytes:
+    out = []
+    for i in range(lo, hi):
+        sec = i % 60
+        minute = (i // 60) % 60
+        hour = (i // 3600) % 24
+        day = 1 + (i // 86400) % 27
+        out.append(
+            '{"event":"rate","entityType":"user","entityId":"u%d",'
+            '"targetEntityType":"item","targetEntityId":"i%d",'
+            '"properties":{"rating":%d.5},'
+            '"eventTime":"2026-%02d-%02dT%02d:%02d:%02d.%06dZ"}'
+            % (i % 20000, i % 4000, i % 5,
+               1 + (i // 2332800) % 12, day, hour, minute, sec, i % 1000000))
+    return ("\n".join(out) + "\n").encode()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--events", type=int, default=1_000_000)
+    ap.add_argument("--delta", type=int, default=1000)
+    ap.add_argument("--chunk", type=int, default=100_000)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # no accelerator needed
+
+    import numpy as np
+
+    from predictionio_tpu.data.store import (read_training_interactions,
+                                             set_scan_cache)
+    from predictionio_tpu.storage.registry import (Storage, StorageConfig,
+                                                   set_storage)
+
+    with tempfile.TemporaryDirectory() as home:
+        os.environ["PIO_SCAN_CACHE_DIR"] = os.path.join(home, "scan_cache")
+        cfg = StorageConfig.from_env({
+            "PIO_HOME": home,
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NATIVE",
+            "PIO_STORAGE_SOURCES_NATIVE_TYPE": "EVENTLOG",
+        })
+        st = Storage(cfg)
+        set_storage(st)
+        app = st.meta.create_app("SnapProfApp")
+
+        t0 = time.perf_counter()
+        for lo in range(0, args.events, args.chunk):
+            hi = min(lo + args.chunk, args.events)
+            blob = _lines(lo, hi)
+            n, fallback = st.events.append_jsonl(blob, hi - lo, app.id)
+            assert n == hi - lo and not fallback, \
+                f"native ingest fell back for {len(fallback)} lines"
+        t_ingest = time.perf_counter() - t0
+
+        from predictionio_tpu.data import store as store_mod
+
+        def read():
+            return read_training_interactions(
+                "SnapProfApp", value_key="rating",
+                value_spec={"rate": "prop"}, storage=st).arrays()
+
+        def scan():
+            return store_mod._scan_with_cache(
+                st.events.scan_columnar, st, app.id, None, None, None,
+                None, None, None, "rating")
+
+        def timed(fn, repeat=1):
+            best, out = float("inf"), None
+            for _ in range(repeat):
+                t0 = time.perf_counter()
+                out = fn()
+                best = min(best, time.perf_counter() - t0)
+            return best, out
+
+        prev = set_scan_cache(False)
+        t_cold, c_cold = timed(scan, repeat=2)
+        t_read_cold, a_cold = timed(read)
+        set_scan_cache(prev)
+
+        t_prime, _c = timed(scan)                # rescan + snapshot write
+
+        lo, hi = args.events, args.events + args.delta
+        n, fallback = st.events.append_jsonl(_lines(lo, hi), hi - lo, app.id)
+        assert n == hi - lo and not fallback
+
+        # steady state: a small delta does not recompact the snapshot,
+        # so repeated warm scans all do load + delta + concat
+        t_warm, c_warm = timed(scan, repeat=3)
+        t_read_warm, a_warm = timed(read)
+
+        prev = set_scan_cache(False)
+        _t, c_ref = timed(scan)                  # post-delta full rescan
+        _t, a_ref = timed(read)
+        set_scan_cache(prev)
+
+        assert c_warm.n == c_ref.n == c_cold.n + args.delta
+        assert (c_warm.times_us == c_ref.times_us).all()
+        assert (c_warm.entity_idx == c_ref.entity_idx).all()
+        assert (c_warm.target_idx == c_ref.target_idx).all()
+        assert list(c_warm.entity_ids) == list(c_ref.entity_ids)
+        for x, y in zip(a_warm, a_ref):
+            assert np.array_equal(x, y), "warm read diverged from rescan"
+
+        st.events.close()
+        print(json.dumps({
+            "events": args.events, "delta": args.delta,
+            "ingest_s": round(t_ingest, 3),
+            "cold_scan_s": round(t_cold, 3),
+            "prime_scan_s": round(t_prime, 3),
+            "warm_scan_s": round(t_warm, 3),
+            "scan_speedup_cold_over_warm": round(t_cold / t_warm, 1),
+            "cold_read_s": round(t_read_cold, 3),
+            "warm_read_s": round(t_read_warm, 3),
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -600,6 +600,79 @@ class TestNativeJsonlImport:
         assert np.isfinite(cols.values).all()
         assert set(cols.names) == {"rate"}
 
+    def test_duplicate_property_keys_fall_back(self, store):
+        """json.loads keeps the LAST duplicate key; the C++ scanner's
+        first-match property extraction would keep the FIRST — so a
+        line with duplicate keys must never be consumed natively.
+        (json.dumps can't emit duplicates; the lines are hand-built.)"""
+        import json as _json
+
+        line = ('{"event":"rate","entityType":"user","entityId":"u1",'
+                '"targetEntityType":"item","targetEntityId":"i1",'
+                '"properties":{"rating":1,"rating":2},'
+                '"eventTime":"2026-01-02T03:04:05Z"}')
+        assert _json.loads(line)["properties"] == {"rating": 2}
+        n = self._import(store, line + "\n")
+        assert n == 1
+        evs = list(store.find(APP))
+        assert len(evs) == 1
+        assert evs[0].properties == {"rating": 2}  # last wins, as Python
+        cols = store.scan_columnar(APP, value_key="rating")
+        assert cols.values.tolist() == [2.0]
+
+    def test_duplicate_top_level_keys_fall_back(self, store):
+        import json as _json
+
+        line = ('{"event":"rate","entityType":"user","entityId":"u1",'
+                '"entityId":"u2","eventTime":"2026-01-02T03:04:05Z"}')
+        assert _json.loads(line)["entityId"] == "u2"
+        n = self._import(store, line + "\n")
+        assert n == 1
+        evs = list(store.find(APP))
+        assert len(evs) == 1 and evs[0].entity_id == "u2"
+
+    def test_escaped_key_duplicates_detected(self, store):
+        """Duplicate detection must compare UNESCAPED key text:
+        "\\u0072ating" and "rating" are the same key."""
+        line = ('{"event":"rate","entityType":"user","entityId":"u1",'
+                '"properties":{"\\u0072ating":1,"rating":2},'
+                '"eventTime":"2026-01-02T03:04:05Z"}')
+        n = self._import(store, line + "\n")
+        assert n == 1
+        evs = list(store.find(APP))
+        assert evs[0].properties == {"rating": 2}
+
+    def test_distinct_keys_stay_native(self, store):
+        """Non-duplicate multi-key objects must not be rejected by the
+        duplicate check (no false positives)."""
+        line = ('{"event":"rate","entityType":"user","entityId":"u1",'
+                '"properties":{"rating":1,"rating2":2,"ratin":3},'
+                '"eventTime":"2026-01-02T03:04:05Z"}')
+        n = self._import(store, line + "\n")
+        assert n == 1
+        evs = list(store.find(APP))
+        assert evs[0].properties == {"rating": 1, "rating2": 2, "ratin": 3}
+
+    def test_batch_creation_times_strictly_increase(self, store):
+        """Defaulted creationTimes within one import batch must be
+        distinct and follow line order (now_us + line index), and a
+        back-to-back second batch must not collide with the first —
+        the snapshot cache's watermark math needs creationTime to be
+        a usable tiebreaker, not a pile of equal timestamps."""
+        def batch(tag, k):
+            return "\n".join(
+                '{"event":"e","entityType":"u","entityId":"%s%d"}' % (tag, i)
+                for i in range(k)) + "\n"
+
+        self._import(store, batch("a", 50))
+        self._import(store, batch("b", 50))
+        evs = sorted(store.find(APP), key=lambda e: e.creation_time)
+        times = [e.creation_time for e in evs]
+        assert len(set(times)) == 100  # all distinct
+        order = [e.entity_id for e in evs]
+        assert order == [f"a{i}" for i in range(50)] + \
+            [f"b{i}" for i in range(50)]
+
 
 class TestNativeJsonlExport:
     """`pio export` native parity: every line must json-loads-equal
