@@ -57,6 +57,13 @@ class EventStore(ABC):
     def close(self) -> None:
         pass
 
+    def set_durable(self, durable: bool = True) -> None:
+        """Ask the backend to make each commit survive power loss (fsync
+        on commit), not just process death. The Event Server's durable-
+        ack mode turns this on so a 201 means on-disk; group commit
+        amortizes the sync over the whole batch. Backends without a
+        meaningful sync level (in-memory) ignore it."""
+
     # -- writes ----------------------------------------------------------------
 
     @abstractmethod
@@ -204,6 +211,22 @@ class MemoryEventStore(EventStore):
         assert event.event_id is not None
         return event.event_id
 
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> List[str]:
+        # group-commit semantics like the SQL backend: validate every
+        # event BEFORE writing any (no partial batch on a bad event),
+        # then land the whole batch under one lock acquisition
+        stamped = []
+        for e in events:
+            validate_event(e)
+            stamped.append(e.with_id())
+        with self._lock:
+            ns = self._ns(app_id, channel_id)
+            for e in stamped:
+                ns[e.event_id] = e
+        return [e.event_id for e in stamped]  # type: ignore[misc]
+
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
         with self._lock:
             return self._ns(app_id, channel_id).get(event_id)
@@ -278,9 +301,23 @@ class SQLEventStore(EventStore):
         self._conns = dialect.thread_conns()
         self._lock = threading.RLock()
         self._known: set = set()  # namespaces whose DDL already ran
+        self._durable = False
+        self._durable_applied: set = set()  # conn ids already at FULL
+
+    def set_durable(self, durable: bool = True) -> None:
+        with self._lock:
+            self._durable = durable
+            self._durable_applied = set()
 
     def _conn(self):
-        return self._conns.get()
+        c = self._conns.get()
+        # connections are created lazily per thread — apply the sync
+        # level the first time each one surfaces after set_durable()
+        if self._durable and id(c) not in self._durable_applied:
+            with self._lock:
+                self._d.set_sync_durable(c, True)
+                self._durable_applied.add(id(c))
+        return c
 
     @staticmethod
     def _table(app_id: int, channel_id: Optional[int]) -> str:

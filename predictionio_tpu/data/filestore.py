@@ -119,6 +119,12 @@ class NativeEventLogStore(EventStore):
         # reserves [now_us, now_us + n_lines) so consecutive chunks
         # never interleave even when the wall clock stalls or steps back
         self._now_floor = 0
+        # durable-ack mode: fsync after each append call (one sync per
+        # group commit, not per event — pel_sync covers the whole batch)
+        self._durable = False
+
+    def set_durable(self, durable: bool = True) -> None:
+        self._durable = durable
 
     # -- plumbing ----------------------------------------------------------
 
@@ -171,9 +177,17 @@ class NativeEventLogStore(EventStore):
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
         return self.insert_batch([event], app_id, channel_id)[0]
 
+    # frames per native append call: bounds the joined buffer (and the
+    # engine's single locked write) when a group commit or `pio import`
+    # hands over a very large batch
+    _APPEND_CHUNK = 8192
+
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
     ) -> List[str]:
+        # validate every event BEFORE appending any: an append-only log
+        # has no rollback, so a bad event mid-batch must fail the call
+        # without leaving a partial prefix behind
         frames = []
         ids = []
         for e in events:
@@ -181,11 +195,16 @@ class NativeEventLogStore(EventStore):
             e = e.with_id()
             frames.append(serialize_event(e))
             ids.append(e.event_id)
-        buf = b"".join(frames)
         h = self._handle(app_id, channel_id)
-        n = self._lib.pel_append_batch(h, buf, len(buf), len(frames))
-        if n != len(frames):
-            raise IOError(f"event log append failed ({n}/{len(frames)})")
+        for lo in range(0, len(frames), self._APPEND_CHUNK):
+            chunk = frames[lo:lo + self._APPEND_CHUNK]
+            buf = b"".join(chunk)
+            n = self._lib.pel_append_batch(h, buf, len(buf), len(chunk))
+            if n != len(chunk):
+                raise IOError(
+                    f"event log append failed ({lo + n}/{len(frames)})")
+        if self._durable and self._lib.pel_sync(h) != 0:
+            raise IOError("event log fsync failed")
         return ids  # type: ignore[return-value]
 
     def append_jsonl(
@@ -226,6 +245,8 @@ class NativeEventLogStore(EventStore):
             h, lines, len(lines), now_us, seed, status, n_lines, None)
         if n < 0:
             raise IOError("event log jsonl append failed")
+        if self._durable and self._lib.pel_sync(h) != 0:
+            raise IOError("event log fsync failed")
         fallback = [i for i in range(n_lines) if status.raw[i] == 1]
         return int(n), fallback
 

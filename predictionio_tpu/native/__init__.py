@@ -75,6 +75,8 @@ def eventlog_library() -> Optional[ctypes.CDLL]:
     lib.pel_append_batch.restype = ctypes.c_int
     lib.pel_append_batch.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong, ctypes.c_int]
+    lib.pel_sync.restype = ctypes.c_int
+    lib.pel_sync.argtypes = [ctypes.c_void_p]
     lib.pel_delete.restype = ctypes.c_int
     lib.pel_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
     lib.pel_wipe.restype = ctypes.c_int

@@ -471,6 +471,18 @@ int pel_append_batch(void* hv, const unsigned char* buf, long long len,
   return append_frames(h, buf, len, n);
 }
 
+// Durable-ack support: fsync the log so an acked append survives power
+// loss, not just process death (fflush alone stops at the page cache).
+// One call covers every record appended before it — the group-commit
+// path pays this once per batch. Returns 0 on success, -1 on failure.
+int pel_sync(void* hv) {
+  Handle* h = (Handle*)hv;
+  std::lock_guard<std::mutex> g(h->mu);
+  if (!h->f) return -1;
+  if (fflush(h->f) != 0) return -1;
+  return fsync(fileno(h->f)) == 0 ? 0 : -1;
+}
+
 // Tombstone an id. Returns 1 if it existed, 0 otherwise, -1 on IO error.
 int pel_delete(void* hv, const char* id, int idlen) {
   Handle* h = (Handle*)hv;

@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import datetime as _dt
 import threading
+import time
 from collections import Counter
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -32,10 +33,86 @@ from predictionio_tpu.data.event import (
     utcnow,
 )
 from predictionio_tpu.server.http import HTTPServer, Request, Response, Router
+from predictionio_tpu.server.ingest import IngestOverload
 from predictionio_tpu.storage.registry import Storage, get_storage
 
 BATCH_LIMIT = 50
 DEFAULT_FIND_LIMIT = 20
+
+
+class AuthCache:
+    """TTL cache for the per-request meta-store lookups (access key +
+    channel-by-name) — every POST otherwise pays one or two SQL reads
+    before touching event storage.
+
+    Freshness: entries expire after ``ttl`` seconds, and the WHOLE
+    cache drops the moment any in-process key/channel admin mutation
+    bumps the meta epoch (:func:`~predictionio_tpu.storage.meta.
+    meta_epoch`) — so `pio accesskey delete` in the same process is
+    effective immediately. Mutations from ANOTHER process are only
+    visible after the TTL; operators who need instant cross-process
+    revocation run with ``auth_cache_ttl=0`` (cache off).
+
+    Negative results are cached too (a flood of bad keys must not
+    turn into a flood of SQL reads); the cache is size-capped so
+    attacker-chosen keys cannot grow it without bound."""
+
+    MAX_ENTRIES = 4096
+
+    def __init__(self, meta, ttl: float = 30.0) -> None:
+        from predictionio_tpu.storage.meta import meta_epoch
+        from predictionio_tpu.utils.metrics import REGISTRY
+
+        self._meta = meta
+        self.ttl = ttl
+        self._epoch_fn = meta_epoch
+        self._epoch = meta_epoch()
+        self._lock = threading.Lock()
+        self._keys: Dict[str, Tuple[float, Any]] = {}
+        self._channels: Dict[Tuple[int, str], Tuple[float, Any]] = {}
+        self._m = REGISTRY.counter(
+            "pio_authcache_total", "Auth cache lookups", ("result",))
+
+    def _fresh(self, cache: Dict, key) -> Tuple[bool, Any]:
+        """Must hold the lock. Returns (hit, value)."""
+        epoch = self._epoch_fn()
+        if epoch != self._epoch:
+            self._keys.clear()
+            self._channels.clear()
+            self._epoch = epoch
+            return False, None
+        ent = cache.get(key)
+        if ent is not None and ent[0] > time.monotonic():
+            return True, ent[1]
+        return False, None
+
+    def _put(self, cache: Dict, key, value) -> None:
+        with self._lock:
+            if len(cache) >= self.MAX_ENTRIES:
+                cache.clear()
+            cache[key] = (time.monotonic() + self.ttl, value)
+
+    def get_access_key(self, key: str):
+        with self._lock:
+            hit, val = self._fresh(self._keys, key)
+        if hit:
+            self._m.inc(("hit",))
+            return val
+        self._m.inc(("miss",))
+        ak = self._meta.get_access_key(key)
+        self._put(self._keys, key, ak)
+        return ak
+
+    def get_channel_by_name(self, app_id: int, name: str):
+        with self._lock:
+            hit, val = self._fresh(self._channels, (app_id, name))
+        if hit:
+            self._m.inc(("hit",))
+            return val
+        self._m.inc(("miss",))
+        ch = self._meta.get_channel_by_name(app_id, name)
+        self._put(self._channels, (app_id, name), ch)
+        return ch
 
 
 class Stats:
@@ -76,8 +153,18 @@ class EventServer:
         ssl_context: Optional[Any] = None,
         bind_retries: int = 3,
         bind_retry_sec: float = 1.0,
+        ingest_batching: bool = False,
+        ingest_max_batch: int = 512,
+        ingest_queue_depth: int = 4096,
+        auth_cache_ttl: float = 30.0,
+        durable_acks: bool = False,
     ) -> None:
         self.storage = storage or get_storage()
+        if durable_acks:
+            # 201 then means on-disk (fsync), not just committed to the
+            # page cache; with ingest batching the coalescer amortizes
+            # the sync over each group commit
+            self.storage.events.set_durable(True)
         self.stats = Stats() if stats else None
         self.plugins = plugins if plugins is not None else _discover_plugins()
         from predictionio_tpu.utils.metrics import REGISTRY
@@ -87,6 +174,15 @@ class EventServer:
             ("app_id", "status"))
         self._m_insert = REGISTRY.histogram(
             "pio_event_insert_seconds", "Single-event insert latency")
+        self._ingest = None
+        if ingest_batching:
+            from predictionio_tpu.server.ingest import WriteCoalescer
+
+            self._ingest = WriteCoalescer(self.storage.events,
+                                          max_batch=ingest_max_batch,
+                                          max_queue=ingest_queue_depth)
+        self._auth_cache = (AuthCache(self.storage.meta, ttl=auth_cache_ttl)
+                            if auth_cache_ttl > 0 else None)
         router = Router()
         router.route("GET", "/", self._status)
         router.route("GET", "/metrics", self._metrics)
@@ -126,14 +222,15 @@ class EventServer:
         if not key:
             return None, Response.json(
                 {"message": "Missing accessKey."}, status=401)
-        ak = self.storage.meta.get_access_key(key)
+        meta = self._auth_cache or self.storage.meta
+        ak = meta.get_access_key(key)
         if ak is None:
             return None, Response.json(
                 {"message": "Invalid accessKey."}, status=401)
         channel_id: Optional[int] = None
         channel = req.param("channel")
         if channel:
-            ch = self.storage.meta.get_channel_by_name(ak.app_id, channel)
+            ch = meta.get_channel_by_name(ak.app_id, channel)
             if ch is None:
                 return None, Response.json(
                     {"message": f"Invalid channel {channel!r}."}, status=400)
@@ -148,32 +245,91 @@ class EventServer:
     async def _status(self, req: Request) -> Response:
         return Response.json({"status": "alive"})
 
-    def _insert_one(self, obj: Any, app_id: int, channel_id: Optional[int],
-                    allowed: List[str]) -> Tuple[int, Dict[str, Any]]:
-        import time
+    @staticmethod
+    def _created(eid: str) -> Response:
+        # constant-shape 201 body without a json.dumps on the hot path;
+        # generated ids are hex, but a client-supplied id might need
+        # real JSON escaping
+        if eid.isalnum():
+            return Response(status=201,
+                            body=b'{"eventId":"%s"}' % eid.encode())
+        return Response.json({"eventId": eid}, status=201)
 
-        t0 = time.perf_counter()
+    def _prepare_one(
+        self, obj: Any, app_id: int, channel_id: Optional[int],
+        allowed: List[str],
+    ) -> Tuple[Optional[Event], Optional[Tuple[int, Dict[str, Any]]]]:
+        """Parse/validate/authorize one event body WITHOUT inserting.
+        Returns (event, None) or (None, (status, error body)); error
+        statuses are counted here."""
         try:
             ev = Event.from_json(obj)
         except EventValidationError as e:
             self._m_events.inc((app_id, 400))
-            return 400, {"message": str(e)}
+            return None, (400, {"message": str(e)})
         if not self._check_permitted(allowed, ev.event):
             self._m_events.inc((app_id, 403))
-            return 403, {"message": f"event {ev.event!r} not permitted by this key"}
+            return None, (403, {"message": f"event {ev.event!r} not permitted "
+                                           "by this key"})
         for p in self.plugins:
             verdict = p.input_blocker(ev, app_id, channel_id)
             if verdict is not None:
                 self._m_events.inc((app_id, 403))
-                return 403, {"message": verdict}
-        eid = self.storage.events.insert(ev, app_id, channel_id)
+                return None, (403, {"message": verdict})
+        return ev, None
+
+    def _finish_one(self, ev: Event, app_id: int, channel_id: Optional[int],
+                    elapsed: float) -> None:
+        """Post-commit accounting shared by every insert path."""
         for p in self.plugins:
             p.input_sniffer(ev, app_id, channel_id)
         if self.stats:
             self.stats.record(app_id, ev.event, 201)
         self._m_events.inc((app_id, 201))
-        self._m_insert.observe(time.perf_counter() - t0)
+        self._m_insert.observe(elapsed)
+
+    def _insert_one(self, obj: Any, app_id: int, channel_id: Optional[int],
+                    allowed: List[str]) -> Tuple[int, Dict[str, Any]]:
+        t0 = time.perf_counter()
+        ev, err = self._prepare_one(obj, app_id, channel_id, allowed)
+        if err is not None:
+            return err
+        eid = self.storage.events.insert(ev, app_id, channel_id)
+        self._finish_one(ev, app_id, channel_id, time.perf_counter() - t0)
         return 201, {"eventId": eid}
+
+    async def _ingest_obj(self, obj: Any, app_id: int,
+                          channel_id: Optional[int],
+                          allowed: List[str]) -> Response:
+        """One event body → Response, through the group-commit
+        coalescer when enabled (ack only after the commit returns),
+        else the per-event insert path."""
+        if self._ingest is None:
+            status, body = await asyncio.to_thread(
+                self._insert_one, obj, app_id, channel_id, allowed)
+            if status == 201:
+                return self._created(body["eventId"])
+            return Response.json(body, status=status)
+        t0 = time.perf_counter()
+        # parse/authorize inline: pure Python, no storage round trip —
+        # keeps the hot path free of a to_thread hop per request
+        ev, err = self._prepare_one(obj, app_id, channel_id, allowed)
+        if err is not None:
+            status, body = err
+            return Response.json(body, status=status)
+        try:
+            eid = await self._ingest.submit(ev, app_id, channel_id)
+        except IngestOverload as e:
+            self._m_events.inc((app_id, 429))
+            resp = Response.json({"message": str(e)}, status=429)
+            resp.headers["Retry-After"] = str(max(1, round(e.retry_after)))
+            return resp
+        except Exception as e:
+            self._m_events.inc((app_id, 500))
+            return Response.json(
+                {"message": f"event insert failed: {e}"}, status=500)
+        self._finish_one(ev, app_id, channel_id, time.perf_counter() - t0)
+        return self._created(eid)
 
     async def _metrics(self, req: Request) -> Response:
         from predictionio_tpu.utils.metrics import REGISTRY
@@ -186,9 +342,7 @@ class EventServer:
         if err:
             return err
         app_id, channel_id, allowed = auth
-        status, body = await asyncio.to_thread(
-            self._insert_one, req.json(), app_id, channel_id, allowed)
-        return Response.json(body, status=status)
+        return await self._ingest_obj(req.json(), app_id, channel_id, allowed)
 
     async def _post_batch(self, req: Request) -> Response:
         auth, err = self._auth(req)
@@ -205,10 +359,44 @@ class EventServer:
                 status=400)
 
         def run() -> List[Dict[str, Any]]:
+            t0 = time.perf_counter()
+            prepared = [self._prepare_one(obj, app_id, channel_id, allowed)
+                        for obj in payload]
+            if prepared and all(err is None for _, err in prepared):
+                # every event valid+permitted: ONE insert_batch, one
+                # storage commit for the whole payload (the group-commit
+                # fast path); any failure falls back below so the
+                # per-item status array stays accurate
+                events = [ev for ev, _ in prepared]
+                try:
+                    ids = self.storage.events.insert_batch(
+                        events, app_id, channel_id)
+                except Exception:
+                    pass
+                else:
+                    per_event = (time.perf_counter() - t0) / len(events)
+                    for ev in events:
+                        self._finish_one(ev, app_id, channel_id, per_event)
+                    return [{"status": 201, "eventId": eid} for eid in ids]
+            # mixed validity (or batch-commit failure): event-by-event,
+            # so one bad item cannot poison its siblings' statuses
             results = []
-            for obj in payload:
-                status, body = self._insert_one(obj, app_id, channel_id, allowed)
-                results.append({"status": status, **body})
+            for ev, err in prepared:
+                if err is not None:
+                    status, body = err
+                    results.append({"status": status, **body})
+                    continue
+                t1 = time.perf_counter()
+                try:
+                    eid = self.storage.events.insert(ev, app_id, channel_id)
+                except Exception as e:
+                    self._m_events.inc((app_id, 500))
+                    results.append({"status": 500,
+                                    "message": f"event insert failed: {e}"})
+                    continue
+                self._finish_one(ev, app_id, channel_id,
+                                 time.perf_counter() - t1)
+                results.append({"status": 201, "eventId": eid})
             return results
 
         return Response.json(await asyncio.to_thread(run))
@@ -296,9 +484,7 @@ class EventServer:
                 obj = conn.to_event_json(req.json())
         except Exception as e:
             return Response.json({"message": f"connector error: {e}"}, status=400)
-        status, body = await asyncio.to_thread(
-            self._insert_one, obj, app_id, channel_id, allowed)
-        return Response.json(body, status=status)
+        return await self._ingest_obj(obj, app_id, channel_id, allowed)
 
     async def _webhook_probe(self, req: Request) -> Response:
         from predictionio_tpu.data.webhooks import get_connector
@@ -315,7 +501,13 @@ class EventServer:
     # -- lifecycle -------------------------------------------------------------
 
     async def serve_forever(self) -> None:
-        await self.http.serve_forever()
+        try:
+            await self.http.serve_forever()
+        finally:
+            if self._ingest is not None:
+                # drain: everything accepted before shutdown commits —
+                # a 201 promised durability, so the queue must land
+                await self._ingest.aclose()
 
     def run(self) -> None:
         asyncio.run(self.serve_forever())

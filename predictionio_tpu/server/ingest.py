@@ -1,0 +1,272 @@
+"""Group-commit write coalescer for the Event Server ingest path.
+
+Every backend's ``insert_batch`` already amortizes the expensive part
+of a write — one SQL ``executemany`` + COMMIT (`data/events.py`), one
+chunked native append (`data/filestore.py`), one WAL append
+(`storage/indexed.py`) — but concurrent single-event POSTs never used
+it: each request paid a full per-event commit. The reference's HBase
+backend got batching for free from client-side put buffering
+(SURVEY.md §3.3); this layer is the framework's equivalent, server
+side, with a durability guarantee the client buffer never had.
+
+Design mirrors :class:`~predictionio_tpu.server.batching.MicroBatcher`
+(the query-path coalescer) and its r5 lessons:
+
+- **No timed wait on the hot path.** Batches form naturally from
+  service time: while a commit runs, new arrivals queue; the next
+  collect drains EVERYTHING queued (up to ``max_batch``). A lone
+  event pays ~0 extra latency.
+- **One commit per (app, channel) group** per dispatch — namespaces
+  are separate tables/logs, so a drained batch is grouped before the
+  backend call.
+- **Ack after commit.** A request's future resolves only once its
+  group's ``insert_batch`` has returned, so a 201 still means the
+  event is as durable as the backend makes a committed write.
+- **Per-event failure isolation.** A failed group commit re-runs its
+  events one by one (the MicroBatcher isolation move): each caller
+  sees their OWN error; siblings of a poison event still land.
+- **Bounded queue with backpressure.** Past ``max_queue`` pending
+  events, ``submit`` raises :class:`IngestOverload`; the HTTP layer
+  maps it to ``429`` + ``Retry-After`` instead of letting the queue
+  grow without bound under a traffic spike.
+- **Clean drain on shutdown.** ``aclose()`` refuses new work, lets
+  the committer finish everything already accepted, then commits any
+  remainder itself — no accepted (let alone acked) event is lost.
+
+Enable with ``EventServer(ingest_batching=True)`` or
+``pio eventserver --ingest-batching``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import time
+from typing import Dict, List, Optional, Tuple
+
+from predictionio_tpu.data.event import Event
+
+_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+#: queue sentinel: aclose() pushes it behind everything already
+#: accepted, so the committer drains in arrival order then exits
+_STOP = object()
+
+
+class IngestOverload(Exception):
+    """Ingest queue at capacity — shed load instead of queueing."""
+
+    def __init__(self, depth: int, limit: int,
+                 retry_after: float = 1.0) -> None:
+        super().__init__(
+            f"ingest queue full ({depth}/{limit} events pending)")
+        self.depth = depth
+        self.limit = limit
+        self.retry_after = retry_after
+
+
+class WriteCoalescer:
+    """Order-preserving group-commit front for an
+    :class:`~predictionio_tpu.data.events.EventStore`."""
+
+    def __init__(self, store, max_batch: int = 512,
+                 max_queue: int = 4096) -> None:
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self.store = store
+        self.max_batch = max_batch
+        self.max_queue = max_queue
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._worker: Optional[asyncio.Task] = None
+        self._executor: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
+        self._closed = False
+        self.submitted = 0    # events accepted into the queue
+        self.batches = 0      # group commits issued
+        self.isolations = 0   # failed groups re-run event-by-event
+        self.rejected = 0     # submits refused by backpressure
+        from predictionio_tpu.utils.metrics import REGISTRY
+
+        self._m_depth = REGISTRY.gauge(
+            "pio_ingest_queue_depth", "Events waiting for a group commit")
+        self._m_batch = REGISTRY.histogram(
+            "pio_ingest_batch_events", "Events per group commit",
+            buckets=_BATCH_BUCKETS)
+        self._m_commit = REGISTRY.histogram(
+            "pio_ingest_commit_seconds", "Group-commit latency")
+        self._m_coalesced = REGISTRY.counter(
+            "pio_ingest_coalesced_events_total",
+            "Events that shared their commit with at least one other")
+        self._m_rejected = REGISTRY.counter(
+            "pio_ingest_rejected_total",
+            "Submits refused by queue backpressure")
+
+    # -- plumbing --------------------------------------------------------------
+
+    def _get_executor(self) -> concurrent.futures.ThreadPoolExecutor:
+        # dedicated single thread: commits must never wait behind the
+        # shared to_thread pool, which blocked request handlers can
+        # saturate — the deadlock the MicroBatcher hit in r4
+        if self._executor is None:
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="pio-ingest")
+        return self._executor
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or self._worker.done():
+            self._worker = asyncio.get_running_loop().create_task(self._run())
+
+    @property
+    def depth(self) -> int:
+        return self._queue.qsize()
+
+    # -- submit ----------------------------------------------------------------
+
+    async def submit(self, event: Event, app_id: int,
+                     channel_id: Optional[int] = None) -> str:
+        """Enqueue one validated event; resolves to its eventId once
+        the group commit that contains it has returned (or raises the
+        per-event storage error)."""
+        if self._closed:
+            raise RuntimeError("ingest coalescer is closed")
+        if self._queue.qsize() >= self.max_queue:
+            self.rejected += 1
+            self._m_rejected.inc()
+            raise IngestOverload(self._queue.qsize(), self.max_queue)
+        self._ensure_worker()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.submitted += 1
+        # hot path: put_nowait (the queue is unbounded — depth limiting
+        # happened above) skips a coroutine round trip per event, and
+        # the depth gauge is refreshed once per dispatch in _collect()
+        self._queue.put_nowait((app_id, channel_id, event, fut))
+        return await fut
+
+    # -- committer -------------------------------------------------------------
+
+    async def _collect(self) -> Tuple[List[tuple], bool]:
+        """One dispatch's worth: block for the first item, yield once
+        so ready handlers enqueue, then take everything queued (up to
+        ``max_batch``). Returns (items, stop_seen). No timed wait —
+        see module docstring."""
+        first = await self._queue.get()
+        if first is _STOP:
+            return [], True
+        items = [first]
+        stop = False
+        # quiescence loop: yield to ready handlers, drain what they
+        # enqueued, repeat while the queue keeps growing. Still no
+        # timed wait — sleep(0) adds zero idle time — but requests
+        # that are already parsed and mid-handler make this dispatch
+        # instead of the next one. Bounded by max_batch and by the
+        # natural cap of in-flight requests (a client waiting for its
+        # ack can't enqueue another event).
+        while len(items) < self.max_batch:
+            await asyncio.sleep(0)
+            grew = False
+            while len(items) < self.max_batch:
+                try:
+                    nxt = self._queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                if nxt is _STOP:
+                    stop = True
+                    break
+                items.append(nxt)
+                grew = True
+            if stop or not grew:
+                break
+        self._m_depth.set(self._queue.qsize())
+        return items, stop
+
+    async def _run(self) -> None:
+        while True:
+            items, stop = await self._collect()
+            if items:
+                await self._commit(items)
+            if stop:
+                return
+
+    async def _commit(self, items: List[tuple]) -> None:
+        """Group by (app, channel), one ``insert_batch`` per group."""
+        groups: Dict[Tuple[int, Optional[int]], List[tuple]] = {}
+        for app_id, channel_id, event, fut in items:
+            groups.setdefault((app_id, channel_id), []).append((event, fut))
+        loop = asyncio.get_running_loop()
+        ex = self._get_executor()
+        for (app_id, channel_id), pairs in groups.items():
+            events = [e for e, _ in pairs]
+            self.batches += 1
+            t0 = time.perf_counter()
+            try:
+                ids = await loop.run_in_executor(
+                    ex, self.store.insert_batch, events, app_id, channel_id)
+                if len(ids) != len(events):
+                    raise RuntimeError(
+                        f"insert_batch returned {len(ids)} ids for "
+                        f"{len(events)} events")
+            except Exception as e:
+                if len(pairs) == 1:
+                    if not pairs[0][1].done():
+                        pairs[0][1].set_exception(e)
+                    continue
+                # a poison event must not fail its commit siblings, and
+                # each caller must see their OWN error — re-run alone
+                self.isolations += 1
+                for event, fut in pairs:
+                    if fut.done():
+                        continue
+                    try:
+                        eid = await loop.run_in_executor(
+                            ex, self.store.insert, event, app_id, channel_id)
+                    except Exception as single_e:
+                        if not fut.done():
+                            fut.set_exception(single_e)
+                    else:
+                        if not fut.done():
+                            fut.set_result(eid)
+                continue
+            self._m_commit.observe(time.perf_counter() - t0)
+            self._m_batch.observe(len(events))
+            if len(events) > 1:
+                self._m_coalesced.inc(n=len(events))
+            for (_, fut), eid in zip(pairs, ids):
+                if not fut.done():
+                    fut.set_result(eid)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def aclose(self) -> None:
+        """Refuse new submits, commit everything already accepted,
+        release the executor. The coalescer is reusable afterwards
+        (next ``submit`` restarts worker + executor) so a server that
+        stops and serves again keeps working."""
+        self._closed = True
+        try:
+            worker = self._worker
+            if worker is not None and not worker.done():
+                await self._queue.put(_STOP)
+                await worker
+            self._worker = None
+            # leftovers are only possible if the worker had previously
+            # died — drain them here so no accepted event is dropped
+            leftovers: List[tuple] = []
+            while True:
+                try:
+                    item = self._queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                if item is not _STOP:
+                    leftovers.append(item)
+            while leftovers:
+                chunk = leftovers[:self.max_batch]
+                leftovers = leftovers[self.max_batch:]
+                await self._commit(chunk)
+            self._m_depth.set(0)
+            if self._executor is not None:
+                self._executor.shutdown(wait=True)
+                self._executor = None
+        finally:
+            self._closed = False

@@ -58,6 +58,7 @@ from predictionio_tpu.storage.meta import (
     Channel,
     EngineInstance,
     EvaluationInstance,
+    bump_meta_epoch as _bump_meta_epoch,
 )
 from predictionio_tpu.storage.models import ModelStore
 
@@ -882,6 +883,7 @@ class ESMetaStore:
         ak = AccessKey(key, app_id, list(events or []))
         self._c.index("pio_access_keys").index(
             key, {"key": key, "appId": app_id, "events": ak.events})
+        _bump_meta_epoch()
         return ak
 
     def get_access_key(self, key: str) -> Optional[AccessKey]:
@@ -897,7 +899,9 @@ class ESMetaStore:
                 for _, _, d in hits]
 
     def delete_access_key(self, key: str) -> bool:
-        return self._c.index("pio_access_keys").delete(key)
+        deleted = self._c.index("pio_access_keys").delete(key)
+        _bump_meta_epoch()
+        return deleted
 
     # -- channels --
 
@@ -907,6 +911,7 @@ class ESMetaStore:
             raise ValueError(f"channel {name!r} already exists for app {app_id}")
         ch_id = self._seq.next("channels")
         idx.index(str(ch_id), {"id": ch_id, "name": name, "appId": app_id})
+        _bump_meta_epoch()
         return Channel(ch_id, name, app_id)
 
     def get_channel_by_name(self, app_id: int, name: str) -> Optional[Channel]:
@@ -923,7 +928,9 @@ class ESMetaStore:
                     must=[("appId", app_id)], sort="id")]
 
     def delete_channel(self, channel_id: int) -> bool:
-        return self._c.index("pio_channels").delete(str(channel_id))
+        deleted = self._c.index("pio_channels").delete(str(channel_id))
+        _bump_meta_epoch()
+        return deleted
 
     # -- engine instances --
 

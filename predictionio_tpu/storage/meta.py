@@ -21,6 +21,28 @@ from typing import Any, Dict, List, Optional
 
 from predictionio_tpu.data.event import format_event_time, parse_event_time, utcnow
 
+# -- meta mutation epoch -------------------------------------------------------
+#
+# Process-wide generation counter over access-key/channel admin state.
+# Every meta backend bumps it on key/channel mutation; the Event
+# Server's AuthCache compares it per lookup (one int read) and drops
+# its entries the moment it moves — in-process revocation is therefore
+# immediate, while cross-process mutations rely on the cache TTL.
+
+_META_EPOCH = 0
+_META_EPOCH_LOCK = threading.Lock()
+
+
+def bump_meta_epoch() -> None:
+    """Record an access-key/channel mutation (invalidates auth caches)."""
+    global _META_EPOCH
+    with _META_EPOCH_LOCK:
+        _META_EPOCH += 1
+
+
+def meta_epoch() -> int:
+    return _META_EPOCH
+
 
 @dataclass
 class App:
@@ -246,6 +268,7 @@ class MetaStore:
             except Exception:
                 self._d.recover(c)
                 raise
+            bump_meta_epoch()  # the app's keys/channels went with it
             return existed
 
     # -- access keys -----------------------------------------------------------
@@ -256,6 +279,7 @@ class MetaStore:
         key = key or secrets.token_urlsafe(48)
         self._x("INSERT INTO access_keys(accesskey, appid, events) VALUES (?,?,?)",
                 (key, app_id, json.dumps(events or [])))
+        bump_meta_epoch()
         return AccessKey(key=key, app_id=app_id, events=events or [])
 
     def get_access_key(self, key: str) -> Optional[AccessKey]:
@@ -274,8 +298,10 @@ class MetaStore:
         return [AccessKey(r[0], r[1], json.loads(r[2])) for r in rows]
 
     def delete_access_key(self, key: str) -> bool:
-        return self._x("DELETE FROM access_keys WHERE accesskey=?",
-                       (key,)) > 0
+        deleted = self._x("DELETE FROM access_keys WHERE accesskey=?",
+                          (key,)) > 0
+        bump_meta_epoch()
+        return deleted
 
     # -- channels --------------------------------------------------------------
 
@@ -290,6 +316,7 @@ class MetaStore:
             except Exception:
                 self._d.recover(c)
                 raise
+            bump_meta_epoch()
             return Channel(id=rid, name=name, app_id=app_id)
 
     def get_channel_by_name(self, app_id: int, name: str) -> Optional[Channel]:
@@ -304,7 +331,10 @@ class MetaStore:
             (app_id,))]
 
     def delete_channel(self, channel_id: int) -> bool:
-        return self._x("DELETE FROM channels WHERE id=?", (channel_id,)) > 0
+        deleted = self._x("DELETE FROM channels WHERE id=?",
+                          (channel_id,)) > 0
+        bump_meta_epoch()
+        return deleted
 
     # -- engine instances ------------------------------------------------------
 

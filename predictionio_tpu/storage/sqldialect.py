@@ -55,6 +55,12 @@ class SQLDialect(ABC):
     def thread_conns(self) -> "_ThreadConns":
         return _ThreadConns(self)
 
+    def set_sync_durable(self, conn, durable: bool) -> None:
+        """Raise (or restore) this connection's commit-durability level.
+        Durable means a returned commit survives power loss, not just
+        process death — the Event Server's durable-ack contract.
+        Engines that are always durable (or have no such knob) no-op."""
+
     # -- statement shaping -----------------------------------------------------
 
     def sql(self, q: str) -> str:
@@ -159,6 +165,14 @@ class SqliteDialect(SQLDialect):
         if self.path == ":memory:":
             return _ThreadConns(self, shared=self.connect())
         return _ThreadConns(self)
+
+    def set_sync_durable(self, conn, durable: bool) -> None:
+        # WAL + NORMAL (the default) fsyncs only at checkpoint: an OS
+        # crash can drop the last commits. FULL fsyncs the WAL per
+        # commit — what a durable 201 ack requires.
+        if self.path != ":memory:":
+            conn.execute(
+                f"PRAGMA synchronous={'FULL' if durable else 'NORMAL'}")
 
     def is_missing_table(self, exc: BaseException) -> bool:
         import sqlite3

@@ -126,8 +126,14 @@ def cmd_accesskey(args: argparse.Namespace) -> None:
 def cmd_eventserver(args: argparse.Namespace) -> None:
     from predictionio_tpu.server.event_server import EventServer
 
-    server = EventServer(host=args.ip, port=args.port, stats=args.stats)
-    print(f"[info] Event Server listening on {args.ip}:{args.port}")
+    server = EventServer(host=args.ip, port=args.port, stats=args.stats,
+                         ingest_batching=args.ingest_batching,
+                         ingest_max_batch=args.ingest_max_batch,
+                         ingest_queue_depth=args.ingest_queue_depth,
+                         auth_cache_ttl=args.auth_cache_ttl,
+                         durable_acks=args.durable_acks)
+    mode = "group-commit" if args.ingest_batching else "per-event commit"
+    print(f"[info] Event Server listening on {args.ip}:{args.port} ({mode})")
     server.run()
 
 
@@ -432,6 +438,23 @@ def build_parser() -> argparse.ArgumentParser:
     es.add_argument("--ip", default="0.0.0.0")
     es.add_argument("--port", type=int, default=7070)
     es.add_argument("--stats", action="store_true")
+    es.add_argument("--ingest-batching", action="store_true",
+                    help="group-commit concurrent single-event POSTs "
+                         "into one storage commit per (app, channel); "
+                         "201 is still acked only after the commit")
+    es.add_argument("--ingest-max-batch", type=int, default=512,
+                    help="max events per group commit")
+    es.add_argument("--ingest-queue-depth", type=int, default=4096,
+                    help="pending-event limit before POSTs get 429 + "
+                         "Retry-After backpressure")
+    es.add_argument("--durable-acks", action="store_true",
+                    help="fsync storage before acking 201 (survives "
+                         "power loss, not just process death); group "
+                         "commit amortizes the sync per batch")
+    es.add_argument("--auth-cache-ttl", type=float, default=30.0,
+                    help="access-key/channel auth cache TTL seconds "
+                         "(0 disables; in-process key mutations "
+                         "invalidate immediately regardless)")
     es.set_defaults(fn=cmd_eventserver)
 
     tr = sub.add_parser("train", help="train an engine")

@@ -40,6 +40,45 @@ class Counter:
         return out
 
 
+class Gauge:
+    """A value that goes up AND down (queue depths, in-flight counts).
+    ``set`` is last-write-wins; ``inc``/``dec`` adjust atomically."""
+
+    def __init__(self, name: str, help: str,
+                 labelnames: Sequence[str] = ()) -> None:
+        self.name, self.help = name, help
+        self.labelnames = tuple(labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, labels: Sequence[str] = ()) -> None:
+        key = tuple(str(l) for l in labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, labels: Sequence[str] = (), n: float = 1.0) -> None:
+        key = tuple(str(l) for l in labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def dec(self, labels: Sequence[str] = (), n: float = 1.0) -> None:
+        self.inc(labels, -n)
+
+    def get(self, labels: Sequence[str] = ()) -> float:
+        key = tuple(str(l) for l in labels)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} gauge"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
+            out.append(f"{self.name}{_labels(self.labelnames, key)} {_num(v)}")
+        return out
+
+
 class Histogram:
     def __init__(self, name: str, help: str,
                  buckets: Sequence[float] = _DEFAULT_BUCKETS) -> None:
@@ -90,6 +129,20 @@ class Registry:
             if m is None:
                 m = self._metrics[name] = Counter(name, help, labelnames)
             elif not isinstance(m, Counter):
+                raise ValueError(f"metric {name!r} already a {type(m).__name__}")
+            elif m.labelnames != tuple(labelnames):
+                raise ValueError(
+                    f"metric {name!r} already registered with labels "
+                    f"{m.labelnames}, requested {tuple(labelnames)}")
+            return m
+
+    def gauge(self, name: str, help: str,
+              labelnames: Sequence[str] = ()) -> Gauge:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Gauge(name, help, labelnames)
+            elif not isinstance(m, Gauge):
                 raise ValueError(f"metric {name!r} already a {type(m).__name__}")
             elif m.labelnames != tuple(labelnames):
                 raise ValueError(

@@ -13,9 +13,19 @@ Measured layers (all warm, persistent connection):
                      (the API's documented maximum per request)
 - ``get_find``     — GET /events.json?limit=100 filtered reads
 
+With ``--concurrency N`` the serial phases are replaced by a
+group-commit comparison: N persistent connections (single-threaded
+selector client, one request in flight per connection) drive
+`single_post` against the same storage twice — ingest batching OFF
+(per-event commit) then ON (write coalescer) — with durable acks in
+both phases (``--volatile-acks`` drops that for the durability-cost
+A/B), and the JSON reports both plus the speedup. Serial mode (the
+default) is unchanged for comparability with earlier rounds.
+
 Usage::
 
     python profile_events.py [--events 5000] [--storage memory|sqlite]
+    python profile_events.py --concurrency 16 --storage sqlite
 
 Prints ONE JSON line.
 """
@@ -26,6 +36,7 @@ import argparse
 import http.client
 import json
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -37,6 +48,15 @@ def main() -> None:
     ap.add_argument("--storage", default="memory",
                     choices=["memory", "sqlite", "eventlog"])
     ap.add_argument("--port", type=int, default=8791)
+    ap.add_argument("--concurrency", type=int, default=0,
+                    help="run the concurrent single_post comparison "
+                         "(ingest batching off vs on) with this many "
+                         "client threads instead of the serial phases")
+    ap.add_argument("--volatile-acks", action="store_true",
+                    help="concurrency mode only: drop the durable-ack "
+                         "(fsync-before-201) contract from BOTH phases "
+                         "— the A/B for measuring what durability "
+                         "itself costs with and without batching")
     ap.add_argument("--bulk", type=int, default=0,
                     help="additionally bulk-import this many events "
                          "through the store SPI (the `pio import` "
@@ -63,6 +83,212 @@ def main() -> None:
     app = st.meta.create_app("EventsBench")
     st.events.init_channel(app.id)
     key = st.meta.create_access_key(app.id).key
+
+    if args.concurrency:
+        # N persistent connections, one event per POST; the same
+        # storage serves both runs so backend state is identical.
+        # Both servers run with DURABLE acks by default — 201 means
+        # fsynced — the contract the group commit makes affordable (the
+        # coalescer pays one sync per batch, the per-event path one per
+        # POST). The client is a single-threaded selector loop over N
+        # raw sockets with prebuilt request bytes (the wrk model): on
+        # this one-core box, N client THREADS would burn the shared
+        # core on GIL switching and charge it to both phases, burying
+        # the server-side difference under harness overhead.
+        import selectors
+        import socket
+
+        n_threads = args.concurrency
+        per = max(1, args.events // n_threads)
+
+        def build_requests(run_key):
+            rng = np.random.default_rng(0)
+            reqs = []
+            for t in range(n_threads):
+                rs = []
+                for i in range(per):
+                    body = json.dumps(
+                        {"event": "view", "entityType": "user",
+                         "entityId": str(int(rng.integers(0, 1000))),
+                         "targetEntityType": "item",
+                         "targetEntityId": str(int(rng.integers(0, 500))),
+                         "properties": {"t": t, "n": i}}).encode()
+                    rs.append(
+                        (f"POST /events.json?accessKey={run_key} HTTP/1.1\r\n"
+                         f"Host: localhost\r\n"
+                         f"Content-Type: application/json\r\n"
+                         f"Content-Length: {len(body)}\r\n\r\n"
+                         ).encode() + body)
+                reqs.append(rs)
+            return reqs
+
+        def run_concurrent(batching: bool, port: int):
+            # fresh app (⇒ fresh table/log) per run: otherwise the
+            # second run pays index-growth costs the first didn't
+            run_app = st.meta.create_app(f"EventsBenchC{int(batching)}")
+            st.events.init_channel(run_app.id)
+            run_key = st.meta.create_access_key(run_app.id).key
+            reqs = build_requests(run_key)
+            server = EventServer(storage=st, host="127.0.0.1", port=port,
+                                 ingest_batching=batching,
+                                 durable_acks=not args.volatile_acks)
+            warmup = min(64, per)
+            total = per * n_threads
+
+            def drive():
+                """One socket per simulated client, one request in
+                flight each, single event-loop thread. Returns
+                (per-request latencies, global completion timestamps).
+                """
+                sel = selectors.DefaultSelector()
+                socks = []
+                for t in range(n_threads):
+                    s = socket.create_connection(("127.0.0.1", port),
+                                                 timeout=60)
+                    s.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+                    state = {"t": t, "sent": 0, "done": 0, "buf": b"",
+                             "clen": -1, "t0": 0.0, "sock": s}
+                    sel.register(s, selectors.EVENT_READ, state)
+                    socks.append(s)
+                lat = np.empty(total)
+                stamps = np.empty(total)
+                completed = 0
+
+                def send_next(st):
+                    t = st["t"]
+                    i = st["sent"] % per
+                    st["t0"] = time.perf_counter()
+                    st["sock"].sendall(reqs[t][i])
+                    st["sent"] += 1
+
+                def pump(goal, timed):
+                    # until every socket has completed `goal` requests
+                    nonlocal completed
+                    pending = n_threads
+                    ready = []
+                    while pending:
+                        for key, _ in sel.select():
+                            st = key.data
+                            if st["done"] >= goal:
+                                continue
+                            st["buf"] += st["sock"].recv(65536)
+                            buf = st["buf"]
+                            if st["clen"] < 0:
+                                hdr_end = buf.find(b"\r\n\r\n")
+                                if hdr_end < 0:
+                                    continue
+                                head = buf[:hdr_end]
+                                assert head[9:12] == b"201", head[:80]
+                                st["clen"] = int(
+                                    head.lower()
+                                    .split(b"content-length:")[1]
+                                    .split(b"\r\n")[0])
+                                st["buf"] = buf = buf[hdr_end + 4:]
+                            if len(buf) < st["clen"]:
+                                continue
+                            now = time.perf_counter()
+                            st["buf"] = buf[st["clen"]:]
+                            st["clen"] = -1
+                            st["done"] += 1
+                            if timed:
+                                lat[completed] = now - st["t0"]
+                                stamps[completed] = now
+                                completed += 1
+                            if st["done"] >= goal:
+                                pending -= 1
+                            else:
+                                ready.append(st)
+                        # send the next burst only after every
+                        # response in this pass is drained: clients
+                        # that finished together re-submit together
+                        for st in ready:
+                            send_next(st)
+                        ready.clear()
+
+                # warmup: tables created, caches primed, batch
+                # formation at steady state — then the timed run
+                for s in socks:
+                    send_next(sel.get_key(s).data)
+                pump(warmup, False)
+                t_run = time.perf_counter()
+                for s in socks:
+                    st = sel.get_key(s).data
+                    st["done"] = 0
+                    send_next(st)
+                pump(per, True)
+                for s in socks:
+                    sel.unregister(s)
+                    s.close()
+                sel.close()
+                return lat, stamps, t_run
+
+            with server_thread(server, port):
+                lat, stamps, t_start = drive()
+            # two timed half-windows; report the better one, so a
+            # noise spike from an unrelated process on this shared box
+            # degrades one window, not the whole estimate (symmetric
+            # for both phases)
+            mid = total // 2
+            rates = [mid / (stamps[mid - 1] - t_start),
+                     (total - mid) / (stamps[-1] - stamps[mid - 1])]
+            total_wall = stamps[-1] - t_start
+            res = {
+                "events": per * n_threads,
+                "wall_sec": round(total_wall, 3),
+                "events_per_sec": round(max(rates)),
+                "p50_ms": round(float(np.percentile(lat, 50) * 1e3), 3),
+                "p99_ms": round(float(np.percentile(lat, 99) * 1e3), 3),
+            }
+            if batching:
+                ing = server._ingest
+                res["commit_batches"] = ing.batches
+                res["mean_batch"] = round(ing.submitted / max(ing.batches, 1), 1)
+            return res
+
+        off = run_concurrent(False, args.port)
+        on = run_concurrent(True, args.port + 1)
+
+        # the same commit-amortization effect isolated at the store SPI
+        # (no HTTP, no client): per-event durable insert vs one
+        # insert_batch group commit — the upper bound the coalescer
+        # approaches as HTTP overhead shrinks
+        from predictionio_tpu.data.event import Event
+
+        st.events.set_durable(True)
+        spi_app = st.meta.create_app("EventsBenchSPI")
+        st.events.init_channel(spi_app.id)
+        spi_n = min(2000, args.events)
+        evs = [Event(event="view", entity_type="user", entity_id=str(i),
+                     target_entity_type="item", target_entity_id="x",
+                     properties={"n": i}) for i in range(2 * spi_n)]
+        t0 = time.perf_counter()
+        for e in evs[:spi_n]:
+            st.events.insert(e, spi_app.id)
+        spi_single = spi_n / (time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        GROUP = 16  # what the coalescer forms at this concurrency
+        for lo in range(spi_n, 2 * spi_n, GROUP):
+            st.events.insert_batch(evs[lo:lo + GROUP], spi_app.id)
+        spi_batch = spi_n / (time.perf_counter() - t0)
+
+        print(json.dumps({
+            "metric": "event_ingest_concurrent",
+            "storage": args.storage,
+            "concurrency": n_threads,
+            "durable_acks": not args.volatile_acks,
+            "batching_off": off,
+            "batching_on": on,
+            "speedup": round(on["events_per_sec"] / off["events_per_sec"],
+                             2),
+            "spi_group_commit": {
+                "group": GROUP,
+                "single_events_per_sec": round(spi_single),
+                "batched_events_per_sec": round(spi_batch),
+                "speedup": round(spi_batch / spi_single, 2),
+            },
+        }))
+        return
 
     server = EventServer(storage=st, host="127.0.0.1", port=args.port)
     with server_thread(server, args.port):

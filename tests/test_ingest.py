@@ -1,0 +1,464 @@
+"""Group-commit ingestion: concurrent single-POST coalescing,
+ack-after-commit durability (exactly-once across restart on every
+backend), per-event failure isolation, queue backpressure, the batch
+endpoint's single-commit fast path, and the auth TTL cache."""
+
+import asyncio
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.events import MemoryEventStore
+from predictionio_tpu.server.event_server import EventServer
+from predictionio_tpu.server.ingest import IngestOverload, WriteCoalescer
+from predictionio_tpu.storage.meta import MetaStore
+from predictionio_tpu.storage.models import MemoryModelStore
+from predictionio_tpu.storage.registry import (Storage, StorageConfig,
+                                               set_storage)
+from test_servers import ServerThread, free_port
+from test_servers import http as http_req
+
+
+def _mem_storage(events_store=None):
+    st = Storage(StorageConfig(metadata_type="MEMORY",
+                               eventdata_type="MEMORY",
+                               modeldata_type="MEMORY"))
+    st._meta = MetaStore(":memory:")
+    st._events = events_store or MemoryEventStore()
+    st._models = MemoryModelStore()
+    return st
+
+
+@pytest.fixture(params=["memory", "sqlite", "eventlog"])
+def backend(request, tmp_path):
+    """(name, Storage) per event backend; file-backed ones live under
+    tmp_path so the test can 'restart' them from disk."""
+    name = request.param
+    if name == "memory":
+        st = _mem_storage()
+    else:
+        st = Storage(StorageConfig(home=str(tmp_path),
+                                   eventdata_type=name.upper()))
+        if name == "eventlog":
+            try:
+                st.events
+            except RuntimeError as e:  # no g++ in this environment
+                pytest.skip(str(e))
+    set_storage(st)
+    yield name, st, tmp_path
+    set_storage(None)
+    try:
+        st.events.close()
+    except Exception:
+        pass
+
+
+def _post(conn, path, obj):
+    conn.request("POST", path, json.dumps(obj),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    return resp.status, (json.loads(data) if data else None), resp.headers
+
+
+def _setup_app(st, name="IngestApp"):
+    app = st.meta.create_app(name)
+    st.events.init_channel(app.id)
+    key = st.meta.create_access_key(app.id).key
+    return app, key
+
+
+class TestConcurrentDurability:
+    def test_exactly_once_after_restart(self, backend):
+        name, st, home = backend
+        app, key = _setup_app(st)
+        port = free_port()
+        server = EventServer(storage=st, host="127.0.0.1", port=port,
+                             ingest_batching=True)
+        N, M = 8, 20
+        acked = [[] for _ in range(N)]
+        errors = []
+
+        def worker(t):
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=30)
+                for m in range(M):
+                    status, body, _ = _post(
+                        conn, f"/events.json?accessKey={key}",
+                        {"event": "view", "entityType": "user",
+                         "entityId": f"u{t}", "targetEntityType": "item",
+                         "targetEntityId": f"i{m}",
+                         "properties": {"t": t, "m": m}})
+                    assert status == 201, body
+                    acked[t].append(body["eventId"])
+                conn.close()
+            except Exception as e:  # surfaced after join
+                errors.append(e)
+
+        with ServerThread(server):
+            threads = [threading.Thread(target=worker, args=(t,))
+                       for t in range(N)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        assert not errors, errors[:3]
+        all_ids = [eid for lst in acked for eid in lst]
+        assert len(all_ids) == N * M
+        assert len(set(all_ids)) == N * M
+        assert server._ingest.submitted == N * M
+
+        # 'restart': reopen the durable backends from disk
+        if name == "memory":
+            store2 = st.events
+        else:
+            st.events.close()
+            store2 = Storage(StorageConfig(
+                home=str(home), eventdata_type=name.upper())).events
+        evs = list(store2.find(app.id))
+        assert sorted(e.event_id for e in evs) == sorted(all_ids)
+
+    def test_shutdown_drains_accepted_events(self):
+        """Every event the coalescer accepted is committed by server
+        shutdown, even if its response never made it out."""
+
+        class SlowStore(MemoryEventStore):
+            def insert_batch(self, events, app_id, channel_id=None):
+                time.sleep(0.03)
+                return super().insert_batch(events, app_id, channel_id)
+
+        st = _mem_storage(SlowStore())
+        app, key = _setup_app(st)
+        port = free_port()
+        server = EventServer(storage=st, host="127.0.0.1", port=port,
+                             ingest_batching=True)
+        statuses = []
+
+        def worker(m):
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=10)
+                status, _, _ = _post(
+                    conn, f"/events.json?accessKey={key}",
+                    {"event": "view", "entityType": "user", "entityId": "u",
+                     "targetEntityType": "item", "targetEntityId": str(m)})
+                statuses.append(status)
+            except Exception:
+                pass  # shutdown may cut the connection; drain still runs
+
+        with ServerThread(server):
+            threads = [threading.Thread(target=worker, args=(m,))
+                       for m in range(10)]
+            for th in threads:
+                th.start()
+            time.sleep(0.05)  # let requests be accepted mid-commit
+        for th in threads:
+            th.join(timeout=10)
+        # the drain guarantee: accepted == committed
+        assert len(list(st.events.find(app.id))) == server._ingest.submitted
+        # and nothing acked was lost
+        assert statuses.count(201) <= server._ingest.submitted
+
+
+class TestFailureIsolation:
+    def test_poison_event_does_not_fail_siblings(self):
+        class PoisonStore(MemoryEventStore):
+            def insert(self, event, app_id, channel_id=None):
+                if event.properties.get("poison"):
+                    raise RuntimeError("poisoned event")
+                return super().insert(event, app_id, channel_id)
+
+            def insert_batch(self, events, app_id, channel_id=None):
+                if any(e.properties.get("poison") for e in events):
+                    raise RuntimeError("poisoned batch")
+                return super().insert_batch(events, app_id, channel_id)
+
+        st = _mem_storage(PoisonStore())
+        app, key = _setup_app(st)
+        port = free_port()
+        server = EventServer(storage=st, host="127.0.0.1", port=port,
+                             ingest_batching=True)
+        results = {}
+
+        def worker(m, poison):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            status, body, _ = _post(
+                conn, f"/events.json?accessKey={key}",
+                {"event": "view", "entityType": "user", "entityId": str(m),
+                 "targetEntityType": "item", "targetEntityId": "x",
+                 "properties": {"poison": poison, "m": m}})
+            results[m] = (status, body)
+            conn.close()
+
+        with ServerThread(server):
+            threads = [threading.Thread(target=worker,
+                                        args=(m, m % 4 == 0))
+                       for m in range(16)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        for m, (status, body) in results.items():
+            if m % 4 == 0:
+                assert status == 500, (m, body)
+            else:
+                assert status == 201, (m, body)
+        stored = list(st.events.find(app.id))
+        assert sorted(e.properties["m"] for e in stored) == \
+            sorted(m for m in range(16) if m % 4 != 0)
+
+
+class TestBackpressure:
+    def test_queue_full_returns_429_and_recovers(self):
+        class SlowStore(MemoryEventStore):
+            def insert_batch(self, events, app_id, channel_id=None):
+                time.sleep(0.1)
+                return super().insert_batch(events, app_id, channel_id)
+
+            def insert(self, event, app_id, channel_id=None):
+                time.sleep(0.1)
+                return super().insert(event, app_id, channel_id)
+
+        st = _mem_storage(SlowStore())
+        app, key = _setup_app(st)
+        port = free_port()
+        server = EventServer(storage=st, host="127.0.0.1", port=port,
+                             ingest_batching=True, ingest_queue_depth=2)
+        outcomes = []
+
+        def worker(m):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            status, body, headers = _post(
+                conn, f"/events.json?accessKey={key}",
+                {"event": "view", "entityType": "user", "entityId": str(m),
+                 "targetEntityType": "item", "targetEntityId": "x"})
+            outcomes.append((status, headers.get("Retry-After")))
+            conn.close()
+
+        with ServerThread(server):
+            threads = [threading.Thread(target=worker, args=(m,))
+                       for m in range(20)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            statuses = [s for s, _ in outcomes]
+            assert set(statuses) <= {201, 429}
+            assert 429 in statuses, statuses
+            for status, retry_after in outcomes:
+                if status == 429:
+                    assert retry_after is not None
+                    assert float(retry_after) >= 1
+            # recovery: once the queue drains, single POSTs succeed
+            deadline = time.time() + 10
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            while True:
+                status, body, _ = _post(
+                    conn, f"/events.json?accessKey={key}",
+                    {"event": "view", "entityType": "user",
+                     "entityId": "recovered", "targetEntityType": "item",
+                     "targetEntityId": "x"})
+                if status == 201:
+                    break
+                assert time.time() < deadline, "never recovered from 429"
+                time.sleep(0.2)
+            conn.close()
+        # only acked events were stored (shed requests wrote nothing)
+        stored = len(list(st.events.find(app.id)))
+        assert stored == [s for s, _ in outcomes].count(201) + 1
+
+
+class TestCoalescerUnit:
+    def test_groups_by_app_channel_and_coalesces(self):
+        commits = []
+
+        class RecordingStore(MemoryEventStore):
+            def insert_batch(self, events, app_id, channel_id=None):
+                commits.append((app_id, channel_id, len(events)))
+                time.sleep(0.01)  # service time → arrivals coalesce
+                return super().insert_batch(events, app_id, channel_id)
+
+        store = RecordingStore()
+
+        async def main():
+            c = WriteCoalescer(store)
+            evs = [Event(event="view", entity_type="user",
+                         entity_id=str(i), target_entity_type="item",
+                         target_entity_id="x", properties={"i": i})
+                   for i in range(40)]
+            ids = await asyncio.gather(*[
+                c.submit(e, 1, None if i % 2 else 7)
+                for i, e in enumerate(evs)])
+            assert len(set(ids)) == 40
+            # far fewer commits than events, grouped per namespace
+            assert c.batches < c.submitted
+            assert all(app == 1 for app, _, _ in commits)
+            await c.aclose()
+            return c
+
+        c = asyncio.run(main())
+        assert len(list(store.find(1, None))) == 20
+        assert len(list(store.find(1, 7))) == 20
+        assert c.submitted == 40
+
+    def test_submit_overload_raises(self):
+        class SlowStore(MemoryEventStore):
+            def insert_batch(self, events, app_id, channel_id=None):
+                time.sleep(0.05)
+                return super().insert_batch(events, app_id, channel_id)
+
+        async def main():
+            c = WriteCoalescer(SlowStore(), max_queue=1)
+            ev = Event(event="view", entity_type="user", entity_id="u",
+                       target_entity_type="item", target_entity_id="x")
+            results = await asyncio.gather(
+                *[c.submit(ev.with_id(), 1) for _ in range(6)],
+                return_exceptions=True)
+            overloads = [r for r in results if isinstance(r, IngestOverload)]
+            oks = [r for r in results if isinstance(r, str)]
+            assert overloads and oks
+            assert len(overloads) + len(oks) == 6
+            assert c.rejected == len(overloads)
+            await c.aclose()
+
+        asyncio.run(main())
+
+    def test_reusable_after_aclose(self):
+        store = MemoryEventStore()
+
+        async def main():
+            c = WriteCoalescer(store)
+            ev = Event(event="view", entity_type="user", entity_id="u",
+                       target_entity_type="item", target_entity_id="x")
+            await c.submit(ev.with_id(), 1)
+            await c.aclose()
+            # a server that stops and serves again keeps working
+            await c.submit(ev.with_id(), 1)
+            await c.aclose()
+
+        asyncio.run(main())
+        assert len(list(store.find(1))) == 2
+
+
+class TestBatchEndpointSingleCommit:
+    def _counting_storage(self):
+        class CountingStore(MemoryEventStore):
+            batch_calls = 0
+            insert_calls = 0
+
+            def insert(self, event, app_id, channel_id=None):
+                CountingStore.insert_calls += 1
+                return super().insert(event, app_id, channel_id)
+
+            def insert_batch(self, events, app_id, channel_id=None):
+                CountingStore.batch_calls += 1
+                return super().insert_batch(events, app_id, channel_id)
+
+        store = CountingStore()
+        return _mem_storage(store), store
+
+    def test_all_valid_batch_is_one_commit(self):
+        st, store = self._counting_storage()
+        app, key = _setup_app(st)
+        port = free_port()
+        with ServerThread(EventServer(storage=st, host="127.0.0.1",
+                                      port=port)):
+            base = f"http://127.0.0.1:{port}"
+            batch = [{"event": "view", "entityType": "user",
+                      "entityId": str(m), "targetEntityType": "item",
+                      "targetEntityId": "x"} for m in range(10)]
+            code, body = http_req("POST",
+                              f"{base}/batch/events.json?accessKey={key}",
+                              batch)
+            assert code == 200
+            assert [it["status"] for it in body] == [201] * 10
+            assert len({it["eventId"] for it in body}) == 10
+        assert type(store).batch_calls == 1
+        assert type(store).insert_calls == 0
+        assert len(list(st.events.find(app.id))) == 10
+
+    def test_mixed_validity_falls_back_per_event(self):
+        st, store = self._counting_storage()
+        app, key = _setup_app(st)
+        port = free_port()
+        with ServerThread(EventServer(storage=st, host="127.0.0.1",
+                                      port=port)):
+            base = f"http://127.0.0.1:{port}"
+            good = {"event": "view", "entityType": "user", "entityId": "u",
+                    "targetEntityType": "item", "targetEntityId": "x"}
+            code, body = http_req("POST",
+                              f"{base}/batch/events.json?accessKey={key}",
+                              [good, {"event": ""}, good])
+            assert code == 200
+            assert [it["status"] for it in body] == [201, 400, 201]
+        assert type(store).batch_calls == 0
+        assert type(store).insert_calls == 2
+        assert len(list(st.events.find(app.id))) == 2
+
+
+class TestAuthCache:
+    def test_hit_counter_and_epoch_invalidation(self):
+        from predictionio_tpu.utils.metrics import REGISTRY
+
+        st = _mem_storage()
+        app, key = _setup_app(st)
+        port = free_port()
+        counter = REGISTRY.counter("pio_authcache_total",
+                                   "Auth cache lookups", ("result",))
+        hits0 = counter._values.get(("hit",), 0)
+        with ServerThread(EventServer(storage=st, host="127.0.0.1",
+                                      port=port)):
+            base = f"http://127.0.0.1:{port}"
+            ev = {"event": "view", "entityType": "user", "entityId": "u",
+                  "targetEntityType": "item", "targetEntityId": "x"}
+            url = f"{base}/events.json?accessKey={key}"
+            assert http_req("POST", url, ev)[0] == 201  # miss, fills cache
+            assert http_req("POST", url, ev)[0] == 201  # hit
+            assert counter._values.get(("hit",), 0) > hits0
+            # in-process revocation is effective immediately (epoch bump)
+            st.meta.delete_access_key(key)
+            assert http_req("POST", url, ev)[0] == 401
+            # a channel created after a cached negative becomes visible
+            key2 = st.meta.create_access_key(app.id).key
+            url2 = f"{base}/events.json?accessKey={key2}&channel=late"
+            assert http_req("POST", url2, ev)[0] == 400  # negative, cached
+            ch = st.meta.create_channel(app.id, "late")
+            st.events.init_channel(app.id, ch.id)
+            assert http_req("POST", url2, ev)[0] == 201
+
+    def test_cache_disabled_with_zero_ttl(self):
+        st = _mem_storage()
+        app, key = _setup_app(st)
+        port = free_port()
+        with ServerThread(EventServer(storage=st, host="127.0.0.1",
+                                      port=port, auth_cache_ttl=0)):
+            base = f"http://127.0.0.1:{port}"
+            ev = {"event": "view", "entityType": "user", "entityId": "u",
+                  "targetEntityType": "item", "targetEntityId": "x"}
+            assert http_req("POST", f"{base}/events.json?accessKey={key}",
+                        ev)[0] == 201
+
+
+class TestWebhookThroughCoalescer:
+    def test_webhook_post_group_commits(self):
+        st = _mem_storage()
+        app, key = _setup_app(st)
+        port = free_port()
+        server = EventServer(storage=st, host="127.0.0.1", port=port,
+                             ingest_batching=True)
+        with ServerThread(server):
+            base = f"http://127.0.0.1:{port}"
+            payload = {"type": "track", "userId": "u42", "event": "signup",
+                       "properties": {"plan": "pro"}}
+            code, _ = http_req("POST",
+                           f"{base}/webhooks/segmentio.json?accessKey={key}",
+                           payload)
+            assert code == 201
+        assert server._ingest.submitted == 1
+        evs = list(st.events.find(app.id, event_names=["signup"]))
+        assert len(evs) == 1 and evs[0].entity_id == "u42"
