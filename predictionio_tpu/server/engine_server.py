@@ -5,6 +5,7 @@ akka-http; unverified, SURVEY.md §3.2). Routes preserved:
 
 - ``POST /queries.json`` → prediction JSON (the p50-critical path)
 - ``GET  /``             → engine status JSON
+- ``GET  /health``       → alive / degraded / not-ready probe
 - ``GET  /reload``       → hot-swap to the latest COMPLETED instance
 - ``GET  /stop``         → shut the server down
 - ``GET  /plugins.json`` + ``/plugins/{name}/{path}`` → plugin surface
@@ -15,12 +16,29 @@ asyncio loop never blocks on device dispatch, and the optional feedback
 loop posts served (query, prediction, prId) back to the event store —
 the reference's feedback mechanism — without touching the hot path
 (fire-and-forget task).
+
+Resilience contract (docs/operations.md "Failure modes"):
+
+- **Deadline**: with ``query_timeout_ms`` set, a query that outlives
+  its budget answers ``504`` — a hung storage backend or slow model
+  can no longer block ``/queries.json`` indefinitely.
+- **Load shedding**: with ``max_inflight`` set, requests past the cap
+  answer ``503`` + ``Retry-After`` immediately (mirror of the ingest
+  429 contract) instead of queueing without bound.
+- **Feedback breaker**: a down Event Server trips the sink's circuit
+  breaker open; feedback then drops fast (counted per cause) instead
+  of stacking HTTP timeouts two-threads deep.
+- **Hardened /reload**: the last-good engine is retained on any
+  failure; the candidate engine must answer a probe query (the last
+  successfully served one) before the swap, so a reload under live
+  traffic serves either the old or the new instance — never an error.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import threading
 import uuid
 from typing import Any, Dict, List, Optional
 
@@ -29,6 +47,12 @@ from predictionio_tpu.core.workflow import DeployedEngine, prepare_deploy
 from predictionio_tpu.data.event import Event, utcnow
 from predictionio_tpu.server.http import HTTPServer, Request, Response, Router
 from predictionio_tpu.storage.registry import Storage, get_storage
+from predictionio_tpu.utils import faults
+from predictionio_tpu.utils.resilience import (
+    OPEN,
+    CircuitBreaker,
+    CircuitOpenError,
+)
 
 
 class EngineServer:
@@ -53,6 +77,10 @@ class EngineServer:
         batching: bool = False,
         batch_max: int = 64,
         batch_wait_ms: float = 0.0,
+        query_timeout_ms: float = 0.0,
+        max_inflight: int = 0,
+        reload_probe: bool = True,
+        require_engine: bool = True,
     ) -> None:
         self.storage = storage or get_storage()
         self.engine_factory = engine_factory
@@ -71,11 +99,35 @@ class EngineServer:
             self._event_sink = HTTPEventSink(
                 feedback_url, feedback_access_key, feedback_channel)
         self.plugins = plugins if plugins is not None else engine_server_plugins()
-        self.deployed: DeployedEngine = prepare_deploy(
-            engine_factory=engine_factory, instance_id=instance_id,
-            storage=self.storage, variant_id=variant_id)
+        self.deployed: Optional[DeployedEngine] = None
+        self._load_error: Optional[str] = None
+        try:
+            self.deployed = prepare_deploy(
+                engine_factory=engine_factory, instance_id=instance_id,
+                storage=self.storage, variant_id=variant_id)
+        except Exception as e:
+            # with require_engine=False the server still comes up (and
+            # reports not-ready) so ops can deploy before the first
+            # train and /reload the model in later
+            if require_engine:
+                raise
+            self._load_error = f"{type(e).__name__}: {e}"
         self.start_time = utcnow()
         self.query_count = 0
+        self.query_timeout = max(0.0, query_timeout_ms) / 1e3
+        self.max_inflight = max(0, max_inflight)
+        self.reload_probe = reload_probe
+        #: loop-thread-only in-flight request count (handler entry to
+        #: handler exit); admission control reads it before any await
+        self._inflight = 0
+        #: guards query_count and _feedback_inflight — both are touched
+        #: from the event loop AND the feedback worker threads, so the
+        #: unlocked += the server shipped with could drift both the
+        #: 256-inflight feedback bound and the status counter
+        self._counts_lock = threading.Lock()
+        self._last_good_query: Optional[Any] = None
+        self._reload_lock: Optional[asyncio.Lock] = None
+        self.reload_generation = 0
         from predictionio_tpu.utils.metrics import REGISTRY
 
         self._m_queries = REGISTRY.counter(
@@ -84,6 +136,24 @@ class EngineServer:
             "pio_engine_query_seconds", "Query latency (handler, seconds)")
         self._m_feedback = REGISTRY.counter(
             "pio_engine_feedback_total", "Feedback events sent", ("status",))
+        self._m_shed = REGISTRY.counter(
+            "pio_engine_shed_total",
+            "Queries shed by the max-inflight cap")
+        self._m_deadline = REGISTRY.counter(
+            "pio_engine_deadline_exceeded_total",
+            "Queries that outlived query_timeout_ms")
+        self._m_reloads = REGISTRY.counter(
+            "pio_engine_reloads_total", "Reload attempts", ("result",))
+        self._m_reload_gen = REGISTRY.gauge(
+            "pio_engine_reload_generation",
+            "Engine swaps served since start (0 = the deploy-time model)")
+        self._m_reload_gen.set(0)
+        #: a down Event Server must fail FAST after a few sink errors,
+        #: not tie both feedback workers up in 5 s connect timeouts
+        self._sink_breaker = CircuitBreaker(
+            "engine_feedback_sink", failure_threshold=5, reset_timeout=10.0)
+        self._breakers: Dict[str, CircuitBreaker] = {
+            "feedback_sink": self._sink_breaker}
         self._feedback_pool = None
         self._feedback_inflight = 0
         self._batcher = None
@@ -92,11 +162,12 @@ class EngineServer:
 
             # bind late so /reload hot-swaps reach the batcher too
             self._batcher = MicroBatcher(
-                lambda qs: self.deployed.batch_query(qs),
+                self._batch_worker,
                 max_batch=batch_max, max_wait_ms=batch_wait_ms)
         router = Router()
         router.route("POST", "/queries.json", self._queries)
         router.route("GET", "/", self._status)
+        router.route("GET", "/health", self._health)
         router.route("GET", "/reload", self._reload)
         router.route("GET", "/stop", self._stop)
         router.route("GET", "/metrics", self._metrics)
@@ -111,50 +182,102 @@ class EngineServer:
                                bind_retries=bind_retries,
                                bind_retry_sec=bind_retry_sec)
 
+    # -- workers ---------------------------------------------------------------
+
+    def _query_worker(self, query: Any) -> Any:
+        faults.inject("serving.query")
+        return self.deployed.query(query)
+
+    def _batch_worker(self, queries: List[Any]) -> List[Any]:
+        faults.inject("serving.query")
+        return self.deployed.batch_query(queries)
+
     # -- handlers --------------------------------------------------------------
+
+    @staticmethod
+    def _unavailable(message: str, retry_after: float = 1.0) -> Response:
+        resp = Response.json({"message": message}, status=503)
+        resp.headers["Retry-After"] = str(max(1, round(retry_after)))
+        return resp
 
     async def _queries(self, req: Request) -> Response:
         import time
 
         t0 = time.perf_counter()
+        # admission control BEFORE any await: shedding costs ~nothing,
+        # which is the whole point — past the cap the server answers
+        # instantly instead of queueing work it cannot finish
+        if self.max_inflight and self._inflight >= self.max_inflight:
+            self._m_shed.inc()
+            self._m_queries.inc(("503",))
+            return self._unavailable(
+                f"server overloaded ({self._inflight} queries in flight)")
+        if self.deployed is None:
+            self._m_queries.inc(("503",))
+            return self._unavailable(
+                f"no engine loaded ({self._load_error}); "
+                "train and GET /reload")
+        self._inflight += 1
+        try:
+            status, resp = await self._query_once(req)
+        finally:
+            self._inflight -= 1
+        self._m_queries.inc((status,))
+        # the latency histogram observes EVERY outcome — the 400/500
+        # (and 504) tails are exactly the slow failures worth seeing
+        self._m_latency.observe(time.perf_counter() - t0)
+        return resp
+
+    async def _query_once(self, req: Request) -> "tuple[str, Response]":
         try:
             query = req.json()
         except json.JSONDecodeError as e:
-            self._m_queries.inc(("400",))
-            return Response.json({"message": f"invalid JSON: {e}"}, status=400)
+            return "400", Response.json(
+                {"message": f"invalid JSON: {e}"}, status=400)
         if query is None:
-            self._m_queries.inc(("400",))
-            return Response.json({"message": "empty query"}, status=400)
+            return "400", Response.json({"message": "empty query"}, status=400)
         try:
             if self._batcher is not None:
-                prediction = await self._batcher.submit(query)
+                work = self._batcher.submit(query)
             else:
-                prediction = await asyncio.to_thread(self.deployed.query, query)
+                work = asyncio.to_thread(self._query_worker, query)
+            if self.query_timeout > 0:
+                prediction = await asyncio.wait_for(work, self.query_timeout)
+            else:
+                prediction = await work
+        except asyncio.TimeoutError:
+            # the worker thread may still be running; admission control
+            # above bounds how many such stragglers can pile up
+            self._m_deadline.inc()
+            return "504", Response.json(
+                {"message": "query deadline exceeded "
+                            f"({self.query_timeout * 1e3:.0f} ms)"},
+                status=504)
         except (ValueError, KeyError, TypeError) as e:
             # malformed/invalid query (bad fields, unknown entity, wrong types)
-            self._m_queries.inc(("400",))
-            return Response.json(
-                {"message": f"query failed: {type(e).__name__}: {e}"}, status=400)
+            return "400", Response.json(
+                {"message": f"query failed: {type(e).__name__}: {e}"},
+                status=400)
         except Exception as e:
             # internal fault; retryable, so 500 (the reference returns
             # 500 on server faults). Micro-batch failures are isolated
             # per-query by the batcher, so a malformed query still
             # surfaces as its own ValueError → 400 above.
-            self._m_queries.inc(("500",))
-            return Response.json(
-                {"message": f"server error: {type(e).__name__}: {e}"}, status=500)
-        self._m_queries.inc(("200",))
-        self._m_latency.observe(time.perf_counter() - t0)
+            return "500", Response.json(
+                {"message": f"server error: {type(e).__name__}: {e}"},
+                status=500)
         for p in self.plugins:
             prediction = p.output_blocker(query, prediction)
             p.output_sniffer(query, prediction)
-        self.query_count += 1
+        with self._counts_lock:
+            self.query_count += 1
+        self._last_good_query = query
         if self.feedback:
             pr_id = uuid.uuid4().hex
             if isinstance(prediction, dict):
                 prediction = {**prediction, "prId": pr_id}
             self._submit_feedback(query, prediction, pr_id)
-        return Response.json(prediction)
+        return "200", Response.json(prediction)
 
     def _submit_feedback(self, query: Any, prediction: Any,
                          pr_id: str) -> None:
@@ -167,16 +290,22 @@ class EngineServer:
         if self._feedback_pool is None:
             self._feedback_pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=2, thread_name_prefix="pio-feedback")
-        if self._feedback_inflight >= 256:
+        with self._counts_lock:
+            if self._feedback_inflight >= 256:
+                drop = True
+            else:
+                drop = False
+                self._feedback_inflight += 1
+        if drop:
             self._m_feedback.inc(("dropped",))
             return
-        self._feedback_inflight += 1
 
         def run():
             try:
                 self._record_feedback(query, prediction, pr_id)
             finally:
-                self._feedback_inflight -= 1
+                with self._counts_lock:
+                    self._feedback_inflight -= 1
 
         self._feedback_pool.submit(run)
 
@@ -201,22 +330,33 @@ class EngineServer:
         tagged with prId, delivered through the configured sink —
         the Event Server's authenticated HTTP API when a feedback URL
         is set (reference: CreateServer feedback, SURVEY.md §3.2), else
-        a direct local write."""
+        a direct local write. Delivery runs through the sink breaker:
+        repeated failures trip it open and subsequent feedback drops
+        fast (counted as breaker_open) until the sink recovers."""
         try:
             sink = self._sink()
             if sink is None:
                 return
-            sink.send(Event(
+            self._sink_breaker.call(sink.send, Event(
                 event="predict",
                 entity_type="pio_pr", entity_id=pr_id,
                 properties={"query": query, "prediction": prediction},
                 pr_id=pr_id,
             ))
             self._m_feedback.inc(("ok",))
+        except CircuitOpenError:
+            self._m_feedback.inc(("breaker_open",))
         except Exception:
             self._m_feedback.inc(("error",))  # never breaks serving
 
     async def _status(self, req: Request) -> Response:
+        if self.deployed is None:
+            return Response.json({
+                "status": "not-ready",
+                "message": self._load_error,
+                "startTime": self.start_time.isoformat(timespec="milliseconds"),
+                "queryCount": self.query_count,
+            })
         ei = self.deployed.instance
         return Response.json({
             "status": "alive",
@@ -228,17 +368,94 @@ class EngineServer:
             "algorithms": [name for name, _ in self.deployed.algorithms],
         })
 
+    async def _health(self, req: Request) -> Response:
+        """Liveness/readiness for supervisors and load balancers.
+
+        - ``200 {"status": "ok"}``       — serving, all breakers closed
+        - ``200 {"status": "degraded"}`` — serving, but a dependency
+          breaker is open or the server is at its inflight cap; a
+          supervisor must NOT restart on this (restarting doesn't fix
+          a down dependency), which is why degraded stays < 500
+        - ``503 {"status": "not-ready"}``— no engine loaded yet
+        """
+        open_breakers = [n for n, b in self._breakers.items()
+                         if b.state == OPEN]
+        at_capacity = bool(self.max_inflight
+                           and self._inflight >= self.max_inflight)
+        body = {
+            "breakers": {n: b.state for n, b in self._breakers.items()},
+            "inflight": self._inflight,
+            "reloadGeneration": self.reload_generation,
+        }
+        if self.deployed is None:
+            return Response.json(
+                {"status": "not-ready", "reason": self._load_error, **body},
+                status=503)
+        if open_breakers or at_capacity:
+            reason = ("breaker open: " + ",".join(open_breakers)
+                      if open_breakers else "at inflight capacity")
+            return Response.json(
+                {"status": "degraded", "reason": reason, **body})
+        return Response.json({"status": "ok", **body})
+
+    def _probe_worker(self, candidate: DeployedEngine, probe: Any) -> None:
+        faults.inject("serving.reload")
+        candidate.query(probe)
+
     async def _reload(self, req: Request) -> Response:
-        """Hot-swap to the latest COMPLETED instance (reference: /reload)."""
-        factory = self.engine_factory or self.deployed.instance.engine_factory
-        try:
-            new = await asyncio.to_thread(
-                prepare_deploy, factory, None, self.storage, self.variant_id)
-        except Exception as e:
-            return Response.json({"message": f"reload failed: {e}"}, status=500)
-        self.deployed = new
-        return Response.json({"message": "Reloaded",
-                              "engineInstanceId": new.instance.id})
+        """Hot-swap to the latest COMPLETED instance (reference: /reload).
+
+        Hardened: reloads are serialized; the last-good engine keeps
+        serving throughout; the candidate must answer a probe query
+        (the last successfully served one) before the swap. A candidate
+        that loads but cannot serve therefore never becomes live —
+        equivalent to an automatic rollback, minus the window where
+        live traffic could have hit the broken engine.
+        """
+        if self._reload_lock is None:
+            self._reload_lock = asyncio.Lock()
+        async with self._reload_lock:
+            factory = self.engine_factory or (
+                self.deployed.instance.engine_factory
+                if self.deployed is not None else None)
+            if factory is None:
+                self._m_reloads.inc(("failed",))
+                return Response.json(
+                    {"message": "reload failed: no engine factory known"},
+                    status=500)
+            try:
+                new = await asyncio.to_thread(
+                    prepare_deploy, factory, None, self.storage,
+                    self.variant_id)
+            except Exception as e:
+                self._m_reloads.inc(("failed",))
+                return Response.json(
+                    {"message": f"reload failed: {e}"}, status=500)
+            probe = self._last_good_query
+            if self.reload_probe and probe is not None:
+                try:
+                    work = asyncio.to_thread(self._probe_worker, new, probe)
+                    if self.query_timeout > 0:
+                        await asyncio.wait_for(work, self.query_timeout)
+                    else:
+                        await work
+                except Exception as e:
+                    old = self.deployed
+                    self._m_reloads.inc(("rolled_back",))
+                    kept = (old.instance.id if old is not None else None)
+                    return Response.json(
+                        {"message": "reload rolled back: probe query failed: "
+                                    f"{type(e).__name__}: {e}",
+                         "engineInstanceId": kept},
+                        status=500)
+            self.deployed = new
+            self.reload_generation += 1
+            self._m_reload_gen.set(self.reload_generation)
+            self._m_reloads.inc(("ok",))
+            self._load_error = None
+            return Response.json({"message": "Reloaded",
+                                  "engineInstanceId": new.instance.id,
+                                  "reloadGeneration": self.reload_generation})
 
     async def _stop(self, req: Request) -> Response:
         asyncio.get_running_loop().call_later(0.05, self.http.request_shutdown)

@@ -33,7 +33,7 @@ from predictionio_tpu.data.event import (
     utcnow,
 )
 from predictionio_tpu.server.http import HTTPServer, Request, Response, Router
-from predictionio_tpu.server.ingest import IngestOverload
+from predictionio_tpu.server.ingest import IngestOverload, StorageUnavailable
 from predictionio_tpu.storage.registry import Storage, get_storage
 
 BATCH_LIMIT = 50
@@ -185,6 +185,7 @@ class EventServer:
                             if auth_cache_ttl > 0 else None)
         router = Router()
         router.route("GET", "/", self._status)
+        router.route("GET", "/health", self._health)
         router.route("GET", "/metrics", self._metrics)
         router.route("POST", "/events.json", self._post_event)
         router.route("GET", "/events.json", self._get_events)
@@ -244,6 +245,28 @@ class EventServer:
 
     async def _status(self, req: Request) -> Response:
         return Response.json({"status": "alive"})
+
+    async def _health(self, req: Request) -> Response:
+        """Liveness/readiness: ``ok`` when storage is reachable,
+        ``degraded`` (still 200 — supervisors must not restart a server
+        that is shedding correctly) while the ingest storage breaker is
+        open or the queue is backed up."""
+        body: Dict[str, Any] = {"status": "ok"}
+        if self._ingest is not None:
+            breaker = self._ingest.breaker
+            body["ingest"] = {
+                "queueDepth": self._ingest.depth,
+                "breaker": breaker.state,
+                "rejected": self._ingest.rejected,
+                "breakerRejected": self._ingest.breaker_rejected,
+            }
+            if breaker.state != "closed":
+                body["status"] = "degraded"
+                body["reason"] = "ingest storage circuit breaker open"
+            elif self._ingest.depth >= self._ingest.max_queue:
+                body["status"] = "degraded"
+                body["reason"] = "ingest queue at capacity"
+        return Response.json(body)
 
     @staticmethod
     def _created(eid: str) -> Response:
@@ -322,6 +345,12 @@ class EventServer:
         except IngestOverload as e:
             self._m_events.inc((app_id, 429))
             resp = Response.json({"message": str(e)}, status=429)
+            resp.headers["Retry-After"] = str(max(1, round(e.retry_after)))
+            return resp
+        except StorageUnavailable as e:
+            # storage breaker open: fail fast, don't queue doomed work
+            self._m_events.inc((app_id, 503))
+            resp = Response.json({"message": str(e)}, status=503)
             resp.headers["Retry-After"] = str(max(1, round(e.retry_after)))
             return resp
         except Exception as e:

@@ -16,6 +16,16 @@ access key enforces the app's write contract. The sink is injectable:
 
 Sinks run off the serving hot path (fire-and-forget worker thread) and
 must never raise into the caller; failures are counted, not fatal.
+
+Resilience: :class:`HTTPEventSink` retries transient failures with
+exponential backoff + full jitter, and accepts an optional
+:class:`~predictionio_tpu.utils.resilience.CircuitBreaker` for
+standalone use — a down Event Server then fails fast with
+``CircuitOpenError`` instead of paying the connect timeout per event.
+(The engine server wraps whatever sink it is given in its own
+``engine_feedback_sink`` breaker, reported on ``/health``, so it does
+not pass one here.) The ``eventsink.send`` fault-injection site covers
+both sinks.
 """
 
 from __future__ import annotations
@@ -28,6 +38,8 @@ from abc import ABC, abstractmethod
 from typing import Any, Dict, Optional
 
 from predictionio_tpu.data.event import Event
+from predictionio_tpu.utils import faults
+from predictionio_tpu.utils.resilience import CircuitBreaker, retry_with_backoff
 
 
 class EventSink(ABC):
@@ -44,13 +56,18 @@ class HTTPEventSink(EventSink):
 
     def __init__(self, url: str, access_key: str,
                  channel: Optional[str] = None,
-                 timeout: float = 5.0) -> None:
+                 timeout: float = 5.0,
+                 retries: int = 2,
+                 breaker: Optional[CircuitBreaker] = None) -> None:
         self.url = url.rstrip("/")
         self.access_key = access_key
         self.channel = channel
         self.timeout = timeout
+        self.retries = retries
+        self.breaker = breaker
 
-    def send(self, event: Event) -> None:
+    def _post(self, event: Event) -> None:
+        faults.inject("eventsink.send")
         qs: Dict[str, str] = {"accessKey": self.access_key}
         if self.channel:
             qs["channel"] = self.channel
@@ -59,9 +76,31 @@ class HTTPEventSink(EventSink):
             data=json.dumps(event.to_json()).encode("utf-8"),
             headers={"Content-Type": "application/json"},
             method="POST")
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            if resp.status not in (200, 201):
-                raise RuntimeError(f"event server returned {resp.status}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                if resp.status not in (200, 201):
+                    raise RuntimeError(f"event server returned {resp.status}")
+        except urllib.error.HTTPError as e:
+            if e.code < 500:
+                # deterministic rejection (bad key, bad event): raise a
+                # type outside retry_on so it is NOT retried
+                raise ValueError(
+                    f"event server rejected feedback: {e.code}") from e
+            raise RuntimeError(f"event server returned {e.code}") from e
+
+    def send(self, event: Event) -> None:
+        # retry transient delivery failures (short, jittered — feedback
+        # is best-effort and must not occupy its worker for long), but
+        # NOT client errors: a 4xx (bad key, bad event) is deterministic
+        # and retrying it just hammers the Event Server
+        attempt = retry_with_backoff(
+            self.retries, base=0.05, cap=0.5,
+            retry_on=(OSError, RuntimeError),
+        )(self._post)
+        if self.breaker is not None:
+            self.breaker.call(attempt, event)
+        else:
+            attempt(event)
 
 
 class DirectEventSink(EventSink):
@@ -72,6 +111,7 @@ class DirectEventSink(EventSink):
         self.app_name = app_name
 
     def send(self, event: Event) -> None:
+        faults.inject("eventsink.send")
         app = self.storage.meta.get_app_by_name(self.app_name)
         if app is None:
             raise ValueError(f"no app named {self.app_name!r}")

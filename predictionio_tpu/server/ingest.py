@@ -29,6 +29,13 @@ Design mirrors :class:`~predictionio_tpu.server.batching.MicroBatcher`
   events, ``submit`` raises :class:`IngestOverload`; the HTTP layer
   maps it to ``429`` + ``Retry-After`` instead of letting the queue
   grow without bound under a traffic spike.
+- **Storage circuit breaker.** Repeated group-commit failures trip
+  the ``ingest_storage`` breaker open; further submits fail
+  IMMEDIATELY with :class:`StorageUnavailable` (HTTP layer → ``503``
+  + ``Retry-After``) instead of queueing events that are doomed to
+  time out against a down backend. Half-open trial commits close it
+  again once storage recovers. Poison events do NOT trip it: a failed
+  group whose per-event rerun succeeds proves storage is up.
 - **Clean drain on shutdown.** ``aclose()`` refuses new work, lets
   the committer finish everything already accepted, then commits any
   remainder itself — no accepted (let alone acked) event is lost.
@@ -45,6 +52,8 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from predictionio_tpu.data.event import Event
+from predictionio_tpu.utils import faults
+from predictionio_tpu.utils.resilience import CircuitBreaker
 
 _BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
@@ -63,6 +72,17 @@ class IngestOverload(Exception):
         self.depth = depth
         self.limit = limit
         self.retry_after = retry_after
+
+
+class StorageUnavailable(Exception):
+    """The storage breaker is open: event storage is known-down, fail
+    fast (HTTP layer → 503 + Retry-After) instead of queueing work."""
+
+    def __init__(self, retry_after: float) -> None:
+        super().__init__(
+            "event storage unavailable (circuit breaker open, "
+            f"retry after {retry_after:.1f}s)")
+        self.retry_after = max(1.0, retry_after)
 
 
 class WriteCoalescer:
@@ -87,6 +107,11 @@ class WriteCoalescer:
         self.batches = 0      # group commits issued
         self.isolations = 0   # failed groups re-run event-by-event
         self.rejected = 0     # submits refused by backpressure
+        self.breaker_rejected = 0  # submits refused by the open breaker
+        #: repeated commit failures → open → fast 503s. Decoupled use
+        #: (admit at submit, record at commit) — see CircuitBreaker doc.
+        self.breaker = CircuitBreaker(
+            "ingest_storage", failure_threshold=8, reset_timeout=5.0)
         from predictionio_tpu.utils.metrics import REGISTRY
 
         self._m_depth = REGISTRY.gauge(
@@ -131,6 +156,10 @@ class WriteCoalescer:
         per-event storage error)."""
         if self._closed:
             raise RuntimeError("ingest coalescer is closed")
+        if not self.breaker.admit():
+            self.breaker_rejected += 1
+            self._m_rejected.inc()
+            raise StorageUnavailable(self.breaker.retry_after())
         if self._queue.qsize() >= self.max_queue:
             self.rejected += 1
             self._m_rejected.inc()
@@ -189,6 +218,16 @@ class WriteCoalescer:
             if stop:
                 return
 
+    def _insert_batch_guarded(self, events: List[Event], app_id: int,
+                              channel_id: Optional[int]) -> List[str]:
+        faults.inject("ingest.commit")
+        return self.store.insert_batch(events, app_id, channel_id)
+
+    def _insert_one_guarded(self, event: Event, app_id: int,
+                            channel_id: Optional[int]) -> str:
+        faults.inject("ingest.commit")
+        return self.store.insert(event, app_id, channel_id)
+
     async def _commit(self, items: List[tuple]) -> None:
         """Group by (app, channel), one ``insert_batch`` per group."""
         groups: Dict[Tuple[int, Optional[int]], List[tuple]] = {}
@@ -202,12 +241,13 @@ class WriteCoalescer:
             t0 = time.perf_counter()
             try:
                 ids = await loop.run_in_executor(
-                    ex, self.store.insert_batch, events, app_id, channel_id)
+                    ex, self._insert_batch_guarded, events, app_id, channel_id)
                 if len(ids) != len(events):
                     raise RuntimeError(
                         f"insert_batch returned {len(ids)} ids for "
                         f"{len(events)} events")
             except Exception as e:
+                self.breaker.record_failure()
                 if len(pairs) == 1:
                     if not pairs[0][1].done():
                         pairs[0][1].set_exception(e)
@@ -220,14 +260,19 @@ class WriteCoalescer:
                         continue
                     try:
                         eid = await loop.run_in_executor(
-                            ex, self.store.insert, event, app_id, channel_id)
+                            ex, self._insert_one_guarded, event, app_id,
+                            channel_id)
                     except Exception as single_e:
                         if not fut.done():
                             fut.set_exception(single_e)
                     else:
+                        # storage demonstrably works — the group failure
+                        # was a poison event, not an outage
+                        self.breaker.record_success()
                         if not fut.done():
                             fut.set_result(eid)
                 continue
+            self.breaker.record_success()
             self._m_commit.observe(time.perf_counter() - t0)
             self._m_batch.observe(len(events))
             if len(events) > 1:

@@ -21,14 +21,60 @@ from __future__ import annotations
 
 import os
 import re
-from typing import List, Optional
+from typing import Any, Callable, List, Optional
 
 from predictionio_tpu.storage.models import ModelStore
+from predictionio_tpu.utils import faults
+from predictionio_tpu.utils.resilience import CircuitBreaker, retry_with_backoff
 
 
 class StorageClientError(RuntimeError):
     """Backend selected but unusable (missing driver / bad config) —
     reference: StorageClientException."""
+
+
+class _ResilientCalls:
+    """Retry + circuit-breaker wrapping shared by the network model
+    stores: transient faults are retried with backoff + full jitter,
+    repeated failures trip the store's breaker open so model fetches
+    fail fast (``CircuitOpenError``) instead of stacking SDK timeouts —
+    a serving-path ``/reload`` against a dead object store then answers
+    in milliseconds, not after minutes of retry stacking.
+
+    Each call also passes the store's named fault-injection site, so a
+    hung or down S3/HDFS is reproducible in tests and in
+    ``profile_serving.py --fault``.
+    """
+
+    #: per-store-type breakers are shared across instances of the same
+    #: backend — two handles on one dead S3 endpoint should learn from
+    #: each other's failures
+    _breakers: dict = {}
+
+    def _init_resilience(self, kind: str, retries: int = 2) -> None:
+        self._fault_site = f"models.{kind}"
+        self._retries = retries
+        breaker = _ResilientCalls._breakers.get(kind)
+        if breaker is None:
+            breaker = CircuitBreaker(f"model_store_{kind}",
+                                     failure_threshold=4, reset_timeout=15.0)
+            _ResilientCalls._breakers[kind] = breaker
+        self.breaker = breaker
+
+    def _call(self, fn: Callable[[], Any]) -> Any:
+        site = self._fault_site
+
+        def guarded() -> Any:
+            # the fault fires INSIDE the breaker so injected failures
+            # trip it exactly like real ones
+            faults.inject(site)
+            return fn()
+
+        def attempt() -> Any:
+            return self.breaker.call(guarded)
+
+        return retry_with_backoff(
+            self._retries, base=0.05, cap=1.0)(attempt)()
 
 
 def _source_env(key: str, default: str = "") -> str:
@@ -45,7 +91,7 @@ def _source_env(key: str, default: str = "") -> str:
     return default
 
 
-class S3ModelStore(ModelStore):
+class S3ModelStore(_ResilientCalls, ModelStore):
     """Model blobs on S3 (reference: [U] storage/s3/ S3Models).
 
     ``props`` = the backing source's settings (StorageConfig
@@ -71,42 +117,52 @@ class S3ModelStore(ModelStore):
         self.base = (base_path or props.get("BASE_PATH")
                      or _source_env("BASE_PATH", "pio_models")).strip("/")
         self._s3 = boto3.client("s3")
+        self._init_resilience("s3")
 
     def _key(self, instance_id: str) -> str:
         return f"{self.base}/{instance_id}.bin"
 
     def put(self, instance_id: str, blob: bytes) -> None:
-        self._s3.put_object(Bucket=self.bucket, Key=self._key(instance_id),
-                            Body=blob)
+        self._call(lambda: self._s3.put_object(
+            Bucket=self.bucket, Key=self._key(instance_id), Body=blob))
 
     def get(self, instance_id: str) -> Optional[bytes]:
-        try:
-            r = self._s3.get_object(Bucket=self.bucket,
-                                    Key=self._key(instance_id))
-        except self._s3.exceptions.NoSuchKey:
-            return None
-        return r["Body"].read()
+        def fetch() -> Optional[bytes]:
+            # a missing key is a RESULT, not a fault: kept inside the
+            # guarded call so it neither retries nor trips the breaker
+            try:
+                r = self._s3.get_object(Bucket=self.bucket,
+                                        Key=self._key(instance_id))
+            except self._s3.exceptions.NoSuchKey:
+                return None
+            return r["Body"].read()
+
+        return self._call(fetch)
 
     def delete(self, instance_id: str) -> bool:
-        self._s3.delete_object(Bucket=self.bucket, Key=self._key(instance_id))
+        self._call(lambda: self._s3.delete_object(
+            Bucket=self.bucket, Key=self._key(instance_id)))
         return True
 
     def list_ids(self) -> List[str]:
-        out, token = [], None
-        while True:
-            kw = {"Bucket": self.bucket, "Prefix": self.base + "/"}
-            if token:
-                kw["ContinuationToken"] = token
-            r = self._s3.list_objects_v2(**kw)
-            out += [o["Key"][len(self.base) + 1:-4]
-                    for o in r.get("Contents", ())
-                    if o["Key"].endswith(".bin")]
-            if not r.get("IsTruncated"):
-                return out
-            token = r.get("NextContinuationToken")
+        def scan() -> List[str]:
+            out, token = [], None
+            while True:
+                kw = {"Bucket": self.bucket, "Prefix": self.base + "/"}
+                if token:
+                    kw["ContinuationToken"] = token
+                r = self._s3.list_objects_v2(**kw)
+                out += [o["Key"][len(self.base) + 1:-4]
+                        for o in r.get("Contents", ())
+                        if o["Key"].endswith(".bin")]
+                if not r.get("IsTruncated"):
+                    return out
+                token = r.get("NextContinuationToken")
+
+        return self._call(scan)
 
 
-class HDFSModelStore(ModelStore):
+class HDFSModelStore(_ResilientCalls, ModelStore):
     """Model blobs on HDFS via pyarrow (reference: [U] storage/hdfs/
     HDFSModels). Needs libhdfs (a Hadoop install) at runtime."""
 
@@ -130,41 +186,52 @@ class HDFSModelStore(ModelStore):
             raise StorageClientError(
                 f"cannot reach HDFS at {host}:{port} (libhdfs present?): {e}"
             ) from e
+        self._init_resilience("hdfs")
 
     def _key(self, instance_id: str) -> str:
         return f"{self.root}/{instance_id}.bin"
 
     def put(self, instance_id: str, blob: bytes) -> None:
-        from pyarrow import fs
+        def write() -> None:
+            self._fs.create_dir(self.root, recursive=True)
+            with self._fs.open_output_stream(self._key(instance_id)) as f:
+                f.write(blob)
 
-        self._fs.create_dir(self.root, recursive=True)
-        with self._fs.open_output_stream(self._key(instance_id)) as f:
-            f.write(blob)
+        self._call(write)
 
     def get(self, instance_id: str) -> Optional[bytes]:
         from pyarrow import fs
 
-        info = self._fs.get_file_info(self._key(instance_id))
-        if info.type == fs.FileType.NotFound:
-            return None
-        with self._fs.open_input_stream(self._key(instance_id)) as f:
-            return f.read()
+        def read() -> Optional[bytes]:
+            info = self._fs.get_file_info(self._key(instance_id))
+            if info.type == fs.FileType.NotFound:
+                return None
+            with self._fs.open_input_stream(self._key(instance_id)) as f:
+                return f.read()
+
+        return self._call(read)
 
     def delete(self, instance_id: str) -> bool:
         from pyarrow import fs
 
-        info = self._fs.get_file_info(self._key(instance_id))
-        if info.type == fs.FileType.NotFound:
-            return False
-        self._fs.delete_file(self._key(instance_id))
-        return True
+        def remove() -> bool:
+            info = self._fs.get_file_info(self._key(instance_id))
+            if info.type == fs.FileType.NotFound:
+                return False
+            self._fs.delete_file(self._key(instance_id))
+            return True
+
+        return self._call(remove)
 
     def list_ids(self) -> List[str]:
         from pyarrow import fs
 
-        sel = fs.FileSelector(self.root, allow_not_found=True)
-        return [i.base_name[:-4] for i in self._fs.get_file_info(sel)
-                if i.base_name.endswith(".bin")]
+        def scan() -> List[str]:
+            sel = fs.FileSelector(self.root, allow_not_found=True)
+            return [i.base_name[:-4] for i in self._fs.get_file_info(sel)
+                    if i.base_name.endswith(".bin")]
+
+        return self._call(scan)
 
 
 def _sql_dialect(type_name: str, cfg, repo: str):

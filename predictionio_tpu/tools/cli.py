@@ -155,6 +155,8 @@ def cmd_deploy(args: argparse.Namespace) -> None:
         batching=args.batching,
         batch_max=args.batch_max,
         batch_wait_ms=args.batch_wait_ms,
+        query_timeout_ms=args.query_timeout_ms,
+        max_inflight=args.max_inflight,
     )
     print(f"[info] Engine Server (instance {server.deployed.instance.id}) "
           f"listening on {args.ip}:{args.port}")
@@ -493,6 +495,14 @@ def build_parser() -> argparse.ArgumentParser:
     dp.add_argument("--batch-wait-ms", type=float, default=0.0,
                     help="opt-in batch-formation wait; 0 = drain-only "
                          "continuous batching (default)")
+    dp.add_argument("--query-timeout-ms", type=float, default=0.0,
+                    help="per-request deadline for /queries.json; a query "
+                         "still running at the deadline returns 504 "
+                         "(0 = no deadline)")
+    dp.add_argument("--max-inflight", type=int, default=0,
+                    help="concurrent query cap; excess requests are shed "
+                         "immediately with 503 + Retry-After "
+                         "(0 = unlimited)")
     dp.set_defaults(fn=cmd_deploy)
 
     ud = sub.add_parser("undeploy", help="stop a running engine server")

@@ -14,7 +14,12 @@ equivalent is split the unix way:
   wrap around any server verb:
 
   * restarts the child when it exits unexpectedly, with exponential
-    backoff that resets after a stable period;
+    backoff + equal jitter (shared
+    :func:`~predictionio_tpu.utils.resilience.backoff_delays` schedule
+    — a fleet of supervised servers crashing on one bad dependency
+    must not restart in lockstep) that resets after a stable period;
+    the backoff sleep is interruptible, so SIGTERM during a long
+    backoff stops promptly instead of after ``backoff_max`` seconds;
   * optional HTTP health checks (``GET health_url`` expecting < 500)
     — a wedged-but-alive server gets killed and restarted;
   * a restart budget within a rolling window, so a crash loop ends in
@@ -31,7 +36,9 @@ import sys
 import time
 import urllib.error
 import urllib.request
-from typing import List, Optional, Sequence
+from typing import Iterator, List, Optional, Sequence
+
+from predictionio_tpu.utils.resilience import backoff_delays
 
 
 def _log(*args) -> None:
@@ -70,6 +77,7 @@ class Supervisor:
         self._child: Optional[subprocess.Popen] = None
         self._stopping = False
         self.restarts = 0
+        self.last_backoff = 0.0  # most recent restart delay (for logs/tests)
         self._restart_times: List[float] = []
 
     # -- child lifecycle -------------------------------------------------------
@@ -106,6 +114,24 @@ class Supervisor:
                                if now - t <= self.restart_window]
         return len(self._restart_times) >= self.max_restarts
 
+    def _new_delays(self) -> Iterator[float]:
+        """Fresh restart-backoff schedule: exponential from ``backoff``
+        to ``backoff_max`` with equal jitter (half deterministic, half
+        random) — late enough to matter, never below half the target."""
+        return backoff_delays(self.backoff, self.backoff_max, jitter="equal")
+
+    def _sleep(self, seconds: float) -> bool:
+        """Interruptible sleep: returns False the moment ``stop()`` (or
+        a signal) flips ``_stopping`` — a SIGTERM mid-backoff must not
+        wait out the remaining delay."""
+        deadline = time.monotonic() + seconds
+        while not self._stopping:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return True
+            time.sleep(min(0.2, left))
+        return False
+
     # -- main loop -------------------------------------------------------------
 
     def run(self) -> int:
@@ -131,7 +157,7 @@ class Supervisor:
             self._spawn()
             started = time.monotonic()
             last_health = started
-            cur_backoff = self.backoff
+            delays: Optional[Iterator[float]] = None  # None = fresh schedule
             while not self._stopping:
                 code = self._child.poll() if self._child else None
                 now = time.monotonic()
@@ -164,15 +190,20 @@ class Supervisor:
                         return 1
                     self._restart_times.append(now)
                     self.restarts += 1
-                    time.sleep(cur_backoff)
-                    cur_backoff = min(cur_backoff * 2, self.backoff_max)
+                    if delays is None:
+                        delays = self._new_delays()
+                    self.last_backoff = next(delays)
+                    self.log(f"[supervise] restarting in "
+                             f"{self.last_backoff:.2f}s")
+                    if not self._sleep(self.last_backoff):
+                        break  # stop requested mid-backoff
                     self._spawn()
                     started = time.monotonic()
                     last_health = started
                 else:
                     if (self._child is not None
                             and now - started > 2 * max(self.backoff, 1.0)):
-                        cur_backoff = self.backoff  # stable → reset backoff
+                        delays = None  # stable → reset backoff schedule
                     time.sleep(0.2)
             self._terminate_child()
             return 0

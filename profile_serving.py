@@ -26,6 +26,17 @@ Usage::
 
     python profile_serving.py [--queries 2000] [--platform cpu|tpu]
 
+Fault-injection mode (the acceptance harness for docs/operations.md
+"Failure modes and degradation")::
+
+    python profile_serving.py --fault "eventsink.send:error=down"
+
+measures a healthy baseline, arms the given ``PIO_FAULTS``-style spec,
+re-runs the same load with feedback enabled, and reports the p50
+ratio, per-status counts, feedback counters, and breaker states —
+e.g. under a sustained sink failure the ``engine_feedback_sink``
+breaker must open and serving p50 must stay within 2x of healthy.
+
 Prints ONE JSON line. On this image's tunneled TPU every device→host
 fetch after the first pays a ~66 ms relay round trip (BASELINE.md
 note) — run with ``--platform cpu`` for the HTTP/host shares and on a
@@ -123,6 +134,77 @@ def _client_proc(port: int, n_users: int, n: int, seed: int, outq) -> None:
         outq.put(f"client {seed}: {type(e).__name__}: {e}")
 
 
+def run_fault_mode(args, st, factory) -> None:
+    """Healthy baseline vs the same load under an armed fault spec."""
+    from predictionio_tpu.server.engine_server import EngineServer
+    from predictionio_tpu.utils.faults import FAULTS
+    from profile_common import server_thread
+
+    # the feedback loop's DirectEventSink resolves the app named in the
+    # instance's data-source params — it must exist for feedback to land
+    st.meta.create_app("ProfileApp")
+    server = EngineServer(
+        engine_factory=factory, storage=st,
+        host="127.0.0.1", port=args.port,
+        feedback=True,
+        query_timeout_ms=args.fault_timeout_ms,
+        max_inflight=args.max_inflight)
+    rng = np.random.default_rng(2)
+
+    def run_pass(n: int):
+        conn = http.client.HTTPConnection("127.0.0.1", args.port, timeout=10)
+        lats, statuses = [], {}
+        for _ in range(n):
+            body = json.dumps(
+                {"user": str(int(rng.integers(0, args.n_users))), "num": 10})
+            t0 = time.perf_counter()
+            try:
+                conn.request("POST", "/queries.json", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                status = resp.status
+            except Exception:
+                # timed-out/reset connection: reconnect, count as 0
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", args.port,
+                                                  timeout=10)
+                status = 0
+            lats.append(time.perf_counter() - t0)
+            statuses[str(status)] = statuses.get(str(status), 0) + 1
+        conn.close()
+        arr = np.asarray(lats)
+        return (float(np.percentile(arr, 50) * 1e3),
+                float(np.percentile(arr, 99) * 1e3), statuses)
+
+    with server_thread(server, args.port):
+        run_pass(50)  # warm: compile + code paths hot before measuring
+        h50, h99, h_status = run_pass(args.queries)
+        FAULTS.arm_spec(args.fault)
+        try:
+            f50, f99, f_status = run_pass(args.queries)
+        finally:
+            FAULTS.disarm()
+        time.sleep(0.5)  # let the feedback pool drain before reading counters
+        feedback = {k[0]: int(v)
+                    for k, v in server._m_feedback._values.items()}
+        breakers = {n: b.state for n, b in server._breakers.items()}
+
+    print(json.dumps({
+        "metric": "serving_fault_injection",
+        "fault": args.fault,
+        "queries_per_pass": args.queries,
+        "healthy_ms": {"p50": round(h50, 4), "p99": round(h99, 4)},
+        "faulted_ms": {"p50": round(f50, 4), "p99": round(f99, 4)},
+        "p50_ratio": round(f50 / h50, 3) if h50 > 0 else None,
+        "statuses": {"healthy": h_status, "faulted": f_status},
+        "feedback": feedback,
+        "breakers": breakers,
+        "shed": int(server._m_shed._values.get((), 0)),
+        "deadline_exceeded": int(server._m_deadline._values.get((), 0)),
+    }))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--queries", type=int, default=2000)
@@ -137,6 +219,15 @@ def main() -> None:
                     help="also measure N parallel HTTP clients against "
                          "a --batching server (micro-batcher + "
                          "one-dispatch batch_predict path)")
+    ap.add_argument("--fault", default=None, metavar="SPEC",
+                    help="fault-injection mode: a PIO_FAULTS-style spec "
+                         "(e.g. 'eventsink.send:error=down'); measures "
+                         "healthy vs faulted p50 with feedback enabled")
+    ap.add_argument("--fault-timeout-ms", type=float, default=1000.0,
+                    help="query deadline for the --fault server")
+    ap.add_argument("--max-inflight", type=int, default=0,
+                    help="inflight cap for the --fault server "
+                         "(0 = unlimited)")
     args = ap.parse_args()
 
     from profile_common import make_memory_storage, resolve_platform
@@ -149,6 +240,9 @@ def main() -> None:
     st = make_memory_storage()
 
     factory = fabricate_instance(st, args.n_users, args.n_items, args.rank)
+    if args.fault:
+        run_fault_mode(args, st, factory)
+        return
     rng = np.random.default_rng(1)
     users = rng.integers(0, args.n_users, args.queries)
 

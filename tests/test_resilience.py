@@ -1,0 +1,378 @@
+"""Unit tests for the resilience primitives (utils/resilience.py) and
+the fault-injection registry (utils/faults.py) — the shared layer under
+the serving-path hardening (docs/operations.md "Failure modes")."""
+
+import asyncio
+import time
+
+import pytest
+
+from predictionio_tpu.utils.faults import FAULTS, FaultError, FaultRegistry
+from predictionio_tpu.utils.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    DeadlineExceeded,
+    backoff_delays,
+    retry_call,
+    retry_with_backoff,
+)
+
+
+class TestDeadline:
+    def test_remaining_counts_down_and_never_negative(self):
+        d = Deadline(0.05)
+        assert 0 < d.remaining() <= 0.05
+        time.sleep(0.07)
+        assert d.remaining() == 0.0
+        assert d.expired()
+
+    def test_check_raises_timeout_error_subclass(self):
+        d = Deadline(-1.0)
+        with pytest.raises(DeadlineExceeded, match="probe exceeded"):
+            d.check("probe")
+        # generic timeout handling must see it
+        with pytest.raises(TimeoutError):
+            d.check()
+
+    def test_fresh_deadline_passes_check(self):
+        Deadline(10.0).check()  # must not raise
+
+
+class TestBackoffDelays:
+    def test_deterministic_doubling_capped(self):
+        g = backoff_delays(0.1, 1.0, jitter="none")
+        got = [next(g) for _ in range(6)]
+        assert got == [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+
+    def test_full_jitter_bounds(self):
+        g = backoff_delays(0.1, 1.0, jitter="full")
+        targets = [0.1, 0.2, 0.4, 0.8, 1.0]
+        for t in targets:
+            assert 0.0 <= next(g) <= t
+
+    def test_equal_jitter_keeps_floor(self):
+        # the supervisor mode: never below half the target
+        g = backoff_delays(1.0, 8.0, jitter="equal")
+        for t in (1.0, 2.0, 4.0, 8.0, 8.0):
+            d = next(g)
+            assert t / 2 <= d <= t
+
+    def test_unknown_jitter_rejected(self):
+        with pytest.raises(ValueError, match="jitter"):
+            next(backoff_delays(0.1, 1.0, jitter="bogus"))
+
+
+class TestRetryWithBackoff:
+    def test_succeeds_after_transient_failures(self):
+        calls = []
+
+        @retry_with_backoff(3, base=0.001, cap=0.002)
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        assert flaky() == "ok"
+        assert len(calls) == 3
+
+    def test_exhausts_and_raises_last_error(self):
+        calls = []
+
+        @retry_with_backoff(2, base=0.001, cap=0.002)
+        def broken():
+            calls.append(1)
+            raise RuntimeError("still down")
+
+        with pytest.raises(RuntimeError, match="still down"):
+            broken()
+        assert len(calls) == 3  # initial + 2 retries
+
+    def test_retry_on_filters_error_types(self):
+        calls = []
+
+        @retry_with_backoff(3, base=0.001, retry_on=(OSError,))
+        def rejects():
+            calls.append(1)
+            raise ValueError("deterministic")
+
+        with pytest.raises(ValueError):
+            rejects()
+        assert len(calls) == 1  # never retried
+
+    def test_circuit_open_error_never_retried(self):
+        calls = []
+
+        @retry_with_backoff(3, base=0.001, retry_on=(Exception,))
+        def open_breaker():
+            calls.append(1)
+            raise CircuitOpenError("dep", 5.0)
+
+        with pytest.raises(CircuitOpenError):
+            open_breaker()
+        assert len(calls) == 1
+
+    def test_on_retry_callback_sees_each_attempt(self):
+        seen = []
+
+        @retry_with_backoff(2, base=0.001,
+                            on_retry=lambda n, e: seen.append((n, str(e))))
+        def fail():
+            raise OSError("x")
+
+        with pytest.raises(OSError):
+            fail()
+        assert [n for n, _ in seen] == [0, 1]
+
+    def test_deadline_bounds_the_whole_run(self):
+        calls = []
+
+        @retry_with_backoff(50, base=0.05, cap=0.05, jitter="none",
+                            deadline=0.12)
+        def slow_fail():
+            calls.append(1)
+            raise OSError("down")
+
+        t0 = time.perf_counter()
+        with pytest.raises(OSError):
+            slow_fail()
+        assert time.perf_counter() - t0 < 1.0
+        assert len(calls) < 10  # nowhere near the 50-retry budget
+
+    def test_async_function_retried(self):
+        calls = []
+
+        @retry_with_backoff(2, base=0.001)
+        async def aflaky():
+            calls.append(1)
+            if len(calls) < 2:
+                raise OSError("transient")
+            return 42
+
+        assert asyncio.run(aflaky()) == 42
+        assert len(calls) == 2
+
+    def test_retry_call_convenience(self):
+        state = {"n": 0}
+
+        def f(x):
+            state["n"] += 1
+            if state["n"] < 2:
+                raise OSError
+            return x * 2
+
+        assert retry_call(f, 21, retries=2, base=0.001) == 42
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestCircuitBreaker:
+    def make(self, **kw):
+        clock = FakeClock()
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("reset_timeout", 10.0)
+        return CircuitBreaker("test_" + str(id(clock)), clock=clock,
+                              **kw), clock
+
+    def test_consecutive_failures_trip_open(self):
+        b, _ = self.make()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == CLOSED
+        b.record_failure()
+        assert b.state == OPEN
+        assert not b.admit()
+        assert b.retry_after() > 0
+
+    def test_success_resets_the_consecutive_count(self):
+        b, _ = self.make()
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == CLOSED  # never 3 consecutive
+
+    def test_open_fails_fast_via_call(self):
+        b, _ = self.make(failure_threshold=1)
+        with pytest.raises(RuntimeError):
+            b.call(lambda: (_ for _ in ()).throw(RuntimeError("down")))
+        calls = []
+        with pytest.raises(CircuitOpenError):
+            b.call(lambda: calls.append(1))
+        assert calls == []  # the dependency was never touched
+
+    def test_half_open_after_reset_timeout_then_close_on_success(self):
+        b, clock = self.make(failure_threshold=1)
+        b.record_failure()
+        assert b.state == OPEN
+        clock.t += 10.0
+        assert b.state == HALF_OPEN
+        assert b.allow()       # takes the single trial slot
+        assert not b.allow()   # no second trial
+        b.record_success()
+        assert b.state == CLOSED
+        assert b.allow()
+
+    def test_half_open_failure_reopens_and_restarts_clock(self):
+        b, clock = self.make(failure_threshold=1)
+        b.record_failure()
+        clock.t += 10.0
+        assert b.allow()
+        b.record_failure()
+        assert b.state == OPEN
+        clock.t += 9.0
+        assert b.state == OPEN  # clock restarted at the re-open
+        clock.t += 1.0
+        assert b.state == HALF_OPEN
+
+    def test_admit_is_non_reserving(self):
+        # the decoupled shape (ingest coalescer): admit at submit time
+        # must not consume half-open trial slots
+        b, clock = self.make(failure_threshold=1)
+        b.record_failure()
+        assert not b.admit()
+        clock.t += 10.0
+        assert b.admit() and b.admit() and b.admit()
+        b.record_success()
+        assert b.state == CLOSED
+
+    def test_call_wraps_success(self):
+        b, _ = self.make()
+        assert b.call(lambda x: x + 1, 41) == 42
+        assert b.state == CLOSED
+
+    def test_acall_wraps_coroutines(self):
+        b, _ = self.make(failure_threshold=1)
+
+        async def boom():
+            raise RuntimeError("down")
+
+        async def scenario():
+            with pytest.raises(RuntimeError):
+                await b.acall(boom)
+            with pytest.raises(CircuitOpenError):
+                await b.acall(boom)
+
+        asyncio.run(scenario())
+
+    def test_reset_forces_closed(self):
+        b, _ = self.make(failure_threshold=1)
+        b.record_failure()
+        assert b.state == OPEN
+        b.reset()
+        assert b.state == CLOSED and b.allow()
+
+
+@pytest.fixture()
+def registry():
+    return FaultRegistry(env={})
+
+
+class TestFaultRegistry:
+    def test_global_registry_disarmed_by_default(self):
+        # tier-1 guarantee: production processes pay ZERO overhead and
+        # inject NO faults unless PIO_FAULTS (or a test) arms them
+        assert FAULTS.armed is False
+        assert FAULTS.plans() == {}
+
+    def test_inject_is_noop_while_disarmed(self, registry):
+        registry.hit("some.site")  # must not raise or count
+        assert registry.hits("some.site") == 0
+
+    def test_error_plan_raises_fault_error(self, registry):
+        registry.arm("svc.op", error="backend down")
+        with pytest.raises(FaultError, match=r"\[svc.op\] backend down"):
+            registry.hit("svc.op")
+        assert registry.hits("svc.op") == 1
+        assert registry.fired("svc.op") == 1
+
+    def test_latency_plan_sleeps(self, registry):
+        registry.arm("svc.op", latency=0.05)
+        t0 = time.perf_counter()
+        registry.hit("svc.op")
+        assert time.perf_counter() - t0 >= 0.05
+
+    def test_rate_is_seeded_and_deterministic(self):
+        def pattern(seed):
+            r = FaultRegistry(env={})
+            r.arm("s", error="x", rate=0.5, seed=seed)
+            out = []
+            for _ in range(20):
+                try:
+                    r.hit("s")
+                    out.append(0)
+                except FaultError:
+                    out.append(1)
+            return out
+
+        a, b = pattern(7), pattern(7)
+        assert a == b                       # reproducible bit-for-bit
+        assert 0 < sum(a) < 20              # actually flaky
+        assert pattern(8) != a              # seed matters
+
+    def test_count_caps_the_fires(self, registry):
+        registry.arm("s", error="blip", count=2)
+        for _ in range(2):
+            with pytest.raises(FaultError):
+                registry.hit("s")
+        registry.hit("s")  # dormant now
+        assert registry.fired("s") == 2
+        assert registry.hits("s") == 3
+
+    def test_arm_spec_parses_multiple_sites(self, registry):
+        registry.arm_spec(
+            "a.b:latency=0.5,rate=0.25,seed=3; c.d:error=down,count=2")
+        plans = registry.plans()
+        assert plans["a.b"].latency == 0.5
+        assert plans["a.b"].rate == 0.25
+        assert plans["a.b"].seed == 3
+        assert plans["c.d"].error == "down"
+        assert plans["c.d"].count == 2
+
+    def test_arm_spec_rejects_garbage(self, registry):
+        with pytest.raises(ValueError):
+            registry.arm_spec("no-colon-here")
+        with pytest.raises(ValueError):
+            registry.arm_spec("site:bogus_key=1")
+
+    def test_env_arming_at_construction(self):
+        r = FaultRegistry(env={"PIO_FAULTS": "x.y:error=down"})
+        assert r.armed
+        with pytest.raises(FaultError):
+            r.hit("x.y")
+
+    def test_disarm_one_site_and_all(self, registry):
+        registry.arm("a", error="x")
+        registry.arm("b", error="x")
+        registry.disarm("a")
+        registry.hit("a")  # no longer armed there
+        assert registry.armed
+        registry.disarm()
+        assert not registry.armed
+        assert registry.plans() == {}
+
+    def test_async_hit_injects_on_the_loop(self, registry):
+        registry.arm("a.op", error="down")
+
+        async def scenario():
+            with pytest.raises(FaultError):
+                await registry.ahit("a.op")
+
+        asyncio.run(scenario())
+
+    def test_probe_plan_counts_without_injecting(self, registry):
+        registry.arm("path.x")  # neither latency nor error
+        registry.hit("path.x")
+        registry.hit("path.x")
+        assert registry.hits("path.x") == 2

@@ -1,0 +1,384 @@
+"""Serving-path resilience over real sockets: deadlines (504), load
+shedding (503), /health semantics, the feedback-sink breaker, hardened
+/reload (probe + rollback), the ingest storage breaker, and the
+supervisor's interruptible jittered restart backoff
+(docs/operations.md "Failure modes and degradation")."""
+
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.core.workflow import run_train
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.server.engine_server import EngineServer
+from predictionio_tpu.server.event_server import EventServer
+from predictionio_tpu.utils.faults import FAULTS
+from tests.test_servers import ServerThread, free_port, http
+
+FACTORY = "predictionio_tpu.templates.recommendation.engine:engine_factory"
+
+VARIANT = {
+    "id": "default",
+    "engineFactory": FACTORY,
+    "datasource": {"params": {"appName": "QuickApp"}},
+    "algorithms": [{"name": "als",
+                    "params": {"rank": 8, "numIterations": 8,
+                               "lambda": 0.05}}],
+}
+
+
+@pytest.fixture(autouse=True)
+def disarm_faults():
+    """Every test leaves the process-wide fault registry clean — an
+    armed leftover plan would silently poison later tests."""
+    FAULTS.disarm()
+    yield
+    FAULTS.disarm()
+
+
+def http_full(method, url, body=None, headers=None):
+    """Like tests.test_servers.http but also returns response headers
+    (the Retry-After contract is part of what's under test)."""
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json",
+                                          **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, json.loads(r.read().decode() or "null"), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "null"), dict(e.headers)
+
+
+def seed_and_train(storage, app_name="QuickApp"):
+    """App + ratings straight into storage (no event server needed),
+    then one real train. Returns (app, instance_id)."""
+    a = storage.meta.create_app(app_name)
+    storage.events.init_channel(a.id)
+    for u in range(12):
+        for i in range(10):
+            if (u + i) % 2 == 0:
+                storage.events.insert(Event(
+                    event="rate", entity_type="user", entity_id=str(u),
+                    target_entity_type="item", target_entity_id=str(i),
+                    properties={"rating": 4.0}), a.id)
+    iid = run_train(FACTORY, variant=VARIANT, storage=storage, use_mesh=False)
+    return a, iid
+
+
+class TestQueryDeadline:
+    def test_hung_query_answers_504_within_the_deadline(self, storage):
+        seed_and_train(storage)
+        port = free_port()
+        server = EngineServer(engine_factory=FACTORY, storage=storage,
+                              host="127.0.0.1", port=port,
+                              query_timeout_ms=300)
+        with ServerThread(server):
+            base = f"http://127.0.0.1:{port}"
+            # healthy first
+            assert http("POST", f"{base}/queries.json",
+                        {"user": "2", "num": 3})[0] == 200
+            # storage/model hang: the worker sleeps far past the deadline
+            FAULTS.arm("serving.query", latency=3.0)
+            t0 = time.perf_counter()
+            code, body = http("POST", f"{base}/queries.json",
+                              {"user": "2", "num": 3})
+            elapsed = time.perf_counter() - t0
+            assert code == 504
+            assert "deadline" in body["message"]
+            # answered at ~the 300ms deadline, nowhere near the 3s hang
+            assert elapsed < 2.0
+            # deadline counter moved
+            assert server._m_deadline._values.get((), 0) >= 1
+            FAULTS.disarm()
+            # recovered: next query is fine (stragglers don't wedge it)
+            assert http("POST", f"{base}/queries.json",
+                        {"user": "2", "num": 3})[0] == 200
+
+    def test_error_paths_still_observe_latency_metrics(self, storage):
+        # satellite: pio_engine_query_seconds must observe 400/500 too
+        seed_and_train(storage)
+        port = free_port()
+        server = EngineServer(engine_factory=FACTORY, storage=storage,
+                              host="127.0.0.1", port=port)
+        with ServerThread(server):
+            base = f"http://127.0.0.1:{port}"
+            before_hist = sum(server._m_latency._counts)
+            before_400 = server._m_queries._values.get(("400",), 0)
+            code, _ = http("POST", f"{base}/queries.json", {"nope": 1})
+            assert code == 400
+            assert server._m_queries._values.get(("400",), 0) == before_400 + 1
+            assert sum(server._m_latency._counts) == before_hist + 1
+
+
+class TestLoadShedding:
+    def test_past_the_cap_sheds_503_with_retry_after(self, storage):
+        seed_and_train(storage)
+        port = free_port()
+        server = EngineServer(engine_factory=FACTORY, storage=storage,
+                              host="127.0.0.1", port=port, max_inflight=1)
+        with ServerThread(server):
+            base = f"http://127.0.0.1:{port}"
+            FAULTS.arm("serving.query", latency=1.0)
+            results = {}
+
+            def slow():
+                results["slow"] = http("POST", f"{base}/queries.json",
+                                       {"user": "2", "num": 3})
+
+            t = threading.Thread(target=slow)
+            t.start()
+            # wait until the slow query is admitted (inflight == 1)
+            deadline = time.time() + 5
+            while server._inflight < 1 and time.time() < deadline:
+                time.sleep(0.01)
+            assert server._inflight == 1
+            t0 = time.perf_counter()
+            code, body, headers = http_full(
+                "POST", f"{base}/queries.json", {"user": "3", "num": 3})
+            shed_elapsed = time.perf_counter() - t0
+            t.join(timeout=10)
+            assert code == 503
+            assert "overloaded" in body["message"]
+            assert int(headers["Retry-After"]) >= 1
+            assert shed_elapsed < 0.5   # shed instantly, no queueing
+            assert results["slow"][0] == 200  # the admitted one finished
+            assert server._m_shed._values.get((), 0) >= 1
+
+
+class TestHealth:
+    def test_ok_when_serving_normally(self, storage):
+        seed_and_train(storage)
+        port = free_port()
+        with ServerThread(EngineServer(engine_factory=FACTORY,
+                                       storage=storage,
+                                       host="127.0.0.1", port=port)):
+            code, body = http("GET", f"http://127.0.0.1:{port}/health")
+            assert code == 200
+            assert body["status"] == "ok"
+            assert body["breakers"]["feedback_sink"] == "closed"
+
+    def test_not_ready_without_an_engine_then_reload_recovers(self, storage):
+        # deploy-before-first-train: comes up not-ready, /reload later
+        # brings the model in (require_engine=False)
+        port = free_port()
+        server = EngineServer(engine_factory=FACTORY, storage=storage,
+                              host="127.0.0.1", port=port,
+                              require_engine=False)
+        with ServerThread(server):
+            base = f"http://127.0.0.1:{port}"
+            code, body = http("GET", f"{base}/health")
+            assert code == 503 and body["status"] == "not-ready"
+            code, body, headers = http_full(
+                "POST", f"{base}/queries.json", {"user": "1", "num": 2})
+            assert code == 503 and "Retry-After" in headers
+            seed_and_train(storage)
+            code, body = http("GET", f"{base}/reload")
+            assert code == 200
+            assert http("GET", f"{base}/health")[1]["status"] == "ok"
+            assert http("POST", f"{base}/queries.json",
+                        {"user": "2", "num": 3})[0] == 200
+
+    def test_degraded_while_a_breaker_is_open_stays_200(self, storage):
+        seed_and_train(storage)
+        port = free_port()
+        server = EngineServer(engine_factory=FACTORY, storage=storage,
+                              host="127.0.0.1", port=port)
+        with ServerThread(server):
+            for _ in range(5):
+                server._sink_breaker.record_failure()
+            code, body = http("GET", f"http://127.0.0.1:{port}/health")
+            # 200, NOT 5xx: a supervisor must not restart a server that
+            # is degrading correctly — restarts don't fix a down sink
+            assert code == 200
+            assert body["status"] == "degraded"
+            assert "feedback_sink" in body["reason"]
+
+
+class FailingSink:
+    """An EventSink whose dependency is hard-down."""
+
+    def __init__(self):
+        self.attempts = 0
+
+    def send(self, event):
+        self.attempts += 1
+        raise OSError("event server unreachable")
+
+
+class TestFeedbackBreaker:
+    def test_sustained_sink_failure_opens_breaker_serving_unaffected(
+            self, storage):
+        seed_and_train(storage)
+        port = free_port()
+        sink = FailingSink()
+        server = EngineServer(engine_factory=FACTORY, storage=storage,
+                              host="127.0.0.1", port=port,
+                              feedback=True, event_sink=sink)
+        with ServerThread(server):
+            base = f"http://127.0.0.1:{port}"
+            for u in range(12):
+                code, _ = http("POST", f"{base}/queries.json",
+                               {"user": str(u % 5), "num": 2})
+                assert code == 200  # feedback failures never break serving
+            # wait for the feedback workers to drain
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                with server._counts_lock:
+                    inflight = server._feedback_inflight
+                if inflight == 0:
+                    break
+                time.sleep(0.05)
+            counts = dict(server._m_feedback._values)
+            assert server._sink_breaker.state == "open"
+            # past the threshold, failures are fast breaker drops —
+            # the sink itself stops being hammered
+            assert counts.get(("breaker_open",), 0) >= 1
+            assert counts.get(("error",), 0) >= server._sink_breaker.failure_threshold
+            assert sink.attempts < 12
+
+
+class TestHardenedReload:
+    def test_reload_under_load_never_serves_an_error(self, storage):
+        _, first = seed_and_train(storage)
+        port = free_port()
+        server = EngineServer(engine_factory=FACTORY, storage=storage,
+                              host="127.0.0.1", port=port)
+        with ServerThread(server):
+            base = f"http://127.0.0.1:{port}"
+            assert http("POST", f"{base}/queries.json",
+                        {"user": "2", "num": 3})[0] == 200
+            second = run_train(FACTORY, variant=VARIANT, storage=storage,
+                               use_mesh=False)
+            stop = threading.Event()
+            statuses = []
+
+            def hammer():
+                while not stop.is_set():
+                    statuses.append(http("POST", f"{base}/queries.json",
+                                         {"user": "2", "num": 3})[0])
+
+            t = threading.Thread(target=hammer)
+            t.start()
+            try:
+                code, body = http("GET", f"{base}/reload")
+            finally:
+                time.sleep(0.2)
+                stop.set()
+                t.join(timeout=10)
+            assert code == 200 and body["engineInstanceId"] == second
+            assert body["reloadGeneration"] == 1
+            # old-or-new instance answered EVERY query; never an error
+            assert statuses and set(statuses) == {200}
+
+    def test_probe_failure_rolls_back_to_last_good_engine(self, storage):
+        _, first = seed_and_train(storage)
+        port = free_port()
+        server = EngineServer(engine_factory=FACTORY, storage=storage,
+                              host="127.0.0.1", port=port)
+        with ServerThread(server):
+            base = f"http://127.0.0.1:{port}"
+            # capture a last-good query for the probe
+            assert http("POST", f"{base}/queries.json",
+                        {"user": "2", "num": 3})[0] == 200
+            run_train(FACTORY, variant=VARIANT, storage=storage,
+                      use_mesh=False)
+            # the candidate loads fine but cannot SERVE (probe fails)
+            FAULTS.arm("serving.reload", error="candidate cannot serve")
+            code, body = http("GET", f"{base}/reload")
+            assert code == 500
+            assert "rolled back" in body["message"]
+            assert body["engineInstanceId"] == first
+            # the last-good engine kept serving throughout
+            assert http("GET", f"{base}/")[1]["engineInstanceId"] == first
+            assert http("POST", f"{base}/queries.json",
+                        {"user": "2", "num": 3})[0] == 200
+            assert server._m_reloads._values.get(("rolled_back",), 0) >= 1
+            # fault cleared → the same reload now succeeds
+            FAULTS.disarm()
+            code, body = http("GET", f"{base}/reload")
+            assert code == 200 and body["engineInstanceId"] != first
+
+
+class TestIngestStorageBreaker:
+    def make_app(self, storage):
+        a = storage.meta.create_app("BreakerApp")
+        storage.events.init_channel(a.id)
+        return a, storage.meta.create_access_key(a.id)
+
+    def test_storage_outage_trips_breaker_to_fast_503(self, storage):
+        _, key = self.make_app(storage)
+        port = free_port()
+        server = EventServer(storage=storage, host="127.0.0.1", port=port,
+                             ingest_batching=True)
+        with ServerThread(server):
+            base = f"http://127.0.0.1:{port}"
+            ev = {"event": "view", "entityType": "user", "entityId": "u",
+                  "targetEntityType": "item", "targetEntityId": "i"}
+            url = f"{base}/events.json?accessKey={key.key}"
+            assert http("POST", url, ev)[0] == 201  # healthy first
+            FAULTS.arm("ingest.commit", error="event storage down")
+            threshold = server._ingest.breaker.failure_threshold
+            # each failed commit is a 500 until the breaker trips
+            codes = [http("POST", url, ev)[0] for _ in range(threshold)]
+            assert set(codes) == {500}
+            assert server._ingest.breaker.state == "open"
+            # now: IMMEDIATE 503 + Retry-After, storage never touched
+            t0 = time.perf_counter()
+            code, body, headers = http_full("POST", url, ev)
+            assert code == 503
+            assert "circuit breaker open" in body["message"]
+            assert int(headers["Retry-After"]) >= 1
+            assert time.perf_counter() - t0 < 0.5
+            assert server._ingest.breaker_rejected >= 1
+            # /health reports the degradation (still 200)
+            code, health = http("GET", f"{base}/health")
+            assert code == 200 and health["status"] == "degraded"
+            assert health["ingest"]["breaker"] == "open"
+            # recovery: storage back + breaker closed again → 201
+            FAULTS.disarm()
+            server._ingest.breaker.reset()
+            assert http("POST", url, ev)[0] == 201
+            assert http("GET", f"{base}/health")[1]["status"] == "ok"
+
+
+class TestSupervisorBackoff:
+    def test_restart_delays_are_jittered_exponential(self):
+        from predictionio_tpu.tools.supervise import Supervisor
+
+        sup = Supervisor(["true"], backoff=1.0, backoff_max=8.0)
+        delays = sup._new_delays()
+        for target in (1.0, 2.0, 4.0, 8.0, 8.0):
+            d = next(delays)
+            assert target / 2 <= d <= target
+
+    def test_stop_interrupts_a_long_backoff_promptly(self):
+        from predictionio_tpu.tools.supervise import Supervisor
+
+        # the child crashes instantly; backoff would sleep 2.5-5s
+        sup = Supervisor([sys.executable, "-c", "import sys; sys.exit(1)"],
+                         backoff=5.0, backoff_max=5.0, log=lambda *a: None)
+        out = {}
+
+        def run():
+            out["code"] = sup.run()
+
+        t = threading.Thread(target=run)
+        t.start()
+        # let it crash and enter the backoff sleep
+        deadline = time.time() + 10
+        while sup.restarts < 1 and time.time() < deadline:
+            time.sleep(0.02)
+        assert sup.restarts >= 1
+        t0 = time.perf_counter()
+        sup.stop()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        # stopped in ~one 0.2s slice, not the full 2.5-5s backoff
+        assert time.perf_counter() - t0 < 2.0
+        assert out["code"] == 0
