@@ -138,11 +138,38 @@ class NativeEventLogStore(EventStore):
         with self._lock:
             h = self._handles.get(key)
             if h is None:
-                h = self._lib.pel_open(self._path(app_id, channel_id).encode())
+                # PIO_EVENTLOG_FORMAT=1 writes legacy (un-checksummed)
+                # frames into FRESH files — the profile_events.py CRC
+                # overhead A/B. Existing files always keep their
+                # on-disk format regardless.
+                fmt = 1 if os.environ.get(
+                    "PIO_EVENTLOG_FORMAT", "2") == "1" else 2
+                h = self._lib.pel_open_ex(
+                    self._path(app_id, channel_id).encode(), fmt)
                 if not h:
                     raise IOError(f"cannot open event log for app {app_id}")
                 self._handles[key] = h
+                self._account_recovery(h)
             return h
+
+    def _account_recovery(self, h: int) -> None:
+        """Surface the engine's open-time recovery report (pel_info)
+        as integrity metrics: checksum-failed records and quarantined
+        torn tails must be visible on /metrics, not only on stderr."""
+        from predictionio_tpu.utils.integrity import (
+            INTEGRITY_FAILED,
+            QUARANTINED,
+        )
+
+        corrupt = ctypes.c_longlong(0)
+        torn = ctypes.c_longlong(-1)
+        quarantined = ctypes.c_longlong(0)
+        self._lib.pel_info(h, None, ctypes.byref(corrupt),
+                           ctypes.byref(torn), ctypes.byref(quarantined))
+        if corrupt.value > 0:
+            INTEGRITY_FAILED.inc(("eventlog",), corrupt.value)
+        if torn.value >= 0:
+            QUARANTINED.inc(("eventlog",))
 
     def _take(self, ptr: ctypes.c_void_p, length: int) -> bytes:
         try:

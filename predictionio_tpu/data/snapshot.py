@@ -25,6 +25,11 @@ cache can cost a rebuild, never correctness):
 - manifest missing/unreadable, schema version bump, filter-key hash
   mismatch, npz corrupt/truncated, or array lengths disagreeing with
   the manifest;
+- a per-column SHA-256 digest in the manifest disagreeing with the
+  loaded array bytes (bit rot in the npz): counted on
+  ``pio_integrity_failed_total{artifact="snapshot"}`` and treated as
+  a cold cache — a corrupt snapshot costs a rebuild, never a wrong
+  training set and never a crash;
 - the live-event count at the old watermark no longer matches the
   manifest (events were deleted, or arrived bearing creationTimes at
   or below the watermark);
@@ -44,16 +49,26 @@ Files live under ``<storage home>/scan_cache/`` (override with
 from __future__ import annotations
 
 import hashlib
+import io
 import json
 import os
-import tempfile
 import time
-from dataclasses import dataclass
-from typing import Optional, Sequence, Tuple
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-SCHEMA_VERSION = 1
+from predictionio_tpu.utils import faults
+from predictionio_tpu.utils.atomic_write import atomic_file, atomic_write_text
+from predictionio_tpu.utils.integrity import (
+    INTEGRITY_FAILED,
+    INTEGRITY_VERIFIED,
+)
+
+# v2: per-column sha256 digests in the manifest. The bump itself
+# invalidates pre-integrity snapshots (a cache miss, rebuilt on the
+# next train).
+SCHEMA_VERSION = 2
 
 # watermark of an empty namespace: below every real creationTime, and
 # matching the native scan's unbounded sentinel so `creation > W`
@@ -78,6 +93,7 @@ class SnapshotManifest:
     pre_count: int  # live events with creationTime <= watermark_us
     n_rows: int     # rows in the npz arrays (post-filter)
     created_at: float
+    digests: Dict[str, str] = field(default_factory=dict)  # field -> sha256
 
 
 def cache_dir(storage) -> str:
@@ -123,6 +139,11 @@ def _table_array(strings) -> np.ndarray:
     return np.empty(0, dtype="U1")
 
 
+def _digest(a: np.ndarray) -> str:
+    """Per-column integrity digest over the exact array bytes."""
+    return hashlib.sha256(np.ascontiguousarray(a).tobytes()).hexdigest()
+
+
 def save_snapshot(
     directory: str,
     fingerprint: str,
@@ -130,35 +151,29 @@ def save_snapshot(
     watermark_us: int,
     pre_count: int,
 ) -> bool:
-    """Persist ``cols`` + manifest atomically (tmp file + rename; the
-    manifest lands LAST, so a manifest's presence implies a complete
-    npz). Returns False instead of raising — a full disk or read-only
-    cache dir must never fail the training read it rides on."""
+    """Persist ``cols`` + manifest atomically AND durably (fsync'd tmp
+    file + rename + dir fsync via utils.atomic_write; the manifest
+    lands LAST, so a manifest's presence implies a complete npz).
+    Returns False instead of raising — a full disk or read-only cache
+    dir must never fail the training read it rides on."""
     npz_path, man_path = _paths(directory, fingerprint)
     try:
         os.makedirs(directory, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                np.savez(
-                    f,
-                    entity_idx=np.ascontiguousarray(cols.entity_idx),
-                    target_idx=np.ascontiguousarray(cols.target_idx),
-                    name_idx=np.ascontiguousarray(cols.name_idx),
-                    values=np.ascontiguousarray(cols.values),
-                    times_us=np.ascontiguousarray(cols.times_us),
-                    entity_ids=_table_array(cols.entity_ids),
-                    target_ids=_table_array(cols.target_ids),
-                    names=_table_array(cols.names))
-            os.replace(tmp, npz_path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        arrays = {
+            "entity_idx": np.ascontiguousarray(cols.entity_idx),
+            "target_idx": np.ascontiguousarray(cols.target_idx),
+            "name_idx": np.ascontiguousarray(cols.name_idx),
+            "values": np.ascontiguousarray(cols.values),
+            "times_us": np.ascontiguousarray(cols.times_us),
+            "entity_ids": _table_array(cols.entity_ids),
+            "target_ids": _table_array(cols.target_ids),
+            "names": _table_array(cols.names),
+        }
+        digests = {k: _digest(a) for k, a in arrays.items()}
+        with atomic_file(npz_path, "wb") as f:
+            np.savez(f, **arrays)
         return _write_manifest(man_path, fingerprint, watermark_us,
-                               pre_count, cols.n)
+                               pre_count, cols.n, digests)
     except Exception:
         return False
 
@@ -173,24 +188,28 @@ def update_manifest(
     """Advance the watermark of an existing snapshot whose arrays are
     unchanged (an empty delta still moves the watermark forward, so
     later delta scans stay O(new events) instead of re-walking the
-    whole post-watermark window)."""
+    whole post-watermark window). The column digests carry over from
+    the existing manifest — the npz did not change."""
     _npz, man_path = _paths(directory, fingerprint)
     try:
+        with open(man_path, "r", encoding="utf-8") as f:
+            digests = json.load(f).get("digests")
+        if not isinstance(digests, dict):
+            return False  # pre-integrity manifest: let it invalidate
         return _write_manifest(man_path, fingerprint, watermark_us,
-                               pre_count, n_rows)
+                               pre_count, n_rows, digests)
     except Exception:
         return False
 
 
 def _write_manifest(man_path: str, fingerprint: str, watermark_us: int,
-                    pre_count: int, n_rows: int) -> bool:
+                    pre_count: int, n_rows: int,
+                    digests: Dict[str, str]) -> bool:
     doc = {"schema": SCHEMA_VERSION, "filter": fingerprint,
            "watermark_us": int(watermark_us), "pre_count": int(pre_count),
-           "n_rows": int(n_rows), "created_at": time.time()}
-    tmp = man_path + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(doc, f, separators=(",", ":"))
-    os.replace(tmp, man_path)
+           "n_rows": int(n_rows), "created_at": time.time(),
+           "digests": digests}
+    atomic_write_text(man_path, json.dumps(doc, separators=(",", ":")))
     return True
 
 
@@ -210,25 +229,52 @@ def load_snapshot(directory: str, fingerprint: str):
         if (doc.get("schema") != SCHEMA_VERSION
                 or doc.get("filter") != fingerprint):
             return None
+        digests = doc.get("digests")
+        if not isinstance(digests, dict):
+            return None
         man = SnapshotManifest(
             schema=int(doc["schema"]), filter_hash=doc["filter"],
             watermark_us=int(doc["watermark_us"]),
             pre_count=int(doc["pre_count"]), n_rows=int(doc["n_rows"]),
-            created_at=float(doc.get("created_at", 0.0)))
-        with np.load(npz_path, allow_pickle=False) as z:
-            arrays = {}
-            for k in _ARRAY_FIELDS:
-                a = z[k]
-                if (a.ndim != 1 or a.shape[0] != man.n_rows
-                        or a.dtype != np.dtype(_DTYPES[k])):
-                    return None
-                arrays[k] = a
-            tables = {}
-            for k in _TABLE_FIELDS:
-                t = z[k]
-                if t.ndim != 1 or t.dtype.kind != "U":
-                    return None
-                tables[k] = t.tolist()
+            created_at=float(doc.get("created_at", 0.0)),
+            digests={str(k): str(v) for k, v in digests.items()})
+        with open(npz_path, "rb") as f:
+            raw = f.read()
+        # byte-flip-on-read fault site, feeding the checks below
+        raw = faults.corrupt_bytes("data.corrupt.snapshot", raw)
+        try:
+            with np.load(io.BytesIO(raw), allow_pickle=False) as z:
+                arrays = {}
+                for k in _ARRAY_FIELDS:
+                    a = z[k]
+                    if (a.ndim != 1 or a.shape[0] != man.n_rows
+                            or a.dtype != np.dtype(_DTYPES[k])):
+                        return None
+                    arrays[k] = a
+                tables = {}
+                raw_tables = {}
+                for k in _TABLE_FIELDS:
+                    t = z[k]
+                    if t.ndim != 1 or t.dtype.kind != "U":
+                        return None
+                    raw_tables[k] = t
+                    tables[k] = t.tolist()
+        except Exception:
+            # valid manifest but unreadable npz = damage, not a cold
+            # cache (the zip container's own CRC often trips before
+            # the per-column digests get their chance)
+            INTEGRITY_FAILED.inc(("snapshot",))
+            return None
+        # per-column digest verification: a flipped bit anywhere in the
+        # arrays is a counted cache miss (rebuild), never a wrong
+        # training set
+        for k in (*_ARRAY_FIELDS, *_TABLE_FIELDS):
+            stored = man.digests.get(k)
+            a = arrays[k] if k in arrays else raw_tables[k]
+            if stored is None or _digest(a) != stored:
+                INTEGRITY_FAILED.inc(("snapshot",))
+                return None
+        INTEGRITY_VERIFIED.inc(("snapshot",))
         # index columns must point inside their tables, or downstream
         # vectorized gathers would read garbage
         for idx_k, tab_k in (("entity_idx", "entity_ids"),

@@ -71,6 +71,12 @@ def eventlog_library() -> Optional[ctypes.CDLL]:
         return None
     lib.pel_open.restype = ctypes.c_void_p
     lib.pel_open.argtypes = [ctypes.c_char_p]
+    lib.pel_open_ex.restype = ctypes.c_void_p
+    lib.pel_open_ex.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.pel_info.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong)]
     lib.pel_close.argtypes = [ctypes.c_void_p]
     lib.pel_append_batch.restype = ctypes.c_int
     lib.pel_append_batch.argtypes = [

@@ -8,14 +8,30 @@
 // analogue). Exposed as a C ABI consumed via ctypes from
 // predictionio_tpu/data/filestore.py.
 //
-// Record framing (little-endian):
-//   [u32 rec_len][u8 kind][payload]          rec_len = 1 + payload size
+// File format v2 (current; little-endian):
+//   8-byte header "PELOGv2\n", then records
+//   [u32 rec_len][u8 kind][payload][u32 crc32c]   rec_len = 1 + payload
+//   crc32c (Castagnoli, the Kafka/iSCSI polynomial) covers the 5 header
+//   bytes AND the payload, so a flipped bit anywhere in a record —
+//   including its length field — fails verification on open.
+// File format v1 (legacy; readable, still writable via pel_open_ex):
+//   no header, records [u32 rec_len][u8 kind][payload] — a headerless
+//   file IS a v1 file; torn tails are detected by length plausibility
+//   only and mid-record bit flips go unnoticed.
 //   kind 0 (event):  i64 time_us, i64 creation_us, then 9 strings each
 //                    [u32 len][bytes]: id, event, entityType, entityId,
 //                    targetEntityType, targetEntityId, propertiesJson,
 //                    tagsJson, prId  (empty string = null for the
 //                    nullable fields)
 //   kind 1 (tombstone): [u32 len][id bytes]
+//
+// Recovery on open walks records by checksum (v2) or length framing
+// (v1). A torn/corrupt tail is never silently dropped: the cut bytes
+// are copied to a `<log>.quarantine-<offset>` sidecar before the
+// truncate, and the truncation offset is reported on stderr and via
+// pel_info(). A v2 record whose checksum fails mid-file (intact
+// framing) is skipped — counted, never indexed, never served — and
+// the walk continues so later checksummed records survive.
 //
 // Semantics matching the Python SPI (data/events.py):
 //   - re-appending an existing id overwrites (HBase put semantics)
@@ -49,6 +65,35 @@
 
 namespace {
 
+// v2 file header: magic + version in one 8-byte stamp. A v1 file has
+// no header — its first bytes are a record length, and a real v1
+// record can never alias the magic (the "PELO" u32 would demand a
+// multi-GB record that the plausibility check rejects anyway).
+const unsigned char kMagic[8] = {'P', 'E', 'L', 'O', 'G', 'v', '2', '\n'};
+
+// CRC32C (Castagnoli, reflected poly 0x82F63B78) — software
+// table-driven; check value: crc32c("123456789") == 0xE3069283.
+struct CrcTable {
+  uint32_t t[256];
+  CrcTable() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+const CrcTable kCrc;
+
+// zlib-style chaining: crc32c(crc32c(0, a, na), b, nb) equals the CRC
+// of the concatenation — used to checksum header + payload in place.
+uint32_t crc32c(uint32_t crc, const unsigned char* p, size_t n) {
+  crc ^= 0xFFFFFFFFu;
+  while (n--) crc = kCrc.t[(crc ^ *p++) & 0xff] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
 struct Rec {
   uint64_t payload_off;  // file offset of payload (after frame header)
   uint32_t payload_len;
@@ -68,6 +113,12 @@ struct Handle {
   std::vector<size_t> sorted;  // alive indices by (time, creation, seq)
   bool sorted_dirty = true;
   uint64_t next_seq = 0;
+  int version = 2;       // format of THIS file (detected on open)
+  int want_version = 2;  // format for a fresh/wiped file
+  // recovery report from the last load_index/wipe (pel_info)
+  long long corrupt_records = 0;   // checksum-failed, skipped mid-file
+  long long torn_offset = -1;      // where the tail was cut; -1 = clean
+  long long quarantined_bytes = 0; // bytes copied to the sidecar
 };
 
 uint32_t rd_u32(const unsigned char* p) {
@@ -183,11 +234,59 @@ void index_record(Handle* h, uint8_t kind, const unsigned char* payload,
   h->sorted_dirty = true;
 }
 
-bool load_index(Handle* h) {
+// Copy the unreadable tail [off, file_size) to the quarantine sidecar
+// BEFORE it is truncated away — corrupt bytes are evidence, not trash.
+// Best-effort: a failed copy must not block recovery (availability
+// over forensics), it just leaves quarantined_bytes at 0.
+void quarantine_tail(Handle* h, uint64_t off, uint64_t file_size) {
+  uint64_t left = file_size - off;
+  if (left == 0) return;
+  std::string qpath =
+      h->path + ".quarantine-" + std::to_string((unsigned long long)off);
+  FILE* qf = fopen(qpath.c_str(), "wb");
+  if (!qf) return;
+  if (fseek(h->f, (long)off, SEEK_SET) != 0) { fclose(qf); return; }
+  char buf[65536];
+  uint64_t copied = 0;
+  while (left > 0) {
+    size_t want = left < sizeof buf ? (size_t)left : sizeof buf;
+    size_t n = fread(buf, 1, want, h->f);
+    if (n == 0) break;
+    if (fwrite(buf, 1, n, qf) != n) break;
+    copied += n;
+    left -= n;
+  }
+  fflush(qf);
+  fsync(fileno(qf));
+  fclose(qf);
+  h->quarantined_bytes = (long long)copied;
+}
+
+bool load_index(Handle* h, int want_version) {
+  h->want_version = want_version;
   if (fseek(h->f, 0, SEEK_END) != 0) return false;
   uint64_t file_size = (uint64_t)ftell(h->f);
+  if (file_size == 0) {  // fresh namespace: stamp the v2 header
+    h->version = want_version;
+    if (want_version == 2) {
+      if (fwrite(kMagic, 1, 8, h->f) != 8) return false;
+      fflush(h->f);
+    }
+    return true;
+  }
+  unsigned char head[8];
   if (fseek(h->f, 0, SEEK_SET) != 0) return false;
-  uint64_t off = 0;  // end of last fully-readable record
+  size_t hn = fread(head, 1, 8, h->f);
+  uint64_t off;  // end of last fully-readable record
+  if (hn == 8 && memcmp(head, kMagic, 8) == 0) {
+    h->version = 2;
+    off = 8;
+  } else {
+    h->version = 1;  // headerless = legacy v1 file
+    off = 0;
+    if (fseek(h->f, 0, SEEK_SET) != 0) return false;
+  }
+  uint32_t trailer = (h->version == 2) ? 4 : 0;
   std::string buf;
   bool torn = false;
   for (;;) {
@@ -198,24 +297,49 @@ bool load_index(Handle* h) {
     uint32_t rec_len = rd_u32(hdr);
     // a length that cannot fit in the rest of the file is corruption,
     // not just a torn tail — truncate rather than try a huge resize
-    if (rec_len < 1 || off + 5 + (uint64_t)(rec_len - 1) > file_size) {
+    if (rec_len < 1 ||
+        off + 5 + (uint64_t)(rec_len - 1) + trailer > file_size) {
       torn = true;
       break;
     }
     uint8_t kind = hdr[4];
     uint32_t plen = rec_len - 1;
-    buf.resize(plen);
-    if (fread(buf.data(), 1, plen, h->f) != plen) { torn = true; break; }
+    buf.resize((size_t)plen + trailer);
+    if (fread(buf.data(), 1, plen + trailer, h->f) != plen + trailer) {
+      torn = true;
+      break;
+    }
+    if (h->version == 2) {
+      uint32_t stored = rd_u32((const unsigned char*)buf.data() + plen);
+      uint32_t actual = crc32c(crc32c(0, hdr, 5),
+                               (const unsigned char*)buf.data(), plen);
+      if (stored != actual) {
+        // damaged record with intact framing: never index (= never
+        // serve) it, keep walking so later checksummed records survive
+        ++h->corrupt_records;
+        off += 5 + (uint64_t)plen + trailer;
+        continue;
+      }
+    }
     index_record(h, kind, (const unsigned char*)buf.data(), plen, off + 5);
-    off += 5 + plen;
+    off += 5 + (uint64_t)plen + trailer;
   }
   if (torn) {
-    // drop the torn tail so later appends stay readable on reopen
+    // preserve the cut bytes, then drop the torn tail so later
+    // appends stay readable on reopen
+    quarantine_tail(h, off, file_size);
     fflush(h->f);
     if (truncate(h->path.c_str(), (off_t)off) != 0) return false;
     fclose(h->f);
     h->f = fopen(h->path.c_str(), "a+b");  // nullptr on failure: caller
     if (!h->f) return false;               // must not fclose again
+    h->torn_offset = (long long)off;
+    fprintf(stderr,
+            "pel: %s: torn/corrupt tail truncated at offset %llu "
+            "(%llu bytes -> %s.quarantine-%llu)\n",
+            h->path.c_str(), (unsigned long long)off,
+            (unsigned long long)(file_size - off), h->path.c_str(),
+            (unsigned long long)off);
   }
   return true;
 }
@@ -418,18 +542,37 @@ char* dup_out(const std::string& s) {
 
 extern "C" {
 
-void* pel_open(const char* path) {
+// want_version picks the record format for a FRESH (empty) file: 2 =
+// checksummed (default), 1 = legacy (the profile_events.py CRC A/B
+// toggle). An existing file always keeps its on-disk format so one
+// file never mixes framings.
+void* pel_open_ex(const char* path, int want_version) {
+  if (want_version != 1 && want_version != 2) return nullptr;
   FILE* f = fopen(path, "a+b");
   if (!f) return nullptr;
   Handle* h = new Handle();
   h->path = path;
   h->f = f;
-  if (!load_index(h)) {
+  if (!load_index(h, want_version)) {
     if (h->f) fclose(h->f);  // may already be closed+nulled by recovery
     delete h;
     return nullptr;
   }
   return h;
+}
+
+void* pel_open(const char* path) { return pel_open_ex(path, 2); }
+
+// Recovery/format report for the last open (or wipe): out-params may
+// be NULL. torn_offset is -1 when the file opened clean.
+void pel_info(void* hv, long long* version, long long* corrupt_records,
+              long long* torn_offset, long long* quarantined_bytes) {
+  Handle* h = (Handle*)hv;
+  std::lock_guard<std::mutex> g(h->mu);
+  if (version) *version = h->version;
+  if (corrupt_records) *corrupt_records = h->corrupt_records;
+  if (torn_offset) *torn_offset = h->torn_offset;
+  if (quarantined_bytes) *quarantined_bytes = h->quarantined_bytes;
 }
 
 void pel_close(void* hv) {
@@ -441,11 +584,43 @@ void pel_close(void* hv) {
 
 namespace {
 // Write + index n framed records from an in-memory buffer (shared by
-// pel_append_batch and the native NDJSON import below).
+// pel_append_batch, pel_delete and the native NDJSON import below).
+// Input frames are the v1 shape ([u32 len][u8 kind][payload], as the
+// Python serializer produces); on a v2 file each frame gains its
+// crc32c trailer here, so every writer path is checksummed without
+// the serializers knowing about record versions.
 int append_frames(Handle* h, const unsigned char* buf, long long len,
                   int n) {
+  if (!h->f) return -1;
   fseek(h->f, 0, SEEK_END);
   uint64_t base = (uint64_t)ftell(h->f);
+  if (h->version == 2) {
+    struct Item {
+      uint8_t kind;
+      uint64_t src_payload;  // payload offset in buf
+      uint32_t plen;
+      uint64_t disk_payload;  // payload offset in the disk image
+    };
+    std::string disk;
+    disk.reserve((size_t)len + (size_t)n * 4);
+    std::vector<Item> items;
+    uint64_t off = 0;
+    while (off + 5 <= (uint64_t)len && (int)items.size() < n) {
+      uint32_t rec_len = rd_u32(buf + off);
+      if (rec_len < 1 || off + 4 + rec_len > (uint64_t)len) break;
+      uint32_t plen = rec_len - 1;
+      items.push_back({buf[off + 4], off + 5, plen, disk.size() + 5});
+      disk.append((const char*)buf + off, 5 + (size_t)plen);
+      append_u32(&disk, crc32c(0, buf + off, 5 + (size_t)plen));
+      off += 5 + (uint64_t)plen;
+    }
+    if (fwrite(disk.data(), 1, disk.size(), h->f) != disk.size()) return -1;
+    fflush(h->f);
+    for (const Item& it : items)
+      index_record(h, it.kind, buf + it.src_payload, it.plen,
+                   base + it.disk_payload);
+    return (int)items.size();
+  }
   if (fwrite(buf, 1, (size_t)len, h->f) != (size_t)len) return -1;
   fflush(h->f);
   uint64_t off = 0;
@@ -499,13 +674,11 @@ int pel_delete(void* hv, const char* id, int idlen) {
   hdr[7] = (idlen >> 16) & 0xff; hdr[8] = (idlen >> 24) & 0xff;
   frame.append((char*)hdr, 9);
   frame.append(id, idlen);
-  fseek(h->f, 0, SEEK_END);
-  if (fwrite(frame.data(), 1, frame.size(), h->f) != frame.size()) return -1;
-  fflush(h->f);
-  auto it = h->by_id.find(key);
-  h->recs[it->second].alive = false;
-  h->by_id.erase(it);
-  h->sorted_dirty = true;
+  // append_frames applies the v2 crc trailer and folds the tombstone
+  // into the index (index_record kills the live entry)
+  if (append_frames(h, (const unsigned char*)frame.data(),
+                    (long long)frame.size(), 1) != 1)
+    return -1;
   return 1;
 }
 
@@ -528,6 +701,16 @@ int pel_wipe(void* hv) {
   h->sorted.clear();
   h->sorted_dirty = true;
   h->next_seq = 0;
+  h->corrupt_records = 0;
+  h->torn_offset = -1;
+  h->quarantined_bytes = 0;
+  // the wiped file is fresh: it takes the handle's requested format
+  // (a wiped legacy file upgrades to the checksummed header)
+  h->version = h->want_version;
+  if (h->f && h->version == 2) {
+    if (fwrite(kMagic, 1, 8, h->f) != 8) return -1;
+    fflush(h->f);
+  }
   return h->f ? 0 : -1;
 }
 

@@ -17,6 +17,9 @@ import threading
 from abc import ABC, abstractmethod
 from typing import List, Optional
 
+from predictionio_tpu.utils import faults, integrity
+from predictionio_tpu.utils.atomic_write import atomic_write_bytes
+
 
 class ModelStore(ABC):
     @abstractmethod
@@ -125,7 +128,15 @@ class SQLModelStore(ModelStore):
 class LocalFSModelStore(ModelStore):
     """Blobs under ``<root>/<instance_id>/model.bin`` (reference default:
     ``~/.pio_store/models``); the per-instance directory doubles as the
-    structured-artifact (Orbax checkpoint) location."""
+    structured-artifact (Orbax checkpoint) location.
+
+    Every blob is written durably (fsync-before-replace) with a
+    ``model.bin.sha256`` digest sidecar, verified on every ``get`` —
+    a corrupt candidate model raises
+    :class:`~predictionio_tpu.utils.integrity.IntegrityError` so the
+    probe-then-swap ``/reload`` path refuses it and keeps serving the
+    previous model. Blobs from before the sidecar existed load
+    unverified (``pio fsck`` reports them as ``unchecksummed``)."""
 
     def __init__(self, root: str) -> None:
         self._root = root
@@ -138,17 +149,30 @@ class LocalFSModelStore(ModelStore):
     def put(self, instance_id: str, blob: bytes) -> None:
         d = self._dir(instance_id)
         os.makedirs(d, exist_ok=True)
-        tmp = os.path.join(d, ".model.bin.tmp")
-        with open(tmp, "wb") as f:
-            f.write(blob)
-        os.replace(tmp, os.path.join(d, "model.bin"))
+        # blob first, digest last: a crash between the two leaves a
+        # mismatched pair that get() REFUSES — fail-safe, never a
+        # silently unverified serve
+        atomic_write_bytes(os.path.join(d, "model.bin"), blob)
+        atomic_write_bytes(
+            os.path.join(d, "model.bin" + integrity.DIGEST_SUFFIX),
+            integrity.sha256_hex(blob).encode("ascii"))
 
     def get(self, instance_id: str) -> Optional[bytes]:
         p = os.path.join(self._dir(instance_id), "model.bin")
         if not os.path.exists(p):
             return None
         with open(p, "rb") as f:
-            return f.read()
+            blob = f.read()
+        blob = faults.corrupt_bytes("data.corrupt.model", blob)
+        expected = None
+        try:
+            with open(p + integrity.DIGEST_SUFFIX, "r",
+                      encoding="ascii") as f:
+                expected = f.read()
+        except OSError:
+            pass  # pre-integrity blob: accepted, fsck flags it
+        integrity.verify_blob(blob, expected, "model", instance_id)
+        return blob
 
     def delete(self, instance_id: str) -> bool:
         d = self._dir(instance_id)

@@ -24,7 +24,7 @@ import re
 from typing import Any, Callable, List, Optional
 
 from predictionio_tpu.storage.models import ModelStore
-from predictionio_tpu.utils import faults
+from predictionio_tpu.utils import faults, integrity
 from predictionio_tpu.utils.resilience import CircuitBreaker, retry_with_backoff
 
 
@@ -123,25 +123,51 @@ class S3ModelStore(_ResilientCalls, ModelStore):
         return f"{self.base}/{instance_id}.bin"
 
     def put(self, instance_id: str, blob: bytes) -> None:
+        key = self._key(instance_id)
+        # blob first, digest sidecar last: a failure between the two
+        # leaves a pair that get() refuses — fail-safe
         self._call(lambda: self._s3.put_object(
-            Bucket=self.bucket, Key=self._key(instance_id), Body=blob))
+            Bucket=self.bucket, Key=key, Body=blob))
+        self._call(lambda: self._s3.put_object(
+            Bucket=self.bucket, Key=key + integrity.DIGEST_SUFFIX,
+            Body=integrity.sha256_hex(blob).encode("ascii")))
 
     def get(self, instance_id: str) -> Optional[bytes]:
+        key = self._key(instance_id)
+
         def fetch() -> Optional[bytes]:
             # a missing key is a RESULT, not a fault: kept inside the
             # guarded call so it neither retries nor trips the breaker
             try:
-                r = self._s3.get_object(Bucket=self.bucket,
-                                        Key=self._key(instance_id))
+                r = self._s3.get_object(Bucket=self.bucket, Key=key)
             except self._s3.exceptions.NoSuchKey:
                 return None
             return r["Body"].read()
 
-        return self._call(fetch)
+        def fetch_digest() -> Optional[bytes]:
+            try:
+                r = self._s3.get_object(
+                    Bucket=self.bucket, Key=key + integrity.DIGEST_SUFFIX)
+            except self._s3.exceptions.NoSuchKey:
+                return None  # pre-integrity blob: accepted, fsck flags it
+            return r["Body"].read()
+
+        blob = self._call(fetch)
+        if blob is None:
+            return None
+        expected = self._call(fetch_digest)
+        blob = faults.corrupt_bytes("data.corrupt.model", blob)
+        integrity.verify_blob(
+            blob, expected.decode("ascii") if expected else None,
+            "model", instance_id)
+        return blob
 
     def delete(self, instance_id: str) -> bool:
+        key = self._key(instance_id)
         self._call(lambda: self._s3.delete_object(
-            Bucket=self.bucket, Key=self._key(instance_id)))
+            Bucket=self.bucket, Key=key))
+        self._call(lambda: self._s3.delete_object(
+            Bucket=self.bucket, Key=key + integrity.DIGEST_SUFFIX))
         return True
 
     def list_ids(self) -> List[str]:
@@ -192,33 +218,65 @@ class HDFSModelStore(_ResilientCalls, ModelStore):
         return f"{self.root}/{instance_id}.bin"
 
     def put(self, instance_id: str, blob: bytes) -> None:
+        key = self._key(instance_id)
+
         def write() -> None:
             self._fs.create_dir(self.root, recursive=True)
-            with self._fs.open_output_stream(self._key(instance_id)) as f:
+            with self._fs.open_output_stream(key) as f:
                 f.write(blob)
 
+        def write_digest() -> None:
+            with self._fs.open_output_stream(
+                    key + integrity.DIGEST_SUFFIX) as f:
+                f.write(integrity.sha256_hex(blob).encode("ascii"))
+
+        # blob first, digest sidecar last — fail-safe ordering
         self._call(write)
+        self._call(write_digest)
 
     def get(self, instance_id: str) -> Optional[bytes]:
         from pyarrow import fs
 
+        key = self._key(instance_id)
+
         def read() -> Optional[bytes]:
-            info = self._fs.get_file_info(self._key(instance_id))
+            info = self._fs.get_file_info(key)
             if info.type == fs.FileType.NotFound:
                 return None
-            with self._fs.open_input_stream(self._key(instance_id)) as f:
+            with self._fs.open_input_stream(key) as f:
                 return f.read()
 
-        return self._call(read)
+        def read_digest() -> Optional[bytes]:
+            side = key + integrity.DIGEST_SUFFIX
+            info = self._fs.get_file_info(side)
+            if info.type == fs.FileType.NotFound:
+                return None  # pre-integrity blob: accepted, fsck flags it
+            with self._fs.open_input_stream(side) as f:
+                return f.read()
+
+        blob = self._call(read)
+        if blob is None:
+            return None
+        expected = self._call(read_digest)
+        blob = faults.corrupt_bytes("data.corrupt.model", blob)
+        integrity.verify_blob(
+            blob, expected.decode("ascii") if expected else None,
+            "model", instance_id)
+        return blob
 
     def delete(self, instance_id: str) -> bool:
         from pyarrow import fs
 
+        key = self._key(instance_id)
+
         def remove() -> bool:
-            info = self._fs.get_file_info(self._key(instance_id))
+            info = self._fs.get_file_info(key)
             if info.type == fs.FileType.NotFound:
                 return False
-            self._fs.delete_file(self._key(instance_id))
+            self._fs.delete_file(key)
+            side = key + integrity.DIGEST_SUFFIX
+            if self._fs.get_file_info(side).type != fs.FileType.NotFound:
+                self._fs.delete_file(side)
             return True
 
         return self._call(remove)

@@ -5,8 +5,9 @@ parser dispatching every verb; unverified, SURVEY.md §3). Verb surface
 preserved: ``app`` (new/list/show/delete/data-delete/channel-new/
 channel-delete), ``accesskey`` (new/list/delete), ``eventserver``,
 ``train``, ``deploy``, ``undeploy``, ``eval``, ``batchpredict``,
-``export``, ``import``, ``status``, ``dashboard``, ``adminserver``,
-``template``, ``build``, ``run``, ``shell``, ``version``. Where the
+``export``, ``import``, ``status``, ``fsck``, ``dashboard``,
+``adminserver``, ``template``, ``build``, ``run``, ``shell``,
+``version``. Where the
 reference shelled out to sbt/spark-submit, training runs in-process on
 the JAX mesh — ``build`` is static validation rather than compilation.
 
@@ -302,6 +303,46 @@ def cmd_status(args: argparse.Namespace) -> None:
     print("[info] status: all systems go")
 
 
+def cmd_fsck(args: argparse.Namespace) -> None:
+    """Offline integrity scan of every persisted artifact under the
+    storage home. Exit codes: 0 = clean, 1 = operational error, 2 =
+    corruption present (unrepaired), 3 = corruption found and repaired
+    — distinct codes so a cron wrapper can page on 2 but merely log 3."""
+    from predictionio_tpu.data.pel_integrity import fsck_home
+    from predictionio_tpu.storage.registry import StorageConfig
+
+    home = args.home or StorageConfig.from_env().home
+    if not os.path.isdir(home):
+        _die(f"storage home not found: {home}")
+    try:
+        report = fsck_home(home, repair=args.repair)
+    except OSError as e:
+        _die(f"fsck failed: {e}")
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for a in report["artifacts"]:
+            name = os.path.basename(str(a["path"]))
+            extra = ""
+            if a["artifact"] == "eventlog":
+                extra = (f" v{a['version']} records={a['records']}"
+                         f" corrupt={a['corrupt']}")
+                if a["torn_offset"] is not None:
+                    extra += f" torn@{a['torn_offset']}"
+                if a["quarantine"]:
+                    extra += f" quarantined→{a['quarantine']}"
+            print(f"[fsck] {a['artifact']:<9} {name}: {a['status']}{extra}")
+        for q in report["quarantines"]:
+            print(f"[fsck] quarantine sidecar: {q}")
+        print(f"[fsck] checked={report['checked']} clean={report['clean']} "
+              f"corrupt={report['corrupt']} repaired={report['repaired']} "
+              f"unchecksummed={report['unchecksummed']}")
+    if report["corrupt"]:
+        raise SystemExit(2)
+    if report["repaired"]:
+        raise SystemExit(3)
+
+
 def cmd_dashboard(args: argparse.Namespace) -> None:
     from predictionio_tpu.tools.dashboard import Dashboard
 
@@ -541,6 +582,21 @@ def build_parser() -> argparse.ArgumentParser:
 
     stp = sub.add_parser("status", help="check storage + device connectivity")
     stp.set_defaults(fn=cmd_status)
+
+    fs = sub.add_parser(
+        "fsck",
+        help="verify integrity of eventlog segments, snapshot cache, "
+             "and model blobs (exit 0 clean / 2 corrupt / 3 repaired)")
+    fs.add_argument("--home", help="storage home to scan "
+                                   "(default: PIO_HOME / ~/.pio_store)")
+    fs.add_argument("--repair", action="store_true",
+                    help="quarantine torn eventlog tails (copied to a "
+                         ".quarantine-<offset> sidecar, then truncated) "
+                         "and delete corrupt snapshots; corrupt model "
+                         "blobs are reported only")
+    fs.add_argument("--json", action="store_true",
+                    help="emit the full report as one JSON document")
+    fs.set_defaults(fn=cmd_fsck)
 
     dm = sub.add_parser(
         "daemon",

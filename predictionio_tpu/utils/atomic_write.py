@@ -1,0 +1,77 @@
+"""Crash-durable atomic file replacement.
+
+``tmp-write + os.replace`` alone gives ATOMICITY (readers see old or
+new, never half) but not DURABILITY: after a power cut the rename can
+survive while the data blocks behind it do not, leaving a complete-
+looking file full of zeros — exactly the corruption class the
+integrity layer exists to refuse. The fix is the classic three-step
+discipline (fsync the tmp file, rename, fsync the parent directory so
+the rename itself is on disk), shared here so every persistence site
+(snapshot npz, snapshot manifest, model blobs + digest sidecars) pays
+it the same way instead of re-deriving it.
+
+Directory fsync is best-effort: some filesystems refuse O_RDONLY
+fsync on directories; the file-level fsync (the important half) has
+already happened by then.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from contextlib import contextmanager
+from typing import Iterator, IO
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a just-renamed entry survives power loss."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+@contextmanager
+def atomic_file(path: str, mode: str = "wb",
+                encoding: str | None = None) -> Iterator[IO]:
+    """Write-to-tmp / fsync / replace / fsync-dir as a context manager.
+
+    The target appears complete and durable or not at all; on any
+    error the tmp file is removed and nothing at ``path`` changes.
+    """
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".atomic-",
+                               suffix=".tmp")
+    try:
+        f = os.fdopen(fd, mode, encoding=encoding)
+        try:
+            yield f
+            f.flush()
+            os.fsync(f.fileno())
+        finally:
+            f.close()
+        os.replace(tmp, path)
+        fsync_dir(directory)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    with atomic_file(path, "wb") as f:
+        f.write(data)
+
+
+def atomic_write_text(path: str, text: str,
+                      encoding: str = "utf-8") -> None:
+    with atomic_file(path, "w", encoding=encoding) as f:
+        f.write(text)

@@ -14,6 +14,13 @@ to something that can fail — and **plans** armed against those sites:
 - ``count``   — fire at most N times, then fall dormant (a transient
   blip that retry logic should absorb).
 
+The ``data.corrupt.*`` sites are special: instead of sleeping or
+raising they **flip a byte** in data passing through
+:meth:`FaultRegistry.corrupt` (bit rot on the read path), so checksum
+verification — not error handling — is what the test exercises. A
+plan's ``rate``/``count``/``seed`` directives gate the flip as usual;
+``latency``/``error`` are ignored at these sites.
+
 Arming is programmatic (tests, ``profile_serving.py --fault``) or via
 the ``PIO_FAULTS`` environment variable, read once at import:
 
@@ -37,6 +44,9 @@ Known sites (grep ``faults.inject`` for the authoritative list):
 ``ingest.commit``       coalescer group commit (event storage down)
 ``models.s3``           S3 model-store operations
 ``models.hdfs``         HDFS model-store operations
+``data.corrupt.eventlog``  byte-flip on ``pio fsck`` eventlog reads
+``data.corrupt.snapshot``  byte-flip on snapshot npz load
+``data.corrupt.model``     byte-flip on model-blob load/download
 ======================  ===================================================
 """
 
@@ -185,6 +195,21 @@ class FaultRegistry:
         if plan.error is not None:
             raise FaultError(f"[{site}] {plan.error}")
 
+    def corrupt(self, site: str, data: bytes) -> bytes:
+        """Byte-flip injection for the ``data.corrupt.*`` sites: when
+        the armed plan fires, return a copy of ``data`` with the
+        middle byte inverted (deterministic position, so a test can
+        predict exactly which artifact region is damaged); otherwise
+        return ``data`` unchanged. Disarmed cost: one attribute read."""
+        if not self.armed or not data:
+            return data
+        plan = self._evaluate(site)
+        if plan is None:
+            return data
+        flipped = bytearray(data)
+        flipped[len(flipped) // 2] ^= 0xFF
+        return bytes(flipped)
+
     async def ahit(self, site: str) -> None:
         """Async injection point — latency sleeps on the event loop
         without blocking it."""
@@ -210,3 +235,11 @@ def inject(site: str) -> None:
     placed at injection sites."""
     if FAULTS.armed:
         FAULTS.hit(site)
+
+
+def corrupt_bytes(site: str, data: bytes) -> bytes:
+    """Module-level shorthand for ``FAULTS.corrupt(site, data)`` — the
+    one-liner placed on read paths that feed checksum verification."""
+    if FAULTS.armed:
+        return FAULTS.corrupt(site, data)
+    return data

@@ -1,0 +1,75 @@
+"""Shared integrity primitives: digests, verdict counters, refusal.
+
+Every checksummed artifact above the native event log (snapshot
+columns, model blobs) uses the same discipline — SHA-256 digest
+written beside the data, verified on every load — and reports through
+the same three counters, so one ``/metrics`` scrape answers "is
+anything corrupt?" across the whole storage stack:
+
+- ``pio_integrity_verified_total{artifact}`` — reads whose checksum
+  matched;
+- ``pio_integrity_failed_total{artifact}``   — reads refused (or, for
+  the cache-shaped snapshot artifact, rebuilt) on mismatch;
+- ``pio_quarantined_total{artifact}``        — corrupt byte ranges
+  preserved in a quarantine sidecar instead of silently dropped.
+
+``artifact`` is one of ``eventlog`` / ``snapshot`` / ``model``.
+
+The eventlog's own per-record CRC32C lives in the native engine
+(eventlog.cc) and the pure-Python scanner
+(:mod:`predictionio_tpu.data.pel_integrity`); this module covers the
+Python-side blobs where a cryptographic digest is cheap relative to
+the artifact size and removes any collision question.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+from predictionio_tpu.utils.metrics import REGISTRY
+
+#: filename suffix for digest sidecars (``model.bin`` -> ``model.bin.sha256``)
+DIGEST_SUFFIX = ".sha256"
+
+INTEGRITY_VERIFIED = REGISTRY.counter(
+    "pio_integrity_verified_total",
+    "Artifact reads whose checksum verified", ("artifact",))
+INTEGRITY_FAILED = REGISTRY.counter(
+    "pio_integrity_failed_total",
+    "Artifact reads refused or rebuilt on checksum mismatch",
+    ("artifact",))
+QUARANTINED = REGISTRY.counter(
+    "pio_quarantined_total",
+    "Corrupt byte ranges preserved in quarantine sidecars", ("artifact",))
+
+
+class IntegrityError(RuntimeError):
+    """A checksummed artifact failed verification — the read is
+    REFUSED, never served. Deliberately not an ``IOError``: retry
+    logic must not treat bad bytes as a transient fault."""
+
+
+def sha256_hex(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def verify_blob(blob: bytes, expected_hex: Optional[str],
+                artifact: str, what: str = "") -> None:
+    """Verify ``blob`` against a hex digest, counting the verdict.
+
+    ``expected_hex`` of None means "no sidecar" (an artifact written
+    before checksums existed): accepted without a verdict so old
+    deployments keep working — ``pio fsck`` reports these as
+    ``unchecksummed``.
+    """
+    if expected_hex is None:
+        return
+    actual = sha256_hex(blob)
+    if actual != expected_hex.strip():
+        INTEGRITY_FAILED.inc((artifact,))
+        raise IntegrityError(
+            f"{artifact} checksum mismatch{f' for {what}' if what else ''}: "
+            f"expected {expected_hex.strip()[:16]}…, got {actual[:16]}… "
+            f"({len(blob)} bytes) — refusing to serve corrupt data")
+    INTEGRITY_VERIFIED.inc((artifact,))

@@ -22,10 +22,19 @@ both phases (``--volatile-acks`` drops that for the durability-cost
 A/B), and the JSON reports both plus the speedup. Serial mode (the
 default) is unchanged for comparability with earlier rounds.
 
+With ``--verify-crc`` the HTTP phases are replaced by a checksum
+overhead A/B at the EVENTLOG store SPI: the same batch ingest + full
+scan against two fresh namespaces, one written in the legacy v1 frame
+format (``PIO_EVENTLOG_FORMAT=1``, no record CRCs) and one in the
+default v2 format (per-record CRC32C, verified on every index
+rebuild) — what the end-to-end integrity contract costs on the ingest
+hot path.
+
 Usage::
 
     python profile_events.py [--events 5000] [--storage memory|sqlite]
     python profile_events.py --concurrency 16 --storage sqlite
+    python profile_events.py --verify-crc --events 200000
 
 Prints ONE JSON line.
 """
@@ -35,6 +44,7 @@ from __future__ import annotations
 import argparse
 import http.client
 import json
+import os
 import tempfile
 import threading
 import time
@@ -62,7 +72,13 @@ def main() -> None:
                          "through the store SPI (the `pio import` "
                          "path) and measure scan/aggregate reads — "
                          "the C++ EVENTLOG scale probe (VERDICT r4 #4)")
+    ap.add_argument("--verify-crc", action="store_true",
+                    help="EVENTLOG checksum overhead A/B: batch ingest "
+                         "+ full scan with v1 (no CRC) vs v2 (CRC32C "
+                         "per record) frame formats, at the store SPI")
     args = ap.parse_args()
+    if args.verify_crc:
+        args.storage = "eventlog"  # the A/B only exists natively
 
     import jax
 
@@ -83,6 +99,69 @@ def main() -> None:
     app = st.meta.create_app("EventsBench")
     st.events.init_channel(app.id)
     key = st.meta.create_access_key(app.id).key
+
+    if args.verify_crc:
+        # one fresh namespace per format (a file keeps its on-disk
+        # format for life, so the env toggle only matters at creation);
+        # same event stream, same chunking, measured at the store SPI
+        # so the delta is the CRC computation + 5-byte-per-record
+        # trailer IO and nothing else
+        from predictionio_tpu.data.event import Event
+
+        rng = np.random.default_rng(0)
+        uu = rng.integers(0, 1000, args.events)
+        ii = rng.integers(0, 500, args.events)
+        evs = [Event(event="view", entity_type="user",
+                     entity_id=str(int(uu[n])),
+                     target_entity_type="item",
+                     target_entity_id=str(int(ii[n])),
+                     properties={"n": int(n)})
+               for n in range(args.events)]
+        prev = os.environ.get("PIO_EVENTLOG_FORMAT")
+        results = {}
+        try:
+            for fmt, label in (("1", "v1_no_crc"), ("2", "v2_crc32c")):
+                os.environ["PIO_EVENTLOG_FORMAT"] = fmt
+                fapp = st.meta.create_app(f"EventsBenchCRC{fmt}")
+                st.events.init_channel(fapp.id)
+                CH = 20_000
+                t0 = time.perf_counter()
+                for lo in range(0, args.events, CH):
+                    st.events.insert_batch(evs[lo:lo + CH], fapp.id)
+                ingest_sec = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                n_scanned = sum(1 for _ in st.events.find(fapp.id))
+                scan_sec = time.perf_counter() - t0
+                assert n_scanned == args.events
+                # reopen: the v2 path re-verifies every record CRC
+                # while rebuilding the index — the recovery-read cost
+                st.events.close()
+                t0 = time.perf_counter()
+                st.events.init_channel(fapp.id)
+                reopen_sec = time.perf_counter() - t0
+                results[label] = {
+                    "ingest_events_per_sec": round(args.events / ingest_sec),
+                    "scan_events_per_sec": round(args.events / scan_sec),
+                    "reopen_ms": round(reopen_sec * 1e3, 1),
+                }
+        finally:
+            if prev is None:
+                os.environ.pop("PIO_EVENTLOG_FORMAT", None)
+            else:
+                os.environ["PIO_EVENTLOG_FORMAT"] = prev
+        v1, v2 = results["v1_no_crc"], results["v2_crc32c"]
+        print(json.dumps({
+            "metric": "eventlog_crc_overhead",
+            "events": args.events,
+            **results,
+            "ingest_overhead_pct": round(
+                (v1["ingest_events_per_sec"] / v2["ingest_events_per_sec"]
+                 - 1) * 100, 1),
+            "scan_overhead_pct": round(
+                (v1["scan_events_per_sec"] / v2["scan_events_per_sec"]
+                 - 1) * 100, 1),
+        }))
+        return
 
     if args.concurrency:
         # N persistent connections, one event per POST; the same
