@@ -101,16 +101,20 @@ class Engine:
         per-phase wall-clock lands in ``ctx.timings``."""
         import time
 
+        from predictionio_tpu.utils import tracing
+
         t0 = time.perf_counter()
-        ds = self.data_source_cls(engine_params.data_source_params)
-        td = ds.read_training(ctx)
+        with tracing.span("train.read"):
+            ds = self.data_source_cls(engine_params.data_source_params)
+            td = ds.read_training(ctx)
         ctx.timings["read_training"] = time.perf_counter() - t0
         ctx.log("read_training done")
         if ctx.stop_after_read:
             return []
         t0 = time.perf_counter()
-        prep = self.preparator_cls(engine_params.preparator_params)
-        pd = prep.prepare(ctx, td)
+        with tracing.span("train.prepare"):
+            prep = self.preparator_cls(engine_params.preparator_params)
+            pd = prep.prepare(ctx, td)
         ctx.timings["prepare"] = time.perf_counter() - t0
         ctx.log("prepare done")
         if ctx.stop_after_prepare:
@@ -121,7 +125,8 @@ class Engine:
                 algo.sanity_check(pd)
             ctx.log(f"training algorithm {name!r}")
             t0 = time.perf_counter()
-            models.append(algo.train(ctx, pd))
+            with tracing.span("train.fit", algorithm=name):
+                models.append(algo.train(ctx, pd))
             ctx.timings[f"train:{name}"] = time.perf_counter() - t0
             ctx.log(f"algorithm {name!r} trained")
         return models

@@ -95,7 +95,7 @@ def run_train(
     """
     from predictionio_tpu.data.store import set_scan_cache
     from predictionio_tpu.parallel import distributed
-    from predictionio_tpu.utils import compilecache
+    from predictionio_tpu.utils import compilecache, tracing
 
     compilecache.enable()
 
@@ -146,48 +146,52 @@ def run_train(
     _prev_scan_cache = (set_scan_cache(scan_cache)
                         if scan_cache is not None else None)
     try:
-        ei.status = "TRAINING"
-        if coord:
-            storage.meta.update_engine_instance(ei)
-        # tracing hook (SURVEY.md §5): PIO_PROFILE_DIR=<dir> wraps the
-        # train in a JAX profiler trace (xplane → Perfetto/TensorBoard)
-        profile_dir = os.environ.get("PIO_PROFILE_DIR")
-        if profile_dir:
-            import jax
+        with tracing.root_span("train.run", engine_factory=engine_factory,
+                               instance_id=instance_id):
+            ei.status = "TRAINING"
+            if coord:
+                storage.meta.update_engine_instance(ei)
+            # tracing hook (SURVEY.md §5): PIO_PROFILE_DIR=<dir> wraps the
+            # train in a JAX profiler trace (xplane → Perfetto/TensorBoard)
+            profile_dir = os.environ.get("PIO_PROFILE_DIR")
+            if profile_dir:
+                import jax
 
-            with jax.profiler.trace(profile_dir):
+                with jax.profiler.trace(profile_dir):
+                    models = engine.train(ctx, engine_params)
+            else:
                 models = engine.train(ctx, engine_params)
-        else:
-            models = engine.train(ctx, engine_params)
-        if ctx.timings:
-            phases = ", ".join(f"{k}={v:.3f}s"
-                               for k, v in ctx.timings.items())
-            ctx.log(f"train phases: {phases}")
-        if multi:
-            distributed.barrier("pio_train_done")
+            if ctx.timings:
+                phases = ", ".join(f"{k}={v:.3f}s"
+                                   for k, v in ctx.timings.items())
+                ctx.log(f"train phases: {phases}")
+            if multi:
+                distributed.barrier("pio_train_done")
 
-        # persist per-algorithm models (coordinator only under multi-host:
-        # the trained arrays are replicated, one writer suffices)
-        if coord:
-            instance_dir = storage.models.model_dir(instance_id)
-            blobs: List[Optional[bytes]] = []
-            for (name, algo), model in zip(
-                    engine.make_algorithms(engine_params), models):
-                algo_dir = None
-                if instance_dir is not None:
-                    algo_dir = os.path.join(instance_dir, name)
-                    os.makedirs(algo_dir, exist_ok=True)
-                blobs.append(algo.save_model(model, algo_dir))
-            storage.models.put(instance_id, pickle.dumps(blobs))
+            # persist per-algorithm models (coordinator only under multi-host:
+            # the trained arrays are replicated, one writer suffices)
+            if coord:
+                with tracing.span("train.save", instance_id=instance_id,
+                                  algorithms=len(models)):
+                    instance_dir = storage.models.model_dir(instance_id)
+                    blobs: List[Optional[bytes]] = []
+                    for (name, algo), model in zip(
+                            engine.make_algorithms(engine_params), models):
+                        algo_dir = None
+                        if instance_dir is not None:
+                            algo_dir = os.path.join(instance_dir, name)
+                            os.makedirs(algo_dir, exist_ok=True)
+                        blobs.append(algo.save_model(model, algo_dir))
+                    storage.models.put(instance_id, pickle.dumps(blobs))
 
-            ei.status = "COMPLETED"
-            ei.end_time = utcnow()
-            storage.meta.update_engine_instance(ei)
-            # the run completed: its mid-train checkpoints are consumed
-            shutil.rmtree(ckpt_root, ignore_errors=True)
-        if multi:
-            distributed.barrier("pio_persist_done")
-        return instance_id
+                ei.status = "COMPLETED"
+                ei.end_time = utcnow()
+                storage.meta.update_engine_instance(ei)
+                # the run completed: its mid-train checkpoints are consumed
+                shutil.rmtree(ckpt_root, ignore_errors=True)
+            if multi:
+                distributed.barrier("pio_persist_done")
+            return instance_id
     except Exception:
         ei.status = "FAILED"
         ei.end_time = utcnow()

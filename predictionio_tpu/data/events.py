@@ -645,7 +645,12 @@ class SQLEventStore(EventStore):
                 except Exception:
                     self._d.recover(c)
 
-        return columnar_from_rows(row_iter(), value_key)
+        cols = columnar_from_rows(row_iter(), value_key)
+        if cols is not None:
+            from predictionio_tpu.utils import tracing
+
+            tracing.add_attrs(scan_backend="sql", scan_records=int(cols.n))
+        return cols
 
     @property
     def cache_identity(self) -> Optional[str]:  # type: ignore[override]

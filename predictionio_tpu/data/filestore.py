@@ -30,6 +30,7 @@ from predictionio_tpu.data.event import (
     validate_event,
 )
 from predictionio_tpu.data.events import EventStore, _ts as _ts_us
+from predictionio_tpu.utils import tracing
 
 _UNBOUNDED_LO = -(2**62)
 _UNBOUNDED_HI = 2**62
@@ -445,6 +446,8 @@ class NativeEventLogStore(EventStore):
             return strs, off + (-off % 8)
 
         ne, n_ent, n_tgt, n_nam = struct.unpack_from("<QQQQ", buf, 0)
+        tracing.add_attrs(scan_backend="eventlog", scan_bytes=int(n),
+                          scan_records=int(ne))
         off = 32
         times = np.frombuffer(buf, "<i8", ne, off); off += 8 * ne
         values = np.frombuffer(buf, "<f8", ne, off); off += 8 * ne

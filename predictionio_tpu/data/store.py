@@ -25,6 +25,7 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 from predictionio_tpu.data.event import Event, PropertyMap
 from predictionio_tpu.storage.registry import Storage, get_storage
+from predictionio_tpu.utils import tracing as _tracing
 from predictionio_tpu.utils.metrics import REGISTRY as _REGISTRY
 
 _SNAP_HITS = _REGISTRY.counter(
@@ -134,6 +135,7 @@ def _cached_scan(
     stats = stats_fn(app_id, channel_id) if stats_fn is not None else None
     if identity is None or stats is None:
         _SNAP_MISSES.inc(("unsupported",))
+        _tracing.add_attrs(scan_cache="miss:unsupported")
         return scan(app_id, channel_id, entity_type=entity_type,
                     target_entity_type=target_entity_type,
                     event_names=event_names, value_key=value_key)
@@ -163,6 +165,7 @@ def _cached_scan(
             if delta is not None:
                 if delta.n == 0:
                     _SNAP_HITS.inc()
+                    _tracing.add_attrs(scan_cache="hit")
                     if watermark > man.watermark_us:
                         _snap.update_manifest(directory, key, watermark,
                                               count_now, cols0.n)
@@ -178,20 +181,26 @@ def _cached_scan(
                     merged = concat_columnar(cols0, delta)
                     if merged is not None:
                         _SNAP_HITS.inc()
+                        _tracing.add_attrs(scan_cache="hit:delta")
                         _SNAP_DELTA_ROWS.inc(n=delta.n)
                         if delta.n * _COMPACT_FACTOR >= cols0.n:
                             _snap.save_snapshot(directory, key, merged,
                                                 watermark, count_now)
                         return merged
                     _SNAP_MISSES.inc(("overflow",))
+                    _tracing.add_attrs(scan_cache="miss:overflow")
                 else:
                     _SNAP_MISSES.inc(("out_of_order",))
+                    _tracing.add_attrs(scan_cache="miss:out_of_order")
             else:
                 _SNAP_MISSES.inc(("declined",))
+                _tracing.add_attrs(scan_cache="miss:declined")
         else:
             _SNAP_MISSES.inc(("mutated",))
+            _tracing.add_attrs(scan_cache="miss:mutated")
     else:
         _SNAP_MISSES.inc(("cold",))
+        _tracing.add_attrs(scan_cache="miss:cold")
 
     cols = scan(app_id, channel_id, entity_type=entity_type,
                 target_entity_type=target_entity_type,
@@ -221,16 +230,24 @@ def _scan_with_cache(
     window slides."""
     t0 = _time.perf_counter()
     try:
-        if (start_time is not None or until_time is not None
-                or not scan_cache_enabled()):
-            return scan(app_id, channel_id, start_time=start_time,
-                        until_time=until_time, entity_type=entity_type,
-                        target_entity_type=target_entity_type,
-                        event_names=event_names, value_key=value_key)
-        return _cached_scan(scan, st, app_id, channel_id, entity_type,
-                            target_entity_type, event_names, value_key)
+        with _tracing.span("storage.scan", app_id=app_id) as sp:
+            if (start_time is not None or until_time is not None
+                    or not scan_cache_enabled()):
+                sp.set_attr("scan_cache", "bypassed")
+                cols = scan(app_id, channel_id, start_time=start_time,
+                            until_time=until_time, entity_type=entity_type,
+                            target_entity_type=target_entity_type,
+                            event_names=event_names, value_key=value_key)
+            else:
+                cols = _cached_scan(scan, st, app_id, channel_id,
+                                    entity_type, target_entity_type,
+                                    event_names, value_key)
+            if cols is not None:
+                sp.set_attr("records", int(cols.n))
+            return cols
     finally:
-        _SCAN_SECONDS.observe(_time.perf_counter() - t0)
+        _SCAN_SECONDS.observe(_time.perf_counter() - t0,
+                              exemplar=_tracing.exemplar())
 
 
 def _parse_value(v) -> Optional[float]:

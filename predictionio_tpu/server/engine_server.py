@@ -45,9 +45,15 @@ from typing import Any, Dict, List, Optional
 from predictionio_tpu.core.plugins import engine_server_plugins
 from predictionio_tpu.core.workflow import DeployedEngine, prepare_deploy
 from predictionio_tpu.data.event import Event, utcnow
-from predictionio_tpu.server.http import HTTPServer, Request, Response, Router
+from predictionio_tpu.server.http import (
+    HTTPServer,
+    Request,
+    Response,
+    Router,
+    traces_handler,
+)
 from predictionio_tpu.storage.registry import Storage, get_storage
-from predictionio_tpu.utils import faults
+from predictionio_tpu.utils import faults, tracing
 from predictionio_tpu.utils.resilience import (
     OPEN,
     CircuitBreaker,
@@ -81,6 +87,7 @@ class EngineServer:
         max_inflight: int = 0,
         reload_probe: bool = True,
         require_engine: bool = True,
+        access_log: bool = False,
     ) -> None:
         self.storage = storage or get_storage()
         self.engine_factory = engine_factory
@@ -133,7 +140,8 @@ class EngineServer:
         self._m_queries = REGISTRY.counter(
             "pio_engine_queries_total", "Queries served", ("status",))
         self._m_latency = REGISTRY.histogram(
-            "pio_engine_query_seconds", "Query latency (handler, seconds)")
+            "pio_engine_query_seconds", "Query latency (handler, seconds)",
+            labelnames=("status",))
         self._m_feedback = REGISTRY.counter(
             "pio_engine_feedback_total", "Feedback events sent", ("status",))
         self._m_shed = REGISTRY.counter(
@@ -171,6 +179,7 @@ class EngineServer:
         router.route("GET", "/reload", self._reload)
         router.route("GET", "/stop", self._stop)
         router.route("GET", "/metrics", self._metrics)
+        router.route("GET", "/traces", traces_handler)
         router.route("GET", "/plugins.json", self._plugins_list)
         router.route("GET", "/plugins/{name}/{path+}", self._plugin_route)
         router.route("POST", "/plugins/{name}/{path+}", self._plugin_route)
@@ -180,13 +189,18 @@ class EngineServer:
         self.http = HTTPServer(router, host, port,
                                ssl_context=ssl_context,
                                bind_retries=bind_retries,
-                               bind_retry_sec=bind_retry_sec)
+                               bind_retry_sec=bind_retry_sec,
+                               access_log=access_log,
+                               server_name="engine")
 
     # -- workers ---------------------------------------------------------------
 
     def _query_worker(self, query: Any) -> Any:
-        faults.inject("serving.query")
-        return self.deployed.query(query)
+        # to_thread copies the contextvars context, so this span parents
+        # to the request's engine.query span automatically
+        with tracing.span("engine.predict"):
+            faults.inject("serving.query")
+            return self.deployed.query(query)
 
     def _batch_worker(self, queries: List[Any]) -> List[Any]:
         faults.inject("serving.query")
@@ -219,13 +233,22 @@ class EngineServer:
                 "train and GET /reload")
         self._inflight += 1
         try:
-            status, resp = await self._query_once(req)
+            async with tracing.span(
+                    "engine.query",
+                    deadline_ms=self.query_timeout * 1e3,
+                    inflight=self._inflight,
+                    feedback_breaker=self._sink_breaker.state) as sp:
+                status, resp = await self._query_once(req)
+                sp.set_attr("status", status)
+                if status in ("500", "504"):
+                    sp.set_error(f"query answered {status}")
         finally:
             self._inflight -= 1
         self._m_queries.inc((status,))
         # the latency histogram observes EVERY outcome — the 400/500
         # (and 504) tails are exactly the slow failures worth seeing
-        self._m_latency.observe(time.perf_counter() - t0)
+        self._m_latency.observe(time.perf_counter() - t0, (status,),
+                                exemplar=tracing.exemplar())
         return resp
 
     async def _query_once(self, req: Request) -> "tuple[str, Response]":
@@ -307,7 +330,9 @@ class EngineServer:
                 with self._counts_lock:
                     self._feedback_inflight -= 1
 
-        self._feedback_pool.submit(run)
+        # a raw executor does not copy contextvars; bind_current carries
+        # the request's span so feedback/sink spans join the query trace
+        self._feedback_pool.submit(tracing.bind_current(run))
 
     def _sink(self):
         if self._event_sink is None:
@@ -333,21 +358,26 @@ class EngineServer:
         a direct local write. Delivery runs through the sink breaker:
         repeated failures trip it open and subsequent feedback drops
         fast (counted as breaker_open) until the sink recovers."""
-        try:
-            sink = self._sink()
-            if sink is None:
-                return
-            self._sink_breaker.call(sink.send, Event(
-                event="predict",
-                entity_type="pio_pr", entity_id=pr_id,
-                properties={"query": query, "prediction": prediction},
-                pr_id=pr_id,
-            ))
-            self._m_feedback.inc(("ok",))
-        except CircuitOpenError:
-            self._m_feedback.inc(("breaker_open",))
-        except Exception:
-            self._m_feedback.inc(("error",))  # never breaks serving
+        with tracing.span("engine.feedback", pr_id=pr_id) as sp:
+            try:
+                sink = self._sink()
+                if sink is None:
+                    sp.set_attr("result", "no_sink")
+                    return
+                self._sink_breaker.call(sink.send, Event(
+                    event="predict",
+                    entity_type="pio_pr", entity_id=pr_id,
+                    properties={"query": query, "prediction": prediction},
+                    pr_id=pr_id,
+                ))
+                self._m_feedback.inc(("ok",))
+                sp.set_attr("result", "ok")
+            except CircuitOpenError:
+                self._m_feedback.inc(("breaker_open",))
+                sp.set_error("feedback sink breaker open")
+            except Exception as e:
+                self._m_feedback.inc(("error",))  # never breaks serving
+                sp.set_error(f"{type(e).__name__}: {e}")
 
     async def _status(self, req: Request) -> Response:
         if self.deployed is None:
@@ -414,12 +444,15 @@ class EngineServer:
         """
         if self._reload_lock is None:
             self._reload_lock = asyncio.Lock()
-        async with self._reload_lock:
+        async with tracing.span("engine.reload",
+                                generation=self.reload_generation) as sp, \
+                self._reload_lock:
             factory = self.engine_factory or (
                 self.deployed.instance.engine_factory
                 if self.deployed is not None else None)
             if factory is None:
                 self._m_reloads.inc(("failed",))
+                sp.set_error("no engine factory known")
                 return Response.json(
                     {"message": "reload failed: no engine factory known"},
                     status=500)
@@ -429,6 +462,7 @@ class EngineServer:
                     self.variant_id)
             except Exception as e:
                 self._m_reloads.inc(("failed",))
+                sp.set_error(f"reload failed: {e}")
                 return Response.json(
                     {"message": f"reload failed: {e}"}, status=500)
             probe = self._last_good_query
@@ -442,6 +476,7 @@ class EngineServer:
                 except Exception as e:
                     old = self.deployed
                     self._m_reloads.inc(("rolled_back",))
+                    sp.set_error("probe query failed; rolled back")
                     kept = (old.instance.id if old is not None else None)
                     return Response.json(
                         {"message": "reload rolled back: probe query failed: "
@@ -452,6 +487,7 @@ class EngineServer:
             self.reload_generation += 1
             self._m_reload_gen.set(self.reload_generation)
             self._m_reloads.inc(("ok",))
+            sp.set_attr("result", "ok")
             self._load_error = None
             return Response.json({"message": "Reloaded",
                                   "engineInstanceId": new.instance.id,

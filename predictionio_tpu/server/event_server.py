@@ -32,9 +32,16 @@ from predictionio_tpu.data.event import (
     parse_event_time,
     utcnow,
 )
-from predictionio_tpu.server.http import HTTPServer, Request, Response, Router
+from predictionio_tpu.server.http import (
+    HTTPServer,
+    Request,
+    Response,
+    Router,
+    traces_handler,
+)
 from predictionio_tpu.server.ingest import IngestOverload, StorageUnavailable
 from predictionio_tpu.storage.registry import Storage, get_storage
+from predictionio_tpu.utils import tracing
 
 BATCH_LIMIT = 50
 DEFAULT_FIND_LIMIT = 20
@@ -158,6 +165,7 @@ class EventServer:
         ingest_queue_depth: int = 4096,
         auth_cache_ttl: float = 30.0,
         durable_acks: bool = False,
+        access_log: bool = False,
     ) -> None:
         self.storage = storage or get_storage()
         if durable_acks:
@@ -187,6 +195,7 @@ class EventServer:
         router.route("GET", "/", self._status)
         router.route("GET", "/health", self._health)
         router.route("GET", "/metrics", self._metrics)
+        router.route("GET", "/traces", traces_handler)
         router.route("POST", "/events.json", self._post_event)
         router.route("GET", "/events.json", self._get_events)
         router.route("POST", "/batch/events.json", self._post_batch)
@@ -201,7 +210,9 @@ class EventServer:
         self.http = HTTPServer(router, host, port,
                                ssl_context=ssl_context,
                                bind_retries=bind_retries,
-                               bind_retry_sec=bind_retry_sec)
+                               bind_retry_sec=bind_retry_sec,
+                               access_log=access_log,
+                               server_name="events")
 
     # -- auth ------------------------------------------------------------------
 
@@ -317,7 +328,8 @@ class EventServer:
         ev, err = self._prepare_one(obj, app_id, channel_id, allowed)
         if err is not None:
             return err
-        eid = self.storage.events.insert(ev, app_id, channel_id)
+        with tracing.span("storage.insert", app_id=app_id):
+            eid = self.storage.events.insert(ev, app_id, channel_id)
         self._finish_one(ev, app_id, channel_id, time.perf_counter() - t0)
         return 201, {"eventId": eid}
 
@@ -341,7 +353,12 @@ class EventServer:
             status, body = err
             return Response.json(body, status=status)
         try:
-            eid = await self._ingest.submit(ev, app_id, channel_id)
+            # the submit span covers queue wait + group commit; the ack
+            # arrives only after the coalescer's detached ingest.commit
+            # span (which lists this trace id in its links) has landed
+            async with tracing.span("ingest.submit", app_id=app_id,
+                                    queue_depth=self._ingest.depth):
+                eid = await self._ingest.submit(ev, app_id, channel_id)
         except IngestOverload as e:
             self._m_events.inc((app_id, 429))
             resp = Response.json({"message": str(e)}, status=429)
@@ -398,8 +415,10 @@ class EventServer:
                 # per-item status array stays accurate
                 events = [ev for ev, _ in prepared]
                 try:
-                    ids = self.storage.events.insert_batch(
-                        events, app_id, channel_id)
+                    with tracing.span("storage.insert_batch",
+                                      app_id=app_id, records=len(events)):
+                        ids = self.storage.events.insert_batch(
+                            events, app_id, channel_id)
                 except Exception:
                     pass
                 else:

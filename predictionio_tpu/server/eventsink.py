@@ -38,7 +38,7 @@ from abc import ABC, abstractmethod
 from typing import Any, Dict, Optional
 
 from predictionio_tpu.data.event import Event
-from predictionio_tpu.utils import faults
+from predictionio_tpu.utils import faults, tracing
 from predictionio_tpu.utils.resilience import CircuitBreaker, retry_with_backoff
 
 
@@ -93,14 +93,15 @@ class HTTPEventSink(EventSink):
         # is best-effort and must not occupy its worker for long), but
         # NOT client errors: a 4xx (bad key, bad event) is deterministic
         # and retrying it just hammers the Event Server
-        attempt = retry_with_backoff(
-            self.retries, base=0.05, cap=0.5,
-            retry_on=(OSError, RuntimeError),
-        )(self._post)
-        if self.breaker is not None:
-            self.breaker.call(attempt, event)
-        else:
-            attempt(event)
+        with tracing.span("sink.send", sink="http", url=self.url):
+            attempt = retry_with_backoff(
+                self.retries, base=0.05, cap=0.5,
+                retry_on=(OSError, RuntimeError),
+            )(self._post)
+            if self.breaker is not None:
+                self.breaker.call(attempt, event)
+            else:
+                attempt(event)
 
 
 class DirectEventSink(EventSink):
@@ -111,8 +112,9 @@ class DirectEventSink(EventSink):
         self.app_name = app_name
 
     def send(self, event: Event) -> None:
-        faults.inject("eventsink.send")
-        app = self.storage.meta.get_app_by_name(self.app_name)
-        if app is None:
-            raise ValueError(f"no app named {self.app_name!r}")
-        self.storage.events.insert(event, app.id)
+        with tracing.span("sink.send", sink="direct", app=self.app_name):
+            faults.inject("eventsink.send")
+            app = self.storage.meta.get_app_by_name(self.app_name)
+            if app is None:
+                raise ValueError(f"no app named {self.app_name!r}")
+            self.storage.events.insert(event, app.id)

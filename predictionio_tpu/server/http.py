@@ -12,11 +12,19 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import re
+import time
 import traceback
 import urllib.parse
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+
+from predictionio_tpu.utils import tracing
+
+#: structured access log — one JSON line per request when the server is
+#: constructed with ``access_log=True`` (``--access-log``)
+access_logger = logging.getLogger("pio.access")
 
 MAX_BODY = 64 * 1024 * 1024
 MAX_HEADER = 64 * 1024
@@ -83,6 +91,23 @@ class Response:
 
 Handler = Callable[[Request], Awaitable[Response]]
 
+
+async def traces_handler(req: Request) -> Response:
+    """``GET /traces`` — recent spans from the tracer's ring buffer,
+    filterable by ``?trace_id=``, ``?min_ms=``, ``?error=1``,
+    ``?limit=``. Mounted by both servers."""
+    try:
+        raw_min = req.param("min_ms")
+        min_ms = float(raw_min) if raw_min else None
+        limit = int(req.param("limit") or "100")
+    except ValueError:
+        return Response.json(
+            {"message": "min_ms and limit must be numeric"}, status=400)
+    errors_only = (req.param("error") or "") in ("1", "true", "yes")
+    return Response.json(tracing.traces_payload(
+        trace_id=req.param("trace_id"), min_ms=min_ms,
+        errors_only=errors_only, limit=max(1, min(limit, 1000))))
+
 _REASONS = {
     200: "OK", 201: "Created", 400: "Bad Request", 401: "Unauthorized",
     403: "Forbidden", 404: "Not Found", 405: "Method Not Allowed",
@@ -148,10 +173,16 @@ class Router:
 class HTTPServer:
     def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 8000,
                  ssl_context: Optional[Any] = None,
-                 bind_retries: int = 0, bind_retry_sec: float = 1.0) -> None:
+                 bind_retries: int = 0, bind_retry_sec: float = 1.0,
+                 access_log: bool = False,
+                 server_name: str = "http") -> None:
         self.router = router
         self.host = host
         self.port = port
+        #: one JSON line per request on the ``pio.access`` logger
+        self.access_log = access_log
+        #: tags the root span so /traces can tell the two servers apart
+        self.server_name = server_name
         #: optional ssl.SSLContext (see server.ssl_config) → HTTPS
         self.ssl_context = ssl_context
         #: port-in-use bind retry (the reference's MasterActor retries
@@ -244,6 +275,40 @@ class HTTPServer:
                 pass
 
     async def _dispatch(self, req: Request) -> Response:
+        """Root span + propagation headers + access log around the
+        route. The disabled-everything path falls straight through to
+        the router — tracing off must cost nothing measurable."""
+        if not tracing.TRACER.enabled and not self.access_log:
+            return await self._route(req)
+        t0 = time.perf_counter()
+        trace_id = ""
+        if tracing.TRACER.enabled:
+            in_trace, in_parent, in_sampled = tracing.extract_headers(
+                req.headers)
+            async with tracing.root_span(
+                    "http.request", trace_id=in_trace,
+                    parent_span_id=in_parent, sampled=in_sampled,
+                    server=self.server_name, method=req.method,
+                    path=req.path) as sp:
+                resp = await self._route(req)
+                sp.set_attr("status", resp.status)
+                if resp.status >= 500:
+                    sp.set_error(f"HTTP {resp.status}")
+                trace_id = sp.trace_id
+            if trace_id:
+                resp.headers["X-PIO-Trace-Id"] = trace_id
+        else:
+            resp = await self._route(req)
+        if self.access_log:
+            access_logger.info(json.dumps(
+                {"server": self.server_name, "method": req.method,
+                 "path": req.path, "status": resp.status,
+                 "duration_ms": round((time.perf_counter() - t0) * 1000, 3),
+                 "trace_id": trace_id or None},
+                separators=(",", ":")))
+        return resp
+
+    async def _route(self, req: Request) -> Response:
         found = self.router.match(req.method, req.path)
         if found is None:
             return Response.json({"message": "Not Found"}, status=404)

@@ -52,7 +52,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from predictionio_tpu.data.event import Event
-from predictionio_tpu.utils import faults
+from predictionio_tpu.utils import faults, tracing
 from predictionio_tpu.utils.resilience import CircuitBreaker
 
 _BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -169,8 +169,12 @@ class WriteCoalescer:
         self.submitted += 1
         # hot path: put_nowait (the queue is unbounded — depth limiting
         # happened above) skips a coroutine round trip per event, and
-        # the depth gauge is refreshed once per dispatch in _collect()
-        self._queue.put_nowait((app_id, channel_id, event, fut))
+        # the depth gauge is refreshed once per dispatch in _collect().
+        # The submitter's trace id rides along: the commit serves many
+        # requests' traces, so its span LINKS to them instead of
+        # parenting under any one (contextvars don't survive the queue)
+        self._queue.put_nowait(
+            (app_id, channel_id, event, fut, tracing.current_trace_id()))
         return await fut
 
     # -- committer -------------------------------------------------------------
@@ -231,53 +235,67 @@ class WriteCoalescer:
     async def _commit(self, items: List[tuple]) -> None:
         """Group by (app, channel), one ``insert_batch`` per group."""
         groups: Dict[Tuple[int, Optional[int]], List[tuple]] = {}
-        for app_id, channel_id, event, fut in items:
-            groups.setdefault((app_id, channel_id), []).append((event, fut))
+        for app_id, channel_id, event, fut, trace_id in items:
+            groups.setdefault((app_id, channel_id), []).append(
+                (event, fut, trace_id))
         loop = asyncio.get_running_loop()
         ex = self._get_executor()
         for (app_id, channel_id), pairs in groups.items():
-            events = [e for e, _ in pairs]
+            events = [e for e, _, _ in pairs]
+            # the commit serves MANY requests' traces: a detached root
+            # span that links every submitter's trace id, so any one of
+            # them finds its batched ack in /traces or the JSONL export
+            links = sorted({t for _, _, t in pairs if t})[:64]
             self.batches += 1
             t0 = time.perf_counter()
-            try:
-                ids = await loop.run_in_executor(
-                    ex, self._insert_batch_guarded, events, app_id, channel_id)
-                if len(ids) != len(events):
-                    raise RuntimeError(
-                        f"insert_batch returned {len(ids)} ids for "
-                        f"{len(events)} events")
-            except Exception as e:
-                self.breaker.record_failure()
-                if len(pairs) == 1:
-                    if not pairs[0][1].done():
-                        pairs[0][1].set_exception(e)
-                    continue
-                # a poison event must not fail its commit siblings, and
-                # each caller must see their OWN error — re-run alone
-                self.isolations += 1
-                for event, fut in pairs:
-                    if fut.done():
+            with tracing.detached_span(
+                    "ingest.commit", app_id=app_id,
+                    records=len(events),
+                    link_traces=links) as sp:
+                try:
+                    ids = await loop.run_in_executor(
+                        ex, self._insert_batch_guarded, events, app_id,
+                        channel_id)
+                    if len(ids) != len(events):
+                        raise RuntimeError(
+                            f"insert_batch returned {len(ids)} ids for "
+                            f"{len(events)} events")
+                except Exception as e:
+                    self.breaker.record_failure()
+                    sp.set_error(f"{type(e).__name__}: {e}")
+                    if len(pairs) == 1:
+                        if not pairs[0][1].done():
+                            pairs[0][1].set_exception(e)
                         continue
-                    try:
-                        eid = await loop.run_in_executor(
-                            ex, self._insert_one_guarded, event, app_id,
-                            channel_id)
-                    except Exception as single_e:
-                        if not fut.done():
-                            fut.set_exception(single_e)
-                    else:
-                        # storage demonstrably works — the group failure
-                        # was a poison event, not an outage
-                        self.breaker.record_success()
-                        if not fut.done():
-                            fut.set_result(eid)
-                continue
+                    # a poison event must not fail its commit siblings,
+                    # and each caller must see their OWN error — re-run
+                    # alone
+                    self.isolations += 1
+                    sp.set_attr("isolated", True)
+                    for event, fut, _ in pairs:
+                        if fut.done():
+                            continue
+                        try:
+                            eid = await loop.run_in_executor(
+                                ex, self._insert_one_guarded, event, app_id,
+                                channel_id)
+                        except Exception as single_e:
+                            if not fut.done():
+                                fut.set_exception(single_e)
+                        else:
+                            # storage demonstrably works — the group
+                            # failure was a poison event, not an outage
+                            self.breaker.record_success()
+                            if not fut.done():
+                                fut.set_result(eid)
+                    continue
             self.breaker.record_success()
-            self._m_commit.observe(time.perf_counter() - t0)
+            self._m_commit.observe(time.perf_counter() - t0,
+                                   exemplar=links[0] if links else None)
             self._m_batch.observe(len(events))
             if len(events) > 1:
                 self._m_coalesced.inc(n=len(events))
-            for (_, fut), eid in zip(pairs, ids):
+            for (_, fut, _), eid in zip(pairs, ids):
                 if not fut.done():
                     fut.set_result(eid)
 

@@ -794,7 +794,12 @@ class ESEventStore(EventStore):
                 yield (d["event"], d["entityId"], tgt,
                        d.get("properties"), int(t_us))
 
-        return columnar_from_rows(rows(), value_key)
+        cols = columnar_from_rows(rows(), value_key)
+        if cols is not None:
+            from predictionio_tpu.utils import tracing
+
+            tracing.add_attrs(scan_backend="index", scan_records=int(cols.n))
+        return cols
 
     @property
     def cache_identity(self) -> Optional[str]:  # type: ignore[override]

@@ -24,7 +24,7 @@ import re
 from typing import Any, Callable, List, Optional
 
 from predictionio_tpu.storage.models import ModelStore
-from predictionio_tpu.utils import faults, integrity
+from predictionio_tpu.utils import faults, integrity, tracing
 from predictionio_tpu.utils.resilience import CircuitBreaker, retry_with_backoff
 
 
@@ -52,6 +52,7 @@ class _ResilientCalls:
     _breakers: dict = {}
 
     def _init_resilience(self, kind: str, retries: int = 2) -> None:
+        self._kind = kind
         self._fault_site = f"models.{kind}"
         self._retries = retries
         breaker = _ResilientCalls._breakers.get(kind)
@@ -124,13 +125,15 @@ class S3ModelStore(_ResilientCalls, ModelStore):
 
     def put(self, instance_id: str, blob: bytes) -> None:
         key = self._key(instance_id)
-        # blob first, digest sidecar last: a failure between the two
-        # leaves a pair that get() refuses — fail-safe
-        self._call(lambda: self._s3.put_object(
-            Bucket=self.bucket, Key=key, Body=blob))
-        self._call(lambda: self._s3.put_object(
-            Bucket=self.bucket, Key=key + integrity.DIGEST_SUFFIX,
-            Body=integrity.sha256_hex(blob).encode("ascii")))
+        with tracing.span("storage.s3.put", instance_id=instance_id,
+                          bytes=len(blob)):
+            # blob first, digest sidecar last: a failure between the two
+            # leaves a pair that get() refuses — fail-safe
+            self._call(lambda: self._s3.put_object(
+                Bucket=self.bucket, Key=key, Body=blob))
+            self._call(lambda: self._s3.put_object(
+                Bucket=self.bucket, Key=key + integrity.DIGEST_SUFFIX,
+                Body=integrity.sha256_hex(blob).encode("ascii")))
 
     def get(self, instance_id: str) -> Optional[bytes]:
         key = self._key(instance_id)
@@ -152,15 +155,18 @@ class S3ModelStore(_ResilientCalls, ModelStore):
                 return None  # pre-integrity blob: accepted, fsck flags it
             return r["Body"].read()
 
-        blob = self._call(fetch)
-        if blob is None:
-            return None
-        expected = self._call(fetch_digest)
-        blob = faults.corrupt_bytes("data.corrupt.model", blob)
-        integrity.verify_blob(
-            blob, expected.decode("ascii") if expected else None,
-            "model", instance_id)
-        return blob
+        with tracing.span("storage.s3.get", instance_id=instance_id) as sp:
+            blob = self._call(fetch)
+            if blob is None:
+                sp.set_attr("found", False)
+                return None
+            sp.set_attr("bytes", len(blob))
+            expected = self._call(fetch_digest)
+            blob = faults.corrupt_bytes("data.corrupt.model", blob)
+            integrity.verify_blob(
+                blob, expected.decode("ascii") if expected else None,
+                "model", instance_id)
+            return blob
 
     def delete(self, instance_id: str) -> bool:
         key = self._key(instance_id)
@@ -231,8 +237,10 @@ class HDFSModelStore(_ResilientCalls, ModelStore):
                 f.write(integrity.sha256_hex(blob).encode("ascii"))
 
         # blob first, digest sidecar last — fail-safe ordering
-        self._call(write)
-        self._call(write_digest)
+        with tracing.span("storage.hdfs.put", instance_id=instance_id,
+                          bytes=len(blob)):
+            self._call(write)
+            self._call(write_digest)
 
     def get(self, instance_id: str) -> Optional[bytes]:
         from pyarrow import fs
@@ -254,15 +262,18 @@ class HDFSModelStore(_ResilientCalls, ModelStore):
             with self._fs.open_input_stream(side) as f:
                 return f.read()
 
-        blob = self._call(read)
-        if blob is None:
-            return None
-        expected = self._call(read_digest)
-        blob = faults.corrupt_bytes("data.corrupt.model", blob)
-        integrity.verify_blob(
-            blob, expected.decode("ascii") if expected else None,
-            "model", instance_id)
-        return blob
+        with tracing.span("storage.hdfs.get", instance_id=instance_id) as sp:
+            blob = self._call(read)
+            if blob is None:
+                sp.set_attr("found", False)
+                return None
+            sp.set_attr("bytes", len(blob))
+            expected = self._call(read_digest)
+            blob = faults.corrupt_bytes("data.corrupt.model", blob)
+            integrity.verify_blob(
+                blob, expected.decode("ascii") if expected else None,
+                "model", instance_id)
+            return blob
 
     def delete(self, instance_id: str) -> bool:
         from pyarrow import fs

@@ -5,7 +5,7 @@ parser dispatching every verb; unverified, SURVEY.md §3). Verb surface
 preserved: ``app`` (new/list/show/delete/data-delete/channel-new/
 channel-delete), ``accesskey`` (new/list/delete), ``eventserver``,
 ``train``, ``deploy``, ``undeploy``, ``eval``, ``batchpredict``,
-``export``, ``import``, ``status``, ``fsck``, ``dashboard``,
+``export``, ``import``, ``status``, ``fsck``, ``trace``, ``dashboard``,
 ``adminserver``, ``template``, ``build``, ``run``, ``shell``,
 ``version``. Where the
 reference shelled out to sbt/spark-submit, training runs in-process on
@@ -124,15 +124,49 @@ def cmd_accesskey(args: argparse.Namespace) -> None:
 # -- servers ------------------------------------------------------------------
 
 
+def _configure_tracing(args: argparse.Namespace) -> None:
+    """Arm the process-wide tracer from the shared server flags."""
+    if getattr(args, "access_log", False):
+        import logging
+
+        # the access log emits at INFO on "pio.access"; without a
+        # handler the stdlib lastResort (WARNING+) would drop every line
+        lg = logging.getLogger("pio.access")
+        if not lg.handlers:
+            h = logging.StreamHandler()
+            h.setFormatter(logging.Formatter("%(message)s"))
+            lg.addHandler(h)
+            lg.setLevel(logging.INFO)
+            lg.propagate = False
+    if not getattr(args, "tracing", False):
+        return
+    from predictionio_tpu.storage.registry import StorageConfig
+    from predictionio_tpu.utils import tracing
+
+    path = args.trace_file
+    if path is None:
+        path = tracing.default_trace_path(StorageConfig.from_env().home)
+    tracing.TRACER.configure(
+        enabled=True,
+        sample_rate=args.trace_sample,
+        slow_query_ms=args.slow_query_ms,
+        jsonl_path=path or None,
+    )
+    print(f"[info] tracing enabled (sample={args.trace_sample}, "
+          f"file={path or '(ring only)'})")
+
+
 def cmd_eventserver(args: argparse.Namespace) -> None:
     from predictionio_tpu.server.event_server import EventServer
 
+    _configure_tracing(args)
     server = EventServer(host=args.ip, port=args.port, stats=args.stats,
                          ingest_batching=args.ingest_batching,
                          ingest_max_batch=args.ingest_max_batch,
                          ingest_queue_depth=args.ingest_queue_depth,
                          auth_cache_ttl=args.auth_cache_ttl,
-                         durable_acks=args.durable_acks)
+                         durable_acks=args.durable_acks,
+                         access_log=args.access_log)
     mode = "group-commit" if args.ingest_batching else "per-event commit"
     print(f"[info] Event Server listening on {args.ip}:{args.port} ({mode})")
     server.run()
@@ -141,6 +175,7 @@ def cmd_eventserver(args: argparse.Namespace) -> None:
 def cmd_deploy(args: argparse.Namespace) -> None:
     from predictionio_tpu.server.engine_server import EngineServer
 
+    _configure_tracing(args)
     variant = _load_variant_file(args.engine_dir, args.variant)
     factory = variant.get("engineFactory") or _die("engine.json missing engineFactory")
     sys.path.insert(0, os.path.abspath(args.engine_dir))
@@ -158,6 +193,7 @@ def cmd_deploy(args: argparse.Namespace) -> None:
         batch_wait_ms=args.batch_wait_ms,
         query_timeout_ms=args.query_timeout_ms,
         max_inflight=args.max_inflight,
+        access_log=args.access_log,
     )
     print(f"[info] Engine Server (instance {server.deployed.instance.id}) "
           f"listening on {args.ip}:{args.port}")
@@ -343,6 +379,63 @@ def cmd_fsck(args: argparse.Namespace) -> None:
         raise SystemExit(3)
 
 
+def cmd_trace(args: argparse.Namespace) -> None:
+    """Tail/grep the span JSONL export written by servers running with
+    ``--tracing``. Filters compose; ``--tree`` re-assembles whole traces
+    into the same indented view the slow-query log prints."""
+    from predictionio_tpu.storage.registry import StorageConfig
+    from predictionio_tpu.utils import tracing
+
+    path = args.file or tracing.default_trace_path(
+        StorageConfig.from_env().home)
+    # include the rotated predecessor so recent history survives rotation
+    paths = [p for p in (path + ".1", path) if os.path.exists(p)]
+    if not paths:
+        _die(f"no trace file at {path} (start a server with --tracing)")
+    spans: List[Dict[str, Any]] = []
+    for fp in paths:
+        with open(fp, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    spans.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail from a live writer
+
+    def keep(s: Dict[str, Any]) -> bool:
+        if args.trace_id and s.get("traceId") != args.trace_id:
+            return False
+        if args.errors_only and s.get("status") != "error":
+            return False
+        if args.min_ms and s.get("durationUs", 0) < args.min_ms * 1000:
+            return False
+        if args.grep and args.grep not in json.dumps(s, sort_keys=True):
+            return False
+        return True
+
+    spans = [s for s in spans if keep(s)]
+    if not spans:
+        print("[info] no spans matched")
+        return
+    if args.tree:
+        by_trace: Dict[str, List[Dict[str, Any]]] = {}
+        order: List[str] = []
+        for s in spans:
+            tid = str(s.get("traceId", "?"))
+            if tid not in by_trace:
+                by_trace[tid] = []
+                order.append(tid)
+            by_trace[tid].append(s)
+        for tid in order[-args.limit:]:
+            print(f"trace {tid}:")
+            print(tracing.render_trace_tree(by_trace[tid]))
+    else:
+        for s in spans[-args.limit:]:
+            print(json.dumps(s, sort_keys=True))
+
+
 def cmd_dashboard(args: argparse.Namespace) -> None:
     from predictionio_tpu.tools.dashboard import Dashboard
 
@@ -452,6 +545,30 @@ def cmd_shell(args: argparse.Namespace) -> None:
 # -- parser -------------------------------------------------------------------
 
 
+def _add_observability_flags(sp: argparse.ArgumentParser) -> None:
+    """Tracing/access-log flags shared by ``eventserver`` and ``deploy``."""
+    sp.add_argument("--tracing", action="store_true",
+                    help="request-scoped tracing: root span per request, "
+                         "child spans through ingest/serving/storage, "
+                         "ring-buffered for /traces and exported to a "
+                         "span JSONL file (see `pio trace`)")
+    sp.add_argument("--trace-sample", type=float, default=1.0,
+                    help="probability a trace is exported to the JSONL "
+                         "file; errors and slow spans always export "
+                         "(ring buffer + /traces see every span)")
+    sp.add_argument("--trace-file",
+                    help="span JSONL path (default: "
+                         "<home>/traces/spans.jsonl; '' = ring only)")
+    sp.add_argument("--slow-query-ms", type=float, default=0.0,
+                    help="log the full span tree of any request slower "
+                         "than this, regardless of sampling "
+                         "(0 = disabled)")
+    sp.add_argument("--access-log", action="store_true",
+                    help="one structured JSON line per request (method, "
+                         "path, status, duration, trace id) on the "
+                         "'pio.access' logger")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="pio", description="TPU-native PredictionIO")
     p.add_argument("--version", action="version", version=__version__)
@@ -498,6 +615,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="access-key/channel auth cache TTL seconds "
                          "(0 disables; in-process key mutations "
                          "invalidate immediately regardless)")
+    _add_observability_flags(es)
     es.set_defaults(fn=cmd_eventserver)
 
     tr = sub.add_parser("train", help="train an engine")
@@ -544,6 +662,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="concurrent query cap; excess requests are shed "
                          "immediately with 503 + Retry-After "
                          "(0 = unlimited)")
+    _add_observability_flags(dp)
     dp.set_defaults(fn=cmd_deploy)
 
     ud = sub.add_parser("undeploy", help="stop a running engine server")
@@ -597,6 +716,25 @@ def build_parser() -> argparse.ArgumentParser:
     fs.add_argument("--json", action="store_true",
                     help="emit the full report as one JSON document")
     fs.set_defaults(fn=cmd_fsck)
+
+    tc = sub.add_parser(
+        "trace",
+        help="tail/grep exported trace spans (JSONL written by servers "
+             "started with --tracing)")
+    tc.add_argument("--file", help="span JSONL path "
+                                   "(default: <home>/traces/spans.jsonl)")
+    tc.add_argument("--trace-id", help="only spans of this trace id")
+    tc.add_argument("--min-ms", type=float, default=0.0,
+                    help="only spans at least this many ms long")
+    tc.add_argument("--errors-only", action="store_true",
+                    help="only spans that finished in error")
+    tc.add_argument("--grep", help="substring filter over the span JSON")
+    tc.add_argument("--tree", action="store_true",
+                    help="group by trace and render indented span trees")
+    tc.add_argument("--limit", type=int, default=50,
+                    help="print at most the newest N spans (or traces "
+                         "with --tree)")
+    tc.set_defaults(fn=cmd_trace)
 
     dm = sub.add_parser(
         "daemon",

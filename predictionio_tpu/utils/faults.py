@@ -44,6 +44,8 @@ Known sites (grep ``faults.inject`` for the authoritative list):
 ``ingest.commit``       coalescer group commit (event storage down)
 ``models.s3``           S3 model-store operations
 ``models.hdfs``         HDFS model-store operations
+``trace.export``        span export (ring + JSONL) — fail-open: an armed
+                        error here must never fail the traced request
 ``data.corrupt.eventlog``  byte-flip on ``pio fsck`` eventlog reads
 ``data.corrupt.snapshot``  byte-flip on snapshot npz load
 ``data.corrupt.model``     byte-flip on model-blob load/download

@@ -80,36 +80,84 @@ class Gauge:
 
 
 class Histogram:
+    """Labelled like Counter/Gauge: one bucket-counts series per label
+    tuple. ``observe`` also takes an optional trace-id **exemplar**;
+    the last exemplar per (labels, bucket) is kept so a latency bucket
+    can name a concrete trace to pull up in ``/traces``. Exemplars stay
+    out of the text exposition (plain-Prometheus parsers reject the
+    OpenMetrics ``#`` syntax) — read them via :meth:`exemplar`."""
+
     def __init__(self, name: str, help: str,
-                 buckets: Sequence[float] = _DEFAULT_BUCKETS) -> None:
+                 buckets: Sequence[float] = _DEFAULT_BUCKETS,
+                 labelnames: Sequence[str] = ()) -> None:
         self.name, self.help = name, help
         self.buckets = tuple(sorted(buckets))
-        self._counts = [0] * (len(self.buckets) + 1)  # +inf tail
-        self._sum = 0.0
+        self.labelnames = tuple(labelnames)
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        # (labels key, bucket index) -> (trace id, observed value)
+        self._exemplars: Dict[Tuple[Tuple[str, ...], int],
+                              Tuple[str, float]] = {}
         self._lock = threading.Lock()
+        if not self.labelnames:
+            # an unlabelled histogram exposes zeroed buckets from birth
+            # (pre-labels behaviour); labelled series appear on first use
+            self._counts[()] = [0] * (len(self.buckets) + 1)
+            self._sums[()] = 0.0
 
-    def observe(self, value: float) -> None:
+    def _bucket_index(self, value: float) -> int:
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                return i
+        return len(self.buckets)  # +Inf tail
+
+    def observe(self, value: float, labels: Sequence[str] = (),
+                exemplar: Optional[str] = None) -> None:
+        key = tuple(str(l) for l in labels)
+        i = self._bucket_index(value)
         with self._lock:
-            self._sum += value
-            for i, b in enumerate(self.buckets):
-                if value <= b:
-                    self._counts[i] += 1
-                    return
-            self._counts[-1] += 1
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = self._counts[key] = [0] * (len(self.buckets) + 1)
+                self._sums[key] = 0.0
+            counts[i] += 1
+            self._sums[key] += value
+            if exemplar:
+                self._exemplars[(key, i)] = (exemplar, value)
+
+    def exemplar(self, le: float | str,
+                 labels: Sequence[str] = ()) -> Optional[Tuple[str, float]]:
+        """Last (trace id, value) observed in the bucket whose upper
+        bound is ``le`` (``"+Inf"`` for the tail), or None."""
+        key = tuple(str(l) for l in labels)
+        if le == "+Inf":
+            i = len(self.buckets)
+        else:
+            try:
+                i = self.buckets.index(float(le))
+            except ValueError:
+                return None
+        with self._lock:
+            return self._exemplars.get((key, i))
 
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
         with self._lock:
-            counts, total_sum = list(self._counts), self._sum
-        cum = 0
-        for b, c in zip(self.buckets, counts):
-            cum += c
-            out.append(f'{self.name}_bucket{{le="{_num(b)}"}} {cum}')
-        cum += counts[-1]
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-        out.append(f"{self.name}_sum {_num(total_sum)}")
-        out.append(f"{self.name}_count {cum}")
+            items = sorted((k, list(c), self._sums[k])
+                           for k, c in self._counts.items())
+        for key, counts, total_sum in items:
+            base = list(zip(self.labelnames, key))
+            cum = 0
+            for b, c in zip(self.buckets, counts):
+                cum += c
+                out.append(f"{self.name}_bucket"
+                           f"{_label_str(base + [('le', _num(b))])} {cum}")
+            cum += counts[-1]
+            out.append(f"{self.name}_bucket"
+                       f"{_label_str(base + [('le', '+Inf')])} {cum}")
+            out.append(f"{self.name}_sum{_label_str(base)} {_num(total_sum)}")
+            out.append(f"{self.name}_count{_label_str(base)} {cum}")
         return out
 
 
@@ -151,18 +199,23 @@ class Registry:
             return m
 
     def histogram(self, name: str, help: str,
-                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+                  buckets: Optional[Sequence[float]] = None,
+                  labelnames: Sequence[str] = ()) -> Histogram:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
                 m = self._metrics[name] = Histogram(
-                    name, help, buckets or _DEFAULT_BUCKETS)
+                    name, help, buckets or _DEFAULT_BUCKETS, labelnames)
             elif not isinstance(m, Histogram):
                 raise ValueError(f"metric {name!r} already a {type(m).__name__}")
             elif buckets is not None and m.buckets != tuple(sorted(buckets)):
                 raise ValueError(
                     f"metric {name!r} already registered with buckets "
                     f"{m.buckets}, requested {tuple(sorted(buckets))}")
+            elif m.labelnames != tuple(labelnames):
+                raise ValueError(
+                    f"metric {name!r} already registered with labels "
+                    f"{m.labelnames}, requested {tuple(labelnames)}")
             return m
 
     def render(self) -> str:
@@ -179,6 +232,12 @@ def _labels(names: Sequence[str], values: Sequence[str]) -> str:
         return ""
     pairs = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
     return "{" + pairs + "}"
+
+
+def _label_str(pairs: Sequence[Tuple[str, str]]) -> str:
+    if not pairs:
+        return ""
+    return "{" + ",".join(f'{n}="{v}"' for n, v in pairs) + "}"
 
 
 def _num(v: float) -> str:

@@ -108,12 +108,17 @@ class TestQueryDeadline:
                               host="127.0.0.1", port=port)
         with ServerThread(server):
             base = f"http://127.0.0.1:{port}"
-            before_hist = sum(server._m_latency._counts)
+
+            def hist_total():
+                # labelled histogram: one bucket-count series per status
+                return sum(sum(c) for c in server._m_latency._counts.values())
+
+            before_hist = hist_total()
             before_400 = server._m_queries._values.get(("400",), 0)
             code, _ = http("POST", f"{base}/queries.json", {"nope": 1})
             assert code == 400
             assert server._m_queries._values.get(("400",), 0) == before_400 + 1
-            assert sum(server._m_latency._counts) == before_hist + 1
+            assert hist_total() == before_hist + 1
 
 
 class TestLoadShedding:
