@@ -11,13 +11,17 @@ per event.
 
 Wire format (shared with the C++ side): see eventlog.cc header comment.
 Single-writer per namespace file; in-process thread safety via the
-engine's per-handle mutex.
+engine's per-handle mutex plus a per-namespace writer lock that covers
+segment rollover (see :mod:`predictionio_tpu.data.segments` for the
+partitioned/tiered layout this store manages per namespace).
 """
 
 from __future__ import annotations
 
 import ctypes
 import datetime as _dt
+import heapq
+import itertools
 import json
 import os
 import struct
@@ -30,6 +34,12 @@ from predictionio_tpu.data.event import (
     validate_event,
 )
 from predictionio_tpu.data.events import EventStore, _ts as _ts_us
+from predictionio_tpu.data.segments import (
+    LogNamespace,
+    SegmentMaintenance,
+    scan_workers_default,
+    segment_bytes_threshold,
+)
 from predictionio_tpu.utils import tracing
 
 _UNBOUNDED_LO = -(2**62)
@@ -112,8 +122,13 @@ class NativeEventLogStore(EventStore):
         self._lib = lib
         self._dir = directory
         os.makedirs(directory, exist_ok=True)
-        self._handles: Dict[Tuple[int, Optional[int]], int] = {}
+        self._namespaces: Dict[Tuple[int, Optional[int]], LogNamespace] = {}
         self._lock = threading.RLock()
+        # segment rollover threshold (PIO_SEGMENT_BYTES; 0 disables) and
+        # scan fan-out width (None → PIO_SCAN_WORKERS / cpu default)
+        self.segment_bytes = segment_bytes_threshold()
+        self.scan_workers: Optional[int] = None
+        self._maintenance: Optional[SegmentMaintenance] = None
         # snapshot-cache key component: same directory ⇒ same log
         self.cache_identity = "eventlog:" + os.path.abspath(directory)
         # floor for append_jsonl's defaulted timestamps — a chunk
@@ -134,24 +149,45 @@ class NativeEventLogStore(EventStore):
             f"_{channel_id}" if channel_id is not None else "")
         return os.path.join(self._dir, name + ".pel")
 
-    def _handle(self, app_id: int, channel_id: Optional[int]) -> int:
+    def _ns(self, app_id: int, channel_id: Optional[int]) -> LogNamespace:
         key = (app_id, channel_id)
         with self._lock:
-            h = self._handles.get(key)
-            if h is None:
+            ns = self._namespaces.get(key)
+            if ns is None:
                 # PIO_EVENTLOG_FORMAT=1 writes legacy (un-checksummed)
                 # frames into FRESH files — the profile_events.py CRC
                 # overhead A/B. Existing files always keep their
                 # on-disk format regardless.
                 fmt = 1 if os.environ.get(
                     "PIO_EVENTLOG_FORMAT", "2") == "1" else 2
-                h = self._lib.pel_open_ex(
-                    self._path(app_id, channel_id).encode(), fmt)
-                if not h:
-                    raise IOError(f"cannot open event log for app {app_id}")
-                self._handles[key] = h
-                self._account_recovery(h)
-            return h
+                ns = LogNamespace(
+                    self._lib, self._path(app_id, channel_id), fmt)
+                self._namespaces[key] = ns
+                self._account_recovery(ns.h)
+            return ns
+
+    def _handle(self, app_id: int, channel_id: Optional[int]) -> int:
+        """The ACTIVE segment's engine handle."""
+        return self._ns(app_id, channel_id).h
+
+    def namespaces(self) -> List[LogNamespace]:
+        with self._lock:
+            return list(self._namespaces.values())
+
+    def _scan_workers(self) -> int:
+        return (self.scan_workers if self.scan_workers
+                else scan_workers_default())
+
+    def start_maintenance(self, interval: float = 30.0,
+                          keep_local: int = 2) -> SegmentMaintenance:
+        """Start (or return) the background compaction/cold-tier
+        maintenance thread for this store."""
+        with self._lock:
+            if self._maintenance is None or not self._maintenance.is_alive():
+                self._maintenance = SegmentMaintenance(
+                    self, interval=interval, keep_local=keep_local)
+                self._maintenance.start()
+            return self._maintenance
 
     def _account_recovery(self, h: int) -> None:
         """Surface the engine's open-time recovery report (pel_info)
@@ -181,24 +217,28 @@ class NativeEventLogStore(EventStore):
     # -- lifecycle ----------------------------------------------------------
 
     def init_channel(self, app_id: int, channel_id: Optional[int] = None) -> None:
-        self._handle(app_id, channel_id)
+        self._ns(app_id, channel_id)
 
     def remove_channel(self, app_id: int, channel_id: Optional[int] = None) -> None:
         key = (app_id, channel_id)
         with self._lock:
-            h = self._handles.pop(key, None)
-            if h is not None:
-                self._lib.pel_close(h)
-            try:
-                os.unlink(self._path(app_id, channel_id))
-            except FileNotFoundError:
-                pass
+            ns = self._namespaces.pop(key, None)
+            if ns is not None:
+                ns.remove()
+            else:
+                try:
+                    os.unlink(self._path(app_id, channel_id))
+                except FileNotFoundError:
+                    pass
 
     def close(self) -> None:
         with self._lock:
-            for h in self._handles.values():
-                self._lib.pel_close(h)
-            self._handles.clear()
+            if self._maintenance is not None:
+                self._maintenance.stop()
+                self._maintenance = None
+            for ns in self._namespaces.values():
+                ns.close()
+            self._namespaces.clear()
 
     # -- writes -------------------------------------------------------------
 
@@ -218,21 +258,34 @@ class NativeEventLogStore(EventStore):
         # without leaving a partial prefix behind
         frames = []
         ids = []
+        client_ids = []
         for e in events:
             validate_event(e)
+            if e.event_id:
+                # caller-supplied id: may overwrite a copy that now
+                # lives in a sealed segment (generated ids cannot)
+                client_ids.append(e.event_id)
             e = e.with_id()
             frames.append(serialize_event(e))
             ids.append(e.event_id)
-        h = self._handle(app_id, channel_id)
-        for lo in range(0, len(frames), self._APPEND_CHUNK):
-            chunk = frames[lo:lo + self._APPEND_CHUNK]
-            buf = b"".join(chunk)
-            n = self._lib.pel_append_batch(h, buf, len(buf), len(chunk))
-            if n != len(chunk):
-                raise IOError(
-                    f"event log append failed ({lo + n}/{len(frames)})")
-        if self._durable and self._lib.pel_sync(h) != 0:
-            raise IOError("event log fsync failed")
+        ns = self._ns(app_id, channel_id)
+        # per-namespace writer lock: appends to different (app, channel)
+        # partitions never contend; rollover swaps the active handle
+        # under the same lock
+        with ns.lock:
+            h = ns.h
+            for lo in range(0, len(frames), self._APPEND_CHUNK):
+                chunk = frames[lo:lo + self._APPEND_CHUNK]
+                buf = b"".join(chunk)
+                n = self._lib.pel_append_batch(h, buf, len(buf), len(chunk))
+                if n != len(chunk):
+                    raise IOError(
+                        f"event log append failed ({lo + n}/{len(frames)})")
+            if self._durable and self._lib.pel_sync(h) != 0:
+                raise IOError("event log fsync failed")
+            if client_ids and ns.sealed:
+                ns.tombstone_sealed(client_ids)
+            ns.maybe_roll(self.segment_bytes)
         return ids  # type: ignore[return-value]
 
     def append_jsonl(
@@ -261,7 +314,7 @@ class NativeEventLogStore(EventStore):
         """
         import time as _time
 
-        h = self._handle(app_id, channel_id)
+        ns = self._ns(app_id, channel_id)
         status = ctypes.create_string_buffer(n_lines)
         now_us = int(_time.time() * 1e6)
         with self._lock:
@@ -269,46 +322,91 @@ class NativeEventLogStore(EventStore):
                 now_us = self._now_floor
             self._now_floor = now_us + n_lines
         seed = int.from_bytes(os.urandom(8), "little")
-        n = self._lib.pel_append_jsonl(
-            h, lines, len(lines), now_us, seed, status, n_lines, None)
-        if n < 0:
-            raise IOError("event log jsonl append failed")
-        if self._durable and self._lib.pel_sync(h) != 0:
-            raise IOError("event log fsync failed")
+        # custom eventIds may overwrite copies living in sealed
+        # segments: collect the accepted ids so tombstones propagate
+        want_ids = bool(ns.sealed) and b'"eventId"' in lines
+        ids_out = (ctypes.create_string_buffer(32 * n_lines)
+                   if want_ids else None)
+        with ns.lock:
+            h = ns.h
+            n = self._lib.pel_append_jsonl(
+                h, lines, len(lines), now_us, seed, status, n_lines,
+                ids_out)
+            if n < 0:
+                raise IOError("event log jsonl append failed")
+            if self._durable and self._lib.pel_sync(h) != 0:
+                raise IOError("event log fsync failed")
+            if want_ids and n > 0:
+                ids = []
+                raw = ids_out.raw  # type: ignore[union-attr]
+                unresolved = []
+                for i in range(n_lines):
+                    if status.raw[i] != 0:
+                        continue
+                    slot = raw[i * 32:(i + 1) * 32]
+                    if slot[0]:
+                        ids.append(slot.rstrip(b"\x00").decode())
+                    else:
+                        # non-32-char custom id: the engine cannot
+                        # report it — recover it from the line itself
+                        unresolved.append(i)
+                if unresolved:
+                    split = lines.split(b"\n")
+                    for i in unresolved:
+                        try:
+                            eid = json.loads(split[i]).get("eventId")
+                            if eid:
+                                ids.append(eid)
+                        except (ValueError, IndexError):
+                            pass
+                if ids:
+                    ns.tombstone_sealed(ids)
+            ns.maybe_roll(self.segment_bytes)
         fallback = [i for i in range(n_lines) if status.raw[i] == 1]
         return int(n), fallback
 
     def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
-        h = self._handle(app_id, channel_id)
+        ns = self._ns(app_id, channel_id)
         b = event_id.encode()
-        r = self._lib.pel_delete(h, b, len(b))
+        r = self._lib.pel_delete(ns.h, b, len(b))
         if r < 0:
             raise IOError("event log delete failed")
-        return bool(r)
+        if r:
+            return True
+        # not in the active segment — the live copy may sit in a
+        # sealed segment (each id is alive in at most one segment)
+        if ns.sealed:
+            return bool(ns.tombstone_sealed([event_id]))
+        return False
 
     def wipe(self, app_id: int, channel_id: Optional[int] = None) -> None:
-        h = self._handle(app_id, channel_id)
-        if self._lib.pel_wipe(h) != 0:
-            # the handle may have lost its backing FILE* — drop it from
-            # the cache so the next call reopens instead of segfaulting
+        ns = self._ns(app_id, channel_id)
+        if not ns.wipe():
+            # the active handle may have lost its backing FILE* — drop
+            # the namespace so the next call reopens instead of
+            # segfaulting
             with self._lock:
-                if self._handles.pop((app_id, channel_id), None) is not None:
-                    self._lib.pel_close(h)
+                if self._namespaces.pop((app_id, channel_id), None) is not None:
+                    ns.close()
             raise IOError("event log wipe failed")
 
     # -- reads --------------------------------------------------------------
 
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
-        h = self._handle(app_id, channel_id)
-        out = ctypes.c_void_p()
+        ns = self._ns(app_id, channel_id)
         b = event_id.encode()
-        n = self._lib.pel_get(h, b, len(b), ctypes.byref(out))
-        if n < 0:
-            raise IOError("event log get failed")
-        if n == 0:
-            return None
-        payload = self._take(out, n)
-        return deserialize_payload(payload, 0, len(payload))
+        # active first (freshest copy), then sealed newest→oldest
+        for h in itertools.chain(
+                (ns.h,),
+                (ns.handle_for(seg) for seg in ns.sealed[::-1])):
+            out = ctypes.c_void_p()
+            n = self._lib.pel_get(h, b, len(b), ctypes.byref(out))
+            if n < 0:
+                raise IOError("event log get failed")
+            if n:
+                payload = self._take(out, n)
+                return deserialize_payload(payload, 0, len(payload))
+        return None
 
     def find(
         self,
@@ -324,22 +422,52 @@ class NativeEventLogStore(EventStore):
         limit: Optional[int] = None,
         reversed: bool = False,
     ) -> Iterator[Event]:
-        h = self._handle(app_id, channel_id)
-        out = ctypes.c_void_p()
-        names = "\n".join(event_names).encode() if event_names is not None else None
-        n = self._lib.pel_find(
-            h,
+        ns = self._ns(app_id, channel_id)
+        args = (
             _ts_us(start_time) if start_time else _UNBOUNDED_LO,
             _ts_us(until_time) if until_time else _UNBOUNDED_HI,
             entity_type.encode() if entity_type is not None else None,
             entity_id.encode() if entity_id is not None else None,
             target_entity_type.encode() if target_entity_type is not None else None,
             target_entity_id.encode() if target_entity_id is not None else None,
-            names,
-            1 if reversed else 0,
+            "\n".join(event_names).encode() if event_names is not None else None,
+            bool(reversed),
             limit if (limit is not None and limit >= 0) else -1,
-            ctypes.byref(out),
         )
+        if not ns.sealed:
+            yield from self._find_one(ns.h, *args)
+            return
+        # each segment returns its matches already (eventTime,
+        # creationTime)-sorted; a stable k-way merge preserves the
+        # global order. Ties fall back to iterable order, so segments
+        # are listed in append order (reversed for descending scans) —
+        # identical to what a single-file scan's seq tiebreak yields,
+        # because rollover never splits identical (time, creation)
+        # runs across a seq inversion.
+        if reversed:
+            handles = itertools.chain(
+                (ns.h,), (ns.handle_for(s) for s in ns.sealed[::-1]))
+        else:
+            handles = itertools.chain(
+                (ns.handle_for(s) for s in ns.sealed), (ns.h,))
+        merged = heapq.merge(
+            *(self._find_one(h, *args) for h in handles),
+            key=lambda e: (e.event_time, e.creation_time),
+            reverse=bool(reversed))
+        if args[-1] >= 0:
+            merged = itertools.islice(merged, args[-1])
+        yield from merged
+
+    def _find_one(self, h: int, start_us: int, until_us: int,
+                  entity_type: Optional[bytes], entity_id: Optional[bytes],
+                  target_entity_type: Optional[bytes],
+                  target_entity_id: Optional[bytes], names: Optional[bytes],
+                  rev: bool, limit: int) -> Iterator[Event]:
+        out = ctypes.c_void_p()
+        n = self._lib.pel_find(
+            h, start_us, until_us, entity_type, entity_id,
+            target_entity_type, target_entity_id, names,
+            1 if rev else 0, limit, ctypes.byref(out))
         if n < 0:
             raise IOError("event log scan failed")
         buf = self._take(out, n)
@@ -358,7 +486,17 @@ class NativeEventLogStore(EventStore):
         chunks straight from C++ (Event.to_json_str key order;
         json-loads-equal — raw property spans re-emit verbatim). The
         cursor walks the time-sorted order; don't interleave writes."""
-        h = self._handle(app_id, channel_id)
+        ns = self._ns(app_id, channel_id)
+        if ns.sealed:
+            # partitioned namespace: the native export cursor is
+            # per-file, so stream the merged find() order instead
+            it = self.find(app_id, channel_id)
+            while True:
+                batch = list(itertools.islice(it, chunk_events))
+                if not batch:
+                    return
+                yield "".join(e.to_json_str() + "\n" for e in batch)
+        h = ns.h
         cursor = 0
         while True:
             out = ctypes.c_void_p()
@@ -411,7 +549,29 @@ class NativeEventLogStore(EventStore):
 
         from predictionio_tpu.data.pipeline import ColumnarEvents
 
-        h = self._handle(app_id, channel_id)
+        ns = self._ns(app_id, channel_id)
+        if ns.sealed:
+            # partitioned namespace: fan the scan out across segments
+            # (sidecar-served where compacted) and merge
+            cols = ns.scan_columnar(
+                _ts_us(start_time) if start_time else _UNBOUNDED_LO,
+                _ts_us(until_time) if until_time else _UNBOUNDED_HI,
+                created_after_us if created_after_us is not None
+                else _UNBOUNDED_LO,
+                created_until_us if created_until_us is not None
+                else _UNBOUNDED_HI,
+                entity_type, target_entity_type,
+                list(event_names) if event_names is not None else None,
+                value_key, workers=self._scan_workers())
+            if cols is not None:
+                detail = (ns.last_scan or {}).get("per_segment", [])
+                tracing.add_attrs(
+                    scan_backend="eventlog",
+                    scan_bytes=sum(s["bytes"] for s in detail),
+                    scan_records=int(cols.n))
+            return cols
+
+        h = ns.h
         out = ctypes.c_void_p()
         names = ("\n".join(event_names).encode()
                  if event_names is not None else None)
@@ -447,7 +607,12 @@ class NativeEventLogStore(EventStore):
 
         ne, n_ent, n_tgt, n_nam = struct.unpack_from("<QQQQ", buf, 0)
         tracing.add_attrs(scan_backend="eventlog", scan_bytes=int(n),
-                          scan_records=int(ne))
+                          scan_records=int(ne), scan_segments=1,
+                          scan_segments_pruned=0)
+        ns.last_scan = {
+            "segments": 1, "pruned": 0,
+            "per_segment": [{"segment": -1, "source": "active",
+                             "records": int(ne), "bytes": int(n)}]}
         off = 32
         times = np.frombuffer(buf, "<i8", ne, off); off += 8 * ne
         values = np.frombuffer(buf, "<f8", ne, off); off += 8 * ne
@@ -471,12 +636,16 @@ class NativeEventLogStore(EventStore):
     ) -> Optional[Tuple[int, Optional[int]]]:
         """(live count, max creationTime µs) with creationTime ≤
         ``until_us`` — the snapshot cache's watermark/invalidation
-        probe, answered from the in-memory index with no payload IO."""
-        h = self._handle(app_id, channel_id)
+        probe, answered from the in-memory index with no payload IO.
+        For partitioned namespaces sealed segments answer from their
+        manifest bounds where the window covers them entirely."""
+        ns = self._ns(app_id, channel_id)
+        bound = until_us if until_us is not None else _UNBOUNDED_HI
+        if ns.sealed:
+            total, max_c = ns.creation_stats(bound)
+            return (total, max_c) if total else (0, None)
         max_out = ctypes.c_longlong(0)
-        n = self._lib.pel_creation_stats(
-            h, until_us if until_us is not None else _UNBOUNDED_HI,
-            ctypes.byref(max_out))
+        n = self._lib.pel_creation_stats(ns.h, bound, ctypes.byref(max_out))
         if n <= 0:
             return (0, None)
         return (int(n), int(max_out.value))
@@ -491,7 +660,15 @@ class NativeEventLogStore(EventStore):
         start_time: Optional[_dt.datetime] = None,
         until_time: Optional[_dt.datetime] = None,
     ) -> Dict[str, PropertyMap]:
-        h = self._handle(app_id, channel_id)
+        ns = self._ns(app_id, channel_id)
+        if ns.sealed:
+            # the native fold is per-file; $set/$unset/$delete order
+            # across segments matters, so fold the merged find() stream
+            # through the generic path instead
+            return super().aggregate_properties(
+                app_id, entity_type, channel_id=channel_id,
+                start_time=start_time, until_time=until_time)
+        h = ns.h
         out = ctypes.c_void_p()
         n = self._lib.pel_aggregate(
             h, entity_type.encode(),

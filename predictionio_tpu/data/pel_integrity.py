@@ -169,6 +169,97 @@ def scan_pel(path: str, repair: bool = False) -> Dict[str, object]:
     return report
 
 
+def check_segment_dir(dir_path: str,
+                      repair: bool = False) -> List[Dict[str, object]]:
+    """Audit one ``.peld`` segment directory against its manifest.
+
+    Sealed segments are immutable, so the rules differ from the active
+    log: a torn tail here is CORRUPTION (never quarantined — only the
+    active segment may legitimately tear in a crash); the manifest's
+    sha256 must match the file when present (``None`` = not yet
+    finalized → ``unchecksummed``); compaction sidecars must match
+    their recorded digest. Cold segments whose frame file has shipped
+    are reported as ``cold`` and content-checked on fetch instead.
+    Under ``repair`` a bad compaction sidecar is deleted (it is a
+    cache; the raw frames remain authoritative) — frame-file
+    corruption is report-only.
+    """
+    reports: List[Dict[str, object]] = []
+    man_path = os.path.join(dir_path, "segments.json")
+    try:
+        with open(man_path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [{"path": man_path, "artifact": "segment",
+                 "status": "corrupt", "detail": f"unreadable manifest: {e}"}]
+    if doc.get("schema") != 1:
+        return [{"path": man_path, "artifact": "segment",
+                 "status": "corrupt",
+                 "detail": f"unknown manifest schema {doc.get('schema')!r}"}]
+    for d in doc.get("segments", []):
+        path = os.path.join(dir_path, str(d.get("file")))
+        r: Dict[str, object] = {
+            "path": path, "artifact": "segment",
+            "segment_id": d.get("id"), "state": d.get("state"),
+            "records": d.get("records"), "status": "ok",
+        }
+        reports.append(r)
+        if not os.path.exists(path):
+            if d.get("state") == "cold":
+                # frame file shipped to the cold tier; its digest is
+                # enforced on fetch (ensure_local refuses mismatches)
+                r["status"] = "cold"
+            else:
+                r["status"] = "corrupt"
+                r["detail"] = "segment file missing"
+        else:
+            s = scan_pel(path, repair=False)
+            r["version"] = s["version"]
+            r["records"] = s["records"]
+            r["corrupt_records"] = s["corrupt"]
+            if s["torn_offset"] is not None:
+                r["status"] = "corrupt"
+                r["detail"] = (f"torn tail at {s['torn_offset']} in a "
+                               "sealed (immutable) segment")
+            elif s["corrupt"]:
+                r["status"] = "corrupt"
+            elif d.get("sha256"):
+                with open(path, "rb") as f:
+                    data = f.read()
+                data = faults.corrupt_bytes("data.corrupt.segment", data)
+                if hashlib.sha256(data).hexdigest() != d["sha256"]:
+                    r["status"] = "corrupt"
+                    r["detail"] = "content digest mismatch vs manifest"
+            else:
+                r["status"] = "unchecksummed"  # sealed, not yet finalized
+        cols = d.get("cols")
+        if cols and r["status"] in ("ok", "unchecksummed", "cold"):
+            cp = os.path.join(dir_path, str(cols.get("file")))
+            if not os.path.exists(cp):
+                # the sidecar is a cache — scans fall back to frames
+                r["cols_status"] = "missing"
+            else:
+                with open(cp, "rb") as f:
+                    cdata = f.read()
+                cdata = faults.corrupt_bytes("data.corrupt.segment", cdata)
+                if hashlib.sha256(cdata).hexdigest() != cols.get("sha256"):
+                    if repair:
+                        try:
+                            os.unlink(cp)
+                        except OSError:
+                            pass
+                        fsync_dir(dir_path)
+                        r["cols_status"] = "repaired"
+                        r["status"] = "repaired"
+                    else:
+                        r["cols_status"] = "corrupt"
+                        r["status"] = "corrupt"
+                        r["detail"] = "compaction sidecar digest mismatch"
+                else:
+                    r["cols_status"] = "ok"
+    return reports
+
+
 def check_snapshot(npz_path: str, repair: bool = False) -> Dict[str, object]:
     """Verify one snapshot pair against its manifest digests. Uses
     ``data/snapshot.load_snapshot``'s own validation (same digest walk
@@ -246,9 +337,13 @@ def fsck_home(home: str, repair: bool = False) -> Dict[str, object]:
         for name in sorted(os.listdir(log_dir)):
             p = os.path.join(log_dir, name)
             if name.endswith(".pel"):
+                # the ACTIVE segment: the one place a torn tail is a
+                # legitimate crash artifact, so repair may quarantine
                 r = scan_pel(p, repair=repair)
                 r["artifact"] = "eventlog"
                 artifacts.append(r)
+            elif name.endswith(".peld") and os.path.isdir(p):
+                artifacts.extend(check_segment_dir(p, repair=repair))
             elif ".quarantine-" in name:
                 quarantines.append(p)
 
@@ -282,5 +377,6 @@ def fsck_home(home: str, repair: bool = False) -> Dict[str, object]:
         "corrupt": sum(1 for s in statuses if s in ("corrupt", "torn")),
         "repaired": statuses.count("repaired"),
         "unchecksummed": statuses.count("unchecksummed"),
+        "cold": statuses.count("cold"),
     }
     return report

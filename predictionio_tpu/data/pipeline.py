@@ -257,6 +257,158 @@ def concat_columnar(
         entity_ids=ents, target_ids=tgts, names=names)
 
 
+def _reindex_first_seen(idx: np.ndarray, table: List[str],
+                        out_dtype) -> Tuple[np.ndarray, List[str]]:
+    """Renumber a vocabulary to first-seen order of ``idx`` (every table
+    entry is referenced at least once — merge output invariant)."""
+    uniq, first = np.unique(idx, return_index=True)
+    order = np.argsort(first, kind="stable")
+    seen = uniq[order]
+    lut = np.empty(len(table), np.int64)
+    lut[seen] = np.arange(len(seen))
+    return lut[idx].astype(out_dtype), [table[int(u)] for u in seen]
+
+
+def merge_columnar_segments(
+    blocks,
+) -> Optional[ColumnarEvents]:
+    """Merge per-segment columnar scans into one global scan result.
+
+    ``blocks`` is an iterable of ``(ColumnarEvents, creation_us)``
+    pairs in global sequence order (segment seal order, active last);
+    each block is internally sorted by (eventTime, creationTime, local
+    seq) with first-seen vocabularies — exactly what one native scan
+    over that segment returns. The result is row- and vocabulary-
+    identical to a single scan over the union: blocks are consumed one
+    at a time (peak memory stays O(result + one block), never a
+    per-event object list), and a final stable (time, creation)
+    lexsort runs only when segment time ranges actually interleave —
+    the append-mostly common case concatenates straight through.
+    Per-block vocabularies are unioned in one vectorized pass at the
+    end (offset-concatenate the tables, ``np.unique`` to collapse
+    duplicate strings, renumber to first-seen row order) rather than
+    string-by-string — the union must not cost more than the decode
+    it replaces. Block tables may be Python lists or numpy ``<U``
+    arrays; output tables are always lists. Returns None when any
+    block was declined (name-vocab overflow) or the union would
+    overflow u16, mirroring :func:`columnar_from_rows`.
+    """
+    e_parts: List[np.ndarray] = []
+    t_parts: List[np.ndarray] = []
+    n_parts: List[np.ndarray] = []
+    v_parts: List[np.ndarray] = []
+    tm_parts: List[np.ndarray] = []
+    c_parts: List[np.ndarray] = []
+    e_tabs: List[np.ndarray] = []
+    t_tabs: List[np.ndarray] = []
+    n_tabs: List[np.ndarray] = []
+    e_off = t_off = n_off = 0
+    in_order = True
+    last_key = None
+
+    for cols, creation in blocks:
+        if cols is None:
+            return None
+        if cols.n == 0:
+            continue
+        # shift each block's indices into the concatenated-table space;
+        # duplicate strings across blocks are collapsed after the loop
+        e_parts.append(cols.entity_idx.astype(np.int64) + e_off)
+        t_parts.append(cols.target_idx.astype(np.int64) + t_off)
+        n_parts.append(cols.name_idx.astype(np.int64) + n_off)
+        e_tabs.append(np.asarray(cols.entity_ids, dtype=str))
+        t_tabs.append(np.asarray(cols.target_ids, dtype=str))
+        n_tabs.append(np.asarray(cols.names, dtype=str))
+        e_off += e_tabs[-1].shape[0]
+        t_off += t_tabs[-1].shape[0]
+        n_off += n_tabs[-1].shape[0]
+        v_parts.append(cols.values)
+        tm_parts.append(cols.times_us)
+        c_parts.append(creation)
+        first_key = (int(cols.times_us[0]), int(creation[0]))
+        if last_key is not None and first_key < last_key:
+            in_order = False
+        last_key = (int(cols.times_us[-1]), int(creation[-1]))
+
+    if not tm_parts:
+        z = np.zeros(0, np.uint32)
+        return ColumnarEvents(
+            entity_idx=z, target_idx=z.copy(),
+            name_idx=np.zeros(0, np.uint16),
+            values=np.zeros(0, np.float64), times_us=np.zeros(0, np.int64),
+            entity_ids=[], target_ids=[], names=[])
+    if len(tm_parts) == 1:
+        # single surviving block: vocabularies are already first-seen
+        # and indices unshifted (offset 0) — only normalize types
+        if len(n_tabs[0]) > 65535:
+            return None
+        return ColumnarEvents(
+            entity_idx=e_parts[0].astype(np.uint32),
+            target_idx=t_parts[0].astype(np.uint32),
+            name_idx=n_parts[0].astype(np.uint16),
+            values=v_parts[0], times_us=tm_parts[0],
+            entity_ids=e_tabs[0].tolist(), target_ids=t_tabs[0].tolist(),
+            names=n_tabs[0].tolist())
+    times = np.concatenate(tm_parts)
+    creations = np.concatenate(c_parts)
+    e_idx = np.concatenate(e_parts)
+    t_idx = np.concatenate(t_parts)
+    n_idx = np.concatenate(n_parts)
+    values = np.concatenate(v_parts)
+    del tm_parts, c_parts, e_parts, t_parts, n_parts, v_parts
+    if in_order:
+        # concatenation in segment order is already the global row
+        # order, and each block table is in first-seen order of its own
+        # rows — so first-seen over rows equals first-seen over the
+        # concatenated TABLES, and the union never has to sort a
+        # row-length array: collapse duplicate strings with one unique
+        # over the (small) table space, order by first slot, and map
+        # rows with a single O(n) gather
+        def renumber(gidx: np.ndarray, tabs: List[np.ndarray],
+                     out_dtype):
+            cat = np.concatenate(tabs)
+            uniq_strs, first_slot, slot_uid = np.unique(
+                cat, return_index=True, return_inverse=True)
+            order = np.argsort(first_slot, kind="stable")
+            lut = np.empty(uniq_strs.shape[0], np.int64)
+            lut[order] = np.arange(order.shape[0])
+            return (lut[slot_uid][gidx].astype(out_dtype),
+                    uniq_strs[order].tolist())
+    else:
+        # interleaved segment time ranges: restore global order with a
+        # stable sort (ties keep concatenation order = global seq
+        # order), then renumber to first-seen of the SORTED row stream
+        # so the result matches one single-file scan of the union
+        perm = np.lexsort((creations, times))
+        times = times[perm]
+        values = values[perm]
+        e_idx = e_idx[perm]
+        t_idx = t_idx[perm]
+        n_idx = n_idx[perm]
+
+        def renumber(gidx: np.ndarray, tabs: List[np.ndarray],
+                     out_dtype):
+            cat = np.concatenate(tabs)
+            uniq_strs, slot_uid = np.unique(cat, return_inverse=True)
+            sidx = slot_uid[gidx]
+            uniq, first = np.unique(sidx, return_index=True)
+            seen = uniq[np.argsort(first, kind="stable")]
+            lut = np.empty(uniq_strs.shape[0], np.int64)
+            lut[seen] = np.arange(seen.shape[0])
+            return lut[sidx].astype(out_dtype), uniq_strs[seen].tolist()
+    del creations
+
+    n_idx, n_tab = renumber(n_idx, n_tabs, np.uint16)
+    if len(n_tab) > 65535:
+        return None
+    e_idx, e_tab = renumber(e_idx, e_tabs, np.uint32)
+    t_idx, t_tab = renumber(t_idx, t_tabs, np.uint32)
+    return ColumnarEvents(
+        entity_idx=e_idx, target_idx=t_idx, name_idx=n_idx,
+        values=values, times_us=times,
+        entity_ids=e_tab, target_ids=t_tab, names=n_tab)
+
+
 def interactions_from_columnar(
     cols: ColumnarEvents,
     value_spec: Optional[Dict[str, Any]] = None,

@@ -120,9 +120,19 @@ def eventlog_library() -> Optional[ctypes.CDLL]:
         ctypes.c_longlong, ctypes.c_longlong,
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.POINTER(ctypes.c_void_p)]
+    lib.pel_scan_columnar_ex.restype = ctypes.c_longlong
+    lib.pel_scan_columnar_ex.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
+        ctypes.c_longlong, ctypes.c_longlong,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_void_p)]
     lib.pel_creation_stats.restype = ctypes.c_longlong
     lib.pel_creation_stats.argtypes = [
         ctypes.c_void_p, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_longlong)]
+    lib.pel_creation_bounds.restype = ctypes.c_longlong
+    lib.pel_creation_bounds.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
         ctypes.POINTER(ctypes.c_longlong)]
     lib.pel_free.argtypes = [ctypes.c_void_p]
     return lib

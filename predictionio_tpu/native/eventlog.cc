@@ -739,6 +739,28 @@ long long pel_creation_stats(void* hv, long long until_us,
   return count;
 }
 
+// Alive-record creationTime bounds for segment seal metadata: returns
+// the alive count and fills *min_out/*max_out (untouched when empty).
+// Index-only walk, no payload IO.
+long long pel_creation_bounds(void* hv, long long* min_out,
+                              long long* max_out) {
+  Handle* h = (Handle*)hv;
+  std::lock_guard<std::mutex> g(h->mu);
+  long long count = 0;
+  int64_t min_c = 0, max_c = 0;
+  for (const Rec& r : h->recs) {
+    if (!r.alive) continue;
+    if (count == 0 || r.creation_us < min_c) min_c = r.creation_us;
+    if (count == 0 || r.creation_us > max_c) max_c = r.creation_us;
+    ++count;
+  }
+  if (count) {
+    if (min_out) *min_out = (long long)min_c;
+    if (max_out) *max_out = (long long)max_c;
+  }
+  return count;
+}
+
 // Fetch one framed record by id into *out (malloc'd). Returns byte
 // length, 0 if missing, -1 on error.
 long long pel_get(void* hv, const char* id, int idlen, char** out) {
@@ -1572,6 +1594,146 @@ long long pel_scan_columnar(void* hv, long long start_us, long long until_us,
   blob.append(ents.table);
   append_padded(&blob);
   blob.append(tgts.table);
+  *out = dup_out(blob);
+  return *out ? (long long)blob.size() : -1;
+}
+
+// Extended columnar scan for the segmented log. Same filters as
+// pel_scan_columnar, richer blob: a creationTime column (so
+// multi-segment merges can restore global (time, creation, seq)
+// order), entity/target TYPE index columns + tables (so a compaction
+// sidecar built with wildcard filters can answer typed scans later),
+// and N value columns extracted in one walk (value_keys is a
+// '\n'-joined list; 0 keys emits 0 value columns).
+//
+// Blob layout (little-endian, sections 8-aligned):
+//   u64 n, n_ent, n_tgt, n_names, n_etypes, n_ttypes, n_keys   (56 B)
+//   i64 times[n]; i64 creation[n]; f64 values[n] * n_keys
+//   u32 ent_idx[n] pad; u32 tgt_idx[n] pad; u16 name_idx[n] pad
+//   u16 etype_idx[n] pad; u16 ttype_idx[n] pad
+//   name table pad; entity table pad; target table pad
+//   etype table pad; ttype table          ([u32 len][bytes] each)
+// Returns blob length via *out; -2 when a u16 vocab overflows.
+long long pel_scan_columnar_ex(void* hv, long long start_us,
+                               long long until_us,
+                               long long created_after_us,
+                               long long created_until_us,
+                               const char* entity_type,
+                               const char* target_entity_type,
+                               const char* event_names,
+                               const char* value_keys, char** out) {
+  Handle* h = (Handle*)hv;
+  std::lock_guard<std::mutex> g(h->mu);
+  ensure_sorted(h);
+  auto split_list = [](const char* src, std::string* buf,
+                       std::vector<std::string_view>* parts) {
+    if (!src) return;
+    *buf = src;
+    size_t p = 0;
+    while (p <= buf->size()) {
+      size_t q = buf->find('\n', p);
+      if (q == std::string::npos) q = buf->size();
+      parts->emplace_back(buf->data() + p, q - p);
+      p = q + 1;
+    }
+  };
+  std::vector<std::string_view> names_filter, vkeys;
+  std::string names_buf, vkeys_buf;
+  split_list(event_names, &names_buf, &names_filter);
+  split_list(value_keys, &vkeys_buf, &vkeys);
+  struct Vocab {
+    std::unordered_map<std::string, uint32_t> idx;
+    std::string table;
+    uint32_t add(std::string_view s) {
+      auto it = idx.find(std::string(s));
+      if (it != idx.end()) return it->second;
+      uint32_t i = (uint32_t)idx.size();
+      idx.emplace(std::string(s), i);
+      append_u32(&table, (uint32_t)s.size());
+      table.append(s.data(), s.size());
+      return i;
+    }
+    bool full16(std::string_view s) const {
+      return idx.size() >= 65535 && idx.find(std::string(s)) == idx.end();
+    }
+  };
+  Vocab ents, tgts, names, etypes, ttypes;
+  std::vector<int64_t> times, creations;
+  std::vector<std::vector<double>> values(vkeys.size());
+  std::vector<uint32_t> ent_idx, tgt_idx;
+  std::vector<uint16_t> name_idx, etype_idx, ttype_idx;
+  LogMap map(h);
+  std::string payload;
+  for (size_t idx : h->sorted) {
+    const Rec& r = h->recs[idx];
+    if (r.time_us < start_us || r.time_us >= until_us) continue;
+    if (r.creation_us <= created_after_us ||
+        r.creation_us > created_until_us)
+      continue;
+    std::string_view pv;
+    if (!map.view(r, &pv)) {
+      if (!read_payload(h, r, &payload)) continue;
+      pv = payload;
+    }
+    int64_t t, c;
+    std::string_view s[9];
+    if (!parse_event((const unsigned char*)pv.data(),
+                     (uint32_t)pv.size(), &t, &c, s))
+      continue;
+    if (entity_type && s[2] != entity_type) continue;
+    if (target_entity_type && s[4] != target_entity_type) continue;
+    if (s[5].empty()) continue;  // no target entity: not a pair
+    if (event_names) {
+      bool ok = false;
+      for (auto& n : names_filter)
+        if (s[1] == n) { ok = true; break; }
+      if (!ok) continue;
+    }
+    if (names.full16(s[1]) || etypes.full16(s[2]) || ttypes.full16(s[4]))
+      return -2;
+    times.push_back(t);
+    creations.push_back(c);
+    for (size_t k = 0; k < vkeys.size(); ++k)
+      values[k].push_back(extract_number(s[6], vkeys[k]));
+    ent_idx.push_back(ents.add(s[3]));
+    tgt_idx.push_back(tgts.add(s[5]));
+    name_idx.push_back((uint16_t)names.add(s[1]));
+    etype_idx.push_back((uint16_t)etypes.add(s[2]));
+    ttype_idx.push_back((uint16_t)ttypes.add(s[4]));
+  }
+  uint64_t n = times.size();
+  std::string blob;
+  blob.reserve(56 + n * (40 + 8 * vkeys.size()) + ents.table.size() +
+               tgts.table.size() + names.table.size() + 128);
+  append_u64(&blob, n);
+  append_u64(&blob, ents.idx.size());
+  append_u64(&blob, tgts.idx.size());
+  append_u64(&blob, names.idx.size());
+  append_u64(&blob, etypes.idx.size());
+  append_u64(&blob, ttypes.idx.size());
+  append_u64(&blob, (uint64_t)vkeys.size());
+  blob.append((const char*)times.data(), n * 8);
+  blob.append((const char*)creations.data(), n * 8);
+  for (auto& col : values) blob.append((const char*)col.data(), n * 8);
+  blob.append((const char*)ent_idx.data(), n * 4);
+  append_padded(&blob);
+  blob.append((const char*)tgt_idx.data(), n * 4);
+  append_padded(&blob);
+  blob.append((const char*)name_idx.data(), n * 2);
+  append_padded(&blob);
+  blob.append((const char*)etype_idx.data(), n * 2);
+  append_padded(&blob);
+  blob.append((const char*)ttype_idx.data(), n * 2);
+  append_padded(&blob);
+  blob.append(names.table);
+  append_padded(&blob);
+  blob.append(ents.table);
+  append_padded(&blob);
+  blob.append(tgts.table);
+  append_padded(&blob);
+  blob.append(etypes.table);
+  append_padded(&blob);
+  blob.append(ttypes.table);
   *out = dup_out(blob);
   return *out ? (long long)blob.size() : -1;
 }

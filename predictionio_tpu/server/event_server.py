@@ -166,8 +166,14 @@ class EventServer:
         auth_cache_ttl: float = 30.0,
         durable_acks: bool = False,
         access_log: bool = False,
+        segment_maintenance: bool = False,
     ) -> None:
         self.storage = storage or get_storage()
+        if segment_maintenance and hasattr(self.storage.events,
+                                           "start_maintenance"):
+            # background segment compaction + cold-tier shipping for the
+            # partitioned native event log (no-op on other backends)
+            self.storage.events.start_maintenance()
         if durable_acks:
             # 201 then means on-disk (fsync), not just committed to the
             # page cache; with ingest batching the coalescer amortizes

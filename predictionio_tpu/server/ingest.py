@@ -108,6 +108,7 @@ class WriteCoalescer:
         self.isolations = 0   # failed groups re-run event-by-event
         self.rejected = 0     # submits refused by backpressure
         self.breaker_rejected = 0  # submits refused by the open breaker
+        self.parallel_dispatches = 0  # dispatches spanning >1 namespace
         #: repeated commit failures → open → fast 503s. Decoupled use
         #: (admit at submit, record at commit) — see CircuitBreaker doc.
         self.breaker = CircuitBreaker(
@@ -130,13 +131,21 @@ class WriteCoalescer:
 
     # -- plumbing --------------------------------------------------------------
 
+    #: commit threads: groups for DIFFERENT (app, channel) namespaces
+    #: hold different writer locks (segmented log: one lock per
+    #: partition), so they commit concurrently. Within one namespace
+    #: commits stay ordered — _commit awaits all groups of a dispatch
+    #: before the next dispatch starts.
+    _COMMIT_WORKERS = 4
+
     def _get_executor(self) -> concurrent.futures.ThreadPoolExecutor:
-        # dedicated single thread: commits must never wait behind the
-        # shared to_thread pool, which blocked request handlers can
-        # saturate — the deadlock the MicroBatcher hit in r4
+        # dedicated pool: commits must never wait behind the shared
+        # to_thread pool, which blocked request handlers can saturate —
+        # the deadlock the MicroBatcher hit in r4
         if self._executor is None:
             self._executor = concurrent.futures.ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="pio-ingest")
+                max_workers=self._COMMIT_WORKERS,
+                thread_name_prefix="pio-ingest")
         return self._executor
 
     def _ensure_worker(self) -> None:
@@ -233,71 +242,84 @@ class WriteCoalescer:
         return self.store.insert(event, app_id, channel_id)
 
     async def _commit(self, items: List[tuple]) -> None:
-        """Group by (app, channel), one ``insert_batch`` per group."""
+        """Group by (app, channel), one ``insert_batch`` per group.
+        Groups are independent namespaces (separate tables / separate
+        partition writer locks), so a multi-namespace dispatch commits
+        them concurrently on the dedicated pool."""
         groups: Dict[Tuple[int, Optional[int]], List[tuple]] = {}
         for app_id, channel_id, event, fut, trace_id in items:
             groups.setdefault((app_id, channel_id), []).append(
                 (event, fut, trace_id))
+        if len(groups) == 1:
+            ((app_id, channel_id), pairs), = groups.items()
+            await self._commit_group(app_id, channel_id, pairs)
+            return
+        self.parallel_dispatches += 1
+        await asyncio.gather(*(
+            self._commit_group(app_id, channel_id, pairs)
+            for (app_id, channel_id), pairs in groups.items()))
+
+    async def _commit_group(self, app_id: int, channel_id: Optional[int],
+                            pairs: List[tuple]) -> None:
         loop = asyncio.get_running_loop()
         ex = self._get_executor()
-        for (app_id, channel_id), pairs in groups.items():
-            events = [e for e, _, _ in pairs]
-            # the commit serves MANY requests' traces: a detached root
-            # span that links every submitter's trace id, so any one of
-            # them finds its batched ack in /traces or the JSONL export
-            links = sorted({t for _, _, t in pairs if t})[:64]
-            self.batches += 1
-            t0 = time.perf_counter()
-            with tracing.detached_span(
-                    "ingest.commit", app_id=app_id,
-                    records=len(events),
-                    link_traces=links) as sp:
-                try:
-                    ids = await loop.run_in_executor(
-                        ex, self._insert_batch_guarded, events, app_id,
-                        channel_id)
-                    if len(ids) != len(events):
-                        raise RuntimeError(
-                            f"insert_batch returned {len(ids)} ids for "
-                            f"{len(events)} events")
-                except Exception as e:
-                    self.breaker.record_failure()
-                    sp.set_error(f"{type(e).__name__}: {e}")
-                    if len(pairs) == 1:
-                        if not pairs[0][1].done():
-                            pairs[0][1].set_exception(e)
+        events = [e for e, _, _ in pairs]
+        # the commit serves MANY requests' traces: a detached root
+        # span that links every submitter's trace id, so any one of
+        # them finds its batched ack in /traces or the JSONL export
+        links = sorted({t for _, _, t in pairs if t})[:64]
+        self.batches += 1
+        t0 = time.perf_counter()
+        with tracing.detached_span(
+                "ingest.commit", app_id=app_id,
+                records=len(events),
+                link_traces=links) as sp:
+            try:
+                ids = await loop.run_in_executor(
+                    ex, self._insert_batch_guarded, events, app_id,
+                    channel_id)
+                if len(ids) != len(events):
+                    raise RuntimeError(
+                        f"insert_batch returned {len(ids)} ids for "
+                        f"{len(events)} events")
+            except Exception as e:
+                self.breaker.record_failure()
+                sp.set_error(f"{type(e).__name__}: {e}")
+                if len(pairs) == 1:
+                    if not pairs[0][1].done():
+                        pairs[0][1].set_exception(e)
+                    return
+                # a poison event must not fail its commit siblings,
+                # and each caller must see their OWN error — re-run
+                # alone
+                self.isolations += 1
+                sp.set_attr("isolated", True)
+                for event, fut, _ in pairs:
+                    if fut.done():
                         continue
-                    # a poison event must not fail its commit siblings,
-                    # and each caller must see their OWN error — re-run
-                    # alone
-                    self.isolations += 1
-                    sp.set_attr("isolated", True)
-                    for event, fut, _ in pairs:
-                        if fut.done():
-                            continue
-                        try:
-                            eid = await loop.run_in_executor(
-                                ex, self._insert_one_guarded, event, app_id,
-                                channel_id)
-                        except Exception as single_e:
-                            if not fut.done():
-                                fut.set_exception(single_e)
-                        else:
-                            # storage demonstrably works — the group
-                            # failure was a poison event, not an outage
-                            self.breaker.record_success()
-                            if not fut.done():
-                                fut.set_result(eid)
-                    continue
-            self.breaker.record_success()
-            self._m_commit.observe(time.perf_counter() - t0,
-                                   exemplar=links[0] if links else None)
-            self._m_batch.observe(len(events))
-            if len(events) > 1:
-                self._m_coalesced.inc(n=len(events))
-            for (_, fut, _), eid in zip(pairs, ids):
-                if not fut.done():
-                    fut.set_result(eid)
+                    try:
+                        eid = await loop.run_in_executor(
+                            ex, self._insert_one_guarded, event, app_id,
+                            channel_id)
+                    except Exception as single_e:
+                        if not fut.done():
+                            fut.set_exception(single_e)
+                    else:
+                        # storage demonstrably works — the group
+                        # failure was a poison event, not an outage
+                        self.breaker.record_success()
+                        if not fut.done():
+                            fut.set_result(eid)
+                return
+        self.breaker.record_success()
+        self._m_commit.observe(time.perf_counter() - t0,
+                               exemplar=links[0] if links else None)
+        self._m_batch.observe(len(events))
+        if len(events) > 1:
+            self._m_coalesced.inc(n=len(events))
+        for (_, fut, _), eid in zip(pairs, ids):
+            if not fut.done():
+                fut.set_result(eid)
 
     # -- lifecycle -------------------------------------------------------------
 

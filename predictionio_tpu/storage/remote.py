@@ -303,6 +303,260 @@ class HDFSModelStore(_ResilientCalls, ModelStore):
         return self._call(scan)
 
 
+# ---------------- segment cold tier ----------------------------------------
+#
+# Sealed event-log segments (data/segments.py) ship to a cold tier and
+# are fetched back on demand. Same resilience plumbing (retry + breaker
+# + named fault site) and the same sha256 digest-sidecar convention as
+# the model stores; the caller additionally verifies the fetched blob
+# against the segment manifest's digest and refuses mismatches.
+
+
+class LocalDirSegmentTier(_ResilientCalls):
+    """Cold tier on a local (or NFS-mounted) directory —
+    ``PIO_SEGMENT_COLD=local:<dir>``. The dev/test tier; shares the
+    put/get/delete contract and digest sidecars with the network
+    tiers."""
+
+    def __init__(self, root: str) -> None:
+        self.root = os.path.abspath(root)
+        self._init_resilience("segment_local")
+        self._fault_site = "segments.cold"
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key.lstrip("/"))
+
+    def put(self, key: str, blob: bytes) -> None:
+        from predictionio_tpu.utils.atomic_write import atomic_write_bytes
+
+        path = self._path(key)
+
+        def write() -> None:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            # blob first, digest sidecar last — fail-safe ordering
+            atomic_write_bytes(path, blob)
+            atomic_write_bytes(path + integrity.DIGEST_SUFFIX,
+                               integrity.sha256_hex(blob).encode("ascii"))
+
+        self._call(write)
+
+    def get(self, key: str) -> Optional[bytes]:
+        path = self._path(key)
+
+        def read() -> Optional[bytes]:
+            try:
+                with open(path, "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                return None
+
+        blob = self._call(read)
+        if blob is None:
+            return None
+        try:
+            with open(path + integrity.DIGEST_SUFFIX, "rb") as f:
+                expected = f.read().decode("ascii").strip()
+        except FileNotFoundError:
+            expected = None  # pre-integrity object: manifest still checks
+        integrity.verify_blob(blob, expected, "segment", key)
+        return blob
+
+    def delete(self, key: str) -> bool:
+        path = self._path(key)
+        found = False
+        for p in (path, path + integrity.DIGEST_SUFFIX):
+            try:
+                os.unlink(p)
+                found = True
+            except FileNotFoundError:
+                pass
+        return found
+
+
+class S3SegmentTier(_ResilientCalls):
+    """Segment cold tier on S3 — ``PIO_SEGMENT_COLD=s3://bucket/prefix``."""
+
+    def __init__(self, bucket: str, prefix: str) -> None:
+        try:
+            import boto3  # type: ignore[import-not-found]
+        except ImportError as e:
+            raise StorageClientError(
+                "PIO_SEGMENT_COLD=s3:// requires the boto3 driver "
+                "(pip install boto3)") from e
+        if not bucket:
+            raise StorageClientError(
+                "PIO_SEGMENT_COLD=s3:// needs a bucket name")
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self._s3 = boto3.client("s3")
+        self._init_resilience("segment_s3")
+        self._fault_site = "segments.cold"
+
+    def _key(self, key: str) -> str:
+        key = key.lstrip("/")
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def put(self, key: str, blob: bytes) -> None:
+        k = self._key(key)
+        self._call(lambda: self._s3.put_object(
+            Bucket=self.bucket, Key=k, Body=blob))
+        self._call(lambda: self._s3.put_object(
+            Bucket=self.bucket, Key=k + integrity.DIGEST_SUFFIX,
+            Body=integrity.sha256_hex(blob).encode("ascii")))
+
+    def get(self, key: str) -> Optional[bytes]:
+        k = self._key(key)
+
+        def fetch() -> Optional[bytes]:
+            try:
+                r = self._s3.get_object(Bucket=self.bucket, Key=k)
+            except self._s3.exceptions.NoSuchKey:
+                return None
+            return r["Body"].read()
+
+        def fetch_digest() -> Optional[bytes]:
+            try:
+                r = self._s3.get_object(
+                    Bucket=self.bucket, Key=k + integrity.DIGEST_SUFFIX)
+            except self._s3.exceptions.NoSuchKey:
+                return None
+            return r["Body"].read()
+
+        blob = self._call(fetch)
+        if blob is None:
+            return None
+        expected = self._call(fetch_digest)
+        integrity.verify_blob(
+            blob, expected.decode("ascii").strip() if expected else None,
+            "segment", key)
+        return blob
+
+    def delete(self, key: str) -> bool:
+        k = self._key(key)
+        self._call(lambda: self._s3.delete_object(Bucket=self.bucket, Key=k))
+        self._call(lambda: self._s3.delete_object(
+            Bucket=self.bucket, Key=k + integrity.DIGEST_SUFFIX))
+        return True
+
+
+class HDFSSegmentTier(_ResilientCalls):
+    """Segment cold tier on HDFS via pyarrow —
+    ``PIO_SEGMENT_COLD=hdfs://host:port/path``."""
+
+    def __init__(self, host: str, port: int, root: str) -> None:
+        try:
+            from pyarrow import fs
+        except ImportError as e:  # pragma: no cover - pyarrow is baked in
+            raise StorageClientError(
+                "PIO_SEGMENT_COLD=hdfs:// requires pyarrow") from e
+        self.root = root.rstrip("/") or "/pio_segments"
+        try:
+            self._fs = fs.HadoopFileSystem(host, port)
+        except Exception as e:
+            raise StorageClientError(
+                f"cannot reach HDFS at {host}:{port} (libhdfs present?): {e}"
+            ) from e
+        self._init_resilience("segment_hdfs")
+        self._fault_site = "segments.cold"
+
+    def _path(self, key: str) -> str:
+        return f"{self.root}/{key.lstrip('/')}"
+
+    def put(self, key: str, blob: bytes) -> None:
+        path = self._path(key)
+
+        def write() -> None:
+            self._fs.create_dir(os.path.dirname(path), recursive=True)
+            with self._fs.open_output_stream(path) as f:
+                f.write(blob)
+
+        def write_digest() -> None:
+            with self._fs.open_output_stream(
+                    path + integrity.DIGEST_SUFFIX) as f:
+                f.write(integrity.sha256_hex(blob).encode("ascii"))
+
+        self._call(write)
+        self._call(write_digest)
+
+    def get(self, key: str) -> Optional[bytes]:
+        from pyarrow import fs
+
+        path = self._path(key)
+
+        def read_file(p: str) -> Optional[bytes]:
+            info = self._fs.get_file_info(p)
+            if info.type == fs.FileType.NotFound:
+                return None
+            with self._fs.open_input_stream(p) as f:
+                return f.read()
+
+        blob = self._call(lambda: read_file(path))
+        if blob is None:
+            return None
+        expected = self._call(
+            lambda: read_file(path + integrity.DIGEST_SUFFIX))
+        integrity.verify_blob(
+            blob, expected.decode("ascii").strip() if expected else None,
+            "segment", key)
+        return blob
+
+    def delete(self, key: str) -> bool:
+        from pyarrow import fs
+
+        path = self._path(key)
+
+        def remove() -> bool:
+            if self._fs.get_file_info(path).type == fs.FileType.NotFound:
+                return False
+            self._fs.delete_file(path)
+            side = path + integrity.DIGEST_SUFFIX
+            if self._fs.get_file_info(side).type != fs.FileType.NotFound:
+                self._fs.delete_file(side)
+            return True
+
+        return self._call(remove)
+
+
+_segment_tiers: dict = {}
+
+
+def segment_cold_tier():
+    """The segment cold tier selected by ``PIO_SEGMENT_COLD``, or None.
+
+    Accepted forms::
+
+        local:/var/pio/cold       directory (dev / test / NFS mount)
+        s3://bucket/prefix
+        hdfs://host:port/path
+
+    Instances are cached per spec so breaker state and client
+    connections are shared across namespaces.
+    """
+    spec = os.environ.get("PIO_SEGMENT_COLD", "").strip()
+    if not spec:
+        return None
+    tier = _segment_tiers.get(spec)
+    if tier is not None:
+        return tier
+    if spec.startswith("local:"):
+        tier = LocalDirSegmentTier(spec[len("local:"):])
+    elif spec.startswith("s3://"):
+        bucket, _, prefix = spec[len("s3://"):].partition("/")
+        tier = S3SegmentTier(bucket, prefix)
+    elif spec.startswith("hdfs://"):
+        loc, _, path = spec[len("hdfs://"):].partition("/")
+        host, _, port = loc.partition(":")
+        tier = HDFSSegmentTier(host or "default", int(port or 8020),
+                               "/" + path)
+    else:
+        raise StorageClientError(
+            f"unrecognized PIO_SEGMENT_COLD {spec!r} "
+            "(want local:<dir>, s3://bucket/prefix, or "
+            "hdfs://host:port/path)")
+    _segment_tiers[spec] = tier
+    return tier
+
+
 def _sql_dialect(type_name: str, cfg, repo: str):
     """Dialect for a SQL-server source; raises StorageClientError with
     install instructions when the DB-API driver is absent."""

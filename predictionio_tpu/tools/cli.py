@@ -166,7 +166,8 @@ def cmd_eventserver(args: argparse.Namespace) -> None:
                          ingest_queue_depth=args.ingest_queue_depth,
                          auth_cache_ttl=args.auth_cache_ttl,
                          durable_acks=args.durable_acks,
-                         access_log=args.access_log)
+                         access_log=args.access_log,
+                         segment_maintenance=args.segment_maintenance)
     mode = "group-commit" if args.ingest_batching else "per-event commit"
     print(f"[info] Event Server listening on {args.ip}:{args.port} ({mode})")
     server.run()
@@ -214,6 +215,10 @@ def cmd_undeploy(args: argparse.Namespace) -> None:
 def cmd_train(args: argparse.Namespace) -> None:
     from predictionio_tpu.core.workflow import run_train
 
+    if getattr(args, "scan_workers", None):
+        # per-invocation override of the segment-scan fan-out; the
+        # EVENTLOG store reads it wherever the Storage gets built
+        os.environ["PIO_SCAN_WORKERS"] = str(args.scan_workers)
     variant = _load_variant_file(args.engine_dir, args.variant)
     factory = variant.get("engineFactory") or _die("engine.json missing engineFactory")
     # engine dir on sys.path so user engine modules import
@@ -367,16 +372,97 @@ def cmd_fsck(args: argparse.Namespace) -> None:
                     extra += f" torn@{a['torn_offset']}"
                 if a["quarantine"]:
                     extra += f" quarantined→{a['quarantine']}"
+            elif a["artifact"] == "segment":
+                extra = f" state={a.get('state')} records={a.get('records')}"
+                if a.get("cols_status"):
+                    extra += f" cols={a['cols_status']}"
+                if a.get("detail"):
+                    extra += f" ({a['detail']})"
             print(f"[fsck] {a['artifact']:<9} {name}: {a['status']}{extra}")
         for q in report["quarantines"]:
             print(f"[fsck] quarantine sidecar: {q}")
         print(f"[fsck] checked={report['checked']} clean={report['clean']} "
               f"corrupt={report['corrupt']} repaired={report['repaired']} "
-              f"unchecksummed={report['unchecksummed']}")
+              f"unchecksummed={report['unchecksummed']} "
+              f"cold={report.get('cold', 0)}")
     if report["corrupt"]:
         raise SystemExit(2)
     if report["repaired"]:
         raise SystemExit(3)
+
+
+def cmd_segments(args: argparse.Namespace) -> None:
+    """Operate the partitioned event log: show segment layout, force a
+    rollover, compact sealed segments into columnar sidecars, or ship
+    them to the cold tier (PIO_SEGMENT_COLD)."""
+    import re as _re
+
+    store = get_storage().events
+    if not hasattr(store, "namespaces") or not hasattr(store, "_dir"):
+        _die("pio segments requires the EVENTLOG backend "
+             f"(configured backend: {type(store).__name__})")
+    # open every namespace present on disk, not just ones touched in
+    # this process
+    names = sorted(os.listdir(store._dir)) if os.path.isdir(store._dir) else []
+    for fn in names:
+        m = _re.match(r"^events_(\d+)(?:_(\d+))?\.pel$", fn)
+        if m:
+            store._ns(int(m.group(1)),
+                      int(m.group(2)) if m.group(2) else None)
+    namespaces = store.namespaces()
+    if not namespaces:
+        print("[segments] no event-log namespaces found")
+        return
+    acted = {"rolled": 0, "compacted": 0, "shipped": 0}
+    report = []
+    for ns in namespaces:
+        if args.action == "roll":
+            if ns.roll():
+                acted["rolled"] += 1
+        elif args.action == "compact":
+            for seg in list(ns.sealed):
+                if seg.meta.cols is None and seg.meta.records:
+                    try:
+                        ns.compact(seg)
+                        acted["compacted"] += 1
+                    except (IOError, OSError) as e:
+                        print(f"[segments] compact {seg.meta.file}: {e}")
+        elif args.action == "ship":
+            for seg in list(ns.sealed):
+                if seg.meta.state == "sealed":
+                    try:
+                        if ns.ship(seg):
+                            acted["shipped"] += 1
+                    except (IOError, OSError) as e:
+                        print(f"[segments] ship {seg.meta.file}: {e}")
+        active_bytes = (os.path.getsize(ns.base_path)
+                        if os.path.exists(ns.base_path) else 0)
+        segs = [s.meta.to_dict() for s in ns.sealed]
+        report.append({"namespace": ns.namespace_tag(),
+                       "active_bytes": active_bytes,
+                       "sealed": segs})
+    if args.json:
+        print(json.dumps({"namespaces": report, **acted},
+                         indent=2, sort_keys=True))
+        return
+    for entry in report:
+        segs = entry["sealed"]
+        compacted = sum(1 for s in segs if s["cols"])
+        cold = sum(1 for s in segs if s["state"] == "cold")
+        print(f"[segments] {entry['namespace']}: "
+              f"{len(segs)} sealed ({compacted} compacted, {cold} cold), "
+              f"active {entry['active_bytes']} B")
+        for s in segs:
+            marks = "".join((
+                "C" if s["cols"] else "-",
+                "S" if s["state"] == "cold" else "-",
+                "#" if s["sha256"] else "-",
+            ))
+            print(f"[segments]   {s['file']} [{marks}] "
+                  f"records={s['records']} bytes={s['bytes']}")
+    if args.action != "status":
+        print(f"[segments] rolled={acted['rolled']} "
+              f"compacted={acted['compacted']} shipped={acted['shipped']}")
 
 
 def cmd_trace(args: argparse.Namespace) -> None:
@@ -611,6 +697,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fsync storage before acking 201 (survives "
                          "power loss, not just process death); group "
                          "commit amortizes the sync per batch")
+    es.add_argument("--segment-maintenance", action="store_true",
+                    help="background compaction of sealed event-log "
+                         "segments into columnar sidecars, plus "
+                         "cold-tier shipping when PIO_SEGMENT_COLD "
+                         "is configured (EVENTLOG backend only)")
     es.add_argument("--auth-cache-ttl", type=float, default=30.0,
                     help="access-key/channel auth cache TTL seconds "
                          "(0 disables; in-process key mutations "
@@ -631,6 +722,9 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--no-scan-cache", action="store_true",
                     help="bypass the columnar snapshot cache and rescan "
                          "the full event log")
+    tr.add_argument("--scan-workers", type=int,
+                    help="parallel segment scans per training read "
+                         "(default: PIO_SCAN_WORKERS)")
     tr.set_defaults(fn=cmd_train)
 
     dp = sub.add_parser("deploy", help="serve the latest trained instance")
@@ -716,6 +810,16 @@ def build_parser() -> argparse.ArgumentParser:
     fs.add_argument("--json", action="store_true",
                     help="emit the full report as one JSON document")
     fs.set_defaults(fn=cmd_fsck)
+
+    sg = sub.add_parser(
+        "segments",
+        help="inspect/operate the partitioned event log (EVENTLOG "
+             "backend): status, force rollover, compact, cold-tier ship")
+    sg.add_argument("action", nargs="?", default="status",
+                    choices=("status", "roll", "compact", "ship"))
+    sg.add_argument("--json", action="store_true",
+                    help="emit the full segment report as JSON")
+    sg.set_defaults(fn=cmd_segments)
 
     tc = sub.add_parser(
         "trace",

@@ -76,8 +76,18 @@ def main() -> None:
                     help="EVENTLOG checksum overhead A/B: batch ingest "
                          "+ full scan with v1 (no CRC) vs v2 (CRC32C "
                          "per record) frame formats, at the store SPI")
+    ap.add_argument("--segments", action="store_true",
+                    help="EVENTLOG partitioned-log A/B at the store "
+                         "SPI: single-file serial scan baseline vs "
+                         "segmented log (compacted columnar sidecars) "
+                         "scanned serially and with --scan-workers; "
+                         "with --concurrency, also single-file vs "
+                         "segmented ingest across N writer threads")
+    ap.add_argument("--scan-workers", type=int, default=4,
+                    help="segment scan fan-out width for the parallel "
+                         "phase of --segments")
     args = ap.parse_args()
-    if args.verify_crc:
+    if args.verify_crc or args.segments:
         args.storage = "eventlog"  # the A/B only exists natively
 
     import jax
@@ -161,6 +171,151 @@ def main() -> None:
                 (v1["scan_events_per_sec"] / v2["scan_events_per_sec"]
                  - 1) * 100, 1),
         }))
+        return
+
+    if args.segments:
+        # Partitioned-log A/B at the store SPI. Baseline: one
+        # unsegmented file (rollover disabled), serial native columnar
+        # scan. Treatment: the same stream through a segmented
+        # namespace, sealed segments compacted into columnar sidecars
+        # (the background-maintenance product), scanned serially and
+        # with a --scan-workers thread pool. Scans repeat twice and
+        # report the better run (warm page cache both sides).
+        from predictionio_tpu.data.event import Event
+
+        # MovieLens-1M shape: ~6k users × ~4k items — the dense
+        # events-per-entity regime recommendation stores actually see
+        rng = np.random.default_rng(0)
+        N = args.events
+        uu = rng.integers(0, 6_040, N)
+        ii = rng.integers(0, 3_952, N)
+        vv = rng.integers(1, 6, N)
+        CH = 20_000
+
+        def ingest(app_id, channel_id=None):
+            t0 = time.perf_counter()
+            for lo in range(0, N, CH):
+                evs = [Event(event="rate", entity_type="user",
+                             entity_id=str(int(uu[n])),
+                             target_entity_type="item",
+                             target_entity_id=str(int(ii[n])),
+                             properties={"rating": float(vv[n])})
+                       for n in range(lo, min(lo + CH, N))]
+                st.events.insert_batch(evs, app_id, channel_id)
+            return time.perf_counter() - t0
+
+        def scan(app_id, workers):
+            st.events.scan_workers = workers
+            best = float("inf")
+            cols = None
+            for _ in range(2):
+                t0 = time.perf_counter()
+                cols = st.events.scan_columnar(app_id, value_key="rating")
+                best = min(best, time.perf_counter() - t0)
+            return cols, best
+
+        # -- baseline: single file, serial scan
+        st.events.segment_bytes = 0  # never roll
+        app_a = st.meta.create_app("EventsBenchSegA")
+        st.events.init_channel(app_a.id)
+        single_ingest_sec = ingest(app_a.id)
+        cols_a, single_scan_sec = scan(app_a.id, 1)
+        assert cols_a is not None and cols_a.n == N
+        single_bytes = os.path.getsize(
+            st.events._path(app_a.id, None))
+
+        # -- treatment: segmented (≈12 segments), compacted sidecars
+        seg_bytes = max(1 << 20, single_bytes // 12)
+        st.events.segment_bytes = seg_bytes
+        app_b = st.meta.create_app("EventsBenchSegB")
+        st.events.init_channel(app_b.id)
+        seg_ingest_sec = ingest(app_b.id)
+        ns = st.events._ns(app_b.id, None)
+        t0 = time.perf_counter()
+        for seg in list(ns.sealed):
+            ns.compact(seg)
+        compact_sec = time.perf_counter() - t0
+        cols_s, seg_serial_sec = scan(app_b.id, 1)
+        cols_p, seg_parallel_sec = scan(app_b.id, args.scan_workers)
+        assert cols_s is not None and cols_s.n == N
+        assert cols_p is not None and cols_p.n == N
+        assert (cols_p.times_us == cols_s.times_us).all()
+        assert (cols_p.values == cols_a.values).all()
+        sources = [d["source"] for d in ns.last_scan["per_segment"]]
+
+        out = {
+            "metric": "eventlog_segments",
+            "events": N,
+            "segments": len(ns.sealed) + 1,
+            "segment_bytes": seg_bytes,
+            "scan_workers": args.scan_workers,
+            "compacted_sources": sources.count("columnar"),
+            "compact_sec": round(compact_sec, 2),
+            "ingest": {
+                "single_file_events_per_sec": round(N / single_ingest_sec),
+                "segmented_events_per_sec": round(N / seg_ingest_sec),
+                "rollover_overhead_pct": round(
+                    (single_ingest_sec / seg_ingest_sec - 1) * -100, 1),
+            },
+            "scan": {
+                "single_file_serial_events_per_sec": round(
+                    N / single_scan_sec),
+                "segmented_serial_events_per_sec": round(
+                    N / seg_serial_sec),
+                "segmented_parallel_events_per_sec": round(
+                    N / seg_parallel_sec),
+                "parallel_vs_single_serial_speedup": round(
+                    single_scan_sec / seg_parallel_sec, 2),
+                "parallel_vs_segmented_serial_speedup": round(
+                    seg_serial_sec / seg_parallel_sec, 2),
+            },
+        }
+
+        if args.concurrency:
+            # single-file vs segmented ingest under N writer threads,
+            # one (app, channel) partition per thread — the contention
+            # the per-namespace writer lock (and rollover inside it)
+            # adds or removes
+            conc = args.concurrency
+            n_conc = min(N, 200_000)
+            per = max(1, n_conc // conc)
+
+            def writer(app_id, ch, lo):
+                for base in range(lo, lo + per, CH):
+                    evs = [Event(event="rate", entity_type="user",
+                                 entity_id=str(int(uu[n])),
+                                 target_entity_type="item",
+                                 target_entity_id=str(int(ii[n])),
+                                 properties={"rating": float(vv[n])})
+                           for n in range(base, min(base + CH, lo + per))]
+                    st.events.insert_batch(evs, app_id, ch)
+
+            def run_conc(seg, tag):
+                st.events.segment_bytes = seg
+                capp = st.meta.create_app(f"EventsBenchSegC{tag}")
+                for t in range(conc):
+                    st.events.init_channel(capp.id, t)
+                threads = [threading.Thread(target=writer,
+                                            args=(capp.id, t, t * per))
+                           for t in range(conc)]
+                t0 = time.perf_counter()
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                return per * conc / (time.perf_counter() - t0)
+
+            single_rate = run_conc(0, "S")
+            seg_rate = run_conc(max(1 << 20, (single_bytes * per // N) // 4),
+                                "P")
+            out["concurrent_ingest"] = {
+                "writers": conc,
+                "events": per * conc,
+                "single_file_events_per_sec": round(single_rate),
+                "segmented_events_per_sec": round(seg_rate),
+            }
+
+        print(json.dumps(out))
         return
 
     if args.concurrency:

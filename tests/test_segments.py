@@ -1,0 +1,472 @@
+"""Partitioned event log: segment rollover, parallel scans, compaction
+sidecars, watermark pruning, cold tier, legacy migration, fsck."""
+
+import datetime as dt
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.utils import faults
+from predictionio_tpu.utils.integrity import IntegrityError
+
+APP = 1
+_T0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+
+
+def _events(n, start=0, users=50, items=20):
+    return [Event(event="rate", entity_type="user",
+                  entity_id=f"u{(start + i) % users}",
+                  target_entity_type="item",
+                  target_entity_id=f"i{(start + i) % items}",
+                  properties={"rating": float((start + i) % 5 + 1)},
+                  event_time=_T0 + dt.timedelta(seconds=start + i))
+            for i in range(n)]
+
+
+def _store(directory, seg_bytes=None):
+    from predictionio_tpu.data.filestore import NativeEventLogStore
+
+    try:
+        s = NativeEventLogStore(str(directory))
+    except RuntimeError as e:  # no g++ in this environment
+        pytest.skip(str(e))
+    if seg_bytes is not None:
+        s.segment_bytes = seg_bytes
+    return s
+
+
+def _rows(cols):
+    """Per-row (name, entity, target, value, time) tuples — the
+    vocabulary-independent view two scans must agree on."""
+    return [(cols.names[cols.name_idx[i]],
+             cols.entity_ids[cols.entity_idx[i]],
+             cols.target_ids[cols.target_idx[i]],
+             cols.values[i], int(cols.times_us[i]))
+            for i in range(cols.n)]
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.FAULTS.disarm()
+
+
+# -- rollover ---------------------------------------------------------------
+
+
+def test_rollover_preserves_reads(tmp_path):
+    st = _store(tmp_path / "log", seg_bytes=4096)
+    ids = []
+    for lo in range(0, 2000, 100):
+        ids.extend(st.insert_batch(_events(100, start=lo), APP))
+    ns = st._ns(APP, None)
+    assert len(ns.sealed) >= 2, "threshold should have sealed segments"
+
+    evs = list(st.find(APP))
+    assert len(evs) == 2000
+    assert [e.event_id for e in evs] == ids  # global (time, seq) order
+    rev = list(st.find(APP, reversed=True))
+    assert [e.event_id for e in rev] == ids[::-1]
+    # point reads cross the active/sealed boundary
+    assert st.get(ids[0], APP).entity_id == "u0"
+    assert st.get(ids[-1], APP) is not None
+    st.close()
+
+
+def test_rollover_under_concurrent_group_commits(tmp_path):
+    # the group-commit coalescer path: concurrent writers appending
+    # NDJSON batches while the active segment rolls underneath them
+    st = _store(tmp_path / "log", seg_bytes=8192)
+    st.init_channel(APP)
+    errors = []
+
+    def writer(t):
+        try:
+            for lo in range(0, 500, 50):
+                lines = "".join(
+                    '{"event":"rate","entityType":"user",'
+                    f'"entityId":"u{t}-{lo + i}",'
+                    '"targetEntityType":"item","targetEntityId":"i1",'
+                    '"properties":{"rating":3.0},'
+                    '"eventTime":"2026-01-02T03:04:05Z"}\n'
+                    for i in range(50)).encode()
+                appended, fallback = st.append_jsonl(lines, 50, APP)
+                assert appended + len(fallback) == 50 and not fallback
+        except Exception as e:  # surfaced after join
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
+    ns = st._ns(APP, None)
+    assert len(ns.sealed) >= 2
+    evs = list(st.find(APP))
+    assert len(evs) == 2000
+    assert len({e.event_id for e in evs}) == 2000  # no dup, no loss
+    # segment accounting agrees with the read path
+    total, max_c = st.creation_stats(APP)
+    assert total == 2000 and max_c is not None
+    st.close()
+
+
+# -- scan parity ------------------------------------------------------------
+
+
+def test_scan_parity_serial_parallel_raw_sidecar(tmp_path):
+    st = _store(tmp_path / "log", seg_bytes=4096)
+    for lo in range(0, 1500, 100):
+        st.insert_batch(_events(100, start=lo), APP)
+    ns = st._ns(APP, None)
+    assert len(ns.sealed) >= 2
+
+    st.scan_workers = 1
+    raw = st.scan_columnar(APP, value_key="rating")  # no sidecars yet
+    for seg in list(ns.sealed):
+        ns.compact(seg)
+    side = st.scan_columnar(APP, value_key="rating")
+    st.scan_workers = 4
+    par = st.scan_columnar(APP, value_key="rating")
+    assert {d["source"] for d in ns.last_scan["per_segment"]} == {
+        "columnar", "active"}
+
+    # single-file reference: identical stream, rollover disabled
+    ref_st = _store(tmp_path / "ref", seg_bytes=0)
+    for lo in range(0, 1500, 100):
+        ref_st.insert_batch(_events(100, start=lo), APP)
+    ref = ref_st.scan_columnar(APP, value_key="rating")
+
+    for cols in (raw, side, par):
+        assert cols.n == ref.n == 1500
+        assert (cols.times_us == ref.times_us).all()
+        assert (cols.values == ref.values).all()
+        # vocabulary parity, not just row parity: first-seen order
+        assert cols.entity_ids == ref.entity_ids
+        assert cols.target_ids == ref.target_ids
+        assert cols.names == ref.names
+        assert (cols.entity_idx == ref.entity_idx).all()
+        assert (cols.target_idx == ref.target_idx).all()
+    st.close()
+    ref_st.close()
+
+
+@pytest.mark.parametrize("backend", ["memory", "sqlite", "eventlog"])
+def test_scan_parity_across_backends(backend, tmp_path):
+    evs = _events(800)
+    expected = [(e.event, e.entity_id, e.target_entity_id,
+                 e.properties["rating"],
+                 int(e.event_time.timestamp() * 1_000_000))
+                for e in evs]
+
+    if backend == "memory":
+        from predictionio_tpu.data.events import MemoryEventStore
+
+        st = MemoryEventStore()
+    elif backend == "sqlite":
+        from predictionio_tpu.data.events import SqliteEventStore
+
+        st = SqliteEventStore(str(tmp_path / "events.db"))
+    else:
+        st = _store(tmp_path / "log", seg_bytes=4096)
+    st.init_channel(APP)
+    st.insert_batch(evs, APP)
+
+    scan = getattr(st, "scan_columnar", None)
+    if scan is not None:
+        got = [_rows(scan(APP, value_key="rating"))]
+        if backend == "eventlog":
+            ns = st._ns(APP, None)
+            for seg in list(ns.sealed):
+                ns.compact(seg)
+            st.scan_workers = 1
+            got.append(_rows(scan(APP, value_key="rating")))
+            st.scan_workers = 4
+            got.append(_rows(scan(APP, value_key="rating")))
+    else:  # memory: the generic find() path trains through
+        got = [[(e.event, e.entity_id, e.target_entity_id,
+                 e.properties["rating"],
+                 int(e.event_time.timestamp() * 1_000_000))
+                for e in st.find(APP)]]
+    for rows in got:
+        assert rows == expected
+    if hasattr(st, "close"):
+        st.close()
+
+
+# -- watermark pruning ------------------------------------------------------
+
+
+def test_watermark_prunes_pre_watermark_segments(tmp_path):
+    st = _store(tmp_path / "log", seg_bytes=4096)
+    for lo in range(0, 1000, 100):
+        st.insert_batch(_events(100, start=lo), APP)
+    ns = st._ns(APP, None)
+    ns.roll()  # seal the remainder: everything pre-watermark is sealed
+    n_old = len(ns.sealed)
+    assert n_old >= 2
+    total, wm = st.creation_stats(APP)
+    assert total == 1000
+
+    st.insert_batch(_events(200, start=1000), APP)
+    st.scan_workers = 1
+    cols = st.scan_columnar(APP, value_key="rating", created_after_us=wm)
+    assert cols.n == 200  # only post-watermark events rescanned
+    # every pre-watermark sealed segment was pruned by manifest bounds,
+    # never opened: the warm `pio train` delta-scan contract
+    assert ns.last_scan["pruned"] == n_old
+    scanned = {d["segment"] for d in ns.last_scan["per_segment"]}
+    assert all(s.meta.id not in scanned for s in ns.sealed[:n_old])
+    st.close()
+
+
+# -- legacy migration -------------------------------------------------------
+
+
+def test_legacy_single_file_migrates_at_first_rollover(tmp_path):
+    # a pre-partitioning store: one flat events_<app>.pel, no manifest
+    st = _store(tmp_path / "log", seg_bytes=0)
+    st.insert_batch(_events(300), APP)
+    st.close()
+    base = tmp_path / "log" / "events_1.pel"
+    assert base.exists()
+    assert not (tmp_path / "log" / "events_1.peld").exists()
+
+    # reopen under segmentation: legacy file serves as-is…
+    st = _store(tmp_path / "log", seg_bytes=4096)
+    assert len(list(st.find(APP))) == 300
+    ns = st._ns(APP, None)
+    assert not ns.sealed
+
+    # …and the first rollover migrates it in place to seg-000000
+    st.insert_batch(_events(300, start=300), APP)
+    assert ns.sealed, "legacy log should have rolled into a segment"
+    assert ns.sealed[0].meta.id == 0
+    manifest = tmp_path / "log" / "events_1.peld" / "segments.json"
+    assert json.loads(manifest.read_text())["schema"] == 1
+    evs = list(st.find(APP))
+    assert len(evs) == 600
+    assert evs[0].entity_id == "u0"
+    st.close()
+
+
+# -- cold tier --------------------------------------------------------------
+
+
+def test_cold_tier_fetch_on_scan_and_corrupt_refusal(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_SEGMENT_COLD", f"local:{tmp_path / 'cold'}")
+    st = _store(tmp_path / "log", seg_bytes=4096)
+    for lo in range(0, 900, 100):
+        st.insert_batch(_events(100, start=lo), APP)
+    ns = st._ns(APP, None)
+    ns.roll()
+    ns.finalize_all()  # ship requires content digests
+    for seg in list(ns.sealed):
+        assert ns.ship(seg)
+    assert all(s.meta.state == "cold" for s in ns.sealed)
+    local = [ns.seg_path(s) for s in ns.sealed]
+    assert not any(os.path.exists(p) for p in local)
+
+    # reopen: the rolled-over fds are gone, so sealed reads must now
+    # fetch from the tier
+    st.close()
+    st = _store(tmp_path / "log", seg_bytes=4096)
+    ns = st._ns(APP, None)
+
+    # an injected data.corrupt.* fault on the fetch path: the store
+    # refuses the bad segment instead of serving flipped bytes
+    faults.FAULTS.arm("data.corrupt.segment")
+    with pytest.raises(IntegrityError, match="refusing"):
+        list(st.find(APP))
+    faults.FAULTS.disarm()
+
+    # clean fetch: scans transparently pull segments back from the tier
+    evs = list(st.find(APP))
+    assert len(evs) == 900
+    assert all(os.path.exists(p) for p in local)
+    st.scan_workers = 2
+    cols = st.scan_columnar(APP, value_key="rating")
+    assert cols.n == 900
+    st.close()
+
+
+# -- fsck -------------------------------------------------------------------
+
+
+def _fsck_cli(home, *extra):
+    from predictionio_tpu.tools.cli import main
+
+    try:
+        main(["fsck", "--home", str(home), "--json", *extra])
+    except SystemExit as e:
+        return int(e.code or 0)
+    return 0
+
+
+def test_fsck_segments_clean_corrupt_sidecar_repair(tmp_path, monkeypatch,
+                                                    capsys):
+    monkeypatch.delenv("PIO_SCAN_CACHE_DIR", raising=False)
+    home = tmp_path / "home"
+    st = _store(home / "eventlog", seg_bytes=4096)
+    for lo in range(0, 800, 100):
+        st.insert_batch(_events(100, start=lo), APP)
+    ns = st._ns(APP, None)
+    for seg in list(ns.sealed):
+        ns.compact(seg)
+    ns.finalize_all()
+    st.close()
+
+    # freshly migrated segmented store: everything clean, exit 0
+    assert _fsck_cli(home) == 0
+    doc = json.loads(capsys.readouterr().out)
+    segs = [a for a in doc["artifacts"] if a["artifact"] == "segment"]
+    assert len(segs) >= 2
+    assert all(a["status"] == "ok" for a in segs)
+
+    # flip one byte inside a sealed segment: corrupt, exit 2 — and
+    # repair must NOT quarantine an immutable segment
+    seg_file = sorted((home / "eventlog" / "events_1.peld").glob(
+        "seg-*.pel"))[0]
+    blob = bytearray(seg_file.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    seg_file.write_bytes(blob)
+    assert _fsck_cli(home) == 2
+    capsys.readouterr()
+    assert _fsck_cli(home, "--repair") == 2
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["quarantines"] == []
+
+
+def test_fsck_repairs_stale_compaction_sidecar(tmp_path, monkeypatch,
+                                               capsys):
+    monkeypatch.delenv("PIO_SCAN_CACHE_DIR", raising=False)
+    home = tmp_path / "home"
+    st = _store(home / "eventlog", seg_bytes=4096)
+    for lo in range(0, 600, 100):
+        st.insert_batch(_events(100, start=lo), APP)
+    ns = st._ns(APP, None)
+    for seg in list(ns.sealed):
+        ns.compact(seg)
+    cols_file = ns.cols_path(ns.sealed[0])
+    st.close()
+
+    with open(cols_file, "r+b") as f:
+        f.seek(30)
+        f.write(b"\xff")
+    assert _fsck_cli(home) == 2
+    capsys.readouterr()
+    # the sidecar is a cache: repair deletes it (the raw segment is
+    # authoritative and re-compaction rebuilds it), exit 3
+    assert _fsck_cli(home, "--repair") == 3
+    capsys.readouterr()
+    assert not os.path.exists(cols_file)
+    assert _fsck_cli(home) == 0
+    capsys.readouterr()
+
+
+def test_fsck_reports_cold_segments_clean(tmp_path, monkeypatch, capsys):
+    monkeypatch.delenv("PIO_SCAN_CACHE_DIR", raising=False)
+    monkeypatch.setenv("PIO_SEGMENT_COLD", f"local:{tmp_path / 'cold'}")
+    home = tmp_path / "home"
+    st = _store(home / "eventlog", seg_bytes=4096)
+    for lo in range(0, 600, 100):
+        st.insert_batch(_events(100, start=lo), APP)
+    ns = st._ns(APP, None)
+    ns.finalize_all()
+    for seg in list(ns.sealed):
+        assert ns.ship(seg)
+    st.close()
+
+    assert _fsck_cli(home) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["cold"] == len(
+        [a for a in doc["artifacts"]
+         if a["artifact"] == "segment" and a["status"] == "cold"])
+    assert doc["cold"] >= 1
+
+
+# -- streaming merge memory guard -------------------------------------------
+
+
+def test_segmented_scan_streams_blocks(tmp_path):
+    # satellite: the cold-scan path must stream segments through the
+    # merge, never materialize a per-event record list. 60k events →
+    # result arrays ≈ 2 MB; a record-list path would hold 60k Event
+    # objects (tens of MB). Bound the traced python-heap peak well
+    # under the materialized cost but safely above numpy's real need.
+    import tracemalloc
+
+    st = _store(tmp_path / "log", seg_bytes=64 * 1024)
+    for lo in range(0, 60_000, 5000):
+        st.insert_batch(_events(5000, start=lo), APP)
+    ns = st._ns(APP, None)
+    assert len(ns.sealed) >= 4
+    for seg in list(ns.sealed):
+        ns.compact(seg)
+    st.scan_workers = 2
+    st.scan_columnar(APP, value_key="rating")  # warm imports/caches
+
+    tracemalloc.start()
+    cols = st.scan_columnar(APP, value_key="rating")
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert cols.n == 60_000
+    assert peak < 24 * 1024 * 1024, f"merge materialized: peak={peak}"
+    st.close()
+
+
+# -- scale ------------------------------------------------------------------
+
+
+def test_segmented_smoke_10k(tmp_path):
+    # fast default-suite smoke: the whole lifecycle at 10k events
+    st = _store(tmp_path / "log", seg_bytes=128 * 1024)
+    for lo in range(0, 10_000, 2000):
+        st.insert_batch(_events(2000, start=lo), APP)
+    ns = st._ns(APP, None)
+    assert len(ns.sealed) >= 2
+    for seg in list(ns.sealed):
+        ns.compact(seg)
+    st.scan_workers = 2
+    cols = st.scan_columnar(APP, value_key="rating")
+    assert cols.n == 10_000
+    exported = sum(chunk.count("\n")
+                   for chunk in st.iter_jsonl_chunks(APP))
+    assert exported == 10_000
+    st.close()
+
+
+@pytest.mark.slow
+def test_parallel_scan_parity_1m(tmp_path):
+    st = _store(tmp_path / "log", seg_bytes=8 * 1024 * 1024)
+    rng = np.random.default_rng(0)
+    uu = rng.integers(0, 6040, 1_000_000)
+    ii = rng.integers(0, 3952, 1_000_000)
+    CH = 20_000
+    for lo in range(0, 1_000_000, CH):
+        evs = [Event(event="rate", entity_type="user",
+                     entity_id=str(int(uu[n])),
+                     target_entity_type="item",
+                     target_entity_id=str(int(ii[n])),
+                     properties={"rating": float(n % 5 + 1)})
+               for n in range(lo, lo + CH)]
+        st.insert_batch(evs, APP)
+    ns = st._ns(APP, None)
+    assert len(ns.sealed) >= 4
+    for seg in list(ns.sealed):
+        ns.compact(seg)
+    st.scan_workers = 1
+    serial = st.scan_columnar(APP, value_key="rating")
+    st.scan_workers = 4
+    par = st.scan_columnar(APP, value_key="rating")
+    assert serial.n == par.n == 1_000_000
+    assert (serial.times_us == par.times_us).all()
+    assert (serial.values == par.values).all()
+    assert serial.entity_ids == par.entity_ids
+    assert (serial.entity_idx == par.entity_idx).all()
+    st.close()
