@@ -284,6 +284,10 @@ class NativeEventLogStore(EventStore):
             if self._durable and self._lib.pel_sync(h) != 0:
                 raise IOError("event log fsync failed")
             if client_ids and ns.sealed:
+                # propagate overwrites into sealed segments; cold
+                # segments are probed through their ship-time id
+                # filters, so a brand-new id never stalls the writer
+                # lock behind a cold-tier fetch
                 ns.tombstone_sealed(client_ids)
             ns.maybe_roll(self.segment_bytes)
         return ids  # type: ignore[return-value]

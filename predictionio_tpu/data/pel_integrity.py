@@ -180,9 +180,9 @@ def check_segment_dir(dir_path: str,
     finalized → ``unchecksummed``); compaction sidecars must match
     their recorded digest. Cold segments whose frame file has shipped
     are reported as ``cold`` and content-checked on fetch instead.
-    Under ``repair`` a bad compaction sidecar is deleted (it is a
-    cache; the raw frames remain authoritative) — frame-file
-    corruption is report-only.
+    Under ``repair`` a bad compaction sidecar or live-id filter is
+    deleted (both are caches; the raw frames remain authoritative) —
+    frame-file corruption is report-only.
     """
     reports: List[Dict[str, object]] = []
     man_path = os.path.join(dir_path, "segments.json")
@@ -257,6 +257,32 @@ def check_segment_dir(dir_path: str,
                         r["detail"] = "compaction sidecar digest mismatch"
                 else:
                     r["cols_status"] = "ok"
+        idf = d.get("idf")
+        if idf and r["status"] in ("ok", "unchecksummed", "cold"):
+            # the live-id filter is a cache like the compaction
+            # sidecar: the tombstone path falls back to fetching the
+            # frames when it is missing, so repair may delete it
+            ip = os.path.join(dir_path, str(idf.get("file")))
+            if not os.path.exists(ip):
+                r["idf_status"] = "missing"
+            else:
+                with open(ip, "rb") as f:
+                    fdata = f.read()
+                if hashlib.sha256(fdata).hexdigest() != idf.get("sha256"):
+                    if repair:
+                        try:
+                            os.unlink(ip)
+                        except OSError:
+                            pass
+                        fsync_dir(dir_path)
+                        r["idf_status"] = "repaired"
+                        r["status"] = "repaired"
+                    else:
+                        r["idf_status"] = "corrupt"
+                        r["status"] = "corrupt"
+                        r["detail"] = "id-filter digest mismatch"
+                else:
+                    r["idf_status"] = "ok"
     return reports
 
 

@@ -12,6 +12,7 @@ plus zero or more SEALED segments under ``events_<app>[_<ch>].peld/``:
         segments.json             manifest (atomic-replace writes)
         seg-000000.pel            sealed segment, immutable
         seg-000000.cols.npz       columnar compaction sidecar
+        seg-000000.ids.bf         live-id filter (ship-time fetch guard)
         seg-000001.pel            ...
 
 A legacy single-file log therefore IS a valid namespace (its lone
@@ -45,7 +46,9 @@ import ctypes
 import hashlib
 import io
 import json
+import logging
 import os
+import struct
 import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -63,10 +66,13 @@ from predictionio_tpu.utils.integrity import (
 )
 from predictionio_tpu.utils.metrics import REGISTRY
 
+logger = logging.getLogger("pio.segments")
+
 SEG_DIR_SUFFIX = ".peld"
 MANIFEST_NAME = "segments.json"
 MANIFEST_SCHEMA = 1
 COLS_SUFFIX = ".cols.npz"
+IDF_SUFFIX = ".ids.bf"
 FAULT_SEGMENT = "data.corrupt.segment"
 DEFAULT_SEGMENT_BYTES = 64 * 1024 * 1024
 _UNBOUNDED_LO = -(2**62)
@@ -80,6 +86,9 @@ SEG_SHIPPED = REGISTRY.counter(
     "pio_segment_shipped_total", "Sealed segments shipped to the cold tier")
 SEG_FETCHES = REGISTRY.counter(
     "pio_segment_fetches_total", "Cold segments fetched back on demand")
+SEG_MAINT_ERRORS = REGISTRY.counter(
+    "pio_segment_maintenance_errors_total",
+    "Errors contained by segment maintenance sweeps")
 
 
 def segment_bytes_threshold() -> int:
@@ -125,6 +134,7 @@ class SegMeta:
     version: int                    # group-commit path)
     cols: Optional[dict] = None     # {"file","sha256","value_keys":[...]}
     remote_key: Optional[str] = None
+    idf: Optional[dict] = None      # {"file","sha256","k","n"} id filter
 
     def to_dict(self) -> dict:
         return {
@@ -134,6 +144,7 @@ class SegMeta:
             "max_creation_us": self.max_creation_us,
             "sha256": self.sha256, "version": self.version,
             "cols": self.cols, "remote_key": self.remote_key,
+            "idf": self.idf,
         }
 
     @classmethod
@@ -145,19 +156,24 @@ class SegMeta:
             max_creation_us=d.get("max_creation_us"),
             sha256=d.get("sha256"), version=int(d.get("version", 2)),
             cols=d.get("cols"), remote_key=d.get("remote_key"),
+            idf=d.get("idf"),
         )
 
 
 class Segment:
     """Runtime state for one sealed segment: manifest row + (lazy)
     engine handle. The handle, once open, stays open for the namespace
-    lifetime — in-flight scans on other threads may hold it."""
+    lifetime — in-flight scans on other threads may hold it. ``gen``
+    counts mutations (tombstone re-seals): slow paths that scan outside
+    the lock snapshot it and abort their commit when it moved."""
 
-    __slots__ = ("meta", "handle")
+    __slots__ = ("meta", "handle", "gen", "idf")
 
     def __init__(self, meta: SegMeta, handle: Optional[int] = None) -> None:
         self.meta = meta
         self.handle = handle
+        self.gen = 0
+        self.idf = None        # cached IdFilter | False (known absent)
 
 
 # ---------------- extended native scan plumbing ---------------------------
@@ -403,6 +419,54 @@ def sidecar_scan(sc: dict, start_us: int, until_us: int,
     return cols, creation_f
 
 
+# ---------------- id membership filters -----------------------------------
+
+
+class IdFilter:
+    """Bloom filter over a sealed segment's live event ids, persisted
+    at ship time so the synchronous write path can prove "this id is
+    not in that cold segment" without fetching the frame file back
+    from the tier. A false positive costs one extra fetch; false
+    negatives cannot happen, so a miss is always safe to skip."""
+
+    __slots__ = ("bits", "k", "m")
+
+    BITS_PER_ID = 12
+    K = 7                           # ~0.3% false positives at 12 b/id
+
+    def __init__(self, bits: bytes, k: int) -> None:
+        self.bits = bits
+        self.k = k
+        self.m = len(bits) * 8
+
+    @staticmethod
+    def _hashes(id_: bytes) -> Tuple[int, int]:
+        d = hashlib.blake2b(id_, digest_size=16).digest()
+        # double hashing: h1 + i*h2 — h2 forced odd so strides cover m
+        return (int.from_bytes(d[:8], "little"),
+                int.from_bytes(d[8:], "little") | 1)
+
+    @classmethod
+    def build(cls, ids: Sequence[bytes]) -> "IdFilter":
+        m = max(1024, len(ids) * cls.BITS_PER_ID)
+        m += -m % 8
+        bits = bytearray(m // 8)
+        for id_ in ids:
+            h1, h2 = cls._hashes(id_)
+            for i in range(cls.K):
+                b = (h1 + i * h2) % m
+                bits[b >> 3] |= 1 << (b & 7)
+        return cls(bytes(bits), cls.K)
+
+    def __contains__(self, id_: str) -> bool:
+        h1, h2 = self._hashes(id_.encode())
+        for i in range(self.k):
+            b = (h1 + i * h2) % self.m
+            if not (self.bits[b >> 3] >> (b & 7)) & 1:
+                return False
+        return True
+
+
 # ---------------- cold tier -----------------------------------------------
 
 
@@ -434,6 +498,10 @@ class LogNamespace:
         self.sealed: List[Segment] = []
         self.next_id = 0
         self.last_scan: Optional[dict] = None
+        # handles swapped out of service (wipe, cold re-materialize):
+        # lock-free readers may still hold them, so they are parked
+        # here and only closed when the namespace itself closes
+        self._retired: List[int] = []
         self._load_manifest()
         self.h = lib.pel_open_ex(base_path.encode(), fmt)
         if not self.h:
@@ -482,6 +550,11 @@ class LogNamespace:
         if not seg.meta.cols:
             return None
         return os.path.join(self.dir_path, seg.meta.cols["file"])
+
+    def idf_path(self, seg: Segment) -> Optional[str]:
+        if not seg.meta.idf:
+            return None
+        return os.path.join(self.dir_path, seg.meta.idf["file"])
 
     # -- rollover ----------------------------------------------------------
 
@@ -612,6 +685,80 @@ class LogNamespace:
         atomic_write_bytes(path, blob)
         SEG_FETCHES.inc()
 
+    def _mutable_handle(self, seg: Segment) -> int:
+        """A handle safe to append tombstones through. A shipped
+        segment's lingering read handle sits on an unlinked inode
+        (:meth:`ship` removes the local path), so appends there would
+        vanish when the handle closes. Re-materialize the authoritative
+        cold copy first and open a fresh handle on it; the stale handle
+        is parked, never closed — lock-free readers may still hold it."""
+        with self.lock:
+            if not os.path.exists(self.seg_path(seg)):
+                self.ensure_local(seg)
+                if seg.handle is not None:
+                    self._retired.append(seg.handle)
+                    seg.handle = None
+            return self.handle_for(seg)
+
+    # -- id membership filters ---------------------------------------------
+
+    def build_id_filter(self, seg: Segment) -> Optional[dict]:
+        """Build + persist the live-id filter for a segment about to go
+        cold (index-only native walk, no payload IO). Best effort: the
+        filter only short-circuits tombstone probes, so on any failure
+        the segment ships without one and probes fall back to fetching."""
+        try:
+            h = self.handle_for(seg)
+            out = ctypes.c_void_p()
+            n = self._lib.pel_live_ids(h, ctypes.byref(out))
+            if n < 0:
+                return None
+            try:
+                buf = ctypes.string_at(out, n)
+            finally:
+                self._lib.pel_free(out)
+            ids = []
+            pos = 0
+            while pos < len(buf):
+                (ln,) = struct.unpack_from("<I", buf, pos)
+                pos += 4
+                ids.append(buf[pos:pos + ln])
+                pos += ln
+            f = IdFilter.build(ids)
+            fname = seg.meta.file[:-len(".pel")] + IDF_SUFFIX
+            atomic_write_bytes(os.path.join(self.dir_path, fname), f.bits)
+            seg.idf = f
+            return {"file": fname, "sha256": sha256_hex(f.bits),
+                    "k": f.k, "n": len(ids)}
+        except Exception:
+            logger.warning("id-filter build failed for %s; cold "
+                           "tombstone probes will fetch", seg.meta.file,
+                           exc_info=True)
+            return None
+
+    def _load_id_filter(self, seg: Segment) -> Optional[IdFilter]:
+        """The segment's persisted id filter (lazy, digest-verified),
+        or None when absent/unreadable — callers then treat every id
+        as a possible member (correct, just slower)."""
+        if seg.idf is not None:
+            return seg.idf or None      # False sentinel = known absent
+        meta = seg.meta.idf
+        path = self.idf_path(seg)
+        if not meta or path is None:
+            seg.idf = False
+            return None
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+            if sha256_hex(blob) != meta.get("sha256"):
+                raise IntegrityError(
+                    f"id-filter digest mismatch: {path}")
+            seg.idf = IdFilter(blob, int(meta.get("k", IdFilter.K)))
+        except (OSError, IntegrityError, ValueError):
+            seg.idf = False
+            return None
+        return seg.idf
+
     # -- compaction --------------------------------------------------------
 
     def sample_value_keys(self, h: int, sample: int = 256) -> List[str]:
@@ -658,6 +805,7 @@ class LogNamespace:
         with self.lock:
             if seg.meta.cols is not None or seg.meta.records == 0:
                 return False
+            gen = seg.gen
         h = self.handle_for(seg)
         keys = list(value_keys) if value_keys is not None \
             else self.sample_value_keys(h)
@@ -669,8 +817,19 @@ class LogNamespace:
         block = parse_scan_ex_blob(blob, keys)
         data = sidecar_bytes(block, keys)
         fname = seg.meta.file[:-len(".pel")] + COLS_SUFFIX
-        atomic_write_bytes(os.path.join(self.dir_path, fname), data)
+        path = os.path.join(self.dir_path, fname)
+        atomic_write_bytes(path, data)
         with self.lock:
+            if seg.gen != gen:
+                # the segment mutated (tombstone re-seal) while we
+                # scanned outside the lock: committing would resurrect
+                # deleted events from the stale snapshot — drop it and
+                # let the next maintenance sweep recompact
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                return False
             self.finalize(seg)
             seg.meta.cols = {"file": fname, "sha256": sha256_hex(data),
                              "value_keys": keys}
@@ -696,6 +855,10 @@ class LogNamespace:
             if seg.meta.cols is None:
                 self.compact(seg)   # best effort; ship regardless
             self.finalize(seg)
+            # live-id filter, persisted locally: the write path probes
+            # it so tombstone misses never fetch the segment back
+            if seg.meta.idf is None:
+                seg.meta.idf = self.build_id_filter(seg)
             path = self.seg_path(seg)
         with open(path, "rb") as f:
             blob = f.read()
@@ -725,7 +888,10 @@ class LogNamespace:
     def tombstone_sealed(self, ids: Sequence[str]) -> int:
         """Propagate deletes/overwrites into sealed segments. Each id
         lives in at most one segment (overwrites tombstone the old copy
-        at insert time), so the walk stops at the first hit per id."""
+        at insert time), so the walk stops at the first hit per id.
+        Cold segments are probed through their shipped-time id filter
+        first: a definite miss skips the segment entirely, so appends
+        with brand-new client-supplied ids never fetch from the tier."""
         deleted = 0
         with self.lock:
             segs = list(self.sealed)
@@ -733,9 +899,19 @@ class LogNamespace:
         for seg in reversed(segs):
             if not remaining:
                 break
-            h = self.handle_for(seg)   # fetches cold segments — rare
+            candidates = remaining
+            if seg.meta.state == "cold":
+                f = self._load_id_filter(seg)
+                if f is not None:
+                    candidates = [i for i in remaining if i in f]
+                if not candidates:
+                    continue        # definite miss: no fetch, no probe
+            # cold segment with a possible hit: re-materialize the
+            # frame file before any mutation (the lingering read handle
+            # sits on an unlinked inode — appends there would be lost)
+            h = self._mutable_handle(seg)
             hit = set()
-            for id_ in remaining:
+            for id_ in candidates:
                 b = id_.encode()
                 r = self._lib.pel_delete(h, b, len(b))
                 if r < 0:
@@ -749,43 +925,53 @@ class LogNamespace:
         return deleted
 
     def _reseal(self, seg: Segment) -> None:
-        """A sealed segment mutated (tombstones): refresh its metadata,
-        drop the now-stale sidecar, and pull it back from the cold tier
-        (the local copy is re-authoritative)."""
+        """A sealed segment mutated (tombstones): refresh its metadata
+        and drop the now-stale sidecar. The local frame file is the new
+        authoritative copy — its digest is recorded in the manifest
+        BEFORE the (now stale) cold-tier object is deleted, so at no
+        point is the only surviving copy an unlinked inode or a
+        remote object about to be removed."""
         with self.lock:
             h = seg.handle
-            if h is not None:
-                self._lib.pel_sync(h)
+            path = self.seg_path(seg)
+            if h is None or not os.path.exists(path):
+                raise IOError(
+                    f"re-seal of {seg.meta.file} without a local frame "
+                    "file — refusing to drop the authoritative copy")
+            self._lib.pel_sync(h)
             mn = ctypes.c_longlong(0)
             mx = ctypes.c_longlong(0)
             count = self._lib.pel_creation_bounds(
-                h, ctypes.byref(mn), ctypes.byref(mx)) if h else 0
+                h, ctypes.byref(mn), ctypes.byref(mx))
             cols = self.cols_path(seg)
             if cols:
                 try:
                     os.unlink(cols)
                 except FileNotFoundError:
                     pass
-            if seg.meta.state == "cold" and seg.meta.remote_key:
-                tier = cold_tier()
-                if tier is not None:
-                    try:
-                        tier.delete(seg.meta.remote_key)
-                    except Exception:
-                        pass  # stale cold copy is harmless: state says
-                        # sealed, nothing will fetch it
+            old_remote = seg.meta.remote_key
             seg.meta.state = "sealed"
             seg.meta.remote_key = None
             seg.meta.cols = None
+            # the id filter stays: tombstones only remove ids, so the
+            # persisted filter remains a superset — still sound
             seg.meta.records = int(count)
             seg.meta.min_creation_us = int(mn.value) if count else None
             seg.meta.max_creation_us = int(mx.value) if count else None
-            path = self.seg_path(seg)
-            seg.meta.sha256 = (_file_sha256(path)
-                               if os.path.exists(path) else None)
-            seg.meta.bytes = (os.path.getsize(path)
-                              if os.path.exists(path) else 0)
+            seg.meta.sha256 = _file_sha256(path)
+            seg.meta.bytes = os.path.getsize(path)
+            seg.gen += 1
             self._write_manifest()
+        # only now — local copy durable and its digest recorded — may
+        # the stale cold object go (network IO, outside the lock)
+        if old_remote:
+            tier = cold_tier()
+            if tier is not None:
+                try:
+                    tier.delete(old_remote)
+                except Exception:
+                    pass  # orphaned object is harmless: state says
+                    # sealed, nothing fetches it, re-ship overwrites it
 
     # -- stats -------------------------------------------------------------
 
@@ -934,9 +1120,13 @@ class LogNamespace:
                 s.meta.state == "cold" for s in self.sealed) else None
             for seg in self.sealed:
                 if seg.handle is not None:
-                    self._lib.pel_close(seg.handle)
+                    # lock-free readers may hold a snapshot of this
+                    # handle: park it (closed at namespace close),
+                    # never free it out from under an in-flight scan
+                    self._retired.append(seg.handle)
                     seg.handle = None
-                for p in (self.seg_path(seg), self.cols_path(seg)):
+                for p in (self.seg_path(seg), self.cols_path(seg),
+                          self.idf_path(seg)):
                     if p:
                         try:
                             os.unlink(p)
@@ -963,6 +1153,9 @@ class LogNamespace:
                 if seg.handle is not None:
                     self._lib.pel_close(seg.handle)
                     seg.handle = None
+            for h in self._retired:
+                self._lib.pel_close(h)
+            self._retired = []
 
     def remove(self) -> None:
         with self.lock:
@@ -992,15 +1185,24 @@ class SegmentMaintenance(threading.Thread):
         self._store = store
         self.interval = interval
         self.keep_local = max(0, keep_local)
-        self._stop = threading.Event()
+        # NOT named _stop: Thread.join() calls the private Thread._stop
+        # method internally, and shadowing it with an Event breaks join
+        self._halt = threading.Event()
         self.sweeps = 0
 
     def run(self) -> None:
-        while not self._stop.wait(self.interval):
+        while not self._halt.wait(self.interval):
             try:
-                self.run_once()
+                res = self.run_once()
+                if res["errors"]:
+                    logger.warning(
+                        "segment maintenance sweep finished with %d "
+                        "contained error(s): %s", res["errors"], res)
             except Exception:
-                pass
+                # systemic failure (bad tier config, permissions):
+                # must be observable, not silently retried forever
+                SEG_MAINT_ERRORS.inc()
+                logger.exception("segment maintenance sweep failed")
 
     def run_once(self) -> dict:
         compacted = shipped = errors = 0
@@ -1019,6 +1221,10 @@ class SegmentMaintenance(threading.Thread):
                         ns.finalize(seg)
                 except Exception:
                     errors += 1
+                    SEG_MAINT_ERRORS.inc()
+                    logger.warning("segment maintenance: compaction/"
+                                   "finalize failed for %s",
+                                   seg.meta.file, exc_info=True)
             if tier is not None:
                 local = [s for s in segs if s.meta.state == "sealed"]
                 for seg in local[:max(0, len(local) - self.keep_local)]:
@@ -1027,10 +1233,14 @@ class SegmentMaintenance(threading.Thread):
                             shipped += 1
                     except Exception:
                         errors += 1
+                        SEG_MAINT_ERRORS.inc()
+                        logger.warning("segment maintenance: ship "
+                                       "failed for %s", seg.meta.file,
+                                       exc_info=True)
         self.sweeps += 1
         return {"compacted": compacted, "shipped": shipped,
                 "errors": errors}
 
     def stop(self) -> None:
-        self._stop.set()
+        self._halt.set()
         self.join(timeout=5.0)

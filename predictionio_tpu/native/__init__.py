@@ -89,6 +89,9 @@ def eventlog_library() -> Optional[ctypes.CDLL]:
     lib.pel_wipe.argtypes = [ctypes.c_void_p]
     lib.pel_count.restype = ctypes.c_longlong
     lib.pel_count.argtypes = [ctypes.c_void_p]
+    lib.pel_live_ids.restype = ctypes.c_longlong
+    lib.pel_live_ids.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]
     # out-params are void* (payloads contain NUL bytes — read with
     # ctypes.string_at(ptr, length), never c_char_p auto-conversion)
     lib.pel_get.restype = ctypes.c_longlong

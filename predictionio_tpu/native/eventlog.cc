@@ -720,6 +720,22 @@ long long pel_count(void* hv) {
   return (long long)h->by_id.size();
 }
 
+// All live event ids as concatenated [u32 len][bytes] frames, in index
+// order. Index-only walk — no payload IO — so a sealed segment about
+// to ship can cheaply persist an id-membership filter. Returns the
+// byte length via the malloc'd *out, -1 on allocation failure.
+long long pel_live_ids(void* hv, char** out) {
+  Handle* h = (Handle*)hv;
+  std::lock_guard<std::mutex> g(h->mu);
+  std::string result;
+  for (const auto& kv : h->by_id) {
+    append_u32(&result, (uint32_t)kv.first.size());
+    result.append(kv.first);
+  }
+  *out = dup_out(result);
+  return *out ? (long long)result.size() : -1;
+}
+
 // Live-event creationTime statistics for the snapshot cache: count of
 // alive records with creation_us <= until_us, and their max
 // creation_us via *max_out (untouched when the count is 0). The walk

@@ -295,6 +295,194 @@ def test_cold_tier_fetch_on_scan_and_corrupt_refusal(tmp_path, monkeypatch):
     st.close()
 
 
+# -- cold-segment tombstones ------------------------------------------------
+
+
+def _custom_events(n, start=0):
+    return [Event(event="rate", entity_type="user",
+                  entity_id=f"u{start + i}",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties={"rating": float(i % 5 + 1)},
+                  event_time=_T0 + dt.timedelta(seconds=start + i),
+                  event_id=f"cust-{start + i}")
+            for i in range(n)]
+
+
+def test_tombstone_in_cold_segment_preserves_data(tmp_path, monkeypatch):
+    # overwriting an id that lives in a SHIPPED segment must pull the
+    # authoritative copy back, apply the tombstone to the local file,
+    # and only then drop the cold object — never append to the
+    # unlinked inode behind the stale read handle
+    monkeypatch.setenv("PIO_SEGMENT_COLD", f"local:{tmp_path / 'cold'}")
+    st = _store(tmp_path / "log", seg_bytes=4096)
+    st.insert_batch(_custom_events(200), APP)
+    ns = st._ns(APP, None)
+    ns.roll()
+    ns.finalize_all()
+    for seg in list(ns.sealed):
+        assert ns.ship(seg)
+    assert all(s.meta.state == "cold" for s in ns.sealed)
+    assert not any(os.path.exists(ns.seg_path(s)) for s in ns.sealed)
+
+    # overwrite one id per sealed segment via the normal write path
+    over = Event(event="rate", entity_type="user", entity_id="u7",
+                 target_entity_type="item", target_entity_id="i1",
+                 properties={"rating": 5.0},
+                 event_time=_T0 + dt.timedelta(days=1),
+                 event_id="cust-7")
+    st.insert_batch([over], APP)
+
+    # the mutated segment is re-sealed LOCALLY with a fresh digest and
+    # its stale cold object deleted; untouched segments stay cold
+    mutated = [s for s in ns.sealed if s.meta.state == "sealed"]
+    assert len(mutated) == 1
+    seg = mutated[0]
+    assert os.path.exists(ns.seg_path(seg))
+    assert seg.meta.sha256 is not None and seg.meta.bytes > 0
+    from predictionio_tpu.data.segments import _file_sha256
+
+    assert _file_sha256(ns.seg_path(seg)) == seg.meta.sha256
+    cold_root = tmp_path / "cold"
+    assert not (cold_root / "segments" / "events_1"
+                / seg.meta.file).exists()
+
+    # survives a restart: the overwrite wins, nothing lost
+    st.close()
+    st = _store(tmp_path / "log", seg_bytes=4096)
+    evs = list(st.find(APP))
+    assert len(evs) == 200
+    got = st.get("cust-7", APP)
+    assert got is not None and got.properties["rating"] == 5.0
+    assert st.get("cust-3", APP) is not None
+    st.close()
+
+
+def test_delete_in_cold_segment_survives_restart(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_SEGMENT_COLD", f"local:{tmp_path / 'cold'}")
+    st = _store(tmp_path / "log", seg_bytes=4096)
+    st.insert_batch(_custom_events(200), APP)
+    ns = st._ns(APP, None)
+    ns.roll()
+    ns.finalize_all()
+    for seg in list(ns.sealed):
+        assert ns.ship(seg)
+    assert st.delete("cust-11", APP)
+    st.close()
+    st = _store(tmp_path / "log", seg_bytes=4096)
+    assert st.get("cust-11", APP) is None
+    assert len(list(st.find(APP))) == 199
+    st.close()
+
+
+def test_new_client_ids_never_fetch_cold_segments(tmp_path, monkeypatch):
+    # the id filter built at ship time must prove brand-new ids absent
+    # without pulling any segment back from the tier
+    monkeypatch.setenv("PIO_SEGMENT_COLD", f"local:{tmp_path / 'cold'}")
+    st = _store(tmp_path / "log", seg_bytes=4096)
+    st.insert_batch(_custom_events(200), APP)
+    ns = st._ns(APP, None)
+    ns.roll()
+    ns.finalize_all()
+    for seg in list(ns.sealed):
+        assert ns.ship(seg)
+        assert seg.meta.idf is not None           # filter persisted
+        assert os.path.exists(ns.idf_path(seg))   # ... and local
+
+    from predictionio_tpu.data.segments import LogNamespace
+
+    fetches = []
+    orig = LogNamespace.ensure_local
+
+    def spy(self, seg):
+        if not os.path.exists(self.seg_path(seg)):  # a real fetch
+            fetches.append(seg.meta.file)
+        return orig(self, seg)
+
+    monkeypatch.setattr(LogNamespace, "ensure_local", spy)
+    st.insert_batch(_custom_events(50, start=10_000), APP)
+    assert fetches == []
+    assert not any(os.path.exists(ns.seg_path(s)) for s in ns.sealed
+                   if s.meta.state == "cold")
+    # a real overwrite of a cold-resident id fetches exactly its segment
+    st.insert_batch([_custom_events(1, start=42)[0]], APP)
+    assert len(fetches) == 1
+    st.close()
+
+
+def test_compact_aborts_on_concurrent_tombstone(tmp_path):
+    # a tombstone re-seal between compact()'s scan and its commit must
+    # abort the commit: the stale sidecar would resurrect the deleted
+    # copy in columnar scans
+    st = _store(tmp_path / "log", seg_bytes=4096)
+    st.insert_batch(_custom_events(200), APP)
+    ns = st._ns(APP, None)
+    ns.roll()
+    seg = ns.sealed[-1]
+
+    orig = ns.sample_value_keys
+
+    def hooked(h, sample=256):
+        # fires inside compact(), outside ns.lock — overwrite an id
+        # living in the segment being compacted (RLock: same thread)
+        st.insert_batch([_custom_events(1, start=3)[0]], APP)
+        return orig(h, sample)
+
+    ns.sample_value_keys = hooked
+    gen_before = seg.gen
+    assert ns.compact(seg) is False
+    assert seg.gen > gen_before       # the tombstone re-sealed it
+    assert seg.meta.cols is None      # no stale sidecar committed
+    ns.sample_value_keys = orig
+
+    assert ns.compact(seg) is True    # clean recompaction succeeds
+    st.scan_workers = 2
+    cols = st.scan_columnar(APP, value_key="rating")
+    assert cols.n == 200              # overwrite did not duplicate
+    st.close()
+
+
+def test_wipe_parks_sealed_handles_until_close(tmp_path):
+    # readers snapshot handles and run lock-free: wipe() must not free
+    # a handle a concurrent scan may still dereference
+    st = _store(tmp_path / "log", seg_bytes=4096)
+    st.insert_batch(_events(400), APP)
+    ns = st._ns(APP, None)
+    assert len(ns.sealed) >= 1
+    live = [ns.handle_for(s) for s in ns.sealed]
+    assert ns.wipe()
+    assert ns.sealed == []
+    assert set(live) <= set(ns._retired)   # parked, not closed
+    st.close()                             # graveyard closed here
+    assert ns._retired == []
+
+
+def test_maintenance_sweep_failure_is_observable(caplog):
+    import logging as _logging
+
+    from predictionio_tpu.data.segments import (
+        SEG_MAINT_ERRORS,
+        SegmentMaintenance,
+    )
+
+    class BoomStore:
+        def namespaces(self):
+            raise RuntimeError("bad tier config")
+
+    before = SEG_MAINT_ERRORS._values.get((), 0.0)
+    m = SegmentMaintenance(BoomStore(), interval=0.01)
+    with caplog.at_level(_logging.ERROR, logger="pio.segments"):
+        m.start()
+        deadline = threading.Event()
+        for _ in range(200):               # ~2 s upper bound
+            if SEG_MAINT_ERRORS._values.get((), 0.0) > before:
+                break
+            deadline.wait(0.01)
+        m.stop()
+    assert SEG_MAINT_ERRORS._values.get((), 0.0) > before
+    assert any("maintenance sweep failed" in r.message
+               for r in caplog.records)
+
+
 # -- fsck -------------------------------------------------------------------
 
 
@@ -388,6 +576,37 @@ def test_fsck_reports_cold_segments_clean(tmp_path, monkeypatch, capsys):
         [a for a in doc["artifacts"]
          if a["artifact"] == "segment" and a["status"] == "cold"])
     assert doc["cold"] >= 1
+    # shipped segments carry their id-filter sidecar, audited clean
+    assert all(a.get("idf_status") == "ok" for a in doc["artifacts"]
+               if a["artifact"] == "segment" and a["status"] == "cold")
+
+
+def test_fsck_repairs_corrupt_id_filter(tmp_path, monkeypatch, capsys):
+    monkeypatch.delenv("PIO_SCAN_CACHE_DIR", raising=False)
+    monkeypatch.setenv("PIO_SEGMENT_COLD", f"local:{tmp_path / 'cold'}")
+    home = tmp_path / "home"
+    st = _store(home / "eventlog", seg_bytes=4096)
+    for lo in range(0, 600, 100):
+        st.insert_batch(_events(100, start=lo), APP)
+    ns = st._ns(APP, None)
+    ns.finalize_all()
+    for seg in list(ns.sealed):
+        assert ns.ship(seg)
+    idf_file = ns.idf_path(ns.sealed[0])
+    st.close()
+
+    with open(idf_file, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff")
+    assert _fsck_cli(home) == 2
+    capsys.readouterr()
+    # the filter is a cache: repair deletes it (tombstone probes fall
+    # back to fetching the segment), exit 3
+    assert _fsck_cli(home, "--repair") == 3
+    capsys.readouterr()
+    assert not os.path.exists(idf_file)
+    assert _fsck_cli(home) == 0
+    capsys.readouterr()
 
 
 # -- streaming merge memory guard -------------------------------------------
