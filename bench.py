@@ -256,6 +256,30 @@ def main() -> None:
     p99_ms = float(np.percentile(lat, 99) * 1e3)
     p50_dev_ms = _device_predict_latency(scorer, n_users)
 
+    # AOT bucket flywheel (server/aot): warm the serving ladder the way
+    # `pio deploy --aot-buckets auto` would, drive each bucket at its
+    # real batch size, and report the per-bucket device-latency p50s
+    # recorded by the pio_predict_device_seconds histogram. The compile
+    # delta over the serving loop must be zero — any hot-path compile
+    # is a warmup gap.
+    from predictionio_tpu.server import aot as aot_mod
+
+    def _jit_dispatches():
+        # serving dispatches that did NOT run a precompiled executable —
+        # each one is a potential on-path XLA compile (warmup gap)
+        return sum(v for k, v in aot_mod._DISPATCHES._values.items()
+                   if k[1] == "jit")
+
+    ladder = aot_mod.BucketLadder.geometric(16 if args.quick else 64)
+    scorer.warm_buckets(ladder, ks=(10,))
+    gaps_before = _jit_dispatches()
+    for B in ladder:
+        users = rng.integers(0, n_users, size=B)
+        for _ in range(20):
+            scorer.recommend_batch(np.asarray(users, np.int32), 10)
+    aot_gaps = _jit_dispatches() - gaps_before
+    p50_by_bucket = aot_mod.device_p50_ms_by_bucket()
+
     baseline = None
     if os.path.exists(BASELINE_FILE):
         try:
@@ -298,6 +322,12 @@ def main() -> None:
             "predict_p50_ms": round(p50_ms, 3),
             "predict_p99_ms": round(p99_ms, 3),
             "predict_p50_device_ms": round(p50_dev_ms, 4),
+            # per-bucket device p50 across the warmed AOT ladder
+            # (histogram upper-bound estimate) + the zero-compile
+            # contract over the bucketed serving loop
+            "predict_p50_device_ms_by_bucket": p50_by_bucket,
+            "aot_buckets": list(ladder.buckets),
+            "aot_serving_jit_fallbacks": int(aot_gaps),
             "predict_queries": n_queries,
             # On this image's tunneled ("axon") chip, every device→host
             # fetch costs a ~66ms round trip, so the end-to-end p50 is

@@ -89,10 +89,26 @@ class Algorithm(ABC, Generic[PD, M, Q, PR]):
     def predict(self, model: M, query: Q) -> PR:
         ...
 
+    #: True when ``batch_predict`` understands AOT-bucket ``PAD``
+    #: sentinels (``server/aot.PAD``) inline — it must then return one
+    #: (discarded) slot per PAD. False (default) → the deploy layer
+    #: strips pads before calling and re-inserts the empty slots.
+    accepts_padding: bool = False
+
     def batch_predict(self, model: M, queries: Sequence[Q]) -> List[PR]:
         """Bulk scoring for `pio batchpredict` and evaluation. Default maps
         ``predict``; algorithms override to batch onto the device."""
         return [self.predict(model, q) for q in queries]
+
+    def aot_warm(self, model: M, ladder: Any,
+                 ks: Sequence[int] = (16,)) -> Optional[dict]:
+        """Deploy-time AOT warmup hook (``server/aot.AOTWarmup``):
+        compile this algorithm's serving program for every batch bucket
+        in ``ladder`` (× each top-k width in ``ks``) so no query shape
+        ≤ max_batch ever compiles on the hot path. Return
+        ``{"targets", "compiled", "cached"}`` counts, or None.
+        Default: nothing to warm (host-side serving)."""
+        return None
 
     @classmethod
     def train_many(cls, ctx: WorkflowContext, prepared_data: PD,

@@ -222,11 +222,29 @@ class DeployedEngine:
         return self.serving.serve(q, preds)
 
     def batch_query(self, queries: Sequence[Any]) -> List[Any]:
-        qs = [self.serving.supplement(q) for q in queries]
-        per_algo = [algo.batch_predict(model, qs)
-                    for (_, algo), model in zip(self.algorithms, self.models)]
+        """Answer a batch; AOT-bucket ``PAD`` sentinels (server/aot) pass
+        through untouched: pad slots are never supplemented or served and
+        come back as PAD so the batcher can slice them off. Algorithms
+        that batch onto the device (``accepts_padding``) see the padded
+        list inline — their executable was compiled for the bucket shape
+        — while per-query algorithms only ever see real queries."""
+        from predictionio_tpu.server.aot import PAD, is_pad
+
+        qs = [q if is_pad(q) else self.serving.supplement(q)
+              for q in queries]
+        real = [q for q in qs if not is_pad(q)]
+        per_algo = []
+        for (_, algo), model in zip(self.algorithms, self.models):
+            if getattr(algo, "accepts_padding", False) or len(real) == len(qs):
+                per_algo.append(algo.batch_predict(model, qs))
+            else:
+                preds = algo.batch_predict(model, real)
+                it = iter(preds)
+                per_algo.append(
+                    [None if is_pad(q) else next(it) for q in qs])
         return [
-            self.serving.serve(q, [preds[i] for preds in per_algo])
+            PAD if is_pad(q)
+            else self.serving.serve(q, [preds[i] for preds in per_algo])
             for i, q in enumerate(qs)
         ]
 

@@ -1027,17 +1027,19 @@ def recommend(
     return top, scores[top]
 
 
-def _gather_score_topk_impl(U, Vp, user_ids, k: int, n_valid: int,
-                            pallas: bool, tile: int):
+def _gather_score_topk_impl(U, Vp, user_ids, rows_valid=None, *, k: int,
+                            n_valid: int, pallas: bool, tile: int):
     import jax.numpy as jnp
 
     from predictionio_tpu import ops
 
     Q = U[user_ids]
     if pallas:
-        vals, idx = ops.score_topk(Q, Vp, k, tile=tile, n_valid=n_valid)
+        vals, idx = ops.score_topk(Q, Vp, k, tile=tile, n_valid=n_valid,
+                                   rows_valid=rows_valid)
     else:
-        vals, idx = ops.score_topk_xla(Q, Vp, k, n_valid=n_valid)
+        vals, idx = ops.score_topk_xla(Q, Vp, k, n_valid=n_valid,
+                                       rows_valid=rows_valid)
     # pack (vals, idx) into ONE output array: each device→host fetch is
     # a full round trip (~66ms each over a tunneled chip), so a query
     # must fetch exactly once. Item indices are exact in f32 (< 2^24).
@@ -1053,7 +1055,7 @@ def _gather_score_topk_jit():
 
 
 def _gather_score_topk(U, Vp, user_ids, *, k: int, n_valid: int,
-                       pallas: bool, tile: int):
+                       pallas: bool, tile: int, rows_valid=None):
     """The p50-critical serving program: gather + score + top-k as ONE
     compiled dispatch, ONE packed host fetch. Eager composition here
     costs a host↔device round trip per op — measured 158ms p50 over the
@@ -1062,9 +1064,19 @@ def _gather_score_topk(U, Vp, user_ids, *, k: int, n_valid: int,
     import jax.numpy as jnp
 
     packed = np.asarray(_gather_score_topk_jit()(
-        U, Vp, jnp.asarray(user_ids, jnp.int32), k=k, n_valid=n_valid,
-        pallas=pallas, tile=tile))
+        U, Vp, jnp.asarray(user_ids, jnp.int32), rows_valid, k=k,
+        n_valid=n_valid, pallas=pallas, tile=tile))
     return packed[..., :k], packed[..., k:].astype(np.int32)
+
+
+def _bucket_k(want: int) -> int:
+    """Serving k bucketed to powers of two from 16 (bounds the set of
+    compiled programs; shared by the hot path and the AOT warmup so
+    they agree on which executables exist)."""
+    k = 16
+    while k < want:
+        k *= 2
+    return k
 
 
 _SERVE_MIN_ITEMS = 2048
@@ -1107,12 +1119,22 @@ def serve_topk_batch(scorer, user_ids, item_inv, queries, fallback,
     ``user_ids``: str id → row index mapping (``.get``);
     ``item_inv``: row index → item id; ``fallback``: per-query callable
     returning a response dict.
+
+    AOT-bucket ``PAD`` sentinels (``server/aot.PAD``, appended by the
+    MicroBatcher to fill a batch up to its bucket) are never served:
+    their slots stay None and the batcher slices them off the fan-out;
+    the device batch itself is re-padded to the scorer's bucket ladder
+    with masked rows inside ``recommend_batch``.
     """
+    from predictionio_tpu.server.aot import PAD
+
     if scorer is None:
-        return [fallback(q) for q in queries]
+        return [None if q is PAD else fallback(q) for q in queries]
     out = [None] * len(queries)
     rows = []  # (out index, user row, num)
     for i, q in enumerate(queries):
+        if q is PAD:
+            continue
         if per_query is not None and per_query(q):
             out[i] = fallback(q)
             continue
@@ -1179,8 +1201,22 @@ class ResidentScorer:
         pad = -self.n_items % self._TILE
         Vp = np.concatenate([V, np.zeros((pad, self.rank), V.dtype)]) if pad else V
         self._V_padded = jax.device_put(jnp.asarray(Vp, jnp.float32))
+        #: AOT-bucket serving state (server/aot): when a ladder is set
+        #: (deploy-time warmup / --aot-buckets), batch sizes snap to it
+        #: and warmed buckets dispatch a precompiled executable
+        self.bucket_ladder = None
+        self._aot: dict = {}   # (B, k) -> (compiled, pallas)
 
-    def _topk(self, user_ids, k: int):
+    # -- AOT bucket ladder (server/aot) ---------------------------------------
+
+    def set_bucket_ladder(self, ladder) -> None:
+        """Snap serving batch sizes to ``ladder`` (a
+        ``server/aot.BucketLadder``) instead of the default
+        power-of-two rule; warmed buckets then dispatch precompiled
+        executables."""
+        self.bucket_ladder = ladder
+
+    def _pallas_for(self, B: int, k: int) -> bool:
         from predictionio_tpu import ops
 
         # The streaming kernel pays off once the (B, n_items) score
@@ -1189,11 +1225,93 @@ class ResidentScorer:
         # v5e: XLA 1.5ms vs Pallas 2.8ms at B=32, N=27k).
         # k > 1024 would unroll the kernel's selection loop too far —
         # XLA's top_k handles large k better.
-        pallas = (ops.use_pallas() and k <= 1024
-                  and len(user_ids) * self.n_items > 64_000_000)
-        return _gather_score_topk(
-            self._U, self._V_padded, user_ids, k=k, n_valid=self.n_items,
-            pallas=pallas, tile=self._TILE)
+        return (ops.use_pallas() and k <= 1024
+                and B * self.n_items > 64_000_000)
+
+    def _aot_key(self, B: int, k: int, pallas: bool) -> tuple:
+        import jax
+
+        # everything that selects a distinct XLA program — executables
+        # are shared process-wide across same-geometry models, which is
+        # what makes a same-geometry /reload compile-free
+        return ("gather_score_topk", self.n_users, self.rank,
+                int(self._V_padded.shape[0]), self.n_items, B, k,
+                pallas, self._TILE, jax.default_backend())
+
+    def _ensure_executable(self, B: int, k: int) -> bool:
+        """AOT lower+compile the serving program for one (batch bucket,
+        k) pair, via the process-wide executable cache. Returns True if
+        this call cold-compiled (False = cache hit)."""
+        import jax
+
+        from predictionio_tpu.server.aot import EXECUTABLES
+
+        pallas = self._pallas_for(B, k)
+        key = self._aot_key(B, k, pallas)
+        was_cold = EXECUTABLES.get(key) is None
+
+        def build():
+            sds = (
+                jax.ShapeDtypeStruct((self.n_users, self.rank), np.float32),
+                jax.ShapeDtypeStruct(tuple(self._V_padded.shape), np.float32),
+                jax.ShapeDtypeStruct((B,), np.int32),
+                jax.ShapeDtypeStruct((), np.int32),  # rows_valid
+            )
+            return _gather_score_topk_jit().lower(
+                *sds, k=k, n_valid=self.n_items, pallas=pallas,
+                tile=self._TILE).compile()
+
+        self._aot[(B, k)] = (EXECUTABLES.get_or_compile(key, build), pallas)
+        return was_cold
+
+    def warm_buckets(self, ladder, ks=(16,)) -> dict:
+        """Deploy-time warmup: compile (or adopt from the process-wide
+        cache) one executable per (bucket, k); adopts ``ladder`` as
+        this scorer's serving ladder. Returns
+        ``{"targets", "compiled", "cached"}`` for warmup progress."""
+        self.set_bucket_ladder(ladder)
+        compiled = cached = 0
+        for B in ladder:
+            for k in ks:
+                kk = min(_bucket_k(k), self.n_items)
+                if self._ensure_executable(B, kk):
+                    compiled += 1
+                else:
+                    cached += 1
+        return {"targets": compiled + cached,
+                "compiled": compiled, "cached": cached}
+
+    def _topk(self, user_ids, k: int, rows: Optional[int] = None):
+        """One serving dispatch at an (already bucket-padded) batch.
+        ``rows`` = real row count (pad rows masked on device). Warmed
+        buckets run the precompiled executable; anything else falls
+        back to jit dispatch (counted — a fallback on the serving path
+        means a warmup gap)."""
+        import time
+
+        from predictionio_tpu.server import aot
+        from predictionio_tpu.utils import tracing
+
+        B = len(user_ids)
+        rows_valid = np.int32(B if rows is None else rows)
+        entry = self._aot.get((B, k))
+        path = "aot" if entry is not None else "jit"
+        with tracing.span("serving.device", bucket=B, k=k, path=path):
+            t0 = time.perf_counter()
+            if entry is not None:
+                prog, _pallas = entry
+                packed = np.asarray(prog(
+                    self._U, self._V_padded,
+                    np.asarray(user_ids, np.int32), rows_valid))
+                out = packed[..., :k], packed[..., k:].astype(np.int32)
+            else:
+                out = _gather_score_topk(
+                    self._U, self._V_padded, user_ids, k=k,
+                    n_valid=self.n_items, pallas=self._pallas_for(B, k),
+                    tile=self._TILE, rows_valid=rows_valid)
+            aot.record_device_latency(B, time.perf_counter() - t0, path,
+                                      trace_exemplar=tracing.exemplar())
+        return out
 
     def recommend_batch(
         self, user_ids: np.ndarray, num: int,
@@ -1215,23 +1333,27 @@ class ResidentScorer:
         # bucket k to powers of two (bounds recompiles); over-fetch for
         # exclusions but never more than the catalog
         want = min(num + max_ex, self.n_items)
-        k = 16
-        while k < want:
-            k *= 2
-        k = min(k, self.n_items)
+        k = min(_bucket_k(want), self.n_items)
         # bucket the BATCH dimension too: the micro-batcher produces
         # every size from 1..max_batch, and an unpadded B would compile
         # a program per distinct size (measured: 172 ms p99 under 8
-        # concurrent clients vs ~7 ms once warm — r4). Pad rows reuse
-        # user 0 and are sliced off after the dispatch.
+        # concurrent clients vs ~7 ms once warm — r4). With an AOT
+        # ladder set (deploy warmup) batches snap to ITS buckets so
+        # every dispatch hits a precompiled executable; pad rows reuse
+        # user 0, are masked on device, and are sliced off after the
+        # dispatch.
         B = len(user_ids)
-        Bp = 1
-        while Bp < B:
-            Bp *= 2
+        Bp = (self.bucket_ladder.snap(B)
+              if self.bucket_ladder is not None else 0)
+        if Bp < B:  # no ladder, or batch beyond its top bucket
+            # (direct recommend_batch callers, e.g. pio batchpredict)
+            Bp = 1
+            while Bp < B:
+                Bp *= 2
         ids = np.asarray(user_ids, np.int32)
         if Bp != B:
             ids = np.concatenate([ids, np.zeros(Bp - B, np.int32)])
-        vals, idx = self._topk(ids, k)
+        vals, idx = self._topk(ids, k, rows=B)
         vals, idx = np.asarray(vals)[:B], np.asarray(idx)[:B]
         out = []
         for row in range(len(user_ids)):

@@ -26,15 +26,32 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG = -3.0e38  # finite "-inf" (python float so the kernel doesn't capture a traced constant)
 
 
+def _mask_pad_rows(Q, rows_valid):
+    """Zero query rows ≥ ``rows_valid`` (a TRACED scalar, so one
+    executable serves every real batch size within a padded bucket).
+    Zeroed rows produce all-zero scores — defined, finite outputs for
+    the pad rows the caller slices off — and cannot perturb real rows
+    (each batch row's score/top-k is row-independent), which is the
+    padded-parity guarantee tests/test_aot_serving.py asserts
+    bitwise."""
+    row = jax.lax.broadcasted_iota(jnp.int32, (Q.shape[0], 1), 0)
+    return jnp.where(row < rows_valid, Q, jnp.zeros_like(Q))
+
+
 @functools.partial(jax.jit, static_argnames=("k", "n_valid"))
-def score_topk_xla(Q, V, k: int, n_valid: int = 0):
+def score_topk_xla(Q, V, k: int, n_valid: int = 0, rows_valid=None):
     """XLA fallback: full (B, N) score matrix then lax.top_k.
 
     ``n_valid``: real row count when V carries tail padding (lets a
     caller share one padded resident copy with :func:`score_topk`).
+    ``rows_valid``: optional traced scalar — real BATCH-row count when
+    Q carries AOT-bucket padding; pad rows are masked (see
+    :func:`_mask_pad_rows`).
     Jitted: the serving path must be ONE dispatch — eager ops each pay
     a host→device round trip (brutal over a tunneled chip).
     """
+    if rows_valid is not None:
+        Q = _mask_pad_rows(Q, rows_valid)
     scores = jnp.dot(Q, V.T, preferred_element_type=jnp.float32,
                      precision=jax.lax.Precision.HIGHEST)
     if n_valid and n_valid < V.shape[0]:
@@ -87,13 +104,17 @@ def _topk_kernel(Q_ref, V_ref, vals_ref, idx_ref, best_v, best_i,
 @functools.partial(jax.jit,
                    static_argnames=("k", "tile", "n_valid", "interpret"))
 def score_topk(Q, V, k: int, *, tile: int = 512, n_valid: int = 0,
-               interpret: bool = False):
+               rows_valid=None, interpret: bool = False):
     """(B,d),(N,d) → top-k (vals (B,k), idx (B,k)) of Q·Vᵀ, streamed.
 
     Pass a pre-padded V (rows a multiple of ``tile``) with ``n_valid``
     set to the real item count to avoid a per-call pad of the factor
-    matrix on the serving hot path.
+    matrix on the serving hot path. ``rows_valid`` (traced scalar)
+    masks AOT-bucket pad rows of Q before the kernel — same contract
+    as :func:`score_topk_xla`.
     """
+    if rows_valid is not None:
+        Q = _mask_pad_rows(Q, rows_valid)
     B, d = Q.shape
     N = n_valid or V.shape[0]
     n_pad = -V.shape[0] % tile

@@ -33,17 +33,43 @@ import asyncio
 import concurrent.futures
 from typing import Any, Callable, List, Optional, Sequence
 
+from predictionio_tpu.server.aot import PAD, BucketLadder
+from predictionio_tpu.utils.metrics import REGISTRY
+
+_BATCHES = REGISTRY.counter(
+    "pio_batcher_batches_total", "Micro-batch dispatches issued")
+_SUBMITTED = REGISTRY.counter(
+    "pio_batcher_submitted_total", "Queries accepted by the micro-batcher")
+_ISOLATIONS = REGISTRY.counter(
+    "pio_batcher_isolations_total",
+    "Failed batches re-run query-by-query")
+_BATCH_SIZE = REGISTRY.histogram(
+    "pio_batcher_batch_size", "Real (pre-padding) queries per dispatch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+_BUCKET_DISPATCH = REGISTRY.counter(
+    "pio_batcher_bucket_dispatch_total",
+    "Dispatches per padded AOT bucket size", labelnames=("bucket",))
+
 
 class MicroBatcher:
-    """Order-preserving async micro-batcher around a sync batch fn."""
+    """Order-preserving async micro-batcher around a sync batch fn.
+
+    With a ``BucketLadder`` attached, every collected batch is snapped UP
+    to the nearest ladder bucket and padded with ``PAD`` sentinels before
+    dispatch, so the device program always runs at a shape the AOT warmup
+    already compiled — zero hot-path XLA compiles. The pad slots are
+    sliced off before results fan back out to callers.
+    """
 
     def __init__(self, fn_batch: Callable[[Sequence[Any]], List[Any]],
-                 max_batch: int = 64, max_wait_ms: float = 0.0) -> None:
+                 max_batch: int = 64, max_wait_ms: float = 0.0,
+                 ladder: Optional[BucketLadder] = None) -> None:
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.fn_batch = fn_batch
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1e3
+        self.ladder = ladder
         self._queue: asyncio.Queue = asyncio.Queue()
         self._worker: Optional[asyncio.Task] = None
         self._executor: Optional[
@@ -73,8 +99,35 @@ class MicroBatcher:
         self._ensure_worker()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self.submitted += 1
+        _SUBMITTED.inc()
         await self._queue.put((query, fut))
         return await fut
+
+    def _pad_to_bucket(self, queries: List[Any]) -> List[Any]:
+        """Snap the batch up to the nearest ladder bucket with PAD
+        sentinels (no-op without a ladder, or when the batch already
+        sits on a bucket)."""
+        if self.ladder is None:
+            return queries
+        bucket = self.ladder.snap(len(queries))
+        if bucket <= len(queries):  # snap() floors at the top bucket
+            return queries
+        return queries + [PAD] * (bucket - len(queries))
+
+    def _dispatch(self, queries: List[Any]) -> List[Any]:
+        """Synchronous dispatch (runs on the batcher executor): pad to
+        the bucket, call the batch fn, arity-check at the PADDED length,
+        slice the pad slots back off."""
+        n = len(queries)
+        padded = self._pad_to_bucket(queries)
+        _BATCH_SIZE.observe(n)
+        _BUCKET_DISPATCH.inc(labels=(str(len(padded)),))
+        results = self.fn_batch(padded)
+        if len(results) != len(padded):
+            raise RuntimeError(
+                f"batch fn returned {len(results)} results for "
+                f"{len(padded)} queries")
+        return results[:n]
 
     async def _collect(self) -> List[tuple]:
         """One batch: block for the first item, then take everything
@@ -110,14 +163,11 @@ class MicroBatcher:
             items = await self._collect()
             queries = [q for q, _ in items]
             self.batches += 1
+            _BATCHES.inc()
             loop = asyncio.get_running_loop()
             try:
                 results = await loop.run_in_executor(
-                    self._get_executor(), self.fn_batch, queries)
-                if len(results) != len(queries):
-                    raise RuntimeError(
-                        f"batch fn returned {len(results)} results for "
-                        f"{len(queries)} queries")
+                    self._get_executor(), self._dispatch, queries)
             except Exception as e:
                 if len(items) == 1:
                     if not items[0][1].done():
@@ -128,16 +178,13 @@ class MicroBatcher:
                 # the offender's ValueError would read as 400 for a fine
                 # query). Isolate by re-running every query alone.
                 self.isolations += 1
+                _ISOLATIONS.inc()
                 for q, fut in items:
                     if fut.done():  # caller gone — don't burn a dispatch
                         continue
                     try:
                         r = await loop.run_in_executor(
-                            self._get_executor(), self.fn_batch, [q])
-                        if len(r) != 1:
-                            raise RuntimeError(
-                                f"batch fn returned {len(r)} results for "
-                                "1 query")
+                            self._get_executor(), self._dispatch, [q])
                     except Exception as single_e:
                         if not fut.done():
                             fut.set_exception(single_e)
@@ -151,10 +198,20 @@ class MicroBatcher:
 
     def stop(self) -> None:
         """Cancel the collector and release the executor. The batcher
-        stays usable: the next submit() restarts both."""
+        stays usable: the next submit() restarts both. Queries still
+        queued (never dispatched) are failed immediately so their
+        callers don't hang awaiting a worker that no longer exists."""
         if self._worker is not None:
             self._worker.cancel()
             self._worker = None
         if self._executor is not None:
-            self._executor.shutdown(wait=False)
+            self._executor.shutdown(wait=False, cancel_futures=True)
             self._executor = None
+        while True:
+            try:
+                _, fut = self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if not fut.done():
+                fut.set_exception(
+                    RuntimeError("micro-batcher stopped before dispatch"))

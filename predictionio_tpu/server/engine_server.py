@@ -83,6 +83,8 @@ class EngineServer:
         batching: bool = False,
         batch_max: int = 64,
         batch_wait_ms: float = 0.0,
+        aot_buckets: Optional[str] = None,
+        aot_topk: int = 16,
         query_timeout_ms: float = 0.0,
         max_inflight: int = 0,
         reload_probe: bool = True,
@@ -164,6 +166,21 @@ class EngineServer:
             "feedback_sink": self._sink_breaker}
         self._feedback_pool = None
         self._feedback_inflight = 0
+        #: AOT warmup: compile the serving program for every padded
+        #: batch bucket at deploy time (and pre-swap at /reload), so no
+        #: query shape ≤ max_batch ever XLA-compiles on the hot path
+        self._warmup = None
+        ladder = None
+        if aot_buckets is not None:
+            from predictionio_tpu.server.aot import AOTWarmup, BucketLadder
+
+            ladder = BucketLadder.parse(aot_buckets, batch_max)
+            # an explicit ladder defines its own max batch: collecting
+            # past the top bucket would dispatch an uncompiled shape
+            batch_max = ladder.max_batch
+            self._warmup = AOTWarmup(ladder, ks=(aot_topk,))
+            if self.deployed is not None:
+                self._warmup.start(self.deployed)
         self._batcher = None
         if batching:
             from predictionio_tpu.server.batching import MicroBatcher
@@ -171,7 +188,8 @@ class EngineServer:
             # bind late so /reload hot-swaps reach the batcher too
             self._batcher = MicroBatcher(
                 self._batch_worker,
-                max_batch=batch_max, max_wait_ms=batch_wait_ms)
+                max_batch=batch_max, max_wait_ms=batch_wait_ms,
+                ladder=ladder)
         router = Router()
         router.route("POST", "/queries.json", self._queries)
         router.route("GET", "/", self._status)
@@ -403,10 +421,15 @@ class EngineServer:
 
         - ``200 {"status": "ok"}``       — serving, all breakers closed
         - ``200 {"status": "degraded"}`` — serving, but a dependency
-          breaker is open or the server is at its inflight cap; a
-          supervisor must NOT restart on this (restarting doesn't fix
-          a down dependency), which is why degraded stays < 500
-        - ``503 {"status": "not-ready"}``— no engine loaded yet
+          breaker is open, the server is at its inflight cap, or AOT
+          warmup FAILED (queries still serve via jit fallback, just
+          with first-shape compile cliffs); a supervisor must NOT
+          restart on this (restarting doesn't fix a down dependency),
+          which is why degraded stays < 500
+        - ``503 {"status": "not-ready"}``— no engine loaded yet, or
+          the AOT bucket ladder is still compiling (``warmup`` block
+          carries progress); a load balancer keeps traffic off the
+          instance until every serving bucket is precompiled
         """
         open_breakers = [n for n, b in self._breakers.items()
                          if b.state == OPEN]
@@ -417,13 +440,25 @@ class EngineServer:
             "inflight": self._inflight,
             "reloadGeneration": self.reload_generation,
         }
+        if self._warmup is not None:
+            body["warmup"] = self._warmup.progress()
         if self.deployed is None:
             return Response.json(
                 {"status": "not-ready", "reason": self._load_error, **body},
                 status=503)
-        if open_breakers or at_capacity:
+        if self._warmup is not None and self._warmup.state in (
+                "idle", "warming"):
+            return Response.json(
+                {"status": "not-ready",
+                 "reason": "aot warmup in progress", **body},
+                status=503)
+        warmup_failed = (self._warmup is not None
+                         and self._warmup.state == "failed")
+        if open_breakers or at_capacity or warmup_failed:
             reason = ("breaker open: " + ",".join(open_breakers)
-                      if open_breakers else "at inflight capacity")
+                      if open_breakers else
+                      "at inflight capacity" if at_capacity
+                      else "aot warmup failed")
             return Response.json(
                 {"status": "degraded", "reason": reason, **body})
         return Response.json({"status": "ok", **body})
@@ -465,6 +500,26 @@ class EngineServer:
                 sp.set_error(f"reload failed: {e}")
                 return Response.json(
                     {"message": f"reload failed: {e}"}, status=500)
+            if self._warmup is not None:
+                # warm the CANDIDATE's bucket ladder BEFORE the probe
+                # and swap: a same-geometry candidate is pure
+                # executable-cache hits (zero compiles); a new geometry
+                # compiles here, off the hot path, while the old engine
+                # keeps serving. Either way the probe below — and the
+                # first post-swap query — run precompiled.
+                try:
+                    await asyncio.to_thread(self._warmup.warm_sync, new)
+                    self._warmup.mark_ready()
+                except Exception as e:
+                    old = self.deployed
+                    self._m_reloads.inc(("rolled_back",))
+                    sp.set_error("aot warmup failed; rolled back")
+                    kept = (old.instance.id if old is not None else None)
+                    return Response.json(
+                        {"message": "reload rolled back: aot warmup failed: "
+                                    f"{type(e).__name__}: {e}",
+                         "engineInstanceId": kept},
+                        status=500)
             probe = self._last_good_query
             if self.reload_probe and probe is not None:
                 try:

@@ -314,6 +314,11 @@ class ALSAlgorithm(Algorithm):
         num = int(query.get("num", 10))
         return {"itemScores": model.recommend_products(user, num)}
 
+    #: serve_topk_batch skips AOT-bucket PAD sentinels inline (their
+    #: slots come back None), so the deploy layer can hand us the
+    #: padded batch directly
+    accepts_padding = True
+
     def batch_predict(self, model: ALSModel, queries) -> List[Dict[str, Any]]:
         """Micro-batched serving (`pio deploy --batching`, batchpredict,
         evaluation): all top-k-shaped queries in the batch score in ONE
@@ -325,6 +330,15 @@ class ALSAlgorithm(Algorithm):
             model._device_scorer(), model.user_ids, model._item_inv,
             queries, fallback=lambda q: self.predict(model, q),
             per_query=lambda q: "item" in q)
+
+    def aot_warm(self, model: ALSModel, ladder, ks=(16,)):
+        """Compile the gather→score→top-k serving executable for every
+        (bucket, k) before traffic arrives (server/aot warmup contract);
+        host-path catalogs (no resident scorer) have nothing to warm."""
+        scorer = model._device_scorer()
+        if scorer is None:
+            return {"targets": 0, "compiled": 0, "cached": 0}
+        return scorer.warm_buckets(ladder, ks)
 
     # structured persistence: npz for factors (compact, zero-copy load)
     def save_model(self, model: ALSModel, instance_dir: Optional[str]) -> bytes:

@@ -213,6 +213,9 @@ class TwoTowerAlgorithm(Algorithm):
         return {"itemScores": model.recommend(str(query["user"]),
                                               int(query.get("num", 10)))}
 
+    #: serve_topk_batch skips AOT-bucket PAD sentinels inline
+    accepts_padding = True
+
     def batch_predict(self, model: TwoTowerModel,
                       queries) -> List[Dict[str, Any]]:
         """Micro-batched serving (`pio deploy --batching`,
@@ -223,6 +226,15 @@ class TwoTowerAlgorithm(Algorithm):
         return serve_topk_batch(
             model._device_scorer(), model.user_ids, model._inv,
             queries, fallback=lambda q: self.predict(model, q))
+
+    def aot_warm(self, model: TwoTowerModel, ladder, ks=(16,)):
+        """Warm the retrieval executable across the bucket ladder —
+        two-tower serving rides the SAME gather→score→top-k program as
+        the ALS family, so the warmup contract is identical."""
+        scorer = model._device_scorer()
+        if scorer is None:
+            return {"targets": 0, "compiled": 0, "cached": 0}
+        return scorer.warm_buckets(ladder, ks)
 
     def save_model(self, model: TwoTowerModel, instance_dir: Optional[str]) -> bytes:
         # user_embeds is NOT persisted: it is derivable from user_vars

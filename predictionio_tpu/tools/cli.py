@@ -192,6 +192,8 @@ def cmd_deploy(args: argparse.Namespace) -> None:
         batching=args.batching,
         batch_max=args.batch_max,
         batch_wait_ms=args.batch_wait_ms,
+        aot_buckets=args.aot_buckets,
+        aot_topk=args.aot_topk,
         query_timeout_ms=args.query_timeout_ms,
         max_inflight=args.max_inflight,
         access_log=args.access_log,
@@ -748,6 +750,17 @@ def build_parser() -> argparse.ArgumentParser:
     dp.add_argument("--batch-wait-ms", type=float, default=0.0,
                     help="opt-in batch-formation wait; 0 = drain-only "
                          "continuous batching (default)")
+    dp.add_argument("--aot-buckets", default=None,
+                    help="AOT-compile the serving program for a ladder of "
+                         "padded batch buckets at deploy time: 'auto' = "
+                         "geometric 1,2,4,..,batch-max; or an explicit "
+                         "comma list e.g. '1,4,16,64' (its largest bucket "
+                         "becomes the effective batch max). /health stays "
+                         "not-ready until the ladder is compiled; unset = "
+                         "no AOT warmup (shapes compile on first use)")
+    dp.add_argument("--aot-topk", type=int, default=16,
+                    help="top-k width to warm the AOT ladder at (serving "
+                         "k is bucketed up to this program shape)")
     dp.add_argument("--query-timeout-ms", type=float, default=0.0,
                     help="per-request deadline for /queries.json; a query "
                          "still running at the deadline returns 504 "

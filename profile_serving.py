@@ -299,6 +299,77 @@ def run_tracing_mode(args, st, factory) -> None:
     }))
 
 
+def run_aot_mode(args, st, factory) -> None:
+    """AOT bucket-ladder profile (ROADMAP item 5 / docs/perf.md):
+    cold-warm the ladder (real lower+compile wall time), re-warm a
+    second deploy of the same geometry (must be pure executable-cache
+    hits), then drive every bucket at its REAL batch size and report
+    per-bucket device p50 — asserting zero XLA compiles happened on
+    the serving path."""
+    import os
+
+    os.environ.setdefault("PIO_ALS_SERVE", "device")
+    from predictionio_tpu.core.workflow import prepare_deploy
+    from predictionio_tpu.server.aot import (
+        EXECUTABLES,
+        AOTWarmup,
+        BucketLadder,
+    )
+
+    ladder = BucketLadder.parse(args.aot_buckets, args.batch_max)
+    deployed = prepare_deploy(engine_factory=factory, storage=st)
+
+    warmup = AOTWarmup(ladder, ks=(10,))
+    t0 = time.perf_counter()
+    cold = warmup.warm_sync(deployed)
+    cold_wall = time.perf_counter() - t0
+
+    # same geometry, fresh model objects → every (bucket, k) must hit
+    # the process-wide executable cache: this is the /reload story
+    deployed2 = prepare_deploy(engine_factory=factory, storage=st)
+    t0 = time.perf_counter()
+    warm = warmup.warm_sync(deployed2)
+    warm_wall = time.perf_counter() - t0
+
+    rng = np.random.default_rng(4)
+    counts_before = EXECUTABLES.counts()
+    per_bucket = {}
+    for B in ladder:
+        users = rng.integers(0, args.n_users, size=B)
+        queries = [{"user": str(int(u)), "num": 10} for u in users]
+        lat = np.empty(args.aot_iters)
+        for i in range(-5, args.aot_iters):  # 5 warm laps per bucket
+            t0 = time.perf_counter()
+            out = deployed2.batch_query(queries)
+            if i >= 0:
+                lat[i] = time.perf_counter() - t0
+        assert len(out) == B and all(r["itemScores"] for r in out)
+        per_bucket[str(B)] = {
+            "p50_ms": round(float(np.percentile(lat, 50) * 1e3), 4),
+            "p99_ms": round(float(np.percentile(lat, 99) * 1e3), 4),
+        }
+    counts_after = EXECUTABLES.counts()
+    serving_compiles = (counts_after.get("compile", 0)
+                        - counts_before.get("compile", 0))
+
+    print(json.dumps({
+        "metric": "aot_serving_buckets",
+        "geometry": {"n_users": args.n_users, "n_items": args.n_items,
+                     "rank": args.rank},
+        "buckets": list(ladder.buckets),
+        "cold_warmup": {"wall_sec": round(cold_wall, 3),
+                        "compiled": cold["compiled"],
+                        "cached": cold["cached"]},
+        "warm_warmup": {"wall_sec": round(warm_wall, 3),
+                        "compiled": warm["compiled"],
+                        "cached": warm["cached"]},
+        "predict_p50_device_ms": {b: v["p50_ms"]
+                                  for b, v in per_bucket.items()},
+        "per_bucket_ms": per_bucket,
+        "serving_path_compiles": serving_compiles,
+    }))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--queries", type=int, default=2000)
@@ -327,6 +398,16 @@ def main() -> None:
                     help="tracing-overhead A/B mode: measure the same "
                          "HTTP load untraced, then with tracing off "
                          "(noise floor) / 1%% sampled / fully exported")
+    ap.add_argument("--aot", action="store_true",
+                    help="AOT bucket-ladder mode: cold vs warm ladder "
+                         "compile wall time + per-bucket device p50, "
+                         "asserting zero serving-path compiles")
+    ap.add_argument("--aot-buckets", default="auto",
+                    help="ladder spec for --aot ('auto' or comma list)")
+    ap.add_argument("--aot-iters", type=int, default=50,
+                    help="measured dispatches per bucket in --aot mode")
+    ap.add_argument("--batch-max", type=int, default=64,
+                    help="top bucket for the 'auto' ladder in --aot mode")
     args = ap.parse_args()
 
     from profile_common import make_memory_storage, resolve_platform
@@ -344,6 +425,9 @@ def main() -> None:
         return
     if args.tracing:
         run_tracing_mode(args, st, factory)
+        return
+    if args.aot:
+        run_aot_mode(args, st, factory)
         return
     rng = np.random.default_rng(1)
     users = rng.integers(0, args.n_users, args.queries)

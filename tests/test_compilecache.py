@@ -54,3 +54,32 @@ def test_defaults_under_pio_home(tmp_path, monkeypatch):
     got = compilecache.enable()
     assert got == os.path.join(str(tmp_path / "home"), "xla_cache")
     assert os.path.isdir(got)
+
+
+def test_aot_warmup_smoke_with_persistent_cache(tmp_path, monkeypatch):
+    """CPU AOT-warmup smoke (tier-1): the deploy-time bucket warmup
+    (server/aot) runs with the persistent compile cache pointed at a
+    real directory — explicit lower().compile() must coexist with the
+    cache wiring — and a same-geometry re-warm is pure in-process
+    executable-cache hits (the compile-free /reload contract)."""
+    import numpy as np
+
+    from predictionio_tpu.models.als import ResidentScorer
+    from predictionio_tpu.server.aot import BucketLadder
+
+    monkeypatch.setenv("PIO_ALS_SERVE", "device")
+    compilecache.enable(str(tmp_path / "xla_cache"))
+
+    rng = np.random.default_rng(0)
+    U = rng.standard_normal((64, 8)).astype(np.float32)
+    V = rng.standard_normal((2100, 8)).astype(np.float32)
+    ladder = BucketLadder([1, 2])
+    first = ResidentScorer(U, V).warm_buckets(ladder, ks=(5,))
+    assert first["targets"] == 2
+    again = ResidentScorer(U, V).warm_buckets(ladder, ks=(5,))
+    assert again == {"targets": 2, "compiled": 0, "cached": 2}
+    # the warmed shape serves without error under the enabled cache
+    sc = ResidentScorer(U, V)
+    sc.warm_buckets(ladder, ks=(5,))
+    [(iv, vv)] = sc.recommend_batch(np.asarray([3], np.int32), 5)
+    assert iv.shape == (5,) and vv.shape == (5,)
