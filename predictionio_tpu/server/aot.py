@@ -286,6 +286,7 @@ class AOTWarmup:
         self.cached = 0
         self.total_targets = 0
         self.wall_sec = 0.0
+        self._started_at = 0.0
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._m_state = REGISTRY.gauge(
@@ -306,6 +307,8 @@ class AOTWarmup:
         from predictionio_tpu.utils import tracing
 
         t0 = time.perf_counter()
+        with self._lock:
+            self._started_at = t0
         compiled = cached = targets = 0
         with tracing.span("serving.aot_warmup",
                           buckets=len(self.ladder), ks=len(self.ks)):
@@ -373,6 +376,20 @@ class AOTWarmup:
     @property
     def ready(self) -> bool:
         return self.state == "ready"
+
+    def retry_after(self) -> float:
+        """Seconds a not-ready client should wait before re-probing:
+        the last pass's wall time minus what has already elapsed of the
+        current one (floored at 0.5 s so pollers don't spin), or the
+        full estimate when no pass is in flight. 0 once settled."""
+        with self._lock:
+            if self.state in ("ready", "failed"):
+                return 0.0
+            est = self.wall_sec if self.wall_sec > 0 else 5.0
+            if self.state == "warming" and self._started_at > 0:
+                elapsed = time.perf_counter() - self._started_at
+                return max(0.5, est - elapsed)
+            return est
 
     def progress(self) -> Dict[str, Any]:
         """The ``/health`` warmup block."""

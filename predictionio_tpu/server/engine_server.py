@@ -38,7 +38,9 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 import threading
+import time
 import uuid
 from typing import Any, Dict, List, Optional
 
@@ -122,6 +124,15 @@ class EngineServer:
                 raise
             self._load_error = f"{type(e).__name__}: {e}"
         self.start_time = utcnow()
+        #: replica identity, surfaced on /health: a router (or any
+        #: client) that sees the instance id change knows it is talking
+        #: to a RESTARTED process — not a flapping one — and resets the
+        #: replica's breaker/EWMA state instead of keeping it ejected
+        self.instance_uid = uuid.uuid4().hex[:12]
+        self.start_epoch = time.time()
+        #: EWMA of successful-query handler latency (loop-thread-only);
+        #: feeds the Retry-After hint on shed 503s
+        self._lat_ewma = 0.0
         self.query_count = 0
         self.query_timeout = max(0.0, query_timeout_ms) / 1e3
         self.max_inflight = max(0, max_inflight)
@@ -226,15 +237,36 @@ class EngineServer:
 
     # -- handlers --------------------------------------------------------------
 
+    def _retry_after_hint(self) -> float:
+        """Best real estimate of when a shed/not-ready 503 is worth
+        retrying, instead of a hardcoded constant: the AOT warmup's
+        remaining compile time when it is still warming, else the
+        longest open-breaker reset window, else a couple of in-flight
+        query durations (shedding clears one slot per completion)."""
+        if self._warmup is not None and self._warmup.state in (
+                "idle", "warming"):
+            eta = self._warmup.retry_after()
+            if eta > 0:
+                return eta
+        open_waits = [b.retry_after() for b in self._breakers.values()
+                      if b.state == OPEN]
+        if open_waits:
+            return max(open_waits)
+        if self._lat_ewma > 0:
+            return max(0.1, 2.0 * self._lat_ewma)
+        return 1.0
+
     @staticmethod
     def _unavailable(message: str, retry_after: float = 1.0) -> Response:
-        resp = Response.json({"message": message}, status=503)
-        resp.headers["Retry-After"] = str(max(1, round(retry_after)))
+        body = {"message": message,
+                "retryAfterSec": round(max(0.0, retry_after), 3)}
+        resp = Response.json(body, status=503)
+        # the header is integral seconds (RFC 9110 delta-seconds); ceil
+        # so the hint is never shorter than the real wait
+        resp.headers["Retry-After"] = str(max(1, math.ceil(retry_after)))
         return resp
 
     async def _queries(self, req: Request) -> Response:
-        import time
-
         t0 = time.perf_counter()
         # admission control BEFORE any await: shedding costs ~nothing,
         # which is the whole point — past the cap the server answers
@@ -243,12 +275,14 @@ class EngineServer:
             self._m_shed.inc()
             self._m_queries.inc(("503",))
             return self._unavailable(
-                f"server overloaded ({self._inflight} queries in flight)")
+                f"server overloaded ({self._inflight} queries in flight)",
+                retry_after=self._retry_after_hint())
         if self.deployed is None:
             self._m_queries.inc(("503",))
             return self._unavailable(
                 f"no engine loaded ({self._load_error}); "
-                "train and GET /reload")
+                "train and GET /reload",
+                retry_after=self._retry_after_hint())
         self._inflight += 1
         try:
             async with tracing.span(
@@ -263,10 +297,14 @@ class EngineServer:
         finally:
             self._inflight -= 1
         self._m_queries.inc((status,))
+        dt = time.perf_counter() - t0
+        if status == "200":
+            # loop-thread-only, like _inflight — no lock needed
+            self._lat_ewma = dt if self._lat_ewma == 0 else (
+                0.9 * self._lat_ewma + 0.1 * dt)
         # the latency histogram observes EVERY outcome — the 400/500
         # (and 504) tails are exactly the slow failures worth seeing
-        self._m_latency.observe(time.perf_counter() - t0, (status,),
-                                exemplar=tracing.exemplar())
+        self._m_latency.observe(dt, (status,), exemplar=tracing.exemplar())
         return resp
 
     async def _query_once(self, req: Request) -> "tuple[str, Response]":
@@ -277,13 +315,25 @@ class EngineServer:
                 {"message": f"invalid JSON: {e}"}, status=400)
         if query is None:
             return "400", Response.json({"message": "empty query"}, status=400)
+        # a routing hop can carry the client's REMAINING budget down in
+        # X-PIO-Deadline-Ms; the effective deadline is the tighter of
+        # that and the server's own --query-timeout-ms
+        timeout = self.query_timeout
+        hop = req.headers.get("x-pio-deadline-ms")
+        if hop:
+            try:
+                hop_sec = float(hop) / 1e3
+            except ValueError:
+                hop_sec = 0.0
+            if hop_sec > 0:
+                timeout = min(timeout, hop_sec) if timeout > 0 else hop_sec
         try:
             if self._batcher is not None:
                 work = self._batcher.submit(query)
             else:
                 work = asyncio.to_thread(self._query_worker, query)
-            if self.query_timeout > 0:
-                prediction = await asyncio.wait_for(work, self.query_timeout)
+            if timeout > 0:
+                prediction = await asyncio.wait_for(work, timeout)
             else:
                 prediction = await work
         except asyncio.TimeoutError:
@@ -292,7 +342,7 @@ class EngineServer:
             self._m_deadline.inc()
             return "504", Response.json(
                 {"message": "query deadline exceeded "
-                            f"({self.query_timeout * 1e3:.0f} ms)"},
+                            f"({timeout * 1e3:.0f} ms)"},
                 status=504)
         except (ValueError, KeyError, TypeError) as e:
             # malformed/invalid query (bad fields, unknown entity, wrong types)
@@ -439,19 +489,17 @@ class EngineServer:
             "breakers": {n: b.state for n, b in self._breakers.items()},
             "inflight": self._inflight,
             "reloadGeneration": self.reload_generation,
+            "instance": self.instance_uid,
+            "startedAt": round(self.start_epoch, 3),
         }
         if self._warmup is not None:
             body["warmup"] = self._warmup.progress()
         if self.deployed is None:
-            return Response.json(
-                {"status": "not-ready", "reason": self._load_error, **body},
-                status=503)
+            return self._not_ready(self._load_error or "no engine loaded",
+                                   body)
         if self._warmup is not None and self._warmup.state in (
                 "idle", "warming"):
-            return Response.json(
-                {"status": "not-ready",
-                 "reason": "aot warmup in progress", **body},
-                status=503)
+            return self._not_ready("aot warmup in progress", body)
         warmup_failed = (self._warmup is not None
                          and self._warmup.state == "failed")
         if open_breakers or at_capacity or warmup_failed:
@@ -462,6 +510,15 @@ class EngineServer:
             return Response.json(
                 {"status": "degraded", "reason": reason, **body})
         return Response.json({"status": "ok", **body})
+
+    def _not_ready(self, reason: str, body: Dict[str, Any]) -> Response:
+        hint = self._retry_after_hint()
+        resp = Response.json(
+            {"status": "not-ready", "reason": reason,
+             "retryAfterSec": round(hint, 3), **body},
+            status=503)
+        resp.headers["Retry-After"] = str(max(1, math.ceil(hint)))
+        return resp
 
     def _probe_worker(self, candidate: DeployedEngine, probe: Any) -> None:
         faults.inject("serving.reload")

@@ -39,7 +39,11 @@ from typing import Any, Dict, Optional
 
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.utils import faults, tracing
-from predictionio_tpu.utils.resilience import CircuitBreaker, retry_with_backoff
+from predictionio_tpu.utils.resilience import (
+    CircuitBreaker,
+    parse_retry_after,
+    retry_with_backoff,
+)
 
 
 class EventSink(ABC):
@@ -81,12 +85,21 @@ class HTTPEventSink(EventSink):
                 if resp.status not in (200, 201):
                     raise RuntimeError(f"event server returned {resp.status}")
         except urllib.error.HTTPError as e:
+            hint = parse_retry_after(e.headers.get("Retry-After"))
+            if e.code == 429:
+                # backpressure, not rejection: retryable, and the
+                # server's Retry-After hint overrides our backoff guess
+                err = RuntimeError("event server throttled feedback: 429")
+                err.retry_after = hint
+                raise err from e
             if e.code < 500:
                 # deterministic rejection (bad key, bad event): raise a
                 # type outside retry_on so it is NOT retried
                 raise ValueError(
                     f"event server rejected feedback: {e.code}") from e
-            raise RuntimeError(f"event server returned {e.code}") from e
+            err = RuntimeError(f"event server returned {e.code}")
+            err.retry_after = hint
+            raise err from e
 
     def send(self, event: Event) -> None:
         # retry transient delivery failures (short, jittered — feedback

@@ -1,0 +1,916 @@
+"""Fleet router: health-aware reverse proxy over N engine-server replicas.
+
+One engine server is one SIGKILL away from a dark app. This router puts
+a replica SET behind a single endpoint (ROADMAP item 4a): clients talk
+to the router; the router spreads queries over healthy replicas and
+absorbs single-replica failures, reloads, and latency outliers.
+
+Architecture (all asyncio, single loop, dependency-free — the HTTP
+client is built on ``asyncio.open_connection`` because the environment
+bakes no aiohttp):
+
+- **Replica state machine** — each replica is ``ok | degraded |
+  not-ready | down``, driven by two signals: ACTIVE ``/health`` polling
+  every ``health_interval`` (picks up PR 7's AOT-warmup not-ready, open
+  dependency breakers, and replica identity), and PASSIVE outlier
+  ejection through a per-replica :class:`CircuitBreaker` fed by live
+  request outcomes — a replica that fails requests stops receiving them
+  before the next poll notices.
+- **Replica identity** — ``/health`` carries ``instance`` (process
+  uid), ``startedAt``, and ``reloadGeneration``. An identity change
+  means a RESTARTED replica, not a flapping one: the router resets its
+  breaker and EWMA instead of keeping the fresh process ejected for the
+  old process's sins.
+- **Load balancing** — power-of-two-choices: sample two available
+  replicas, route to the lower ``(inflight + 1) x EWMA-latency`` score.
+  Near-optimal load spread at O(1) per request, no global sort.
+- **Deadline + trace propagation** — the client's remaining budget
+  travels down in ``X-PIO-Deadline-Ms`` and SHRINKS per hop; W3C
+  ``traceparent`` flows through (router span when tracing is on,
+  passthrough otherwise) so one trace id explains a request across the
+  fleet.
+- **Retry budget** — retries are token-bucket capped at
+  ``retry_budget_ratio`` of live traffic, so a brown-out cannot be
+  amplified into a retry storm. Non-idempotent POSTs (feedback,
+  events) are NEVER retried; ``/queries.json`` POSTs are read-only by
+  contract and are.
+- **Hedging** — a ``/queries.json`` attempt still running after the
+  rolling p95 of recent latencies gets a second attempt on a different
+  replica; first answer wins, the loser is cancelled. Hedges draw from
+  the same retry budget.
+- **Retry-After honoring** — a replica answering 429/503 with
+  ``Retry-After`` is backed off for exactly that window (PR 8 made the
+  hint real: breaker reset / AOT re-warm ETA).
+- **Rolling reload** — ``pio router reload --rolling`` (or ``POST
+  /router/reload?rolling=1``): one replica at a time is drained
+  (out of rotation, in-flight allowed to finish), told to ``/reload``
+  (probe-then-swap + AOT pre-warm happen replica-side), polled back to
+  ready, and re-admitted. A full-fleet model swap serves zero errors.
+
+Fault sites (``utils/faults.py``): ``router.replica.down`` and
+``router.replica.slow`` on the forward path, ``router.health.flap`` on
+the active probe.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import os
+import random
+import urllib.parse
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Set, Tuple
+
+from predictionio_tpu.server.http import (
+    HTTPServer,
+    Request,
+    Response,
+    Router,
+    traces_handler,
+)
+from predictionio_tpu.utils import tracing
+from predictionio_tpu.utils.faults import FAULTS
+from predictionio_tpu.utils.metrics import REGISTRY
+from predictionio_tpu.utils.resilience import CircuitBreaker, parse_retry_after
+
+# replica states (the router's view; /health's "ok"/"degraded"/
+# "not-ready" map onto the first three, "down" is the router's own
+# verdict after failed probes)
+OK, DEGRADED, NOT_READY, DOWN = "ok", "degraded", "not-ready", "down"
+
+#: pio_router_replica_state gauge encoding
+_STATE_CODE = {OK: 0, DEGRADED: 1, NOT_READY: 2, DOWN: 3}
+_DRAINING_CODE = 4
+
+#: POST paths that are safe to retry/hedge: /queries.json is read-only
+#: by contract (a prediction, not a write). Feedback/event POSTs are
+#: not — a retried POST /events.json is a duplicate event.
+_IDEMPOTENT_POSTS = frozenset({"/queries.json"})
+
+#: consecutive probe failures before a replica is marked down (one
+#: blip must not eject a replica the passive path still likes)
+_DOWN_AFTER = 2
+
+
+class ReplicaError(RuntimeError):
+    """Transport-level failure talking to a replica."""
+
+
+class Replica:
+    """One engine-server backend and everything the router knows
+    about it."""
+
+    @staticmethod
+    def parse_hostport(url: str) -> Tuple[str, int]:
+        u = url.strip()
+        if "//" not in u:
+            u = "http://" + u
+        parts = urllib.parse.urlsplit(u)
+        if not parts.hostname or not parts.port:
+            raise ValueError(f"replica url needs host:port, got {url!r}")
+        return parts.hostname, parts.port
+
+    def __init__(self, url: str, *,
+                 breaker_threshold: int = 3,
+                 breaker_reset: float = 5.0) -> None:
+        self.host, self.port = self.parse_hostport(url)
+        self.name = f"{self.host}:{self.port}"
+        self.state = NOT_READY  # unknown until the first probe
+        self.draining = False
+        self.inflight = 0
+        self.ewma_sec = 0.0
+        #: loop-time before which this replica takes no traffic
+        #: (replica-sent Retry-After on 429/503)
+        self.backoff_until = 0.0
+        self.health_failures = 0
+        #: identity from /health; a change == restarted process
+        self.instance: Optional[str] = None
+        self.started_at: Optional[float] = None
+        self.reload_generation: int = -1
+        self.last_health: Dict[str, Any] = {}
+        self.breaker = CircuitBreaker(
+            f"router_replica_{self.name}",
+            failure_threshold=breaker_threshold,
+            reset_timeout=breaker_reset)
+        #: pooled keep-alive connections (reader, writer)
+        self.pool: List[Tuple[asyncio.StreamReader, asyncio.StreamWriter]] = []
+
+    def available(self, now: float) -> bool:
+        """In rotation right now? Active state says serving, not
+        draining, not inside a Retry-After window, and the passive
+        breaker admits traffic (half-open probes flow — the breaker's
+        ``admit`` is non-reserving, the real gate is recorded
+        outcomes)."""
+        return (not self.draining
+                and self.state in (OK, DEGRADED)
+                and now >= self.backoff_until
+                and self.breaker.admit())
+
+    def score(self) -> float:
+        """P2C score: lower is better. In-flight count weighted by the
+        replica's EWMA latency, floored so a fresh replica (no samples)
+        still competes."""
+        return (self.inflight + 1) * max(self.ewma_sec, 1e-4)
+
+    def observe(self, dt: float) -> None:
+        self.ewma_sec = dt if self.ewma_sec == 0 else (
+            0.8 * self.ewma_sec + 0.2 * dt)
+
+    def reset_runtime(self) -> None:
+        """A restarted process inherits none of its predecessor's
+        penalties."""
+        self.breaker.reset()
+        self.ewma_sec = 0.0
+        self.backoff_until = 0.0
+        self.health_failures = 0
+
+    def close_pool(self) -> None:
+        for _, w in self.pool:
+            with contextlib.suppress(Exception):
+                w.close()
+        self.pool.clear()
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "url": f"http://{self.name}",
+            "state": self.state,
+            "draining": self.draining,
+            "inflight": self.inflight,
+            "ewmaMs": round(self.ewma_sec * 1e3, 3),
+            "breaker": self.breaker.state,
+            "instance": self.instance,
+            "startedAt": self.started_at,
+            "reloadGeneration": self.reload_generation,
+        }
+
+
+class _Attempt:
+    """Outcome of one proxied try against one replica. ``status == 0``
+    means the request never got an HTTP answer (transport error, fault,
+    down replica)."""
+
+    __slots__ = ("replica", "status", "headers", "body", "error")
+
+    def __init__(self, replica: Replica, status: int,
+                 headers: Dict[str, str], body: bytes,
+                 error: Optional[str] = None) -> None:
+        self.replica = replica
+        self.status = status
+        self.headers = headers
+        self.body = body
+        self.error = error
+
+    @property
+    def retryable(self) -> bool:
+        # 5xx and 429/503 are replica-local problems another replica
+        # may not have; 4xx (bar 429) is the CLIENT's problem and
+        # retrying it elsewhere just repeats the rejection
+        return self.status == 0 or self.status >= 500 or self.status == 429
+
+
+class FleetRouter:
+    """The reverse proxy. One instance == one listening endpoint over
+    one replica set."""
+
+    def __init__(
+        self,
+        replicas: Optional[List[str]] = None,
+        manifest: Optional[str] = None,
+        host: str = "0.0.0.0",
+        port: int = 8100,
+        *,
+        health_interval: float = 1.0,
+        retry_budget_ratio: float = 0.1,
+        retry_budget_burst: float = 10.0,
+        hedge: bool = True,
+        hedge_min_ms: float = 20.0,
+        default_deadline_ms: float = 10000.0,
+        per_try_timeout_ms: float = 0.0,
+        connect_timeout_ms: float = 1000.0,
+        drain_timeout: float = 30.0,
+        ready_timeout: float = 120.0,
+        breaker_threshold: int = 3,
+        breaker_reset: float = 5.0,
+        access_log: bool = False,
+    ) -> None:
+        if not replicas and not manifest:
+            raise ValueError("need a replica list or a manifest file")
+        self.manifest = manifest
+        self._manifest_mtime = 0.0
+        self._breaker_threshold = breaker_threshold
+        self._breaker_reset = breaker_reset
+        urls = list(replicas or [])
+        if manifest:
+            urls = self._read_manifest() or urls
+        self.replicas: List[Replica] = [self._make_replica(u) for u in urls]
+        self.health_interval = max(0.05, health_interval)
+        self.default_deadline = max(0.001, default_deadline_ms / 1e3)
+        self.per_try_timeout = max(0.0, per_try_timeout_ms / 1e3)
+        self.connect_timeout = max(0.05, connect_timeout_ms / 1e3)
+        self.drain_timeout = drain_timeout
+        self.ready_timeout = ready_timeout
+        self.hedge_enabled = hedge
+        self.hedge_min = max(0.001, hedge_min_ms / 1e3)
+        #: rolling window of successful /queries.json latencies; the
+        #: hedge fires at its p95 — hedging the median would double
+        #: traffic, hedging only the true tail costs ~5%
+        self._lat_window: Deque[float] = deque(maxlen=512)
+        self._hedge_delay_cached = self.hedge_min
+        self._lat_seen = 0
+        #: retry token bucket: each live request deposits
+        #: ``retry_budget_ratio`` tokens (capped at burst); a retry or
+        #: hedge withdraws 1.0. Loop-thread-only — no lock.
+        self.retry_budget_ratio = max(0.0, retry_budget_ratio)
+        self.retry_budget_burst = max(1.0, retry_budget_burst)
+        self._budget_tokens = self.retry_budget_burst
+        self._reload_lock: Optional[asyncio.Lock] = None
+        self._rng = random.Random(0x9107)
+
+        self._m_state = REGISTRY.gauge(
+            "pio_router_replica_state",
+            "Replica state (0 ok, 1 degraded, 2 not-ready, 3 down, "
+            "4 draining)", ("replica",))
+        self._m_requests = REGISTRY.counter(
+            "pio_router_requests_total", "Client requests answered",
+            ("status",))
+        self._m_attempts = REGISTRY.counter(
+            "pio_router_attempts_total", "Proxied attempts per replica",
+            ("replica", "outcome"))
+        self._m_retries = REGISTRY.counter(
+            "pio_router_retries_total", "Retried attempts", ("reason",))
+        self._m_retry_denied = REGISTRY.counter(
+            "pio_router_retry_denied_total",
+            "Retries NOT taken", ("reason",))
+        self._m_hedges = REGISTRY.counter(
+            "pio_router_hedges_total", "Hedged /queries.json attempts",
+            ("outcome",))
+        self._m_budget = REGISTRY.gauge(
+            "pio_router_retry_budget_remaining",
+            "Retry/hedge tokens currently in the bucket")
+        self._m_budget.set(self._budget_tokens)
+        self._m_replica_s = REGISTRY.histogram(
+            "pio_router_replica_seconds",
+            "Per-replica attempt latency (seconds)",
+            labelnames=("replica",))
+        self._m_rolling = REGISTRY.counter(
+            "pio_router_rolling_reloads_total",
+            "Rolling fleet reloads", ("result",))
+
+        router = Router()
+        router.route("GET", "/", self._root)
+        router.route("GET", "/health", self._own_health)
+        router.route("GET", "/metrics", self._metrics)
+        router.route("GET", "/traces", traces_handler)
+        router.route("GET", "/router/status", self._router_status)
+        router.route("POST", "/router/reload", self._router_reload)
+        router.route("GET", "/{path+}", self._proxy)
+        router.route("POST", "/{path+}", self._proxy)
+        self.http = HTTPServer(router, host, port, access_log=access_log,
+                               server_name="router")
+
+    # -- replica set -------------------------------------------------------
+
+    def _make_replica(self, url: str) -> Replica:
+        return Replica(url, breaker_threshold=self._breaker_threshold,
+                       breaker_reset=self._breaker_reset)
+
+    def _read_manifest(self) -> List[str]:
+        """One replica URL per line; blank lines and ``#`` comments
+        skipped. Returns [] when unreadable (keep the current set)."""
+        if not self.manifest:
+            return []
+        try:
+            self._manifest_mtime = os.stat(self.manifest).st_mtime
+            with open(self.manifest, "r", encoding="utf-8") as f:
+                return [ln.strip() for ln in f
+                        if ln.strip() and not ln.strip().startswith("#")]
+        except OSError:
+            return []
+
+    def _refresh_manifest(self) -> None:
+        if not self.manifest:
+            return
+        try:
+            mtime = os.stat(self.manifest).st_mtime
+        except OSError:
+            return
+        if mtime == self._manifest_mtime:
+            return
+        urls = self._read_manifest()
+        if not urls:
+            return
+        want = {"%s:%d" % Replica.parse_hostport(u): u for u in urls}
+        have = {r.name: r for r in self.replicas}
+        for name, url in want.items():
+            if name not in have:
+                self.replicas.append(self._make_replica(url))
+        for name, rep in list(have.items()):
+            if name not in want:
+                rep.close_pool()
+                self.replicas.remove(rep)
+                self._m_state.set(_STATE_CODE[DOWN], (name,))
+
+    # -- retry budget ------------------------------------------------------
+
+    def _budget_refill(self) -> None:
+        self._budget_tokens = min(
+            self.retry_budget_burst,
+            self._budget_tokens + self.retry_budget_ratio)
+        self._m_budget.set(self._budget_tokens)
+
+    def _budget_take(self) -> bool:
+        if self._budget_tokens < 1.0:
+            return False
+        self._budget_tokens -= 1.0
+        self._m_budget.set(self._budget_tokens)
+        return True
+
+    # -- hedge delay -------------------------------------------------------
+
+    def _note_query_latency(self, dt: float) -> None:
+        self._lat_window.append(dt)
+        self._lat_seen += 1
+        # recompute the cached p95 every 32 samples — sorting 512
+        # floats per request would be silly
+        if self._lat_seen % 32 == 0 and len(self._lat_window) >= 32:
+            ordered = sorted(self._lat_window)
+            p95 = ordered[max(0, int(len(ordered) * 0.95) - 1)]
+            self._hedge_delay_cached = max(self.hedge_min, p95)
+
+    def _hedge_delay(self) -> float:
+        if len(self._lat_window) < 32:
+            return self.hedge_min
+        return self._hedge_delay_cached
+
+    # -- picking -----------------------------------------------------------
+
+    def _pick(self, exclude: Set[str]) -> Optional[Replica]:
+        """Power-of-two-choices over available replicas not in
+        ``exclude``; falls back to the full available set when
+        exclusion empties it (retrying the same replica beats 502)."""
+        now = asyncio.get_running_loop().time()
+        avail = [r for r in self.replicas
+                 if r.available(now) and r.name not in exclude]
+        if not avail:
+            avail = [r for r in self.replicas if r.available(now)]
+        if not avail:
+            return None
+        if len(avail) == 1:
+            return avail[0]
+        a, b = self._rng.sample(avail, 2)
+        return a if a.score() <= b.score() else b
+
+    # -- the HTTP client ---------------------------------------------------
+
+    async def _connect(self, replica: Replica) -> Tuple[
+            asyncio.StreamReader, asyncio.StreamWriter]:
+        try:
+            return await asyncio.wait_for(
+                asyncio.open_connection(replica.host, replica.port),
+                self.connect_timeout)
+        except asyncio.TimeoutError:
+            raise ReplicaError(f"connect to {replica.name} timed out")
+        except OSError as e:
+            raise ReplicaError(f"connect to {replica.name} failed: {e}")
+
+    async def _roundtrip(self, replica: Replica,
+                         conn: Tuple[asyncio.StreamReader,
+                                     asyncio.StreamWriter],
+                         payload: bytes, timeout: float
+                         ) -> Tuple[int, Dict[str, str], bytes, bool]:
+        """Write one request, read one response. Returns (status,
+        headers, body, keep_alive)."""
+        reader, writer = conn
+
+        async def io() -> Tuple[int, Dict[str, str], bytes, bool]:
+            writer.write(payload)
+            await writer.drain()
+            head = await reader.readuntil(b"\r\n\r\n")
+            lines = head.decode("latin-1").split("\r\n")
+            try:
+                status = int(lines[0].split(" ", 2)[1])
+            except (IndexError, ValueError):
+                raise ReplicaError(
+                    f"bad status line from {replica.name}: {lines[0]!r}")
+            headers: Dict[str, str] = {}
+            for ln in lines[1:]:
+                if ":" in ln:
+                    k, v = ln.split(":", 1)
+                    headers[k.strip().lower()] = v.strip()
+            length = int(headers.get("content-length", "0") or "0")
+            body = await reader.readexactly(length) if length else b""
+            keep = headers.get("connection", "keep-alive").lower() != "close"
+            return status, headers, body, keep
+
+        try:
+            return await asyncio.wait_for(io(), timeout)
+        except asyncio.TimeoutError:
+            raise
+        except ReplicaError:
+            raise
+        except (OSError, asyncio.IncompleteReadError,
+                asyncio.LimitOverrunError) as e:
+            raise ReplicaError(
+                f"{replica.name}: {type(e).__name__}: {e}")
+
+    async def _fetch(self, replica: Replica, method: str, target: str,
+                     headers: Dict[str, str], body: bytes,
+                     timeout: float) -> Tuple[int, Dict[str, str], bytes]:
+        """One HTTP exchange with keep-alive pooling. A pooled
+        connection that fails before the deadline is retried ONCE on a
+        fresh one (the replica may have closed it between requests);
+        a timeout is never retried here — that would silently double
+        the per-try budget."""
+        head = [f"{method} {target} HTTP/1.1",
+                f"Host: {replica.name}",
+                f"Content-Length: {len(body)}"]
+        for k, v in headers.items():
+            head.append(f"{k}: {v}")
+        payload = ("\r\n".join(head) + "\r\n\r\n").encode("latin-1") + body
+
+        pooled = bool(replica.pool)
+        conn = replica.pool.pop() if pooled else await self._connect(replica)
+        try:
+            status, rhead, rbody, keep = await self._roundtrip(
+                replica, conn, payload, timeout)
+        except asyncio.TimeoutError:
+            self._close_conn(conn)
+            raise ReplicaError(f"{replica.name}: per-try timeout "
+                               f"({timeout * 1e3:.0f} ms)")
+        except ReplicaError:
+            self._close_conn(conn)
+            if not pooled:
+                raise
+            # stale pooled socket — one fresh retry
+            conn = await self._connect(replica)
+            try:
+                status, rhead, rbody, keep = await self._roundtrip(
+                    replica, conn, payload, timeout)
+            except (ReplicaError, asyncio.TimeoutError):
+                self._close_conn(conn)
+                raise
+        except asyncio.CancelledError:
+            self._close_conn(conn)
+            raise
+        if keep and len(replica.pool) < 8:
+            replica.pool.append(conn)
+        else:
+            self._close_conn(conn)
+        return status, rhead, rbody
+
+    @staticmethod
+    def _close_conn(conn: Tuple[asyncio.StreamReader,
+                                asyncio.StreamWriter]) -> None:
+        with contextlib.suppress(Exception):
+            conn[1].close()
+
+    # -- proxying ----------------------------------------------------------
+
+    def _forward_headers(self, req: Request, remaining: float
+                         ) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        ct = req.headers.get("content-type")
+        if ct:
+            out["Content-Type"] = ct
+        # the budget SHRINKS per hop: what we forward is what is left
+        out["X-PIO-Deadline-Ms"] = str(max(1, int(remaining * 1e3)))
+        if tracing.TRACER.enabled:
+            sp = tracing.current_span()
+            tp = sp.traceparent() if sp is not None else ""
+            if tp:
+                out["traceparent"] = tp
+        if "traceparent" not in out and "traceparent" in req.headers:
+            out["traceparent"] = req.headers["traceparent"]
+        if "x-pio-trace-id" in req.headers:
+            out["X-PIO-Trace-Id"] = req.headers["x-pio-trace-id"]
+        return out
+
+    async def _attempt(self, replica: Replica, req: Request, target: str,
+                       deadline: float) -> _Attempt:
+        """One try against one replica: fault sites, per-try timeout,
+        latency observation, breaker + Retry-After bookkeeping. A
+        cancelled attempt (lost hedge) records neither success nor
+        failure — it proves nothing about the replica."""
+        loop = asyncio.get_running_loop()
+        remaining = deadline - loop.time()
+        if remaining <= 0:
+            return _Attempt(replica, 0, {}, b"", error="deadline exhausted")
+        timeout = remaining
+        if self.per_try_timeout > 0:
+            timeout = min(timeout, self.per_try_timeout)
+        headers = self._forward_headers(req, remaining)
+        async def io() -> Tuple[int, Dict[str, str], bytes]:
+            await FAULTS.ahit("router.replica.slow")
+            await FAULTS.ahit("router.replica.down")
+            return await self._fetch(
+                replica, req.method, target, headers, req.body, timeout)
+
+        replica.inflight += 1
+        t0 = loop.time()
+        try:
+            # the outer wait_for also bounds injected fault latency —
+            # a router.replica.slow sleep cannot outlive the deadline
+            status, rhead, rbody = await asyncio.wait_for(io(), timeout)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # ReplicaError, FaultError
+            replica.breaker.record_failure()
+            self._m_attempts.inc((replica.name, "error"))
+            return _Attempt(replica, 0, {}, b"",
+                            error=f"{type(e).__name__}: {e}")
+        finally:
+            replica.inflight -= 1
+        dt = loop.time() - t0
+        replica.observe(dt)
+        self._m_replica_s.observe(dt, (replica.name,),
+                                  exemplar=tracing.exemplar())
+        if status >= 500 or status == 429:
+            replica.breaker.record_failure()
+            self._m_attempts.inc((replica.name, str(status)))
+            if status in (429, 503):
+                hint = parse_retry_after(rhead.get("retry-after"))
+                if hint is not None:
+                    replica.backoff_until = loop.time() + hint
+        else:
+            replica.breaker.record_success()
+            self._m_attempts.inc((replica.name, "ok"))
+            if status == 200 and req.path == "/queries.json":
+                self._note_query_latency(dt)
+        return _Attempt(replica, status, rhead, rbody)
+
+    async def _attempt_hedged(self, replica: Replica, req: Request,
+                              target: str, deadline: float) -> _Attempt:
+        """Primary attempt + (after the p95 delay) one hedge on a
+        different replica. First non-retryable answer wins; the other
+        task is cancelled. Falls back to plain behavior when no second
+        replica or no budget."""
+        primary = asyncio.create_task(
+            self._attempt(replica, req, target, deadline))
+        done, _ = await asyncio.wait({primary}, timeout=self._hedge_delay())
+        tasks: List[asyncio.Task] = [primary]
+        if not done:
+            second = self._pick({replica.name})
+            if second is not None and second is not replica \
+                    and self._budget_take():
+                self._m_hedges.inc(("launched",))
+                tasks.append(asyncio.create_task(
+                    self._attempt(second, req, target, deadline)))
+            elif second is not None and second is not replica:
+                self._m_hedges.inc(("denied",))
+        hedged = len(tasks) > 1
+        winner: Optional[_Attempt] = None
+        fallback: Optional[_Attempt] = None
+        pending = set(tasks)
+        while pending and winner is None:
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED)
+            for t in done:
+                try:
+                    att = t.result()
+                except asyncio.CancelledError:
+                    continue
+                if not att.retryable:
+                    winner = att
+                    if hedged:
+                        self._m_hedges.inc(
+                            ("won",) if t is not primary else ("lost",))
+                    break
+                fallback = fallback or att
+        for t in pending:
+            t.cancel()
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+        return winner or fallback or _Attempt(
+            replica, 0, {}, b"", error="all attempts failed")
+
+    def _is_idempotent(self, req: Request) -> bool:
+        return req.method == "GET" or req.path in _IDEMPOTENT_POSTS
+
+    async def _proxy(self, req: Request) -> Response:
+        self._budget_refill()
+        loop = asyncio.get_running_loop()
+        budget = self.default_deadline
+        hop = req.headers.get("x-pio-deadline-ms")
+        if hop:
+            try:
+                v = float(hop) / 1e3
+                if v > 0:
+                    budget = min(budget, v)
+            except ValueError:
+                pass
+        deadline = loop.time() + budget
+        target = req.path
+        if req.query:
+            target += "?" + urllib.parse.urlencode(req.query, doseq=True)
+        hedge = (self.hedge_enabled and req.method == "POST"
+                 and req.path == "/queries.json")
+        idempotent = self._is_idempotent(req)
+
+        tried: Set[str] = set()
+        att: Optional[_Attempt] = None
+        while True:
+            replica = self._pick(tried)
+            if replica is None:
+                break
+            tried.add(replica.name)
+            if hedge:
+                att = await self._attempt_hedged(replica, req, target,
+                                                 deadline)
+            else:
+                att = await self._attempt(replica, req, target, deadline)
+            if not att.retryable:
+                break
+            # retry gates, in order of what they protect: correctness
+            # (idempotency), the fleet (budget), the client (deadline)
+            if not idempotent:
+                self._m_retry_denied.inc(("non_idempotent",))
+                break
+            if not self._budget_take():
+                self._m_retry_denied.inc(("budget",))
+                break
+            if deadline - loop.time() <= 0:
+                self._m_retry_denied.inc(("deadline",))
+                break
+            self._m_retries.inc(
+                ("transport",) if att.status == 0 else (str(att.status),))
+
+        if att is None:
+            self._m_requests.inc(("503",))
+            resp = Response.json(
+                {"message": "no replica available"}, status=503)
+            resp.headers["Retry-After"] = str(
+                max(1, round(self.health_interval)))
+            return resp
+        if att.status == 0:
+            self._m_requests.inc(("502",))
+            return Response.json(
+                {"message": f"all replicas failed: {att.error}"},
+                status=502)
+        self._m_requests.inc((str(att.status),))
+        resp = Response(
+            status=att.status, body=att.body,
+            content_type=att.headers.get(
+                "content-type", "application/json; charset=utf-8"))
+        ra = att.headers.get("retry-after")
+        if ra:
+            resp.headers["Retry-After"] = ra
+        return resp
+
+    # -- health polling ----------------------------------------------------
+
+    async def _poll_replica(self, replica: Replica) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            await FAULTS.ahit("router.health.flap")
+            status, _, body = await self._fetch(
+                replica, "GET", "/health", {},
+                b"", max(0.5, self.health_interval * 2))
+        except Exception as e:  # noqa: BLE001 — any probe failure counts
+            replica.health_failures += 1
+            if replica.health_failures >= _DOWN_AFTER:
+                replica.state = DOWN
+            replica.last_health = {"error": str(e)}
+            return
+        replica.health_failures = 0
+        try:
+            doc = json.loads(body) if body else {}
+        except json.JSONDecodeError:
+            doc = {}
+        replica.last_health = doc
+        ident = doc.get("instance")
+        if ident and replica.instance and ident != replica.instance:
+            # restarted replica: forget the old process's record
+            replica.reset_runtime()
+        if ident:
+            replica.instance = ident
+        if doc.get("startedAt") is not None:
+            replica.started_at = doc.get("startedAt")
+        if doc.get("reloadGeneration") is not None:
+            replica.reload_generation = int(doc["reloadGeneration"])
+        state = doc.get("status")
+        if state in (OK, DEGRADED, NOT_READY):
+            replica.state = state
+        elif status == 200:
+            replica.state = OK
+        else:
+            replica.state = NOT_READY
+        if replica.state == NOT_READY and status == 503:
+            hint = parse_retry_after(doc.get("retryAfterSec"))
+            if hint is not None:
+                replica.backoff_until = loop.time() + hint
+
+    def _publish_states(self) -> None:
+        for r in self.replicas:
+            code = _DRAINING_CODE if r.draining else _STATE_CODE[r.state]
+            self._m_state.set(code, (r.name,))
+
+    async def _poll_all(self) -> None:
+        self._refresh_manifest()
+        if self.replicas:
+            await asyncio.gather(
+                *(self._poll_replica(r) for r in self.replicas))
+        self._publish_states()
+
+    async def _health_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.health_interval)
+            try:
+                await self._poll_all()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — the loop must survive
+                pass
+
+    # -- rolling reload ----------------------------------------------------
+
+    async def rolling_reload(self) -> Dict[str, Any]:
+        """Drain → /reload → wait-ready → re-admit, one replica at a
+        time. At most one replica is ever out of rotation, so fleet
+        capacity never drops below N-1 and a reload that wedges one
+        replica leaves the rest serving."""
+        if self._reload_lock is None:
+            self._reload_lock = asyncio.Lock()
+        async with self._reload_lock:
+            loop = asyncio.get_running_loop()
+            entries: List[Dict[str, Any]] = []
+            ok = True
+            for replica in list(self.replicas):
+                entry: Dict[str, Any] = {"replica": replica.name}
+                entries.append(entry)
+                replica.draining = True
+                self._publish_states()
+                try:
+                    t0 = loop.time()
+                    while (replica.inflight > 0
+                           and loop.time() - t0 < self.drain_timeout):
+                        await asyncio.sleep(0.01)
+                    entry["drainedMs"] = round(
+                        (loop.time() - t0) * 1e3, 1)
+                    try:
+                        status, _, body = await self._fetch(
+                            replica, "GET", "/reload", {}, b"",
+                            max(self.ready_timeout, 1.0))
+                    except (ReplicaError, asyncio.TimeoutError) as e:
+                        entry["result"] = f"reload failed: {e}"
+                        ok = False
+                        continue
+                    if status != 200:
+                        entry["result"] = f"reload answered {status}"
+                        ok = False
+                        continue
+                    with contextlib.suppress(Exception):
+                        entry["reloadGeneration"] = json.loads(
+                            body).get("reloadGeneration")
+                    # wait for readiness (AOT re-warm shows up here as
+                    # /health not-ready until the ladder is compiled)
+                    t0 = loop.time()
+                    ready = False
+                    while loop.time() - t0 < self.ready_timeout:
+                        await self._poll_replica(replica)
+                        if (replica.state in (OK, DEGRADED)
+                                and replica.health_failures == 0):
+                            ready = True
+                            break
+                        await asyncio.sleep(
+                            min(0.05, self.health_interval))
+                    if not ready:
+                        entry["result"] = "not ready after reload"
+                        ok = False
+                        continue
+                    entry["result"] = "ok"
+                finally:
+                    replica.draining = False
+                    self._publish_states()
+            ok = ok and all(e.get("result") == "ok" for e in entries)
+            self._m_rolling.inc(("ok",) if ok else ("failed",))
+            return {"rolling": True, "ok": ok, "replicas": entries}
+
+    async def reload_all(self) -> Dict[str, Any]:
+        """Non-rolling: fire /reload at every replica concurrently.
+        Fast, but the fleet may serve stale+fresh models side by side
+        and briefly lose capacity to simultaneous AOT re-warms."""
+        async def one(r: Replica) -> Dict[str, Any]:
+            try:
+                status, _, body = await self._fetch(
+                    r, "GET", "/reload", {}, b"",
+                    max(self.ready_timeout, 1.0))
+            except (ReplicaError, asyncio.TimeoutError) as e:
+                return {"replica": r.name, "result": f"reload failed: {e}"}
+            if status != 200:
+                return {"replica": r.name,
+                        "result": f"reload answered {status}"}
+            out = {"replica": r.name, "result": "ok"}
+            with contextlib.suppress(Exception):
+                out["reloadGeneration"] = json.loads(
+                    body).get("reloadGeneration")
+            return out
+
+        entries = await asyncio.gather(*(one(r) for r in self.replicas))
+        ok = all(e.get("result") == "ok" for e in entries)
+        return {"rolling": False, "ok": ok, "replicas": list(entries)}
+
+    # -- own endpoints -----------------------------------------------------
+
+    async def _root(self, req: Request) -> Response:
+        now = asyncio.get_running_loop().time()
+        return Response.json({
+            "status": "router",
+            "replicas": len(self.replicas),
+            "available": sum(1 for r in self.replicas if r.available(now)),
+        })
+
+    async def _own_health(self, req: Request) -> Response:
+        now = asyncio.get_running_loop().time()
+        avail = sum(1 for r in self.replicas if r.available(now))
+        body = {
+            "status": "ok" if avail else "not-ready",
+            "available": avail,
+            "replicas": {r.name: r.state for r in self.replicas},
+        }
+        if avail:
+            return Response.json(body)
+        resp = Response.json(body, status=503)
+        resp.headers["Retry-After"] = str(
+            max(1, round(self.health_interval)))
+        return resp
+
+    async def _router_status(self, req: Request) -> Response:
+        return Response.json({
+            "replicas": [r.snapshot() for r in self.replicas],
+            "retryBudgetTokens": round(self._budget_tokens, 3),
+            "hedgeDelayMs": round(self._hedge_delay() * 1e3, 3),
+            "hedging": self.hedge_enabled,
+            "manifest": self.manifest,
+        })
+
+    async def _router_reload(self, req: Request) -> Response:
+        rolling = (req.param("rolling") or "") in ("1", "true", "yes")
+        out = await (self.rolling_reload() if rolling
+                     else self.reload_all())
+        return Response.json(out, status=200 if out["ok"] else 500)
+
+    async def _metrics(self, req: Request) -> Response:
+        return Response.text(REGISTRY.render(),
+                             content_type="text/plain; version=0.0.4")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def serve_forever(self) -> None:
+        # probe the fleet once BEFORE accepting traffic, so the first
+        # client request has states to route on
+        await self._poll_all()
+        poller = asyncio.create_task(self._health_loop(),
+                                     name="pio-router-health")
+        try:
+            await self.http.serve_forever()
+        finally:
+            poller.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await poller
+            for r in self.replicas:
+                r.close_pool()
+
+    def run(self) -> None:
+        asyncio.run(self.serve_forever())

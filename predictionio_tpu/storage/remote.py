@@ -25,7 +25,11 @@ from typing import Any, Callable, List, Optional
 
 from predictionio_tpu.storage.models import ModelStore
 from predictionio_tpu.utils import faults, integrity, tracing
-from predictionio_tpu.utils.resilience import CircuitBreaker, retry_with_backoff
+from predictionio_tpu.utils.resilience import (
+    CircuitBreaker,
+    parse_retry_after,
+    retry_with_backoff,
+)
 
 
 class StorageClientError(RuntimeError):
@@ -62,6 +66,26 @@ class _ResilientCalls:
             _ResilientCalls._breakers[kind] = breaker
         self.breaker = breaker
 
+    @staticmethod
+    def _attach_retry_hint(e: BaseException) -> None:
+        """Copy a server-provided ``Retry-After`` (botocore throttling
+        responses carry one in the response metadata) onto the
+        exception, where ``retry_with_backoff`` reads it and waits the
+        server's window instead of its own exponential guess."""
+        if getattr(e, "retry_after", None) is not None:
+            return
+        meta = getattr(e, "response", None)
+        if not isinstance(meta, dict):
+            return
+        headers = (meta.get("ResponseMetadata") or {}).get(
+            "HTTPHeaders") or {}
+        hint = parse_retry_after(headers.get("retry-after"))
+        if hint is not None:
+            try:
+                e.retry_after = hint
+            except AttributeError:
+                pass
+
     def _call(self, fn: Callable[[], Any]) -> Any:
         site = self._fault_site
 
@@ -69,7 +93,11 @@ class _ResilientCalls:
             # the fault fires INSIDE the breaker so injected failures
             # trip it exactly like real ones
             faults.inject(site)
-            return fn()
+            try:
+                return fn()
+            except Exception as e:
+                self._attach_retry_hint(e)
+                raise
 
         def attempt() -> Any:
             return self.breaker.call(guarded)

@@ -211,6 +211,60 @@ def cmd_undeploy(args: argparse.Namespace) -> None:
         print(r.read().decode())
 
 
+def cmd_router(args: argparse.Namespace) -> None:
+    """Fleet router: one endpoint over N engine-server replicas —
+    health-aware P2C routing, retry budget, hedging, rolling reload
+    (docs/operations.md "Fleet deployment")."""
+    if args.router_cmd == "serve":
+        from predictionio_tpu.server.router import FleetRouter
+
+        _configure_tracing(args)
+        replicas = ([u for u in args.replicas.split(",") if u.strip()]
+                    if args.replicas else None)
+        router = FleetRouter(
+            replicas=replicas,
+            manifest=args.manifest,
+            host=args.ip, port=args.port,
+            health_interval=args.health_interval,
+            retry_budget_ratio=args.retry_budget,
+            hedge=not args.no_hedge,
+            hedge_min_ms=args.hedge_min_ms,
+            default_deadline_ms=args.deadline_ms,
+            per_try_timeout_ms=args.per_try_timeout_ms,
+            drain_timeout=args.drain_timeout,
+            ready_timeout=args.ready_timeout,
+            access_log=args.access_log,
+        )
+        print(f"[info] Fleet router on {args.ip}:{args.port} over "
+              f"{len(router.replicas)} replicas "
+              f"({', '.join(r.name for r in router.replicas)})")
+        router.run()
+        return
+
+    import urllib.error
+    import urllib.request
+
+    base = args.url.rstrip("/")
+    if args.router_cmd == "status":
+        with urllib.request.urlopen(f"{base}/router/status",
+                                    timeout=args.timeout) as r:
+            print(json.dumps(json.loads(r.read()), indent=2, sort_keys=True))
+        return
+    # reload: POST /router/reload[?rolling=1] — long timeout, a rolling
+    # pass drains + re-warms every replica sequentially
+    qs = "?rolling=1" if args.rolling else ""
+    req = urllib.request.Request(f"{base}/router/reload{qs}", data=b"",
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=args.timeout) as r:
+            out = json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        out = json.loads(e.read() or b"{}")
+    print(json.dumps(out, indent=2, sort_keys=True))
+    if not out.get("ok"):
+        _die("fleet reload failed")
+
+
 # -- train / eval / batchpredict ----------------------------------------------
 
 
@@ -771,6 +825,53 @@ def build_parser() -> argparse.ArgumentParser:
                          "(0 = unlimited)")
     _add_observability_flags(dp)
     dp.set_defaults(fn=cmd_deploy)
+
+    rt = sub.add_parser(
+        "router",
+        help="fleet router: one endpoint over N engine-server replicas")
+    rts = rt.add_subparsers(dest="router_cmd", required=True)
+    x = rts.add_parser("serve", help="start the router")
+    x.add_argument("--replicas",
+                   help="comma-separated replica URLs (host:port or "
+                        "http://host:port)")
+    x.add_argument("--manifest",
+                   help="file with one replica URL per line, re-read on "
+                        "mtime change (# comments ok)")
+    x.add_argument("--ip", default="0.0.0.0")
+    x.add_argument("--port", type=int, default=8100)
+    x.add_argument("--health-interval", type=float, default=1.0,
+                   help="seconds between active /health probe rounds")
+    x.add_argument("--retry-budget", type=float, default=0.1,
+                   help="retry/hedge tokens earned per live request; "
+                        "bounds retries to this fraction of traffic")
+    x.add_argument("--no-hedge", action="store_true",
+                   help="disable tail-latency hedging of /queries.json")
+    x.add_argument("--hedge-min-ms", type=float, default=20.0,
+                   help="hedge delay floor (used until enough latency "
+                        "samples exist for a p95)")
+    x.add_argument("--deadline-ms", type=float, default=10000.0,
+                   help="default end-to-end budget per client request "
+                        "(an inbound X-PIO-Deadline-Ms only tightens it)")
+    x.add_argument("--per-try-timeout-ms", type=float, default=0.0,
+                   help="cap any single replica attempt (0 = the "
+                        "remaining deadline)")
+    x.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="rolling reload: max seconds to wait for a "
+                        "replica's in-flight requests to finish")
+    x.add_argument("--ready-timeout", type=float, default=120.0,
+                   help="rolling reload: max seconds for /reload + "
+                        "AOT re-warm readiness per replica")
+    _add_observability_flags(x)
+    x = rts.add_parser("status", help="replica states from a running router")
+    x.add_argument("--url", default="http://localhost:8100")
+    x.add_argument("--timeout", type=float, default=10.0)
+    x = rts.add_parser("reload", help="reload the fleet through the router")
+    x.add_argument("--url", default="http://localhost:8100")
+    x.add_argument("--rolling", action="store_true",
+                   help="drain + reload + re-warm one replica at a time "
+                        "(zero-downtime); default reloads all at once")
+    x.add_argument("--timeout", type=float, default=600.0)
+    rt.set_defaults(fn=cmd_router)
 
     ud = sub.add_parser("undeploy", help="stop a running engine server")
     ud.add_argument("--ip", default="127.0.0.1")

@@ -46,9 +46,16 @@ Known sites (grep ``faults.inject`` for the authoritative list):
 ``models.hdfs``         HDFS model-store operations
 ``trace.export``        span export (ring + JSONL) — fail-open: an armed
                         error here must never fail the traced request
+``router.replica.down``  fleet-router forward path — replica refuses /
+                        drops the proxied request (down replica)
+``router.replica.slow``  fleet-router forward path — added latency on the
+                        proxied request (slow replica; drives hedging)
+``router.health.flap``  fleet-router active ``/health`` probe (flapping
+                        or partitioned replica)
 ``data.corrupt.eventlog``  byte-flip on ``pio fsck`` eventlog reads
 ``data.corrupt.snapshot``  byte-flip on snapshot npz load
 ``data.corrupt.model``     byte-flip on model-blob load/download
+``data.corrupt.segment``   byte-flip on cold-tier segment fetch
 ======================  ===================================================
 """
 

@@ -105,6 +105,33 @@ def backoff_delays(base: float, cap: float, *, jitter: str = "full",
             n += 1
 
 
+def parse_retry_after(value: Optional[str]) -> Optional[float]:
+    """Parse an HTTP ``Retry-After`` header value (delta-seconds form)
+    into a positive float, or None. The HTTP-date form is not parsed —
+    every server in this tree emits delta-seconds."""
+    if not value:
+        return None
+    try:
+        secs = float(str(value).strip())
+    except (TypeError, ValueError):
+        return None
+    return secs if secs > 0 else None
+
+
+def retry_after_hint(e: BaseException) -> Optional[float]:
+    """Server-provided backoff hint riding on an exception: HTTP layers
+    set a ``retry_after`` attribute (seconds) from a 429/503
+    ``Retry-After`` header before re-raising. Positive float or None."""
+    hint = getattr(e, "retry_after", None)
+    if hint is None:
+        return None
+    try:
+        hint = float(hint)
+    except (TypeError, ValueError):
+        return None
+    return hint if hint > 0 else None
+
+
 def retry_with_backoff(
     retries: int = 3,
     *,
@@ -125,6 +152,12 @@ def retry_with_backoff(
     retry run: once the budget is gone the last error is raised rather
     than starting another attempt or sleep.
 
+    A failure carrying a server-provided ``retry_after`` hint (see
+    :func:`retry_after_hint` — set from a 429/503 ``Retry-After``
+    header) overrides the jittered delay for that pause: the server
+    knows its own recovery window better than our exponential guess.
+    The hint is still bounded by ``deadline``.
+
     :class:`CircuitOpenError` is never retried, regardless of
     ``retry_on`` — an open breaker means the dependency is known-down
     and hammering it is exactly what the breaker exists to prevent.
@@ -132,6 +165,16 @@ def retry_with_backoff(
 
     def should_retry(e: BaseException) -> bool:
         return isinstance(e, retry_on) and not isinstance(e, CircuitOpenError)
+
+    def next_pause(delays: Iterator[float], e: BaseException,
+                   dl: Optional[Deadline]) -> float:
+        pause = next(delays)
+        hint = retry_after_hint(e)
+        if hint is not None:
+            pause = hint
+        if dl is not None:
+            pause = min(pause, dl.remaining())
+        return pause
 
     def deco(fn: Callable) -> Callable:
         if inspect.iscoroutinefunction(fn):
@@ -148,10 +191,7 @@ def retry_with_backoff(
                             raise
                         if on_retry is not None:
                             on_retry(attempt, e)
-                        pause = next(delays)
-                        if dl is not None:
-                            pause = min(pause, dl.remaining())
-                        await asyncio.sleep(pause)
+                        await asyncio.sleep(next_pause(delays, e, dl))
             return awrapper
 
         @functools.wraps(fn)
@@ -167,10 +207,7 @@ def retry_with_backoff(
                         raise
                     if on_retry is not None:
                         on_retry(attempt, e)
-                    pause = next(delays)
-                    if dl is not None:
-                        pause = min(pause, dl.remaining())
-                    time.sleep(pause)
+                    time.sleep(next_pause(delays, e, dl))
         return wrapper
 
     return deco

@@ -134,6 +134,259 @@ def _client_proc(port: int, n_users: int, n: int, seed: int, outq) -> None:
         outq.put(f"client {seed}: {type(e).__name__}: {e}")
 
 
+def _replica_main(args) -> None:
+    """Hidden subprocess entry (``--_replica-port``): one engine-server
+    replica with its own in-memory storage. ``fabricate_instance`` is
+    deterministic (seeded rng), so every replica serves the identical
+    model — the router A/B compares routing, not models."""
+    from profile_common import make_memory_storage, resolve_platform
+
+    resolve_platform(args.platform)
+    from predictionio_tpu.server.engine_server import EngineServer
+
+    st = make_memory_storage()
+    factory = fabricate_instance(st, args.n_users, args.n_items, args.rank)
+    st.meta.create_app("ProfileApp")
+    server = EngineServer(engine_factory=factory, storage=st,
+                          host="127.0.0.1", port=args.replica_port)
+    server.run()
+
+
+def _spawn_replicas(args, n: int):
+    """N replica subprocesses on free ports; blocks until every
+    ``/health`` answers 200."""
+    import socket
+    import subprocess
+    import sys
+
+    ports = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    procs = [subprocess.Popen(
+        [sys.executable, __file__, "--_replica-port", str(p),
+         "--platform", args.platform,
+         "--n-users", str(args.n_users), "--n-items", str(args.n_items),
+         "--rank", str(args.rank)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for p in ports]
+    deadline = time.time() + 180  # jax import + model fabrication
+    pending = set(ports)
+    while pending and time.time() < deadline:
+        for p in list(pending):
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", p, timeout=2)
+                conn.request("GET", "/health")
+                if conn.getresponse().status in (200, 503):
+                    conn.close()
+                    pending.discard(p)
+            except OSError:
+                pass
+        if pending:
+            time.sleep(0.3)
+    if pending:
+        for pr in procs:
+            pr.kill()
+        raise TimeoutError(f"replicas never came up on {sorted(pending)}")
+    return ports, procs
+
+
+def _router_load(port: int, n_users: int, n: int, threads: int = 3,
+                 stop_when=None):
+    """Closed-loop client threads against the router. Counts EVERY
+    outcome (status 0 = transport error) — the chaos checks hinge on
+    nothing hiding. With ``stop_when`` (a threading.Event), workers
+    keep going past ``n`` until it is set, so the load provably spans
+    the whole chaos window."""
+    import threading
+
+    lock = threading.Lock()
+    results = []
+    sent = [0]
+
+    def worker(seed: int, count: int):
+        import http.client as hc
+
+        rng = np.random.default_rng(seed)
+        conn = hc.HTTPConnection("127.0.0.1", port, timeout=30)
+        out = []
+        while True:
+            with lock:
+                if sent[0] >= count and (
+                        stop_when is None or stop_when.is_set()):
+                    break
+                sent[0] += 1
+            body = json.dumps(
+                {"user": str(int(rng.integers(0, n_users))), "num": 10})
+            t0 = time.perf_counter()
+            try:
+                conn.request("POST", "/queries.json", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                status = resp.status
+            except Exception:
+                conn.close()
+                conn = hc.HTTPConnection("127.0.0.1", port, timeout=30)
+                status = 0
+            out.append((status, time.perf_counter() - t0))
+        conn.close()
+        with lock:
+            results.extend(out)
+
+    ts = [threading.Thread(target=worker, args=(100 + i, n), daemon=True)
+          for i in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.perf_counter() - t0
+    statuses = {}
+    for s, _ in results:
+        statuses[str(s)] = statuses.get(str(s), 0) + 1
+    lats = np.asarray([l for _, l in results])
+    return statuses, lats, wall
+
+
+def run_router_mode(args, st, factory) -> None:
+    """Fleet-router chaos harness (ISSUE 8 acceptance): 3 replicas
+    behind a FleetRouter; (a) steady-state baseline, (b) a rolling
+    reload across the whole fleet under load, (c) kill -9 of one
+    replica mid-load. Both chaos passes must serve 0 non-200s with
+    p99 within 2x the steady-state baseline, and hedges must stay
+    inside the retry budget."""
+    import os
+    import signal
+    import socket
+    import threading
+
+    from predictionio_tpu.server.router import FleetRouter
+    from profile_common import server_thread
+
+    ports, procs = _spawn_replicas(args, 3)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    router_port = s.getsockname()[1]
+    s.close()
+    router = FleetRouter(
+        [f"127.0.0.1:{p}" for p in ports],
+        host="127.0.0.1", port=router_port,
+        health_interval=0.25,
+        retry_budget_ratio=0.2,
+        hedge=True, hedge_min_ms=25.0,
+        default_deadline_ms=15000.0,
+        drain_timeout=10.0, ready_timeout=60.0)
+
+    def counter(metric):
+        return {"/".join(k): int(v) for k, v in metric._values.items()}
+
+    try:
+        with server_thread(router, router_port):
+            # -- steady-state baseline --------------------------------
+            _router_load(router_port, args.n_users, 100)  # warm
+            base_status, base_lats, base_wall = _router_load(
+                router_port, args.n_users, args.queries)
+            base_p99 = float(np.percentile(base_lats, 99))
+
+            # -- (b) rolling reload under load ------------------------
+            reload_done = threading.Event()
+            reload_out = {}
+
+            def do_reload():
+                try:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", router_port, timeout=300)
+                    conn.request("POST", "/router/reload?rolling=1", b"")
+                    resp = conn.getresponse()
+                    reload_out.update(json.loads(resp.read()))
+                    reload_out["http_status"] = resp.status
+                    conn.close()
+                except Exception as e:  # noqa: BLE001 — recorded below
+                    reload_out["error"] = f"{type(e).__name__}: {e}"
+                finally:
+                    reload_done.set()
+
+            rt = threading.Thread(target=do_reload, daemon=True)
+            rt.start()
+            roll_status, roll_lats, _ = _router_load(
+                router_port, args.n_users, args.queries,
+                stop_when=reload_done)
+            rt.join(timeout=300)
+            roll_p99 = float(np.percentile(roll_lats, 99))
+
+            # -- (c) kill -9 one replica mid-load ---------------------
+            killer = threading.Timer(
+                max(0.05, base_wall / 3),
+                lambda: os.kill(procs[0].pid, signal.SIGKILL))
+            killer.start()
+            kill_status, kill_lats, _ = _router_load(
+                router_port, args.n_users, args.queries)
+            killer.cancel()
+            procs[0].wait(timeout=10)
+            kill_p99 = float(np.percentile(kill_lats, 99))
+
+            hedges = counter(router._m_hedges)
+            retries = counter(router._m_retries)
+            denied = counter(router._m_retry_denied)
+            budget_left = router._budget_tokens
+    finally:
+        for pr in procs:
+            if pr.poll() is None:
+                pr.terminate()
+        for pr in procs:
+            try:
+                pr.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pr.kill()
+
+    total_chaos = sum(roll_status.values()) + sum(kill_status.values())
+    hedges_launched = hedges.get("launched", 0)
+    retries_taken = sum(retries.values())
+    # the budget admits ratio x traffic plus the initial burst
+    budget_cap = router.retry_budget_ratio * (
+        total_chaos + sum(base_status.values()) + 100) \
+        + router.retry_budget_burst
+    p99_bound = max(2 * base_p99, base_p99 + 0.05)
+    checks = {
+        "rolling_all_200": set(roll_status) == {"200"},
+        "kill_all_200": set(kill_status) == {"200"},
+        "rolling_reload_ok": bool(reload_out.get("ok")),
+        "every_replica_reloaded": all(
+            e.get("result") == "ok" and e.get("reloadGeneration", 0) >= 1
+            for e in reload_out.get("replicas", [])) and len(
+                reload_out.get("replicas", [])) == 3,
+        "rolling_p99_bounded": roll_p99 <= p99_bound,
+        "kill_p99_bounded": kill_p99 <= p99_bound,
+        "hedges_within_budget":
+            hedges_launched + retries_taken <= budget_cap,
+    }
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "router_chaos",
+        "replicas": 3,
+        "queries_per_pass": args.queries,
+        "baseline": {"statuses": base_status,
+                     "p99_ms": round(base_p99 * 1e3, 3)},
+        "rolling_reload": {"statuses": roll_status,
+                           "p99_ms": round(roll_p99 * 1e3, 3),
+                           "detail": reload_out},
+        "kill_9": {"statuses": kill_status,
+                   "p99_ms": round(kill_p99 * 1e3, 3)},
+        "p99_bound_ms": round(p99_bound * 1e3, 3),
+        "hedges": hedges,
+        "retries": retries,
+        "retries_denied": denied,
+        "retry_budget_tokens_left": round(budget_left, 2),
+        "checks": checks,
+        "ok": ok,
+    }))
+    if not ok:
+        raise SystemExit(1)
+
+
 def run_fault_mode(args, st, factory) -> None:
     """Healthy baseline vs the same load under an armed fault spec."""
     from predictionio_tpu.server.engine_server import EngineServer
@@ -398,6 +651,13 @@ def main() -> None:
                     help="tracing-overhead A/B mode: measure the same "
                          "HTTP load untraced, then with tracing off "
                          "(noise floor) / 1%% sampled / fully exported")
+    ap.add_argument("--router", action="store_true",
+                    help="fleet-router chaos mode: 3 replica "
+                         "subprocesses behind a FleetRouter; rolling "
+                         "reload + kill -9 under load must serve 0 "
+                         "non-200s with bounded p99")
+    ap.add_argument("--_replica-port", dest="replica_port", type=int,
+                    default=0, help=argparse.SUPPRESS)
     ap.add_argument("--aot", action="store_true",
                     help="AOT bucket-ladder mode: cold vs warm ladder "
                          "compile wall time + per-bucket device p50, "
@@ -410,6 +670,10 @@ def main() -> None:
                     help="top bucket for the 'auto' ladder in --aot mode")
     args = ap.parse_args()
 
+    if args.replica_port:
+        _replica_main(args)
+        return
+
     from profile_common import make_memory_storage, resolve_platform
 
     jax = resolve_platform(args.platform)
@@ -420,6 +684,9 @@ def main() -> None:
     st = make_memory_storage()
 
     factory = fabricate_instance(st, args.n_users, args.n_items, args.rank)
+    if args.router:
+        run_router_mode(args, st, factory)
+        return
     if args.fault:
         run_fault_mode(args, st, factory)
         return

@@ -1,0 +1,102 @@
+"""Registry audit for the fault-injection surface (utils/faults.py).
+
+A fault site that exists in code but not in the docs is a chaos drill
+nobody knows to run; one that is documented but unexercised by any
+test is a robustness claim nobody has checked. This suite closes the
+loop mechanically: it enumerates every site reachable via ``PIO_FAULTS``
+straight from the source tree and fails if any is missing from the
+Known-sites table, from docs/operations.md, or from the test corpus —
+so ADDING a site without wiring it everywhere breaks the build, not
+the on-call.
+"""
+
+import re
+from pathlib import Path
+
+import predictionio_tpu.utils.faults as faults_mod
+from predictionio_tpu.data.segments import FAULT_SEGMENT
+from predictionio_tpu.utils.faults import FaultRegistry
+
+ROOT = Path(__file__).resolve().parents[1]
+PKG = ROOT / "predictionio_tpu"
+TESTS = ROOT / "tests"
+AUDIT_FILE = Path(__file__).name
+
+#: literal site strings at the three injection entry points
+_LITERAL = re.compile(
+    r"""(?:inject|ahit|corrupt_bytes)\(\s*["']([a-z0-9_.]+)["']""")
+
+
+def table_sites():
+    """Sites from the Known-sites table in the module docstring — the
+    documentation anchor the rest of the audit is checked against."""
+    # a site always has at least one dot; plan-key words (``rate`` …)
+    # that land at line starts when the docstring wraps do not
+    sites = set(re.findall(r"^``([a-z0-9_]+(?:\.[a-z0-9_]+)+)``",
+                           faults_mod.__doc__, re.MULTILINE))
+    assert sites, "Known-sites table missing from utils/faults.py"
+    return sites
+
+
+def source_sites():
+    """Every site wired into the package: literal call sites, plus the
+    two dynamic constructions (remote model stores build
+    ``models.{kind}``; the segment read path uses a constant)."""
+    found = {}
+
+    def note(site, where):
+        found.setdefault(site, set()).add(str(where))
+
+    for py in PKG.rglob("*.py"):
+        if py.name == "faults.py":  # defines the registry, no real sites
+            continue
+        for site in _LITERAL.findall(py.read_text(encoding="utf-8")):
+            note(site, py.relative_to(ROOT))
+    remote = (PKG / "storage" / "remote.py").read_text(encoding="utf-8")
+    assert 'f"models.{kind}"' in remote, \
+        "remote stores no longer build their fault site from the kind?"
+    for kind in re.findall(r"""_init_resilience\(\s*["']([a-z0-9]+)["']""",
+                           remote):
+        note(f"models.{kind}", "predictionio_tpu/storage/remote.py")
+    note(FAULT_SEGMENT, "predictionio_tpu/data/segments.py")
+    return found
+
+
+class TestFaultSiteAudit:
+    def test_every_wired_site_is_in_the_known_sites_table(self):
+        undocumented = {s: sorted(w) for s, w in source_sites().items()
+                        if s not in table_sites()}
+        assert not undocumented, (
+            "fault sites wired in code but missing from the "
+            f"utils/faults.py Known-sites table: {undocumented}")
+
+    def test_every_table_site_is_actually_wired(self):
+        stale = table_sites() - set(source_sites())
+        assert not stale, (
+            f"Known-sites table documents sites no code injects: "
+            f"{sorted(stale)}")
+
+    def test_every_site_is_documented_for_operators(self):
+        text = (ROOT / "docs" / "operations.md").read_text(
+            encoding="utf-8")
+        missing = [s for s in sorted(table_sites()) if s not in text]
+        assert not missing, (
+            f"fault sites missing from docs/operations.md: {missing}")
+
+    def test_every_site_is_exercised_by_a_test(self):
+        corpus = {p.name: p.read_text(encoding="utf-8")
+                  for p in TESTS.glob("test_*.py")
+                  if p.name != AUDIT_FILE}
+        missing = [s for s in sorted(table_sites())
+                   if not any(s in text for text in corpus.values())]
+        assert not missing, (
+            f"fault sites no test exercises (the robustness claim is "
+            f"unchecked): {missing}")
+
+    def test_every_site_is_armable_via_pio_faults_spec(self):
+        sites = table_sites()
+        spec = ";".join(f"{s}:error=drill" for s in sorted(sites))
+        r = FaultRegistry(env={"PIO_FAULTS": spec})
+        assert set(r.plans()) == sites
+        r.disarm()
+        assert not r.armed

@@ -3,10 +3,12 @@ the fault-injection registry (utils/faults.py) — the shared layer under
 the serving-path hardening (docs/operations.md "Failure modes")."""
 
 import asyncio
+import threading
 import time
 
 import pytest
 
+from predictionio_tpu.storage.remote import _ResilientCalls
 from predictionio_tpu.utils.faults import FAULTS, FaultError, FaultRegistry
 from predictionio_tpu.utils.resilience import (
     CLOSED,
@@ -17,6 +19,8 @@ from predictionio_tpu.utils.resilience import (
     Deadline,
     DeadlineExceeded,
     backoff_delays,
+    parse_retry_after,
+    retry_after_hint,
     retry_call,
     retry_with_backoff,
 )
@@ -376,3 +380,186 @@ class TestFaultRegistry:
         registry.hit("path.x")
         registry.hit("path.x")
         assert registry.hits("path.x") == 2
+
+
+class TestParseRetryAfter:
+    def test_delta_seconds_forms(self):
+        assert parse_retry_after("2.5") == 2.5
+        assert parse_retry_after(" 3 ") == 3.0
+        assert parse_retry_after(30) == 30.0  # non-str (JSON field)
+
+    def test_garbage_and_non_positive_are_none(self):
+        assert parse_retry_after(None) is None
+        assert parse_retry_after("") is None
+        assert parse_retry_after("soon") is None
+        assert parse_retry_after("0") is None
+        assert parse_retry_after("-5") is None
+        # HTTP-date form deliberately unsupported (nothing emits it here)
+        assert parse_retry_after("Wed, 21 Oct 2026 07:28:00 GMT") is None
+
+    def test_hint_reading_tolerates_junk_attributes(self):
+        e = RuntimeError("x")
+        assert retry_after_hint(e) is None
+        e.retry_after = "not-a-number"
+        assert retry_after_hint(e) is None
+        e.retry_after = -1.0
+        assert retry_after_hint(e) is None
+        e.retry_after = 0.25
+        assert retry_after_hint(e) == 0.25
+
+
+class TestRetryAfterHintHonored:
+    """Satellite: a 429/503 ``Retry-After`` riding on the exception
+    overrides the exponential guess for that pause."""
+
+    def _flaky(self, hint, fails=2):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) <= fails:
+                e = RuntimeError("throttled")
+                e.retry_after = hint
+                raise e
+            return "ok"
+
+        return fn, calls
+
+    def test_sync_hint_overrides_the_backoff_delay(self):
+        # without the hint: two 0.5s pauses; with it: two 0.01s pauses
+        fn, calls = self._flaky(0.01)
+        wrapped = retry_with_backoff(3, base=0.5, cap=0.5,
+                                     jitter="none")(fn)
+        t0 = time.perf_counter()
+        assert wrapped() == "ok"
+        assert time.perf_counter() - t0 < 0.3
+        assert len(calls) == 3
+
+    def test_async_hint_overrides_the_backoff_delay(self):
+        fn, calls = self._flaky(0.01)
+
+        @retry_with_backoff(3, base=0.5, cap=0.5, jitter="none")
+        async def afn():
+            return fn()
+
+        t0 = time.perf_counter()
+        assert asyncio.run(afn()) == "ok"
+        assert time.perf_counter() - t0 < 0.3
+        assert len(calls) == 3
+
+    def test_hint_is_still_bounded_by_the_deadline(self):
+        # a 5s server hint must not make the retry run blow a 0.15s
+        # deadline: the pause is clamped to what is left
+        fn, calls = self._flaky(5.0, fails=10)
+        wrapped = retry_with_backoff(10, base=0.01, cap=0.01,
+                                     jitter="none", deadline=0.15)(fn)
+        t0 = time.perf_counter()
+        with pytest.raises(RuntimeError, match="throttled"):
+            wrapped()
+        assert time.perf_counter() - t0 < 1.0
+
+
+class TestHalfOpenProbeRace:
+    """Satellite: N threads racing into a half-open breaker must admit
+    exactly one reserving probe (``allow``), while the non-reserving
+    ``admit`` lets them all pass — that split is the ingest coalescer's
+    decoupled contract."""
+
+    def make_half_open(self, n_ok=16):
+        clock = FakeClock()
+        b = CircuitBreaker(f"race_{id(clock)}", failure_threshold=1,
+                           reset_timeout=10.0, half_open_max=1,
+                           clock=clock)
+        b.record_failure()
+        assert b.state == OPEN
+        clock.t += 10.0
+        return b
+
+    def _race(self, fn, n=16):
+        barrier = threading.Barrier(n)
+        results = [None] * n
+
+        def worker(i):
+            barrier.wait()
+            results[i] = fn()
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert all(not t.is_alive() for t in threads)
+        return results
+
+    def test_concurrent_allow_admits_exactly_one_probe(self):
+        b = self.make_half_open()
+        results = self._race(b.allow)
+        assert sum(results) == 1
+        b.record_success()
+        assert b.state == CLOSED
+
+    def test_concurrent_admit_is_non_reserving_by_design(self):
+        b = self.make_half_open()
+        assert all(self._race(b.admit))  # nobody consumed a slot
+        assert b.allow()                 # the reserving slot is intact
+        assert not b.allow()
+        b.record_failure()
+        assert b.state == OPEN           # one failed probe re-opens
+
+    def test_probe_failure_then_race_sees_open(self):
+        b = self.make_half_open()
+        assert b.allow()
+        b.record_failure()
+        assert not any(self._race(b.allow))
+        assert not any(self._race(b.admit))
+
+
+class _FakeRemoteStore(_ResilientCalls):
+    """The retry/breaker/fault plumbing of S3ModelStore/HDFSModelStore
+    without boto3/HDFS: exercises the real ``models.s3``/``models.hdfs``
+    injection sites."""
+
+    def __init__(self, kind):
+        self._init_resilience(kind, retries=2)
+
+
+class TestRemoteStoreResilience:
+    @pytest.mark.parametrize("kind,site", [("s3", "models.s3"),
+                                           ("hdfs", "models.hdfs")])
+    def test_injected_outage_is_retried_through_the_breaker(
+            self, kind, site):
+        store = _FakeRemoteStore(kind)
+        store.breaker.reset()  # breakers are shared per backend kind
+        try:
+            FAULTS.arm(site, error=f"{kind} down", count=1)
+            # one injected failure, then the retry lands
+            assert store._call(lambda: "blob") == "blob"
+            assert store.breaker.state == CLOSED
+            # a persistent outage exhausts retries and surfaces
+            FAULTS.arm(site, error=f"{kind} down")
+            with pytest.raises(FaultError):
+                store._call(lambda: "blob")
+        finally:
+            FAULTS.disarm()
+            store.breaker.reset()
+
+    def test_botocore_shaped_retry_after_is_attached(self):
+        e = RuntimeError("throttled")
+        e.response = {"ResponseMetadata":
+                      {"HTTPHeaders": {"retry-after": "0.2"}}}
+        _ResilientCalls._attach_retry_hint(e)
+        assert e.retry_after == 0.2
+        # an existing hint is never clobbered
+        e.response["ResponseMetadata"]["HTTPHeaders"]["retry-after"] = "9"
+        _ResilientCalls._attach_retry_hint(e)
+        assert e.retry_after == 0.2
+
+    def test_hintless_errors_are_left_alone(self):
+        for e in (RuntimeError("plain"),):
+            _ResilientCalls._attach_retry_hint(e)
+            assert getattr(e, "retry_after", None) is None
+        e = RuntimeError("weird meta")
+        e.response = {"ResponseMetadata": {}}
+        _ResilientCalls._attach_retry_hint(e)
+        assert getattr(e, "retry_after", None) is None

@@ -17,7 +17,9 @@ from predictionio_tpu.core.workflow import run_train
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.server.engine_server import EngineServer
 from predictionio_tpu.server.event_server import EventServer
-from predictionio_tpu.utils.faults import FAULTS
+from predictionio_tpu.server.eventsink import DirectEventSink, HTTPEventSink
+from predictionio_tpu.server.http import HTTPServer, Response, Router
+from predictionio_tpu.utils.faults import FAULTS, FaultError
 from tests.test_servers import ServerThread, free_port, http
 
 FACTORY = "predictionio_tpu.templates.recommendation.engine:engine_factory"
@@ -387,3 +389,180 @@ class TestSupervisorBackoff:
         # stopped in ~one 0.2s slice, not the full 2.5-5s backoff
         assert time.perf_counter() - t0 < 2.0
         assert out["code"] == 0
+
+
+class TestReplicaIdentity:
+    """Satellite: /health carries a process identity (instance uid,
+    start time, reload generation) so a fleet router can tell a
+    RESTARTED replica from a flapping one."""
+
+    def test_health_carries_stable_process_identity(self, storage):
+        seed_and_train(storage)
+        port = free_port()
+        server = EngineServer(engine_factory=FACTORY, storage=storage,
+                              host="127.0.0.1", port=port)
+        with ServerThread(server):
+            base = f"http://127.0.0.1:{port}"
+            code, body = http("GET", f"{base}/health")
+            assert code == 200
+            assert body["instance"] == server.instance_uid
+            assert len(body["instance"]) == 12
+            assert body["startedAt"] == round(server.start_epoch, 3)
+            assert body["reloadGeneration"] == 0
+            # identity is per-process, not per-request
+            assert http("GET", f"{base}/health")[1]["instance"] \
+                == body["instance"]
+
+    def test_not_ready_surfaces_identity_and_a_real_retry_hint(
+            self, storage):
+        port = free_port()
+        server = EngineServer(engine_factory=FACTORY, storage=storage,
+                              host="127.0.0.1", port=port,
+                              require_engine=False)
+        with ServerThread(server):
+            code, body, headers = http_full(
+                "GET", f"http://127.0.0.1:{port}/health")
+            assert code == 503 and body["status"] == "not-ready"
+            assert body["instance"] == server.instance_uid
+            # the hint is a number the server computed, not a constant
+            # header bolted on at the end
+            assert body["retryAfterSec"] > 0
+            assert int(headers["Retry-After"]) >= 1
+
+    def test_shed_503_hint_tracks_observed_latency(self, storage):
+        seed_and_train(storage)
+        port = free_port()
+        server = EngineServer(engine_factory=FACTORY, storage=storage,
+                              host="127.0.0.1", port=port, max_inflight=1)
+        with ServerThread(server):
+            base = f"http://127.0.0.1:{port}"
+            assert http("POST", f"{base}/queries.json",
+                        {"user": "2", "num": 3})[0] == 200
+            assert server._lat_ewma > 0
+            ewma_at_shed = server._lat_ewma  # the slow query hasn't
+            # completed when the shed happens, so this is the EWMA the
+            # hint is computed from
+            FAULTS.arm("serving.query", latency=1.0)
+            done = {}
+
+            def slow():
+                done["r"] = http("POST", f"{base}/queries.json",
+                                 {"user": "2", "num": 3})
+
+            t = threading.Thread(target=slow)
+            t.start()
+            deadline = time.time() + 5
+            while server._inflight < 1 and time.time() < deadline:
+                time.sleep(0.01)
+            code, body, _ = http_full("POST", f"{base}/queries.json",
+                                      {"user": "3", "num": 3})
+            t.join(timeout=10)
+            assert code == 503
+            # shed hint = max(0.1, 2x the EWMA of served queries)
+            assert body["retryAfterSec"] == pytest.approx(
+                max(0.1, 2.0 * ewma_at_shed), rel=0.5)
+
+
+class TestHopDeadline:
+    def test_forwarded_deadline_tightens_the_query_timeout(self, storage):
+        # a router's X-PIO-Deadline-Ms must bound the query even when
+        # the server's own --query-timeout-ms is far looser
+        seed_and_train(storage)
+        port = free_port()
+        server = EngineServer(engine_factory=FACTORY, storage=storage,
+                              host="127.0.0.1", port=port,
+                              query_timeout_ms=30000)
+        with ServerThread(server):
+            base = f"http://127.0.0.1:{port}"
+            FAULTS.arm("serving.query", latency=3.0)
+            t0 = time.perf_counter()
+            code, body = http("POST", f"{base}/queries.json",
+                              {"user": "2", "num": 3},
+                              headers={"X-PIO-Deadline-Ms": "300"})
+            elapsed = time.perf_counter() - t0
+            assert code == 504
+            assert elapsed < 2.0  # the 300ms hop budget won, not 30s
+
+    def test_garbage_deadline_header_is_ignored(self, storage):
+        seed_and_train(storage)
+        port = free_port()
+        server = EngineServer(engine_factory=FACTORY, storage=storage,
+                              host="127.0.0.1", port=port)
+        with ServerThread(server):
+            code, _ = http("POST", f"http://127.0.0.1:{port}/queries.json",
+                           {"user": "2", "num": 3},
+                           headers={"X-PIO-Deadline-Ms": "bogus"})
+            assert code == 200
+
+
+class ThrottlingEventStub:
+    """A fake Event Server that throttles the first N posts with 429 +
+    Retry-After before accepting (or rejects outright)."""
+
+    def __init__(self, port, throttles=0, retry_after="0.3", reject=None):
+        self.port = port
+        self.posts = 0
+        self.throttles = throttles
+        self.retry_after = retry_after
+        self.reject = reject  # fixed 4xx status instead of accepting
+        router = Router()
+        router.route("POST", "/events.json", self._post)
+        self.http = HTTPServer(router, "127.0.0.1", port,
+                               access_log=False, server_name="stub-events")
+
+    async def serve_forever(self):
+        await self.http.serve_forever()
+
+    async def _post(self, req):
+        self.posts += 1
+        if self.reject is not None:
+            return Response.json({"message": "no"}, status=self.reject)
+        if self.posts <= self.throttles:
+            resp = Response.json({"message": "slow down"}, status=429)
+            resp.headers["Retry-After"] = self.retry_after
+            return resp
+        return Response.json({"eventId": "e1"}, status=201)
+
+
+def make_event():
+    return Event(event="rate", entity_type="user", entity_id="7",
+                 target_entity_type="item", target_entity_id="3",
+                 properties={"rating": 5.0})
+
+
+class TestEventSinkRetryAfter:
+    """Satellite: the HTTP sink honors the Event Server's Retry-After
+    on 429 instead of its own exponential guess."""
+
+    def test_429_is_retried_after_the_servers_hint(self):
+        stub = ThrottlingEventStub(free_port(), throttles=1)
+        with ServerThread(stub):
+            sink = HTTPEventSink(f"http://127.0.0.1:{stub.port}", "key",
+                                 retries=2)
+            t0 = time.perf_counter()
+            sink.send(make_event())  # must not raise
+            elapsed = time.perf_counter() - t0
+            assert stub.posts == 2
+            # the sink's own backoff pause would be <= 50ms (base 0.05,
+            # full jitter); waiting ~0.3s proves the header drove it
+            assert elapsed >= 0.28
+
+    def test_4xx_rejection_is_never_retried(self):
+        stub = ThrottlingEventStub(free_port(), reject=400)
+        with ServerThread(stub):
+            sink = HTTPEventSink(f"http://127.0.0.1:{stub.port}", "key",
+                                 retries=3)
+            with pytest.raises(ValueError, match="rejected"):
+                sink.send(make_event())
+            assert stub.posts == 1  # deterministic rejection: one shot
+
+    def test_fault_site_covers_the_direct_sink(self, storage):
+        a = storage.meta.create_app("SinkApp")
+        storage.events.init_channel(a.id)
+        sink = DirectEventSink(storage, "SinkApp")
+        FAULTS.arm("eventsink.send", error="sink down")
+        with pytest.raises(FaultError):
+            sink.send(make_event())
+        FAULTS.disarm()
+        sink.send(make_event())  # recovered: delivered for real
+        assert len(list(storage.events.find(a.id))) == 1
