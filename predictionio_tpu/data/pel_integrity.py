@@ -346,12 +346,123 @@ def check_model(blob_path: str) -> Dict[str, object]:
     return report
 
 
+def check_model_registry(root: str,
+                         repair: bool = False) -> List[Dict[str, object]]:
+    """Audit the generation-aware model registry (``model_registry/``).
+
+    Checks, per manifest generation: the blob dir + ``model.bin``
+    exist, the sha256 sidecar exists and agrees with the manifest, and
+    the blob content matches the recorded digest. Also surfaces
+    **orphaned** ``gen-*`` dirs — dirs with no manifest entry, the
+    signature of a trainer crash between blob write and manifest commit
+    (the write order is deliberate: an orphan is harmless; a manifest
+    entry pointing at nothing would not be).
+
+    Repair policy: orphaned dirs are deleted (the crashed cycle never
+    published, the next delta train re-registers); a missing or
+    mismatched *sidecar* over an intact blob is rewritten from the
+    manifest digest (the manifest is authoritative); blob corruption is
+    report-only — like ``check_model``, a generation blob is not
+    rebuildable here.
+    """
+    import shutil as _shutil
+
+    from predictionio_tpu.storage.models import ModelRegistry
+
+    reports: List[Dict[str, object]] = []
+    man_path = os.path.join(root, ModelRegistry.MANIFEST)
+    try:
+        with open(man_path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return reports  # no registry at this home: nothing to audit
+    except (OSError, ValueError) as e:
+        return [{"path": man_path, "artifact": "model_registry",
+                 "status": "corrupt", "detail": f"unreadable manifest: {e}"}]
+    if doc.get("schema") != 1:
+        return [{"path": man_path, "artifact": "model_registry",
+                 "status": "corrupt",
+                 "detail": f"unknown manifest schema {doc.get('schema')!r}"}]
+    champ = doc.get("champion")
+    if champ is not None and not any(
+            e.get("gen") == champ for e in doc.get("generations", [])):
+        reports.append({
+            "path": man_path, "artifact": "model_registry",
+            "status": "corrupt",
+            "detail": f"champion generation {champ} has no manifest entry"})
+    known = set()
+    for entry in doc.get("generations", []):
+        gen = entry.get("gen")
+        known.add(gen)
+        d = os.path.join(root, f"gen-{int(gen):06d}")
+        blob_path = os.path.join(d, "model.bin")
+        r: Dict[str, object] = {
+            "path": blob_path, "artifact": "model_registry",
+            "generation": gen, "gen_status": entry.get("status"),
+            "status": "ok",
+        }
+        reports.append(r)
+        try:
+            with open(blob_path, "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            r["status"] = "corrupt"
+            r["detail"] = f"generation blob missing: {e}"
+            continue
+        blob = faults.corrupt_bytes("data.corrupt.model", blob)
+        expected = entry.get("sha256")
+        if not expected:
+            r["status"] = "unchecksummed"
+            continue
+        if hashlib.sha256(blob).hexdigest() != expected:
+            r["status"] = "corrupt"
+            r["detail"] = "blob digest mismatch vs manifest"
+            continue
+        side = blob_path + DIGEST_SUFFIX
+        side_ok = False
+        try:
+            with open(side, "r", encoding="ascii") as f:
+                side_ok = f.read().strip() == expected
+        except OSError:
+            pass
+        if not side_ok:
+            if repair:
+                with open(side, "w", encoding="ascii") as f:
+                    f.write(expected)
+                    f.flush()
+                    os.fsync(f.fileno())
+                fsync_dir(d)
+                r["status"] = "repaired"
+                r["detail"] = "sidecar rewritten from manifest digest"
+            else:
+                r["status"] = "corrupt"
+                r["detail"] = "sha256 sidecar missing or mismatched"
+    gen_dir_re = ModelRegistry._GEN_DIR
+    for name in sorted(os.listdir(root)):
+        m = gen_dir_re.match(name)
+        if not m or int(m.group(1)) in known:
+            continue
+        p = os.path.join(root, name)
+        r = {"path": p, "artifact": "model_registry",
+             "status": "corrupt", "detail": "orphaned generation dir "
+             "(no manifest entry; crash between blob write and commit)"}
+        if repair:
+            _shutil.rmtree(p, ignore_errors=True)
+            fsync_dir(root)
+            r["status"] = "repaired"
+            r["detail"] = "orphaned generation dir deleted"
+        reports.append(r)
+    return reports
+
+
 def fsck_home(home: str, repair: bool = False) -> Dict[str, object]:
     """Scan every persisted artifact under one storage home.
 
     Covers ``<home>/eventlog/*.pel`` (record walk), the snapshot cache
-    (``PIO_SCAN_CACHE_DIR`` or ``<home>/scan_cache``), and
-    ``<home>/models/*/model.bin``. Also lists quarantine sidecars left
+    (``PIO_SCAN_CACHE_DIR`` or ``<home>/scan_cache``),
+    ``<home>/models/*/model.bin``, and the continuous-training model
+    registry (``<home>/model_registry``: manifest ↔ dirs ↔ sidecars,
+    orphaned candidate dirs). Also lists quarantine sidecars left
     by previous recoveries so the runbook's "inspect, then delete"
     step has an inventory to work from.
     """
@@ -392,6 +503,10 @@ def fsck_home(home: str, repair: bool = False) -> Dict[str, object]:
                 r["artifact"] = "model"
                 r["instance"] = inst
                 artifacts.append(r)
+
+    reg_dir = os.path.join(home, "model_registry")
+    if os.path.isdir(reg_dir):
+        artifacts.extend(check_model_registry(reg_dir, repair=repair))
 
     statuses = [a["status"] for a in artifacts]
     report = {

@@ -148,6 +148,12 @@ class EngineServer:
         self._last_good_query: Optional[Any] = None
         self._reload_lock: Optional[asyncio.Lock] = None
         self.reload_generation = 0
+        #: outcome of the most recent /reload swap attempt
+        #: ({"outcome": "promoted"|"rolled_back"|"refused", ...}), so the
+        #: continuous trainer and the router can verify a promotion
+        #: landed without scraping metrics
+        self.last_swap: Optional[Dict[str, Any]] = None
+        self._model_registry: Optional[Any] = None
         from predictionio_tpu.utils.metrics import REGISTRY
 
         self._m_queries = REGISTRY.counter(
@@ -489,6 +495,8 @@ class EngineServer:
             "breakers": {n: b.state for n, b in self._breakers.items()},
             "inflight": self._inflight,
             "reloadGeneration": self.reload_generation,
+            "modelGeneration": self._model_generation(),
+            "lastSwap": self.last_swap,
             "instance": self.instance_uid,
             "startedAt": round(self.start_epoch, 3),
         }
@@ -510,6 +518,30 @@ class EngineServer:
             return Response.json(
                 {"status": "degraded", "reason": reason, **body})
         return Response.json({"status": "ok", **body})
+
+    def _model_generation(self) -> Optional[int]:
+        """Registry generation of the SERVING instance, or None when no
+        engine is loaded / the instance predates the registry / there is
+        no registry at this storage home (batch-only deployments)."""
+        if self.deployed is None:
+            return None
+        try:
+            if self._model_registry is None:
+                from predictionio_tpu.storage.models import model_registry
+
+                self._model_registry = model_registry(self.storage)
+            return self._model_registry.find_gen(self.deployed.instance.id)
+        except Exception:
+            return None
+
+    def _record_swap(self, outcome: str, **extra: Any) -> Dict[str, Any]:
+        """Remember a /reload outcome for /health's ``lastSwap``:
+        ``promoted`` (swap landed), ``rolled_back`` (candidate failed
+        warmup/probe, old engine kept), ``refused`` (candidate never
+        loaded — prepare_deploy failed)."""
+        self.last_swap = {"outcome": outcome,
+                          "at": round(time.time(), 3), **extra}
+        return self.last_swap
 
     def _not_ready(self, reason: str, body: Dict[str, Any]) -> Response:
         hint = self._retry_after_hint()
@@ -555,8 +587,10 @@ class EngineServer:
             except Exception as e:
                 self._m_reloads.inc(("failed",))
                 sp.set_error(f"reload failed: {e}")
+                self._record_swap("refused", reason=f"{type(e).__name__}: {e}")
                 return Response.json(
-                    {"message": f"reload failed: {e}"}, status=500)
+                    {"message": f"reload failed: {e}", "swap": "refused"},
+                    status=500)
             if self._warmup is not None:
                 # warm the CANDIDATE's bucket ladder BEFORE the probe
                 # and swap: a same-geometry candidate is pure
@@ -572,10 +606,13 @@ class EngineServer:
                     self._m_reloads.inc(("rolled_back",))
                     sp.set_error("aot warmup failed; rolled back")
                     kept = (old.instance.id if old is not None else None)
+                    self._record_swap(
+                        "rolled_back", reason="aot warmup failed",
+                        engineInstanceId=kept)
                     return Response.json(
                         {"message": "reload rolled back: aot warmup failed: "
                                     f"{type(e).__name__}: {e}",
-                         "engineInstanceId": kept},
+                         "engineInstanceId": kept, "swap": "rolled_back"},
                         status=500)
             probe = self._last_good_query
             if self.reload_probe and probe is not None:
@@ -590,10 +627,13 @@ class EngineServer:
                     self._m_reloads.inc(("rolled_back",))
                     sp.set_error("probe query failed; rolled back")
                     kept = (old.instance.id if old is not None else None)
+                    self._record_swap(
+                        "rolled_back", reason="probe query failed",
+                        engineInstanceId=kept)
                     return Response.json(
                         {"message": "reload rolled back: probe query failed: "
                                     f"{type(e).__name__}: {e}",
-                         "engineInstanceId": kept},
+                         "engineInstanceId": kept, "swap": "rolled_back"},
                         status=500)
             self.deployed = new
             self.reload_generation += 1
@@ -601,9 +641,13 @@ class EngineServer:
             self._m_reloads.inc(("ok",))
             sp.set_attr("result", "ok")
             self._load_error = None
+            self._record_swap("promoted", engineInstanceId=new.instance.id,
+                              modelGeneration=self._model_generation())
             return Response.json({"message": "Reloaded",
                                   "engineInstanceId": new.instance.id,
-                                  "reloadGeneration": self.reload_generation})
+                                  "reloadGeneration": self.reload_generation,
+                                  "modelGeneration": self._model_generation(),
+                                  "swap": "promoted"})
 
     async def _stop(self, req: Request) -> Response:
         asyncio.get_running_loop().call_later(0.05, self.http.request_shutdown)

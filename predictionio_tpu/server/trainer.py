@@ -1,0 +1,767 @@
+"""Continuous-training loop: crash-safe delta trainer with lease
+fencing, guardrail-gated promotion, and automatic rollback.
+
+Every piece of the online-learning loop already exists elsewhere in
+this tree — feedback events flow to the Event Server, the snapshot
+cache exposes a creation-time watermark, ``/reload`` does
+probe-then-swap — but nothing connects them. This module is the
+connection, built robustness-first because an unsupervised loop is how
+a production recommender ships a bad model to every user at 3am:
+
+1. **Single-writer lease with fencing** (:class:`TrainerLease`): a
+   file-backed lease under the storage home, renewed by heartbeat.
+   Every acquisition bumps a monotonically increasing fencing token;
+   the model registry remembers the highest token it has seen and
+   refuses writes carrying an older one — so a wedged trainer that
+   loses its lease mid-train can never publish a late blob, even if it
+   wakes up after a successor was elected.
+2. **Watermark wake**: the trainer polls
+   ``events.creation_stats`` and only trains when ≥
+   ``min_delta_events`` new events arrived since the last completed
+   cycle (state in ``trainer.state.json``).
+3. **Crash-safe delta train**: training goes through
+   ``run_train(resume=True)``, so a ``kill -9`` mid-train leaves the
+   per-(factory, variant) checkpoint directory in place and the
+   restarted trainer resumes from the latest checkpoint instead of
+   restarting from scratch.
+4. **Generation registry**: the candidate lands in
+   :class:`~predictionio_tpu.storage.models.ModelRegistry` as a new
+   generation (sha256 sidecar, fence-checked) and its meta status is
+   SHELVED until judged — a concurrent ``/reload`` stays on the
+   champion.
+5. **Offline guardrail**: champion vs candidate RMSE on the newest
+   held-out feedback events. A candidate more than
+   ``guardrail_max_regress`` worse than the champion is REFUSED.
+6. **Probe-then-swap push**: survivors are promoted (champion pointer +
+   meta sync) and every replica — or the fleet router, rolling — gets a
+   plain ``/reload``, which resolves to the new champion.
+7. **Bake window with automatic rollback**: for ``bake_seconds`` the
+   trainer scrapes live serving metrics (error rate from
+   ``pio_engine_queries_total``, p95 from the query-latency histogram);
+   a regression rolls the champion pointer back and pushes ``/reload``
+   again — the fleet is back on the old generation with zero operator
+   involvement.
+
+Fault sites (see ``utils/faults.py``): ``train.crash`` (process dies
+mid-delta-train; the supervisor restarts it and resume picks up the
+checkpoint), ``train.lease.lost`` (heartbeat discovers the lease was
+stolen; the cycle is abandoned before any registry write), and
+``promote.regression`` (forces the candidate to score as regressed so
+the guardrail/bake path must refuse or roll back).
+
+Run it supervised::
+
+    pio daemon -- pio train --continuous --engine-factory ... --app myapp
+
+On SIGTERM the trainer releases the lease (expiry zeroed, token kept)
+before exiting 0, so a graceful restart re-acquires instantly — no
+lease-TTL dead window — and the supervisor treats the clean exit as a
+finished job, not a crash.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import math
+import os
+import re
+import signal
+import time
+import urllib.request
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from predictionio_tpu.storage.models import (
+    FencedWriteError,
+    ModelRegistry,
+    model_registry,
+)
+from predictionio_tpu.storage.registry import Storage, get_storage
+from predictionio_tpu.utils import faults
+from predictionio_tpu.utils.atomic_write import atomic_write_bytes
+
+
+class LeaseLost(RuntimeError):
+    """The trainer's single-writer lease was stolen (or vanished): the
+    current cycle must be abandoned without publishing anything."""
+
+
+# -- the single-writer lease ---------------------------------------------------
+
+
+class TrainerLease:
+    """File-backed single-writer lease with fencing tokens.
+
+    The lease file (``<home>/trainer.lease``) holds one JSON document::
+
+        {"owner": "host:pid", "token": 7, "expires": 1722870000.0}
+
+    Mutations are serialized by a sibling ``.lock`` file created with
+    ``O_CREAT|O_EXCL`` (the portable atomic primitive on a local or NFS
+    filesystem); a lock older than a few seconds is presumed orphaned by
+    a dead process and broken. The lease itself expires by wall clock:
+    a holder that stops heartbeating is supersedable after ``ttl``.
+
+    **Fencing**: every successful :meth:`acquire` bumps ``token`` past
+    the previous holder's, whether or not that holder is alive. The
+    token rides along on every registry write, and the registry refuses
+    tokens older than the highest it has seen — so even a holder that
+    is superseded *mid-write* cannot land a late blob. :meth:`release`
+    zeroes ``expires`` but **keeps the token**, so a graceful handoff
+    still forces the next holder onto a fresh token.
+    """
+
+    def __init__(self, path: str, owner: str, ttl: float = 30.0,
+                 clock: Callable[[], float] = time.time,
+                 sleep: Callable[[float], None] = time.sleep) -> None:
+        self.path = path
+        self.owner = owner
+        self.ttl = float(ttl)
+        self.token: Optional[int] = None
+        self._clock = clock
+        self._sleep = sleep
+
+    # -- the .lock mutex -------------------------------------------------------
+
+    def _lock_path(self) -> str:
+        return self.path + ".lock"
+
+    def _locked(self):
+        lease = self
+
+        class _Ctx:
+            def __enter__(self):
+                deadline = lease._clock() + 0.5
+                lp = lease._lock_path()
+                while True:
+                    try:
+                        fd = os.open(lp, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                        os.close(fd)
+                        return self
+                    except OSError as e:
+                        if e.errno != errno.EEXIST:
+                            raise
+                        # break a lock left by a process that died between
+                        # creating it and removing it
+                        try:
+                            if lease._clock() - os.path.getmtime(lp) > 5.0:
+                                os.unlink(lp)
+                                continue
+                        except OSError:
+                            continue
+                        if lease._clock() >= deadline:
+                            raise TimeoutError(
+                                f"could not take {lp} within 0.5s")
+                        lease._sleep(0.02)
+
+            def __exit__(self, *exc):
+                try:
+                    os.unlink(lease._lock_path())
+                except OSError:
+                    pass
+                return False
+
+        return _Ctx()
+
+    # -- lease document --------------------------------------------------------
+
+    def _read(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                return json.load(f)
+        except (FileNotFoundError, ValueError):
+            return None
+
+    def _write(self, doc: Dict[str, Any]) -> None:
+        atomic_write_bytes(
+            self.path, json.dumps(doc, sort_keys=True).encode("utf-8"))
+
+    # -- protocol --------------------------------------------------------------
+
+    def acquire(self) -> bool:
+        """Try to take the lease. True on success (``self.token`` is the
+        new fencing token); False when another live holder has it."""
+        with self._locked():
+            doc = self._read()
+            now = self._clock()
+            if (doc is not None and doc.get("owner") != self.owner
+                    and float(doc.get("expires", 0)) > now):
+                return False
+            prev = int(doc.get("token", 0)) if doc else 0
+            self.token = prev + 1
+            self._write({"owner": self.owner, "token": self.token,
+                         "expires": now + self.ttl})
+            return True
+
+    def renew(self) -> None:
+        """Heartbeat: extend the expiry — but first verify we still hold
+        the lease. Raises :class:`LeaseLost` when the file shows another
+        owner or a different token (we were superseded while wedged)."""
+        try:
+            faults.inject("train.lease.lost")
+        except faults.FaultError as e:
+            raise LeaseLost(str(e)) from e
+        if self.token is None:
+            raise LeaseLost("renew() before acquire()")
+        with self._locked():
+            doc = self._read()
+            if (doc is None or doc.get("owner") != self.owner
+                    or int(doc.get("token", -1)) != self.token):
+                raise LeaseLost(
+                    f"lease superseded (file shows "
+                    f"{doc.get('owner') if doc else None!r} "
+                    f"token {doc.get('token') if doc else None})")
+            doc["expires"] = self._clock() + self.ttl
+            self._write(doc)
+
+    def release(self) -> None:
+        """Graceful handoff: zero the expiry so a successor acquires
+        instantly, but KEEP the token so the successor still fences us
+        out. A no-op if we no longer hold the lease."""
+        if self.token is None:
+            return
+        try:
+            with self._locked():
+                doc = self._read()
+                if (doc is not None and doc.get("owner") == self.owner
+                        and int(doc.get("token", -1)) == self.token):
+                    doc["expires"] = 0
+                    self._write(doc)
+        finally:
+            self.token = None
+
+
+# -- serving-metrics parsing (bake window) -------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+([0-9.eE+-]+|NaN|[+-]?Inf)\s*$")
+
+
+def _parse_prom(text: str) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]:
+    """Prometheus text format → {(name, sorted label tuple): value}."""
+    out: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line)
+        if not m:
+            continue
+        name, labels_raw, value = m.group(1), m.group(2), m.group(3)
+        labels: List[Tuple[str, str]] = []
+        if labels_raw:
+            for part in re.findall(r'(\w+)="((?:[^"\\]|\\.)*)"', labels_raw):
+                labels.append((part[0], part[1]))
+        try:
+            out[(name, tuple(sorted(labels)))] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def _query_stats(snap: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float],
+                 ) -> Tuple[float, float, Dict[float, float]]:
+    """(total queries, 5xx queries, {le: cumulative bucket count}) from
+    one scrape of an engine server's ``/metrics``."""
+    total = err = 0.0
+    buckets: Dict[float, float] = {}
+    for (name, labels), value in snap.items():
+        ld = dict(labels)
+        if name == "pio_engine_queries_total":
+            total += value
+            if ld.get("status", "").startswith("5"):
+                err += value
+        elif name == "pio_engine_query_seconds_bucket":
+            le = ld.get("le", "")
+            bound = math.inf if le in ("+Inf", "Inf") else float(le)
+            buckets[bound] = buckets.get(bound, 0.0) + value
+    return total, err, buckets
+
+
+def _p95_from_delta(before: Dict[float, float],
+                    after: Dict[float, float]) -> Optional[float]:
+    """p95 latency over the window, from cumulative-histogram deltas."""
+    deltas = sorted((le, max(0.0, after.get(le, 0.0) - before.get(le, 0.0)))
+                    for le in after)
+    if not deltas:
+        return None
+    total = deltas[-1][1]  # +Inf bucket is cumulative over all
+    if total <= 0:
+        return None
+    want = 0.95 * total
+    for le, cum in deltas:
+        if cum >= want:
+            return le if le != math.inf else deltas[-2][0] if len(deltas) > 1 else None
+    return None
+
+
+# -- trainer configuration -----------------------------------------------------
+
+
+@dataclass
+class TrainerConfig:
+    """Everything the continuous trainer needs to run one loop."""
+
+    engine_factory: str
+    app_name: str
+    variant: Dict[str, Any] = field(default_factory=dict)
+    variant_id: str = ""
+    channel: Optional[str] = None
+    #: wake threshold: train only when this many new events arrived
+    min_delta_events: int = 1
+    #: seconds between watermark polls when idle
+    poll_interval: float = 5.0
+    #: lease TTL; heartbeats renew at ttl/3
+    lease_ttl: float = 30.0
+    lease_path: Optional[str] = None
+    #: generations kept by the registry beyond the champion
+    retain: int = 5
+    #: guardrail: newest-N held-out feedback events to score against
+    guardrail_holdout: int = 200
+    #: guardrail: refuse candidates whose RMSE is worse than champion
+    #: by more than this fraction
+    guardrail_max_regress: float = 0.10
+    #: guardrail: below this many scoreable pairs, pass trivially
+    guardrail_min_events: int = 10
+    #: bake window length; 0 disables live-metrics bake
+    bake_seconds: float = 0.0
+    #: bake: roll back when the 5xx fraction over the window exceeds this
+    bake_error_rate: float = 0.01
+    #: bake: roll back when window p95 exceeds baseline p95 by this factor
+    bake_p95_ratio: float = 2.0
+    #: engine-server base URLs to /reload and scrape (direct mode)
+    reload_urls: List[str] = field(default_factory=list)
+    #: fleet-router base URL: reload goes through POST /router/reload?rolling=1
+    router_url: Optional[str] = None
+    #: fleet manifest path: replica URLs parsed for reload + bake scraping
+    fleet_manifest: Optional[str] = None
+    use_mesh: bool = False
+    http_timeout: float = 10.0
+
+
+# -- the trainer ---------------------------------------------------------------
+
+
+class ContinuousTrainer:
+    """The supervised delta-train → gate → promote → bake loop.
+
+    All effectful dependencies are injectable (``clock``, ``sleep``,
+    ``train_fn``, ``http`` fetcher) so the tier-1 smoke can drive one
+    full wake cycle with a fake clock, a stub trainer, and no sockets.
+    """
+
+    def __init__(self, cfg: TrainerConfig,
+                 storage: Optional[Storage] = None,
+                 clock: Callable[[], float] = time.time,
+                 sleep: Callable[[float], None] = time.sleep,
+                 train_fn: Optional[Callable[..., str]] = None,
+                 http: Optional[Callable[[str, str], str]] = None) -> None:
+        self.cfg = cfg
+        self.storage = storage or get_storage()
+        self.clock = clock
+        self.sleep = sleep
+        self._train_fn = train_fn
+        self._http = http or self._urllib_http
+        self._stopping = False
+        home = self.storage.config.home
+        self.registry: ModelRegistry = model_registry(
+            self.storage, retain=cfg.retain)
+        # the uuid suffix makes the owner unique per trainer OBJECT, not
+        # just per process: a successor on the same host/pid (or a second
+        # trainer constructed in-process) must go through the normal
+        # expiry + fencing path, never silently reclaim
+        owner = f"{os.uname().nodename}:{os.getpid()}:{uuid.uuid4().hex[:8]}"
+        self.lease = TrainerLease(
+            cfg.lease_path or os.path.join(home, "trainer.lease"),
+            owner=owner, ttl=cfg.lease_ttl, clock=clock, sleep=sleep)
+        self.state_path = os.path.join(home, "trainer.state.json")
+        self._app_id: Optional[int] = None
+        self._channel_id: Optional[int] = None
+
+    # -- plumbing --------------------------------------------------------------
+
+    def _urllib_http(self, method: str, url: str) -> str:
+        req = urllib.request.Request(url, method=method)
+        with urllib.request.urlopen(req, timeout=self.cfg.http_timeout) as r:
+            return r.read().decode("utf-8", "replace")
+
+    def _resolve_app(self) -> Tuple[int, Optional[int]]:
+        if self._app_id is None:
+            app = self.storage.meta.get_app_by_name(self.cfg.app_name)
+            if app is None:
+                raise ValueError(f"no app named {self.cfg.app_name!r}")
+            self._app_id = app.id
+            if self.cfg.channel:
+                ch = self.storage.meta.get_channel_by_name(
+                    app.id, self.cfg.channel)
+                if ch is None:
+                    raise ValueError(
+                        f"no channel {self.cfg.channel!r} in app "
+                        f"{self.cfg.app_name!r}")
+                self._channel_id = ch.id
+        return self._app_id, self._channel_id
+
+    def _load_state(self) -> Dict[str, Any]:
+        try:
+            with open(self.state_path, "r", encoding="utf-8") as f:
+                return json.load(f)
+        except (FileNotFoundError, ValueError):
+            return {"watermark_us": None, "count": 0}
+
+    def _save_state(self, state: Dict[str, Any]) -> None:
+        atomic_write_bytes(
+            self.state_path,
+            json.dumps(state, sort_keys=True).encode("utf-8"))
+
+    def _delta(self) -> Tuple[int, Dict[str, Any]]:
+        """(new events since the last completed cycle, current stats)."""
+        app_id, channel_id = self._resolve_app()
+        state = self._load_state()
+        stats = self.storage.events.creation_stats(app_id, channel_id)
+        if stats is None:
+            # backend can't answer cheaply (memory store): count via find
+            count = sum(1 for _ in self.storage.events.find(app_id, channel_id))
+            cur = {"watermark_us": None, "count": count}
+            return max(0, count - int(state.get("count") or 0)), cur
+        count, max_us = stats
+        cur = {"watermark_us": max_us, "count": count}
+        return max(0, count - int(state.get("count") or 0)), cur
+
+    # -- training --------------------------------------------------------------
+
+    def _train(self) -> str:
+        """One delta train through the resumable checkpoint machinery."""
+        if self._train_fn is not None:
+            return self._train_fn(storage=self.storage)
+        from predictionio_tpu.core.workflow import run_train
+
+        return run_train(
+            self.cfg.engine_factory,
+            variant=self.cfg.variant,
+            storage=self.storage,
+            use_mesh=self.cfg.use_mesh,
+            resume=True,
+            batch="continuous",
+        )
+
+    # -- guardrail -------------------------------------------------------------
+
+    def _holdout(self) -> List[Tuple[str, str, float]]:
+        """Newest held-out feedback as (user, item, rating) triplets."""
+        app_id, channel_id = self._resolve_app()
+        names = (self.cfg.variant.get("datasource", {})
+                 .get("params", {}).get("event_names")) or ["rate", "buy"]
+        buy_rating = float(self.cfg.variant.get("datasource", {})
+                           .get("params", {}).get("buy_rating", 4.0))
+        out: List[Tuple[str, str, float]] = []
+        for ev in self.storage.events.find(
+                app_id, channel_id, event_names=list(names),
+                limit=self.cfg.guardrail_holdout, reversed=True):
+            if ev.target_entity_id is None:
+                continue
+            if ev.event == "buy":
+                rating = buy_rating
+            else:
+                try:
+                    rating = float(ev.properties.get("rating", math.nan))
+                except (TypeError, ValueError):
+                    continue
+            if math.isnan(rating):
+                continue
+            out.append((ev.entity_id, ev.target_entity_id, rating))
+        return out
+
+    def _rmse(self, instance_id: str,
+              pairs: List[Tuple[str, str, float]]) -> Optional[float]:
+        """Rating-prediction RMSE of one instance on the holdout, via the
+        same query path serving uses (None = nothing scoreable)."""
+        from predictionio_tpu.core.workflow import prepare_deploy
+
+        try:
+            deployed = prepare_deploy(instance_id=instance_id,
+                                      storage=self.storage)
+        except Exception:
+            # an instance this process cannot materialize (unresolvable
+            # factory, missing blob) is unscoreable, not a hard error —
+            # the guardrail treats None as "pass" and the bake window
+            # remains the online line of defense
+            return None
+        se = n = 0
+        for user, item, rating in pairs:
+            try:
+                res = deployed.query({"user": user, "item": item})
+                scores = res.get("itemScores") or []
+                if not scores:
+                    continue
+                se += (float(scores[0]["score"]) - rating) ** 2
+                n += 1
+            except Exception:
+                continue
+        return math.sqrt(se / n) if n else None
+
+    def _guardrail(self, candidate_id: str) -> Tuple[bool, Dict[str, Any]]:
+        """Champion-vs-candidate offline gate. True = promote."""
+        detail: Dict[str, Any] = {"champion_rmse": None,
+                                  "candidate_rmse": None, "pairs": 0}
+        regressed = False
+        try:
+            faults.inject("promote.regression")
+        except faults.FaultError:
+            regressed = True
+        champ = self.registry.champion()
+        pairs = self._holdout()
+        detail["pairs"] = len(pairs)
+        if regressed:
+            detail["candidate_rmse"] = math.inf
+            detail["reason"] = "injected regression"
+            # an injected regression must be caught even on the first
+            # generation / an empty holdout
+            return False, detail
+        if champ is None:
+            detail["reason"] = "no champion: first generation promotes"
+            return True, detail
+        if len(pairs) < self.cfg.guardrail_min_events:
+            detail["reason"] = (f"only {len(pairs)} holdout pairs "
+                                f"(< {self.cfg.guardrail_min_events}): pass")
+            return True, detail
+        champ_rmse = self._rmse(champ["instance_id"], pairs)
+        cand_rmse = self._rmse(candidate_id, pairs)
+        detail["champion_rmse"] = champ_rmse
+        detail["candidate_rmse"] = cand_rmse
+        if champ_rmse is None or cand_rmse is None:
+            detail["reason"] = "unscoreable: pass"
+            return True, detail
+        limit = champ_rmse * (1.0 + self.cfg.guardrail_max_regress) + 1e-9
+        if cand_rmse <= limit:
+            detail["reason"] = f"rmse {cand_rmse:.4f} <= limit {limit:.4f}"
+            return True, detail
+        detail["reason"] = f"rmse {cand_rmse:.4f} > limit {limit:.4f}"
+        return False, detail
+
+    # -- reload push + bake ----------------------------------------------------
+
+    def _replica_urls(self) -> List[str]:
+        urls = list(self.cfg.reload_urls)
+        if self.cfg.fleet_manifest:
+            try:
+                with open(self.cfg.fleet_manifest, "r", encoding="utf-8") as f:
+                    doc = json.load(f)
+                for rep in doc.get("replicas", []):
+                    u = rep.get("url") if isinstance(rep, dict) else rep
+                    if u:
+                        urls.append(str(u).rstrip("/"))
+            except (OSError, ValueError):
+                pass
+        return list(dict.fromkeys(u.rstrip("/") for u in urls))
+
+    def _push_reload(self) -> bool:
+        """Tell the fleet to swap onto the current champion. Rolling via
+        the router when configured; direct ``/reload`` otherwise. True
+        when every push succeeded."""
+        ok = True
+        if self.cfg.router_url:
+            try:
+                self._http("POST", self.cfg.router_url.rstrip("/")
+                           + "/router/reload?rolling=1")
+            except Exception:
+                ok = False
+        else:
+            for u in self._replica_urls():
+                try:
+                    self._http("GET", u + "/reload")
+                except Exception:
+                    ok = False
+        return ok
+
+    def _scrape(self) -> Tuple[float, float, Dict[float, float]]:
+        """Aggregate (queries, 5xx, latency buckets) across the fleet."""
+        total = err = 0.0
+        buckets: Dict[float, float] = {}
+        for u in self._replica_urls():
+            try:
+                t, e, b = _query_stats(_parse_prom(
+                    self._http("GET", u + "/metrics")))
+            except Exception:
+                continue
+            total += t
+            err += e
+            for le, c in b.items():
+                buckets[le] = buckets.get(le, 0.0) + c
+        return total, err, buckets
+
+    def _bake(self, baseline: Tuple[float, float, Dict[float, float]],
+              ) -> Tuple[bool, Dict[str, Any]]:
+        """Watch live metrics for the bake window. True = keep."""
+        detail: Dict[str, Any] = {"window_queries": 0.0,
+                                  "error_rate": 0.0, "p95": None}
+        if self.cfg.bake_seconds <= 0 or not self._replica_urls():
+            detail["reason"] = "bake disabled"
+            return True, detail
+        t0, e0, b0 = baseline
+        # pre-bake p95 over the metrics' whole history, as the reference
+        pre_p95 = _p95_from_delta({}, b0)
+        deadline = self.clock() + self.cfg.bake_seconds
+        step = max(0.2, min(2.0, self.cfg.bake_seconds / 5.0))
+        while self.clock() < deadline and not self._stopping:
+            self.sleep(step)
+        t1, e1, b1 = self._scrape()
+        dq = max(0.0, t1 - t0)
+        de = max(0.0, e1 - e0)
+        detail["window_queries"] = dq
+        if dq > 0:
+            rate = de / dq
+            detail["error_rate"] = rate
+            if rate > self.cfg.bake_error_rate:
+                detail["reason"] = (f"error rate {rate:.4f} > "
+                                    f"{self.cfg.bake_error_rate}")
+                return False, detail
+        p95 = _p95_from_delta(b0, b1)
+        detail["p95"] = p95
+        detail["baseline_p95"] = pre_p95
+        if (p95 is not None and pre_p95 is not None and pre_p95 > 0
+                and p95 > pre_p95 * self.cfg.bake_p95_ratio):
+            detail["reason"] = (f"p95 {p95} > {self.cfg.bake_p95_ratio}x "
+                                f"baseline {pre_p95}")
+            return False, detail
+        detail["reason"] = "healthy"
+        return True, detail
+
+    # -- one cycle -------------------------------------------------------------
+
+    def run_once(self) -> Dict[str, Any]:
+        """One wake cycle. Returns an outcome record::
+
+            {"outcome": "idle" | "lease-held" | "promoted" | "refused"
+                        | "rolled_back" | "reload-failed",
+             "generation": int | None, "detail": {...}}
+
+        Raises :class:`LeaseLost` when superseded mid-cycle (the caller
+        — ``run`` or the supervisor — decides whether to re-acquire) and
+        propagates training errors (the supervisor restarts us; the
+        checkpoint directory carries the resume point).
+        """
+        if self.lease.token is None:
+            if not self.lease.acquire():
+                return {"outcome": "lease-held", "generation": None,
+                        "detail": {"path": self.lease.path}}
+        else:
+            self.lease.renew()
+
+        delta, cur = self._delta()
+        if delta < self.cfg.min_delta_events:
+            return {"outcome": "idle", "generation": None,
+                    "detail": {"delta": delta,
+                               "need": self.cfg.min_delta_events}}
+
+        # mid-delta-train crash site: an armed error here kills the
+        # process the way kill -9 would — AFTER the wake decision,
+        # BEFORE the model publishes. The supervisor restarts us and
+        # run_train(resume=True) picks up the checkpoint.
+        faults.inject("train.crash")
+
+        instance_id = self._train()
+
+        # the fence, part 1: prove we still hold the lease before any
+        # registry write — a wedged trainer whose lease expired during
+        # the (long) train must not publish
+        self.lease.renew()
+
+        blob = self.storage.models.get(instance_id)
+        if blob is None:
+            raise RuntimeError(f"trained instance {instance_id} has no blob")
+        # the fence, part 2: the registry refuses stale tokens even if
+        # the renew above raced a successor
+        gen = self.registry.register(
+            instance_id, blob, token=self.lease.token,
+            created_us=int(self.clock() * 1_000_000))
+        # candidate is SHELVED in meta until judged: a concurrent
+        # /reload keeps serving the champion
+        self.registry.sync_meta(self.storage.meta)
+
+        promote, gate = self._guardrail(instance_id)
+        if not promote:
+            self.registry.mark(gen, "refused", token=self.lease.token)
+            self.registry.sync_meta(self.storage.meta)
+            self._save_state(cur)
+            return {"outcome": "refused", "generation": gen, "detail": gate}
+
+        baseline = (self._scrape() if self.cfg.bake_seconds > 0
+                    else (0.0, 0.0, {}))
+        self.lease.renew()
+        self.registry.promote(gen, token=self.lease.token,
+                              now_us=int(self.clock() * 1_000_000))
+        self.registry.sync_meta(self.storage.meta)
+        pushed = self._push_reload()
+        self._save_state(cur)
+
+        keep, bake = self._bake(baseline)
+        if not keep:
+            self.lease.renew()
+            restored = self.registry.rollback(token=self.lease.token)
+            self.registry.sync_meta(self.storage.meta)
+            self._push_reload()
+            return {"outcome": "rolled_back", "generation": gen,
+                    "detail": {"gate": gate, "bake": bake,
+                               "restored": restored["gen"]}}
+        if not pushed:
+            return {"outcome": "reload-failed", "generation": gen,
+                    "detail": {"gate": gate}}
+        return {"outcome": "promoted", "generation": gen,
+                "detail": {"gate": gate, "bake": bake}}
+
+    # -- the loop --------------------------------------------------------------
+
+    def stop(self, *_args: Any) -> None:
+        self._stopping = True
+
+    def run(self, max_cycles: Optional[int] = None,
+            install_signals: bool = True) -> List[Dict[str, Any]]:
+        """The supervised loop: wake → cycle → heartbeat-paced sleep.
+
+        SIGTERM/SIGINT set the stop flag; the loop finishes the current
+        cycle, releases the lease (token kept — see
+        :meth:`TrainerLease.release`) and returns, exiting 0 so the
+        supervisor treats it as a finished job. Crashes propagate
+        WITHOUT releasing: the lease expires (or is superseded) and the
+        fencing token does the rest.
+        """
+        if install_signals:
+            signal.signal(signal.SIGTERM, self.stop)
+            signal.signal(signal.SIGINT, self.stop)
+        outcomes: List[Dict[str, Any]] = []
+        cycles = 0
+        while not self._stopping:
+            try:
+                rec = self.run_once()
+            except LeaseLost:
+                # drop our claim; next iteration re-acquires (and is
+                # fenced out if a successor is live)
+                self.lease.token = None
+                rec = {"outcome": "lease-lost", "generation": None,
+                       "detail": {}}
+            outcomes.append(rec)
+            cycles += 1
+            if max_cycles is not None and cycles >= max_cycles:
+                break
+            pause = (self.cfg.poll_interval
+                     if rec["outcome"] in ("idle", "lease-held",
+                                           "lease-lost")
+                     else 0.0)
+            # heartbeat-paced: never sleep past a renewal deadline
+            pause = min(pause, self.cfg.lease_ttl / 3.0) if pause else 0.0
+            deadline = self.clock() + pause
+            while not self._stopping and self.clock() < deadline:
+                self.sleep(min(0.2, self.cfg.poll_interval))
+            if self.lease.token is not None and not self._stopping:
+                try:
+                    self.lease.renew()
+                except LeaseLost:
+                    self.lease.token = None
+        # graceful exit only (stop flag or max_cycles): release zeroes
+        # the expiry so the next trainer starts instantly — no TTL dead
+        # window. A crash skips this on purpose: the lease expires (or
+        # is superseded) and the fencing token refuses any late write.
+        self.lease.release()
+        return outcomes

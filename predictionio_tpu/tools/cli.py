@@ -269,8 +269,6 @@ def cmd_router(args: argparse.Namespace) -> None:
 
 
 def cmd_train(args: argparse.Namespace) -> None:
-    from predictionio_tpu.core.workflow import run_train
-
     if getattr(args, "scan_workers", None):
         # per-invocation override of the segment-scan fan-out; the
         # EVENTLOG store reads it wherever the Storage gets built
@@ -279,6 +277,11 @@ def cmd_train(args: argparse.Namespace) -> None:
     factory = variant.get("engineFactory") or _die("engine.json missing engineFactory")
     # engine dir on sys.path so user engine modules import
     sys.path.insert(0, os.path.abspath(args.engine_dir))
+    if getattr(args, "continuous", False):
+        _run_continuous(args, variant, factory)
+        return
+    from predictionio_tpu.core.workflow import run_train
+
     instance_id = run_train(
         engine_factory=factory,
         variant=variant,
@@ -289,6 +292,100 @@ def cmd_train(args: argparse.Namespace) -> None:
         scan_cache=False if getattr(args, "no_scan_cache", False) else None,
     )
     print(f"[info] Training completed. Engine instance: {instance_id}")
+
+
+def _run_continuous(args: argparse.Namespace, variant: Dict[str, Any],
+                    factory: str) -> None:
+    """The supervised continuous-training loop (``pio train
+    --continuous``): lease → watermark wake → delta train (resumable)
+    → registry candidate → guardrail gate → promote + /reload push →
+    bake window with automatic rollback. See server/trainer.py and
+    docs/operations.md "Continuous training"."""
+    from predictionio_tpu.server.trainer import ContinuousTrainer, TrainerConfig
+
+    dsp = (variant.get("datasource") or {}).get("params") or {}
+    app_name = args.app or dsp.get("app_name") or dsp.get("appName")
+    if not app_name:
+        _die("--continuous needs --app or an appName in the variant's "
+             "datasource params")
+    cfg = TrainerConfig(
+        engine_factory=factory,
+        app_name=app_name,
+        variant=variant,
+        variant_id=str(variant.get("id", "")),
+        channel=args.channel,
+        min_delta_events=args.min_delta_events,
+        poll_interval=args.poll_interval,
+        lease_ttl=args.lease_ttl,
+        retain=args.retain,
+        guardrail_holdout=args.guardrail_holdout,
+        guardrail_max_regress=args.guardrail_max_regress,
+        guardrail_min_events=args.guardrail_min_events,
+        bake_seconds=args.bake_seconds,
+        bake_error_rate=args.bake_error_rate,
+        bake_p95_ratio=args.bake_p95_ratio,
+        reload_urls=args.reload_url or [],
+        router_url=args.router_url,
+        fleet_manifest=args.fleet_manifest,
+        use_mesh=not args.no_mesh,
+    )
+    trainer = ContinuousTrainer(cfg)
+    print(f"[info] Continuous trainer: app={app_name!r} "
+          f"min_delta={cfg.min_delta_events} lease={trainer.lease.path}")
+    outcomes = trainer.run(max_cycles=args.max_cycles)
+    for rec in outcomes[-10:]:
+        print(f"[train] {rec['outcome']}"
+              + (f" gen={rec['generation']}" if rec["generation"] else ""))
+    print(f"[info] Continuous trainer stopped after {len(outcomes)} cycles.")
+
+
+def cmd_models(args: argparse.Namespace) -> None:
+    """Generation-aware model registry verbs. Operator writes carry no
+    fencing token (``token=None`` bypasses the fence deliberately — the
+    human outranks a wedged trainer); meta statuses are re-synced so a
+    plain ``/reload`` lands on the chosen champion."""
+    from predictionio_tpu.storage.models import model_registry
+
+    st = get_storage()
+    reg = model_registry(st)
+    if args.models_cmd == "list":
+        doc = {"championGeneration": (reg.champion() or {}).get("gen"),
+               "fenceToken": reg.fence_token(),
+               "generations": reg.generations()}
+        if args.json:
+            print(json.dumps(doc, indent=2, sort_keys=True))
+            return
+        champ = doc["championGeneration"]
+        print(f"[models] champion=gen-{champ:06d}" if champ is not None
+              else "[models] champion=(none)")
+        print(f"[models] fence token={doc['fenceToken']}")
+        for e in doc["generations"]:
+            mark = " *champion*" if e["gen"] == champ else ""
+            print(f"  gen-{e['gen']:06d}  {e['status']:<12} "
+                  f"instance={e['instance_id']}  "
+                  f"sha256={e['sha256'][:12]}…{mark}")
+        return
+    if args.models_cmd == "promote":
+        try:
+            entry = reg.promote(args.generation)
+        except KeyError as e:
+            _die(str(e))
+        reg.sync_meta(st.meta)
+        print(f"[models] promoted gen-{entry['gen']:06d} "
+              f"(instance {entry['instance_id']}). "
+              "GET /reload on each replica (or `pio router reload "
+              "--rolling`) to swap serving onto it.")
+        return
+    if args.models_cmd == "rollback":
+        try:
+            entry = reg.rollback()
+        except LookupError as e:
+            _die(str(e))
+        reg.sync_meta(st.meta)
+        print(f"[models] rolled back to gen-{entry['gen']:06d} "
+              f"(instance {entry['instance_id']}). "
+              "GET /reload on each replica (or `pio router reload "
+              "--rolling`) to swap serving onto it.")
 
 
 def cmd_eval(args: argparse.Namespace) -> None:
@@ -329,6 +426,7 @@ def cmd_daemon(args: argparse.Namespace) -> None:
                      health_grace=args.health_grace,
                      max_restarts=args.max_restarts,
                      restart_window=args.restart_window,
+                     term_grace=args.term_grace,
                      pidfile=args.pidfile)
     raise SystemExit(sup.run())
 
@@ -781,6 +879,59 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--scan-workers", type=int,
                     help="parallel segment scans per training read "
                          "(default: PIO_SCAN_WORKERS)")
+    tr.add_argument("--continuous", action="store_true",
+                    help="run the supervised continuous-training loop: "
+                         "single-writer lease with fencing tokens, "
+                         "watermark-triggered delta trains (resumable "
+                         "after kill -9), guardrail-gated promotion "
+                         "through the model registry, /reload push, and "
+                         "a live-metrics bake window with automatic "
+                         "rollback (docs/operations.md)")
+    tr.add_argument("--app", help="app whose events drive the loop "
+                                  "(default: variant datasource appName)")
+    tr.add_argument("--channel", help="optional event channel")
+    tr.add_argument("--min-delta-events", type=int, default=1,
+                    help="train only when at least this many new events "
+                         "arrived since the last completed cycle")
+    tr.add_argument("--poll-interval", type=float, default=5.0,
+                    help="seconds between watermark polls when idle")
+    tr.add_argument("--lease-ttl", type=float, default=30.0,
+                    help="trainer lease TTL seconds; a trainer that "
+                         "stops heartbeating is supersedable after this")
+    tr.add_argument("--retain", type=int, default=5,
+                    help="registry generations kept beyond the champion")
+    tr.add_argument("--guardrail-holdout", type=int, default=200,
+                    help="newest-N feedback events scored champion vs "
+                         "candidate before promotion")
+    tr.add_argument("--guardrail-max-regress", type=float, default=0.10,
+                    help="refuse candidates whose holdout RMSE is worse "
+                         "than the champion's by more than this fraction")
+    tr.add_argument("--guardrail-min-events", type=int, default=10,
+                    help="below this many scoreable holdout pairs the "
+                         "gate passes trivially")
+    tr.add_argument("--bake-seconds", type=float, default=0.0,
+                    help="watch live serving metrics for this long after "
+                         "promotion and auto-roll-back on regression "
+                         "(0 = no bake window)")
+    tr.add_argument("--bake-error-rate", type=float, default=0.01,
+                    help="bake: roll back when the 5xx fraction over the "
+                         "window exceeds this")
+    tr.add_argument("--bake-p95-ratio", type=float, default=2.0,
+                    help="bake: roll back when window p95 exceeds the "
+                         "pre-swap baseline by this factor")
+    tr.add_argument("--reload-url", action="append",
+                    help="engine-server base URL to /reload and scrape "
+                         "(repeatable)")
+    tr.add_argument("--router-url",
+                    help="fleet-router base URL; promotion then pushes "
+                         "POST /router/reload?rolling=1 instead of "
+                         "direct /reload calls")
+    tr.add_argument("--fleet-manifest",
+                    help="router manifest file; its replica URLs are "
+                         "used for direct reload + bake scraping")
+    tr.add_argument("--max-cycles", type=int,
+                    help="stop after N wake cycles (smoke/testing; "
+                         "default: run until SIGTERM)")
     tr.set_defaults(fn=cmd_train)
 
     dp = sub.add_parser("deploy", help="serve the latest trained instance")
@@ -913,17 +1064,39 @@ def build_parser() -> argparse.ArgumentParser:
     fs = sub.add_parser(
         "fsck",
         help="verify integrity of eventlog segments, snapshot cache, "
-             "and model blobs (exit 0 clean / 2 corrupt / 3 repaired)")
+             "model blobs, and the model registry "
+             "(exit 0 clean / 2 corrupt / 3 repaired)")
     fs.add_argument("--home", help="storage home to scan "
                                    "(default: PIO_HOME / ~/.pio_store)")
     fs.add_argument("--repair", action="store_true",
                     help="quarantine torn eventlog tails (copied to a "
-                         ".quarantine-<offset> sidecar, then truncated) "
-                         "and delete corrupt snapshots; corrupt model "
-                         "blobs are reported only")
+                         ".quarantine-<offset> sidecar, then truncated), "
+                         "delete corrupt snapshots, delete orphaned "
+                         "registry generation dirs, and rewrite registry "
+                         "sha256 sidecars from the manifest; corrupt "
+                         "model blobs are reported only")
     fs.add_argument("--json", action="store_true",
                     help="emit the full report as one JSON document")
     fs.set_defaults(fn=cmd_fsck)
+
+    md = sub.add_parser(
+        "models",
+        help="generation-aware model registry: list the promotion "
+             "history, promote a generation, or roll back the champion "
+             "(continuous-training loop, docs/operations.md)")
+    mds = md.add_subparsers(dest="models_cmd", required=True)
+    x = mds.add_parser("list", help="generations, statuses, champion, "
+                                    "fence token")
+    x.add_argument("--json", action="store_true",
+                   help="emit the registry state as one JSON document")
+    x = mds.add_parser("promote",
+                       help="move the champion pointer to a generation "
+                            "(then /reload the fleet to swap serving)")
+    x.add_argument("generation", type=int)
+    x = mds.add_parser("rollback",
+                       help="demote the champion and restore the most "
+                            "recently promoted retired generation")
+    md.set_defaults(fn=cmd_models)
 
     sg = sub.add_parser(
         "segments",
@@ -964,6 +1137,11 @@ def build_parser() -> argparse.ArgumentParser:
     dm.add_argument("--health-grace", type=float, default=30.0)
     dm.add_argument("--max-restarts", type=int, default=10)
     dm.add_argument("--restart-window", type=float, default=600.0)
+    dm.add_argument("--term-grace", type=float, default=10.0,
+                    help="seconds between SIGTERM and SIGKILL when "
+                         "stopping the child; give the continuous "
+                         "trainer enough to finish its cycle and "
+                         "release the lease cleanly")
     dm.add_argument("command", nargs=argparse.REMAINDER)
     dm.set_defaults(fn=cmd_daemon)
 

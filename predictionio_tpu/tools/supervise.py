@@ -25,6 +25,16 @@ equivalent is split the unix way:
   * a restart budget within a rolling window, so a crash loop ends in
     a loud failure instead of a silent hot loop;
   * clean SIGTERM/SIGINT forwarding and a pidfile for stop scripts.
+
+Supervising the continuous trainer (``pio daemon -- pio train
+--continuous …``) composes with its lease protocol: the forwarded
+SIGTERM lets the trainer finish its cycle and **release** the lease
+(expiry zeroed, fencing token kept) before exiting 0, which the
+supervisor treats as a finished job — no restart, and the next trainer
+acquires instantly instead of waiting out the lease TTL. Size
+``term_grace`` so a cycle can complete; a child killed at the grace
+deadline simply leaves the lease to expire (the fencing token keeps
+late writes out either way).
 """
 
 from __future__ import annotations
@@ -60,6 +70,7 @@ class Supervisor:
         restart_window: float = 600.0,
         backoff: float = 1.0,
         backoff_max: float = 30.0,
+        term_grace: float = 10.0,
         pidfile: Optional[str] = None,
         log=_log,
     ) -> None:
@@ -72,6 +83,9 @@ class Supervisor:
         self.restart_window = restart_window
         self.backoff = backoff
         self.backoff_max = backoff_max
+        #: SIGTERM→SIGKILL window when stopping the child; the
+        #: continuous trainer needs enough to release its lease cleanly
+        self.term_grace = term_grace
         self.pidfile = pidfile
         self.log = log
         self._child: Optional[subprocess.Popen] = None
@@ -87,13 +101,13 @@ class Supervisor:
         self.log(f"[supervise] started pid {self._child.pid}: "
                  f"{' '.join(self.argv)}")
 
-    def _terminate_child(self, grace: float = 10.0) -> None:
+    def _terminate_child(self, grace: Optional[float] = None) -> None:
         child = self._child
         if child is None or child.poll() is not None:
             return
         child.terminate()
         try:
-            child.wait(timeout=grace)
+            child.wait(timeout=self.term_grace if grace is None else grace)
         except subprocess.TimeoutExpired:
             child.kill()
             child.wait()
@@ -255,6 +269,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "startup (model load + first compile)")
     ap.add_argument("--max-restarts", type=int, default=10)
     ap.add_argument("--restart-window", type=float, default=600.0)
+    ap.add_argument("--term-grace", type=float, default=10.0,
+                    help="seconds between SIGTERM and SIGKILL when "
+                         "stopping the child (the continuous trainer "
+                         "uses this window to release its lease)")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="the pio verb to supervise, e.g. "
                          "eventserver --port 7070")
@@ -267,6 +285,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                      health_grace=args.health_grace,
                      max_restarts=args.max_restarts,
                      restart_window=args.restart_window,
+                     term_grace=args.term_grace,
                      pidfile=args.pidfile)
     return sup.run()
 

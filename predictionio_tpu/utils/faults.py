@@ -52,6 +52,15 @@ Known sites (grep ``faults.inject`` for the authoritative list):
                         proxied request (slow replica; drives hedging)
 ``router.health.flap``  fleet-router active ``/health`` probe (flapping
                         or partitioned replica)
+``train.crash``         continuous trainer, mid-delta-train — process
+                        dies (SIGKILL-equivalent); resume must pick up
+                        from the checkpoint, not restart from scratch
+``train.lease.lost``    continuous trainer heartbeat renewal — the
+                        single-writer lease was stolen; the trainer
+                        must abandon the cycle and never publish
+``promote.regression``  guardrail scoring of a candidate generation —
+                        forces the candidate to look regressed so the
+                        gate (or bake window) must refuse/roll back
 ``data.corrupt.eventlog``  byte-flip on ``pio fsck`` eventlog reads
 ``data.corrupt.snapshot``  byte-flip on snapshot npz load
 ``data.corrupt.model``     byte-flip on model-blob load/download

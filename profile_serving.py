@@ -37,6 +37,17 @@ ratio, per-status counts, feedback counters, and breaker states —
 e.g. under a sustained sink failure the ``engine_feedback_sink``
 breaker must open and serving p50 must stay within 2x of healthy.
 
+Continuous-training chaos mode (the acceptance harness for
+docs/operations.md "Continuous training")::
+
+    python profile_serving.py --train-loop
+
+drives real ``pio train --continuous`` subprocesses and a shared-home
+replica through kill -9 mid-delta-train (resume + exactly one
+promotion), an injected ``promote.regression`` (guardrail refusal,
+fleet stays on the champion), and a fenced-out second trainer — all
+under live serving load that must stay all-200s.
+
 Prints ONE JSON line. On this image's tunneled TPU every device→host
 fetch after the first pays a ~66 ms relay round trip (BASELINE.md
 note) — run with ``--platform cpu`` for the HTTP/host shares and on a
@@ -138,17 +149,39 @@ def _replica_main(args) -> None:
     """Hidden subprocess entry (``--_replica-port``): one engine-server
     replica with its own in-memory storage. ``fabricate_instance`` is
     deterministic (seeded rng), so every replica serves the identical
-    model — the router A/B compares routing, not models."""
-    from profile_common import make_memory_storage, resolve_platform
+    model — the router A/B compares routing, not models.
+
+    With ``--_replica-home`` the replica instead shares an on-disk
+    storage home (SQLITE + LOCALFS) with the continuous trainer and
+    starts engine-less (``require_engine=False``): the trainer's
+    ``/reload`` pushes are what make it serve, exactly as in
+    production."""
+    from profile_common import resolve_platform
 
     resolve_platform(args.platform)
     from predictionio_tpu.server.engine_server import EngineServer
 
-    st = make_memory_storage()
-    factory = fabricate_instance(st, args.n_users, args.n_items, args.rank)
-    st.meta.create_app("ProfileApp")
-    server = EngineServer(engine_factory=factory, storage=st,
-                          host="127.0.0.1", port=args.replica_port)
+    if args.replica_home:
+        from predictionio_tpu.storage.registry import (Storage,
+                                                       StorageConfig,
+                                                       set_storage)
+
+        st = Storage(StorageConfig(home=args.replica_home))
+        set_storage(st)
+        factory = ("predictionio_tpu.templates.recommendation.engine:"
+                   "engine_factory")
+        server = EngineServer(engine_factory=factory, storage=st,
+                              host="127.0.0.1", port=args.replica_port,
+                              require_engine=False)
+    else:
+        from profile_common import make_memory_storage
+
+        st = make_memory_storage()
+        factory = fabricate_instance(st, args.n_users, args.n_items,
+                                     args.rank)
+        st.meta.create_app("ProfileApp")
+        server = EngineServer(engine_factory=factory, storage=st,
+                              host="127.0.0.1", port=args.replica_port)
     server.run()
 
 
@@ -384,6 +417,280 @@ def run_router_mode(args, st, factory) -> None:
         "ok": ok,
     }))
     if not ok:
+        raise SystemExit(1)
+
+
+def run_train_loop_mode(args) -> None:
+    """Continuous-training chaos harness (ISSUE 9 acceptance): a real
+    engine-server replica and real ``pio train --continuous`` trainer
+    subprocesses over one shared on-disk home. Proves, under live
+    serving load:
+
+    (a) kill -9 mid-delta-train → the restarted trainer resumes from
+        the checkpoint and promotes exactly ONE new generation, with
+        every query answered 200;
+    (b) ``PIO_FAULTS=promote.regression`` → the guardrail refuses the
+        candidate, the fleet never leaves the champion, zero errors;
+    (c) a second trainer against a held lease never writes a model
+        blob (fencing).
+    """
+    import os
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.storage.registry import Storage, StorageConfig
+
+    base = tempfile.mkdtemp(prefix="pio-train-loop-")
+    home = os.path.join(base, "home")
+    engine_dir = os.path.join(base, "engine")
+    os.makedirs(home)
+    os.makedirs(engine_dir)
+    n_users, n_items = 24, 16
+    variant = {
+        "id": "default",
+        "engineFactory": ("predictionio_tpu.templates.recommendation."
+                          "engine:engine_factory"),
+        "datasource": {"params": {"appName": "TrainLoopApp"}},
+        "algorithms": [{"name": "als",
+                        "params": {"rank": 4, "numIterations": 80,
+                                   "lambda": 0.05, "checkpointEvery": 1}}],
+    }
+    with open(os.path.join(engine_dir, "engine.json"), "w") as f:
+        json.dump(variant, f)
+
+    st = Storage(StorageConfig(home=home))  # SQLITE meta/events, LOCALFS
+    app = st.meta.create_app("TrainLoopApp")
+    st.events.init_channel(app.id)
+
+    def add_ratings(seed: int, n: int = 40):
+        rng = np.random.default_rng(seed)
+        evs = []
+        for _ in range(n):
+            u, i = int(rng.integers(n_users)), int(rng.integers(n_items))
+            r = 5.0 if (u % 2) == (i % 2) else 1.0
+            evs.append(Event(event="rate", entity_type="user",
+                             entity_id=str(u), target_entity_type="item",
+                             target_entity_id=str(i),
+                             properties={"rating": r}))
+        st.events.insert_batch(evs, app.id)
+
+    add_ratings(0, 200)
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    child_env = {**os.environ, "JAX_PLATFORMS": "cpu", "PIO_HOME": home}
+    replica_log = open(os.path.join(base, "replica.log"), "wb")
+    replica = subprocess.Popen(
+        [sys.executable, __file__, "--_replica-port", str(port),
+         "--_replica-home", home, "--platform", args.platform],
+        env=child_env, stdout=replica_log, stderr=replica_log)
+
+    def health():
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+            conn.request("GET", "/health")
+            resp = conn.getresponse()
+            body = json.loads(resp.read() or b"{}")
+            conn.close()
+            return resp.status, body
+        except OSError:
+            return 0, {}
+
+    def wait_for(pred, what: str, deadline_sec: float):
+        end = time.time() + deadline_sec
+        while time.time() < end:
+            if pred():
+                return
+            time.sleep(0.1)
+        raise TimeoutError(f"timed out waiting for {what}")
+
+    def spawn_trainer(name: str, extra_env=None, max_cycles=None):
+        cmd = [sys.executable, "-m", "predictionio_tpu.tools.cli", "train",
+               "--engine-dir", engine_dir, "--continuous", "--no-mesh",
+               "--min-delta-events", "1", "--poll-interval", "0.2",
+               "--lease-ttl", "5", "--guardrail-max-regress", "10.0",
+               "--reload-url", f"http://127.0.0.1:{port}"]
+        if max_cycles is not None:
+            cmd += ["--max-cycles", str(max_cycles)]
+        log = open(os.path.join(base, f"{name}.log"), "wb")
+        return subprocess.Popen(cmd, env={**child_env, **(extra_env or {})},
+                                stdout=log, stderr=log)
+
+    reg_path = os.path.join(home, "model_registry", "registry.json")
+
+    def registry():
+        try:
+            with open(reg_path, "r") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {"champion": None, "generations": [],
+                    "fence_token": 0}
+
+    def champion():
+        return registry().get("champion")
+
+    def stop_clean(proc, grace: float = 60.0) -> int:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            return proc.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            return proc.wait()
+
+    ckpt_root = os.path.join(home, "train_ckpt")
+
+    def ckpt_steps() -> int:
+        count = 0
+        for dirpath, dirnames, _ in os.walk(ckpt_root):
+            count += sum(1 for d in dirnames if d.isdigit())
+        return count
+
+    load_stop = None
+    load_box = {}
+
+    def start_load():
+        nonlocal load_stop
+        load_stop = threading.Event()
+        box = {}
+
+        def run():
+            box["result"] = _router_load(port, n_users, 50,
+                                         stop_when=load_stop)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        return t, box
+
+    checks = {}
+    detail = {}
+    try:
+        wait_for(lambda: health()[0] in (200, 503), "replica up", 180)
+
+        # -- bootstrap: first trainer promotes gen 1 and reloads ------
+        t0 = spawn_trainer("trainer-bootstrap")
+        wait_for(lambda: champion() == 1, "bootstrap promotion", 300)
+        rc0 = stop_clean(t0)
+        wait_for(lambda: health()[1].get("modelGeneration") == 1,
+                 "replica serving gen 1", 60)
+        with open(os.path.join(home, "trainer.lease")) as f:
+            lease_doc = json.load(f)
+        checks["clean_shutdown_released_lease"] = (
+            rc0 == 0 and lease_doc.get("expires") == 0)
+
+        # -- (a) kill -9 mid-delta-train, restart, resume -------------
+        add_ratings(1)
+        lt, lbox = start_load()
+        t1 = spawn_trainer("trainer-killed")
+        wait_for(lambda: ckpt_steps() >= 2,
+                 "mid-train checkpoints", 240)
+        steps_at_kill = ckpt_steps()
+        t1.send_signal(signal.SIGKILL)
+        t1.wait()
+        reg_at_kill = registry()
+        t2 = spawn_trainer("trainer-resumed")
+        wait_for(lambda: champion() == 2, "post-crash promotion", 300)
+        rc2 = stop_clean(t2)
+        wait_for(lambda: health()[1].get("modelGeneration") == 2,
+                 "replica serving gen 2", 60)
+        load_stop.set()
+        lt.join(timeout=120)
+        status_a, lats_a, _ = lbox["result"]
+        reg_a = registry()
+        checks["checkpointed_before_kill"] = steps_at_kill >= 2
+        checks["crashed_run_published_nothing"] = (
+            reg_at_kill["champion"] == 1
+            and len(reg_at_kill["generations"]) == 1)
+        checks["resumed_promoted_exactly_one"] = (
+            rc2 == 0 and reg_a["champion"] == 2
+            and len(reg_a["generations"]) == 2)
+        checks["restart_bumped_fence_token"] = reg_a["fence_token"] >= 2
+        checks["crash_pass_all_200"] = set(status_a) == {"200"}
+        detail["kill_9"] = {
+            "ckpt_steps_at_kill": steps_at_kill,
+            "statuses": status_a,
+            "p99_ms": round(float(np.percentile(lats_a, 99)) * 1e3, 3),
+        }
+
+        # -- (b) injected regression → guardrail refusal --------------
+        add_ratings(2)
+        lt, lbox = start_load()
+        t3 = spawn_trainer(
+            "trainer-regressed",
+            extra_env={"PIO_FAULTS":
+                       "promote.regression:error=injected,count=1"})
+        wait_for(lambda: any(g["status"] == "refused"
+                             for g in registry()["generations"]),
+                 "guardrail refusal", 300)
+        rc3 = stop_clean(t3)
+        load_stop.set()
+        lt.join(timeout=120)
+        status_b, lats_b, _ = lbox["result"]
+        reg_b = registry()
+        _, hb = health()
+        checks["regression_refused"] = (
+            rc3 == 0
+            and any(g["status"] == "refused"
+                    for g in reg_b["generations"]))
+        checks["fleet_stayed_on_champion"] = (
+            reg_b["champion"] == 2
+            and hb.get("modelGeneration") == 2)
+        checks["regression_pass_all_200"] = set(status_b) == {"200"}
+        detail["regression"] = {
+            "statuses": status_b,
+            "p99_ms": round(float(np.percentile(lats_b, 99)) * 1e3, 3),
+            "generations": {str(g["gen"]): g["status"]
+                            for g in reg_b["generations"]},
+        }
+
+        # -- (c) second trainer vs a held lease: fenced out -----------
+        from predictionio_tpu.server.trainer import TrainerLease
+
+        lease = TrainerLease(os.path.join(home, "trainer.lease"),
+                             "harness", ttl=300.0)
+        assert lease.acquire(), "harness could not take the lease"
+        with open(reg_path, "rb") as f:
+            reg_bytes_before = f.read()
+        dirs_before = sorted(os.listdir(os.path.dirname(reg_path)))
+        t4 = spawn_trainer("trainer-fenced", max_cycles=5)
+        rc4 = t4.wait(timeout=180)
+        with open(reg_path, "rb") as f:
+            reg_bytes_after = f.read()
+        dirs_after = sorted(os.listdir(os.path.dirname(reg_path)))
+        lease.release()
+        checks["fenced_trainer_wrote_nothing"] = (
+            rc4 == 0 and reg_bytes_after == reg_bytes_before
+            and dirs_after == dirs_before)
+        detail["fenced"] = {"registry_dirs": dirs_after}
+    finally:
+        if load_stop is not None:
+            load_stop.set()
+        replica.terminate()
+        try:
+            replica.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            replica.kill()
+        replica_log.close()
+
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "train_loop_chaos",
+        "queries_min_per_pass": 50,
+        **detail,
+        "checks": checks,
+        "ok": ok,
+    }))
+    if ok:
+        shutil.rmtree(base, ignore_errors=True)
+    else:
+        print(f"[train-loop] logs kept in {base}", file=sys.stderr)
         raise SystemExit(1)
 
 
@@ -656,8 +963,17 @@ def main() -> None:
                          "subprocesses behind a FleetRouter; rolling "
                          "reload + kill -9 under load must serve 0 "
                          "non-200s with bounded p99")
+    ap.add_argument("--train-loop", action="store_true",
+                    help="continuous-training chaos mode: a shared-home "
+                         "replica + real `pio train --continuous` "
+                         "subprocesses; kill -9 mid-delta-train, an "
+                         "injected promote.regression, and a fenced "
+                         "second trainer must all leave the fleet "
+                         "serving the right champion with zero errors")
     ap.add_argument("--_replica-port", dest="replica_port", type=int,
                     default=0, help=argparse.SUPPRESS)
+    ap.add_argument("--_replica-home", dest="replica_home", default="",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--aot", action="store_true",
                     help="AOT bucket-ladder mode: cold vs warm ladder "
                          "compile wall time + per-bucket device p50, "
@@ -672,6 +988,11 @@ def main() -> None:
 
     if args.replica_port:
         _replica_main(args)
+        return
+    if args.train_loop:
+        # no jax in the parent: the trainers and the replica are real
+        # subprocesses, the harness only seeds events and watches files
+        run_train_loop_mode(args)
         return
 
     from profile_common import make_memory_storage, resolve_platform

@@ -93,6 +93,13 @@ class TestFaultSiteAudit:
             f"fault sites no test exercises (the robustness claim is "
             f"unchecked): {missing}")
 
+    def test_trainer_loop_sites_are_registered(self):
+        """The continuous-training drill sites must stay in the table:
+        the chaos harness (``profile_serving.py --train-loop``) and the
+        runbook both arm them by name."""
+        assert {"train.crash", "train.lease.lost",
+                "promote.regression"} <= table_sites()
+
     def test_every_site_is_armable_via_pio_faults_spec(self):
         sites = table_sites()
         spec = ";".join(f"{s}:error=drill" for s in sorted(sites))

@@ -1,0 +1,569 @@
+"""Continuous-training loop: lease fencing, generation registry,
+guardrail-gated promotion, bake-window rollback, and the SIGKILL
+crash/resume harness (ISSUE 9 acceptance scenarios).
+
+Fault sites exercised here (closure-audited by test_faults_registry):
+``train.crash``, ``train.lease.lost``, ``promote.regression``.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from predictionio_tpu.data.event import Event, utcnow
+from predictionio_tpu.data.events import MemoryEventStore
+from predictionio_tpu.server.trainer import (
+    ContinuousTrainer,
+    LeaseLost,
+    TrainerConfig,
+    TrainerLease,
+    _p95_from_delta,
+    _parse_prom,
+    _query_stats,
+)
+from predictionio_tpu.storage.meta import EngineInstance, MetaStore
+from predictionio_tpu.storage.models import (
+    FencedWriteError,
+    MemoryModelStore,
+    ModelRegistry,
+)
+from predictionio_tpu.storage.registry import (
+    Storage,
+    StorageConfig,
+    set_storage,
+)
+from predictionio_tpu.utils import faults
+from predictionio_tpu.utils.integrity import IntegrityError
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.FAULTS.disarm()
+
+
+@pytest.fixture()
+def home_storage(tmp_path):
+    """In-memory backends over a real on-disk home (lease, registry,
+    and trainer state all live under ``storage.config.home``)."""
+    st = Storage(StorageConfig(metadata_type="MEMORY",
+                               eventdata_type="MEMORY",
+                               modeldata_type="MEMORY",
+                               home=str(tmp_path)))
+    st._meta = MetaStore(":memory:")
+    st._events = MemoryEventStore()
+    st._models = MemoryModelStore()
+    set_storage(st)
+    yield st
+    set_storage(None)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def clock(self):
+        return self.t
+
+    def sleep(self, seconds):
+        self.t += seconds
+
+
+def _seed_events(storage, app_name="LoopApp", n=12):
+    app = storage.meta.create_app(app_name)
+    storage.events.init_channel(app.id)
+    evs = [Event(event="rate", entity_type="user", entity_id=str(i % 4),
+                 target_entity_type="item", target_entity_id=str(i % 3),
+                 properties={"rating": float(1 + i % 5)})
+           for i in range(n)]
+    storage.events.insert_batch(evs, app.id)
+    return app
+
+
+def _stub_train(storage, blob=b"model-blob-v1"):
+    """A train_fn that mimics run_train's persistence contract: new
+    COMPLETED EngineInstance + model blob, returns the instance id."""
+
+    def train_fn(storage=storage, **_kw):
+        iid = storage.meta.new_instance_id()
+        ei = EngineInstance(
+            id=iid, status="COMPLETED", start_time=utcnow(),
+            end_time=utcnow(), engine_factory="stub:factory",
+            engine_variant="", batch="continuous", env={}, mesh_conf={},
+            data_source_params="{}", preparator_params="{}",
+            algorithms_params="[]", serving_params="{}")
+        storage.meta.insert_engine_instance(ei)
+        storage.models.put(iid, blob)
+        return iid
+
+    return train_fn
+
+
+def _trainer(storage, clock, **cfg_kw):
+    cfg = TrainerConfig(engine_factory="stub:factory", app_name="LoopApp",
+                        poll_interval=0.5, lease_ttl=30.0,
+                        use_mesh=False, **cfg_kw)
+    return ContinuousTrainer(cfg, storage=storage, clock=clock.clock,
+                             sleep=clock.sleep,
+                             train_fn=_stub_train(storage))
+
+
+# -- lease ---------------------------------------------------------------------
+
+
+class TestTrainerLease:
+    def test_acquire_renew_release_token_monotonic(self, tmp_path):
+        clk = FakeClock()
+        path = str(tmp_path / "t.lease")
+        a = TrainerLease(path, "a:1", ttl=30.0, clock=clk.clock,
+                         sleep=clk.sleep)
+        assert a.acquire() and a.token == 1
+        a.renew()
+        a.release()
+        # release zeroes the expiry but KEEPS the token: the successor
+        # acquires instantly AND still gets a strictly newer token
+        b = TrainerLease(path, "b:2", ttl=30.0, clock=clk.clock,
+                         sleep=clk.sleep)
+        assert b.acquire() and b.token == 2
+
+    def test_held_lease_refuses_second_acquirer(self, tmp_path):
+        clk = FakeClock()
+        path = str(tmp_path / "t.lease")
+        a = TrainerLease(path, "a:1", ttl=30.0, clock=clk.clock,
+                         sleep=clk.sleep)
+        b = TrainerLease(path, "b:2", ttl=30.0, clock=clk.clock,
+                         sleep=clk.sleep)
+        assert a.acquire()
+        assert not b.acquire()
+
+    def test_expired_lease_is_stolen_and_renew_detects_it(self, tmp_path):
+        clk = FakeClock()
+        path = str(tmp_path / "t.lease")
+        a = TrainerLease(path, "a:1", ttl=30.0, clock=clk.clock,
+                         sleep=clk.sleep)
+        b = TrainerLease(path, "b:2", ttl=30.0, clock=clk.clock,
+                         sleep=clk.sleep)
+        assert a.acquire()
+        clk.t += 31.0  # a stops heartbeating past the TTL
+        assert b.acquire() and b.token == 2
+        with pytest.raises(LeaseLost):
+            a.renew()  # the wedged holder must notice it was superseded
+
+    def test_train_lease_lost_fault_site(self, tmp_path):
+        clk = FakeClock()
+        a = TrainerLease(str(tmp_path / "t.lease"), "a:1", ttl=30.0,
+                         clock=clk.clock, sleep=clk.sleep)
+        assert a.acquire()
+        faults.FAULTS.arm("train.lease.lost", error="lease stolen")
+        with pytest.raises(LeaseLost):
+            a.renew()
+
+
+# -- registry ------------------------------------------------------------------
+
+
+class TestModelRegistry:
+    def test_register_promote_rollback(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "model_registry"), retain=5)
+        g1 = reg.register("i1", b"one", token=1)
+        g2 = reg.register("i2", b"two", token=1)
+        reg.promote(g1, token=1, now_us=100)
+        reg.promote(g2, token=1, now_us=200)
+        assert reg.champion()["gen"] == g2
+        assert reg.get_blob(g1) == b"one"
+        restored = reg.rollback(token=1)
+        assert restored["gen"] == g1
+        assert reg.champion()["gen"] == g1
+        statuses = {e["gen"]: e["status"] for e in reg.generations()}
+        assert statuses == {g1: "champion", g2: "rolled_back"}
+
+    def test_sha256_sidecar_and_digest_verify(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "model_registry"))
+        g = reg.register("i1", b"payload", token=1)
+        side = os.path.join(reg.gen_dir(g), "model.bin.sha256")
+        assert os.path.isfile(side)
+        with open(os.path.join(reg.gen_dir(g), "model.bin"), "wb") as f:
+            f.write(b"tampered")
+        with pytest.raises(IntegrityError):
+            reg.get_blob(g)
+
+    def test_fencing_refuses_stale_token_before_any_blob(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "model_registry"))
+        reg.register("i1", b"one", token=5)
+        with pytest.raises(FencedWriteError):
+            reg.register("late", b"late-blob", token=4)
+        # acceptance (c): the fenced writer left ZERO bytes behind
+        assert not os.path.exists(reg.gen_dir(2))
+        assert reg.find_gen("late") is None
+        with pytest.raises(FencedWriteError):
+            reg.promote(1, token=4)
+
+    def test_retention_prunes_old_generations(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "model_registry"), retain=2)
+        gens = [reg.register(f"i{i}", b"x", token=1) for i in range(5)]
+        reg.promote(gens[0], token=1, now_us=1)
+        reg.promote(gens[4], token=1, now_us=2)
+        kept = {e["gen"] for e in reg.generations()}
+        assert gens[4] in kept and len(kept) == 3  # champion + 2 newest
+        for g in gens:
+            assert os.path.isdir(reg.gen_dir(g)) == (g in kept)
+
+    def test_sync_meta_statuses_follow_the_champion(self, home_storage):
+        from predictionio_tpu.storage.models import model_registry
+
+        st = home_storage
+        train = _stub_train(st)
+        i1, i2, i3, i4 = (train() for _ in range(4))
+        reg = model_registry(st)
+        g1 = reg.register(i1, b"1", token=1)
+        g2 = reg.register(i2, b"2", token=1)
+        g3 = reg.register(i3, b"3", token=1)
+        g4 = reg.register(i4, b"4", token=1)
+        reg.promote(g1, token=1, now_us=1)
+        reg.mark(g2, "refused", token=1)
+        reg.promote(g3, token=1, now_us=2)
+        reg.rollback(token=1)  # g3 out, g1 back
+        reg.sync_meta(st.meta)
+        assert st.meta.get_engine_instance(i1).status == "COMPLETED"
+        assert st.meta.get_engine_instance(i2).status == "REFUSED"
+        assert st.meta.get_engine_instance(i3).status == "REGRESSED"
+        assert st.meta.get_engine_instance(i4).status == "SHELVED"
+        # the serving contract: latest-COMPLETED == the champion, so a
+        # plain /reload lands on it — including right after rollback
+        latest = st.meta.get_latest_completed_engine_instance(
+            "stub:factory", "")
+        assert latest.id == i1
+
+
+# -- trainer wake cycles (fake clock, tier-1 fast) -----------------------------
+
+
+class TestTrainerLoop:
+    def test_single_wake_cycle_promotes_first_generation(self, home_storage):
+        _seed_events(home_storage)
+        clk = FakeClock()
+        t = _trainer(home_storage, clk, min_delta_events=5)
+        rec = t.run_once()
+        assert rec["outcome"] == "promoted"
+        assert rec["generation"] == 1
+        assert t.registry.champion()["gen"] == 1
+        # consumed the watermark: next cycle is idle
+        assert t.run_once()["outcome"] == "idle"
+        # new events re-arm the wake
+        _app = home_storage.meta.get_app_by_name("LoopApp")
+        home_storage.events.insert_batch(
+            [Event(event="rate", entity_type="user", entity_id="9",
+                   target_entity_type="item", target_entity_id="1",
+                   properties={"rating": 5.0}) for _ in range(5)], _app.id)
+        rec2 = t.run_once()
+        assert rec2["outcome"] == "promoted" and rec2["generation"] == 2
+
+    def test_run_releases_lease_on_stop(self, home_storage):
+        _seed_events(home_storage)
+        clk = FakeClock()
+        t = _trainer(home_storage, clk, min_delta_events=5)
+        outcomes = t.run(max_cycles=2, install_signals=False)
+        assert [r["outcome"] for r in outcomes] == ["promoted", "idle"]
+        with open(t.lease.path) as f:
+            doc = json.load(f)
+        # released: expiry zeroed, token kept for the successor's fence
+        assert doc["expires"] == 0 and doc["token"] == 1
+        assert t.lease.token is None
+
+    def test_train_crash_fault_site_then_recovery(self, home_storage):
+        _seed_events(home_storage)
+        clk = FakeClock()
+        t = _trainer(home_storage, clk, min_delta_events=5)
+        faults.FAULTS.arm("train.crash", error="mid-train crash", count=1)
+        with pytest.raises(faults.FaultError):
+            t.run_once()
+        # crashed before any publish: no generation, watermark unconsumed
+        assert t.registry.generations() == []
+        # the "restarted" trainer (fault exhausted) completes the cycle
+        rec = t.run_once()
+        assert rec["outcome"] == "promoted" and rec["generation"] == 1
+
+    def test_guardrail_refuses_injected_regression(self, home_storage):
+        _seed_events(home_storage)
+        clk = FakeClock()
+        t = _trainer(home_storage, clk, min_delta_events=5)
+        assert t.run_once()["outcome"] == "promoted"  # champion = gen 1
+        app = home_storage.meta.get_app_by_name("LoopApp")
+        home_storage.events.insert_batch(
+            [Event(event="rate", entity_type="user", entity_id="1",
+                   target_entity_type="item", target_entity_id="2",
+                   properties={"rating": 3.0}) for _ in range(6)], app.id)
+        faults.FAULTS.arm("promote.regression", error="regressed")
+        rec = t.run_once()
+        assert rec["outcome"] == "refused"
+        assert t.registry.champion()["gen"] == 1  # fleet stays on champion
+        entry = [e for e in t.registry.generations()
+                 if e["gen"] == rec["generation"]][0]
+        assert entry["status"] == "refused"
+        cand = home_storage.meta.get_engine_instance(entry["instance_id"])
+        assert cand.status == "REFUSED"
+
+    def test_second_trainer_against_held_lease_never_writes(
+            self, home_storage):
+        _seed_events(home_storage)
+        clk = FakeClock()
+        a = _trainer(home_storage, clk, min_delta_events=5)
+        assert a.lease.acquire()  # a holds the lease
+        b = _trainer(home_storage, clk, min_delta_events=5)
+        rec = b.run_once()
+        assert rec["outcome"] == "lease-held"
+        assert b.registry.generations() == []  # acceptance (c): no blob
+        assert not os.listdir(os.path.join(
+            home_storage.config.home, "model_registry")) or (
+            os.listdir(os.path.join(home_storage.config.home,
+                                    "model_registry")) == ["registry.json"])
+
+    def test_wedged_trainer_is_fenced_out_of_late_publish(self, home_storage):
+        """A trainer superseded DURING its train must not land a blob:
+        the pre-publish renew raises LeaseLost and run() abandons the
+        cycle without registering anything."""
+        _seed_events(home_storage)
+        clk = FakeClock()
+        a = _trainer(home_storage, clk, min_delta_events=5)
+
+        def stealing_train(**kw):
+            clk.t += 40.0  # train outlives the TTL...
+            b = TrainerLease(a.lease.path, "b:2", ttl=30.0, clock=clk.clock,
+                             sleep=clk.sleep)
+            assert b.acquire()  # ...and a successor takes over
+            return _stub_train(home_storage)()
+
+        a._train_fn = stealing_train
+        with pytest.raises(LeaseLost):
+            a.run_once()
+        assert a.registry.generations() == []
+
+    def test_bake_window_rolls_back_on_error_rate(self, home_storage):
+        _seed_events(home_storage)
+        clk = FakeClock()
+        scrapes = {"n": 0}
+
+        def fake_http(method, url):
+            if url.endswith("/reload"):
+                return "{}"
+            scrapes["n"] += 1
+            errs = 0 if scrapes["n"] == 1 else 50  # post-swap: 50 5xx
+            return (
+                'pio_engine_queries_total{status="200"} 1000\n'
+                f'pio_engine_queries_total{{status="500"}} {errs}\n'
+                'pio_engine_query_seconds_bucket{status="200",le="0.1"} 900\n'
+                'pio_engine_query_seconds_bucket{status="200",le="+Inf"} '
+                f'{1000 + errs}\n')
+
+        cfg = TrainerConfig(
+            engine_factory="stub:factory", app_name="LoopApp",
+            min_delta_events=5, poll_interval=0.5, use_mesh=False,
+            bake_seconds=5.0, bake_error_rate=0.01,
+            reload_urls=["http://replica:8000"])
+        t = ContinuousTrainer(cfg, storage=home_storage, clock=clk.clock,
+                              sleep=clk.sleep,
+                              train_fn=_stub_train(home_storage),
+                              http=fake_http)
+        # first promotion bakes clean? no — the fake fleet regresses on
+        # every post-swap scrape, so even gen 1 gets rolled... gen 1 has
+        # nothing to roll back TO, which is its own interesting case:
+        # promote a baseline champion with bake disabled first.
+        t.cfg.bake_seconds = 0.0
+        assert t.run_once()["outcome"] == "promoted"
+        app = home_storage.meta.get_app_by_name("LoopApp")
+        home_storage.events.insert_batch(
+            [Event(event="rate", entity_type="user", entity_id="7",
+                   target_entity_type="item", target_entity_id="1",
+                   properties={"rating": 2.0}) for _ in range(6)], app.id)
+        t.cfg.bake_seconds = 5.0
+        rec = t.run_once()
+        assert rec["outcome"] == "rolled_back"
+        assert rec["detail"]["restored"] == 1
+        assert t.registry.champion()["gen"] == 1  # fleet back on champion
+        gen2 = [e for e in t.registry.generations() if e["gen"] == 2][0]
+        assert gen2["status"] == "rolled_back"
+        # rollback re-synced meta: latest COMPLETED is the old champion,
+        # so the /reload push lands the fleet back on it
+        latest = home_storage.meta.get_latest_completed_engine_instance(
+            "stub:factory", "")
+        assert latest.id == t.registry.champion()["instance_id"]
+
+
+# -- prometheus parsing helpers ------------------------------------------------
+
+
+def test_parse_prom_and_p95():
+    text = ('# HELP x y\n'
+            'pio_engine_queries_total{status="200"} 90\n'
+            'pio_engine_queries_total{status="500"} 10\n'
+            'pio_engine_query_seconds_bucket{status="200",le="0.05"} 50\n'
+            'pio_engine_query_seconds_bucket{status="200",le="0.5"} 96\n'
+            'pio_engine_query_seconds_bucket{status="200",le="+Inf"} 100\n')
+    total, err, buckets = _query_stats(_parse_prom(text))
+    assert total == 100 and err == 10
+    p95 = _p95_from_delta({}, buckets)
+    assert p95 == 0.5  # 95th of 100 lands in the 0.5 bucket
+
+
+# -- engine server identity satellites -----------------------------------------
+
+
+class TestServerSwapIdentity:
+    def _server(self, home_storage):
+        from predictionio_tpu.server.engine_server import EngineServer
+
+        return EngineServer(engine_factory="stub:factory",
+                            storage=home_storage, port=0,
+                            require_engine=False)
+
+    def test_health_reports_generation_and_last_swap(self, home_storage):
+        import asyncio
+
+        srv = self._server(home_storage)
+        resp = asyncio.run(srv._health(None))
+        body = json.loads(resp.body)
+        assert body["modelGeneration"] is None
+        assert body["lastSwap"] is None
+        srv._record_swap("rolled_back", reason="probe query failed")
+        resp = asyncio.run(srv._health(None))
+        body = json.loads(resp.body)
+        assert body["lastSwap"]["outcome"] == "rolled_back"
+
+    def test_model_generation_resolves_from_registry(self, home_storage):
+        from predictionio_tpu.storage.models import model_registry
+
+        iid = _stub_train(home_storage)()
+        reg = model_registry(home_storage)
+        g = reg.register(iid, b"blob", token=1)
+        srv = self._server(home_storage)
+
+        class _Deployed:
+            class instance:
+                id = iid
+
+        srv.deployed = _Deployed()
+        assert srv._model_generation() == g
+
+
+# -- SIGKILL crash harness (full loop, subprocess) -----------------------------
+
+
+_CHILD = """
+import os, sys
+from predictionio_tpu.storage.registry import Storage, StorageConfig, set_storage
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.server.trainer import ContinuousTrainer, TrainerConfig
+
+st = Storage(StorageConfig(metadata_type="SQLITE", eventdata_type="SQLITE",
+                           modeldata_type="LOCALFS", home="home"))
+set_storage(st)
+app = st.meta.get_app_by_name("CrashApp")
+if app is None:
+    app = st.meta.create_app("CrashApp")
+    st.events.init_channel(app.id)
+    evs = []
+    for u in range(24):
+        for i in range(16):
+            if (u + i) % 2 == 0:
+                r = 5.0 if (u % 2) == (i % 2) else 1.0
+                evs.append(Event(event="rate", entity_type="user",
+                                 entity_id=str(u), target_entity_type="item",
+                                 target_entity_id=str(i),
+                                 properties={"rating": r}))
+    st.events.insert_batch(evs, app.id)
+
+VARIANT = {
+    "id": "default",
+    "engineFactory":
+        "predictionio_tpu.templates.recommendation.engine:engine_factory",
+    "datasource": {"params": {"appName": "CrashApp"}},
+    "algorithms": [{"name": "als",
+                    "params": {"rank": 4, "numIterations": 60,
+                               "lambda": 0.05, "checkpointEvery": 1}}],
+}
+cfg = TrainerConfig(
+    engine_factory=VARIANT["engineFactory"], app_name="CrashApp",
+    variant=VARIANT, variant_id="default", min_delta_events=1,
+    poll_interval=0.2, lease_ttl=10.0, use_mesh=False)
+trainer = ContinuousTrainer(cfg, storage=st)
+# the predecessor's SIGKILL leaves its lease to expire (never released),
+# so the restarted trainer may spend its first cycles on "lease-held"
+# until the TTL runs out — that wait IS the crash-safety protocol
+import time
+deadline = time.monotonic() + 240.0
+rec = None
+while time.monotonic() < deadline:
+    rec = trainer.run_once()
+    if rec["outcome"] == "promoted":
+        break
+    time.sleep(0.5)
+trainer.lease.release()
+print("OUTCOME", rec, flush=True)
+assert rec and rec["outcome"] == "promoted", rec
+"""
+
+
+@pytest.mark.slow
+def test_sigkill_mid_delta_train_resumes_and_promotes_once(tmp_path):
+    """Acceptance (a): kill -9 the trainer mid-delta-train; the
+    restarted trainer resumes from the mid-train checkpoint, completes,
+    and promotes EXACTLY one generation — the crashed run's lease and
+    partial state produce no duplicate promotion (fencing proof)."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO_ROOT}
+    home = tmp_path / "home"
+    ckpt_root = home / "train_ckpt"
+
+    proc = subprocess.Popen([sys.executable, "-c", _CHILD],
+                            cwd=str(tmp_path), env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE)
+
+    def checkpointed():
+        # at least two completed checkpoint steps under train_ckpt/<id>/als
+        if not ckpt_root.is_dir():
+            return False
+        steps = [p for p in ckpt_root.rglob("*") if p.is_dir()
+                 and p.name.isdigit()]
+        return len(steps) >= 2
+
+    deadline = time.monotonic() + 120.0
+    try:
+        while not checkpointed():
+            if proc.poll() is not None:
+                raise AssertionError("trainer finished before the kill: "
+                                     + proc.stderr.read().decode())
+            if time.monotonic() > deadline:
+                raise AssertionError("trainer made no checkpoint progress")
+            time.sleep(0.05)
+    finally:
+        try:
+            proc.send_signal(signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+
+    # the kill left mid-train checkpoints and an unreleased lease behind
+    assert checkpointed()
+    reg_path = home / "model_registry" / "registry.json"
+    assert not reg_path.exists(), "crashed run must not have published"
+
+    # restart: the new trainer takes the lease (new fencing token),
+    # resumes from the checkpoint, completes, and promotes
+    done = subprocess.run([sys.executable, "-c", _CHILD],
+                          cwd=str(tmp_path), env=env,
+                          capture_output=True, timeout=300)
+    assert done.returncode == 0, done.stderr.decode()
+
+    doc = json.loads(reg_path.read_text())
+    assert doc["champion"] == 1
+    assert len(doc["generations"]) == 1, "exactly one promotion"
+    assert doc["fence_token"] >= 2, "restart bumped the fencing token"
+    # COMPLETED consumed the checkpoints: the resume point is gone
+    assert not checkpointed()
