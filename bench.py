@@ -280,6 +280,31 @@ def main() -> None:
     aot_gaps = _jit_dispatches() - gaps_before
     p50_by_bucket = aot_mod.device_p50_ms_by_bucket()
 
+    # ANN flywheel (predictionio_tpu/ann): PQ-index the trained item
+    # factors, warm the ANN ladder, and report recall@10 vs the exact
+    # resident scorer plus the per-bucket ANN-vs-exact device p50 — the
+    # PQ trade-off printed next to the exact numbers it trades against.
+    from predictionio_tpu import ann as ann_mod
+
+    ann_m = next(m for m in (8, 4, 2, 1) if args.rank % m == 0)
+    ann_index = ann_mod.build_index(
+        V, ann_m, 256, iters=4, sample=min(65536, n_items))
+    ann_scorer = ann_mod.ANNScorer(U, V, ann_index, shortlist=128)
+    ann_scorer.warm_buckets(ladder, ks=(10,))
+    gaps_before = _jit_dispatches()
+    ann_hits = ann_total = 0
+    for B in ladder:
+        busers = np.asarray(rng.integers(0, n_users, size=B), np.int32)
+        for rep in range(5):
+            er = scorer.recommend_batch(busers, 10)
+            ar = ann_scorer.recommend_batch(busers, 10)
+            if rep == 0:
+                for (ei, _), (ai, _) in zip(er, ar):
+                    ann_hits += np.intersect1d(ei, ai).size
+                    ann_total += len(ei)
+    ann_gaps = _jit_dispatches() - gaps_before
+    ann_p50_by_bucket = aot_mod.device_p50_ms_by_bucket(path="ann")
+
     baseline = None
     if os.path.exists(BASELINE_FILE):
         try:
@@ -328,6 +353,13 @@ def main() -> None:
             "predict_p50_device_ms_by_bucket": p50_by_bucket,
             "aot_buckets": list(ladder.buckets),
             "aot_serving_jit_fallbacks": int(aot_gaps),
+            # ANN retrieval: recall@10 of the PQ ADC+re-rank path vs
+            # the exact scorer on the same query batches, and its
+            # per-bucket device p50 (dispatch path="ann")
+            "ann_recall_at_10": round(ann_hits / max(ann_total, 1), 4),
+            "ann_p50_device_ms_by_bucket": ann_p50_by_bucket,
+            "ann_serving_jit_fallbacks": int(ann_gaps),
+            "ann_index_build_sec": ann_index.meta.get("build_sec"),
             "predict_queries": n_queries,
             # On this image's tunneled ("axon") chip, every device→host
             # fetch costs a ~66ms round trip, so the end-to-end p50 is

@@ -1,0 +1,32 @@
+"""JAX-native approximate nearest-neighbor retrieval (ROADMAP item 3).
+
+Product-quantized index for two-tower serving at 10M+ item corpora:
+
+- :mod:`.pq` — k-means PQ codebook training (jitted Lloyd) + uint8
+  corpus encoding, run at ``pio train`` time;
+- :mod:`.index` — versioned ``PIOANN01`` index blob with sha256
+  integrity (PR 4 contract: corrupt index → ``/reload`` refused),
+  sidecars + manifest for ``pio fsck`` / ``pio index status``;
+- :mod:`.scorer` — device-resident serving: ADC lookup-table scan +
+  top-k′ shortlist + exact float re-rank fused into ONE jitted program
+  per AOT bucket, drop-in beside the exact ``ResidentScorer``.
+
+Import cost discipline: this package root pulls numpy-only modules;
+jax loads lazily inside the functions that trace (the CLI's jax-free
+verbs — ``pio index status`` among them — must stay jax-free).
+"""
+
+from predictionio_tpu.ann.index import (INDEX_BASENAME, MANIFEST_BASENAME,
+                                        PQIndex, build_index, load_index,
+                                        manifest_dict, save_index)
+from predictionio_tpu.ann.pq import (decode, encode, reconstruction_mse,
+                                     train_codebooks)
+from predictionio_tpu.ann.scorer import (DEFAULT_SHORTLIST, ANNScorer,
+                                         maybe_ann_scorer)
+
+__all__ = [
+    "PQIndex", "build_index", "load_index", "save_index", "manifest_dict",
+    "INDEX_BASENAME", "MANIFEST_BASENAME",
+    "train_codebooks", "encode", "decode", "reconstruction_mse",
+    "ANNScorer", "maybe_ann_scorer", "DEFAULT_SHORTLIST",
+]

@@ -1,0 +1,219 @@
+"""Versioned PQ index blob: build, persist, verify, load.
+
+The index is part of the model artifact (codebooks-as-model — PAPER.md
+survey: the trained model IS the serving artifact). On-disk/in-blob
+layout, all little-endian:
+
+    b"PIOANN01" | u32 header_len | header JSON | payload
+
+where payload = codebooks (m·K·dsub f32) ++ codes (N·m u8)
+[++ ids (N i32) when ``has_ids``] and the header carries the payload's
+sha256. :func:`PQIndex.from_bytes` verifies that digest on EVERY load —
+file-backed or embedded in a pickled model blob — so a corrupt index is
+refused at ``/reload`` exactly like a corrupt model blob (PR 4
+contract). The fault site ``ann.index.corrupt`` byte-flips the blob at
+this single choke point for chaos tests.
+
+When the model store has a real directory (LOCALFS), :func:`save_index`
+also writes ``ann_index.bin`` + ``.sha256`` sidecar + ``ann_index.json``
+manifest next to the model blob; ``pio fsck`` audits the pair and
+``pio index status`` pretty-prints the manifest jax-free.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from predictionio_tpu.utils import faults
+from predictionio_tpu.utils.atomic_write import atomic_write_bytes
+from predictionio_tpu.utils.integrity import (IntegrityError, sha256_hex,
+                                              verify_blob)
+
+MAGIC = b"PIOANN01"
+INDEX_BASENAME = "ann_index.bin"
+MANIFEST_BASENAME = "ann_index.json"
+
+#: bytes-per-item of the float re-rank embeddings are added on top of
+#: codes+codebooks for the HBM estimate (the serving scorer keeps V
+#: resident for the exact re-rank of the shortlist)
+_F32 = 4
+
+
+@dataclass
+class PQIndex:
+    """In-memory PQ index: ``codebooks`` (m, K, dsub) f32, ``codes``
+    (N, m) u8, optional ``ids`` (N,) i32 mapping code rows to corpus
+    rows (None = identity), plus build metadata."""
+
+    codebooks: np.ndarray
+    codes: np.ndarray
+    ids: Optional[np.ndarray] = None
+    meta: dict = field(default_factory=dict)
+
+    @property
+    def m(self) -> int:
+        return int(self.codebooks.shape[0])
+
+    @property
+    def k(self) -> int:
+        return int(self.codebooks.shape[1])
+
+    @property
+    def dsub(self) -> int:
+        return int(self.codebooks.shape[2])
+
+    @property
+    def dim(self) -> int:
+        return self.m * self.dsub
+
+    @property
+    def n_items(self) -> int:
+        return int(self.codes.shape[0])
+
+    def code_bytes(self) -> int:
+        return self.codes.size  # uint8
+
+    def codebook_bytes(self) -> int:
+        return self.codebooks.size * _F32
+
+    def hbm_estimate_bytes(self) -> int:
+        """Device-resident footprint of ANN serving: codes + codebooks
+        + the float corpus kept for exact shortlist re-rank."""
+        return (self.code_bytes() + self.codebook_bytes()
+                + self.n_items * self.dim * _F32)
+
+    # -- wire format ----------------------------------------------------------
+
+    def to_bytes(self) -> bytes:
+        codebooks = np.ascontiguousarray(self.codebooks, np.float32)
+        codes = np.ascontiguousarray(self.codes, np.uint8)
+        payload = codebooks.tobytes() + codes.tobytes()
+        has_ids = self.ids is not None
+        if has_ids:
+            payload += np.ascontiguousarray(self.ids, np.int32).tobytes()
+        header = {
+            "version": 1,
+            "m": self.m, "k": self.k, "dsub": self.dsub,
+            "n": self.n_items, "dim": self.dim,
+            "has_ids": has_ids,
+            "payload_sha256": sha256_hex(payload),
+            "build_sec": self.meta.get("build_sec"),
+            "built_unix": self.meta.get("built_unix"),
+        }
+        hj = json.dumps(header, sort_keys=True).encode("utf-8")
+        return MAGIC + struct.pack("<I", len(hj)) + hj + payload
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "PQIndex":
+        """Parse + verify an index blob. The single load choke point:
+        the ``ann.index.corrupt`` fault injects here (covers both the
+        ``ann_index.bin`` file path and indexes embedded in pickled
+        model blobs), and any structural damage or payload-digest
+        mismatch raises :class:`IntegrityError` — which ``/reload``
+        turns into a refused candidate, champion kept."""
+        blob = faults.corrupt_bytes("ann.index.corrupt", blob)
+        try:
+            if blob[:len(MAGIC)] != MAGIC:
+                raise ValueError(f"bad magic {blob[:len(MAGIC)]!r}")
+            off = len(MAGIC)
+            (hlen,) = struct.unpack_from("<I", blob, off)
+            off += 4
+            header = json.loads(blob[off:off + hlen].decode("utf-8"))
+            off += hlen
+            payload = blob[off:]
+            if header.get("version") != 1:
+                raise ValueError(f"unknown version {header.get('version')!r}")
+            verify_blob(payload, header["payload_sha256"], "ann_index",
+                        what="payload")
+            m, k, dsub, n = (header["m"], header["k"], header["dsub"],
+                             header["n"])
+            pos = 0
+            cb_n = m * k * dsub * _F32
+            codebooks = np.frombuffer(
+                payload, np.float32, count=m * k * dsub,
+                offset=pos).reshape(m, k, dsub).copy()
+            pos += cb_n
+            codes = np.frombuffer(
+                payload, np.uint8, count=n * m,
+                offset=pos).reshape(n, m).copy()
+            pos += n * m
+            ids = None
+            if header.get("has_ids"):
+                ids = np.frombuffer(
+                    payload, np.int32, count=n, offset=pos).copy()
+        except IntegrityError:
+            raise
+        except Exception as e:
+            raise IntegrityError(f"ann index blob corrupt: {e}") from e
+        meta = {"build_sec": header.get("build_sec"),
+                "built_unix": header.get("built_unix")}
+        return cls(codebooks=codebooks, codes=codes, ids=ids, meta=meta)
+
+
+def build_index(V, m: int, k: int, *, iters: int = 8, seed: int = 0,
+                sample: int = 65536) -> PQIndex:
+    """Train codebooks + encode the corpus → :class:`PQIndex` with
+    build timing in ``meta`` (surfaced by ``pio index status``)."""
+    from predictionio_tpu.ann import pq
+
+    t0 = time.perf_counter()
+    codebooks = pq.train_codebooks(V, m, k, iters=iters, seed=seed,
+                                   sample=sample)
+    codes = pq.encode(V, codebooks)
+    return PQIndex(codebooks=codebooks, codes=codes,
+                   meta={"build_sec": round(time.perf_counter() - t0, 3),
+                         "built_unix": int(time.time())})
+
+
+def manifest_dict(index: PQIndex, blob_sha256: str) -> dict:
+    """The jax-free geometry summary ``pio index status`` prints."""
+    return {
+        "version": 1,
+        "m": index.m, "k": index.k, "dsub": index.dsub,
+        "dim": index.dim, "n_items": index.n_items,
+        "code_bytes": index.code_bytes(),
+        "codebook_bytes": index.codebook_bytes(),
+        "hbm_estimate_bytes": index.hbm_estimate_bytes(),
+        "build_sec": index.meta.get("build_sec"),
+        "built_unix": index.meta.get("built_unix"),
+        "sha256": blob_sha256,
+    }
+
+
+def save_index(index: PQIndex, algo_dir: str) -> str:
+    """Persist ``ann_index.bin`` + ``.sha256`` sidecar (via the shared
+    ``storage/models`` artifact layout: blob durably first, digest
+    last — a torn write reads back refused or unchecksummed, never
+    silently wrong) and the ``ann_index.json`` manifest. Returns the
+    blob path."""
+    from predictionio_tpu.storage.models import write_artifact
+
+    blob = index.to_bytes()
+    path = os.path.join(algo_dir, INDEX_BASENAME)
+    digest = write_artifact(path, blob)
+    atomic_write_bytes(
+        os.path.join(algo_dir, MANIFEST_BASENAME),
+        (json.dumps(manifest_dict(index, digest), indent=2, sort_keys=True)
+         + "\n").encode("utf-8"))
+    return path
+
+
+def load_index(algo_dir: str) -> Optional[PQIndex]:
+    """Load + verify ``ann_index.bin`` from ``algo_dir`` (None when
+    absent). The file sidecar is checked against the raw bytes via the
+    shared artifact reader; the header payload digest is checked in
+    :func:`PQIndex.from_bytes` either way."""
+    from predictionio_tpu.storage.models import read_artifact
+
+    path = os.path.join(algo_dir, INDEX_BASENAME)
+    blob = read_artifact(path, "ann_index", what=path)
+    if blob is None:
+        return None
+    return PQIndex.from_bytes(blob)

@@ -1,0 +1,183 @@
+"""Product-quantization codebook training + corpus encoding (JAX).
+
+The index-build half of the ANN subsystem (ROADMAP item 3): split the
+embedding dimension into ``m`` subspaces, train ``k ≤ 256`` centroids
+per subspace with a few Lloyd iterations (jitted, sample-bounded), and
+encode the full item corpus to (N, m) uint8 code words. Training runs
+at ``pio train`` time — the codebooks travel inside the model artifact
+(see :mod:`predictionio_tpu.ann.index`), never rebuilt at serve time.
+
+Memory discipline: the Lloyd assignment tensor is (m, chunk, K) — the
+sample is scanned in fixed chunks so the one-hot/assignment
+intermediates stay bounded no matter the sample size, and encoding
+chunks the corpus the same way (pad-to-chunk, slice after).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+_LLOYD_CHUNK = 8192    # sample rows per assignment step
+_ENCODE_CHUNK = 16384  # corpus rows per encode dispatch
+
+
+def _lloyd_impl(Xc, w, C0, *, iters: int):
+    """``Xc``: (S, m, T, dsub) chunked sample, ``w``: (S, T) row
+    validity (0.0 pad), ``C0``: (m, K, dsub) initial centroids."""
+    import jax
+    import jax.numpy as jnp
+
+    K = C0.shape[1]
+
+    def one_iter(C, _):
+        def chunk(carry, inp):
+            sums, cnt = carry
+            x, wv = inp                                   # (m,T,d), (T,)
+            cn = jnp.sum(C * C, axis=-1)                  # (m,K)
+            d = cn[:, None, :] - 2.0 * jnp.einsum(
+                "mtd,mkd->mtk", x, C,
+                preferred_element_type=jnp.float32)
+            a = jnp.argmin(d, axis=-1)                    # (m,T)
+            oh = jax.nn.one_hot(a, K, dtype=x.dtype) * wv[None, :, None]
+            sums = sums + jnp.einsum("mtk,mtd->mkd", oh, x)
+            cnt = cnt + jnp.sum(oh, axis=1)               # (m,K)
+            return (sums, cnt), None
+
+        (sums, cnt), _ = jax.lax.scan(
+            chunk, (jnp.zeros_like(C), jnp.zeros(C.shape[:2], C.dtype)),
+            (Xc, w))
+        # empty clusters keep their previous centroid (standard Lloyd
+        # degeneracy handling; with sampled init they stay rare)
+        C2 = jnp.where(cnt[..., None] > 0.5,
+                       sums / jnp.maximum(cnt, 1.0)[..., None], C)
+        return C2, None
+
+    C, _ = jax.lax.scan(one_iter, C0, None, length=iters)
+    return C
+
+
+@functools.lru_cache(maxsize=1)
+def _lloyd_jit():
+    import jax
+
+    return jax.jit(_lloyd_impl, static_argnames=("iters",))
+
+
+def _encode_impl(x, C):
+    """``x``: (T, m, dsub) chunk, ``C``: (m, K, dsub) → (T, m) uint8."""
+    import jax.numpy as jnp
+
+    cn = jnp.sum(C * C, axis=-1)                          # (m,K)
+    d = cn[None, :, :] - 2.0 * jnp.einsum(
+        "tmd,mkd->tmk", x, C, preferred_element_type=jnp.float32)
+    return jnp.argmin(d, axis=-1).astype(jnp.uint8)
+
+
+@functools.lru_cache(maxsize=1)
+def _encode_jit():
+    import jax
+
+    return jax.jit(_encode_impl)
+
+
+def _check_geometry(dim: int, m: int, k: int) -> int:
+    if m < 1 or dim % m:
+        raise ValueError(
+            f"embedding dim {dim} must split evenly into m={m} subspaces")
+    if not 2 <= k <= 256:
+        raise ValueError(f"PQ k={k} out of range [2, 256] (codes are uint8)")
+    return dim // m
+
+
+def train_codebooks(V, m: int, k: int, *, iters: int = 8, seed: int = 0,
+                    sample: int = 65536) -> np.ndarray:
+    """Train (m, k, dim/m) PQ codebooks over item embeddings ``V``.
+
+    Lloyd k-means per subspace, all subspaces in one jitted program; at
+    most ``sample`` corpus rows participate (uniform without
+    replacement) so build time is corpus-size-independent past the
+    sample. Centroids are seeded from distinct sampled rows; when the
+    corpus has fewer than ``k`` rows the remainder is jittered copies
+    (those clusters go empty and just hold their centroid).
+    """
+    import jax.numpy as jnp
+
+    V = np.asarray(V, np.float32)
+    n, dim = V.shape
+    dsub = _check_geometry(dim, m, k)
+    rng = np.random.default_rng(seed)
+    if n > sample:
+        X = V[rng.choice(n, size=sample, replace=False)]
+    else:
+        X = V
+    # (m, n_sample, dsub): subspace-major so every per-subspace op is a
+    # leading-axis batch
+    Xs = np.ascontiguousarray(
+        X.reshape(len(X), m, dsub).transpose(1, 0, 2))
+    if len(X) >= k:
+        C0 = Xs[:, rng.choice(len(X), size=k, replace=False), :]
+    else:
+        picks = rng.choice(len(X), size=k, replace=True)
+        C0 = Xs[:, picks, :] + rng.normal(
+            0, 1e-3, size=(m, k, dsub)).astype(np.float32)
+    # chunk the sample for the scanned assignment step
+    T = min(_LLOYD_CHUNK, max(len(X), 1))
+    pad = -len(X) % T
+    w = np.concatenate([np.ones(len(X), np.float32),
+                        np.zeros(pad, np.float32)])
+    if pad:
+        Xs = np.concatenate(
+            [Xs, np.zeros((m, pad, dsub), np.float32)], axis=1)
+    S = Xs.shape[1] // T
+    Xc = np.ascontiguousarray(
+        Xs.reshape(m, S, T, dsub).transpose(1, 0, 2, 3))
+    C = _lloyd_jit()(jnp.asarray(Xc), jnp.asarray(w.reshape(S, T)),
+                     jnp.asarray(C0), iters=iters)
+    return np.asarray(C)
+
+
+def encode(V, codebooks: np.ndarray) -> np.ndarray:
+    """Encode the corpus to (N, m) uint8 nearest-centroid code words,
+    chunked (last chunk padded then sliced — one compile total)."""
+    import jax.numpy as jnp
+
+    V = np.asarray(V, np.float32)
+    n, dim = V.shape
+    m, k, dsub = codebooks.shape
+    if dim != m * dsub:
+        raise ValueError(f"corpus dim {dim} != codebook dim {m * dsub}")
+    Cd = jnp.asarray(codebooks)
+    out = np.empty((n, m), np.uint8)
+    T = min(_ENCODE_CHUNK, max(n, 1))
+    for lo in range(0, n, T):
+        chunk = V[lo:lo + T]
+        rows = len(chunk)
+        if rows < T:
+            chunk = np.concatenate(
+                [chunk, np.zeros((T - rows, dim), np.float32)])
+        codes = _encode_jit()(
+            jnp.asarray(chunk.reshape(T, m, dsub)), Cd)
+        out[lo:lo + rows] = np.asarray(codes)[:rows]
+    return out
+
+
+def decode(codes: np.ndarray, codebooks: np.ndarray) -> np.ndarray:
+    """Reconstruct (N, dim) float approximations from code words —
+    used by round-trip tests and recall diagnostics, not serving."""
+    cb = np.asarray(codebooks, np.float32)
+    cd = np.asarray(codes)
+    return np.concatenate(
+        [cb[mi][cd[:, mi]] for mi in range(cb.shape[0])], axis=1)
+
+
+def reconstruction_mse(V, codebooks: np.ndarray,
+                       codes: Optional[np.ndarray] = None) -> float:
+    """Mean squared quantization error of the corpus (diagnostic)."""
+    V = np.asarray(V, np.float32)
+    if codes is None:
+        codes = encode(V, codebooks)
+    err = V - decode(codes, codebooks)
+    return float(np.mean(err * err))

@@ -1,0 +1,265 @@
+"""Device-resident ANN serving: fused ADC scan → shortlist → re-rank.
+
+The serving half of the ANN subsystem. Mirrors the exact path's
+:class:`predictionio_tpu.models.als.ResidentScorer` contract exactly —
+same AOT bucket-ladder warmup, same packed single-fetch output, same
+PAD-row masking — so the :class:`~predictionio_tpu.server.aot.AOTWarmup`
+/ ``MicroBatcher`` machinery and ``serve_topk_batch`` work unchanged;
+a template swaps scorers, nothing above it moves.
+
+One serving dispatch runs, fused in a single jitted program:
+
+    Q = U[user_ids]                   (gather query embeddings)
+    LUT = Q_sub · codebooks           ((B, m, K) inner-product tables)
+    adc = Σ_m LUT[b, m, code[m, n]]   ((B, N) approximate scores)
+    shortlist = top_k'(adc)           ((B, k′) candidate rows)
+    exact = Q · V[shortlist]          (float re-rank, gathered rows only)
+    out = top_k(exact) packed as [vals ++ idx.astype(f32)]
+
+Device latency records under ``path="ann"`` (vs the exact path's
+``"aot"``) so per-bucket ANN-vs-exact p50 is one
+``device_p50_ms_by_bucket(path=...)`` call; un-warmed geometry falls
+back to jit dispatch recorded as ``"jit"`` — the same
+zero-compile-after-warmup audit as the exact path catches warmup gaps.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import numpy as np
+
+from predictionio_tpu.ann.index import PQIndex
+from predictionio_tpu.models.als import _SERVE_MIN_ITEMS, _bucket_k
+
+DEFAULT_SHORTLIST = 128
+
+
+def _ann_topk_impl(U, V, codebooks, codesT, user_ids, rows_valid=None, *,
+                   k: int, kprime: int):
+    import jax.numpy as jnp
+
+    from predictionio_tpu import ops
+    from predictionio_tpu.ops.topk import _mask_pad_rows
+
+    Q = U[user_ids]
+    if rows_valid is not None:
+        Q = _mask_pad_rows(Q, rows_valid)
+    _svals, sidx = ops.adc_shortlist(Q, codebooks, codesT, kprime)
+    vals, idx = ops.rerank_topk(Q, V, sidx, k)
+    # ONE packed output array — one host fetch per query batch, same
+    # rationale as als._gather_score_topk_impl (indices exact in f32
+    # below 2^24)
+    return jnp.concatenate([vals, idx.astype(jnp.float32)], axis=-1)
+
+
+@functools.lru_cache(maxsize=1)
+def _ann_topk_jit():
+    import jax
+
+    return jax.jit(_ann_topk_impl, static_argnames=("k", "kprime"))
+
+
+class ANNScorer:
+    """Serving-time ANN scorer: PQ codes + codebooks + float corpus
+    resident in HBM, one fused dispatch per query batch.
+
+    Same external contract as ``ResidentScorer`` (``recommend_batch``,
+    ``recommend``, ``warm_buckets``, ``set_bucket_ladder``,
+    ``built_from``) so ``maybe_*_scorer`` callers, ``serve_topk_batch``
+    and the AOT warmup hook treat the two interchangeably.
+    """
+
+    def built_from(self, U, V) -> bool:
+        if self._source is None:
+            return False
+        return self._source[0]() is U and self._source[1]() is V
+
+    def __init__(self, U: np.ndarray, V: np.ndarray, index: PQIndex,
+                 shortlist: int = DEFAULT_SHORTLIST):
+        import jax
+        import jax.numpy as jnp
+        import weakref
+
+        try:
+            self._source = (weakref.ref(U), weakref.ref(V))
+        except TypeError:
+            self._source = None
+        self.n_users, self.rank = U.shape
+        self.n_items = V.shape[0]
+        if self.n_items >= 1 << 24:
+            raise ValueError("ANNScorer supports catalogs < 2^24 items")
+        if index.n_items != self.n_items:
+            raise ValueError(
+                f"index covers {index.n_items} items, corpus has "
+                f"{self.n_items}")
+        if index.dim != self.rank:
+            raise ValueError(
+                f"index dim {index.dim} != embedding dim {self.rank}")
+        self.m, self.K = index.m, index.k
+        #: shortlist size k′ — the recall/latency knob (clamped to the
+        #: catalog; serving k is further clamped to k′)
+        self.shortlist = max(1, min(int(shortlist), self.n_items))
+        self._U = jax.device_put(jnp.asarray(U, jnp.float32))
+        # float corpus stays resident for the exact re-rank; UNPADDED —
+        # the re-rank gathers only shortlist rows, never scans V
+        self._V = jax.device_put(jnp.asarray(V, jnp.float32))
+        self._codebooks = jax.device_put(
+            jnp.asarray(index.codebooks, jnp.float32))
+        # (m, N) uint8, subspace-major: each unrolled ADC step gathers
+        # one contiguous row
+        self._codesT = jax.device_put(jnp.asarray(
+            np.ascontiguousarray(np.asarray(index.codes, np.uint8).T)))
+        self.bucket_ladder = None
+        self._aot: dict = {}   # (B, k) -> compiled
+
+    # -- AOT bucket ladder (server/aot) ---------------------------------------
+
+    def set_bucket_ladder(self, ladder) -> None:
+        self.bucket_ladder = ladder
+
+    def _serving_k(self, want: int) -> int:
+        """Bucketed serving k, never beyond the shortlist (the re-rank
+        can only return k′ rows) or the catalog."""
+        return min(_bucket_k(want), self.shortlist, self.n_items)
+
+    def _aot_key(self, B: int, k: int) -> tuple:
+        import jax
+
+        return ("ann_adc_topk", self.n_users, self.rank, self.m, self.K,
+                self.n_items, B, k, self.shortlist, jax.default_backend())
+
+    def _ensure_executable(self, B: int, k: int) -> bool:
+        """AOT lower+compile one (bucket, k) serving program via the
+        process-wide cache. True = cold compile, False = cache hit."""
+        import jax
+
+        from predictionio_tpu.server.aot import EXECUTABLES
+
+        key = self._aot_key(B, k)
+        was_cold = EXECUTABLES.get(key) is None
+
+        def build():
+            sds = (
+                jax.ShapeDtypeStruct((self.n_users, self.rank), np.float32),
+                jax.ShapeDtypeStruct((self.n_items, self.rank), np.float32),
+                jax.ShapeDtypeStruct(
+                    (self.m, self.K, self.rank // self.m), np.float32),
+                jax.ShapeDtypeStruct((self.m, self.n_items), np.uint8),
+                jax.ShapeDtypeStruct((B,), np.int32),
+                jax.ShapeDtypeStruct((), np.int32),  # rows_valid
+            )
+            return _ann_topk_jit().lower(
+                *sds, k=k, kprime=self.shortlist).compile()
+
+        self._aot[(B, k)] = EXECUTABLES.get_or_compile(key, build)
+        return was_cold
+
+    def warm_buckets(self, ladder, ks=(16,)) -> dict:
+        """Deploy-time warmup over the bucket ladder — same return
+        shape as ``ResidentScorer.warm_buckets``."""
+        self.set_bucket_ladder(ladder)
+        compiled = cached = 0
+        for B in ladder:
+            for k in ks:
+                if self._ensure_executable(B, self._serving_k(k)):
+                    compiled += 1
+                else:
+                    cached += 1
+        return {"targets": compiled + cached,
+                "compiled": compiled, "cached": cached}
+
+    def _topk(self, user_ids, k: int, rows: Optional[int] = None):
+        """One serving dispatch at a bucket-padded batch. Warmed
+        buckets run the precompiled executable under ``path="ann"``;
+        anything else is a counted jit fallback (= warmup gap)."""
+        import time
+
+        import jax.numpy as jnp
+
+        from predictionio_tpu.server import aot
+        from predictionio_tpu.utils import tracing
+
+        B = len(user_ids)
+        rows_valid = np.int32(B if rows is None else rows)
+        prog = self._aot.get((B, k))
+        path = "ann" if prog is not None else "jit"
+        with tracing.span("serving.device", bucket=B, k=k, path=path):
+            t0 = time.perf_counter()
+            if prog is not None:
+                packed = np.asarray(prog(
+                    self._U, self._V, self._codebooks, self._codesT,
+                    np.asarray(user_ids, np.int32), rows_valid))
+            else:
+                packed = np.asarray(_ann_topk_jit()(
+                    self._U, self._V, self._codebooks, self._codesT,
+                    jnp.asarray(user_ids, jnp.int32), rows_valid,
+                    k=k, kprime=self.shortlist))
+            out = packed[..., :k], packed[..., k:].astype(np.int32)
+            aot.record_device_latency(B, time.perf_counter() - t0, path,
+                                      trace_exemplar=tracing.exemplar())
+        return out
+
+    def recommend_batch(
+        self, user_ids: np.ndarray, num: int,
+        exclude: Optional[list] = None,
+    ) -> list:
+        """Top-``num`` per user → list of (item_indices, scores);
+        identical batch/k bucketing and host-side exclusion filtering
+        as ``ResidentScorer.recommend_batch``, with k clamped to the
+        shortlist (over-asking an ANN index cannot improve recall)."""
+        if not exclude:
+            exclude = [None] * len(user_ids)
+        exclude = [np.asarray([] if e is None else e, np.int32)
+                   for e in exclude]
+        max_ex = max((e.size for e in exclude), default=0)
+        want = min(num + max_ex, self.n_items)
+        k = self._serving_k(want)
+        B = len(user_ids)
+        Bp = (self.bucket_ladder.snap(B)
+              if self.bucket_ladder is not None else 0)
+        if Bp < B:
+            Bp = 1
+            while Bp < B:
+                Bp *= 2
+        ids = np.asarray(user_ids, np.int32)
+        if Bp != B:
+            ids = np.concatenate([ids, np.zeros(Bp - B, np.int32)])
+        vals, idx = self._topk(ids, k, rows=B)
+        vals, idx = np.asarray(vals)[:B], np.asarray(idx)[:B]
+        out = []
+        for row in range(len(user_ids)):
+            iv, vv = idx[row], vals[row]
+            if exclude[row].size:
+                keep = ~np.isin(iv, exclude[row])
+                iv, vv = iv[keep], vv[keep]
+            out.append((iv[:num], vv[:num]))
+        return out
+
+    def recommend(self, user: int, num: int,
+                  exclude: Optional[np.ndarray] = None):
+        [(iv, vv)] = self.recommend_batch(
+            np.asarray([user]), num,
+            [np.asarray(exclude if exclude is not None else [], np.int32)])
+        return iv, vv
+
+
+def maybe_ann_scorer(U, V, index: Optional[PQIndex], cached=None,
+                     shortlist: int = DEFAULT_SHORTLIST):
+    """ANN twin of ``als.maybe_resident_scorer``: None (→ caller's
+    exact/host path) when there is no index or the catalog is below
+    ``_SERVE_MIN_ITEMS`` in auto mode; honors the same
+    ``PIO_ALS_SERVE`` override and reuses ``cached`` only when built
+    from these exact U/V arrays."""
+    if index is None:
+        return None
+    mode = os.environ.get("PIO_ALS_SERVE", "auto")
+    if mode == "host" or (mode == "auto"
+                          and V.shape[0] < _SERVE_MIN_ITEMS):
+        return None
+    if (cached is not None and isinstance(cached, ANNScorer)
+            and cached.built_from(U, V) and cached.shortlist == shortlist):
+        return cached
+    return ANNScorer(U, V, index, shortlist=shortlist)

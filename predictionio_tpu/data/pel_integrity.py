@@ -346,6 +346,43 @@ def check_model(blob_path: str) -> Dict[str, object]:
     return report
 
 
+def check_ann_index(blob_path: str) -> Dict[str, object]:
+    """Verify one ANN retrieval index blob (``ann_index.bin``,
+    predictionio_tpu/ann) against its sha256 sidecar AND its internal
+    header payload digest (the blob is self-verifying, so an index
+    embedded without a sidecar still gets a real verdict). Report-only:
+    an index is rebuilt by re-running ``pio train``, not by fsck."""
+    report: Dict[str, object] = {"path": blob_path, "status": "ok"}
+    try:
+        with open(blob_path, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        report["status"] = "corrupt"
+        report["detail"] = str(e)
+        return report
+    sidecar = None
+    try:
+        with open(blob_path + DIGEST_SUFFIX, "r", encoding="ascii") as f:
+            sidecar = f.read().strip()
+    except OSError:
+        pass
+    if sidecar is not None and hashlib.sha256(blob).hexdigest() != sidecar:
+        report["status"] = "corrupt"
+        report["detail"] = "blob digest mismatch vs sidecar"
+        return report
+    from predictionio_tpu.ann.index import PQIndex
+
+    try:
+        PQIndex.from_bytes(blob)
+    except Exception as e:
+        report["status"] = "corrupt"
+        report["detail"] = f"index blob failed verification: {e}"
+        return report
+    if sidecar is None:
+        report["status"] = "unchecksummed"
+    return report
+
+
 def check_model_registry(root: str,
                          repair: bool = False) -> List[Dict[str, object]]:
     """Audit the generation-aware model registry (``model_registry/``).
@@ -497,12 +534,23 @@ def fsck_home(home: str, repair: bool = False) -> Dict[str, object]:
     model_dir = os.path.join(home, "models")
     if os.path.isdir(model_dir):
         for inst in sorted(os.listdir(model_dir)):
-            p = os.path.join(model_dir, inst, "model.bin")
+            inst_dir = os.path.join(model_dir, inst)
+            p = os.path.join(inst_dir, "model.bin")
             if os.path.exists(p):
                 r = check_model(p)
                 r["artifact"] = "model"
                 r["instance"] = inst
                 artifacts.append(r)
+            # per-algorithm ANN index blobs beside the model blob
+            # (<inst>/<algo>/ann_index.bin — predictionio_tpu/ann)
+            if os.path.isdir(inst_dir):
+                for algo in sorted(os.listdir(inst_dir)):
+                    ip = os.path.join(inst_dir, algo, "ann_index.bin")
+                    if os.path.exists(ip):
+                        r = check_ann_index(ip)
+                        r["artifact"] = "ann_index"
+                        r["instance"] = inst
+                        artifacts.append(r)
 
     reg_dir = os.path.join(home, "model_registry")
     if os.path.isdir(reg_dir):

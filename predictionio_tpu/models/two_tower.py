@@ -336,3 +336,19 @@ def two_tower_embed_users(user_variables, n_users: int,
         _tower_forward_np(user_variables, np.arange(lo, min(lo + chunk,
                                                             n_users)))
         for lo in range(0, n_users, chunk)])
+
+
+def two_tower_build_index(item_embeds: np.ndarray, m: int = 8, k: int = 256,
+                          *, iters: int = 8, seed: int = 0,
+                          sample: int = 65536):
+    """Build the PQ retrieval index over the materialized item table
+    (ROADMAP item 3) — the `pio train`-time step that turns exact
+    top-k serving into ADC-shortlist + re-rank at 10M+ corpora. Thin
+    model-layer wrapper so templates depend on models/, not on the
+    index internals; returns a :class:`predictionio_tpu.ann.PQIndex`
+    (persisted inside the model artifact by the template's
+    ``save_model``)."""
+    from predictionio_tpu import ann
+
+    return ann.build_index(np.asarray(item_embeds, np.float32), m, k,
+                           iters=iters, seed=seed, sample=sample)

@@ -14,7 +14,9 @@ backend (compiled on TPU, XLA elsewhere, interpret-mode in tests).
 
 from predictionio_tpu.ops.gram import rows_gram, rows_gram_xla
 from predictionio_tpu.ops.segment import segment_count, segment_mean, segment_sum
-from predictionio_tpu.ops.topk import score_topk, score_topk_xla
+from predictionio_tpu.ops.topk import (adc_scores, adc_shortlist,
+                                       rerank_topk, score_topk,
+                                       score_topk_xla)
 
 
 def use_pallas(platform=None) -> bool:
@@ -39,6 +41,7 @@ def use_pallas(platform=None) -> bool:
 
 
 __all__ = [
+    "adc_scores", "adc_shortlist", "rerank_topk",
     "rows_gram", "rows_gram_xla", "score_topk", "score_topk_xla",
     "segment_sum", "segment_count", "segment_mean", "use_pallas",
 ]

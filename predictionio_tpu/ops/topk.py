@@ -101,6 +101,111 @@ def _topk_kernel(Q_ref, V_ref, vals_ref, idx_ref, best_v, best_i,
         idx_ref[:] = best_i[:]
 
 
+# -- PQ asymmetric-distance scan + re-rank (ann subsystem math) ---------------
+#
+# Pure traceable functions (no jit here): predictionio_tpu/ann/scorer.py
+# fuses gather → ADC scan → shortlist → exact re-rank into ONE jitted
+# serving program per AOT bucket; keeping the math in ops/ keeps the
+# layering of the exact path (ops holds math, the caller owns residency
+# and compilation).
+
+
+#: columns per streamed ADC tile — the live score set is (B, _ADC_CHUNK)
+#: f32 (8 MB at B=64), cache/VMEM-resident, independent of corpus size
+_ADC_CHUNK = 32768
+
+
+def _adc_lut(Q, codebooks):
+    """(B, m, K) table of query-subvector · centroid inner products."""
+    B = Q.shape[0]
+    m, K, dsub = codebooks.shape
+    return jnp.einsum("bmd,mkd->bmk", Q.reshape(B, m, dsub),
+                      codebooks, preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+def _adc_sum(lut, codesT):
+    """Sum LUT entries along each item's code word → (B, n) scores.
+    The m-loop is a static Python unroll (m is small); each step is
+    one (B, K) table gather → (B, n) add."""
+    scores = jnp.zeros((lut.shape[0], codesT.shape[1]), jnp.float32)
+    for mi in range(codesT.shape[0]):
+        scores = scores + jnp.take(lut[:, mi, :], codesT[mi], axis=1)
+    return scores
+
+
+def adc_scores(Q, codebooks, codesT):
+    """Asymmetric-distance (inner-product) scores of queries against a
+    product-quantized corpus, dense: (B, N).
+
+    ``Q``: (B, d) float queries; ``codebooks``: (m, K, d/m) PQ
+    centroids; ``codesT``: (m, N) uint8 code matrix (transposed so each
+    subspace's codes are a contiguous gather). Materializes the full
+    (B, N) score matrix — fine for parity tests and small corpora; the
+    serving path uses :func:`adc_shortlist`, which streams.
+    """
+    return _adc_sum(_adc_lut(Q, codebooks), codesT)
+
+
+def adc_shortlist(Q, codebooks, codesT, kprime: int,
+                  chunk: int = _ADC_CHUNK):
+    """Top-``kprime`` shortlist by ADC score → (vals, idx (B, k′) i32).
+
+    Streams the corpus in ``chunk``-column tiles: each
+    :func:`jax.lax.scan` step sums the m LUT gathers for one tile and
+    keeps the tile-local top-k′; one final top-k′ over the
+    (n_tiles · k′) tile winners merges them. The result is identical to
+    a full-scan top-k (every global winner wins its own tile), but the
+    (B, N) score matrix is never materialized — the live set is
+    (B, chunk), so a 10M-item scan holds steady at megabytes where the
+    dense scan needs gigabytes of HBM per batch.
+    """
+    m = codesT.shape[0]
+    N = codesT.shape[1]
+    B = Q.shape[0]
+    lut = _adc_lut(Q, codebooks)
+    if N <= 2 * chunk or kprime > chunk:   # small corpus: one dense tile
+        vals, idx = jax.lax.top_k(_adc_sum(lut, codesT), kprime)
+        return vals, idx.astype(jnp.int32)
+    n_tiles = -(-N // chunk)
+    pad = n_tiles * chunk - N
+    ct = codesT
+    if pad:
+        ct = jnp.concatenate([ct, jnp.zeros((m, pad), ct.dtype)], axis=1)
+    ct = jnp.moveaxis(ct.reshape(m, n_tiles, chunk), 1, 0)  # (T, m, chunk)
+    starts = jnp.arange(n_tiles, dtype=jnp.int32) * chunk
+
+    def tile_step(carry, xs):
+        codes, start = xs
+        s = _adc_sum(lut, codes)                            # (B, chunk)
+        col = start + jnp.arange(chunk, dtype=jnp.int32)
+        s = jnp.where((col < N)[None, :], s, _NEG)          # tail padding
+        v, i = jax.lax.top_k(s, kprime)
+        return carry, (v, (i + start).astype(jnp.int32))
+
+    _, (tv, ti) = jax.lax.scan(tile_step, 0, (ct, starts))
+    tv = jnp.moveaxis(tv, 0, 1).reshape(B, n_tiles * kprime)
+    ti = jnp.moveaxis(ti, 0, 1).reshape(B, n_tiles * kprime)
+    vals, loc = jax.lax.top_k(tv, kprime)
+    return vals, jnp.take_along_axis(ti, loc, axis=1)
+
+
+def rerank_topk(Q, V, shortlist_idx, k: int):
+    """Exact re-rank of a per-row shortlist against float embeddings.
+
+    Gathers only the (B, k′, d) shortlist rows of ``V`` — never the
+    full corpus — scores them exactly, and returns the top-``k``
+    (vals, idx) with ``idx`` mapped back to corpus row indices.
+    """
+    Vs = V[shortlist_idx]                                   # (B, k', d)
+    exact = jnp.einsum("bd,bqd->bq", Q, Vs,
+                       preferred_element_type=jnp.float32,
+                       precision=jax.lax.Precision.HIGHEST)
+    vals, loc = jax.lax.top_k(exact, k)
+    idx = jnp.take_along_axis(shortlist_idx, loc, axis=1)
+    return vals, idx.astype(jnp.int32)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("k", "tile", "n_valid", "interpret"))
 def score_topk(Q, V, k: int, *, tile: int = 512, n_valid: int = 0,

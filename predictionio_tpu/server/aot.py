@@ -234,17 +234,20 @@ def record_device_latency(bucket: int, seconds: float, path: str,
     _DISPATCHES.inc(labels)
 
 
-def device_p50_ms_by_bucket() -> Dict[str, float]:
+def device_p50_ms_by_bucket(path: str = "aot") -> Dict[str, float]:
     """Approximate per-bucket p50 (ms) from the histogram buckets —
     the ``predict_p50_device_ms`` series bench.py / profile_serving.py
     report. Median taken at the first bucket whose cumulative count
-    crosses half the total (upper-bound estimate)."""
+    crosses half the total (upper-bound estimate). ``path`` selects the
+    dispatch path: ``"aot"`` = exact precompiled serving, ``"ann"`` =
+    precompiled ADC-shortlist serving (predictionio_tpu/ann) — bench.py
+    reads both to report the ANN-vs-exact per-bucket story."""
     out: Dict[str, float] = {}
     with DEVICE_LATENCY._lock:
         items = {k: list(c) for k, c in DEVICE_LATENCY._counts.items()}
     for key, counts in items.items():
         total = sum(counts)
-        if not total or key[1] != "aot":
+        if not total or key[1] != path:
             continue
         half, cum = total / 2.0, 0
         p50 = DEVICE_LATENCY.buckets[-1]

@@ -195,6 +195,50 @@ class LocalFSModelStore(ModelStore):
         return d
 
 
+# -- checksummed artifact files (sidecar layout helpers) -----------------------
+#
+# The model artifact is no longer a single blob: per-algorithm
+# directories beside ``model.bin`` carry structured artifacts (Orbax
+# checkpoints, the PQ retrieval index ``ann_index.bin`` —
+# predictionio_tpu/ann). These helpers pin the ONE sidecar discipline
+# for all of them: ``<name>`` + ``<name>.sha256``, blob durably first
+# and digest last, so a crash between the two reads back as REFUSED
+# (mismatch) or unchecksummed (missing sidecar), never silently wrong.
+
+
+def write_artifact(path: str, blob: bytes) -> str:
+    """Write ``blob`` at ``path`` with its ``.sha256`` sidecar; returns
+    the digest hex."""
+    digest = integrity.sha256_hex(blob)
+    atomic_write_bytes(path, blob)
+    atomic_write_bytes(path + integrity.DIGEST_SUFFIX,
+                       digest.encode("ascii"))
+    return digest
+
+
+def read_artifact(path: str, artifact: str,
+                  what: str = "") -> Optional[bytes]:
+    """Read + sidecar-verify an artifact file (None when absent;
+    missing sidecar = legacy/torn write, accepted here and reported as
+    ``unchecksummed`` by ``pio fsck``). Raises
+    :class:`~predictionio_tpu.utils.integrity.IntegrityError` on
+    digest mismatch — loaders turn that into a refused ``/reload``
+    candidate."""
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        blob = f.read()
+    expected = None
+    try:
+        with open(path + integrity.DIGEST_SUFFIX, "r",
+                  encoding="ascii") as f:
+            expected = f.read()
+    except OSError:
+        pass
+    integrity.verify_blob(blob, expected, artifact, what or path)
+    return blob
+
+
 # -- generation-aware model registry ------------------------------------------
 
 

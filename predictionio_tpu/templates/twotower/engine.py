@@ -121,12 +121,24 @@ class TTAlgorithmParams:
     # mid-train checkpoint/resume (Orbax); None disables
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1
+    # -- approximate retrieval (predictionio_tpu/ann, ROADMAP item 3):
+    # ``ann`` turns on PQ index build at train time and ADC-shortlist
+    # serving; exact scoring remains the fallback whenever the index is
+    # absent. engine.json spelling: annM, annK, annIters, annShortlist,
+    # annSample. Sizing guidance: docs/perf.md "Approximate retrieval".
+    ann: bool = False
+    ann_m: int = 8            # subspaces (must divide out_dim)
+    ann_k: int = 256          # centroids per subspace (≤ 256, uint8 codes)
+    ann_iters: int = 8        # Lloyd iterations
+    ann_shortlist: int = 128  # k′ re-rank candidates (recall knob)
+    ann_sample: int = 65536   # codebook training sample bound
 
 
 class TwoTowerModel:
     def __init__(self, user_vars, item_embeds: np.ndarray, user_ids: BiMap,
                  item_ids: BiMap, params: TwoTowerParams,
-                 user_embeds: Optional[np.ndarray] = None) -> None:
+                 user_embeds: Optional[np.ndarray] = None,
+                 ann_index=None, ann_shortlist: int = 128) -> None:
         self.user_vars = user_vars
         self.item_embeds = item_embeds
         self.user_ids = user_ids
@@ -138,20 +150,45 @@ class TwoTowerModel:
         # (r5); load_model recomputes this from user_vars, so it is
         # None only for hand-built models
         self.user_embeds = user_embeds
+        #: optional PQ retrieval index (predictionio_tpu/ann) built at
+        #: train time; when present the device scorer serves
+        #: ADC-shortlist + exact re-rank instead of a full-corpus scan
+        self.ann_index = ann_index
+        self.ann_shortlist = ann_shortlist
         self._scorer = None
 
     def _device_scorer(self):
-        """Lazy shared-policy resident scorer (models/als).
-        Retrieval here IS the ALS serving shape: U @ V.T + top-k."""
+        """Lazy shared-policy device scorer: ANN (ADC shortlist +
+        re-rank) when the model carries a PQ index, else the exact
+        resident scorer (models/als) — both share the AOT-ladder /
+        PAD-masking serving contract, and both defer to the host path
+        on tiny catalogs (`maybe_*_scorer` policy)."""
         if self.user_embeds is None:
             return None
         from predictionio_tpu.models.als import maybe_resident_scorer
 
+        if self.ann_index is not None:
+            from predictionio_tpu.ann import maybe_ann_scorer
+
+            s = maybe_ann_scorer(self.user_embeds, self.item_embeds,
+                                 self.ann_index, self._scorer,
+                                 shortlist=self.ann_shortlist)
+            if s is not None:
+                self._scorer = s
+                return s
+        from predictionio_tpu.ann.scorer import ANNScorer
+
+        cached = (None if isinstance(self._scorer, ANNScorer)
+                  else self._scorer)
         self._scorer = maybe_resident_scorer(
-            self.user_embeds, self.item_embeds, self._scorer)
+            self.user_embeds, self.item_embeds, cached)
         return self._scorer
 
     def recommend(self, user: str, num: int) -> List[Dict[str, Any]]:
+        # unknown user (absent from the training BiMap) → clean empty
+        # result on EVERY path — exact, ANN and host alike — which the
+        # server returns as HTTP 200 {"itemScores": []}, never a
+        # KeyError 500 (cold-start contract; tests/test_ann.py)
         uidx = self.user_ids.get(user)
         if uidx is None:
             return []
@@ -206,8 +243,16 @@ class TwoTowerAlgorithm(Algorithm):
             pair_chunks=(pd.interactions.chunks if pd.stream else None))
         item_embeds = two_tower_embed_items(iv, len(item_ids), tp)
         user_embeds = two_tower_embed_users(uv, len(user_ids), tp)
+        ann_index = None
+        if p.ann:
+            from predictionio_tpu.models.two_tower import two_tower_build_index
+
+            ann_index = two_tower_build_index(
+                item_embeds, m=p.ann_m, k=p.ann_k, iters=p.ann_iters,
+                seed=p.seed, sample=p.ann_sample)
         return TwoTowerModel(uv, item_embeds, user_ids, item_ids, tp,
-                             user_embeds=user_embeds)
+                             user_embeds=user_embeds, ann_index=ann_index,
+                             ann_shortlist=p.ann_shortlist)
 
     def predict(self, model: TwoTowerModel, query: Dict[str, Any]) -> Dict[str, Any]:
         return {"itemScores": model.recommend(str(query["user"]),
@@ -240,25 +285,53 @@ class TwoTowerAlgorithm(Algorithm):
         # user_embeds is NOT persisted: it is derivable from user_vars
         # in one chunked numpy pass (~35 MB saved per ML-20M blob) and
         # recomputing on load also upgrades pre-r5 blobs to the
-        # device-resident serving path
-        return pickle.dumps({
+        # device-resident serving path.
+        # The PQ index rides INSIDE the blob as its self-verifying
+        # PIOANN01 wire bytes (memory-backed model stores have no
+        # directory) and, when the store has a real directory, ALSO as
+        # ann_index.bin + .sha256 + manifest beside model.bin — that is
+        # what `pio fsck` audits and `pio index status` reads jax-free.
+        d = {
             "user_vars": model.user_vars,
             "item_embeds": model.item_embeds,
             "user_ids": model.user_ids.to_dict(),
             "item_ids": model.item_ids.to_dict(),
             "params": model.params,
-        })
+            "ann_shortlist": model.ann_shortlist,
+        }
+        if model.ann_index is not None:
+            from predictionio_tpu import ann
+
+            d["ann_index"] = model.ann_index.to_bytes()
+            if instance_dir:
+                ann.save_index(model.ann_index, instance_dir)
+        return pickle.dumps(d)
 
     def load_model(self, blob: Optional[bytes], instance_dir: Optional[str]) -> TwoTowerModel:
         assert blob is not None
         d = pickle.loads(blob)
         user_ids = BiMap(d["user_ids"])
+        # index integrity is verified on EVERY load (header payload
+        # sha256, plus the file sidecar when directory-backed); an
+        # IntegrityError here propagates to prepare_deploy → /reload
+        # refuses the candidate and the champion keeps serving
+        ann_index = None
+        if instance_dir:
+            from predictionio_tpu import ann
+
+            ann_index = ann.load_index(instance_dir)
+        if ann_index is None and d.get("ann_index") is not None:
+            from predictionio_tpu.ann import PQIndex
+
+            ann_index = PQIndex.from_bytes(d["ann_index"])
         return TwoTowerModel(d["user_vars"], d["item_embeds"],
                              user_ids, BiMap(d["item_ids"]),
                              d["params"],
                              user_embeds=two_tower_embed_users(
                                  d["user_vars"], len(user_ids),
-                                 d["params"]))
+                                 d["params"]),
+                             ann_index=ann_index,
+                             ann_shortlist=d.get("ann_shortlist", 128))
 
 
 def engine_factory() -> Engine:
@@ -307,3 +380,33 @@ class DefaultGrid(EngineParamsGenerator):
             algorithms_params=[("twotower", TTAlgorithmParams(
                 embed_dim=d, out_dim=d, hidden=[2 * d], batch_size=256,
                 epochs=30))]) for d in (16, 32)]
+
+
+class ANNGrid(EngineParamsGenerator):
+    """Exact-vs-ANN candidates under the same Recall@10 metric — the
+    `pio eval` leg of the PQ recall/latency trade-off: the exact
+    candidate is the recall ceiling, the ANN candidates show what each
+    (m, shortlist) point costs in held-out retrieval quality.
+
+        pio eval ... tt.TTEvaluation tt.ANNGrid
+
+    App name via $PIO_EVAL_APP_NAME; shortlist points via
+    $PIO_EVAL_ANN_SHORTLISTS (comma-separated, default "64,128")."""
+
+    @property
+    def engine_params_list(self):
+        import os
+
+        app = os.environ.get("PIO_EVAL_APP_NAME", "MyApp1")
+        shortlists = [
+            int(s) for s in os.environ.get(
+                "PIO_EVAL_ANN_SHORTLISTS", "64,128").split(",") if s]
+        base = dict(embed_dim=32, out_dim=32, hidden=[64], batch_size=256,
+                    epochs=30)
+        cands = [TTAlgorithmParams(**base)]          # exact ceiling
+        cands += [TTAlgorithmParams(**base, ann=True, ann_m=8,
+                                    ann_shortlist=sl)
+                  for sl in shortlists]
+        return [EngineParams(
+            data_source_params=DataSourceParams(app_name=app),
+            algorithms_params=[("twotower", c)]) for c in cands]

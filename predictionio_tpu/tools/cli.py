@@ -388,6 +388,110 @@ def cmd_models(args: argparse.Namespace) -> None:
               "--rolling`) to swap serving onto it.")
 
 
+def _human_bytes(n: Optional[int]) -> str:
+    if n is None:
+        return "?"
+    v = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if v < 1024 or unit == "TiB":
+            return f"{v:.1f} {unit}" if unit != "B" else f"{int(v)} B"
+        v /= 1024
+    raise AssertionError
+
+
+def cmd_index(args: argparse.Namespace) -> None:
+    """ANN retrieval-index status for the deployed (latest COMPLETED)
+    engine instance: geometry, sizes, HBM estimate, build time, digest
+    verdict. Reads only the on-disk artifact manifest + sidecar
+    (jax-free — this verb must work on an ops box with no accelerator
+    stack), so a memory-backed model store has nothing to show."""
+    import hashlib
+    from datetime import datetime, timezone
+
+    from predictionio_tpu.utils.integrity import DIGEST_SUFFIX
+
+    st = get_storage()
+    iid = args.engine_instance_id
+    if not iid:
+        latest = next((ei for ei in st.meta.list_engine_instances()
+                       if ei.status == "COMPLETED"), None)
+        if latest is None:
+            _die("no COMPLETED engine instance found "
+                 "(train one, or pass --engine-instance-id)")
+        iid = latest.id
+    instance_dir = st.models.model_dir(iid)
+    if instance_dir is None:
+        _die(f"model store {type(st.models).__name__} has no filesystem "
+             "directory — ANN index manifests live beside model.bin "
+             "(LOCALFS)")
+    found = []
+    for algo in sorted(os.listdir(instance_dir)):
+        algo_dir = os.path.join(instance_dir, algo)
+        man_path = os.path.join(algo_dir, "ann_index.json")
+        if not os.path.isfile(man_path):
+            continue
+        try:
+            with open(man_path, "r", encoding="utf-8") as f:
+                man = json.load(f)
+        except (OSError, ValueError) as e:
+            found.append({"algorithm": algo, "digest_status": "corrupt",
+                          "detail": f"unreadable manifest: {e}"})
+            continue
+        blob_path = os.path.join(algo_dir, "ann_index.bin")
+        digest_status = "missing-blob"
+        if os.path.exists(blob_path):
+            with open(blob_path, "rb") as f:
+                actual = hashlib.sha256(f.read()).hexdigest()
+            side = None
+            try:
+                with open(blob_path + DIGEST_SUFFIX, "r",
+                          encoding="ascii") as f:
+                    side = f.read().strip()
+            except OSError:
+                pass
+            if actual == man.get("sha256") and (side is None
+                                                or side == actual):
+                digest_status = ("verified" if side is not None
+                                 else "unchecksummed")
+            else:
+                digest_status = "MISMATCH"
+        found.append({"algorithm": algo, "digest_status": digest_status,
+                      **{k: man.get(k) for k in (
+                          "m", "k", "dsub", "dim", "n_items", "code_bytes",
+                          "codebook_bytes", "hbm_estimate_bytes",
+                          "build_sec", "built_unix", "sha256")}})
+    doc = {"engineInstanceId": iid, "instanceDir": instance_dir,
+           "indexes": found}
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return
+    print(f"[index] engine instance {iid}")
+    if not found:
+        print("[index] no ANN index artifacts (exact retrieval; enable "
+              "with \"ann\": true in engine.json algorithm params)")
+        return
+    for ix in found:
+        print(f"[index] algorithm {ix['algorithm']!r}: "
+              f"status={ix['digest_status']}")
+        if ix.get("detail"):
+            print(f"        {ix['detail']}")
+            continue
+        if ix.get("m") is None:
+            continue
+        print(f"        geometry   M={ix['m']} K={ix['k']} "
+              f"dsub={ix['dsub']} (dim {ix['dim']})")
+        print(f"        corpus     {ix['n_items']:,} items, "
+              f"codes {_human_bytes(ix['code_bytes'])}, "
+              f"codebooks {_human_bytes(ix['codebook_bytes'])}")
+        print(f"        HBM est.   {_human_bytes(ix['hbm_estimate_bytes'])} "
+              "(codes + codebooks + re-rank floats)")
+        built = ix.get("built_unix")
+        when = (datetime.fromtimestamp(built, timezone.utc)
+                .strftime("%Y-%m-%d %H:%M:%SZ") if built else "?")
+        print(f"        built      {when} in {ix.get('build_sec', '?')}s, "
+              f"sha256 {str(ix.get('sha256'))[:12]}…")
+
+
 def cmd_eval(args: argparse.Namespace) -> None:
     from predictionio_tpu.controller.evaluation import Evaluation, EngineParamsGenerator
     from predictionio_tpu.core.workflow import run_evaluation
@@ -1064,7 +1168,7 @@ def build_parser() -> argparse.ArgumentParser:
     fs = sub.add_parser(
         "fsck",
         help="verify integrity of eventlog segments, snapshot cache, "
-             "model blobs, and the model registry "
+             "model blobs, ANN index blobs, and the model registry "
              "(exit 0 clean / 2 corrupt / 3 repaired)")
     fs.add_argument("--home", help="storage home to scan "
                                    "(default: PIO_HOME / ~/.pio_store)")
@@ -1097,6 +1201,24 @@ def build_parser() -> argparse.ArgumentParser:
                        help="demote the champion and restore the most "
                             "recently promoted retired generation")
     md.set_defaults(fn=cmd_models)
+
+    ix = sub.add_parser(
+        "index",
+        help="ANN retrieval index: geometry (M, K, corpus size, code "
+             "bytes, HBM estimate), build time, and digest status of "
+             "the deployed model's PQ index — reads the artifact "
+             "manifest only, jax-free (docs/perf.md \"Approximate "
+             "retrieval\")")
+    ixs = ix.add_subparsers(dest="index_cmd", required=True)
+    x = ixs.add_parser("status",
+                       help="inspect the latest COMPLETED instance's "
+                            "ann_index.json manifests")
+    x.add_argument("--engine-instance-id",
+                   help="inspect this instance instead of the latest "
+                        "COMPLETED one")
+    x.add_argument("--json", action="store_true",
+                   help="emit the full report as one JSON document")
+    ix.set_defaults(fn=cmd_index)
 
     sg = sub.add_parser(
         "segments",

@@ -65,6 +65,10 @@ Known sites (grep ``faults.inject`` for the authoritative list):
 ``data.corrupt.snapshot``  byte-flip on snapshot npz load
 ``data.corrupt.model``     byte-flip on model-blob load/download
 ``data.corrupt.segment``   byte-flip on cold-tier segment fetch
+``ann.index.corrupt``   byte-flip on ANN retrieval-index load
+                        (``PQIndex.from_bytes`` — covers the
+                        ``ann_index.bin`` file and blob-embedded
+                        indexes; ``/reload`` must refuse, fsck exit ≥ 2)
 ======================  ===================================================
 """
 

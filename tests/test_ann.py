@@ -1,0 +1,366 @@
+"""ANN retrieval subsystem (predictionio_tpu/ann): PQ codec round-trip,
+ADC serving parity vs the exact path, AOT zero-compile contract, index
+blob integrity (the ``ann.index.corrupt`` drill: ``pio fsck`` detects,
+``/reload`` refuses, champion keeps serving), and the unknown-user
+contract on the ANN path."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from predictionio_tpu import ann
+from predictionio_tpu.ann import pq
+from predictionio_tpu.ann.index import PQIndex
+from predictionio_tpu.utils import faults
+from predictionio_tpu.utils.faults import FAULTS
+from predictionio_tpu.utils.integrity import IntegrityError
+
+TT_FACTORY = "predictionio_tpu.templates.twotower.engine:engine_factory"
+
+
+@pytest.fixture(autouse=True)
+def disarm_faults():
+    FAULTS.disarm()
+    yield
+    FAULTS.disarm()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _restore_aot_counters():
+    """pio_aot_cache_lookups_total / pio_predict_dispatch_total are
+    process-global; later test files assert absolute values on them, so
+    this module's warmup compiles must not leak out."""
+    from predictionio_tpu.server import aot as aot_mod
+
+    counters = (aot_mod.EXECUTABLES._m_lookups, aot_mod._DISPATCHES)
+    snaps = [dict(c._values) for c in counters]
+    yield
+    for c, snap in zip(counters, snaps):
+        with c._lock:
+            c._values.clear()
+            c._values.update(snap)
+
+
+def _clustered(n, d, centers, seed=0, noise=0.2):
+    """Unit-norm corpus with cluster structure — recall@k against the
+    exact scan is only meaningful when neighborhoods exist."""
+    rng = np.random.default_rng(seed)
+    C = rng.standard_normal((centers, d)).astype(np.float32)
+    V = (C[rng.integers(0, centers, size=n)]
+         + noise * rng.standard_normal((n, d)).astype(np.float32))
+    V /= np.linalg.norm(V, axis=1, keepdims=True) + 1e-9
+    return V
+
+
+# -- PQ codec ------------------------------------------------------------------
+
+
+class TestPQCodec:
+    def test_encode_decode_roundtrip_bounds(self):
+        V = _clustered(1500, 16, 24, seed=1)
+        cb = pq.train_codebooks(V, 4, 32, iters=6, sample=1500)
+        assert cb.shape == (4, 32, 4) and cb.dtype == np.float32
+        codes = pq.encode(V, cb)
+        assert codes.shape == (1500, 4) and codes.dtype == np.uint8
+        rec = pq.decode(codes, cb)
+        assert rec.shape == V.shape
+        mse = pq.reconstruction_mse(V, cb, codes)
+        # quantizing must beat the zero-codebook baseline (= mean ‖v‖²/d)
+        assert mse < float(np.mean(V * V))
+        # and the chunked encode is the true argmin assignment: no
+        # other centroid combination reconstructs any row better
+        err = V - rec
+        assert float(np.mean(np.sum(err * err, axis=1))) < 1.0  # unit rows
+
+    def test_geometry_validation(self):
+        V = np.zeros((10, 15), np.float32)
+        with pytest.raises(ValueError, match="split evenly"):
+            pq.train_codebooks(V, 4, 16, sample=10)
+        with pytest.raises(ValueError, match="out of range"):
+            pq.train_codebooks(np.zeros((10, 16), np.float32), 4, 300,
+                               sample=10)
+
+    def test_tiny_corpus_fewer_rows_than_centroids(self):
+        V = _clustered(12, 8, 3, seed=2)
+        idx = ann.build_index(V, 2, 16, iters=2, sample=12)
+        assert idx.codes.shape == (12, 2)
+        assert np.isfinite(idx.codebooks).all()
+
+
+# -- wire format + integrity ---------------------------------------------------
+
+
+class TestIndexBlob:
+    def test_blob_roundtrip_and_manifest(self, tmp_path):
+        V = _clustered(600, 16, 12, seed=3)
+        idx = ann.build_index(V, 4, 16, iters=3, sample=600)
+        back = PQIndex.from_bytes(idx.to_bytes())
+        np.testing.assert_array_equal(back.codes, idx.codes)
+        np.testing.assert_array_equal(back.codebooks, idx.codebooks)
+        assert back.meta["build_sec"] == idx.meta["build_sec"]
+
+        d = str(tmp_path)
+        ann.save_index(idx, d)
+        loaded = ann.load_index(d)
+        np.testing.assert_array_equal(loaded.codes, idx.codes)
+        with open(os.path.join(d, ann.MANIFEST_BASENAME)) as f:
+            man = json.load(f)
+        assert man["m"] == 4 and man["k"] == 16 and man["n_items"] == 600
+        assert man["code_bytes"] == idx.code_bytes()
+        assert man["hbm_estimate_bytes"] == idx.hbm_estimate_bytes()
+        assert len(man["sha256"]) == 64
+        assert ann.load_index(str(tmp_path / "nope")) is None
+
+    def test_corrupt_blob_is_refused_then_loads_when_disarmed(
+            self, tmp_path):
+        V = _clustered(300, 8, 6, seed=4)
+        ann.save_index(ann.build_index(V, 2, 8, iters=2, sample=300),
+                       str(tmp_path))
+        FAULTS.arm("ann.index.corrupt")
+        with pytest.raises(IntegrityError):
+            ann.load_index(str(tmp_path))
+        FAULTS.disarm()
+        assert ann.load_index(str(tmp_path)) is not None
+
+    def test_structural_damage_raises_integrity_error(self):
+        with pytest.raises(IntegrityError, match="corrupt"):
+            PQIndex.from_bytes(b"NOTANANN" + b"\x00" * 64)
+        V = _clustered(100, 8, 4, seed=5)
+        blob = bytearray(ann.build_index(V, 2, 8, iters=2,
+                                         sample=100).to_bytes())
+        blob[len(blob) // 2] ^= 0xFF   # payload damage → digest mismatch
+        with pytest.raises(IntegrityError):
+            PQIndex.from_bytes(bytes(blob))
+
+    def test_fsck_detects_corrupt_index_file(self, tmp_path, monkeypatch,
+                                             capsys):
+        from predictionio_tpu.data.pel_integrity import fsck_home
+        from predictionio_tpu.tools.cli import main as cli_main
+
+        monkeypatch.delenv("PIO_SCAN_CACHE_DIR", raising=False)
+        home = tmp_path / "home"
+        algo_dir = home / "models" / "inst1" / "twotower"
+        algo_dir.mkdir(parents=True)
+        V = _clustered(200, 8, 4, seed=6)
+        ann.save_index(ann.build_index(V, 2, 8, iters=2, sample=200),
+                       str(algo_dir))
+
+        rep = fsck_home(str(home))
+        assert rep["corrupt"] == 0
+
+        blob_path = algo_dir / ann.INDEX_BASENAME
+        raw = bytearray(blob_path.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF
+        blob_path.write_bytes(bytes(raw))
+        rep = fsck_home(str(home))
+        assert rep["corrupt"] == 1
+        bad = [r for r in rep["artifacts"] if r["status"] == "corrupt"]
+        assert bad and bad[0]["artifact"] == "ann_index"
+
+        try:
+            cli_main(["fsck", "--home", str(home), "--json"])
+            code = 0
+        except SystemExit as e:
+            code = int(e.code or 0)
+        assert code == 2
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["corrupt"] == 1
+
+    def test_fsck_detects_corruption_via_fault_site(self, tmp_path,
+                                                    monkeypatch):
+        from predictionio_tpu.data.pel_integrity import fsck_home
+
+        monkeypatch.delenv("PIO_SCAN_CACHE_DIR", raising=False)
+        home = tmp_path / "home"
+        algo_dir = home / "models" / "inst1" / "twotower"
+        algo_dir.mkdir(parents=True)
+        V = _clustered(200, 8, 4, seed=7)
+        ann.save_index(ann.build_index(V, 2, 8, iters=2, sample=200),
+                       str(algo_dir))
+        assert fsck_home(str(home))["corrupt"] == 0
+        faults.FAULTS.arm("ann.index.corrupt")
+        assert fsck_home(str(home))["corrupt"] == 1
+
+
+# -- ADC serving parity --------------------------------------------------------
+
+
+class TestANNServing:
+    def _fixture(self, n=3000, d=16, shortlist=256, seed=8, centers=40):
+        V = _clustered(n, d, centers, seed=seed)
+        rng = np.random.default_rng(seed + 1)
+        U = (V[rng.integers(0, n, size=64)]
+             + 0.1 * rng.standard_normal((64, d)).astype(np.float32))
+        U /= np.linalg.norm(U, axis=1, keepdims=True) + 1e-9
+        idx = ann.build_index(V, 4, 64, iters=5, sample=n)
+        return U, V, ann.ANNScorer(U, V, idx, shortlist=shortlist)
+
+    def test_recall_at_10_vs_exact(self):
+        U, V, scorer = self._fixture()
+        exact_top = np.argsort(-(U @ V.T), axis=1)[:, :10]
+        got = scorer.recommend_batch(np.arange(len(U), dtype=np.int32), 10)
+        hits = sum(np.intersect1d(iv, et).size
+                   for (iv, _), et in zip(got, exact_top))
+        assert hits / (len(U) * 10) >= 0.95
+
+    def test_pad_row_masking_parity_across_buckets(self):
+        from predictionio_tpu.server.aot import BucketLadder
+
+        U, V, scorer = self._fixture(n=2500)
+        ladder = BucketLadder([2, 4, 8])
+        scorer.warm_buckets(ladder, ks=(10,))
+        singles = {u: scorer.recommend(u, 10) for u in range(8)}
+        for B in (1, 2, 3, 5, 7, 8):   # every bucket, padded and full
+            got = scorer.recommend_batch(np.arange(B, dtype=np.int32), 10)
+            for u, (iv, vv) in enumerate(got):
+                np.testing.assert_array_equal(iv, singles[u][0])
+                np.testing.assert_allclose(vv, singles[u][1], rtol=1e-5)
+
+    def test_zero_compiles_after_warmup_sweep(self):
+        from predictionio_tpu.server import aot as aot_mod
+        from predictionio_tpu.server.aot import BucketLadder
+
+        def jit_gaps():
+            return sum(v for k, v in aot_mod._DISPATCHES._values.items()
+                       if k[1] == "jit")
+
+        U, V, scorer = self._fixture(n=2200)
+        ladder = BucketLadder([2, 4, 8])
+        warm = scorer.warm_buckets(ladder, ks=(10,))
+        assert warm["targets"] == 3
+        compiles0 = aot_mod.EXECUTABLES.counts().get("compile", 0)
+        gaps0 = jit_gaps()
+        for B in (1, 2, 3, 4, 6, 8):
+            scorer.recommend_batch(np.arange(B, dtype=np.int32), 10)
+        assert aot_mod.EXECUTABLES.counts().get("compile", 0) == compiles0
+        assert jit_gaps() == gaps0
+
+    def test_exclusion_filtering(self):
+        U, V, scorer = self._fixture(n=2100)
+        [(iv, _)] = scorer.recommend_batch(np.asarray([0]), 5)
+        [(iv2, _)] = scorer.recommend_batch(
+            np.asarray([0]), 5, exclude=[iv[:2]])
+        assert not np.intersect1d(iv2, iv[:2]).size
+
+    @pytest.mark.slow
+    def test_big_corpus_recall_and_streamed_shortlist(self):
+        """200k items exercises the streamed (scan-tiled) ADC shortlist
+        path (> 2 tiles at the 32768-column chunk)."""
+        U, V, scorer = self._fixture(n=200_000, d=16, shortlist=512,
+                                     seed=9, centers=1600)
+        exact_top = np.argsort(-(U @ V.T), axis=1)[:, :10]
+        got = scorer.recommend_batch(np.arange(len(U), dtype=np.int32), 10)
+        hits = sum(np.intersect1d(iv, et).size
+                   for (iv, _), et in zip(got, exact_top))
+        assert hits / (len(U) * 10) >= 0.9
+
+
+# -- template integration: train → deploy → query → reload ---------------------
+
+
+def _tt_variant(ann_on: bool):
+    algo = {"embedDim": 16, "outDim": 16, "hidden": [32], "epochs": 3,
+            "batchSize": 128}
+    if ann_on:
+        algo.update({"ann": True, "annM": 4, "annK": 16, "annIters": 2,
+                     "annShortlist": 16, "annSample": 512})
+    return {
+        "engineFactory": TT_FACTORY,
+        "datasource": {"params": {"appName": "ANNApp"}},
+        "algorithms": [{"name": "twotower", "params": algo}],
+    }
+
+
+def _seed_tt_events(storage, n_users=20, n_items=16):
+    from predictionio_tpu.data.event import Event
+
+    app = storage.meta.create_app("ANNApp")
+    storage.events.init_channel(app.id)
+    rng = np.random.default_rng(11)
+    evs = [Event(event="view", entity_type="user",
+                 entity_id=f"u{int(u)}", target_entity_type="item",
+                 target_entity_id=f"i{int(i)}")
+           for u, i in zip(rng.integers(0, n_users, 400),
+                           rng.integers(0, n_items, 400))]
+    storage.events.insert_batch(evs, app.id)
+    return app
+
+
+class TestTemplateANN:
+    def test_train_deploy_query_and_unknown_user(self, storage,
+                                                 monkeypatch):
+        from predictionio_tpu.ann.scorer import ANNScorer
+        from predictionio_tpu.core.workflow import prepare_deploy, run_train
+
+        monkeypatch.setenv("PIO_ALS_SERVE", "device")
+        _seed_tt_events(storage)
+        run_train(TT_FACTORY, variant=_tt_variant(True), storage=storage,
+                  use_mesh=False)
+        deployed = prepare_deploy(engine_factory=TT_FACTORY,
+                                  storage=storage)
+        model = deployed.models[0]
+        assert model.ann_index is not None
+        assert isinstance(model._device_scorer(), ANNScorer)
+        res = deployed.query({"user": "u1", "num": 5})
+        assert len(res["itemScores"]) == 5
+        # unknown user → HTTP-level empty result, never a 500 (same
+        # contract as the exact path)
+        assert deployed.query({"user": "nobody", "num": 3}) == \
+            {"itemScores": []}
+
+    def test_ann_results_match_exact_rerank_scores(self, storage,
+                                                   monkeypatch):
+        """With k′ = catalog size the shortlist covers everything, so
+        the ANN path's re-ranked answer must equal the exact path's."""
+        from predictionio_tpu.core.workflow import prepare_deploy, run_train
+
+        _seed_tt_events(storage)
+        run_train(TT_FACTORY, variant=_tt_variant(True), storage=storage,
+                  use_mesh=False)
+        monkeypatch.setenv("PIO_ALS_SERVE", "host")
+        host = prepare_deploy(engine_factory=TT_FACTORY, storage=storage)
+        host_res = host.query({"user": "u2", "num": 5})
+        monkeypatch.setenv("PIO_ALS_SERVE", "device")
+        dev = prepare_deploy(engine_factory=TT_FACTORY, storage=storage)
+        dev_res = dev.query({"user": "u2", "num": 5})
+        assert [s["item"] for s in dev_res["itemScores"]] == \
+            [s["item"] for s in host_res["itemScores"]]
+        np.testing.assert_allclose(
+            [s["score"] for s in dev_res["itemScores"]],
+            [s["score"] for s in host_res["itemScores"]], rtol=1e-4)
+
+    def test_reload_refuses_corrupt_index_champion_keeps_serving(
+            self, storage, monkeypatch):
+        from predictionio_tpu.core.workflow import run_train
+        from predictionio_tpu.server.engine_server import EngineServer
+        from tests.test_servers import ServerThread, free_port, http
+
+        monkeypatch.setenv("PIO_ALS_SERVE", "device")
+        _seed_tt_events(storage)
+        first = run_train(TT_FACTORY, variant=_tt_variant(True),
+                          storage=storage, use_mesh=False)
+        port = free_port()
+        server = EngineServer(engine_factory=TT_FACTORY, storage=storage,
+                              host="127.0.0.1", port=port)
+        with ServerThread(server):
+            base = f"http://127.0.0.1:{port}"
+            assert http("POST", f"{base}/queries.json",
+                        {"user": "u1", "num": 3})[0] == 200
+            run_train(TT_FACTORY, variant=_tt_variant(True),
+                      storage=storage, use_mesh=False)
+            # candidate's index blob is corrupt: /reload must refuse it
+            # (prepare_deploy raises IntegrityError) and keep serving
+            # the champion
+            FAULTS.arm("ann.index.corrupt")
+            code, body = http("GET", f"{base}/reload")
+            assert code == 500
+            assert body["swap"] == "refused"
+            assert http("GET", f"{base}/")[1]["engineInstanceId"] == first
+            assert http("POST", f"{base}/queries.json",
+                        {"user": "u1", "num": 3})[0] == 200
+            # drill over: the same candidate now promotes
+            FAULTS.disarm()
+            code, body = http("GET", f"{base}/reload")
+            assert code == 200 and body["engineInstanceId"] != first

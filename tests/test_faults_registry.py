@@ -100,6 +100,12 @@ class TestFaultSiteAudit:
         assert {"train.crash", "train.lease.lost",
                 "promote.regression"} <= table_sites()
 
+    def test_ann_index_site_is_registered(self):
+        """The ANN retrieval-index drill site must stay in the table:
+        ``pio fsck`` detection and the ``/reload``-refusal drill
+        (docs/operations.md) arm it by name."""
+        assert "ann.index.corrupt" in table_sites()
+
     def test_every_site_is_armable_via_pio_faults_spec(self):
         sites = table_sites()
         spec = ";".join(f"{s}:error=drill" for s in sorted(sites))
