@@ -305,6 +305,34 @@ def main() -> None:
     ann_gaps = _jit_dispatches() - gaps_before
     ann_p50_by_bucket = aot_mod.device_p50_ms_by_bucket(path="ann")
 
+    # Variant multiplexing flywheel (server/variants): two same-geometry
+    # variants resident at once must share every executable. Preview the
+    # 90/10 dispatch share with the exact assignment hash serving uses,
+    # warm a challenger scorer (must be pure executable-cache hits),
+    # and report each variant's single-query device-path p50.
+    from predictionio_tpu.server.variants import weighted_assign
+
+    arms = [("champion", 9.0), ("challenger", 1.0)]
+    dispatch = {"champion": 0, "challenger": 0}
+    for i in range(n_queries):
+        dispatch[weighted_assign(str(i), arms)] += 1
+    chal_scorer = ResidentScorer(U * 0.999, V)  # same geometry, new weights
+    ex_before = aot_mod.EXECUTABLES.counts().get("compile", 0)
+    chal_scorer.warm_buckets(ladder, ks=(10,))
+    variant_warm_compiles = (aot_mod.EXECUTABLES.counts().get("compile", 0)
+                             - ex_before)
+    variant_p50 = {}
+    m = 500 if args.quick else 2_000
+    for vname, vscorer in (("champion", scorer), ("challenger", chal_scorer)):
+        for u in qusers[:50]:
+            vscorer.recommend_batch(np.asarray([u]), 10)
+        vlat = np.empty(m)
+        for i, u in enumerate(qusers[50:50 + m]):
+            q0 = time.perf_counter()
+            vscorer.recommend_batch(np.asarray([u]), 10)
+            vlat[i] = time.perf_counter() - q0
+        variant_p50[vname] = round(float(np.percentile(vlat, 50) * 1e3), 3)
+
     baseline = None
     if os.path.exists(BASELINE_FILE):
         try:
@@ -360,6 +388,15 @@ def main() -> None:
             "ann_p50_device_ms_by_bucket": ann_p50_by_bucket,
             "ann_serving_jit_fallbacks": int(ann_gaps),
             "ann_index_build_sec": ann_index.meta.get("build_sec"),
+            # variant multiplexing: the 90/10 dispatch share the sticky
+            # hash actually produces over n_queries distinct entities,
+            # each resident variant's device-path p50, and the compile
+            # cost of making the second variant resident (must be 0 —
+            # same geometry ⇒ pure executable-cache adoption)
+            "variant_dispatch_share": {
+                k: round(v / n_queries, 4) for k, v in dispatch.items()},
+            "variant_device_p50_ms": variant_p50,
+            "variant_warm_extra_compiles": int(variant_warm_compiles),
             "predict_queries": n_queries,
             # On this image's tunneled ("axon") chip, every device→host
             # fetch costs a ~66ms round trip, so the end-to-end p50 is

@@ -31,7 +31,8 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
-from typing import Any, Callable, List, Optional, Sequence
+import inspect
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from predictionio_tpu.server.aot import PAD, BucketLadder
 from predictionio_tpu.utils.metrics import REGISTRY
@@ -59,6 +60,14 @@ class MicroBatcher:
     dispatch, so the device program always runs at a shape the AOT warmup
     already compiled — zero hot-path XLA compiles. The pad slots are
     sliced off before results fan back out to callers.
+
+    With multi-model serving (server/variants.py) each submit carries a
+    ``group`` — the serving variant — and one collect dispatches ONE
+    padded batch PER GROUP: a padded batch never mixes two variants'
+    weights. A group may register its own ladder
+    (:meth:`set_group_ladder`); ``stop()`` drops that per-group ladder
+    state along with the worker, so a stop/serve-again cycle can never
+    dispatch against a stale ladder from the previous variant set.
     """
 
     def __init__(self, fn_batch: Callable[[Sequence[Any]], List[Any]],
@@ -67,9 +76,17 @@ class MicroBatcher:
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.fn_batch = fn_batch
+        # a batch fn may take (queries) or (queries, group); detect once
+        # so single-model servers (and their tests) are untouched
+        try:
+            self._fn_takes_group = (
+                len(inspect.signature(fn_batch).parameters) >= 2)
+        except (TypeError, ValueError):
+            self._fn_takes_group = False
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1e3
         self.ladder = ladder
+        self._group_ladders: Dict[Any, BucketLadder] = {}
         self._queue: asyncio.Queue = asyncio.Queue()
         self._worker: Optional[asyncio.Task] = None
         self._executor: Optional[
@@ -94,35 +111,51 @@ class MicroBatcher:
         if self._worker is None or self._worker.done():
             self._worker = asyncio.get_running_loop().create_task(self._run())
 
-    async def submit(self, query: Any) -> Any:
-        """Enqueue one query; resolves to its prediction (or raises)."""
+    def set_group_ladder(self, group: Any,
+                         ladder: Optional[BucketLadder]) -> None:
+        """Attach (or with ``None``, detach) a per-group bucket ladder —
+        one serving variant's padded-shape set."""
+        if ladder is None:
+            self._group_ladders.pop(group, None)
+        else:
+            self._group_ladders[group] = ladder
+
+    async def submit(self, query: Any, group: Any = None) -> Any:
+        """Enqueue one query; resolves to its prediction (or raises).
+        ``group`` keys the dispatch batch (the serving variant): queries
+        from different groups never share a padded batch."""
         self._ensure_worker()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self.submitted += 1
         _SUBMITTED.inc()
-        await self._queue.put((query, fut))
+        await self._queue.put((query, fut, group))
         return await fut
 
-    def _pad_to_bucket(self, queries: List[Any]) -> List[Any]:
+    def _pad_to_bucket(self, queries: List[Any],
+                       group: Any = None) -> List[Any]:
         """Snap the batch up to the nearest ladder bucket with PAD
         sentinels (no-op without a ladder, or when the batch already
         sits on a bucket)."""
-        if self.ladder is None:
+        ladder = self._group_ladders.get(group, self.ladder)
+        if ladder is None:
             return queries
-        bucket = self.ladder.snap(len(queries))
+        bucket = ladder.snap(len(queries))
         if bucket <= len(queries):  # snap() floors at the top bucket
             return queries
         return queries + [PAD] * (bucket - len(queries))
 
-    def _dispatch(self, queries: List[Any]) -> List[Any]:
+    def _dispatch(self, queries: List[Any], group: Any = None) -> List[Any]:
         """Synchronous dispatch (runs on the batcher executor): pad to
         the bucket, call the batch fn, arity-check at the PADDED length,
         slice the pad slots back off."""
         n = len(queries)
-        padded = self._pad_to_bucket(queries)
+        padded = self._pad_to_bucket(queries, group)
         _BATCH_SIZE.observe(n)
         _BUCKET_DISPATCH.inc(labels=(str(len(padded)),))
-        results = self.fn_batch(padded)
+        if self._fn_takes_group:
+            results = self.fn_batch(padded, group)
+        else:
+            results = self.fn_batch(padded)
         if len(results) != len(padded):
             raise RuntimeError(
                 f"batch fn returned {len(results)} results for "
@@ -160,56 +193,69 @@ class MicroBatcher:
 
     async def _run(self) -> None:
         while True:
-            items = await self._collect()
-            queries = [q for q, _ in items]
-            self.batches += 1
-            _BATCHES.inc()
-            loop = asyncio.get_running_loop()
-            try:
-                results = await loop.run_in_executor(
-                    self._get_executor(), self._dispatch, queries)
-            except Exception as e:
-                if len(items) == 1:
-                    if not items[0][1].done():
-                        items[0][1].set_exception(e)
+            collected = await self._collect()
+            # split per group, arrival order preserved within each: a
+            # padded batch must never mix two variants' weights
+            grouped: Dict[Any, List[tuple]] = {}
+            for item in collected:
+                grouped.setdefault(item[2], []).append(item)
+            for group, items in grouped.items():
+                await self._run_group(group, items)
+
+    async def _run_group(self, group: Any, items: List[tuple]) -> None:
+        queries = [q for q, _, _ in items]
+        self.batches += 1
+        _BATCHES.inc()
+        loop = asyncio.get_running_loop()
+        try:
+            results = await loop.run_in_executor(
+                self._get_executor(), self._dispatch, queries, group)
+        except Exception as e:
+            if len(items) == 1:
+                if not items[0][1].done():
+                    items[0][1].set_exception(e)
+                return
+            # One bad query must not poison its batch siblings — and
+            # each caller must see their OWN error (a sibling getting
+            # the offender's ValueError would read as 400 for a fine
+            # query). Isolate by re-running every query alone.
+            self.isolations += 1
+            _ISOLATIONS.inc()
+            for q, fut, _ in items:
+                if fut.done():  # caller gone — don't burn a dispatch
                     continue
-                # One bad query must not poison its batch siblings — and
-                # each caller must see their OWN error (a sibling getting
-                # the offender's ValueError would read as 400 for a fine
-                # query). Isolate by re-running every query alone.
-                self.isolations += 1
-                _ISOLATIONS.inc()
-                for q, fut in items:
-                    if fut.done():  # caller gone — don't burn a dispatch
-                        continue
-                    try:
-                        r = await loop.run_in_executor(
-                            self._get_executor(), self._dispatch, [q])
-                    except Exception as single_e:
-                        if not fut.done():
-                            fut.set_exception(single_e)
-                    else:
-                        if not fut.done():
-                            fut.set_result(r[0])
-                continue
-            for (_, fut), r in zip(items, results):
-                if not fut.done():
-                    fut.set_result(r)
+                try:
+                    r = await loop.run_in_executor(
+                        self._get_executor(), self._dispatch, [q], group)
+                except Exception as single_e:
+                    if not fut.done():
+                        fut.set_exception(single_e)
+                else:
+                    if not fut.done():
+                        fut.set_result(r[0])
+            return
+        for (_, fut, _), r in zip(items, results):
+            if not fut.done():
+                fut.set_result(r)
 
     def stop(self) -> None:
         """Cancel the collector and release the executor. The batcher
         stays usable: the next submit() restarts both. Queries still
         queued (never dispatched) are failed immediately so their
-        callers don't hang awaiting a worker that no longer exists."""
+        callers don't hang awaiting a worker that no longer exists.
+        Per-group (variant) ladder state is dropped too: the next serve
+        cycle may host a different variant set, and padding against the
+        previous set's ladders would dispatch uncompiled shapes."""
         if self._worker is not None:
             self._worker.cancel()
             self._worker = None
         if self._executor is not None:
             self._executor.shutdown(wait=False, cancel_futures=True)
             self._executor = None
+        self._group_ladders.clear()
         while True:
             try:
-                _, fut = self._queue.get_nowait()
+                _, fut, _ = self._queue.get_nowait()
             except asyncio.QueueEmpty:
                 break
             if not fut.done():

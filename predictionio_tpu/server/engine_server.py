@@ -32,6 +32,16 @@ Resilience contract (docs/operations.md "Failure modes"):
   failure; the candidate engine must answer a probe query (the last
   successfully served one) before the swap, so a reload under live
   traffic serves either the old or the new instance — never an error.
+
+Multi-model serving (``variants=...`` / ``pio deploy --variants``):
+several registry generations stay resident at once (champion /
+challenger / canary — server/variants.py), each query is dispatched to
+an arm by a deterministic sticky weighted split, the serving arm is
+returned (and overridable) via the ``X-PIO-Variant`` header, feedback
+is attributed per arm (server/variant_metrics.py), ``/reload?variant=``
+swaps ONE arm without disturbing the others, and ``/variants`` +
+``POST /variants/weights`` expose the split with probe-then-apply
+edit semantics.
 """
 
 from __future__ import annotations
@@ -92,6 +102,8 @@ class EngineServer:
         reload_probe: bool = True,
         require_engine: bool = True,
         access_log: bool = False,
+        variants: Optional[str] = None,
+        variant_salt: str = "pio",
     ) -> None:
         self.storage = storage or get_storage()
         self.engine_factory = engine_factory
@@ -112,17 +124,18 @@ class EngineServer:
         self.plugins = plugins if plugins is not None else engine_server_plugins()
         self.deployed: Optional[DeployedEngine] = None
         self._load_error: Optional[str] = None
-        try:
-            self.deployed = prepare_deploy(
-                engine_factory=engine_factory, instance_id=instance_id,
-                storage=self.storage, variant_id=variant_id)
-        except Exception as e:
-            # with require_engine=False the server still comes up (and
-            # reports not-ready) so ops can deploy before the first
-            # train and /reload the model in later
-            if require_engine:
-                raise
-            self._load_error = f"{type(e).__name__}: {e}"
+        if not variants:
+            try:
+                self.deployed = prepare_deploy(
+                    engine_factory=engine_factory, instance_id=instance_id,
+                    storage=self.storage, variant_id=variant_id)
+            except Exception as e:
+                # with require_engine=False the server still comes up (and
+                # reports not-ready) so ops can deploy before the first
+                # train and /reload the model in later
+                if require_engine:
+                    raise
+                self._load_error = f"{type(e).__name__}: {e}"
         self.start_time = utcnow()
         #: replica identity, surfaced on /health: a router (or any
         #: client) that sees the instance id change knows it is talking
@@ -195,9 +208,46 @@ class EngineServer:
             # an explicit ladder defines its own max batch: collecting
             # past the top bucket would dispatch an uncompiled shape
             batch_max = ladder.max_batch
-            self._warmup = AOTWarmup(ladder, ks=(aot_topk,))
-            if self.deployed is not None:
-                self._warmup.start(self.deployed)
+            if not variants:
+                self._warmup = AOTWarmup(ladder, ks=(aot_topk,))
+                if self.deployed is not None:
+                    self._warmup.start(self.deployed)
+        #: multi-model serving: the resident variant set + its online
+        #: scoreboard. Each arm gets its OWN AOTWarmup over the shared
+        #: ladder geometry — same-geometry arms are pure executable-cache
+        #: hits, so residency costs HBM, not compiles.
+        self._mux = None
+        self._scoreboard = None
+        if variants:
+            from predictionio_tpu.server.variant_metrics import (
+                VariantScoreboard,
+            )
+            from predictionio_tpu.server.variants import VariantSet
+
+            warm_factory = None
+            if ladder is not None:
+                def warm_factory(_ladder=ladder, _k=aot_topk):
+                    from predictionio_tpu.server.aot import AOTWarmup
+
+                    return AOTWarmup(_ladder, ks=(_k,))
+            self._mux = VariantSet(
+                self.storage, variants, engine_factory=engine_factory,
+                variant_id=variant_id, salt=variant_salt,
+                warm_factory=warm_factory)
+            self._scoreboard = VariantScoreboard()
+            try:
+                self._mux.load()
+            except Exception as e:
+                if require_engine:
+                    raise
+                self._load_error = f"{type(e).__name__}: {e}"
+            default = self._mux.get(self._mux.default)
+            if default.serving():
+                # the default (champion) arm also serves every legacy
+                # single-model path: /, probes, model generation
+                self.deployed = default.deployed
+                self._warmup = default.warmup
+                self._mux.start_warmups()
         self._batcher = None
         if batching:
             from predictionio_tpu.server.batching import MicroBatcher
@@ -209,6 +259,9 @@ class EngineServer:
                 ladder=ladder)
         router = Router()
         router.route("POST", "/queries.json", self._queries)
+        router.route("POST", "/feedback.json", self._feedback_route)
+        router.route("GET", "/variants", self._variants_route)
+        router.route("POST", "/variants/weights", self._variants_weights)
         router.route("GET", "/", self._status)
         router.route("GET", "/health", self._health)
         router.route("GET", "/reload", self._reload)
@@ -230,16 +283,27 @@ class EngineServer:
 
     # -- workers ---------------------------------------------------------------
 
-    def _query_worker(self, query: Any) -> Any:
+    def _deployed_for(self, variant: Optional[str]) -> DeployedEngine:
+        """The engine behind one serving arm (the single deployed
+        engine when multi-model serving is off)."""
+        if variant is not None and self._mux is not None:
+            rv = self._mux.get(variant)
+            if rv.deployed is not None:
+                return rv.deployed
+        return self.deployed
+
+    def _query_worker(self, query: Any,
+                      variant: Optional[str] = None) -> Any:
         # to_thread copies the contextvars context, so this span parents
         # to the request's engine.query span automatically
         with tracing.span("engine.predict"):
             faults.inject("serving.query")
-            return self.deployed.query(query)
+            return self._deployed_for(variant).query(query)
 
-    def _batch_worker(self, queries: List[Any]) -> List[Any]:
+    def _batch_worker(self, queries: List[Any],
+                      variant: Optional[str] = None) -> List[Any]:
         faults.inject("serving.query")
-        return self.deployed.batch_query(queries)
+        return self._deployed_for(variant).batch_query(queries)
 
     # -- handlers --------------------------------------------------------------
 
@@ -311,16 +375,46 @@ class EngineServer:
         # the latency histogram observes EVERY outcome — the 400/500
         # (and 504) tails are exactly the slow failures worth seeing
         self._m_latency.observe(dt, (status,), exemplar=tracing.exemplar())
+        if self._scoreboard is not None:
+            served_by = resp.headers.get("X-PIO-Variant")
+            if served_by:
+                self._scoreboard.observe_request(served_by, dt, status)
         return resp
 
     async def _query_once(self, req: Request) -> "tuple[str, Response]":
+        status, resp, variant = await self._dispatch_once(req)
+        if variant is not None:
+            # which arm answered (or would have) — clients and the
+            # chaos harness read the split from this header
+            resp.headers["X-PIO-Variant"] = variant
+        return status, resp
+
+    async def _dispatch_once(
+            self, req: Request) -> "tuple[str, Response, Optional[str]]":
+        variant: Optional[str] = None
         try:
             query = req.json()
         except json.JSONDecodeError as e:
             return "400", Response.json(
-                {"message": f"invalid JSON: {e}"}, status=400)
+                {"message": f"invalid JSON: {e}"}, status=400), None
         if query is None:
-            return "400", Response.json({"message": "empty query"}, status=400)
+            return ("400",
+                    Response.json({"message": "empty query"}, status=400),
+                    None)
+        if self._mux is not None:
+            from predictionio_tpu.server.variants import (
+                VariantError,
+                entity_of,
+            )
+
+            override = req.headers.get("x-pio-variant")
+            try:
+                variant = self._mux.choose(entity_of(query),
+                                           override or None)
+            except VariantError as e:
+                return ("400",
+                        Response.json({"message": str(e)}, status=400),
+                        None)
         # a routing hop can carry the client's REMAINING budget down in
         # X-PIO-Deadline-Ms; the effective deadline is the tighter of
         # that and the server's own --query-timeout-ms
@@ -335,9 +429,9 @@ class EngineServer:
                 timeout = min(timeout, hop_sec) if timeout > 0 else hop_sec
         try:
             if self._batcher is not None:
-                work = self._batcher.submit(query)
+                work = self._batcher.submit(query, group=variant)
             else:
-                work = asyncio.to_thread(self._query_worker, query)
+                work = asyncio.to_thread(self._query_worker, query, variant)
             if timeout > 0:
                 prediction = await asyncio.wait_for(work, timeout)
             else:
@@ -349,12 +443,12 @@ class EngineServer:
             return "504", Response.json(
                 {"message": "query deadline exceeded "
                             f"({timeout * 1e3:.0f} ms)"},
-                status=504)
+                status=504), variant
         except (ValueError, KeyError, TypeError) as e:
             # malformed/invalid query (bad fields, unknown entity, wrong types)
             return "400", Response.json(
                 {"message": f"query failed: {type(e).__name__}: {e}"},
-                status=400)
+                status=400), variant
         except Exception as e:
             # internal fault; retryable, so 500 (the reference returns
             # 500 on server faults). Micro-batch failures are isolated
@@ -362,7 +456,7 @@ class EngineServer:
             # surfaces as its own ValueError → 400 above.
             return "500", Response.json(
                 {"message": f"server error: {type(e).__name__}: {e}"},
-                status=500)
+                status=500), variant
         for p in self.plugins:
             prediction = p.output_blocker(query, prediction)
             p.output_sniffer(query, prediction)
@@ -373,11 +467,15 @@ class EngineServer:
             pr_id = uuid.uuid4().hex
             if isinstance(prediction, dict):
                 prediction = {**prediction, "prId": pr_id}
-            self._submit_feedback(query, prediction, pr_id)
-        return "200", Response.json(prediction)
+            if variant is not None and self._scoreboard is not None:
+                # remember what was served under this prId so feedback
+                # can be attributed and scored per arm
+                self._scoreboard.record_served(pr_id, variant, prediction)
+            self._submit_feedback(query, prediction, pr_id, variant)
+        return "200", Response.json(prediction), variant
 
     def _submit_feedback(self, query: Any, prediction: Any,
-                         pr_id: str) -> None:
+                         pr_id: str, variant: Optional[str] = None) -> None:
         """Queue feedback on a DEDICATED small executor — a slow or down
         Event Server (HTTP sink blocks up to its timeout) must not eat
         the shared to_thread pool that query handling runs on. Bounded:
@@ -399,7 +497,7 @@ class EngineServer:
 
         def run():
             try:
-                self._record_feedback(query, prediction, pr_id)
+                self._record_feedback(query, prediction, pr_id, variant)
             finally:
                 with self._counts_lock:
                     self._feedback_inflight -= 1
@@ -424,24 +522,30 @@ class EngineServer:
             self._event_sink = DirectEventSink(self.storage, app_name)
         return self._event_sink
 
-    def _record_feedback(self, query: Any, prediction: Any, pr_id: str) -> None:
+    def _record_feedback(self, query: Any, prediction: Any, pr_id: str,
+                         variant: Optional[str] = None) -> None:
         """Feedback loop: served predictions become 'predict' events
         tagged with prId, delivered through the configured sink —
         the Event Server's authenticated HTTP API when a feedback URL
         is set (reference: CreateServer feedback, SURVEY.md §3.2), else
         a direct local write. Delivery runs through the sink breaker:
         repeated failures trip it open and subsequent feedback drops
-        fast (counted as breaker_open) until the sink recovers."""
+        fast (counted as breaker_open) until the sink recovers. With
+        multi-model serving the event carries the SERVING VARIANT, so
+        downstream consumers can score arms without the prId map."""
         with tracing.span("engine.feedback", pr_id=pr_id) as sp:
             try:
                 sink = self._sink()
                 if sink is None:
                     sp.set_attr("result", "no_sink")
                     return
+                props = {"query": query, "prediction": prediction}
+                if variant is not None:
+                    props["variant"] = variant
                 self._sink_breaker.call(sink.send, Event(
                     event="predict",
                     entity_type="pio_pr", entity_id=pr_id,
-                    properties={"query": query, "prediction": prediction},
+                    properties=props,
                     pr_id=pr_id,
                 ))
                 self._m_feedback.inc(("ok",))
@@ -452,6 +556,91 @@ class EngineServer:
             except Exception as e:
                 self._m_feedback.inc(("error",))  # never breaks serving
                 sp.set_error(f"{type(e).__name__}: {e}")
+
+    # -- variant surface -------------------------------------------------------
+
+    async def _feedback_route(self, req: Request) -> Response:
+        """POST /feedback.json — close the online loop for one served
+        prediction: ``{"prId": ..., "rating": 4.0, "item": ...}`` or
+        ``{"prId": ..., "click": true}`` (an explicit ``"variant"``
+        attributes directly when the prId is unknown/evicted). Accrues
+        into the per-variant online series the ``--gate online``
+        promotion gate reads."""
+        if self._scoreboard is None:
+            return Response.json(
+                {"message": "variant serving not enabled "
+                            "(deploy with --variants)"}, status=404)
+        try:
+            body = req.json()
+        except json.JSONDecodeError as e:
+            return Response.json(
+                {"message": f"invalid JSON: {e}"}, status=400)
+        if not isinstance(body, dict):
+            return Response.json(
+                {"message": "feedback body must be a JSON object"},
+                status=400)
+        rating = body.get("rating")
+        if rating is not None:
+            try:
+                rating = float(rating)
+            except (TypeError, ValueError):
+                return Response.json(
+                    {"message": f"bad rating {body.get('rating')!r}"},
+                    status=400)
+        clicked = body.get("click", body.get("clicked"))
+        variant = self._scoreboard.observe_feedback(
+            pr_id=body.get("prId"),
+            variant=body.get("variant"),
+            rating=rating,
+            item=body.get("item"),
+            clicked=bool(clicked) if clicked is not None else None)
+        if variant is None:
+            return Response.json(
+                {"message": "feedback not attributable: unknown prId "
+                            "and no variant given"}, status=404)
+        return Response.json({"accepted": True, "variant": variant})
+
+    async def _variants_route(self, req: Request) -> Response:
+        """GET /variants — the resident variant set: per-arm generation,
+        warmup state, weights, and accrued online stats."""
+        if self._mux is None:
+            return Response.json(
+                {"message": "variant serving not enabled"}, status=404)
+        snap = self._mux.snapshot()
+        if self._scoreboard is not None:
+            stats = self._scoreboard.snapshot()
+            for name, v in snap["variants"].items():
+                v["online"] = stats.get(name)
+        return Response.json(snap)
+
+    async def _variants_weights(self, req: Request) -> Response:
+        """POST /variants/weights — probe-then-apply split edit:
+        ``{"weights": {"champion": 9, "challenger": 1}}``. Every named
+        arm must be resident AND serving or NOTHING changes (409)."""
+        if self._mux is None:
+            return Response.json(
+                {"message": "variant serving not enabled"}, status=404)
+        from predictionio_tpu.server.variants import VariantError
+
+        try:
+            body = req.json()
+        except json.JSONDecodeError as e:
+            return Response.json(
+                {"message": f"invalid JSON: {e}"}, status=400)
+        weights = body.get("weights") if isinstance(body, dict) else None
+        if not isinstance(weights, dict):
+            return Response.json(
+                {"message": 'body must be {"weights": {name: weight}}'},
+                status=400)
+        try:
+            eff = self._mux.set_weights(weights)
+        except VariantError as e:
+            return Response.json({"message": str(e)}, status=409)
+        return Response.json({
+            "applied": True,
+            "effectiveWeights": dict(eff),
+            "weightsEpoch": self._mux.weights_epoch,
+        })
 
     async def _status(self, req: Request) -> Response:
         if self.deployed is None:
@@ -502,19 +691,33 @@ class EngineServer:
         }
         if self._warmup is not None:
             body["warmup"] = self._warmup.progress()
+        if self._mux is not None:
+            # the resident variant set: per-arm generation + warmup
+            # state, so a router/operator sees the split without /variants
+            body["variants"] = self._mux.snapshot()
         if self.deployed is None:
             return self._not_ready(self._load_error or "no engine loaded",
                                    body)
         if self._warmup is not None and self._warmup.state in (
                 "idle", "warming"):
             return self._not_ready("aot warmup in progress", body)
+        mux_warm = (self._mux.warm_state() if self._mux is not None
+                    else "ready")
+        if mux_warm == "warming":
+            return self._not_ready("variant aot warmup in progress", body)
+        failed_arms = ([n for n, v in body["variants"]["variants"].items()
+                        if v["state"] == "failed"]
+                       if self._mux is not None else [])
         warmup_failed = (self._warmup is not None
                          and self._warmup.state == "failed")
-        if open_breakers or at_capacity or warmup_failed:
+        if (open_breakers or at_capacity or warmup_failed
+                or mux_warm == "failed" or failed_arms):
             reason = ("breaker open: " + ",".join(open_breakers)
                       if open_breakers else
-                      "at inflight capacity" if at_capacity
-                      else "aot warmup failed")
+                      "at inflight capacity" if at_capacity else
+                      "aot warmup failed" if warmup_failed else
+                      "variant aot warmup failed" if mux_warm == "failed"
+                      else "variant failed: " + ",".join(failed_arms))
             return Response.json(
                 {"status": "degraded", "reason": reason, **body})
         return Response.json({"status": "ok", **body})
@@ -571,6 +774,8 @@ class EngineServer:
         async with tracing.span("engine.reload",
                                 generation=self.reload_generation) as sp, \
                 self._reload_lock:
+            if self._mux is not None:
+                return await self._reload_variant_locked(req, sp)
             factory = self.engine_factory or (
                 self.deployed.instance.engine_factory
                 if self.deployed is not None else None)
@@ -648,6 +853,61 @@ class EngineServer:
                                   "reloadGeneration": self.reload_generation,
                                   "modelGeneration": self._model_generation(),
                                   "swap": "promoted"})
+
+    async def _reload_variant_locked(self, req: Request, sp: Any) -> Response:
+        """``/reload[?variant=name]`` under multi-model serving: swap
+        ONE arm onto its freshly-resolved registry generation, leaving
+        every other arm resident and serving. Defaults to the champion
+        arm. Outcomes mirror the single-model reload: ``promoted``,
+        ``rolled_back`` (default arm keeps its last-good engine),
+        ``failed`` (a non-default arm drops out of the split — the
+        champion absorbs its weight until the next successful swap)."""
+        from predictionio_tpu.server.variants import VariantError
+
+        target = req.param("variant") or self._mux.default
+        probe_fn = None
+        if self.reload_probe and self._last_good_query is not None:
+            last = self._last_good_query
+
+            def probe_fn(candidate: Any, _q: Any = last) -> None:
+                faults.inject("serving.reload")
+                candidate.query(_q)
+
+        try:
+            out = await asyncio.to_thread(
+                self._mux.reload_variant, target, probe_fn)
+        except VariantError as e:
+            self._m_reloads.inc(("failed",))
+            sp.set_error(str(e))
+            return Response.json({"message": str(e)}, status=404)
+        if out["outcome"] == "promoted":
+            rv = self._mux.get(target)
+            if target == self._mux.default:
+                self.deployed = rv.deployed
+                self._warmup = rv.warmup or self._warmup
+                self._load_error = None
+            self.reload_generation += 1
+            self._m_reload_gen.set(self.reload_generation)
+            self._m_reloads.inc(("ok",))
+            sp.set_attr("result", "ok")
+            self._record_swap(
+                "promoted", variant=target,
+                engineInstanceId=out.get("engineInstanceId"),
+                modelGeneration=out.get("generation"))
+            return Response.json({
+                "message": "Reloaded", "variant": target,
+                "engineInstanceId": out.get("engineInstanceId"),
+                "modelGeneration": out.get("generation"),
+                "reloadGeneration": self.reload_generation,
+                "swap": "promoted"})
+        result = out["outcome"]  # rolled_back | failed
+        self._m_reloads.inc((result,))
+        sp.set_error(f"variant reload {result}: {out.get('error')}")
+        self._record_swap(result, variant=target, reason=out.get("error"))
+        return Response.json(
+            {"message": f"reload {result}: {out.get('error')}",
+             "variant": target, "swap": result},
+            status=500)
 
     async def _stop(self, req: Request) -> Response:
         asyncio.get_running_loop().call_later(0.05, self.http.request_shutdown)

@@ -241,9 +241,15 @@ class FleetRouter:
         self._manifest_mtime = 0.0
         self._breaker_threshold = breaker_threshold
         self._breaker_reset = breaker_reset
+        #: per-replica variant-weight pins from the manifest
+        #: (``URL variants=champion:9,challenger:1`` lines), keyed by
+        #: replica name; pushed to the replica's POST /variants/weights
+        #: by the health loop (probe-then-apply happens replica-side)
+        self._variant_pins: Dict[str, Dict[str, float]] = {}
+        self._pins_pushed: Dict[str, Dict[str, float]] = {}
         urls = list(replicas or [])
         if manifest:
-            urls = self._read_manifest() or urls
+            urls = self._manifest_urls() or urls
         self.replicas: List[Replica] = [self._make_replica(u) for u in urls]
         self.health_interval = max(0.05, health_interval)
         self.default_deadline = max(0.001, default_deadline_ms / 1e3)
@@ -318,7 +324,9 @@ class FleetRouter:
 
     def _read_manifest(self) -> List[str]:
         """One replica URL per line; blank lines and ``#`` comments
-        skipped. Returns [] when unreadable (keep the current set)."""
+        skipped. A line may pin that replica's variant split with a
+        trailing ``variants=name:weight,...`` annotation. Returns []
+        when unreadable (keep the current set)."""
         if not self.manifest:
             return []
         try:
@@ -329,6 +337,34 @@ class FleetRouter:
         except OSError:
             return []
 
+    def _manifest_urls(self) -> List[str]:
+        """Manifest lines → replica URLs, recording ``variants=`` pins
+        (and dropping the pin of any replica that left the manifest)."""
+        urls: List[str] = []
+        pins: Dict[str, Dict[str, float]] = {}
+        for line in self._read_manifest():
+            parts = line.split()
+            url = parts[0]
+            urls.append(url)
+            for tok in parts[1:]:
+                if tok.startswith("variants="):
+                    try:
+                        from predictionio_tpu.server.variants import (
+                            parse_weights,
+                        )
+
+                        name = "%s:%d" % Replica.parse_hostport(url)
+                        pins[name] = {s.name: s.weight for s in
+                                      parse_weights(tok[len("variants="):])}
+                    except Exception:
+                        pass  # a bad pin never takes the manifest down
+        if urls:
+            self._variant_pins = pins
+            for name in list(self._pins_pushed):
+                if self._pins_pushed.get(name) != pins.get(name):
+                    self._pins_pushed.pop(name, None)
+        return urls
+
     def _refresh_manifest(self) -> None:
         if not self.manifest:
             return
@@ -338,7 +374,7 @@ class FleetRouter:
             return
         if mtime == self._manifest_mtime:
             return
-        urls = self._read_manifest()
+        urls = self._manifest_urls()
         if not urls:
             return
         want = {"%s:%d" % Replica.parse_hostport(u): u for u in urls}
@@ -752,6 +788,39 @@ class FleetRouter:
             await asyncio.gather(
                 *(self._poll_replica(r) for r in self.replicas))
         self._publish_states()
+        await self._push_variant_pins()
+
+    async def _push_variant_pins(self) -> None:
+        """Apply manifest-pinned variant splits to serving replicas
+        (POST /variants/weights — the replica itself enforces
+        probe-then-apply). Idempotent per pin: pushed once, re-pushed
+        only when the pin changes or the push failed (retried on the
+        next health tick, so a replica that comes up late still
+        converges to its pinned split)."""
+        if not self._variant_pins:
+            return
+        for rep in self.replicas:
+            pin = self._variant_pins.get(rep.name)
+            if (pin is None or rep.state not in (OK, DEGRADED)
+                    or self._pins_pushed.get(rep.name) == pin):
+                continue
+            try:
+                await asyncio.to_thread(
+                    self._post_weights, f"http://{rep.name}", pin)
+                self._pins_pushed[rep.name] = dict(pin)
+            except Exception:  # noqa: BLE001 — retried next tick
+                pass
+
+    @staticmethod
+    def _post_weights(url: str, weights: Dict[str, float]) -> None:
+        import urllib.request
+
+        req = urllib.request.Request(
+            url.rstrip("/") + "/variants/weights",
+            data=json.dumps({"weights": weights}).encode("utf-8"),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=5.0):
+            pass
 
     async def _health_loop(self) -> None:
         while True:

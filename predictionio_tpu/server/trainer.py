@@ -325,6 +325,21 @@ class TrainerConfig:
     guardrail_max_regress: float = 0.10
     #: guardrail: below this many scoreable pairs, pass trivially
     guardrail_min_events: int = 10
+    #: promotion gate mode: ``offline`` (held-out RMSE, the default),
+    #: ``online`` (the challenger's accrued LIVE metrics scraped from
+    #: the fleet's ``pio_variant_online_rmse`` series), or ``both``
+    gate: str = "offline"
+    #: online gate: the variant names of the incumbent and the arm
+    #: whose accrued live RMSE is being judged
+    online_champion: str = "champion"
+    online_challenger: str = "challenger"
+    #: online gate: below this many rated pairs accrued fleet-wide,
+    #: pass trivially (not enough live evidence to refuse on)
+    online_min_pairs: int = 20
+    #: online gate: refuse when the challenger's accrued online RMSE is
+    #: worse than the champion's by more than this fraction
+    #: (None = reuse guardrail_max_regress)
+    online_max_regress: Optional[float] = None
     #: bake window length; 0 disables live-metrics bake
     bake_seconds: float = 0.0
     #: bake: roll back when the 5xx fraction over the window exceeds this
@@ -540,6 +555,104 @@ class ContinuousTrainer:
         detail["reason"] = f"rmse {cand_rmse:.4f} > limit {limit:.4f}"
         return False, detail
 
+    def _online_stats(self) -> Dict[str, Tuple[float, float]]:
+        """Per-variant accrued ONLINE rating scores scraped from the
+        fleet: {variant: (combined rmse, rated pairs)}. Replicas are
+        combined pairs-weighted (sum of squared errors recomposed from
+        each replica's rmse × pair count), so a replica that served
+        10× the traffic counts 10× in the verdict."""
+        per_replica: Dict[str, List[Tuple[float, float]]] = {}
+        for u in self._replica_urls():
+            try:
+                snap = _parse_prom(self._http("GET", u + "/metrics"))
+            except Exception:
+                continue
+            rmse: Dict[str, float] = {}
+            pairs: Dict[str, float] = {}
+            for (name, labels), value in snap.items():
+                ld = dict(labels)
+                v = ld.get("variant")
+                if not v:
+                    continue
+                if name == "pio_variant_online_rmse":
+                    rmse[v] = value
+                elif (name == "pio_variant_feedback_total"
+                      and ld.get("kind") == "rating"):
+                    pairs[v] = pairs.get(v, 0.0) + value
+            for v, r in rmse.items():
+                per_replica.setdefault(v, []).append(
+                    (r, pairs.get(v, 0.0)))
+        out: Dict[str, Tuple[float, float]] = {}
+        for v, obs in per_replica.items():
+            n = sum(p for _, p in obs)
+            if n <= 0:
+                continue
+            sq = sum(p * r * r for r, p in obs)
+            out[v] = (math.sqrt(sq / n), n)
+        return out
+
+    def _guardrail_online(self, candidate_id: str,
+                          ) -> Tuple[bool, Dict[str, Any]]:
+        """Online champion-vs-challenger gate (``--gate online``): the
+        verdict comes from the CHALLENGER arm's accrued live RMSE
+        (``pio_variant_online_rmse``, fed by real feedback against real
+        traffic — server/variant_metrics.py) instead of an offline
+        held-out replay. Trivial pass when the fleet has not accrued
+        enough rated pairs, or when no champion baseline exists —
+        exactly mirroring the offline gate's unscoreable semantics."""
+        detail: Dict[str, Any] = {
+            "mode": "online", "candidate": candidate_id,
+            "champion_rmse": None, "challenger_rmse": None, "pairs": 0}
+        regressed = False
+        try:
+            faults.inject("promote.regression")
+        except faults.FaultError:
+            regressed = True
+        if regressed:
+            detail["challenger_rmse"] = math.inf
+            detail["reason"] = "injected regression"
+            return False, detail
+        stats = self._online_stats()
+        chal = stats.get(self.cfg.online_challenger)
+        champ = stats.get(self.cfg.online_champion)
+        if champ is not None:
+            detail["champion_rmse"] = champ[0]
+        if chal is not None:
+            detail["challenger_rmse"] = chal[0]
+            detail["pairs"] = chal[1]
+        if chal is None or chal[1] < self.cfg.online_min_pairs:
+            detail["reason"] = (
+                f"only {chal[1] if chal else 0:.0f} online rated pairs "
+                f"(< {self.cfg.online_min_pairs}): pass")
+            return True, detail
+        if champ is None:
+            detail["reason"] = "no champion online baseline: pass"
+            return True, detail
+        regress = (self.cfg.online_max_regress
+                   if self.cfg.online_max_regress is not None
+                   else self.cfg.guardrail_max_regress)
+        limit = champ[0] * (1.0 + regress) + 1e-9
+        if chal[0] <= limit:
+            detail["reason"] = (f"online rmse {chal[0]:.4f} <= "
+                                f"limit {limit:.4f}")
+            return True, detail
+        detail["reason"] = (f"online rmse {chal[0]:.4f} > "
+                            f"limit {limit:.4f}")
+        return False, detail
+
+    def _gate(self, candidate_id: str) -> Tuple[bool, Dict[str, Any]]:
+        """The promotion gate: offline held-out guardrail (default),
+        the online live-metrics gate, or both (both must pass)."""
+        mode = (self.cfg.gate or "offline").lower()
+        if mode == "online":
+            return self._guardrail_online(candidate_id)
+        if mode == "both":
+            ok_off, off = self._guardrail(candidate_id)
+            ok_on, on = self._guardrail_online(candidate_id)
+            return ok_off and ok_on, {"mode": "both",
+                                      "offline": off, "online": on}
+        return self._guardrail(candidate_id)
+
     # -- reload push + bake ----------------------------------------------------
 
     def _replica_urls(self) -> List[str]:
@@ -680,7 +793,7 @@ class ContinuousTrainer:
         # /reload keeps serving the champion
         self.registry.sync_meta(self.storage.meta)
 
-        promote, gate = self._guardrail(instance_id)
+        promote, gate = self._gate(instance_id)
         if not promote:
             self.registry.mark(gen, "refused", token=self.lease.token)
             self.registry.sync_meta(self.storage.meta)

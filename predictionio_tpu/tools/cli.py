@@ -197,9 +197,21 @@ def cmd_deploy(args: argparse.Namespace) -> None:
         query_timeout_ms=args.query_timeout_ms,
         max_inflight=args.max_inflight,
         access_log=args.access_log,
+        variants=args.variants,
+        variant_salt=args.variant_salt,
     )
-    print(f"[info] Engine Server (instance {server.deployed.instance.id}) "
-          f"listening on {args.ip}:{args.port}")
+    if args.variants:
+        snap = server._mux.snapshot()
+        arms = ", ".join(
+            f"{n}=gen-{v['generation']:06d}" if v["generation"] is not None
+            else f"{n}={v['state']}"
+            for n, v in snap["variants"].items())
+        print(f"[info] Engine Server ({arms}) "
+              f"listening on {args.ip}:{args.port}")
+    else:
+        print(f"[info] Engine Server "
+              f"(instance {server.deployed.instance.id}) "
+              f"listening on {args.ip}:{args.port}")
     server.run()
 
 
@@ -321,6 +333,11 @@ def _run_continuous(args: argparse.Namespace, variant: Dict[str, Any],
         guardrail_holdout=args.guardrail_holdout,
         guardrail_max_regress=args.guardrail_max_regress,
         guardrail_min_events=args.guardrail_min_events,
+        gate=args.gate,
+        online_champion=args.online_champion,
+        online_challenger=args.online_challenger,
+        online_min_pairs=args.online_min_pairs,
+        online_max_regress=args.online_max_regress,
         bake_seconds=args.bake_seconds,
         bake_error_rate=args.bake_error_rate,
         bake_p95_ratio=args.bake_p95_ratio,
@@ -339,6 +356,136 @@ def _run_continuous(args: argparse.Namespace, variant: Dict[str, Any],
     print(f"[info] Continuous trainer stopped after {len(outcomes)} cycles.")
 
 
+def _http_json(url: str, *, method: str = "GET",
+               body: Optional[dict] = None, timeout: float = 10.0) -> dict:
+    """GET/POST JSON over urllib (jax-free ops path). An HTTP error
+    with a JSON body comes back as that body plus ``_status``, so
+    callers can show the replica's own refusal reason instead of a
+    stack trace; transport errors still raise."""
+    import urllib.error
+    import urllib.request
+
+    data = None
+    headers = {}
+    if body is not None:
+        data = json.dumps(body).encode("utf-8")
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, headers=headers,
+                                 method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            doc = json.loads(e.read() or b"{}")
+        except ValueError:
+            doc = {}
+        doc["_status"] = e.code
+        return doc
+
+
+def _replica_urls(args: argparse.Namespace) -> List[str]:
+    """--url (repeatable) plus manifest lines (router format: first
+    token is the URL, ``variants=`` annotations ignored here)."""
+    urls = list(args.url or [])
+    if getattr(args, "manifest", None):
+        try:
+            with open(args.manifest, "r", encoding="utf-8") as f:
+                for ln in f:
+                    ln = ln.strip()
+                    if not ln or ln.startswith("#"):
+                        continue
+                    u = ln.split()[0]
+                    urls.append(u if "//" in u else "http://" + u)
+        except OSError as e:
+            _die(f"cannot read manifest {args.manifest!r}: {e}")
+    return urls
+
+
+def cmd_variants(args: argparse.Namespace) -> None:
+    """Operate the live variant split (jax-free — runs on an ops box).
+    ``status`` shows each replica's resident arms with warmup state and
+    online score; ``set-weights`` re-splits traffic fleet-wide with
+    probe-then-apply semantics: every replica must report every named
+    arm serving BEFORE any replica's weights change, so a typo'd arm or
+    a half-warmed challenger can't blackhole traffic on part of the
+    fleet."""
+    urls = _replica_urls(args)
+    if not urls:
+        _die("no replicas: pass --url (repeatable) or --manifest FILE")
+    if args.variants_cmd == "status":
+        out = {}
+        for u in urls:
+            base = u.rstrip("/")
+            try:
+                out[base] = _http_json(f"{base}/variants",
+                                       timeout=args.timeout)
+            except Exception as e:  # noqa: BLE001 — per-replica verdict
+                out[base] = {"error": f"{type(e).__name__}: {e}"}
+        if args.json:
+            print(json.dumps(out, indent=2, sort_keys=True))
+            return
+        for base, doc in out.items():
+            if "variants" not in doc:
+                why = doc.get("error") or f"HTTP {doc.get('_status')}"
+                print(f"[variants] {base}: {why}")
+                continue
+            print(f"[variants] {base} default={doc['default']} "
+                  f"salt={doc['salt']!r} epoch={doc['weightsEpoch']}")
+            for name, arm in sorted(doc["variants"].items()):
+                gen = arm.get("generation")
+                on = arm.get("online") or {}
+                rmse = on.get("onlineRmse")
+                print(f"  {name:<16} "
+                      f"gen={'?' if gen is None else gen}  "
+                      f"state={arm['state']:<8} "
+                      f"w={arm['weight']:g}"
+                      f"→{arm['effectiveWeight']:.3f}  "
+                      f"served={on.get('served', 0)} "
+                      f"ctr={on.get('ctr', 0.0):.3f} "
+                      f"rmse={'-' if rmse is None else f'{rmse:.4f}'}")
+        return
+    # set-weights: probe ALL replicas before writing ANY
+    from predictionio_tpu.server.variants import parse_weights
+
+    try:
+        specs = parse_weights(args.weights)
+    except ValueError as e:
+        _die(str(e))
+    if any(s.gen is not None for s in specs):
+        _die("set-weights re-splits arms already resident — generation "
+             "pins (name@N) belong to `pio deploy --variants`")
+    weights = {s.name: s.weight for s in specs}
+    probed: List[str] = []
+    for u in urls:
+        base = u.rstrip("/")
+        try:
+            doc = _http_json(f"{base}/variants", timeout=args.timeout)
+        except Exception as e:  # noqa: BLE001
+            _die(f"probe {base}/variants failed: {type(e).__name__}: {e} "
+                 "(no weights were changed)")
+        arms = doc.get("variants") or {}
+        missing = sorted(n for n in weights
+                         if (arms.get(n) or {}).get("state") != "ready")
+        if missing:
+            _die(f"{base}: arm(s) not serving: {', '.join(missing)} "
+                 "(no weights were changed)")
+        probed.append(base)
+    failed = False
+    for base in probed:
+        doc = _http_json(f"{base}/variants/weights", method="POST",
+                         body={"weights": weights}, timeout=args.timeout)
+        if "_status" in doc:
+            print(f"[variants] {base}: refused "
+                  f"({doc.get('error') or doc['_status']})")
+            failed = True
+        else:
+            print(f"[variants] {base}: weights applied "
+                  f"(epoch {doc.get('weightsEpoch')})")
+    if failed:
+        raise SystemExit(1)
+
+
 def cmd_models(args: argparse.Namespace) -> None:
     """Generation-aware model registry verbs. Operator writes carry no
     fencing token (``token=None`` bypasses the fence deliberately — the
@@ -352,6 +499,19 @@ def cmd_models(args: argparse.Namespace) -> None:
         doc = {"championGeneration": (reg.champion() or {}).get("gen"),
                "fenceToken": reg.fence_token(),
                "generations": reg.generations()}
+        if args.replica_url:
+            # residency column: which generations each serving replica
+            # actually holds in HBM right now (reads /health, so a
+            # not-ready 503 still yields the variants block)
+            doc["variants"] = {}
+            for u in args.replica_url:
+                base = u.rstrip("/")
+                try:
+                    h = _http_json(f"{base}/health", timeout=5.0)
+                    doc["variants"][base] = h.get("variants") or {}
+                except Exception as e:  # noqa: BLE001
+                    doc["variants"][base] = {
+                        "error": f"{type(e).__name__}: {e}"}
         if args.json:
             print(json.dumps(doc, indent=2, sort_keys=True))
             return
@@ -364,6 +524,19 @@ def cmd_models(args: argparse.Namespace) -> None:
             print(f"  gen-{e['gen']:06d}  {e['status']:<12} "
                   f"instance={e['instance_id']}  "
                   f"sha256={e['sha256'][:12]}…{mark}")
+        for base, snap in (doc.get("variants") or {}).items():
+            arms = snap.get("variants") if isinstance(snap, dict) else None
+            if not arms:
+                why = (snap.get("error") or "no variant set resident"
+                       if isinstance(snap, dict) else snap)
+                print(f"  replica {base}: {why}")
+                continue
+            residency = ", ".join(
+                (f"{n}=gen-{a['generation']:06d}[{a['state']}]"
+                 if a.get("generation") is not None
+                 else f"{n}=?[{a['state']}]")
+                for n, a in sorted(arms.items()))
+            print(f"  replica {base}: {residency}")
         return
     if args.models_cmd == "promote":
         try:
@@ -1013,6 +1186,25 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--guardrail-min-events", type=int, default=10,
                     help="below this many scoreable holdout pairs the "
                          "gate passes trivially")
+    tr.add_argument("--gate", choices=("offline", "online", "both"),
+                    default="offline",
+                    help="promotion gate mode: 'offline' scores the "
+                         "candidate on held-out feedback (default); "
+                         "'online' judges the CHALLENGER arm's accrued "
+                         "live metrics (pio_variant_online_rmse, fed by "
+                         "real traffic on a --variants replica) against "
+                         "the champion's; 'both' requires both to pass")
+    tr.add_argument("--online-challenger", default="challenger",
+                    help="variant name whose accrued online RMSE the "
+                         "online gate judges")
+    tr.add_argument("--online-champion", default="champion",
+                    help="variant name serving as the online baseline")
+    tr.add_argument("--online-min-pairs", type=int, default=20,
+                    help="below this many fleet-wide online rated pairs "
+                         "the online gate passes trivially")
+    tr.add_argument("--online-max-regress", type=float, default=None,
+                    help="online gate regression tolerance (default: "
+                         "--guardrail-max-regress)")
     tr.add_argument("--bake-seconds", type=float, default=0.0,
                     help="watch live serving metrics for this long after "
                          "promotion and auto-roll-back on regression "
@@ -1078,6 +1270,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="concurrent query cap; excess requests are shed "
                          "immediately with 503 + Retry-After "
                          "(0 = unlimited)")
+    dp.add_argument("--variants", default=None, metavar="SPEC",
+                    help="multi-model serving: keep several registry "
+                         "generations resident and split traffic by a "
+                         "deterministic sticky hash, e.g. "
+                         "'champion:9,challenger:1' (name[@gen]:weight; "
+                         "'champion' = registry champion, an unpinned "
+                         "other name = newest non-champion generation). "
+                         "The first arm is the default and absorbs a "
+                         "failed arm's weight. See docs/operations.md "
+                         "'Running a challenger'")
+    dp.add_argument("--variant-salt", default="pio",
+                    help="salt for the sticky split hash; change it to "
+                         "reshuffle which entities land on which arm")
     _add_observability_flags(dp)
     dp.set_defaults(fn=cmd_deploy)
 
@@ -1193,6 +1398,10 @@ def build_parser() -> argparse.ArgumentParser:
                                     "fence token")
     x.add_argument("--json", action="store_true",
                    help="emit the registry state as one JSON document")
+    x.add_argument("--replica-url", action="append", metavar="URL",
+                   help="also show which generations this serving "
+                        "replica holds resident (repeatable; reads the "
+                        "replica's /health variants block)")
     x = mds.add_parser("promote",
                        help="move the champion pointer to a generation "
                             "(then /reload the fleet to swap serving)")
@@ -1201,6 +1410,39 @@ def build_parser() -> argparse.ArgumentParser:
                        help="demote the champion and restore the most "
                             "recently promoted retired generation")
     md.set_defaults(fn=cmd_models)
+
+    vt = sub.add_parser(
+        "variants",
+        help="multi-model serving: show resident variant sets or "
+             "re-weight the live traffic split across the fleet "
+             "(probe-then-apply; jax-free — docs/operations.md "
+             "\"Running a challenger\")")
+    vts = vt.add_subparsers(dest="variants_cmd", required=True)
+    x = vts.add_parser("status",
+                       help="resident arms, weights, warmup state and "
+                            "online score, per replica")
+    x.add_argument("--url", action="append", metavar="URL",
+                   help="replica base URL, e.g. http://h:8000 "
+                        "(repeatable)")
+    x.add_argument("--manifest",
+                   help="fleet manifest file (router format, one "
+                        "replica per line)")
+    x.add_argument("--json", action="store_true")
+    x.add_argument("--timeout", type=float, default=10.0)
+    x = vts.add_parser(
+        "set-weights",
+        help="re-split live traffic across already-resident arms; every "
+             "replica is probed for every named arm BEFORE any replica "
+             "is changed")
+    x.add_argument("weights", metavar="SPEC",
+                   help='e.g. "champion:8,challenger:2" — same grammar '
+                        "as deploy --variants, minus generation pins")
+    x.add_argument("--url", action="append", metavar="URL",
+                   help="replica base URL (repeatable)")
+    x.add_argument("--manifest",
+                   help="fleet manifest file (router format)")
+    x.add_argument("--timeout", type=float, default=10.0)
+    vt.set_defaults(fn=cmd_variants)
 
     ix = sub.add_parser(
         "index",

@@ -69,6 +69,14 @@ Known sites (grep ``faults.inject`` for the authoritative list):
                         (``PQIndex.from_bytes`` — covers the
                         ``ann_index.bin`` file and blob-embedded
                         indexes; ``/reload`` must refuse, fsck exit ≥ 2)
+``variant.assign.skew``  variant-split assignment — the weighted hash
+                        is bypassed and every query lands on the
+                        default arm (a skewed split the per-variant
+                        request series must make visible)
+``variant.reload.partial``  variant swap mid-``/reload`` — the
+                        candidate died after loading but before
+                        publishing; the champion must keep serving and
+                        the split must fall back to 100/0
 ======================  ===================================================
 """
 

@@ -65,7 +65,8 @@ import time
 import numpy as np
 
 
-def fabricate_instance(storage, n_users: int, n_items: int, rank: int):
+def fabricate_instance(storage, n_users: int, n_items: int, rank: int,
+                       instance_id: str = "profile-serving", seed: int = 0):
     """Persist a synthetic ALS model + COMPLETED EngineInstance the way
     `pio train` would, so prepare_deploy loads the real thing."""
     from predictionio_tpu.storage.meta import EngineInstance
@@ -77,7 +78,7 @@ def fabricate_instance(storage, n_users: int, n_items: int, rank: int):
     from predictionio_tpu.utils.bimap import BiMap
     from predictionio_tpu.data.event import utcnow
 
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(seed)
     U = (rng.standard_normal((n_users, rank)) / np.sqrt(rank)).astype(
         np.float32)
     V = (rng.standard_normal((n_items, rank)) / np.sqrt(rank)).astype(
@@ -90,7 +91,7 @@ def fabricate_instance(storage, n_users: int, n_items: int, rank: int):
 
     factory = "predictionio_tpu.templates.recommendation.engine:engine_factory"
     ei = EngineInstance(
-        id="profile-serving", status="COMPLETED",
+        id=instance_id, status="COMPLETED",
         start_time=utcnow(), end_time=utcnow(),
         engine_factory=factory, engine_variant="", batch="",
         env={}, mesh_conf={},
@@ -930,6 +931,159 @@ def run_aot_mode(args, st, factory) -> None:
     }))
 
 
+def run_variants_mode(args) -> None:
+    """Multi-model multiplexing chaos mode (ISSUE 11 acceptance):
+
+    1. split fidelity — 20k all-200 queries against a 90/10
+       champion/challenger split must land within ±1% of 90/10, and
+       assignment must be sticky (same entity → same arm, always);
+    2. mid-swap kill — arm ``variant.reload.partial`` and
+       ``GET /reload?variant=challenger``: the swap must fail closed
+       (500), the champion must keep serving, and the effective split
+       must fall back to 100/0;
+    3. compile hygiene — with TWO variants resident and both ladders
+       warmed, the measured query run must trigger ZERO XLA compiles
+       (same geometry ⇒ pure executable-cache sharing).
+    """
+    import os
+    import shutil
+    import tempfile
+
+    if args.n_users < args.queries:
+        raise SystemExit(
+            "--variants needs --n-users >= --queries: the ±1% split "
+            "proof is over DISTINCT entities (sticky assignment makes "
+            "repeat queries correlated, not independent)")
+    os.environ.setdefault("PIO_ALS_SERVE", "device")
+    from predictionio_tpu.server.aot import EXECUTABLES
+    from predictionio_tpu.server.engine_server import EngineServer
+    from predictionio_tpu.storage.models import model_registry
+    from predictionio_tpu.storage.registry import (Storage, StorageConfig,
+                                                   set_storage)
+    from predictionio_tpu.utils.faults import FAULTS
+    from profile_common import server_thread
+
+    home = tempfile.mkdtemp(prefix="pio-variants-")
+    try:
+        st = Storage(StorageConfig(home=home))
+        set_storage(st)
+        factory = fabricate_instance(
+            st, args.n_users, args.n_items, args.rank,
+            instance_id="variants-champ", seed=0)
+        fabricate_instance(st, args.n_users, args.n_items, args.rank,
+                           instance_id="variants-chal", seed=1)
+        reg = model_registry(st)
+        champ_gen = reg.register("variants-champ",
+                                 st.models.get("variants-champ"))
+        reg.promote(champ_gen)
+        chal_gen = reg.register("variants-chal",
+                                st.models.get("variants-chal"))
+
+        server = EngineServer(
+            engine_factory=factory, storage=st,
+            host="127.0.0.1", port=args.port,
+            aot_buckets="1", aot_topk=10,
+            variants="champion:9,challenger:1")
+        # deterministic harness: both ladders warmed before any
+        # measurement, so phase 3 counts serving-path compiles only
+        server._mux.warm_sync_all()
+
+        def ask(conn, user: str):
+            conn.request("POST", "/queries.json",
+                         json.dumps({"user": user, "num": 10}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status, resp.getheader("X-PIO-Variant")
+
+        with server_thread(server, args.port):
+            conn = http.client.HTTPConnection("127.0.0.1", args.port,
+                                              timeout=30)
+            rng = np.random.default_rng(7)
+            warm_users = rng.integers(0, args.n_users, 50)
+            for u in warm_users:
+                ask(conn, str(int(u)))
+
+            # -- 1. split fidelity + stickiness -------------------------
+            n = args.queries
+            compiles_before = EXECUTABLES.counts().get("compile", 0)
+            counts: dict = {}
+            first_arm: dict = {}
+            statuses: dict = {}
+            t0 = time.perf_counter()
+            for i in range(n):
+                user = str(i)
+                status, arm = ask(conn, user)
+                statuses[str(status)] = statuses.get(str(status), 0) + 1
+                counts[arm] = counts.get(arm, 0) + 1
+                if user not in first_arm:
+                    first_arm[user] = arm
+            wall = time.perf_counter() - t0
+            compiles = (EXECUTABLES.counts().get("compile", 0)
+                        - compiles_before)
+            sticky_violations = sum(
+                1 for i in rng.integers(0, n, 200)
+                if ask(conn, str(int(i)))[1] != first_arm[str(int(i))])
+            chal_share = counts.get("challenger", 0) / n
+            assert statuses.get("200") == n, \
+                f"non-200s in split pass: {statuses}"
+            assert abs(chal_share - 0.10) <= 0.01, \
+                f"challenger share {chal_share:.4f} outside 10%±1%"
+            assert sticky_violations == 0, \
+                f"{sticky_violations} sticky-assignment violations"
+            assert compiles == 0, \
+                f"{compiles} XLA compiles on the serving path"
+
+            # -- 2. mid-swap kill --------------------------------------
+            FAULTS.arm("variant.reload.partial", error="mid-swap kill")
+            try:
+                conn.request("GET", "/reload?variant=challenger")
+                r = conn.getresponse()
+                reload_body = json.loads(r.read())
+                reload_status = r.status
+            finally:
+                FAULTS.disarm()
+            conn.request("GET", "/health")
+            h = conn.getresponse()
+            health = json.loads(h.read())
+            chal_state = health["variants"]["variants"]["challenger"]["state"]
+            after = {}
+            for i in range(500):
+                status, arm = ask(conn, str(i))
+                assert status == 200, f"post-kill query {i} -> {status}"
+                after[arm] = after.get(arm, 0) + 1
+            conn.close()
+            assert reload_status == 500, \
+                f"partial swap answered {reload_status}, want 500"
+            assert reload_body.get("swap") == "failed", reload_body
+            assert chal_state == "failed", \
+                f"challenger state {chal_state!r} after mid-swap kill"
+            assert after == {"champion": 500}, \
+                f"split did not fall back to 100/0: {after}"
+
+        print(json.dumps({
+            "metric": "variant_multiplexing",
+            "geometry": {"n_users": args.n_users, "n_items": args.n_items,
+                         "rank": args.rank},
+            "generations": {"champion": champ_gen,
+                            "challenger": chal_gen},
+            "queries": n,
+            "qps": round(n / wall, 1),
+            "split": {"weights": "champion:9,challenger:1",
+                      "observed": counts,
+                      "challenger_share": round(chal_share, 4),
+                      "sticky_violations": sticky_violations},
+            "statuses": statuses,
+            "serving_path_compiles": compiles,
+            "mid_swap_kill": {"reload_status": reload_status,
+                              "challenger_state": chal_state,
+                              "post_kill_split": after},
+            "ok": True,
+        }))
+    finally:
+        shutil.rmtree(home, ignore_errors=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--queries", type=int, default=2000)
@@ -974,6 +1128,14 @@ def main() -> None:
                     default=0, help=argparse.SUPPRESS)
     ap.add_argument("--_replica-home", dest="replica_home", default="",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--variants", action="store_true",
+                    help="multi-model multiplexing chaos mode: two "
+                         "registry generations resident on one replica "
+                         "under a 90/10 split; proves split fidelity "
+                         "±1%% with sticky assignment, champion "
+                         "survival of a mid-swap kill "
+                         "(variant.reload.partial), and zero "
+                         "serving-path compiles")
     ap.add_argument("--aot", action="store_true",
                     help="AOT bucket-ladder mode: cold vs warm ladder "
                          "compile wall time + per-bucket device p50, "
@@ -998,6 +1160,11 @@ def main() -> None:
     from profile_common import make_memory_storage, resolve_platform
 
     jax = resolve_platform(args.platform)
+    if args.variants:
+        # home-backed storage of its own (the model registry lives on
+        # the filesystem) — skips the shared memory-storage setup
+        run_variants_mode(args)
+        return
     from predictionio_tpu.core.workflow import prepare_deploy
     from predictionio_tpu.models.als import ResidentScorer
     from predictionio_tpu.server.engine_server import EngineServer

@@ -444,7 +444,7 @@ class TestMicroBatcherLadder:
         async def main():
             mb = MicroBatcher(lambda qs: qs, max_batch=4)
             fut = asyncio.get_running_loop().create_future()
-            await mb._queue.put(("orphan", fut))
+            await mb._queue.put(("orphan", fut, None))
             mb.stop()
             return fut
 

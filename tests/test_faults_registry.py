@@ -100,6 +100,13 @@ class TestFaultSiteAudit:
         assert {"train.crash", "train.lease.lost",
                 "promote.regression"} <= table_sites()
 
+    def test_variant_sites_are_registered(self):
+        """The multi-model multiplexing drill sites must stay in the
+        table: the chaos harness (``profile_serving.py --variants``)
+        and the challenger runbook both arm them by name."""
+        assert {"variant.assign.skew",
+                "variant.reload.partial"} <= table_sites()
+
     def test_ann_index_site_is_registered(self):
         """The ANN retrieval-index drill site must stay in the table:
         ``pio fsck`` detection and the ``/reload``-refusal drill

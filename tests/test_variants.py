@@ -1,0 +1,842 @@
+"""Multi-model HBM multiplexing (ISSUE 11): resident variant sets,
+deterministic weighted splits, per-variant micro-batching, online
+champion/challenger scoring, and the ``--gate online`` promotion gate.
+
+Fault sites exercised here (closure-audited by test_faults_registry):
+``variant.assign.skew``, ``variant.reload.partial``.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from predictionio_tpu.core.workflow import run_train
+from predictionio_tpu.data.event import Event, utcnow
+from predictionio_tpu.data.events import MemoryEventStore
+from predictionio_tpu.server.batching import MicroBatcher
+from predictionio_tpu.server.engine_server import EngineServer
+from predictionio_tpu.server.trainer import ContinuousTrainer, TrainerConfig
+from predictionio_tpu.server.variant_metrics import VariantScoreboard
+from predictionio_tpu.server.variants import (
+    VariantError,
+    VariantSet,
+    entity_of,
+    parse_weights,
+    weighted_assign,
+)
+from predictionio_tpu.storage.meta import EngineInstance, MetaStore
+from predictionio_tpu.storage.models import MemoryModelStore, model_registry
+from predictionio_tpu.storage.registry import (
+    Storage,
+    StorageConfig,
+    set_storage,
+)
+from predictionio_tpu.utils import faults
+from tests.test_servers import ServerThread, free_port, http
+
+FACTORY = "predictionio_tpu.templates.recommendation.engine:engine_factory"
+
+VARIANT = {
+    "id": "default",
+    "engineFactory": FACTORY,
+    "datasource": {"params": {"appName": "VariantApp"}},
+    "algorithms": [{"name": "als",
+                    "params": {"rank": 8, "numIterations": 6,
+                               "lambda": 0.05}}],
+}
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.FAULTS.disarm()
+    yield
+    faults.FAULTS.disarm()
+
+
+@pytest.fixture()
+def home_storage(tmp_path):
+    """In-memory backends over a real on-disk home (the model registry
+    lives under ``storage.config.home``)."""
+    st = Storage(StorageConfig(metadata_type="MEMORY",
+                               eventdata_type="MEMORY",
+                               modeldata_type="MEMORY",
+                               home=str(tmp_path)))
+    st._meta = MetaStore(":memory:")
+    st._events = MemoryEventStore()
+    st._models = MemoryModelStore()
+    set_storage(st)
+    yield st
+    set_storage(None)
+
+
+# -- spec parsing --------------------------------------------------------------
+
+
+class TestParseWeights:
+    def test_basic_and_equals_grammar(self):
+        specs = parse_weights("champion:9,challenger:1")
+        assert [(s.name, s.weight, s.gen) for s in specs] == [
+            ("champion", 9.0, None), ("challenger", 1.0, None)]
+        assert parse_weights("a=1,b=3")[1].weight == 3.0
+
+    def test_generation_pins(self):
+        specs = parse_weights("champion@3:90,canary@5:10")
+        assert [(s.name, s.gen) for s in specs] == [
+            ("champion", 3), ("canary", 5)]
+
+    def test_rejections(self):
+        for bad in ("", "champion", "champion:", "champion:x",
+                    "champion:9,champion:1",     # duplicate
+                    "Champ!on:9",                # bad name
+                    "a:0,b:0",                   # zero-sum
+                    "a:-1,b:2"):                 # negative
+            with pytest.raises(VariantError):
+                parse_weights(bad)
+
+    def test_zero_weight_arm_is_allowed(self):
+        # a parked arm: resident, no traffic (until set-weights revives it)
+        specs = parse_weights("champion:1,shadow:0")
+        assert specs[1].weight == 0.0
+
+
+class TestWeightedAssign:
+    ARMS = [("champion", 9.0), ("challenger", 1.0)]
+
+    def test_deterministic_and_sticky(self):
+        first = {str(i): weighted_assign(str(i), self.ARMS)
+                 for i in range(500)}
+        for i in range(500):
+            assert weighted_assign(str(i), self.ARMS) == first[str(i)]
+
+    def test_split_within_one_percent_at_20k_entities(self):
+        n = 20_000
+        chal = sum(1 for i in range(n)
+                   if weighted_assign(str(i), self.ARMS) == "challenger")
+        assert abs(chal / n - 0.10) <= 0.01
+
+    def test_salt_changes_assignment_weights_do_not_flip_everyone(self):
+        moved = sum(1 for i in range(1000)
+                    if weighted_assign(str(i), self.ARMS, salt="a")
+                    != weighted_assign(str(i), self.ARMS, salt="b"))
+        assert moved > 0  # a new salt reshuffles...
+        # ...but the SAME salt with widened weights keeps champion users
+        # in place (hash-walk monotonicity: only boundary users move)
+        wide = [("champion", 95.0), ("challenger", 5.0)]
+        for i in range(1000):
+            if weighted_assign(str(i), self.ARMS) == "champion":
+                assert weighted_assign(str(i), wide) == "champion"
+
+    def test_no_positive_weight_raises(self):
+        with pytest.raises(VariantError):
+            weighted_assign("u", [])
+
+    def test_entity_of(self):
+        assert entity_of({"user": "42", "num": 10}) == "42"
+        assert entity_of({"item": 7}) == "7"
+        # no entity key: canonical JSON of the query (deterministic)
+        assert entity_of({"num": 10}) == entity_of({"num": 10})
+
+
+# -- VariantSet (stubbed engines over a real registry) -------------------------
+
+
+class FakeDeployed:
+    def __init__(self, iid):
+        self.iid = iid
+        self.probed = []
+
+    def query(self, q):
+        self.probed.append(q)
+        return {"echo": self.iid}
+
+
+def _registry_with(storage, gens):
+    """Register instance ids as generations; first is promoted champion."""
+    reg = model_registry(storage)
+    out = []
+    for i, iid in enumerate(gens):
+        g = reg.register(iid, f"blob-{iid}".encode())
+        if i == 0:
+            reg.promote(g)
+        out.append(g)
+    return reg, out
+
+
+def _varset(storage, spec="champion:9,challenger:1", prepare=None, **kw):
+    return VariantSet(storage, spec,
+                      prepare=prepare or (lambda iid: FakeDeployed(iid)),
+                      **kw)
+
+
+class TestVariantSet:
+    def test_resolution_champion_and_newest_challenger(self, home_storage):
+        _registry_with(home_storage, ["i-champ", "i-cand1", "i-cand2"])
+        vs = _varset(home_storage)
+        vs.load()
+        assert vs.get("champion").instance_id == "i-champ"
+        # unpinned challenger = NEWEST non-champion live generation
+        assert vs.get("challenger").instance_id == "i-cand2"
+        assert vs.get("champion").serving() and vs.get("challenger").serving()
+
+    def test_pinned_generation(self, home_storage):
+        reg, gens = _registry_with(home_storage, ["i1", "i2", "i3"])
+        vs = _varset(home_storage, f"champion:9,canary@{gens[1]}:1")
+        vs.load()
+        assert vs.get("canary").gen == gens[1]
+        assert vs.get("canary").instance_id == "i2"
+
+    def test_retired_generations_are_not_challengers(self, home_storage):
+        reg, gens = _registry_with(home_storage, ["i1", "i2", "i3"])
+        reg.mark(gens[2], "rolled_back")
+        vs = _varset(home_storage)
+        vs.load()
+        assert vs.get("challenger").instance_id == "i2"
+
+    def test_default_arm_load_failure_propagates(self, home_storage):
+        vs = _varset(home_storage)  # empty registry
+        with pytest.raises(VariantError):
+            vs.load()
+
+    def test_failed_challenger_folds_into_default(self, home_storage):
+        _registry_with(home_storage, ["i-champ", "i-cand"])
+
+        def prepare(iid):
+            if iid == "i-cand":
+                raise RuntimeError("challenger blob corrupt")
+            return FakeDeployed(iid)
+
+        vs = _varset(home_storage, prepare=prepare)
+        vs.load()
+        assert vs.get("challenger").state == "failed"
+        assert vs.effective_weights() == [("champion", 10.0)]
+        for i in range(50):  # 100/0: every entity lands on champion
+            assert vs.choose(str(i)) == "champion"
+
+    def test_choose_override_must_be_serving(self, home_storage):
+        _registry_with(home_storage, ["i-champ", "i-cand"])
+        vs = _varset(home_storage)
+        vs.load()
+        assert vs.choose("u1", override="challenger") == "challenger"
+        with pytest.raises(VariantError):
+            vs.choose("u1", override="nope")
+
+    def test_assign_skew_fault_lands_everything_on_default(
+            self, home_storage):
+        _registry_with(home_storage, ["i-champ", "i-cand"])
+        vs = _varset(home_storage, "champion:1,challenger:1")
+        vs.load()
+        challenger_users = [str(i) for i in range(200)
+                            if vs.choose(str(i)) == "challenger"]
+        assert challenger_users  # 50/50: some users DO get the challenger
+        faults.FAULTS.arm("variant.assign.skew", error="skew drill")
+        assert all(vs.choose(u) == "champion" for u in challenger_users)
+
+    def test_set_weights_probe_then_apply(self, home_storage):
+        _registry_with(home_storage, ["i-champ", "i-cand"])
+        vs = _varset(home_storage)
+        vs.load()
+        with pytest.raises(VariantError):
+            vs.set_weights({"champion": 1, "ghost": 1})
+        with pytest.raises(VariantError):
+            vs.set_weights({"champion": 0})
+        before = vs.weights_epoch
+        eff = vs.set_weights({"champion": 7, "challenger": 3})
+        assert eff == [("champion", 7.0), ("challenger", 3.0)]
+        assert vs.weights_epoch == before + 1
+        # an arm not named keeps weight 0 — an explicit retire
+        assert dict(vs.set_weights({"champion": 1}))["champion"] == 1.0
+        assert vs.get("challenger").spec.weight == 0.0
+
+    def test_set_weights_refuses_failed_arm(self, home_storage):
+        _registry_with(home_storage, ["i-champ", "i-cand"])
+
+        def prepare(iid):
+            if iid == "i-cand":
+                raise RuntimeError("dead")
+            return FakeDeployed(iid)
+
+        vs = _varset(home_storage, prepare=prepare)
+        vs.load()
+        with pytest.raises(VariantError):
+            vs.set_weights({"champion": 1, "challenger": 1})
+
+    def test_reload_partial_fault_fails_closed_to_100_0(self, home_storage):
+        _registry_with(home_storage, ["i-champ", "i-cand"])
+        vs = _varset(home_storage)
+        vs.load()
+        faults.FAULTS.arm("variant.reload.partial", error="mid-swap kill")
+        out = vs.reload_variant("challenger")
+        assert out["outcome"] == "failed"
+        assert vs.get("challenger").state == "failed"
+        assert vs.get("challenger").deployed is None
+        assert vs.effective_weights() == [("champion", 10.0)]
+        # the champion never noticed
+        assert vs.get("champion").serving()
+        faults.FAULTS.disarm()
+        # the next (clean) reload brings the challenger back
+        out = vs.reload_variant("challenger")
+        assert out["outcome"] == "promoted"
+        assert dict(vs.effective_weights()) == {
+            "champion": 9.0, "challenger": 1.0}
+
+    def test_default_arm_reload_failure_keeps_last_good(self, home_storage):
+        _registry_with(home_storage, ["i-champ", "i-cand"])
+        vs = _varset(home_storage)
+        vs.load()
+        old = vs.get("champion").deployed
+        faults.FAULTS.arm("variant.reload.partial", error="mid-swap kill")
+        out = vs.reload_variant("champion")
+        assert out["outcome"] == "rolled_back"
+        assert vs.get("champion").deployed is old
+        assert vs.get("champion").serving()
+
+    def test_reload_probe_failure_counts_as_swap_failure(self, home_storage):
+        _registry_with(home_storage, ["i-champ", "i-cand"])
+        vs = _varset(home_storage)
+        vs.load()
+
+        def probe(candidate):
+            raise RuntimeError("probe query failed")
+
+        assert vs.reload_variant("challenger", probe)["outcome"] == "failed"
+
+    def test_snapshot_shape(self, home_storage):
+        _registry_with(home_storage, ["i-champ", "i-cand"])
+        vs = _varset(home_storage)
+        vs.load()
+        snap = vs.snapshot()
+        assert snap["default"] == "champion"
+        arm = snap["variants"]["challenger"]
+        assert arm["state"] == "ready"
+        assert arm["engineInstanceId"] == "i-cand"
+        assert 0.0 < arm["effectiveWeight"] < 1.0
+
+
+# -- scoreboard ----------------------------------------------------------------
+
+
+class TestVariantScoreboard:
+    def test_rating_feedback_accrues_rmse(self):
+        sb = VariantScoreboard()
+        sb.observe_request("challenger", 0.01, "200")
+        sb.record_served("pr1", "challenger", {
+            "itemScores": [{"item": "7", "score": 3.0}]})
+        assert sb.observe_feedback(pr_id="pr1", rating=4.0,
+                                   item="7") == "challenger"
+        snap = sb.snapshot()["challenger"]
+        assert snap["ratedPairs"] == 1
+        assert snap["onlineRmse"] == pytest.approx(1.0)
+
+    def test_click_feedback_accrues_ctr(self):
+        sb = VariantScoreboard()
+        for _ in range(4):
+            sb.observe_request("champion", 0.01, "200")
+        sb.record_served("pr1", "champion", {"itemScores": []})
+        assert sb.observe_feedback(pr_id="pr1", clicked=True) == "champion"
+        assert sb.snapshot()["champion"]["ctr"] == pytest.approx(0.25)
+
+    def test_unattributable_feedback_is_dropped(self):
+        sb = VariantScoreboard()
+        assert sb.observe_feedback(pr_id="ghost", rating=5.0) is None
+
+    def test_explicit_variant_beats_unknown_prid(self):
+        sb = VariantScoreboard()
+        assert sb.observe_feedback(pr_id="ghost", variant="canary",
+                                   rating=2.0) == "canary"
+
+    def test_served_map_is_bounded(self):
+        sb = VariantScoreboard(capacity=10)
+        for i in range(25):
+            sb.record_served(f"pr{i}", "champion", {"itemScores": []})
+        assert sb.resolve("pr0") is None
+        assert sb.resolve("pr24") == "champion"
+
+
+# -- micro-batcher grouping ----------------------------------------------------
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class TestMicroBatcherGroups:
+    def test_groups_never_share_a_dispatch(self):
+        dispatched = []
+
+        def fn(queries, group):
+            dispatched.append((group, list(queries)))
+            return [f"{group}:{q}" for q in queries]
+
+        async def drive():
+            mb = MicroBatcher(fn, max_batch=64)
+            outs = await asyncio.gather(
+                *(mb.submit(i, group="a" if i % 2 else "b")
+                  for i in range(10)))
+            mb.stop()
+            return outs
+
+        outs = _run(drive())
+        assert outs == [f"{'a' if i % 2 else 'b'}:{i}" for i in range(10)]
+        for group, queries in dispatched:
+            assert all(f"{group}:{q}" == f"{group}:{q}" for q in queries)
+        # no dispatch carried a query from the other group
+        for group, queries in dispatched:
+            other = "a" if group == "b" else "b"
+            assert all((q % 2 == 1) == (group == "a") for q in queries), \
+                f"group {group} dispatched {queries} (mixed with {other})"
+
+    def test_per_group_ladder_pads_that_group_only(self):
+        from predictionio_tpu.server.aot import PAD, BucketLadder
+
+        sizes = {}
+
+        def fn(queries, group):
+            sizes.setdefault(group, []).append(len(queries))
+            return ["r" if q is not PAD else None for q in queries]
+
+        async def drive():
+            mb = MicroBatcher(fn, max_batch=64,
+                              ladder=BucketLadder((2,)))
+            mb.set_group_ladder("big", BucketLadder((8,)))
+            a = await mb.submit("q", group="big")
+            b = await mb.submit("q", group=None)
+            mb.stop()
+            return a, b
+
+        assert _run(drive()) == ("r", "r")
+        assert sizes == {"big": [8], None: [2]}
+
+    def test_single_arg_batch_fn_still_works(self):
+        def fn(queries):  # legacy single-model signature
+            return [q * 2 for q in queries]
+
+        async def drive():
+            mb = MicroBatcher(fn, max_batch=8)
+            out = await mb.submit(21)
+            mb.stop()
+            return out
+
+        assert _run(drive()) == 42
+
+    def test_stop_clears_group_ladders(self):
+        """Regression (ISSUE 11 satellite): a stop()/serve-again cycle
+        must not pad against the previous variant set's ladders."""
+        from predictionio_tpu.server.aot import BucketLadder
+
+        sizes = []
+
+        def fn(queries, group):
+            sizes.append(len(queries))
+            return list(queries)
+
+        async def drive():
+            mb = MicroBatcher(fn, max_batch=8)
+            mb.set_group_ladder("v", BucketLadder((4,)))
+            await mb.submit("q", group="v")
+            mb.stop()
+            assert mb._group_ladders == {}
+            # restart: same group name, NO ladder — must not pad to 4
+            await mb.submit("q", group="v")
+            mb.stop()
+
+        _run(drive())
+        assert sizes == [4, 1]
+
+
+# -- engine server integration (real sockets, real trained engines) -----------
+
+
+def seed_and_train(storage, app_name="VariantApp"):
+    a = storage.meta.create_app(app_name)
+    storage.events.init_channel(a.id)
+    for u in range(12):
+        for i in range(10):
+            if (u + i) % 2 == 0:
+                storage.events.insert(Event(
+                    event="rate", entity_type="user", entity_id=str(u),
+                    target_entity_type="item", target_entity_id=str(i),
+                    properties={"rating": 4.0}), a.id)
+    iid = run_train(FACTORY, variant=VARIANT, storage=storage,
+                    use_mesh=False)
+    return a, iid
+
+
+def http_full(method, url, body=None, headers=None):
+    import urllib.error
+    import urllib.request
+
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, json.loads(r.read().decode() or "null"), \
+                dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "null"), \
+            dict(e.headers)
+
+
+class TestEngineServerVariants:
+    def test_split_serving_feedback_and_partial_reload(self, home_storage):
+        _, iid = seed_and_train(home_storage)
+        reg = model_registry(home_storage)
+        g1 = reg.register(iid, b"gen1")
+        reg.promote(g1)
+        iid2 = run_train(FACTORY, variant=VARIANT, storage=home_storage,
+                         use_mesh=False)
+        g2 = reg.register(iid2, b"gen2")
+        port = free_port()
+        server = EngineServer(
+            engine_factory=FACTORY, storage=home_storage,
+            host="127.0.0.1", port=port, feedback=True,
+            variants="champion:1,challenger:1", variant_salt="t")
+        with ServerThread(server):
+            base = f"http://127.0.0.1:{port}"
+
+            # health reports the resident set, per-arm generation
+            code, h, _ = http_full("GET", f"{base}/health")
+            assert code == 200
+            arms = h["variants"]["variants"]
+            assert arms["champion"]["generation"] == g1
+            assert arms["challenger"]["generation"] == g2
+            assert {v["state"] for v in arms.values()} == {"ready"}
+
+            # 50/50 split: deterministic, sticky, tagged via the header
+            seen = {}
+            for u in range(12):
+                code, pred, hh = http_full(
+                    "POST", f"{base}/queries.json",
+                    {"user": str(u), "num": 3})
+                assert code == 200 and pred["itemScores"]
+                seen[str(u)] = hh["X-PIO-Variant"]
+            assert set(seen.values()) == {"champion", "challenger"}
+            for u, arm in seen.items():  # sticky on re-query
+                _, _, hh = http_full("POST", f"{base}/queries.json",
+                                     {"user": u, "num": 3})
+                assert hh["X-PIO-Variant"] == arm
+
+            # the override header forces an arm; an unknown arm is a 400
+            code, _, hh = http_full("POST", f"{base}/queries.json",
+                                    {"user": "1", "num": 3},
+                                    headers={"X-PIO-Variant": "challenger"})
+            assert code == 200 and hh["X-PIO-Variant"] == "challenger"
+            code, body, _ = http_full("POST", f"{base}/queries.json",
+                                      {"user": "1", "num": 3},
+                                      headers={"X-PIO-Variant": "ghost"})
+            assert code == 400 and "ghost" in body["message"]
+
+            # /feedback.json closes the online loop per arm
+            code, pred, hh = http_full("POST", f"{base}/queries.json",
+                                       {"user": "2", "num": 3})
+            arm = hh["X-PIO-Variant"]
+            item = pred["itemScores"][0]["item"]
+            code, fb, _ = http_full(
+                "POST", f"{base}/feedback.json",
+                {"prId": pred["prId"], "rating": 4.0, "item": item})
+            assert code == 200 and fb["variant"] == arm
+            code, _, _ = http_full("POST", f"{base}/feedback.json",
+                                   {"prId": "ghost", "rating": 1.0})
+            assert code == 404
+            code, snap, _ = http_full("GET", f"{base}/variants")
+            assert code == 200
+            assert snap["variants"][arm]["online"]["ratedPairs"] == 1
+            assert snap["variants"][arm]["online"]["onlineRmse"] is not None
+
+            # the per-variant series are live on /metrics
+            import urllib.request
+
+            with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+                prom = r.read().decode()
+            assert "pio_variant_requests_total" in prom
+            assert f'pio_variant_online_rmse{{variant="{arm}"}}' in prom
+
+            # POST /variants/weights: probe-then-apply, 409 on unknown
+            code, body, _ = http_full("POST", f"{base}/variants/weights",
+                                      {"weights": {"ghost": 1}})
+            assert code == 409
+            code, body, _ = http_full(
+                "POST", f"{base}/variants/weights",
+                {"weights": {"champion": 3, "challenger": 1}})
+            assert code == 200 and body["applied"]
+            assert body["effectiveWeights"] == {
+                "champion": 3.0, "challenger": 1.0}
+
+            # mid-swap kill: challenger drops out, champion absorbs all
+            faults.FAULTS.arm("variant.reload.partial",
+                              error="mid-swap kill")
+            code, body, _ = http_full(
+                "GET", f"{base}/reload?variant=challenger")
+            assert code == 500 and body["swap"] == "failed"
+            faults.FAULTS.disarm()
+            code, h, _ = http_full("GET", f"{base}/health")
+            assert code == 200 and h["status"] == "degraded"
+            assert "challenger" in h["reason"]
+            for u in range(10):
+                code, _, hh = http_full("POST", f"{base}/queries.json",
+                                        {"user": str(u), "num": 3})
+                assert code == 200
+                assert hh["X-PIO-Variant"] == "champion"
+
+            # a clean reload brings the challenger back into the split
+            code, body, _ = http_full(
+                "GET", f"{base}/reload?variant=challenger")
+            assert code == 200 and body["swap"] == "promoted"
+            code, h, _ = http_full("GET", f"{base}/health")
+            assert h["status"] == "ok"
+            # an unknown arm 404s
+            code, _, _ = http_full("GET", f"{base}/reload?variant=ghost")
+            assert code == 404
+
+    def test_single_model_server_has_no_variant_surface(self, home_storage):
+        _, iid = seed_and_train(home_storage)
+        port = free_port()
+        server = EngineServer(engine_factory=FACTORY, storage=home_storage,
+                              host="127.0.0.1", port=port)
+        with ServerThread(server):
+            base = f"http://127.0.0.1:{port}"
+            code, _, hh = http_full("POST", f"{base}/queries.json",
+                                    {"user": "2", "num": 3})
+            assert code == 200 and "X-PIO-Variant" not in hh
+            assert http("GET", f"{base}/variants")[0] == 404
+            assert http("POST", f"{base}/feedback.json",
+                        {"prId": "x"})[0] == 404
+            code, h, _ = http_full("GET", f"{base}/health")
+            assert code == 200 and "variants" not in h
+
+
+# -- router manifest pins ------------------------------------------------------
+
+
+class TestRouterVariantPins:
+    def test_manifest_pins_are_pushed_idempotently(self, tmp_path):
+        from predictionio_tpu.server.router import OK, FleetRouter
+
+        manifest = tmp_path / "fleet.txt"
+        manifest.write_text(
+            "# fleet\n127.0.0.1:18000 variants=champion:9,challenger:1\n"
+            "127.0.0.1:18001\n")
+        router = FleetRouter(manifest=str(manifest), host="127.0.0.1",
+                             port=free_port(), hedge=False)
+        pushed = []
+        router._post_weights = lambda url, w: pushed.append((url, dict(w)))
+        assert router._variant_pins == {
+            "127.0.0.1:18000": {"champion": 9.0, "challenger": 1.0}}
+
+        async def tick():
+            await router._push_variant_pins()
+
+        # not pushed while the replica is down (it would refuse anyway)
+        _run(tick())
+        assert pushed == []
+        for rep in router.replicas:
+            rep.state = OK
+        _run(tick())
+        _run(tick())  # idempotent: one push per pin, not per tick
+        assert pushed == [("http://127.0.0.1:18000",
+                           {"champion": 9.0, "challenger": 1.0})]
+        # a changed pin in the manifest is pushed again
+        manifest.write_text(
+            "127.0.0.1:18000 variants=champion:1\n127.0.0.1:18001\n")
+        router._manifest_urls()
+        _run(tick())
+        assert pushed[-1] == ("http://127.0.0.1:18000", {"champion": 1.0})
+        assert len(pushed) == 2
+
+    def test_push_failure_is_retried_next_tick(self, tmp_path):
+        from predictionio_tpu.server.router import OK, FleetRouter
+
+        manifest = tmp_path / "fleet.txt"
+        manifest.write_text("127.0.0.1:18000 variants=champion:1\n")
+        router = FleetRouter(manifest=str(manifest), host="127.0.0.1",
+                             port=free_port(), hedge=False)
+        for rep in router.replicas:
+            rep.state = OK
+        calls = []
+
+        def post(url, w):
+            calls.append(url)
+            if len(calls) == 1:
+                raise OSError("replica restarting")
+
+        router._post_weights = post
+        _run(router._push_variant_pins())
+        _run(router._push_variant_pins())
+        _run(router._push_variant_pins())
+        assert len(calls) == 2  # failed once, converged, then idempotent
+
+    def test_bad_pin_never_takes_the_manifest_down(self, tmp_path):
+        from predictionio_tpu.server.router import FleetRouter
+
+        manifest = tmp_path / "fleet.txt"
+        manifest.write_text("127.0.0.1:18000 variants=:::garbage\n")
+        router = FleetRouter(manifest=str(manifest), host="127.0.0.1",
+                             port=free_port(), hedge=False)
+        assert [r.name for r in router.replicas] == ["127.0.0.1:18000"]
+        assert router._variant_pins == {}
+
+
+# -- the online promotion gate -------------------------------------------------
+
+
+def _seed_events(storage, app_name="LoopApp", n=12):
+    app = storage.meta.create_app(app_name)
+    storage.events.init_channel(app.id)
+    evs = [Event(event="rate", entity_type="user", entity_id=str(i % 4),
+                 target_entity_type="item", target_entity_id=str(i % 3),
+                 properties={"rating": float(1 + i % 5)})
+           for i in range(n)]
+    storage.events.insert_batch(evs, app.id)
+    return app
+
+
+def _stub_train(storage):
+    def train_fn(storage=storage, **_kw):
+        iid = storage.meta.new_instance_id()
+        ei = EngineInstance(
+            id=iid, status="COMPLETED", start_time=utcnow(),
+            end_time=utcnow(), engine_factory="stub:factory",
+            engine_variant="", batch="continuous", env={}, mesh_conf={},
+            data_source_params="{}", preparator_params="{}",
+            algorithms_params="[]", serving_params="{}")
+        storage.meta.insert_engine_instance(ei)
+        storage.models.put(iid, b"model-blob")
+        return iid
+
+    return train_fn
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def clock(self):
+        return self.t
+
+    def sleep(self, seconds):
+        self.t += seconds
+
+
+def _metrics_text(champ_rmse, chal_rmse, pairs=100.0):
+    return (
+        f'pio_variant_online_rmse{{variant="champion"}} {champ_rmse}\n'
+        f'pio_variant_online_rmse{{variant="challenger"}} {chal_rmse}\n'
+        f'pio_variant_feedback_total{{variant="champion",kind="rating"}} '
+        f'{pairs}\n'
+        f'pio_variant_feedback_total{{variant="challenger",kind="rating"}} '
+        f'{pairs}\n')
+
+
+def _online_trainer(storage, metrics_text, **cfg_kw):
+    clk = FakeClock()
+
+    def fake_http(method, url):
+        if url.endswith("/metrics"):
+            return metrics_text
+        return "{}"
+
+    cfg = TrainerConfig(engine_factory="stub:factory", app_name="LoopApp",
+                        min_delta_events=5, poll_interval=0.5,
+                        use_mesh=False, gate="online",
+                        reload_urls=["http://replica:8000"], **cfg_kw)
+    return ContinuousTrainer(cfg, storage=storage, clock=clk.clock,
+                             sleep=clk.sleep,
+                             train_fn=_stub_train(storage), http=fake_http)
+
+
+class TestOnlineGate:
+    def test_regressed_challenger_is_refused(self, home_storage):
+        _seed_events(home_storage)
+        t = _online_trainer(home_storage, _metrics_text(0.80, 1.50))
+        # first cycle: no champion generation yet → the online gate has
+        # a baseline from metrics but promotion of gen 1 passes offline
+        # semantics? No — online gate reads the fleet: challenger rmse
+        # 1.50 vs champion 0.80 is a >5% regression → refused
+        rec = t.run_once()
+        assert rec["outcome"] == "refused"
+        assert "online rmse" in rec["detail"]["reason"]
+        statuses = {e["status"] for e in t.registry.generations()}
+        assert statuses == {"refused"}
+
+    def test_healthy_challenger_is_promoted(self, home_storage):
+        _seed_events(home_storage)
+        t = _online_trainer(home_storage, _metrics_text(0.80, 0.79))
+        rec = t.run_once()
+        assert rec["outcome"] == "promoted"
+        assert rec["detail"]["gate"]["mode"] == "online"
+
+    def test_insufficient_pairs_is_a_trivial_pass(self, home_storage):
+        _seed_events(home_storage)
+        t = _online_trainer(home_storage, _metrics_text(0.80, 9.9, pairs=3))
+        rec = t.run_once()
+        assert rec["outcome"] == "promoted"
+        assert "pass" in rec["detail"]["gate"]["reason"]
+
+    def test_promote_regression_fault_refuses(self, home_storage):
+        _seed_events(home_storage)
+        t = _online_trainer(home_storage, _metrics_text(0.80, 0.79))
+        faults.FAULTS.arm("promote.regression", error="drill")
+        rec = t.run_once()
+        assert rec["outcome"] == "refused"
+        assert rec["detail"]["reason"] == "injected regression"
+
+    def test_gate_both_needs_offline_and_online(self, home_storage):
+        _seed_events(home_storage)
+        t = _online_trainer(home_storage, _metrics_text(0.80, 1.50))
+        t.cfg.gate = "both"
+        rec = t.run_once()
+        assert rec["outcome"] == "refused"
+        assert rec["detail"]["mode"] == "both"
+        assert rec["detail"]["online"]["reason"].startswith(
+            "online rmse")
+
+
+# -- CLI (jax-free surface) ----------------------------------------------------
+
+
+class TestVariantsCLI:
+    def test_variants_verb_stays_jax_free(self):
+        from predictionio_tpu.tools import cli
+
+        assert "variants" not in cli._JAX_VERBS
+
+    def test_set_weights_rejects_generation_pins(self, capsys):
+        from predictionio_tpu.tools import cli
+
+        with pytest.raises(SystemExit):
+            cli.main(["variants", "set-weights", "champion@3:1",
+                      "--url", "http://127.0.0.1:9"])
+        assert "generation pins" in capsys.readouterr().err
+
+    def test_set_weights_probe_failure_changes_nothing(self, capsys):
+        from predictionio_tpu.tools import cli
+
+        # an unreachable replica must abort BEFORE any write
+        with pytest.raises(SystemExit):
+            cli.main(["variants", "set-weights", "champion:1",
+                      "--url", "http://127.0.0.1:9", "--timeout", "0.2"])
+        assert "no weights were changed" in capsys.readouterr().err
+
+    def test_status_against_live_server(self, home_storage, capsys):
+        from predictionio_tpu.tools import cli
+
+        _, iid = seed_and_train(home_storage)
+        reg = model_registry(home_storage)
+        reg.promote(reg.register(iid, b"g1"))
+        reg.register(iid, b"g2")
+        port = free_port()
+        server = EngineServer(
+            engine_factory=FACTORY, storage=home_storage,
+            host="127.0.0.1", port=port,
+            variants="champion:9,challenger:1")
+        with ServerThread(server):
+            cli.main(["variants", "status", "--json",
+                      "--url", f"http://127.0.0.1:{port}"])
+            doc = json.loads(capsys.readouterr().out)
+            snap = doc[f"http://127.0.0.1:{port}"]
+            assert set(snap["variants"]) == {"champion", "challenger"}
+            cli.main(["variants", "set-weights", "champion:4,challenger:1",
+                      "--url", f"http://127.0.0.1:{port}"])
+            assert "weights applied" in capsys.readouterr().out
