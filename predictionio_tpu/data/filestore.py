@@ -14,6 +14,23 @@ Single-writer per namespace file; in-process thread safety via the
 engine's per-handle mutex plus a per-namespace writer lock that covers
 segment rollover (see :mod:`predictionio_tpu.data.segments` for the
 partitioned/tiered layout this store manages per namespace).
+
+**Hot-partition writer sharding.** One ``(app, channel)`` namespace
+can fan its ACTIVE-segment appends across N writer shards (shard 0 is
+the legacy file ``events_<app>[_<ch>].pel``; shard k ≥ 1 is
+``events_<app>[_<ch>].s<k>.pel``), each a full
+:class:`~predictionio_tpu.data.segments.LogNamespace` with its own
+writer lock, rollover, manifest and crash recovery — so one hot app's
+appends stop serializing on a single ``LogNamespace.lock``. Splits are
+writer-lock-free: raising the shard count (``set_shard_policy``, fed
+by quotas.json) just routes NEW writes by entity hash — no data moves,
+shard files roll in behind their own manifests, and the fsck cycle
+picks up ``*.pel``/``*.peld`` shard files unchanged. Reads unify the
+shards for free because every multi-segment read path is already a
+merge: ``find()`` heapq-merges per-shard streams, ``scan_columnar``
+chains every shard's block stream into one
+:func:`~predictionio_tpu.data.pipeline.merge_columnar_segments` call,
+and tombstone propagation walks all shards.
 """
 
 from __future__ import annotations
@@ -26,7 +43,8 @@ import json
 import os
 import struct
 import threading
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+import zlib
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from predictionio_tpu.data.event import (
     Event,
@@ -40,7 +58,7 @@ from predictionio_tpu.data.segments import (
     scan_workers_default,
     segment_bytes_threshold,
 )
-from predictionio_tpu.utils import tracing
+from predictionio_tpu.utils import faults, tracing
 
 _UNBOUNDED_LO = -(2**62)
 _UNBOUNDED_HI = 2**62
@@ -122,8 +140,21 @@ class NativeEventLogStore(EventStore):
         self._lib = lib
         self._dir = directory
         os.makedirs(directory, exist_ok=True)
-        self._namespaces: Dict[Tuple[int, Optional[int]], LogNamespace] = {}
+        # keyed (app_id, channel_id, shard) — shard 0 is the legacy
+        # unsharded file, so existing deployments open unchanged
+        self._namespaces: Dict[
+            Tuple[int, Optional[int], int], LogNamespace] = {}
         self._lock = threading.RLock()
+        # writer-shard policy (app_id -> shard count) and the per-key
+        # count actually visible on disk (monotonic: reads must keep
+        # covering shards even after a policy shrink)
+        self._shard_policy: Optional[Callable[[int], int]] = None
+        self._disk_shards: Dict[Tuple[int, Optional[int]], int] = {}
+        from predictionio_tpu.utils.metrics import REGISTRY
+
+        self._m_shard_appends = REGISTRY.counter(
+            "pio_eventlog_shard_appends_total",
+            "Events appended per writer shard", ("app", "shard"))
         # segment rollover threshold (PIO_SEGMENT_BYTES; 0 disables) and
         # scan fan-out width (None → PIO_SCAN_WORKERS / cpu default)
         self.segment_bytes = segment_bytes_threshold()
@@ -144,13 +175,20 @@ class NativeEventLogStore(EventStore):
 
     # -- plumbing ----------------------------------------------------------
 
-    def _path(self, app_id: int, channel_id: Optional[int]) -> str:
-        name = f"events_{app_id}" + (
+    def _stem(self, app_id: int, channel_id: Optional[int]) -> str:
+        return f"events_{app_id}" + (
             f"_{channel_id}" if channel_id is not None else "")
+
+    def _path(self, app_id: int, channel_id: Optional[int],
+              shard: int = 0) -> str:
+        name = self._stem(app_id, channel_id)
+        if shard:
+            name += f".s{shard}"
         return os.path.join(self._dir, name + ".pel")
 
-    def _ns(self, app_id: int, channel_id: Optional[int]) -> LogNamespace:
-        key = (app_id, channel_id)
+    def _ns(self, app_id: int, channel_id: Optional[int],
+            shard: int = 0) -> LogNamespace:
+        key = (app_id, channel_id, shard)
         with self._lock:
             ns = self._namespaces.get(key)
             if ns is None:
@@ -161,14 +199,87 @@ class NativeEventLogStore(EventStore):
                 fmt = 1 if os.environ.get(
                     "PIO_EVENTLOG_FORMAT", "2") == "1" else 2
                 ns = LogNamespace(
-                    self._lib, self._path(app_id, channel_id), fmt)
+                    self._lib, self._path(app_id, channel_id, shard), fmt)
                 self._namespaces[key] = ns
                 self._account_recovery(ns.h)
+                if shard:
+                    dk = (app_id, channel_id)
+                    self._disk_shards[dk] = max(
+                        self._disk_shards.get(dk, 1), shard + 1)
             return ns
 
     def _handle(self, app_id: int, channel_id: Optional[int]) -> int:
-        """The ACTIVE segment's engine handle."""
+        """The ACTIVE segment's engine handle (writer shard 0)."""
         return self._ns(app_id, channel_id).h
+
+    # -- writer sharding ---------------------------------------------------
+
+    def set_shard_policy(
+            self, policy: Optional[Callable[[int], int]]) -> None:
+        """Install the writer-shard policy: ``policy(app_id)`` names
+        how many ACTIVE writer shards that app's namespaces fan NEW
+        appends across. Raising the count is a writer-lock-free split
+        (new shard files appear on first write); lowering it only
+        redirects new writes — existing shard files keep being read."""
+        self._shard_policy = policy
+
+    def _discovered_shards(self, app_id: int,
+                           channel_id: Optional[int]) -> int:
+        """Shard files present on disk for this namespace (>= 1), so a
+        restarted store (or a shrunk policy) still reads every shard."""
+        key = (app_id, channel_id)
+        with self._lock:
+            n = self._disk_shards.get(key)
+            if n is None:
+                n = 1
+                prefix = self._stem(app_id, channel_id) + ".s"
+                try:
+                    names = os.listdir(self._dir)
+                except OSError:
+                    names = []
+                for name in names:
+                    if name.startswith(prefix) and name.endswith(".pel"):
+                        idx = name[len(prefix):-4]
+                        if idx.isdigit():
+                            n = max(n, int(idx) + 1)
+                self._disk_shards[key] = n
+            return n
+
+    def _shard_count(self, app_id: int, channel_id: Optional[int]) -> int:
+        """Shards READS must cover: max(policy, what's on disk)."""
+        want = 1
+        if self._shard_policy is not None:
+            try:
+                want = max(1, int(self._shard_policy(app_id)))
+            except Exception:
+                want = 1
+        return max(want, self._discovered_shards(app_id, channel_id))
+
+    def _write_shards(self, app_id: int) -> int:
+        """Shards NEW writes fan across (policy only)."""
+        if self._shard_policy is None:
+            return 1
+        try:
+            return max(1, int(self._shard_policy(app_id)))
+        except Exception:
+            return 1
+
+    def _all_ns(self, app_id: int,
+                channel_id: Optional[int]) -> List[LogNamespace]:
+        return [self._ns(app_id, channel_id, s)
+                for s in range(self._shard_count(app_id, channel_id))]
+
+    def _pick_shard(self, entity_id: str, n: int) -> int:
+        if n <= 1:
+            return 0
+        try:
+            # chaos drill: an armed error collapses the hash — every
+            # append lands on shard 0, the visible hot-shard signature
+            # (watch pio_eventlog_shard_appends_total skew)
+            faults.inject("segments.shard.hot")
+        except faults.FaultError:
+            return 0
+        return zlib.crc32((entity_id or "").encode("utf-8")) % n
 
     def namespaces(self) -> List[LogNamespace]:
         with self._lock:
@@ -220,16 +331,18 @@ class NativeEventLogStore(EventStore):
         self._ns(app_id, channel_id)
 
     def remove_channel(self, app_id: int, channel_id: Optional[int] = None) -> None:
-        key = (app_id, channel_id)
+        shards = self._shard_count(app_id, channel_id)
         with self._lock:
-            ns = self._namespaces.pop(key, None)
-            if ns is not None:
-                ns.remove()
-            else:
-                try:
-                    os.unlink(self._path(app_id, channel_id))
-                except FileNotFoundError:
-                    pass
+            for s in range(shards):
+                ns = self._namespaces.pop((app_id, channel_id, s), None)
+                if ns is not None:
+                    ns.remove()
+                else:
+                    try:
+                        os.unlink(self._path(app_id, channel_id, s))
+                    except FileNotFoundError:
+                        pass
+            self._disk_shards.pop((app_id, channel_id), None)
 
     def close(self) -> None:
         with self._lock:
@@ -256,22 +369,60 @@ class NativeEventLogStore(EventStore):
         # validate every event BEFORE appending any: an append-only log
         # has no rollback, so a bad event mid-batch must fail the call
         # without leaving a partial prefix behind
+        n_shards = self._write_shards(app_id)
         frames = []
         ids = []
         client_ids = []
+        shards = []
         for e in events:
             validate_event(e)
             if e.event_id:
                 # caller-supplied id: may overwrite a copy that now
                 # lives in a sealed segment (generated ids cannot)
                 client_ids.append(e.event_id)
+            shards.append(self._pick_shard(e.entity_id, n_shards))
             e = e.with_id()
             frames.append(serialize_event(e))
             ids.append(e.event_id)
-        ns = self._ns(app_id, channel_id)
+        if n_shards <= 1 and self._shard_count(app_id, channel_id) <= 1:
+            self._append_frames(self._ns(app_id, channel_id), frames,
+                                client_ids)
+            return ids  # type: ignore[return-value]
+        # sharded namespace: group frames per writer shard, append each
+        # group under ITS OWN shard lock — concurrent batches for the
+        # same hot app pipeline across shards instead of serializing
+        groups: Dict[int, List[bytes]] = {}
+        for frame, shard in zip(frames, shards):
+            groups.setdefault(shard, []).append(frame)
+        for shard in sorted(groups):
+            self._append_frames(self._ns(app_id, channel_id, shard),
+                                groups[shard], client_ids=None)
+            self._m_shard_appends.inc((app_id, shard),
+                                      n=len(groups[shard]))
+        if client_ids:
+            # a client-supplied id's previous copy may live in ANY
+            # shard (the shard count can change across an id's
+            # lifetime): tombstone sealed copies everywhere, delete
+            # active copies in every shard the new copy did NOT go to
+            dest = {e.event_id: s
+                    for e, s in zip(events, shards) if e.event_id}
+            for s, ns in enumerate(self._all_ns(app_id, channel_id)):
+                with ns.lock:
+                    for eid in client_ids:
+                        if dest.get(eid) == s:
+                            continue  # engine overwrote in-place here
+                        b = eid.encode()
+                        self._lib.pel_delete(ns.h, b, len(b))
+                    if ns.sealed:
+                        ns.tombstone_sealed(client_ids)
+        return ids  # type: ignore[return-value]
+
+    def _append_frames(self, ns: LogNamespace, frames: List[bytes],
+                       client_ids: Optional[List[str]]) -> None:
         # per-namespace writer lock: appends to different (app, channel)
-        # partitions never contend; rollover swaps the active handle
-        # under the same lock
+        # partitions — and different writer shards of one hot partition
+        # — never contend; rollover swaps the active handle under the
+        # same lock
         with ns.lock:
             h = ns.h
             for lo in range(0, len(frames), self._APPEND_CHUNK):
@@ -290,7 +441,6 @@ class NativeEventLogStore(EventStore):
                 # lock behind a cold-tier fetch
                 ns.tombstone_sealed(client_ids)
             ns.maybe_roll(self.segment_bytes)
-        return ids  # type: ignore[return-value]
 
     def append_jsonl(
         self, lines: bytes, n_lines: int, app_id: int,
@@ -315,6 +465,10 @@ class NativeEventLogStore(EventStore):
         arrival order survives the time sort and creationTime
         watermarks are strictly monotonic; the store-level floor below
         extends that guarantee across chunks.
+
+        Bulk import always appends to writer shard 0 (the serving-path
+        hot-partition problem sharding solves does not apply to a
+        offline import); reads merge shard 0 with the others as usual.
         """
         import time as _time
 
@@ -370,46 +524,49 @@ class NativeEventLogStore(EventStore):
         return int(n), fallback
 
     def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
-        ns = self._ns(app_id, channel_id)
         b = event_id.encode()
-        r = self._lib.pel_delete(ns.h, b, len(b))
-        if r < 0:
-            raise IOError("event log delete failed")
-        if r:
-            return True
-        # not in the active segment — the live copy may sit in a
-        # sealed segment (each id is alive in at most one segment)
-        if ns.sealed:
-            return bool(ns.tombstone_sealed([event_id]))
-        return False
+        deleted = False
+        # the live copy sits in at most one segment of one shard, but a
+        # resharded id may have stale copies elsewhere — walk them all
+        for ns in self._all_ns(app_id, channel_id):
+            r = self._lib.pel_delete(ns.h, b, len(b))
+            if r < 0:
+                raise IOError("event log delete failed")
+            if r:
+                deleted = True
+                continue
+            if ns.sealed and ns.tombstone_sealed([event_id]):
+                deleted = True
+        return deleted
 
     def wipe(self, app_id: int, channel_id: Optional[int] = None) -> None:
-        ns = self._ns(app_id, channel_id)
-        if not ns.wipe():
-            # the active handle may have lost its backing FILE* — drop
-            # the namespace so the next call reopens instead of
-            # segfaulting
-            with self._lock:
-                if self._namespaces.pop((app_id, channel_id), None) is not None:
-                    ns.close()
-            raise IOError("event log wipe failed")
+        for s, ns in enumerate(self._all_ns(app_id, channel_id)):
+            if not ns.wipe():
+                # the active handle may have lost its backing FILE* —
+                # drop the namespace so the next call reopens instead
+                # of segfaulting
+                with self._lock:
+                    if self._namespaces.pop(
+                            (app_id, channel_id, s), None) is not None:
+                        ns.close()
+                raise IOError("event log wipe failed")
 
     # -- reads --------------------------------------------------------------
 
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
-        ns = self._ns(app_id, channel_id)
         b = event_id.encode()
-        # active first (freshest copy), then sealed newest→oldest
-        for h in itertools.chain(
-                (ns.h,),
-                (ns.handle_for(seg) for seg in ns.sealed[::-1])):
-            out = ctypes.c_void_p()
-            n = self._lib.pel_get(h, b, len(b), ctypes.byref(out))
-            if n < 0:
-                raise IOError("event log get failed")
-            if n:
-                payload = self._take(out, n)
-                return deserialize_payload(payload, 0, len(payload))
+        for ns in self._all_ns(app_id, channel_id):
+            # active first (freshest copy), then sealed newest→oldest
+            for h in itertools.chain(
+                    (ns.h,),
+                    (ns.handle_for(seg) for seg in ns.sealed[::-1])):
+                out = ctypes.c_void_p()
+                n = self._lib.pel_get(h, b, len(b), ctypes.byref(out))
+                if n < 0:
+                    raise IOError("event log get failed")
+                if n:
+                    payload = self._take(out, n)
+                    return deserialize_payload(payload, 0, len(payload))
         return None
 
     def find(
@@ -426,7 +583,7 @@ class NativeEventLogStore(EventStore):
         limit: Optional[int] = None,
         reversed: bool = False,
     ) -> Iterator[Event]:
-        ns = self._ns(app_id, channel_id)
+        ns_list = self._all_ns(app_id, channel_id)
         args = (
             _ts_us(start_time) if start_time else _UNBOUNDED_LO,
             _ts_us(until_time) if until_time else _UNBOUNDED_HI,
@@ -438,8 +595,8 @@ class NativeEventLogStore(EventStore):
             bool(reversed),
             limit if (limit is not None and limit >= 0) else -1,
         )
-        if not ns.sealed:
-            yield from self._find_one(ns.h, *args)
+        if len(ns_list) == 1 and not ns_list[0].sealed:
+            yield from self._find_one(ns_list[0].h, *args)
             return
         # each segment returns its matches already (eventTime,
         # creationTime)-sorted; a stable k-way merge preserves the
@@ -447,15 +604,20 @@ class NativeEventLogStore(EventStore):
         # are listed in append order (reversed for descending scans) —
         # identical to what a single-file scan's seq tiebreak yields,
         # because rollover never splits identical (time, creation)
-        # runs across a seq inversion.
-        if reversed:
-            handles = itertools.chain(
-                (ns.h,), (ns.handle_for(s) for s in ns.sealed[::-1]))
-        else:
-            handles = itertools.chain(
-                (ns.handle_for(s) for s in ns.sealed), (ns.h,))
+        # runs across a seq inversion. Writer shards join the same
+        # merge (shard order breaks cross-shard ties — events with
+        # identical timestamps down to the microsecond).
+        streams = []
+        for ns in ns_list:
+            if reversed:
+                handles = itertools.chain(
+                    (ns.h,), (ns.handle_for(s) for s in ns.sealed[::-1]))
+            else:
+                handles = itertools.chain(
+                    (ns.handle_for(s) for s in ns.sealed), (ns.h,))
+            streams.extend(self._find_one(h, *args) for h in handles)
         merged = heapq.merge(
-            *(self._find_one(h, *args) for h in handles),
+            *streams,
             key=lambda e: (e.event_time, e.creation_time),
             reverse=bool(reversed))
         if args[-1] >= 0:
@@ -491,9 +653,9 @@ class NativeEventLogStore(EventStore):
         json-loads-equal — raw property spans re-emit verbatim). The
         cursor walks the time-sorted order; don't interleave writes."""
         ns = self._ns(app_id, channel_id)
-        if ns.sealed:
-            # partitioned namespace: the native export cursor is
-            # per-file, so stream the merged find() order instead
+        if ns.sealed or self._shard_count(app_id, channel_id) > 1:
+            # partitioned/sharded namespace: the native export cursor
+            # is per-file, so stream the merged find() order instead
             it = self.find(app_id, channel_id)
             while True:
                 batch = list(itertools.islice(it, chunk_events))
@@ -553,11 +715,18 @@ class NativeEventLogStore(EventStore):
 
         from predictionio_tpu.data.pipeline import ColumnarEvents
 
-        ns = self._ns(app_id, channel_id)
-        if ns.sealed:
-            # partitioned namespace: fan the scan out across segments
-            # (sidecar-served where compacted) and merge
-            cols = ns.scan_columnar(
+        ns_list = self._all_ns(app_id, channel_id)
+        ns = ns_list[0]
+        if len(ns_list) > 1 or ns.sealed:
+            # partitioned and/or writer-sharded namespace: fan the scan
+            # out across every shard's segments (sidecar-served where
+            # compacted) and feed ALL block streams through ONE merge —
+            # identical to a single-file scan of the union
+            from predictionio_tpu.data.pipeline import (
+                merge_columnar_segments,
+            )
+
+            scan_args = (
                 _ts_us(start_time) if start_time else _UNBOUNDED_LO,
                 _ts_us(until_time) if until_time else _UNBOUNDED_HI,
                 created_after_us if created_after_us is not None
@@ -566,13 +735,20 @@ class NativeEventLogStore(EventStore):
                 else _UNBOUNDED_HI,
                 entity_type, target_entity_type,
                 list(event_names) if event_names is not None else None,
-                value_key, workers=self._scan_workers())
+                value_key)
+            workers = self._scan_workers()
+            cols = merge_columnar_segments(itertools.chain.from_iterable(
+                n.scan_blocks(*scan_args, workers=workers)
+                for n in ns_list))
             if cols is not None:
-                detail = (ns.last_scan or {}).get("per_segment", [])
+                detail = [s for n in ns_list
+                          for s in (n.last_scan or {}).get(
+                              "per_segment", [])]
                 tracing.add_attrs(
                     scan_backend="eventlog",
                     scan_bytes=sum(s["bytes"] for s in detail),
-                    scan_records=int(cols.n))
+                    scan_records=int(cols.n),
+                    scan_shards=len(ns_list))
             return cols
 
         h = ns.h
@@ -643,16 +819,21 @@ class NativeEventLogStore(EventStore):
         probe, answered from the in-memory index with no payload IO.
         For partitioned namespaces sealed segments answer from their
         manifest bounds where the window covers them entirely."""
-        ns = self._ns(app_id, channel_id)
         bound = until_us if until_us is not None else _UNBOUNDED_HI
-        if ns.sealed:
-            total, max_c = ns.creation_stats(bound)
-            return (total, max_c) if total else (0, None)
-        max_out = ctypes.c_longlong(0)
-        n = self._lib.pel_creation_stats(ns.h, bound, ctypes.byref(max_out))
-        if n <= 0:
-            return (0, None)
-        return (int(n), int(max_out.value))
+        total = 0
+        max_c: Optional[int] = None
+        for ns in self._all_ns(app_id, channel_id):
+            if ns.sealed:
+                t, m = ns.creation_stats(bound)
+            else:
+                max_out = ctypes.c_longlong(0)
+                n = self._lib.pel_creation_stats(
+                    ns.h, bound, ctypes.byref(max_out))
+                t, m = (int(n), int(max_out.value)) if n > 0 else (0, None)
+            total += t
+            if m is not None and (max_c is None or m > max_c):
+                max_c = m
+        return (total, max_c) if total else (0, None)
 
     # -- derived (native fold) ------------------------------------------------
 
@@ -665,10 +846,10 @@ class NativeEventLogStore(EventStore):
         until_time: Optional[_dt.datetime] = None,
     ) -> Dict[str, PropertyMap]:
         ns = self._ns(app_id, channel_id)
-        if ns.sealed:
+        if ns.sealed or self._shard_count(app_id, channel_id) > 1:
             # the native fold is per-file; $set/$unset/$delete order
-            # across segments matters, so fold the merged find() stream
-            # through the generic path instead
+            # across segments (and writer shards) matters, so fold the
+            # merged find() stream through the generic path instead
             return super().aggregate_properties(
                 app_id, entity_type, channel_id=channel_id,
                 start_time=start_time, until_time=until_time)

@@ -1022,6 +1022,26 @@ class LogNamespace:
         a bounded thread-pool window, merge into global order."""
         from predictionio_tpu.data.pipeline import merge_columnar_segments
 
+        return merge_columnar_segments(self.scan_blocks(
+            start_us, until_us, created_after_us, created_until_us,
+            entity_type, target_entity_type, event_names, value_key,
+            workers))
+
+    def scan_blocks(self, start_us: int, until_us: int,
+                    created_after_us: int, created_until_us: int,
+                    entity_type: Optional[str],
+                    target_entity_type: Optional[str],
+                    event_names: Optional[Sequence[str]],
+                    value_key: Optional[str],
+                    workers: int):
+        """The scan fan-out as a ``(cols, creation)`` block generator,
+        in segment order, WITHOUT the final merge — so a caller can
+        chain several namespaces' streams (the writer-shard read path
+        in ``data/filestore.py``) into ONE
+        :func:`~predictionio_tpu.data.pipeline.merge_columnar_segments`
+        call and still get a result identical to a single-file scan of
+        the union. Scan stats (``last_scan``, trace attrs) are recorded
+        when the generator is exhausted."""
         with self.lock:
             segs = list(self.sealed)
             active_h = self.h
@@ -1099,7 +1119,7 @@ class LogNamespace:
                     fut = pending.pop(0)
                     yield fut.result()
 
-        cols = merge_columnar_segments(blocks())
+        yield from blocks()
         seg_stats = [s for s in stats if s]
         self.last_scan = {
             "segments": len(targets), "pruned": pruned,
@@ -1108,7 +1128,6 @@ class LogNamespace:
         tracing.add_attrs(
             scan_segments=len(targets), scan_segments_pruned=pruned,
             scan_segment_detail=seg_stats)
-        return cols
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -104,6 +104,7 @@ class EngineServer:
         access_log: bool = False,
         variants: Optional[str] = None,
         variant_salt: str = "pio",
+        tenant_quotas: Optional[Any] = None,
     ) -> None:
         self.storage = storage or get_storage()
         self.engine_factory = engine_factory
@@ -153,6 +154,21 @@ class EngineServer:
         #: loop-thread-only in-flight request count (handler entry to
         #: handler exit); admission control reads it before any await
         self._inflight = 0
+        # per-app weighted-fair admission under max_inflight: an app
+        # over its weighted share of the cap is shed FIRST, so one
+        # bursting tenant cannot move other tenants' p99 (weights from
+        # quotas.json; with no X-PIO-App header every request shares
+        # one bucket and the behavior degenerates to the global cap)
+        from predictionio_tpu.server.tenancy import FairInflight, TenantQuotas
+
+        if isinstance(tenant_quotas, TenantQuotas):
+            self.quotas = tenant_quotas
+        elif tenant_quotas:
+            self.quotas = TenantQuotas(str(tenant_quotas))
+        else:
+            self.quotas = TenantQuotas.for_home(self.storage.config.home)
+        self._fair = FairInflight(self.max_inflight,
+                                  weight_of=self.quotas.weight)
         #: guards query_count and _feedback_inflight — both are touched
         #: from the event loop AND the feedback worker threads, so the
         #: unlocked += the server shipped with could drift both the
@@ -178,7 +194,7 @@ class EngineServer:
             "pio_engine_feedback_total", "Feedback events sent", ("status",))
         self._m_shed = REGISTRY.counter(
             "pio_engine_shed_total",
-            "Queries shed by the max-inflight cap")
+            "Queries shed by the max-inflight cap", ("app",))
         self._m_deadline = REGISTRY.counter(
             "pio_engine_deadline_exceeded_total",
             "Queries that outlived query_timeout_ms")
@@ -340,32 +356,44 @@ class EngineServer:
         t0 = time.perf_counter()
         # admission control BEFORE any await: shedding costs ~nothing,
         # which is the whole point — past the cap the server answers
-        # instantly instead of queueing work it cannot finish
-        if self.max_inflight and self._inflight >= self.max_inflight:
-            self._m_shed.inc()
+        # instantly instead of queueing work it cannot finish. The cap
+        # is weighted-fair per app (X-PIO-App, propagated by the
+        # router): at saturation the tenant OVER its share sheds first,
+        # quiet tenants keep their seats. Requests with no app header
+        # share one default bucket — single-tenant behavior unchanged.
+        app = req.headers.get("x-pio-app", "")
+        if self.max_inflight and not self._fair.try_acquire(app):
+            self._m_shed.inc((app or "-",))
             self._m_queries.inc(("503",))
             return self._unavailable(
-                f"server overloaded ({self._inflight} queries in flight)",
+                f"server overloaded ({self._inflight} queries in "
+                f"flight; app {app or 'default'} at "
+                f"{self._fair.inflight(app)}/{self._fair.share(app)} "
+                "of its fair share)",
                 retry_after=self._retry_after_hint())
-        if self.deployed is None:
-            self._m_queries.inc(("503",))
-            return self._unavailable(
-                f"no engine loaded ({self._load_error}); "
-                "train and GET /reload",
-                retry_after=self._retry_after_hint())
-        self._inflight += 1
         try:
-            async with tracing.span(
-                    "engine.query",
-                    deadline_ms=self.query_timeout * 1e3,
-                    inflight=self._inflight,
-                    feedback_breaker=self._sink_breaker.state) as sp:
-                status, resp = await self._query_once(req)
-                sp.set_attr("status", status)
-                if status in ("500", "504"):
-                    sp.set_error(f"query answered {status}")
+            if self.deployed is None:
+                self._m_queries.inc(("503",))
+                return self._unavailable(
+                    f"no engine loaded ({self._load_error}); "
+                    "train and GET /reload",
+                    retry_after=self._retry_after_hint())
+            self._inflight += 1
+            try:
+                async with tracing.span(
+                        "engine.query",
+                        deadline_ms=self.query_timeout * 1e3,
+                        inflight=self._inflight,
+                        feedback_breaker=self._sink_breaker.state) as sp:
+                    status, resp = await self._query_once(req)
+                    sp.set_attr("status", status)
+                    if status in ("500", "504"):
+                        sp.set_error(f"query answered {status}")
+            finally:
+                self._inflight -= 1
         finally:
-            self._inflight -= 1
+            if self.max_inflight:
+                self._fair.release(app)
         self._m_queries.inc((status,))
         dt = time.perf_counter() - t0
         if status == "200":
@@ -683,6 +711,7 @@ class EngineServer:
         body = {
             "breakers": {n: b.state for n, b in self._breakers.items()},
             "inflight": self._inflight,
+            "inflightByApp": self._fair.snapshot(),
             "reloadGeneration": self.reload_generation,
             "modelGeneration": self._model_generation(),
             "lastSwap": self.last_swap,

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import datetime as _dt
+import math
 import threading
 import time
 from collections import Counter
@@ -40,6 +41,7 @@ from predictionio_tpu.server.http import (
     traces_handler,
 )
 from predictionio_tpu.server.ingest import IngestOverload, StorageUnavailable
+from predictionio_tpu.server.tenancy import TenantQuotas
 from predictionio_tpu.storage.registry import Storage, get_storage
 from predictionio_tpu.utils import tracing
 
@@ -167,8 +169,24 @@ class EventServer:
         durable_acks: bool = False,
         access_log: bool = False,
         segment_maintenance: bool = False,
+        tenant_quotas: Optional[Any] = None,
     ) -> None:
         self.storage = storage or get_storage()
+        # per-app QoS policy (quotas.json next to the event data,
+        # written by `pio app quota`): ingest token buckets + writer
+        # shard counts. Zero-config default is unlimited/1-shard, so
+        # single-tenant deployments are unchanged.
+        if isinstance(tenant_quotas, TenantQuotas):
+            self.quotas = tenant_quotas
+        elif tenant_quotas:
+            self.quotas = TenantQuotas(str(tenant_quotas))
+        else:
+            self.quotas = TenantQuotas.for_home(self.storage.config.home)
+        if hasattr(self.storage.events, "set_shard_policy"):
+            # hot-partition writer sharding for the native event log:
+            # the policy names how many ACTIVE writer shards each app's
+            # namespaces fan appends across (no-op on other backends)
+            self.storage.events.set_shard_policy(self.quotas.writer_shards)
         if segment_maintenance and hasattr(self.storage.events,
                                            "start_maintenance"):
             # background segment compaction + cold-tier shipping for the
@@ -188,6 +206,9 @@ class EventServer:
             ("app_id", "status"))
         self._m_insert = REGISTRY.histogram(
             "pio_event_insert_seconds", "Single-event insert latency")
+        self._m_quota = REGISTRY.counter(
+            "pio_tenant_quota_rejected_total",
+            "Events refused by the app's own ingest quota", ("app",))
         self._ingest = None
         if ingest_batching:
             from predictionio_tpu.server.ingest import WriteCoalescer
@@ -269,6 +290,8 @@ class EventServer:
         that is shedding correctly) while the ingest storage breaker is
         open or the queue is backed up."""
         body: Dict[str, Any] = {"status": "ok"}
+        if self.quotas.path:
+            body["tenantQuotas"] = self.quotas.path
         if self._ingest is not None:
             breaker = self._ingest.breaker
             body["ingest"] = {
@@ -276,6 +299,9 @@ class EventServer:
                 "breaker": breaker.state,
                 "rejected": self._ingest.rejected,
                 "breakerRejected": self._ingest.breaker_rejected,
+                # who filled the queue (accepted, not yet committed)
+                "queuedByApp": {str(a): n for a, n in
+                                sorted(self._ingest.queued_by_app.items())},
             }
             if breaker.state != "closed":
                 body["status"] = "degraded"
@@ -284,6 +310,30 @@ class EventServer:
                 body["status"] = "degraded"
                 body["reason"] = "ingest queue at capacity"
         return Response.json(body)
+
+    @staticmethod
+    def _throttled(status: int, message: str, retry_after: float) -> Response:
+        """Shed response in the fleet-standard shape: a machine-usable
+        ``retryAfterSec`` float in the body (same field the engine
+        server's 503s carry) plus the RFC 9110 integral ``Retry-After``
+        header, ceil'd so the hint is never shorter than the wait."""
+        body = {"message": message,
+                "retryAfterSec": round(max(0.0, retry_after), 3)}
+        resp = Response.json(body, status=status)
+        resp.headers["Retry-After"] = str(max(1, math.ceil(retry_after)))
+        return resp
+
+    def _quota_gate(self, app_id: int, n: int) -> Optional[Response]:
+        """Charge ``n`` events to the app's ingest bucket; a refusal is
+        that tenant's OWN 429 — other apps' submits never see it."""
+        ok, retry_after = self.quotas.admit(app_id, n)
+        if ok:
+            return None
+        self._m_quota.inc((app_id,))
+        self._m_events.inc((app_id, 429))
+        return self._throttled(
+            429, f"app {app_id} over its ingest quota "
+                 f"({n} event(s) refused)", retry_after)
 
     @staticmethod
     def _created(eid: str) -> Response:
@@ -345,6 +395,9 @@ class EventServer:
         """One event body → Response, through the group-commit
         coalescer when enabled (ack only after the commit returns),
         else the per-event insert path."""
+        deny = self._quota_gate(app_id, 1)
+        if deny is not None:
+            return deny
         if self._ingest is None:
             status, body = await asyncio.to_thread(
                 self._insert_one, obj, app_id, channel_id, allowed)
@@ -366,16 +419,14 @@ class EventServer:
                                     queue_depth=self._ingest.depth):
                 eid = await self._ingest.submit(ev, app_id, channel_id)
         except IngestOverload as e:
+            # last-resort global backstop; the Retry-After is computed
+            # from queue depth over measured drain rate, not a constant
             self._m_events.inc((app_id, 429))
-            resp = Response.json({"message": str(e)}, status=429)
-            resp.headers["Retry-After"] = str(max(1, round(e.retry_after)))
-            return resp
+            return self._throttled(429, str(e), e.retry_after)
         except StorageUnavailable as e:
             # storage breaker open: fail fast, don't queue doomed work
             self._m_events.inc((app_id, 503))
-            resp = Response.json({"message": str(e)}, status=503)
-            resp.headers["Retry-After"] = str(max(1, round(e.retry_after)))
-            return resp
+            return self._throttled(503, str(e), e.retry_after)
         except Exception as e:
             self._m_events.inc((app_id, 500))
             return Response.json(
@@ -409,6 +460,9 @@ class EventServer:
             return Response.json(
                 {"message": f"Batch request must have at most {BATCH_LIMIT} events"},
                 status=400)
+        deny = self._quota_gate(app_id, len(payload))
+        if deny is not None:
+            return deny
 
         def run() -> List[Dict[str, Any]]:
             t0 = time.perf_counter()

@@ -28,7 +28,12 @@ Design mirrors :class:`~predictionio_tpu.server.batching.MicroBatcher`
 - **Bounded queue with backpressure.** Past ``max_queue`` pending
   events, ``submit`` raises :class:`IngestOverload`; the HTTP layer
   maps it to ``429`` + ``Retry-After`` instead of letting the queue
-  grow without bound under a traffic spike.
+  grow without bound under a traffic spike. The Retry-After is
+  *computed* — queue depth over the measured commit drain rate — so
+  clients back off proportionally to actual congestion, and the
+  coalescer keeps per-app accounting of who filled the queue (the
+  global cap is the last-resort backstop behind the per-app token
+  buckets in ``server/tenancy.py``).
 - **Storage circuit breaker.** Repeated group-commit failures trip
   the ``ingest_storage`` breaker open; further submits fail
   IMMEDIATELY with :class:`StorageUnavailable` (HTTP layer → ``503``
@@ -109,6 +114,13 @@ class WriteCoalescer:
         self.rejected = 0     # submits refused by backpressure
         self.breaker_rejected = 0  # submits refused by the open breaker
         self.parallel_dispatches = 0  # dispatches spanning >1 namespace
+        #: queued events per app (accepted, not yet dispatched to a
+        #: commit) — when the global cap trips, this names the tenant
+        #: that filled it
+        self.queued_by_app: Dict[int, int] = {}
+        #: EWMA of commit throughput (events/sec) — denominator for
+        #: the computed 429 Retry-After
+        self._drain_ewma = 0.0
         #: repeated commit failures → open → fast 503s. Decoupled use
         #: (admit at submit, record at commit) — see CircuitBreaker doc.
         self.breaker = CircuitBreaker(
@@ -127,7 +139,8 @@ class WriteCoalescer:
             "Events that shared their commit with at least one other")
         self._m_rejected = REGISTRY.counter(
             "pio_ingest_rejected_total",
-            "Submits refused by queue backpressure")
+            "Submits refused before queueing, by app and reason",
+            ("app", "reason"))
 
     # -- plumbing --------------------------------------------------------------
 
@@ -156,6 +169,19 @@ class WriteCoalescer:
     def depth(self) -> int:
         return self._queue.qsize()
 
+    @property
+    def drain_rate(self) -> float:
+        """Measured commit throughput, events/sec (0 until observed)."""
+        return self._drain_ewma
+
+    def overload_retry_after(self) -> float:
+        """Honest backoff hint for a queue-full 429: time to drain the
+        current depth at the measured rate, clamped to [0.05s, 30s].
+        Before any commit has been observed, 1s (the old constant)."""
+        if self._drain_ewma <= 0:
+            return 1.0
+        return min(30.0, max(0.05, self._queue.qsize() / self._drain_ewma))
+
     # -- submit ----------------------------------------------------------------
 
     async def submit(self, event: Event, app_id: int,
@@ -167,15 +193,17 @@ class WriteCoalescer:
             raise RuntimeError("ingest coalescer is closed")
         if not self.breaker.admit():
             self.breaker_rejected += 1
-            self._m_rejected.inc()
+            self._m_rejected.inc((app_id, "breaker"))
             raise StorageUnavailable(self.breaker.retry_after())
         if self._queue.qsize() >= self.max_queue:
             self.rejected += 1
-            self._m_rejected.inc()
-            raise IngestOverload(self._queue.qsize(), self.max_queue)
+            self._m_rejected.inc((app_id, "queue_full"))
+            raise IngestOverload(self._queue.qsize(), self.max_queue,
+                                 self.overload_retry_after())
         self._ensure_worker()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self.submitted += 1
+        self.queued_by_app[app_id] = self.queued_by_app.get(app_id, 0) + 1
         # hot path: put_nowait (the queue is unbounded — depth limiting
         # happened above) skips a coroutine round trip per event, and
         # the depth gauge is refreshed once per dispatch in _collect().
@@ -264,6 +292,11 @@ class WriteCoalescer:
         loop = asyncio.get_running_loop()
         ex = self._get_executor()
         events = [e for e, _, _ in pairs]
+        left = self.queued_by_app.get(app_id, 0) - len(pairs)
+        if left > 0:
+            self.queued_by_app[app_id] = left
+        else:
+            self.queued_by_app.pop(app_id, None)
         # the commit serves MANY requests' traces: a detached root
         # span that links every submitter's trace id, so any one of
         # them finds its batched ack in /traces or the JSONL export
@@ -312,7 +345,11 @@ class WriteCoalescer:
                             fut.set_result(eid)
                 return
         self.breaker.record_success()
-        self._m_commit.observe(time.perf_counter() - t0,
+        elapsed = time.perf_counter() - t0
+        rate = len(events) / max(elapsed, 1e-6)
+        self._drain_ewma = (rate if self._drain_ewma <= 0
+                            else 0.3 * rate + 0.7 * self._drain_ewma)
+        self._m_commit.observe(elapsed,
                                exemplar=links[0] if links else None)
         self._m_batch.observe(len(events))
         if len(events) > 1:

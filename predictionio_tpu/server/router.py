@@ -34,6 +34,13 @@ bakes no aiohttp):
   amplified into a retry storm. Non-idempotent POSTs (feedback,
   events) are NEVER retried; ``/queries.json`` POSTs are read-only by
   contract and are.
+- **Per-tenant budgets** — requests carrying ``X-PIO-App`` (forwarded
+  downstream unchanged) additionally spend from THAT app's retry/hedge
+  bucket, refilled only by that app's live traffic and scaled by its
+  quota weight. A retrying tenant draws down its own budget before the
+  fleet's, so one tenant's brown-out cannot eat the shared retry
+  allowance. Per-app ``deadline_ms`` quota overrides cap the deadline
+  budget the router grants that tenant.
 - **Hedging** — a ``/queries.json`` attempt still running after the
   rolling p95 of recent latencies gets a second attempt on a different
   replica; first answer wins, the loser is cancelled. Hedges draw from
@@ -70,6 +77,7 @@ from predictionio_tpu.server.http import (
     Router,
     traces_handler,
 )
+from predictionio_tpu.server.tenancy import TenantQuotas
 from predictionio_tpu.utils import tracing
 from predictionio_tpu.utils.faults import FAULTS
 from predictionio_tpu.utils.metrics import REGISTRY
@@ -234,6 +242,7 @@ class FleetRouter:
         breaker_threshold: int = 3,
         breaker_reset: float = 5.0,
         access_log: bool = False,
+        tenant_quotas: Optional[Any] = None,
     ) -> None:
         if not replicas and not manifest:
             raise ValueError("need a replica list or a manifest file")
@@ -271,6 +280,19 @@ class FleetRouter:
         self.retry_budget_ratio = max(0.0, retry_budget_ratio)
         self.retry_budget_burst = max(1.0, retry_budget_burst)
         self._budget_tokens = self.retry_budget_burst
+        #: per-tenant sub-buckets under the global one, keyed by the
+        #: ``X-PIO-App`` header ("-" when absent). Refilled only by
+        #: that tenant's live traffic; a retry/hedge must clear BOTH
+        #: its own bucket and the global one. Loop-thread-only.
+        if isinstance(tenant_quotas, str):
+            self.quotas = TenantQuotas(tenant_quotas)
+        elif tenant_quotas is not None:
+            self.quotas = tenant_quotas
+        else:
+            self.quotas = TenantQuotas.for_home(
+                os.environ.get("PIO_HOME")
+                or os.path.join(os.path.expanduser("~"), ".pio_store"))
+        self._app_tokens: Dict[str, float] = {}
         self._reload_lock: Optional[asyncio.Lock] = None
         self._rng = random.Random(0x9107)
 
@@ -285,17 +307,21 @@ class FleetRouter:
             "pio_router_attempts_total", "Proxied attempts per replica",
             ("replica", "outcome"))
         self._m_retries = REGISTRY.counter(
-            "pio_router_retries_total", "Retried attempts", ("reason",))
+            "pio_router_retries_total", "Retried attempts",
+            ("reason", "app"))
         self._m_retry_denied = REGISTRY.counter(
             "pio_router_retry_denied_total",
-            "Retries NOT taken", ("reason",))
+            "Retries NOT taken", ("reason", "app"))
         self._m_hedges = REGISTRY.counter(
             "pio_router_hedges_total", "Hedged /queries.json attempts",
-            ("outcome",))
+            ("outcome", "app"))
         self._m_budget = REGISTRY.gauge(
             "pio_router_retry_budget_remaining",
             "Retry/hedge tokens currently in the bucket")
         self._m_budget.set(self._budget_tokens)
+        self._m_app_budget = REGISTRY.gauge(
+            "pio_router_app_retry_tokens",
+            "Per-app retry/hedge tokens remaining", ("app",))
         self._m_replica_s = REGISTRY.histogram(
             "pio_router_replica_seconds",
             "Per-replica attempt latency (seconds)",
@@ -390,15 +416,43 @@ class FleetRouter:
 
     # -- retry budget ------------------------------------------------------
 
-    def _budget_refill(self) -> None:
+    def _app_burst(self, app: str) -> float:
+        """This tenant's bucket depth: the global burst scaled by its
+        quota weight (floor 1.0 so every tenant can afford at least
+        one retry)."""
+        try:
+            w = self.quotas.weight(app)
+        except Exception:  # noqa: BLE001 — policy lookup must not 500
+            w = 1.0
+        return max(1.0, self.retry_budget_burst * w)
+
+    def _budget_refill(self, app: str = "-") -> None:
         self._budget_tokens = min(
             self.retry_budget_burst,
             self._budget_tokens + self.retry_budget_ratio)
         self._m_budget.set(self._budget_tokens)
+        tokens = self._app_tokens.get(app)
+        if tokens is None:
+            if len(self._app_tokens) >= 1024:
+                # header values are attacker-controlled: drop full
+                # (i.e. inert) buckets rather than grow without bound
+                self._app_tokens = {
+                    a: t for a, t in self._app_tokens.items()
+                    if t < self._app_burst(a)}
+            tokens = self._app_burst(app)
+        self._app_tokens[app] = min(self._app_burst(app),
+                                    tokens + self.retry_budget_ratio)
+        self._m_app_budget.set(self._app_tokens[app], (app,))
 
-    def _budget_take(self) -> bool:
-        if self._budget_tokens < 1.0:
+    def _budget_take(self, app: str = "-") -> bool:
+        """Spend one retry/hedge token: the tenant's own bucket AND
+        the global one must both clear, atomically (loop-thread-only,
+        no award between the two checks)."""
+        tokens = self._app_tokens.get(app, self._app_burst(app))
+        if tokens < 1.0 or self._budget_tokens < 1.0:
             return False
+        self._app_tokens[app] = tokens - 1.0
+        self._m_app_budget.set(self._app_tokens[app], (app,))
         self._budget_tokens -= 1.0
         self._m_budget.set(self._budget_tokens)
         return True
@@ -561,6 +615,10 @@ class FleetRouter:
             out["traceparent"] = req.headers["traceparent"]
         if "x-pio-trace-id" in req.headers:
             out["X-PIO-Trace-Id"] = req.headers["x-pio-trace-id"]
+        # tenant identity rides down with the request so the replica's
+        # fair-admission gate sheds the right app under saturation
+        if "x-pio-app" in req.headers:
+            out["X-PIO-App"] = req.headers["x-pio-app"]
         return out
 
     async def _attempt(self, replica: Replica, req: Request, target: str,
@@ -617,11 +675,13 @@ class FleetRouter:
         return _Attempt(replica, status, rhead, rbody)
 
     async def _attempt_hedged(self, replica: Replica, req: Request,
-                              target: str, deadline: float) -> _Attempt:
+                              target: str, deadline: float,
+                              app: str = "-") -> _Attempt:
         """Primary attempt + (after the p95 delay) one hedge on a
         different replica. First non-retryable answer wins; the other
         task is cancelled. Falls back to plain behavior when no second
-        replica or no budget."""
+        replica or no budget (the hedge spends from the requesting
+        tenant's bucket as well as the global one)."""
         primary = asyncio.create_task(
             self._attempt(replica, req, target, deadline))
         done, _ = await asyncio.wait({primary}, timeout=self._hedge_delay())
@@ -629,12 +689,12 @@ class FleetRouter:
         if not done:
             second = self._pick({replica.name})
             if second is not None and second is not replica \
-                    and self._budget_take():
-                self._m_hedges.inc(("launched",))
+                    and self._budget_take(app):
+                self._m_hedges.inc(("launched", app))
                 tasks.append(asyncio.create_task(
                     self._attempt(second, req, target, deadline)))
             elif second is not None and second is not replica:
-                self._m_hedges.inc(("denied",))
+                self._m_hedges.inc(("denied", app))
         hedged = len(tasks) > 1
         winner: Optional[_Attempt] = None
         fallback: Optional[_Attempt] = None
@@ -651,7 +711,8 @@ class FleetRouter:
                     winner = att
                     if hedged:
                         self._m_hedges.inc(
-                            ("won",) if t is not primary else ("lost",))
+                            ("won", app) if t is not primary
+                            else ("lost", app))
                     break
                 fallback = fallback or att
         for t in pending:
@@ -665,9 +726,17 @@ class FleetRouter:
         return req.method == "GET" or req.path in _IDEMPOTENT_POSTS
 
     async def _proxy(self, req: Request) -> Response:
-        self._budget_refill()
+        app = req.headers.get("x-pio-app", "") or "-"
+        self._budget_refill(app)
         loop = asyncio.get_running_loop()
         budget = self.default_deadline
+        if app != "-":
+            try:
+                cap = self.quotas.deadline_ms(app) / 1e3
+            except Exception:  # noqa: BLE001 — policy lookup must not 500
+                cap = 0.0
+            if cap > 0:
+                budget = min(budget, cap)
         hop = req.headers.get("x-pio-deadline-ms")
         if hop:
             try:
@@ -693,24 +762,26 @@ class FleetRouter:
             tried.add(replica.name)
             if hedge:
                 att = await self._attempt_hedged(replica, req, target,
-                                                 deadline)
+                                                 deadline, app)
             else:
                 att = await self._attempt(replica, req, target, deadline)
             if not att.retryable:
                 break
             # retry gates, in order of what they protect: correctness
-            # (idempotency), the fleet (budget), the client (deadline)
+            # (idempotency), the tenant + fleet (budgets), the client
+            # (deadline)
             if not idempotent:
-                self._m_retry_denied.inc(("non_idempotent",))
+                self._m_retry_denied.inc(("non_idempotent", app))
                 break
-            if not self._budget_take():
-                self._m_retry_denied.inc(("budget",))
+            if not self._budget_take(app):
+                self._m_retry_denied.inc(("budget", app))
                 break
             if deadline - loop.time() <= 0:
-                self._m_retry_denied.inc(("deadline",))
+                self._m_retry_denied.inc(("deadline", app))
                 break
             self._m_retries.inc(
-                ("transport",) if att.status == 0 else (str(att.status),))
+                ("transport", app) if att.status == 0
+                else (str(att.status), app))
 
         if att is None:
             self._m_requests.inc(("503",))
@@ -949,6 +1020,8 @@ class FleetRouter:
         return Response.json({
             "replicas": [r.snapshot() for r in self.replicas],
             "retryBudgetTokens": round(self._budget_tokens, 3),
+            "appRetryTokens": {a: round(t, 3)
+                               for a, t in sorted(self._app_tokens.items())},
             "hedgeDelayMs": round(self._hedge_delay() * 1e3, 3),
             "hedging": self.hedge_enabled,
             "manifest": self.manifest,

@@ -101,6 +101,34 @@ def cmd_app(args: argparse.Namespace) -> None:
         st.events.remove_channel(app.id, ch.id)
         meta.delete_channel(ch.id)
         print(f"[info] Deleted channel {args.channel!r}.")
+    elif args.app_cmd == "quota":
+        # jax-free by design: writes quotas.json next to the event
+        # data; every server hot-reloads it within ~1s of the edit
+        from predictionio_tpu.server.tenancy import TenantQuotas
+
+        app = meta.get_app_by_name(args.name) or _die(f"no app {args.name!r}")
+        quotas = (TenantQuotas(args.quotas_file) if args.quotas_file
+                  else TenantQuotas.for_home(st.config.home))
+        fields: Dict[str, Any] = {}
+        if args.rate is not None:
+            fields["rate"] = args.rate
+        if args.burst is not None:
+            fields["burst"] = args.burst
+        if args.weight is not None:
+            fields["weight"] = args.weight
+        if args.writer_shards is not None:
+            fields["writer_shards"] = args.writer_shards
+        if args.deadline_ms is not None:
+            fields["deadline_ms"] = args.deadline_ms
+        for k in args.clear or []:
+            fields[k.replace("-", "_")] = None
+        if fields:
+            quotas.set_quota(str(app.id), **fields)
+            print(f"[info] Updated quota overrides for app "
+                  f"{app.name!r} (id {app.id}) in {quotas.path}.")
+        eff = quotas.describe(str(app.id))
+        print(json.dumps({"app": app.name, "appId": app.id,
+                          "effective": eff}, indent=2, sort_keys=True))
 
 
 def cmd_accesskey(args: argparse.Namespace) -> None:
@@ -167,7 +195,8 @@ def cmd_eventserver(args: argparse.Namespace) -> None:
                          auth_cache_ttl=args.auth_cache_ttl,
                          durable_acks=args.durable_acks,
                          access_log=args.access_log,
-                         segment_maintenance=args.segment_maintenance)
+                         segment_maintenance=args.segment_maintenance,
+                         tenant_quotas=args.tenant_quotas)
     mode = "group-commit" if args.ingest_batching else "per-event commit"
     print(f"[info] Event Server listening on {args.ip}:{args.port} ({mode})")
     server.run()
@@ -199,6 +228,7 @@ def cmd_deploy(args: argparse.Namespace) -> None:
         access_log=args.access_log,
         variants=args.variants,
         variant_salt=args.variant_salt,
+        tenant_quotas=args.tenant_quotas,
     )
     if args.variants:
         snap = server._mux.snapshot()
@@ -246,6 +276,7 @@ def cmd_router(args: argparse.Namespace) -> None:
             drain_timeout=args.drain_timeout,
             ready_timeout=args.ready_timeout,
             access_log=args.access_log,
+            tenant_quotas=args.tenant_quotas,
         )
         print(f"[info] Fleet router on {args.ip}:{args.port} over "
               f"{len(router.replicas)} replicas "
@@ -1091,7 +1122,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--version", action="version", version=__version__)
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    ap = sub.add_parser("app", help="manage apps and channels")
+    ap = sub.add_parser("app", aliases=["apps"],
+                        help="manage apps, channels, and QoS quotas")
     aps = ap.add_subparsers(dest="app_cmd", required=True)
     x = aps.add_parser("new"); x.add_argument("name")
     x.add_argument("--description"); x.add_argument("--access-key")
@@ -1102,6 +1134,32 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--channel")
     x = aps.add_parser("channel-new"); x.add_argument("name"); x.add_argument("channel")
     x = aps.add_parser("channel-delete"); x.add_argument("name"); x.add_argument("channel")
+    x = aps.add_parser(
+        "quota",
+        help="show or set per-app QoS overrides (quotas.json; "
+             "hot-reloaded by every server within ~1s)")
+    x.add_argument("name", help="app name (overrides key on the app id)")
+    x.add_argument("--rate", type=float,
+                   help="sustained ingest events/second (0 = unlimited)")
+    x.add_argument("--burst", type=float,
+                   help="ingest bucket depth (0 = rate for 1s, min 1)")
+    x.add_argument("--weight", type=float,
+                   help="weighted share of engine-server inflight and of "
+                        "the router retry budget at saturation")
+    x.add_argument("--writer-shards", type=int,
+                   help="ACTIVE-segment writer shards for this app's "
+                        "event namespaces (hot-partition relief)")
+    x.add_argument("--deadline-ms", type=float,
+                   help="router deadline cap for this app's queries "
+                        "(0 = router default)")
+    x.add_argument("--clear", action="append", metavar="FIELD",
+                   choices=["rate", "burst", "weight", "writer-shards",
+                            "deadline-ms"],
+                   help="drop one override, back to the fleet default "
+                        "(repeatable)")
+    x.add_argument("--quotas-file",
+                   help="explicit quotas.json path (default: "
+                        "<storage home>/quotas.json)")
     ap.set_defaults(fn=cmd_app)
 
     ak = sub.add_parser("accesskey", help="manage access keys")
@@ -1137,6 +1195,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="access-key/channel auth cache TTL seconds "
                          "(0 disables; in-process key mutations "
                          "invalidate immediately regardless)")
+    es.add_argument("--tenant-quotas", metavar="PATH", default=None,
+                    help="per-app QoS policy file (default: "
+                         "<storage home>/quotas.json, managed by "
+                         "'pio app quota'; hot-reloaded)")
     _add_observability_flags(es)
     es.set_defaults(fn=cmd_eventserver)
 
@@ -1283,6 +1345,10 @@ def build_parser() -> argparse.ArgumentParser:
     dp.add_argument("--variant-salt", default="pio",
                     help="salt for the sticky split hash; change it to "
                          "reshuffle which entities land on which arm")
+    dp.add_argument("--tenant-quotas", metavar="PATH", default=None,
+                    help="per-app QoS policy file driving weighted-fair "
+                         "admission under --max-inflight (default: "
+                         "<storage home>/quotas.json; hot-reloaded)")
     _add_observability_flags(dp)
     dp.set_defaults(fn=cmd_deploy)
 
@@ -1321,6 +1387,11 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--ready-timeout", type=float, default=120.0,
                    help="rolling reload: max seconds for /reload + "
                         "AOT re-warm readiness per replica")
+    x.add_argument("--tenant-quotas", metavar="PATH", default=None,
+                   help="per-app QoS policy file driving per-tenant "
+                        "retry/hedge budgets and deadline caps "
+                        "(default: <storage home>/quotas.json; "
+                        "hot-reloaded)")
     _add_observability_flags(x)
     x = rts.add_parser("status", help="replica states from a running router")
     x.add_argument("--url", default="http://localhost:8100")

@@ -77,6 +77,14 @@ Known sites (grep ``faults.inject`` for the authoritative list):
                         candidate died after loading but before
                         publishing; the champion must keep serving and
                         the split must fall back to 100/0
+``tenant.quota.exhausted``  per-app ingest quota gate — the tenant's
+                        token bucket reads empty, so its events get
+                        the app-scoped 429 + computed Retry-After
+                        (other tenants must be unaffected)
+``segments.shard.hot``  hot-partition writer sharding — the entity-id
+                        hash is bypassed and every append lands on
+                        writer shard 0 (the skew the per-shard append
+                        series must make visible)
 ======================  ===================================================
 """
 

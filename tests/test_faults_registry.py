@@ -107,6 +107,13 @@ class TestFaultSiteAudit:
         assert {"variant.assign.skew",
                 "variant.reload.partial"} <= table_sites()
 
+    def test_tenant_qos_sites_are_registered(self):
+        """The multi-tenant QoS drill sites must stay in the table:
+        the chaos harness (``profile_serving.py --tenants``) and the
+        noisy-neighbor runbook both arm them by name."""
+        assert {"tenant.quota.exhausted",
+                "segments.shard.hot"} <= table_sites()
+
     def test_ann_index_site_is_registered(self):
         """The ANN retrieval-index drill site must stay in the table:
         ``pio fsck`` detection and the ``/reload``-refusal drill

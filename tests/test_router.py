@@ -223,21 +223,21 @@ class TestRouting:
         with fleet(2, {"hedge": False}) as (router, stubs, _):
             base = f"http://127.0.0.1:{router.http.port}"
             assert http("POST", f"{base}/queries.json", {})[0] == 200
-            before = cval(router._m_retries, "transport")
+            before = cval(router._m_retries, "transport", "-")
             FAULTS.arm("router.replica.down", error="replica gone", count=1)
             code, _ = http("POST", f"{base}/queries.json", {})
             assert code == 200
-            assert cval(router._m_retries, "transport") == before + 1
+            assert cval(router._m_retries, "transport", "-") == before + 1
 
     def test_transient_500s_are_retried_until_success(self):
         with fleet(1, {"hedge": False}) as (router, stubs, _):
             base = f"http://127.0.0.1:{router.http.port}"
             stubs[0].fail_first = 2
-            before = cval(router._m_retries, "500")
+            before = cval(router._m_retries, "500", "-")
             code, _ = http("POST", f"{base}/queries.json", {})
             assert code == 200
             assert stubs[0].queries == 3
-            assert cval(router._m_retries, "500") == before + 2
+            assert cval(router._m_retries, "500", "-") == before + 2
 
 
 class TestRetryPolicy:
@@ -245,24 +245,24 @@ class TestRetryPolicy:
         with fleet(1, {"hedge": False}) as (router, stubs, _):
             base = f"http://127.0.0.1:{router.http.port}"
             stubs[0].query_status = 500
-            before = cval(router._m_retry_denied, "non_idempotent")
+            before = cval(router._m_retry_denied, "non_idempotent", "-")
             code, _ = http("POST", f"{base}/events.json", {"event": "buy"})
             assert code == 500          # passthrough, not masked
             assert stubs[0].events == 1  # exactly ONE delivery attempt
             assert cval(router._m_retry_denied,
-                        "non_idempotent") == before + 1
+                        "non_idempotent", "-") == before + 1
 
     def test_retry_budget_caps_amplification(self):
         with fleet(1, {"hedge": False, "retry_budget_ratio": 0.0,
                        "retry_budget_burst": 1.0}) as (router, stubs, _):
             base = f"http://127.0.0.1:{router.http.port}"
             stubs[0].query_status = 500
-            denied = cval(router._m_retry_denied, "budget")
+            denied = cval(router._m_retry_denied, "budget", "-")
             code, _ = http("POST", f"{base}/queries.json", {})
             assert code == 500
             # one original + the single budgeted retry, then denial
             assert stubs[0].queries == 2
-            assert cval(router._m_retry_denied, "budget") >= denied + 1
+            assert cval(router._m_retry_denied, "budget", "-") >= denied + 1
             # keep failing: the breaker (threshold 3) ejects the
             # replica, and with nothing left the router answers 503
             code, _ = http("POST", f"{base}/queries.json", {})
@@ -301,8 +301,8 @@ class TestHedging:
         with fleet(2, {"hedge_min_ms": 30.0}) as (router, stubs, _):
             base = f"http://127.0.0.1:{router.http.port}"
             assert http("POST", f"{base}/queries.json", {})[0] == 200
-            won = cval(router._m_hedges, "won")
-            launched = cval(router._m_hedges, "launched")
+            won = cval(router._m_hedges, "won", "-")
+            launched = cval(router._m_hedges, "launched", "-")
             FAULTS.arm("router.replica.slow", latency=0.8, count=1)
             t0 = time.perf_counter()
             code, _ = http("POST", f"{base}/queries.json", {})
@@ -310,8 +310,8 @@ class TestHedging:
             assert code == 200
             # answered at ~the 30ms hedge delay, not the 800ms stall
             assert elapsed < 0.6
-            assert cval(router._m_hedges, "launched") == launched + 1
-            assert cval(router._m_hedges, "won") == won + 1
+            assert cval(router._m_hedges, "launched", "-") == launched + 1
+            assert cval(router._m_hedges, "won", "-") == won + 1
 
 
 class TestHealthAndIdentity:

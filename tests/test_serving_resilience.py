@@ -155,7 +155,8 @@ class TestLoadShedding:
             assert int(headers["Retry-After"]) >= 1
             assert shed_elapsed < 0.5   # shed instantly, no queueing
             assert results["slow"][0] == 200  # the admitted one finished
-            assert server._m_shed._values.get((), 0) >= 1
+            # shed metric is per-app ("-" = no X-PIO-App header)
+            assert server._m_shed._values.get(("-",), 0) >= 1
 
 
 class TestHealth:

@@ -1,0 +1,483 @@
+"""Multi-tenant QoS: token buckets, the quotas.json policy store,
+weighted-fair admission, the tenant-scoped ingest 429, hot-partition
+writer sharding (read parity + SIGKILL-during-split crash safety), and
+the ``profile_serving.py --tenants`` isolation drill."""
+
+import datetime as dt
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.pel_integrity import fsck_home
+from predictionio_tpu.server.event_server import EventServer
+from predictionio_tpu.server.tenancy import (FairInflight, TenantQuotas,
+                                             TokenBucket)
+from predictionio_tpu.utils.faults import FAULTS
+from test_ingest import _mem_storage, _post, _setup_app
+from test_servers import ServerThread, free_port
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+# -- TokenBucket ---------------------------------------------------------------
+
+
+class TestTokenBucket:
+    def test_burst_then_refusal_then_refill(self):
+        clk = FakeClock()
+        b = TokenBucket(rate=10.0, burst=5.0, clock=clk)
+        assert b.take(5)
+        assert not b.take(1)
+        clk.advance(0.11)  # ~1 token accrues at 10/s
+        assert b.take(1)
+        assert not b.take(1)
+
+    def test_retry_after_is_proportional_to_deficit(self):
+        clk = FakeClock()
+        b = TokenBucket(rate=10.0, burst=5.0, clock=clk)
+        assert b.take(5)
+        # 1 token needs 0.1s at 10/s; 5 tokens need 0.5s — the hint
+        # prices the deficit, it is not a constant
+        assert b.retry_after(1) == pytest.approx(0.1)
+        assert b.retry_after(5) == pytest.approx(0.5)
+
+    def test_bucket_never_overfills(self):
+        clk = FakeClock()
+        b = TokenBucket(rate=100.0, burst=3.0, clock=clk)
+        clk.advance(60.0)
+        assert b.take(3)
+        assert not b.take(1)
+
+
+# -- TenantQuotas --------------------------------------------------------------
+
+
+class TestTenantQuotas:
+    def test_default_policy_is_unlimited(self, tmp_path):
+        q = TenantQuotas(str(tmp_path / "quotas.json"))
+        for _ in range(1000):
+            ok, ra = q.admit("7", 50)
+            assert ok and ra == 0.0
+
+    def test_override_throttles_one_app_only(self, tmp_path):
+        clk = FakeClock()
+        q = TenantQuotas(str(tmp_path / "quotas.json"), clock=clk)
+        q.set_quota("7", rate=2.0, burst=2.0)
+        assert q.admit("7")[0]
+        assert q.admit("7")[0]
+        ok, ra = q.admit("7")
+        assert not ok and ra == pytest.approx(0.5)  # 1-token deficit at 2/s
+        # the neighbour app never sees tenant 7's throttle
+        assert q.admit("8")[0]
+
+    def test_describe_and_field_floors(self, tmp_path):
+        q = TenantQuotas(str(tmp_path / "quotas.json"))
+        q.set_quota("7", rate=50.0, weight=2.0, writer_shards=4,
+                    deadline_ms=750.0)
+        eff = q.describe("7")
+        assert eff == {"rate": 50.0, "burst": 50.0, "weight": 2.0,
+                       "writer_shards": 4, "deadline_ms": 750.0}
+        q.set_quota("9", weight=-3.0, writer_shards=0, deadline_ms=-1.0)
+        assert q.weight("9") == 0.0
+        assert q.writer_shards("9") == 1
+        assert q.deadline_ms("9") == 0.0
+
+    def test_clearing_an_override_restores_defaults(self, tmp_path):
+        q = TenantQuotas(str(tmp_path / "quotas.json"))
+        q.set_quota("7", rate=1.0, burst=1.0)
+        assert q.admit("7")[0]
+        assert not q.admit("7")[0]
+        q.set_quota("7", rate=None, burst=None)
+        assert q.admit("7", 100)[0]  # back to unlimited
+
+    def test_quota_edit_does_not_refill_a_drained_bucket(self, tmp_path):
+        clk = FakeClock()
+        q = TenantQuotas(str(tmp_path / "quotas.json"), clock=clk)
+        q.set_quota("7", rate=1.0, burst=3.0)
+        for _ in range(3):
+            assert q.admit("7")[0]
+        assert not q.admit("7")[0]
+        # editing an UNRELATED field must not hand the burster a
+        # fresh burst allowance...
+        q.set_quota("7", weight=2.0)
+        assert not q.admit("7")[0]
+        # ...but an actual rate/burst change rebuilds the bucket
+        q.set_quota("7", rate=100.0, burst=100.0)
+        assert q.admit("7")[0]
+
+    def test_garbled_policy_file_keeps_previous_policy(self, tmp_path):
+        clk = FakeClock()
+        path = tmp_path / "quotas.json"
+        q = TenantQuotas(str(path), clock=clk)
+        q.set_quota("7", rate=1.0, burst=5.0)
+        assert q.admit("7", 5)[0]
+        path.write_text("{not json", encoding="utf-8")
+        clk.advance(2.0)  # get past the 1s mtime-probe throttle
+        # only 2 tokens accrued: a 5-event submit still over-draws —
+        # proving the old policy survived the torn file (an unlimited
+        # fallback would have admitted it)
+        ok, ra = q.admit("7", 5)
+        assert not ok and ra == pytest.approx(3.0)
+
+    def test_quota_exhausted_fault_drills_the_429_path(self, tmp_path):
+        """``tenant.quota.exhausted`` empties the bucket on demand:
+        even an unlimited app gets its 429 + Retry-After, and the gate
+        recovers the moment the drill is disarmed."""
+        q = TenantQuotas(str(tmp_path / "quotas.json"))
+        assert q.admit("9")[0]
+        FAULTS.arm("tenant.quota.exhausted", error="drill")
+        try:
+            ok, ra = q.admit("9")
+            assert not ok and ra > 0
+        finally:
+            FAULTS.disarm("tenant.quota.exhausted")
+        assert q.admit("9")[0]
+
+
+# -- FairInflight --------------------------------------------------------------
+
+
+class TestFairInflight:
+    def test_single_tenant_owns_the_whole_limit(self):
+        f = FairInflight(4, clock=FakeClock())
+        assert all(f.try_acquire("a") for _ in range(4))
+        assert not f.try_acquire("a")  # global cap, not the share
+        f.release("a")
+        assert f.try_acquire("a")
+
+    def test_burster_sheds_first_under_contention(self):
+        clk = FakeClock()
+        f = FairInflight(4, clock=clk)
+        # both tenants active: each share is ceil(4 * 1/2) = 2
+        for app in ("a", "b"):
+            assert f.try_acquire(app)
+            f.release(app)
+        assert f.try_acquire("a") and f.try_acquire("a")
+        assert not f.try_acquire("a")  # "a" is at its share...
+        assert f.try_acquire("b")      # ...while "b" still gets a seat
+        assert f.inflight("a") == 2 and f.inflight("b") == 1
+        assert f.total == 3
+
+    def test_weights_skew_the_shares(self):
+        clk = FakeClock()
+        weights = {"heavy": 3.0, "light": 1.0}
+        f = FairInflight(4, weight_of=lambda a: weights.get(a, 1.0),
+                         clock=clk)
+        for app in ("heavy", "light"):
+            assert f.try_acquire(app)
+            f.release(app)
+        # heavy: ceil(4 * 3/4) = 3; light: ceil(4 * 1/4) = 1
+        for _ in range(3):
+            assert f.try_acquire("heavy")
+        assert not f.try_acquire("heavy")
+        assert f.try_acquire("light")
+        assert not f.try_acquire("light")
+
+    def test_idle_tenants_stop_diluting_the_shares(self):
+        clk = FakeClock()
+        f = FairInflight(4, active_window=5.0, clock=clk)
+        assert f.try_acquire("b")
+        f.release("b")
+        assert f.share("a") == 2  # "b" still in the active window
+        clk.advance(6.0)
+        assert f.share("a") == 4  # "b" aged out: "a" is alone again
+
+    def test_release_of_unknown_app_is_harmless(self):
+        f = FairInflight(2, clock=FakeClock())
+        f.release("ghost")
+        assert f.total == 0
+        assert f.try_acquire("a")
+
+
+# -- the tenant-scoped 429 through a live Event Server -------------------------
+
+
+class TestIngestQuota429:
+    def test_429_is_tenant_scoped_with_honest_retry_after(self, tmp_path):
+        st = _mem_storage()
+        limited, lkey = _setup_app(st, "limited")
+        unmetered, ukey = _setup_app(st, "unmetered")
+        quotas = TenantQuotas(str(tmp_path / "quotas.json"))
+        quotas.set_quota(str(limited.id), rate=1.0, burst=3.0)
+        port = free_port()
+        server = EventServer(storage=st, host="127.0.0.1", port=port,
+                             tenant_quotas=quotas)
+        ev = {"event": "buy", "entityType": "user", "entityId": "u1",
+              "targetEntityType": "item", "targetEntityId": "i1"}
+        with ServerThread(server):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            results = [_post(conn, f"/events.json?accessKey={lkey}", ev)
+                       for _ in range(6)]
+            throttled = [r for r in results if r[0] == 429]
+            assert throttled, f"no 429 for the over-quota app: {results}"
+            for status, body, headers in throttled:
+                # fleet-standard shed shape: machine-usable float in
+                # the body, RFC 9110 integral header, never shorter
+                # than the computed wait
+                assert body["retryAfterSec"] > 0
+                assert int(headers["Retry-After"]) >= 1
+            # the unmetered neighbour never sees tenant 7's throttle
+            for _ in range(6):
+                status, _, _ = _post(
+                    conn, f"/events.json?accessKey={ukey}", ev)
+                assert status == 201
+            conn.close()
+        assert server._m_quota._values.get(
+            (str(limited.id),), 0) >= len(throttled)
+        assert server._m_quota._values.get((str(unmetered.id),), 0) == 0
+
+
+# -- hot-partition writer sharding --------------------------------------------
+
+APP_PARITY = 91
+APP_HOT = 92
+_BASE = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+
+
+def _native_store(path):
+    from predictionio_tpu.data.filestore import NativeEventLogStore
+
+    try:
+        return NativeEventLogStore(str(path))  # builds the engine
+    except RuntimeError as e:  # no g++ in this environment
+        pytest.skip(str(e))
+
+
+def _mk_events(n):
+    # DISTINCT event times: cross-shard merge ties are broken by shard
+    # order, so identical timestamps could legally reorder vs the
+    # unsharded file — the parity claim is about real streams, which
+    # have distinct microsecond timestamps
+    return [Event(event="rate", entity_type="user",
+                  entity_id=f"u{i % 17}",
+                  target_entity_type="item", target_entity_id=f"i{i % 11}",
+                  properties={"rating": float(i % 5)},
+                  event_time=_BASE + dt.timedelta(seconds=i))
+            for i in range(n)]
+
+
+def _rows(events):
+    return [(e.event, e.entity_type, e.entity_id, e.target_entity_type,
+             e.target_entity_id, e.properties.get("rating"), e.event_time)
+            for e in events]
+
+
+def _col_rows(cols):
+    return sorted(
+        (cols.entity_ids[cols.entity_idx[i]],
+         cols.target_ids[cols.target_idx[i]],
+         cols.names[cols.name_idx[i]],
+         float(cols.values[i]), int(cols.times_us[i]))
+        for i in range(len(cols.entity_idx)))
+
+
+class TestShardReadParity:
+    def test_sharded_reads_match_unsharded(self, tmp_path):
+        flat = _native_store(tmp_path / "flat")
+        sharded = _native_store(tmp_path / "sharded")
+        sharded.set_shard_policy(lambda app: 4)
+        events = _mk_events(120)
+        flat_ids = flat.insert_batch(events, APP_PARITY)
+        shard_ids = sharded.insert_batch(events, APP_PARITY)
+        try:
+            # the fan-out actually happened: >1 shard file on disk
+            shard_files = [p for p in os.listdir(tmp_path / "sharded")
+                           if p.startswith(f"events_{APP_PARITY}")
+                           and p.endswith(".pel")]
+            assert len(shard_files) > 1, shard_files
+
+            # find(): identical streams, identical ORDER (the k-way
+            # merge restores the global event-time order)
+            assert _rows(sharded.find(APP_PARITY)) == \
+                _rows(flat.find(APP_PARITY))
+            assert _rows(sharded.find(APP_PARITY, reversed=True)) == \
+                _rows(flat.find(APP_PARITY, reversed=True))
+            # filtered reads agree too (entity filter crosses shards)
+            assert _rows(sharded.find(APP_PARITY, entity_id="u3")) == \
+                _rows(flat.find(APP_PARITY, entity_id="u3"))
+
+            # creation_stats: same live count either way
+            assert sharded.creation_stats(APP_PARITY)[0] == \
+                flat.creation_stats(APP_PARITY)[0] == 120
+
+            # scan_columnar: same training matrix from either layout
+            f_cols = flat.scan_columnar(APP_PARITY, value_key="rating")
+            s_cols = sharded.scan_columnar(APP_PARITY, value_key="rating")
+            assert _col_rows(s_cols) == _col_rows(f_cols)
+
+            # tombstones: delete the same logical event in both;
+            # every read path agrees afterwards
+            assert flat.delete(flat_ids[37], APP_PARITY)
+            assert sharded.delete(shard_ids[37], APP_PARITY)
+            assert sharded.get(shard_ids[37], APP_PARITY) is None
+            assert sharded.creation_stats(APP_PARITY)[0] == \
+                flat.creation_stats(APP_PARITY)[0] == 119
+            assert _rows(sharded.find(APP_PARITY)) == \
+                _rows(flat.find(APP_PARITY))
+        finally:
+            flat.close()
+            sharded.close()
+
+        # restart WITHOUT the policy: shard discovery keeps reads
+        # covering every shard file ever written
+        reopened = _native_store(tmp_path / "sharded")
+        try:
+            assert reopened.creation_stats(APP_PARITY)[0] == 119
+            assert len(_rows(reopened.find(APP_PARITY))) == 119
+        finally:
+            reopened.close()
+
+    def test_hot_shard_fault_collapses_the_hash(self, tmp_path):
+        """``segments.shard.hot`` bypasses the entity hash: every
+        append lands on writer shard 0, and the per-shard append
+        series (``pio_eventlog_shard_appends_total``) shows exactly
+        the skew the runbook tells operators to watch for."""
+        store = _native_store(tmp_path / "hot")
+        store.set_shard_policy(lambda app: 4)
+        counter = store._m_shard_appends
+        before = dict(counter._values)
+        FAULTS.arm("segments.shard.hot", error="hot partition drill")
+        try:
+            store.insert_batch(_mk_events(40), APP_HOT)
+        finally:
+            FAULTS.disarm("segments.shard.hot")
+        app = str(APP_HOT)  # label tuples are stringified
+        deltas = {k: counter._values.get(k, 0) - before.get(k, 0)
+                  for k in counter._values
+                  if k[0] == app and counter._values.get(k, 0) !=
+                  before.get(k, 0)}
+        assert deltas == {(app, "0"): 40}
+        try:
+            # disarmed, the hash spreads the very next batch again
+            before = dict(counter._values)
+            store.insert_batch(_mk_events(40), APP_HOT)
+            spread = {k for k in counter._values
+                      if k[0] == app and
+                      counter._values.get(k, 0) > before.get(k, 0)}
+            assert len(spread) > 1, spread
+        finally:
+            store.close()
+
+
+# -- SIGKILL during the shard split -------------------------------------------
+
+_SPLIT_CHILD = """
+import datetime as dt
+import os
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.filestore import NativeEventLogStore
+
+home = os.path.join(os.getcwd(), "home")
+store = NativeEventLogStore(os.path.join(home, "eventlog"))
+store.set_shard_policy(lambda app: 4)  # the split: 1 -> 4 writer shards
+base = dt.datetime(2026, 4, 1, tzinfo=dt.timezone.utc)
+i = 0
+while True:
+    events = [Event(event="rate", entity_type="user",
+                    entity_id=str((i * 40 + j) % 257),
+                    target_entity_type="item", target_entity_id=str(j % 13),
+                    properties={"rating": float(j % 5)},
+                    event_time=base + dt.timedelta(seconds=i * 40 + j))
+              for j in range(40)]
+    store.insert_batch(events, 7)
+    i += 1
+"""
+
+
+@pytest.mark.slow
+def test_sigkill_during_split_leaves_a_clean_home(tmp_path):
+    """kill -9 a writer mid-split (appends fanning across brand-new
+    shard files): ``pio fsck`` must come back clean after repair (a
+    torn ACTIVE tail is a legitimate crash artifact, quarantined — not
+    corruption), and a restarted store must read every shard with
+    ``find``/``creation_stats`` agreeing on the surviving count."""
+    probe = _native_store(tmp_path / "probe")  # g++ gate for the child
+    probe.close()
+    home = tmp_path / "home"
+    log_dir = home / "eventlog"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO_ROOT}
+    proc = subprocess.Popen([sys.executable, "-c", _SPLIT_CHILD],
+                            cwd=str(tmp_path), env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE)
+
+    def split_visible():
+        if not log_dir.is_dir():
+            return False
+        shards = [p for p in os.listdir(log_dir)
+                  if p.startswith("events_7") and p.endswith(".pel")]
+        return len(shards) >= 3  # the split materialized on disk
+
+    deadline = time.monotonic() + 120.0
+    try:
+        while not split_visible():
+            if proc.poll() is not None:
+                raise AssertionError("writer died before the kill: "
+                                     + proc.stderr.read().decode())
+            if time.monotonic() > deadline:
+                raise AssertionError("writer produced no shard files")
+            time.sleep(0.02)
+    finally:
+        try:
+            proc.send_signal(signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+
+    # fsck with repair quarantines any torn tails; a second pass must
+    # then be fully clean — nothing else in the home was damaged
+    fsck_home(str(home), repair=True)
+    assert fsck_home(str(home))["corrupt"] == 0
+
+    store = _native_store(log_dir)
+    try:
+        rows = _rows(store.find(7))
+        assert rows  # the committed prefix survived
+        assert rows == sorted(rows, key=lambda r: r[-1])  # merged order
+        assert store.creation_stats(7)[0] == len(rows)
+    finally:
+        store.close()
+
+
+# -- the end-to-end isolation drill -------------------------------------------
+
+
+@pytest.mark.slow
+def test_tenants_chaos_harness_proves_isolation():
+    """Run the full ``profile_serving.py --tenants`` drill: a 10x
+    burster against two quiet tenants; quiet p99 within 1.5x of the
+    solo baseline, zero quiet-tenant 429/503, the burster throttled
+    with an honest Retry-After, zero serving-path compiles."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO_ROOT}
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "profile_serving.py"),
+         "--tenants", "--n-users", "20000", "--n-items", "8000",
+         "--rank", "32", "--queries", "400"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=900)
+    assert r.returncode == 0, (r.stdout[-4000:], r.stderr[-4000:])
+    payloads = [line for line in r.stdout.splitlines()
+                if line.startswith("{")]
+    assert payloads, r.stdout[-4000:]
+    doc = json.loads(payloads[-1])
+    assert doc["metric"] == "tenant_qos_isolation"
+    assert doc["ok"] is True
