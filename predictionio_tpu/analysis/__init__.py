@@ -1,0 +1,45 @@
+"""Static invariant analysis for the predictionio_tpu tree (``pio lint``).
+
+Twelve PRs of runtime hardening left the system with guarantees that
+only *runtime* could check: zero XLA compiles on the serving path, CLI
+verbs that must work on a jax-less ops box, writer-lock discipline in
+the segmented filestore, and the fault-site/docs/tests closure. This
+package turns each into a deterministic AST pass that fails CI the
+moment a diff breaks one — the codebase-level analogue of upstream
+PredictionIO's ``pio status``/``pio build`` pre-deploy validation.
+
+Rule families (see docs/development.md for the full contract):
+
+========  ==============================================================
+``PL01``  trace-safety / recompile hazards — compile containment in the
+          AOT executable cache, jax-agnostic serving modules, traced
+          Python leaks inside jitted functions, cache-key hygiene
+``PL02``  jax-free import closure — jax-free CLI verbs must not reach
+          ``jax``/``jaxlib`` through module-scope imports (the lazy
+          function-local import in ``ann/__init__.py`` is the allowed
+          pattern)
+``PL03``  lock discipline — unlocked writes to attributes a class
+          elsewhere guards, blocking calls under a writer lock in the
+          data tier, ``open()`` without a context manager in storage
+          paths
+``PL04``  registry closure — fault sites, Prometheus series, and CLI
+          flags must each appear in their docs anchor, and every fault
+          site must be exercised by a test
+``PL05``  resilience hygiene — retries that would swallow deterministic
+          4xx rejections, bare ``except:`` on serving paths, 429/503
+          responses without a Retry-After hint
+========  ==============================================================
+
+Everything here is stdlib-``ast`` only — importing this package (and
+running ``pio lint``) never imports jax, numpy, or anything outside the
+standard library, so the lint step runs on the dependency-free CI path.
+
+Suppression: a finding on line N is silenced by ``# pio-lint:
+disable=RULE`` on line N or N-1. Accepted findings live in
+``conf/lint-baseline.json`` keyed by the stable ``rule:path:symbol``
+key (no line numbers, so unrelated edits never invalidate an entry);
+every entry carries a written justification.
+"""
+
+from predictionio_tpu.analysis.core import Finding, Project  # noqa: F401
+from predictionio_tpu.analysis.runner import RULES, run_lint  # noqa: F401

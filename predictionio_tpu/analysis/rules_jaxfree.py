@@ -1,0 +1,186 @@
+"""PL02 — jax-free import closure for ops-box CLI verbs.
+
+``tools/cli.py`` declares ``_JAX_VERBS`` — the verbs whose command path
+is allowed to import jax. Every OTHER verb is documented to work on a
+jax-less ops box (``pio models``/``variants``/``index``/``fsck``/
+``lint`` …), which means the modules its ``cmd_*`` function imports —
+plus everything THOSE import at module scope, transitively — must never
+reach ``jax``/``jaxlib``.
+
+The check therefore:
+
+1. parses ``build_parser()`` to map each verb to its ``cmd_*`` function
+   (``x = sub.add_parser("verb", …)`` followed by
+   ``x.set_defaults(fn=cmd_verb)``);
+2. closes each non-jax verb's command function over the *local* call
+   graph inside cli.py (helpers like ``_http_json`` or
+   ``_configure_tracing`` contribute their lazy imports too);
+3. collects every module imported anywhere inside those functions, and
+4. walks each one's **module-scope** import closure (shared
+   :class:`~predictionio_tpu.analysis.imports.ImportGraph`) looking for
+   a chain that ends at jax/jaxlib. Function-local imports inside the
+   closure are invisible by construction — the lazy-import idiom in
+   ``ann/__init__.py`` is exactly the allowed escape hatch.
+
+The cli module's own module-scope imports are checked the same way:
+``pio --help`` must not pay a jax import either.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from predictionio_tpu.analysis.core import (
+    Finding,
+    Project,
+    SourceModule,
+    call_name,
+    const_str,
+)
+from predictionio_tpu.analysis.imports import (
+    imports_of_statement,
+    resolve_from_base,
+)
+
+RULE = "PL02"
+_JAX_TOPS = {"jax", "jaxlib"}
+
+
+def _jax_verbs(cli: SourceModule) -> Set[str]:
+    """Literal ``_JAX_VERBS = {...}`` set, empty when absent."""
+    for node in cli.tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "_JAX_VERBS"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.Set, ast.Tuple, ast.List))):
+            return {s for e in node.value.elts
+                    if (s := const_str(e)) is not None}
+    return set()
+
+
+def _verb_map(cli: SourceModule) -> Dict[str, str]:
+    """verb → cmd function name, from the add_parser/set_defaults idiom
+    anywhere in the module (normally inside ``build_parser``)."""
+    var_verb: Dict[str, str] = {}
+    verbs: Dict[str, str] = {}
+    for node in ast.walk(cli.tree):
+        if (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and call_name(node.value) == "add_parser"
+                and node.value.args):
+            verb = const_str(node.value.args[0])
+            if verb:
+                var_verb[node.targets[0].id] = verb
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "set_defaults"
+              and isinstance(node.func.value, ast.Name)):
+            verb = var_verb.get(node.func.value.id)
+            if verb is None:
+                continue
+            for kw in node.keywords:
+                if kw.arg == "fn" and isinstance(kw.value, ast.Name):
+                    verbs[verb] = kw.value.id
+    return verbs
+
+
+def _local_functions(cli: SourceModule) -> Dict[str, ast.AST]:
+    return {n.name: n for n in cli.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _reachable_locals(entry: str, funcs: Dict[str, ast.AST]) -> Set[str]:
+    """Fixpoint over the intra-module call graph: every local function
+    reachable from ``entry`` by plain-name calls or references."""
+    seen: Set[str] = set()
+    todo = [entry]
+    while todo:
+        name = todo.pop()
+        if name in seen or name not in funcs:
+            continue
+        seen.add(name)
+        for node in ast.walk(funcs[name]):
+            if isinstance(node, ast.Name) and node.id in funcs:
+                todo.append(node.id)
+    return seen
+
+
+def _function_imports(fn: ast.AST, cli: SourceModule,
+                      project: Project) -> List[Tuple[str, int]]:
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            out.extend(imports_of_statement(node, cli, project))
+        elif (isinstance(node, ast.Call)
+              and call_name(node) in ("import_module",)
+              and node.args):
+            s = const_str(node.args[0])
+            if s:
+                out.append((s, node.lineno))
+    return out
+
+
+def _closure_finding(project: Project, cli: SourceModule, root_mod: str,
+                     line: int, context: str) -> Optional[Finding]:
+    graph = project.import_graph()
+    top = root_mod.split(".")[0]
+    if top in _JAX_TOPS:
+        chain: Optional[List[str]] = [root_mod]
+    elif root_mod in project.modules or top == project.package:
+        target = root_mod if root_mod in project.modules else None
+        if target is None:
+            # from X import attr resolved to a non-module: walk up
+            name = root_mod
+            while name and name not in project.modules:
+                name = name.rsplit(".", 1)[0] if "." in name else ""
+            target = name or None
+        if target is None:
+            return None
+        chain = graph.external_path(target, _JAX_TOPS)
+    else:
+        return None  # external, non-jax (stdlib, numpy, …)
+    if chain is None:
+        return None
+    return Finding(
+        RULE, cli.relpath, line, f"{context}:{root_mod}",
+        f"{context} reaches jax through module-scope imports: "
+        + " -> ".join(chain)
+        + " — break the chain or make the jax import lazy "
+          "(function-local), like ann/__init__.py does")
+
+
+def check(project: Project) -> List[Finding]:
+    cli = project.get(f"{project.package}.tools.cli")
+    if cli is None:
+        return []
+    out: List[Finding] = []
+
+    # the CLI module itself: module-scope closure must be jax-free
+    for name, line in (project.import_graph()
+                       .internal[cli.name]
+                       + project.import_graph().external[cli.name]):
+        f = _closure_finding(project, cli, name, line, "cli-startup")
+        if f:
+            out.append(f)
+
+    jax_verbs = _jax_verbs(cli)
+    funcs = _local_functions(cli)
+    for verb, fn_name in sorted(_verb_map(cli).items()):
+        if verb in jax_verbs or fn_name not in funcs:
+            continue
+        for local in sorted(_reachable_locals(fn_name, funcs)):
+            for mod_name, line in _function_imports(
+                    funcs[local], cli, project):
+                f = _closure_finding(project, cli, mod_name, line,
+                                     f"verb '{verb}'")
+                if f:
+                    out.append(f)
+    # one verb importing a jax-bound module can be reached through many
+    # helpers; identical keys collapse to one finding
+    uniq: Dict[str, Finding] = {}
+    for f in out:
+        uniq.setdefault(f.key, f)
+    return list(uniq.values())

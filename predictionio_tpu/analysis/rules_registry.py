@@ -1,0 +1,237 @@
+"""PL04 — registry/docs/tests closure.
+
+Generalizes the audit ``tests/test_faults_registry.py`` pioneered: a
+name registered in code but absent from its docs anchor is a drill
+nobody knows to run / a series nobody graphs / a flag nobody finds.
+Three registries, each with its documentation anchor:
+
+=====================  ======================  =======================
+registry               collected from          must appear in
+=====================  ======================  =======================
+fault injection sites  ``faults.inject("x")``  utils/faults.py
+                       / ``ahit`` / ``hit`` /  Known-sites table,
+                       ``corrupt_bytes`` /     docs/operations.md,
+                       ``corrupt`` literals +  and ≥ 1 test file
+                       the two dynamic sites
+Prometheus series      ``REGISTRY.counter/     docs/observability.md
+                       gauge/histogram("x")``
+                       + direct constructors
+CLI flags              ``add_argument("--x")`` docs/cli.md
+                       in tools/cli.py
+=====================  ======================  =======================
+
+The fault-site closure is bidirectional (a table row no code wires is
+stale) and includes test coverage — every documented site must be
+exercised by some ``tests/test_*.py``. ``tests/test_faults_registry.py``
+now delegates to :func:`fault_site_closure` so there is one source of
+truth.
+
+The analysis package itself is excluded from collection: its sources
+quote these very literals as examples.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from predictionio_tpu.analysis.core import (
+    Finding,
+    Project,
+    SourceModule,
+    call_name,
+    const_str,
+)
+
+RULE = "PL04"
+
+_FAULT_CALLS = {"inject", "ahit", "hit", "corrupt", "corrupt_bytes"}
+_SITE_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+_TABLE_RE = re.compile(r"^``([a-z0-9_]+(?:\.[a-z0-9_]+)+)``", re.MULTILINE)
+_METRIC_CALLS = {"counter", "gauge", "histogram"}
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
+_METRIC_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_AUDIT_TEST = "test_faults_registry.py"
+
+
+def _excluded(project: Project, mod: SourceModule) -> bool:
+    return mod.name.startswith(f"{project.package}.analysis")
+
+
+# -- fault sites --------------------------------------------------------------
+
+def table_sites(project: Project) -> Set[str]:
+    """Sites in the Known-sites table of utils/faults.py's docstring —
+    the documentation anchor everything else is compared against."""
+    mod = project.get(f"{project.package}.utils.faults")
+    if mod is None:
+        return set()
+    doc = ast.get_docstring(mod.tree) or ""
+    return set(_TABLE_RE.findall(doc))
+
+
+def wired_sites(project: Project) -> Dict[str, List[Tuple[str, int]]]:
+    """Every site the package wires: literal injection calls plus the
+    two dynamic constructions (remote stores build ``models.{kind}``,
+    the segment read path uses the ``FAULT_SEGMENT`` constant)."""
+    faults_mod = f"{project.package}.utils.faults"
+    found: Dict[str, List[Tuple[str, int]]] = {}
+
+    def note(site: str, where: str, line: int) -> None:
+        found.setdefault(site, []).append((where, line))
+
+    for mod in project.iter_modules():
+        if mod.name == faults_mod or _excluded(project, mod):
+            continue
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Call)
+                    and call_name(node) in _FAULT_CALLS and node.args):
+                s = const_str(node.args[0])
+                if s and _SITE_RE.match(s):
+                    note(s, mod.relpath, node.lineno)
+    remote = project.get(f"{project.package}.storage.remote")
+    if remote is not None:
+        for node in ast.walk(remote.tree):
+            if (isinstance(node, ast.Call)
+                    and call_name(node) == "_init_resilience" and node.args):
+                # fault_site= overrides the default models.{kind} site
+                site = next((const_str(kw.value) for kw in node.keywords
+                             if kw.arg == "fault_site"), None)
+                kind = const_str(node.args[0])
+                if site:
+                    note(site, remote.relpath, node.lineno)
+                elif kind:
+                    note(f"models.{kind}", remote.relpath, node.lineno)
+    segments = project.get(f"{project.package}.data.segments")
+    if segments is not None:
+        for node in segments.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "FAULT_SEGMENT"
+                            for t in node.targets)):
+                s = const_str(node.value)
+                if s:
+                    note(s, segments.relpath, node.lineno)
+    return found
+
+
+def fault_site_closure(project: Project) -> List[Finding]:
+    """The four directions of the fault-site audit, as findings.
+    ``tests/test_faults_registry.py`` calls this directly."""
+    faults_mod = project.get(f"{project.package}.utils.faults")
+    if faults_mod is None:
+        return []
+    out: List[Finding] = []
+    table = table_sites(project)
+    wired = wired_sites(project)
+    if not table:
+        out.append(Finding(
+            RULE, faults_mod.relpath, 1, "known-sites-table",
+            "Known-sites table missing from utils/faults.py docstring "
+            "— the fault registry has lost its documentation anchor"))
+        return out
+    for site in sorted(set(wired) - table):
+        where, line = wired[site][0]
+        out.append(Finding(
+            RULE, where, line, f"fault-site:{site}",
+            f"fault site '{site}' is wired in code but missing from "
+            "the utils/faults.py Known-sites table"))
+    for site in sorted(table - set(wired)):
+        out.append(Finding(
+            RULE, faults_mod.relpath, 1, f"fault-site-stale:{site}",
+            f"Known-sites table documents '{site}' but no code injects "
+            "it — stale row or a dropped injection point"))
+    ops = project.read_doc("docs/operations.md")
+    for site in sorted(table):
+        if site not in ops:
+            out.append(Finding(
+                RULE, faults_mod.relpath, 1, f"fault-site-doc:{site}",
+                f"fault site '{site}' missing from docs/operations.md "
+                "— a chaos drill nobody knows to run"))
+    corpus = project.test_corpus(exclude=(_AUDIT_TEST,))
+    for site in sorted(table):
+        if not any(site in text for text in corpus.values()):
+            out.append(Finding(
+                RULE, faults_mod.relpath, 1, f"fault-site-test:{site}",
+                f"fault site '{site}' is exercised by no test — the "
+                "robustness claim is unchecked"))
+    # the dynamic-construction invariant the old audit asserted
+    remote = project.get(f"{project.package}.storage.remote")
+    if remote is not None and 'f"models.{kind}"' not in remote.text:
+        out.append(Finding(
+            RULE, remote.relpath, 1, "models-kind-fstring",
+            "remote stores no longer build their fault site from the "
+            "kind — the models.* audit below is blind"))
+    return out
+
+
+# -- Prometheus series --------------------------------------------------------
+
+def metric_series(project: Project) -> Dict[str, Tuple[str, int]]:
+    """series name → first (path, line) where it is created."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for mod in project.iter_modules():
+        if _excluded(project, mod):
+            continue
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            name = call_name(node)
+            is_factory = (isinstance(node.func, ast.Attribute)
+                          and name in _METRIC_CALLS)
+            is_ctor = isinstance(node.func, ast.Name) and name in _METRIC_CTORS
+            if not (is_factory or is_ctor):
+                continue
+            s = const_str(node.args[0])
+            if s and _METRIC_RE.match(s) and "_" in s:
+                out.setdefault(s, (mod.relpath, node.lineno))
+    return out
+
+
+def _metric_findings(project: Project) -> List[Finding]:
+    doc = project.read_doc("docs/observability.md")
+    out: List[Finding] = []
+    for series, (path, line) in sorted(metric_series(project).items()):
+        if series not in doc:
+            out.append(Finding(
+                RULE, path, line, f"metric:{series}",
+                f"Prometheus series '{series}' is not documented in "
+                "docs/observability.md — a signal nobody graphs or "
+                "alerts on"))
+    return out
+
+
+# -- CLI flags ----------------------------------------------------------------
+
+def cli_flags(project: Project) -> Dict[str, Tuple[str, int]]:
+    cli = project.get(f"{project.package}.tools.cli")
+    out: Dict[str, Tuple[str, int]] = {}
+    if cli is None:
+        return out
+    for node in ast.walk(cli.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"):
+            for arg in node.args:
+                s = const_str(arg)
+                if s and s.startswith("--"):
+                    out.setdefault(s, (cli.relpath, node.lineno))
+    return out
+
+
+def _flag_findings(project: Project) -> List[Finding]:
+    doc = project.read_doc("docs/cli.md")
+    out: List[Finding] = []
+    for flag, (path, line) in sorted(cli_flags(project).items()):
+        if flag not in doc:
+            out.append(Finding(
+                RULE, path, line, f"flag:{flag}",
+                f"CLI flag '{flag}' is not documented in docs/cli.md"))
+    return out
+
+
+def check(project: Project) -> List[Finding]:
+    return (fault_site_closure(project)
+            + _metric_findings(project)
+            + _flag_findings(project))

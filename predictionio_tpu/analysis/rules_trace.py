@@ -1,0 +1,296 @@
+"""PL01 — trace-safety / recompile hazards.
+
+Four sub-checks, all grounded in the AOT discipline PRs 7/10/11
+established:
+
+1. **Serving modules stay jax-agnostic.** ``server/engine_server.py``,
+   ``server/batching.py``, ``server/router.py`` and ``server/http.py``
+   dispatch through duck-typed hooks and today contain zero references
+   to jax; any reference appearing there (even a lazy import) is a
+   compile hazard on the request path.
+2. **Compile containment.** A ``…lower(…)….compile()`` chain anywhere
+   outside ``server/aot.py`` is legal only inside a local builder
+   function that the same module passes to
+   ``EXECUTABLES.get_or_compile(key, build)`` — the cache is the single
+   place allowed to decide a compile happens.
+3. **Traced-value leaks.** Inside a function that is jitted (decorated
+   with ``jax.jit``/``jit``/``functools.partial(jax.jit, …)`` or
+   wrapped via ``jax.jit(f, …)`` in the same module), ``int()``/
+   ``float()``/``bool()`` on a traced parameter or an ``if`` whose test
+   reads one forces a concretization error or a silent recompile per
+   distinct value. Parameters named in ``static_argnames`` (or indexed
+   by ``static_argnums``) are exempt — they are not traced.
+4. **Cache-key hygiene.** ``*_aot_key`` functions must derive keys from
+   geometry only: calls into ``time``/``random``/``uuid``/``id()``/
+   ``os.getpid`` make every request a cache miss and a fresh compile.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from predictionio_tpu.analysis.core import (
+    Finding,
+    Project,
+    SourceModule,
+    call_name,
+    const_str,
+    dotted_name,
+    iter_functions,
+)
+
+RULE = "PL01"
+
+#: request-path modules that must never mention jax (relative to the
+#: package root)
+SERVING_MODULES = ("server.engine_server", "server.batching",
+                   "server.router", "server.http")
+
+_NONGEOMETRY = ("time.", "random.", "uuid.", "datetime.", "os.getpid")
+
+
+def _findings_serving_jax(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for rel in SERVING_MODULES:
+        mod = project.get(f"{project.package}.{rel}")
+        if mod is None:
+            continue
+        for node in ast.walk(mod.tree):
+            hit: Optional[str] = None
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.split(".")[0] in ("jax", "jaxlib"):
+                        hit = a.name
+            elif isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] in ("jax", "jaxlib"):
+                    hit = node.module or ""
+            elif isinstance(node, ast.Name) and node.id == "jax":
+                hit = "jax"
+            if hit:
+                out.append(Finding(
+                    RULE, mod.relpath, node.lineno, f"jax:{hit}",
+                    f"serving module references {hit}: request-path "
+                    "modules must stay jax-agnostic (compiles belong "
+                    "behind server/aot.py's ExecutableCache)"))
+    return out
+
+
+def _builder_names(tree: ast.AST) -> Set[str]:
+    """Names passed to a ``get_or_compile(key, build)`` call anywhere in
+    the module — the only functions allowed to contain a lower/compile
+    chain outside server/aot.py."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and call_name(node) == "get_or_compile":
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+    return names
+
+
+def _is_lower_compile_chain(node: ast.Call) -> bool:
+    """``X.lower(…)[.more].compile()``."""
+    if not (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "compile"):
+        return False
+    cur: ast.AST = node.func.value
+    while True:
+        if isinstance(cur, ast.Call):
+            if (isinstance(cur.func, ast.Attribute)
+                    and cur.func.attr == "lower"):
+                return True
+            cur = cur.func
+        elif isinstance(cur, ast.Attribute):
+            cur = cur.value
+        else:
+            return False
+
+
+def _findings_compile_containment(project: Project,
+                                  mod: SourceModule) -> List[Finding]:
+    out: List[Finding] = []
+    builders = _builder_names(mod.tree)
+
+    # recursive walk tracking the INNERMOST enclosing def: a chain
+    # inside a nested build() must be attributed to build, not to the
+    # method that defines it
+    def visit(node: ast.AST, stack: List[str]) -> None:
+        if isinstance(node, ast.Call) and _is_lower_compile_chain(node):
+            leaf = stack[-1] if stack else None
+            if leaf not in builders:
+                qual = ".".join(stack) if stack else "module"
+                out.append(Finding(
+                    RULE, mod.relpath, node.lineno, f"{qual}:compile",
+                    "lower().compile() outside an ExecutableCache "
+                    "builder — wrap it in a local build() passed to "
+                    "EXECUTABLES.get_or_compile(key, build) so the "
+                    "cache governs every compile"))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit_body(child, stack + [child.name])
+            else:
+                visit(child, stack)
+
+    def visit_body(fn: ast.AST, stack: List[str]) -> None:
+        for child in ast.iter_child_nodes(fn):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit_body(child, stack + [child.name])
+            else:
+                visit(child, stack)
+
+    visit_body(mod.tree, [])
+    return out
+
+
+def _static_params(deco_or_call: ast.Call,
+                   fn: ast.FunctionDef) -> Set[str]:
+    """Parameter names a jit call marks static."""
+    static: Set[str] = set()
+    argnames = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in deco_or_call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in vals:
+                s = const_str(e)
+                if s:
+                    static.add(s)
+        elif kw.arg == "static_argnums":
+            v = kw.value
+            vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in vals:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    if 0 <= e.value < len(argnames):
+                        static.add(argnames[e.value])
+    # kwonly args named static are covered by static_argnames above
+    return static
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    d = dotted_name(node)
+    return d in ("jit", "jax.jit", "pjit", "jax.pjit")
+
+
+def _jitted_functions(mod: SourceModule) -> Dict[str, Set[str]]:
+    """function name → static param names, for every function the
+    module jits (by decorator or by a ``jit(f, …)`` wrap)."""
+    by_name: Dict[str, ast.FunctionDef] = {}
+    jitted: Dict[str, Set[str]] = {}
+    for _qual, fn, _cls in iter_functions(mod.tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        by_name[fn.name] = fn
+        for deco in fn.decorator_list:
+            if _is_jit_expr(deco):
+                jitted[fn.name] = set()
+            elif isinstance(deco, ast.Call):
+                if _is_jit_expr(deco.func):
+                    jitted[fn.name] = _static_params(deco, fn)
+                elif (call_name(deco) == "partial" and deco.args
+                      and _is_jit_expr(deco.args[0])):
+                    jitted[fn.name] = _static_params(deco, fn)
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Call) and _is_jit_expr(node.func)
+                and node.args and isinstance(node.args[0], ast.Name)):
+            target = node.args[0].id
+            if target in by_name:
+                jitted[target] = _static_params(node, by_name[target])
+    return jitted
+
+
+def _is_none_check(test: ast.AST) -> bool:
+    if isinstance(test, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            operands = [test.left] + list(test.comparators)
+            return any(isinstance(o, ast.Constant) and o.value is None
+                       for o in operands)
+    if isinstance(test, ast.Call) and call_name(test) == "isinstance":
+        return True
+    return False
+
+
+def _findings_traced_leaks(mod: SourceModule) -> List[Finding]:
+    out: List[Finding] = []
+    jitted = _jitted_functions(mod)
+    funcs = {fn.name: (qual, fn)
+             for qual, fn, _cls in iter_functions(mod.tree)
+             if isinstance(fn, ast.FunctionDef)}
+    for name, static in jitted.items():
+        if name not in funcs:
+            continue
+        qual, fn = funcs[name]
+        params = {a.arg for a in
+                  fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs}
+        traced = params - static - {"self", "cls"}
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in ("int", "float", "bool")
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in traced):
+                out.append(Finding(
+                    RULE, mod.relpath, node.lineno,
+                    f"{qual}:{node.func.id}({node.args[0].id})",
+                    f"{node.func.id}() on traced parameter "
+                    f"'{node.args[0].id}' inside jitted '{name}' — "
+                    "concretizes the tracer (error) or forces a "
+                    "recompile per value; mark it static_argnames or "
+                    "keep it an array op"))
+            elif isinstance(node, ast.If) and not _is_none_check(node.test):
+                # x.shape / x.dtype / x.ndim are static metadata — a
+                # Python branch on them is trace-safe (it specializes
+                # per geometry, which the AOT bucket ladder already
+                # keys on)
+                meta_ok = {n.value.id for n in ast.walk(node.test)
+                           if isinstance(n, ast.Attribute)
+                           and n.attr in ("shape", "dtype", "ndim", "size")
+                           and isinstance(n.value, ast.Name)}
+                used = {n.id for n in ast.walk(node.test)
+                        if isinstance(n, ast.Name)}
+                leak = sorted((used - meta_ok) & traced)
+                if leak:
+                    out.append(Finding(
+                        RULE, mod.relpath, node.lineno,
+                        f"{qual}:if({','.join(leak)})",
+                        f"`if` on traced parameter(s) {leak} inside "
+                        f"jitted '{name}' — Python control flow on "
+                        "tracers fails or recompiles; use jnp.where/"
+                        "lax.cond, or mark the parameter static"))
+    return out
+
+
+def _findings_key_hygiene(mod: SourceModule) -> List[Finding]:
+    out: List[Finding] = []
+    for qual, fn, _cls in iter_functions(mod.tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        if not (fn.name == "aot_key" or fn.name.endswith("_aot_key")):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            bad = (d == "id"
+                   or any(d.startswith(p) or d == p.rstrip(".")
+                          for p in _NONGEOMETRY))
+            if bad:
+                out.append(Finding(
+                    RULE, mod.relpath, node.lineno, f"{qual}:{d}",
+                    f"non-geometry value from {d}() in an executable "
+                    "cache key — every call becomes a cache miss and a "
+                    "fresh XLA compile; keys must be pure geometry "
+                    "(shapes, dtypes, backend)"))
+    return out
+
+
+def check(project: Project) -> List[Finding]:
+    out = _findings_serving_jax(project)
+    aot = f"{project.package}.server.aot"
+    for mod in project.iter_modules():
+        if mod.name != aot:
+            out.extend(_findings_compile_containment(project, mod))
+        out.extend(_findings_traced_leaks(mod))
+        out.extend(_findings_key_hygiene(mod))
+    return out

@@ -72,7 +72,8 @@ class TokenBucket:
         self._last = clock()
         self._lock = threading.Lock()
 
-    def _refill(self, now: float) -> None:
+    def _refill_locked(self, now: float) -> None:
+        # caller holds self._lock
         if now > self._last:
             self._tokens = min(self.burst,
                                self._tokens + (now - self._last) * self.rate)
@@ -80,7 +81,7 @@ class TokenBucket:
 
     def take(self, n: float = 1.0) -> bool:
         with self._lock:
-            self._refill(self._clock())
+            self._refill_locked(self._clock())
             if self._tokens >= n:
                 self._tokens -= n
                 return True
@@ -89,7 +90,7 @@ class TokenBucket:
     def retry_after(self, n: float = 1.0) -> float:
         """Seconds until ``n`` tokens will be available (>= 0.05)."""
         with self._lock:
-            self._refill(self._clock())
+            self._refill_locked(self._clock())
             deficit = n - self._tokens
         if deficit <= 0 or self.rate <= 0:
             return 0.05

@@ -151,6 +151,8 @@ class EmbeddedIndex:
         os.replace(tmp, self._path + ".snap")
 
     def _replay(self) -> None:
+        """Runs during construction, before the store is shared —
+        the caller holds exclusive access."""
         if self._path is None or not os.path.exists(self._path):
             return
         good_end = 0  # byte offset after the last intact record
@@ -175,9 +177,11 @@ class EmbeddedIndex:
                 f.truncate(good_end)
 
     def _log(self, op: Dict[str, Any]) -> None:
+        """Caller holds the lock."""
         self._log_line(json.dumps(op, separators=(",", ":")))
 
     def _log_line(self, line: str) -> None:
+        """Caller holds the lock."""
         if self._wal is None:
             return
         self._wal.write(line + "\n")
@@ -187,7 +191,8 @@ class EmbeddedIndex:
             self._compact()
 
     def _compact(self) -> None:
-        """Snapshot + truncate the WAL (segment-merge analogue). One
+        """Snapshot + truncate the WAL (segment-merge analogue); the
+        caller holds the lock. One
         pickle dump instead of the r4 full-JSONL rewrite — compaction
         of 1M docs drops from ~tens of seconds to ~2 s, and restart
         replays only the post-snapshot tail."""

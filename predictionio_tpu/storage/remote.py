@@ -55,9 +55,13 @@ class _ResilientCalls:
     #: each other's failures
     _breakers: dict = {}
 
-    def _init_resilience(self, kind: str, retries: int = 2) -> None:
+    def _init_resilience(self, kind: str, retries: int = 2,
+                         fault_site: Optional[str] = None) -> None:
+        """``fault_site`` overrides the default ``models.{kind}`` site
+        — the segment cold tiers share one ``segments.cold`` site
+        across backends (one drill covers local/S3/HDFS alike)."""
         self._kind = kind
-        self._fault_site = f"models.{kind}"
+        self._fault_site = fault_site or f"models.{kind}"
         self._retries = retries
         breaker = _ResilientCalls._breakers.get(kind)
         if breaker is None:
@@ -348,8 +352,7 @@ class LocalDirSegmentTier(_ResilientCalls):
 
     def __init__(self, root: str) -> None:
         self.root = os.path.abspath(root)
-        self._init_resilience("segment_local")
-        self._fault_site = "segments.cold"
+        self._init_resilience("segment_local", fault_site="segments.cold")
 
     def _path(self, key: str) -> str:
         return os.path.join(self.root, key.lstrip("/"))
@@ -417,8 +420,7 @@ class S3SegmentTier(_ResilientCalls):
         self.bucket = bucket
         self.prefix = prefix.strip("/")
         self._s3 = boto3.client("s3")
-        self._init_resilience("segment_s3")
-        self._fault_site = "segments.cold"
+        self._init_resilience("segment_s3", fault_site="segments.cold")
 
     def _key(self, key: str) -> str:
         key = key.lstrip("/")
@@ -484,8 +486,7 @@ class HDFSSegmentTier(_ResilientCalls):
             raise StorageClientError(
                 f"cannot reach HDFS at {host}:{port} (libhdfs present?): {e}"
             ) from e
-        self._init_resilience("segment_hdfs")
-        self._fault_site = "segments.cold"
+        self._init_resilience("segment_hdfs", fault_site="segments.cold")
 
     def _path(self, key: str) -> str:
         return f"{self.root}/{key.lstrip('/')}"

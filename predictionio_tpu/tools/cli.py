@@ -853,6 +853,38 @@ def cmd_fsck(args: argparse.Namespace) -> None:
         raise SystemExit(3)
 
 
+def cmd_lint(args: argparse.Namespace) -> None:
+    """Static invariant analysis over the predictionio_tpu tree
+    (stdlib ast only — runs on a jax-less ops box / CI path). Exits 0
+    when every finding is baselined or suppressed, 1 otherwise."""
+    from predictionio_tpu.analysis.runner import run_lint
+
+    try:
+        report = run_lint(
+            root=args.root,
+            rules=args.rule or None,
+            baseline=args.baseline,
+            use_baseline=not args.no_baseline,
+        )
+    except ValueError as e:
+        _die(str(e))
+    if args.json:
+        print(json.dumps(report.to_json(), indent=2, sort_keys=True))
+    else:
+        for f in report.findings:
+            print(f.render())
+        print(f"[lint] rules={','.join(report.rules)} "
+              f"files={report.files} findings={len(report.findings)} "
+              f"baselined={len(report.baselined)} "
+              f"suppressed={report.suppressed} "
+              f"({report.duration_s:.2f}s)")
+        for key in report.stale_baseline:
+            print(f"[lint] warning: stale baseline entry (no longer "
+                  f"fires): {key}")
+    if not report.ok:
+        raise SystemExit(1)
+
+
 def cmd_segments(args: argparse.Namespace) -> None:
     """Operate the partitioned event log: show segment layout, force a
     rollover, compact sealed segments into columnar sidecars, or ship
@@ -1609,6 +1641,27 @@ def build_parser() -> argparse.ArgumentParser:
 
     sh = sub.add_parser("shell", help="interactive framework REPL")
     sh.set_defaults(fn=cmd_shell)
+
+    ln = sub.add_parser(
+        "lint",
+        help="static invariant analysis: trace-safety (PL01), jax-free "
+             "import closure (PL02), lock discipline (PL03), "
+             "registry/docs closure (PL04), resilience hygiene (PL05) "
+             "— stdlib ast only, jax-free (docs/development.md)")
+    ln.add_argument("--json", action="store_true",
+                    help="emit the full report as one JSON document")
+    ln.add_argument("--rule", action="append", metavar="RULE",
+                    help="run only this rule family, e.g. PL03 "
+                         "(repeatable; default: all)")
+    ln.add_argument("--baseline", metavar="PATH",
+                    help="baseline file of reviewed, accepted findings "
+                         "(default: conf/lint-baseline.json)")
+    ln.add_argument("--no-baseline", action="store_true",
+                    help="report baselined findings too (review mode)")
+    ln.add_argument("--root", metavar="DIR",
+                    help="repo root to analyze (default: the tree this "
+                         "package was loaded from)")
+    ln.set_defaults(fn=cmd_lint)
 
     vp = sub.add_parser("version")
     vp.set_defaults(fn=lambda a: print(__version__))

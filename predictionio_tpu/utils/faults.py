@@ -61,6 +61,10 @@ Known sites (grep ``faults.inject`` for the authoritative list):
 ``promote.regression``  guardrail scoring of a candidate generation —
                         forces the candidate to look regressed so the
                         gate (or bake window) must refuse/roll back
+``segments.cold``       cold-tier segment store operations (put/get/
+                        delete), shared by the local/S3/HDFS tiers —
+                        a down cold store must fail reads loudly, not
+                        hang writers
 ``data.corrupt.eventlog``  byte-flip on ``pio fsck`` eventlog reads
 ``data.corrupt.snapshot``  byte-flip on snapshot npz load
 ``data.corrupt.model``     byte-flip on model-blob load/download

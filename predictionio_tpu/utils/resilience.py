@@ -294,7 +294,8 @@ class CircuitBreaker:
             self._m_trans.inc((self.name, state))
 
     def _tick(self) -> None:
-        """Open → half-open once the reset timeout has elapsed."""
+        """Open → half-open once the reset timeout has elapsed.
+        Caller holds the lock."""
         if (self._state == OPEN
                 and self._clock() - self._opened_at >= self.reset_timeout):
             self._set_state(HALF_OPEN)

@@ -275,6 +275,7 @@ class JSONLExporter:
         self._size = 0
 
     def _open(self) -> None:
+        """Caller holds the lock."""
         d = os.path.dirname(self.path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -282,6 +283,7 @@ class JSONLExporter:
         self._size = self._f.tell()
 
     def _rotate(self) -> None:
+        """Caller holds the lock."""
         assert self._f is not None
         self._f.flush()
         os.fsync(self._f.fileno())

@@ -2,126 +2,107 @@
 
 A fault site that exists in code but not in the docs is a chaos drill
 nobody knows to run; one that is documented but unexercised by any
-test is a robustness claim nobody has checked. This suite closes the
-loop mechanically: it enumerates every site reachable via ``PIO_FAULTS``
-straight from the source tree and fails if any is missing from the
-Known-sites table, from docs/operations.md, or from the test corpus —
-so ADDING a site without wiring it everywhere breaks the build, not
-the on-call.
+test is a robustness claim nobody has checked. Since ISSUE 13 the
+closure itself is computed by the ``pio lint`` PL04 checker
+(:mod:`predictionio_tpu.analysis.rules_registry`) — one source of
+truth shared with CI — and this suite drives that checker plus the
+assertions only a live registry can make (pinned drill sites,
+arm/disarm via ``PIO_FAULTS``). Either way: ADDING a site without
+wiring it everywhere breaks the build, not the on-call.
 """
 
-import re
 from pathlib import Path
 
-import predictionio_tpu.utils.faults as faults_mod
-from predictionio_tpu.data.segments import FAULT_SEGMENT
+import pytest
+
+from predictionio_tpu.analysis import rules_registry
+from predictionio_tpu.analysis.core import Project
 from predictionio_tpu.utils.faults import FaultRegistry
 
 ROOT = Path(__file__).resolve().parents[1]
-PKG = ROOT / "predictionio_tpu"
-TESTS = ROOT / "tests"
-AUDIT_FILE = Path(__file__).name
-
-#: literal site strings at the three injection entry points
-_LITERAL = re.compile(
-    r"""(?:inject|ahit|corrupt_bytes)\(\s*["']([a-z0-9_.]+)["']""")
 
 
-def table_sites():
-    """Sites from the Known-sites table in the module docstring — the
-    documentation anchor the rest of the audit is checked against."""
-    # a site always has at least one dot; plan-key words (``rate`` …)
-    # that land at line starts when the docstring wraps do not
-    sites = set(re.findall(r"^``([a-z0-9_]+(?:\.[a-z0-9_]+)+)``",
-                           faults_mod.__doc__, re.MULTILINE))
+@pytest.fixture(scope="module")
+def project():
+    return Project(ROOT)
+
+
+@pytest.fixture(scope="module")
+def closure(project):
+    """PL04 fault-site findings, keyed by the symbol prefix that names
+    the closure direction."""
+    return rules_registry.fault_site_closure(project)
+
+
+def table_sites(project):
+    sites = rules_registry.table_sites(project)
     assert sites, "Known-sites table missing from utils/faults.py"
     return sites
 
 
-def source_sites():
-    """Every site wired into the package: literal call sites, plus the
-    two dynamic constructions (remote model stores build
-    ``models.{kind}``; the segment read path uses a constant)."""
-    found = {}
-
-    def note(site, where):
-        found.setdefault(site, set()).add(str(where))
-
-    for py in PKG.rglob("*.py"):
-        if py.name == "faults.py":  # defines the registry, no real sites
-            continue
-        for site in _LITERAL.findall(py.read_text(encoding="utf-8")):
-            note(site, py.relative_to(ROOT))
-    remote = (PKG / "storage" / "remote.py").read_text(encoding="utf-8")
-    assert 'f"models.{kind}"' in remote, \
-        "remote stores no longer build their fault site from the kind?"
-    for kind in re.findall(r"""_init_resilience\(\s*["']([a-z0-9]+)["']""",
-                           remote):
-        note(f"models.{kind}", "predictionio_tpu/storage/remote.py")
-    note(FAULT_SEGMENT, "predictionio_tpu/data/segments.py")
-    return found
+def _direction(closure, prefix):
+    return [f.message for f in closure if f.symbol.startswith(prefix)]
 
 
 class TestFaultSiteAudit:
-    def test_every_wired_site_is_in_the_known_sites_table(self):
-        undocumented = {s: sorted(w) for s, w in source_sites().items()
-                        if s not in table_sites()}
+    def test_every_wired_site_is_in_the_known_sites_table(self, closure):
+        undocumented = _direction(closure, "fault-site:")
         assert not undocumented, (
             "fault sites wired in code but missing from the "
             f"utils/faults.py Known-sites table: {undocumented}")
 
-    def test_every_table_site_is_actually_wired(self):
-        stale = table_sites() - set(source_sites())
+    def test_every_table_site_is_actually_wired(self, closure):
+        stale = _direction(closure, "fault-site-stale:")
         assert not stale, (
-            f"Known-sites table documents sites no code injects: "
-            f"{sorted(stale)}")
+            f"Known-sites table documents sites no code injects: {stale}")
 
-    def test_every_site_is_documented_for_operators(self):
-        text = (ROOT / "docs" / "operations.md").read_text(
-            encoding="utf-8")
-        missing = [s for s in sorted(table_sites()) if s not in text]
+    def test_every_site_is_documented_for_operators(self, closure):
+        missing = _direction(closure, "fault-site-doc:")
         assert not missing, (
             f"fault sites missing from docs/operations.md: {missing}")
 
-    def test_every_site_is_exercised_by_a_test(self):
-        corpus = {p.name: p.read_text(encoding="utf-8")
-                  for p in TESTS.glob("test_*.py")
-                  if p.name != AUDIT_FILE}
-        missing = [s for s in sorted(table_sites())
-                   if not any(s in text for text in corpus.values())]
+    def test_every_site_is_exercised_by_a_test(self, closure):
+        missing = _direction(closure, "fault-site-test:")
         assert not missing, (
             f"fault sites no test exercises (the robustness claim is "
             f"unchecked): {missing}")
 
-    def test_trainer_loop_sites_are_registered(self):
+    def test_dynamic_model_store_sites_are_collected(self, project):
+        """The checker must keep seeing through the remote stores'
+        dynamic ``models.{kind}`` construction — if collection went
+        blind, the closure above would pass vacuously."""
+        wired = rules_registry.wired_sites(project)
+        assert {"models.s3", "models.hdfs", "segments.cold"} <= set(wired)
+
+    def test_trainer_loop_sites_are_registered(self, project):
         """The continuous-training drill sites must stay in the table:
         the chaos harness (``profile_serving.py --train-loop``) and the
         runbook both arm them by name."""
         assert {"train.crash", "train.lease.lost",
-                "promote.regression"} <= table_sites()
+                "promote.regression"} <= table_sites(project)
 
-    def test_variant_sites_are_registered(self):
+    def test_variant_sites_are_registered(self, project):
         """The multi-model multiplexing drill sites must stay in the
         table: the chaos harness (``profile_serving.py --variants``)
         and the challenger runbook both arm them by name."""
         assert {"variant.assign.skew",
-                "variant.reload.partial"} <= table_sites()
+                "variant.reload.partial"} <= table_sites(project)
 
-    def test_tenant_qos_sites_are_registered(self):
+    def test_tenant_qos_sites_are_registered(self, project):
         """The multi-tenant QoS drill sites must stay in the table:
         the chaos harness (``profile_serving.py --tenants``) and the
         noisy-neighbor runbook both arm them by name."""
         assert {"tenant.quota.exhausted",
-                "segments.shard.hot"} <= table_sites()
+                "segments.shard.hot"} <= table_sites(project)
 
-    def test_ann_index_site_is_registered(self):
+    def test_ann_index_site_is_registered(self, project):
         """The ANN retrieval-index drill site must stay in the table:
         ``pio fsck`` detection and the ``/reload``-refusal drill
         (docs/operations.md) arm it by name."""
-        assert "ann.index.corrupt" in table_sites()
+        assert "ann.index.corrupt" in table_sites(project)
 
-    def test_every_site_is_armable_via_pio_faults_spec(self):
-        sites = table_sites()
+    def test_every_site_is_armable_via_pio_faults_spec(self, project):
+        sites = table_sites(project)
         spec = ";".join(f"{s}:error=drill" for s in sorted(sites))
         r = FaultRegistry(env={"PIO_FAULTS": spec})
         assert set(r.plans()) == sites

@@ -1,0 +1,500 @@
+"""`pio lint` checker framework (predictionio_tpu/analysis/).
+
+Each rule family gets at least one synthetic fixture it must CATCH and
+one clean idiom it must NOT flag — the clean cases pin the escape
+hatches the codebase relies on (get_or_compile builders, lazy jax
+imports, static_argnames, *_locked callers, `with open(...)`). On top
+of the fixtures, the shipped tree itself must lint clean (zero
+unbaselined findings) and the whole run must stay inside the < 10 s
+budget with jax entirely absent.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from predictionio_tpu.analysis.core import load_baseline
+from predictionio_tpu.analysis.runner import run_lint
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+PKG = "predictionio_tpu"
+
+
+def make_tree(root, files):
+    """Lay out a synthetic repo: {relpath: source} with dedent."""
+    for rel, text in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text), encoding="utf-8")
+    (root / PKG).mkdir(exist_ok=True)
+    (root / PKG / "__init__.py").touch()
+    return root
+
+
+def lint(root, rule, **kw):
+    kw.setdefault("use_baseline", False)
+    return run_lint(root=root, rules=[rule], **kw)
+
+
+def symbols(report):
+    return {f.symbol for f in report.findings}
+
+
+# -- PL01: trace safety -------------------------------------------------------
+
+class TestTraceSafety:
+    def test_serving_module_jax_reference_is_flagged(self, tmp_path):
+        root = make_tree(tmp_path, {
+            f"{PKG}/server/engine_server.py": "import jax\n",
+        })
+        report = lint(root, "PL01")
+        assert f"jax:jax" in symbols(report)
+
+    def test_compile_outside_builder_is_flagged(self, tmp_path):
+        root = make_tree(tmp_path, {
+            f"{PKG}/ops/kern.py": """\
+                def compile_now(fn, x):
+                    return fn.lower(x).compile()
+            """,
+        })
+        report = lint(root, "PL01")
+        assert "compile_now:compile" in symbols(report)
+
+    def test_compile_inside_get_or_compile_builder_is_allowed(self, tmp_path):
+        root = make_tree(tmp_path, {
+            f"{PKG}/ops/kern.py": """\
+                def get(EXECUTABLES, fn, x):
+                    def build():
+                        return fn.lower(x).compile()
+                    return EXECUTABLES.get_or_compile(("k",), build)
+            """,
+        })
+        assert lint(root, "PL01").ok
+
+    def test_python_branch_on_traced_param_is_flagged(self, tmp_path):
+        root = make_tree(tmp_path, {
+            f"{PKG}/ops/act.py": """\
+                import jax
+
+                @jax.jit
+                def relu(x):
+                    if x > 0:
+                        return x
+                    return 0 * x
+
+                @jax.jit
+                def concretize(x):
+                    return int(x)
+            """,
+        })
+        report = lint(root, "PL01")
+        assert "relu:if(x)" in symbols(report)
+        assert "concretize:int(x)" in symbols(report)
+
+    def test_static_argnames_and_shape_metadata_are_trace_safe(self, tmp_path):
+        root = make_tree(tmp_path, {
+            f"{PKG}/ops/act.py": """\
+                from functools import partial
+                import jax
+
+                @partial(jax.jit, static_argnames=("n",))
+                def top(x, n):
+                    if n > 1 and x.shape[0] > 2:
+                        return x[:n]
+                    return x
+            """,
+        })
+        assert lint(root, "PL01").ok
+
+    def test_nongeometry_aot_key_is_flagged(self, tmp_path):
+        root = make_tree(tmp_path, {
+            f"{PKG}/ops/keys.py": """\
+                import time
+
+                def bucket_aot_key(x):
+                    return (x.shape, time.time())
+
+                def good_aot_key(x):
+                    return (x.shape, str(x.dtype))
+            """,
+        })
+        report = lint(root, "PL01")
+        assert symbols(report) == {"bucket_aot_key:time.time"}
+
+
+# -- PL02: jax-free import closure for non-jax CLI verbs ----------------------
+
+_PL02_CLI = f"""\
+    import argparse
+
+    _JAX_VERBS = {{"train"}}
+
+    def cmd_train(args):
+        import {PKG}.ops.math as m
+        return 0
+
+    def cmd_models(args):
+        import {PKG}.ops.math as m
+        return 0
+
+    def cmd_index(args):
+        import {PKG}.ann
+        return 0
+
+    def build_parser():
+        p = argparse.ArgumentParser()
+        sub = p.add_subparsers()
+        a = sub.add_parser("train")
+        a.set_defaults(fn=cmd_train)
+        b = sub.add_parser("models")
+        b.set_defaults(fn=cmd_models)
+        c = sub.add_parser("index")
+        c.set_defaults(fn=cmd_index)
+        return p
+"""
+
+_PL02_FILES = {
+    f"{PKG}/ops/__init__.py": "",
+    f"{PKG}/ops/math.py": "import jax\n",
+    f"{PKG}/ann/__init__.py": """\
+        def load():
+            import jax  # the allowed lazy-import escape hatch
+            return jax
+    """,
+    f"{PKG}/tools/__init__.py": "",
+}
+
+
+class TestJaxFreeClosure:
+    def test_non_jax_verb_reaching_jax_is_flagged(self, tmp_path):
+        root = make_tree(tmp_path, dict(
+            _PL02_FILES, **{f"{PKG}/tools/cli.py": _PL02_CLI}))
+        report = lint(root, "PL02")
+        # 'models' is not in _JAX_VERBS, so its import of ops.math (which
+        # imports jax at module scope) is a violation; 'train' is exempt
+        # and 'index' only reaches jax through a function-local import.
+        assert symbols(report) == {f"verb 'models':{PKG}.ops.math"}
+        assert "jax" in report.findings[0].message
+
+    def test_cli_startup_closure_is_checked_too(self, tmp_path):
+        root = make_tree(tmp_path, dict(_PL02_FILES, **{
+            f"{PKG}/tools/cli.py": f"    import {PKG}.ops.math\n" + _PL02_CLI,
+        }))
+        report = lint(root, "PL02")
+        assert f"cli-startup:{PKG}.ops.math" in symbols(report)
+
+
+# -- PL03: lock discipline ----------------------------------------------------
+
+class TestLockDiscipline:
+    def test_unlocked_write_to_guarded_attr_is_flagged(self, tmp_path):
+        root = make_tree(tmp_path, {
+            f"{PKG}/server/state.py": """\
+                import threading
+
+                class Counter:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._n = 0
+
+                    def bump(self):
+                        with self._lock:
+                            self._n += 1
+
+                    def reset(self):
+                        self._n = 0
+            """,
+        })
+        report = lint(root, "PL03")
+        assert symbols(report) == {"Counter.reset._n"}
+
+    def test_locked_suffix_and_docstring_convention_are_exempt(self, tmp_path):
+        root = make_tree(tmp_path, {
+            f"{PKG}/server/state.py": """\
+                import threading
+
+                class Counter:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._n = 0
+
+                    def bump(self):
+                        with self._lock:
+                            self._n += 1
+                            self._reset_locked()
+
+                    def _reset_locked(self):
+                        self._n = 0
+
+                    def _drain(self):
+                        \"\"\"Caller holds the lock.\"\"\"
+                        self._n = 0
+            """,
+        })
+        assert lint(root, "PL03").ok
+
+    def test_blocking_call_under_writer_lock_is_flagged(self, tmp_path):
+        root = make_tree(tmp_path, {
+            f"{PKG}/data/store.py": """\
+                import os
+                import threading
+
+                class Store:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._fd = 0
+
+                    def append(self, b):
+                        with self._lock:
+                            os.fsync(self._fd)
+
+                    def staged(self, b):
+                        os.fsync(self._fd)  # outside the lock: fine
+                        with self._lock:
+                            pass
+            """,
+        })
+        report = lint(root, "PL03")
+        assert symbols(report) == {"Store.append:fsync"}
+
+    def test_blocking_call_outside_data_tier_is_ignored(self, tmp_path):
+        root = make_tree(tmp_path, {
+            f"{PKG}/utils/misc.py": """\
+                import os
+                import threading
+
+                _lock = threading.Lock()
+
+                class W:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def f(self, fd):
+                        with self._lock:
+                            os.fsync(fd)
+            """,
+        })
+        assert lint(root, "PL03").ok
+
+    def test_open_without_context_manager_is_flagged(self, tmp_path):
+        root = make_tree(tmp_path, {
+            f"{PKG}/storage/wal.py": """\
+                def read_all(path):
+                    fh = open(path)
+                    data = fh.read()
+                    fh.close()
+                    return data
+
+                def read_ok(path):
+                    with open(path) as fh:
+                        return fh.read()
+            """,
+        })
+        report = lint(root, "PL03")
+        assert symbols(report) == {"read_all:open"}
+
+
+# -- PL04: registry/docs/tests closure ----------------------------------------
+
+class TestRegistryClosure:
+    @pytest.fixture()
+    def closure_root(self, tmp_path):
+        return make_tree(tmp_path, {
+            f"{PKG}/utils/__init__.py": "",
+            f"{PKG}/utils/faults.py": '''\
+                """Fault registry.
+
+                Known sites
+                -----------
+                ``a.b``           documented, wired, drilled, tested
+                ``stale.site``    documented but wired nowhere
+                ``undoc.site``    wired but absent from operations.md
+                ``untested.site`` wired and drilled but never tested
+                """
+
+                FAULTS = None
+            ''',
+            f"{PKG}/data/__init__.py": "",
+            f"{PKG}/data/x.py": """\
+                def f(faults, REGISTRY):
+                    faults.inject("a.b")
+                    faults.inject("ghost.site")
+                    faults.inject("undoc.site")
+                    faults.inject("untested.site")
+                    REGISTRY.counter("pio_ghost_total")
+                    REGISTRY.counter("pio_ok_total")
+            """,
+            f"{PKG}/tools/__init__.py": "",
+            f"{PKG}/tools/cli.py": """\
+                def build_parser(p):
+                    p.add_argument("--documented-flag")
+                    p.add_argument("--undocumented-flag")
+                    return p
+            """,
+            "docs/operations.md": "drills: a.b, stale.site, untested.site\n",
+            "docs/observability.md": "series: pio_ok_total\n",
+            "docs/cli.md": "flags: --documented-flag\n",
+            "tests/test_sites.py": "# exercises a.b stale.site undoc.site\n",
+        })
+
+    def test_all_four_closure_directions_fire(self, closure_root):
+        report = lint(closure_root, "PL04")
+        assert {
+            "fault-site:ghost.site",        # wired, missing from table
+            "fault-site-stale:stale.site",  # table row nothing injects
+            "fault-site-doc:undoc.site",    # not in docs/operations.md
+            "fault-site-test:untested.site",  # no test exercises it
+            "metric:pio_ghost_total",       # not in docs/observability.md
+            "flag:--undocumented-flag",     # not in docs/cli.md
+        } == symbols(report)
+        # the fully-wired entries stay quiet
+        assert not any("a.b" in s or "pio_ok_total" in s
+                       or "documented-flag" == s.lstrip("flag:--")
+                       for s in symbols(report))
+
+    def test_missing_table_is_one_loud_finding(self, tmp_path):
+        root = make_tree(tmp_path, {
+            f"{PKG}/utils/__init__.py": "",
+            f"{PKG}/utils/faults.py": '"""No table here."""\n',
+        })
+        report = lint(root, "PL04")
+        assert "known-sites-table" in symbols(report)
+
+
+# -- PL05: resilience hygiene -------------------------------------------------
+
+_PL05_SERVER = f"""\
+    def fetch(call, retry_with_backoff):
+        return retry_with_backoff(call)
+
+    def fetch_ok(call, retry_with_backoff):
+        return retry_with_backoff(call, retry_on=(TimeoutError,))
+
+    def swallow():
+        try:
+            return 1
+        except:
+            return None
+
+    def careful():
+        try:
+            return 1
+        except Exception:
+            return None
+
+    def throttle(Response):
+        return Response(status=429)
+
+    def throttle_ok(Response):
+        resp = Response(status=429)
+        resp.headers["Retry-After"] = "1"
+        return resp
+"""
+
+
+class TestResilienceHygiene:
+    def test_retry_bare_except_and_hintless_429_are_flagged(self, tmp_path):
+        root = make_tree(tmp_path, {f"{PKG}/server/h.py": _PL05_SERVER})
+        report = lint(root, "PL05")
+        assert symbols(report) == {
+            "fetch:retry_on", "swallow:bare-except", "throttle:retry-after"}
+
+    def test_retry_on_outside_server_tier_still_required(self, tmp_path):
+        root = make_tree(tmp_path, {
+            f"{PKG}/storage/s.py": """\
+                def pull(call, retry_call):
+                    return retry_call(call)
+            """,
+        })
+        report = lint(root, "PL05")
+        assert symbols(report) == {"pull:retry_on"}
+
+
+# -- suppression & baseline ---------------------------------------------------
+
+class TestSuppressionAndBaseline:
+    def test_inline_suppression_comment_silences_the_finding(self, tmp_path):
+        root = make_tree(tmp_path, {
+            f"{PKG}/server/h.py": """\
+                def fetch(call, retry_with_backoff):
+                    # pio-lint: disable=PL05
+                    return retry_with_backoff(call)
+            """,
+        })
+        report = lint(root, "PL05")
+        assert report.ok
+        assert report.suppressed == 1
+
+    def test_baseline_accepts_keys_and_reports_stale_entries(self, tmp_path):
+        root = make_tree(tmp_path, {
+            f"{PKG}/server/h.py": """\
+                def fetch(call, retry_with_backoff):
+                    return retry_with_backoff(call)
+            """,
+            "conf/lint-baseline.json": json.dumps({"entries": [
+                {"key": f"PL05:{PKG}/server/h.py:fetch:retry_on",
+                 "reason": "fixture: deliberately unscoped"},
+                {"key": f"PL05:{PKG}/server/gone.py:old:retry_on",
+                 "reason": "fixture: the code this covered is gone"},
+            ]}),
+        })
+        report = lint(root, "PL05", use_baseline=True)
+        assert report.ok
+        assert [f.symbol for f in report.baselined] == ["fetch:retry_on"]
+        assert report.stale_baseline == [
+            f"PL05:{PKG}/server/gone.py:old:retry_on"]
+
+    def test_baseline_entry_without_reason_is_rejected(self, tmp_path):
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps({"entries": [{"key": "PL05:x:y", "reason": ""}]}))
+        with pytest.raises(ValueError, match="written reason"):
+            load_baseline(p)
+
+    def test_unknown_rule_id_is_rejected(self, tmp_path):
+        make_tree(tmp_path, {})
+        with pytest.raises(ValueError, match="PL99"):
+            run_lint(root=tmp_path, rules=["PL99"])
+
+
+# -- the shipped tree and the CLI surface -------------------------------------
+
+class TestShippedTree:
+    def test_repo_lints_clean_within_budget(self):
+        report = run_lint(root=REPO_ROOT)
+        assert report.ok, "unbaselined findings:\n" + "\n".join(
+            f.render() for f in report.findings)
+        assert not report.stale_baseline, report.stale_baseline
+        assert report.files > 50
+        assert report.duration_s < 10.0
+
+    def test_cli_lint_exits_nonzero_on_fixture_violations(self, tmp_path):
+        root = make_tree(tmp_path, {f"{PKG}/server/h.py": _PL05_SERVER})
+        proc = subprocess.run(
+            [sys.executable, "-m", f"{PKG}.tools.cli", "lint", "--json",
+             "--root", str(root), "--no-baseline", "--rule", "PL05"],
+            capture_output=True, text=True, cwd=str(REPO_ROOT))
+        assert proc.returncode == 1, proc.stderr
+        payload = json.loads(proc.stdout)
+        assert payload["ok"] is False
+        assert {f["symbol"] for f in payload["findings"]} == {
+            "fetch:retry_on", "swallow:bare-except", "throttle:retry-after"}
+
+    def test_lint_runs_with_jax_unimportable(self):
+        """The ops-box contract: `pio lint` must work where jax does not
+        even install. Poison the import and lint the real tree."""
+        code = (
+            "import sys\n"
+            "sys.modules['jax'] = None\n"
+            "sys.modules['jaxlib'] = None\n"
+            "from predictionio_tpu.analysis.runner import run_lint\n"
+            "r = run_lint()\n"
+            "sys.exit(0 if r.ok else 1)\n"
+        )
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              cwd=str(REPO_ROOT))
+        assert proc.returncode == 0, proc.stderr

@@ -295,6 +295,25 @@ def test_cold_tier_fetch_on_scan_and_corrupt_refusal(tmp_path, monkeypatch):
     st.close()
 
 
+def test_cold_tier_fault_site_is_armable(tmp_path):
+    """Every cold-tier backend (local/S3/HDFS) routes put/get/delete
+    through the shared ``segments.cold`` fault site: a down cold store
+    must surface as a loud FaultError, not a hang — the drill
+    docs/operations.md names. PL04 (pio lint) audits that this site
+    stays in the Known-sites table and exercised here."""
+    from predictionio_tpu.storage.remote import LocalDirSegmentTier
+    from predictionio_tpu.utils.faults import FaultError
+
+    tier = LocalDirSegmentTier(str(tmp_path / "cold"))
+    tier.put("segments/a", b"payload")
+    assert tier.get("segments/a") == b"payload"
+    faults.FAULTS.arm("segments.cold", error="cold store down")
+    with pytest.raises(FaultError):
+        tier.get("segments/a")
+    faults.FAULTS.disarm()
+    assert tier.get("segments/a") == b"payload"
+
+
 # -- cold-segment tombstones ------------------------------------------------
 
 
