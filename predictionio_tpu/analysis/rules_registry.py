@@ -16,6 +16,12 @@ fault injection sites  ``faults.inject("x")``  utils/faults.py
 Prometheus series      ``REGISTRY.counter/     docs/observability.md
                        gauge/histogram("x")``
                        + direct constructors
+fleet/SLO series       any ``pio_fleet_*`` /   docs/observability.md
+                       ``pio_slo_*`` string
+                       literal (these names
+                       are often built
+                       dynamically, e.g. the
+                       federation rename)
 CLI flags              ``add_argument("--x")`` docs/cli.md
                        in tools/cli.py
 =====================  ======================  =======================
@@ -202,6 +208,42 @@ def _metric_findings(project: Project) -> List[Finding]:
     return out
 
 
+_PREFIXED_RE = re.compile(r"^pio_(fleet|slo)_[a-z0-9_]*$")
+
+
+def prefixed_series(project: Project) -> Dict[str, Tuple[str, int]]:
+    """Every ``pio_fleet_*`` / ``pio_slo_*`` string constant in the
+    package, wherever it appears. These series names are often built
+    dynamically (federation renames ``pio_*`` to ``pio_fleet_*`` at
+    scrape time; ``pio top`` queries the renamed series by literal), so
+    the factory-call collector above never sees them — but an
+    undocumented fleet or SLO series is exactly the signal an on-call
+    needs and cannot find."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for mod in project.iter_modules():
+        if _excluded(project, mod):
+            continue
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and _PREFIXED_RE.match(node.value)):
+                out.setdefault(node.value, (mod.relpath, node.lineno))
+    return out
+
+
+def _prefixed_findings(project: Project) -> List[Finding]:
+    doc = project.read_doc("docs/observability.md")
+    out: List[Finding] = []
+    for series, (path, line) in sorted(prefixed_series(project).items()):
+        if series not in doc:
+            out.append(Finding(
+                RULE, path, line, f"metric:{series}",
+                f"fleet/SLO series '{series}' is not documented in "
+                "docs/observability.md — a paging signal nobody can "
+                "look up"))
+    return out
+
+
 # -- CLI flags ----------------------------------------------------------------
 
 def cli_flags(project: Project) -> Dict[str, Tuple[str, int]]:
@@ -234,4 +276,5 @@ def _flag_findings(project: Project) -> List[Finding]:
 def check(project: Project) -> List[Finding]:
     return (fault_site_closure(project)
             + _metric_findings(project)
+            + _prefixed_findings(project)
             + _flag_findings(project))

@@ -47,6 +47,7 @@ edit semantics.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import math
 import threading
@@ -105,6 +106,7 @@ class EngineServer:
         variants: Optional[str] = None,
         variant_salt: str = "pio",
         tenant_quotas: Optional[Any] = None,
+        scrape_interval: float = 10.0,
     ) -> None:
         self.storage = storage or get_storage()
         self.engine_factory = engine_factory
@@ -204,6 +206,18 @@ class EngineServer:
             "pio_engine_reload_generation",
             "Engine swaps served since start (0 = the deploy-time model)")
         self._m_reload_gen.set(0)
+        from predictionio_tpu.utils.metrics import build_info
+        from predictionio_tpu.utils.timeseries import (
+            TimeSeriesStore,
+            scaled_tiers,
+        )
+
+        build_info(self.instance_uid)
+        #: local metrics history (GET /metrics/history), scraped from
+        #: the registry every scrape_interval by a background task
+        self.scrape_interval = max(0.05, scrape_interval)
+        self.tsdb = TimeSeriesStore(
+            REGISTRY, tiers=scaled_tiers(self.scrape_interval))
         #: a down Event Server must fail FAST after a few sink errors,
         #: not tie both feedback workers up in 5 s connect timeouts
         self._sink_breaker = CircuitBreaker(
@@ -283,6 +297,7 @@ class EngineServer:
         router.route("GET", "/reload", self._reload)
         router.route("GET", "/stop", self._stop)
         router.route("GET", "/metrics", self._metrics)
+        router.route("GET", "/metrics/history", self._metrics_history)
         router.route("GET", "/traces", traces_handler)
         router.route("GET", "/plugins.json", self._plugins_list)
         router.route("GET", "/plugins/{name}/{path+}", self._plugin_route)
@@ -362,7 +377,12 @@ class EngineServer:
         # quiet tenants keep their seats. Requests with no app header
         # share one default bucket — single-tenant behavior unchanged.
         app = req.headers.get("x-pio-app", "")
-        if self.max_inflight and not self._fair.try_acquire(app):
+        # router-originated synthetic canaries are marked X-PIO-Probe:
+        # they measure the serving path but must not CHARGE anyone —
+        # no tenant's fair-share seat, no variant scoreboard sample
+        probe = "x-pio-probe" in req.headers
+        if self.max_inflight and not probe \
+                and not self._fair.try_acquire(app):
             self._m_shed.inc((app or "-",))
             self._m_queries.inc(("503",))
             return self._unavailable(
@@ -392,7 +412,7 @@ class EngineServer:
             finally:
                 self._inflight -= 1
         finally:
-            if self.max_inflight:
+            if self.max_inflight and not probe:
                 self._fair.release(app)
         self._m_queries.inc((status,))
         dt = time.perf_counter() - t0
@@ -403,7 +423,7 @@ class EngineServer:
         # the latency histogram observes EVERY outcome — the 400/500
         # (and 504) tails are exactly the slow failures worth seeing
         self._m_latency.observe(dt, (status,), exemplar=tracing.exemplar())
-        if self._scoreboard is not None:
+        if self._scoreboard is not None and not probe:
             served_by = resp.headers.get("X-PIO-Variant")
             if served_by:
                 self._scoreboard.observe_request(served_by, dt, status)
@@ -948,6 +968,13 @@ class EngineServer:
         return Response.text(REGISTRY.render(),
                              content_type="text/plain; version=0.0.4")
 
+    async def _metrics_history(self, req: Request) -> Response:
+        from predictionio_tpu.utils.timeseries import history_payload
+
+        status, payload = history_payload(
+            self.tsdb, req.param("series") or "", req.param("window") or "")
+        return Response.json(payload, status=status)
+
     async def _plugins_list(self, req: Request) -> Response:
         return Response.json({"plugins": {
             "outputblockers": [p.name for p in self.plugins],
@@ -966,9 +993,17 @@ class EngineServer:
     # -- lifecycle -------------------------------------------------------------
 
     async def serve_forever(self) -> None:
+        from predictionio_tpu.utils.timeseries import scrape_loop
+
+        scraper = asyncio.create_task(
+            scrape_loop(self.tsdb, self.scrape_interval),
+            name="pio-engine-tsdb")
         try:
             await self.http.serve_forever()
         finally:
+            scraper.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await scraper
             # the batcher's collector task must die BEFORE the loop
             # closes: a pending queue.get() getter cancelled at
             # interpreter teardown touches the closed loop and raises

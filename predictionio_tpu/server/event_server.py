@@ -170,6 +170,7 @@ class EventServer:
         access_log: bool = False,
         segment_maintenance: bool = False,
         tenant_quotas: Optional[Any] = None,
+        scrape_interval: float = 10.0,
     ) -> None:
         self.storage = storage or get_storage()
         # per-app QoS policy (quotas.json next to the event data,
@@ -209,6 +210,22 @@ class EventServer:
         self._m_quota = REGISTRY.counter(
             "pio_tenant_quota_rejected_total",
             "Events refused by the app's own ingest quota", ("app",))
+        from predictionio_tpu.utils.metrics import build_info
+        from predictionio_tpu.utils.timeseries import (
+            TimeSeriesStore,
+            scaled_tiers,
+        )
+
+        import uuid as _uuid
+
+        #: process identity on pio_build_info (and fleet dashboards)
+        self.instance_uid = _uuid.uuid4().hex[:12]
+        build_info(self.instance_uid)
+        #: local metrics history (GET /metrics/history), scraped from
+        #: the registry every scrape_interval by a background task
+        self.scrape_interval = max(0.05, scrape_interval)
+        self.tsdb = TimeSeriesStore(
+            REGISTRY, tiers=scaled_tiers(self.scrape_interval))
         self._ingest = None
         if ingest_batching:
             from predictionio_tpu.server.ingest import WriteCoalescer
@@ -222,6 +239,7 @@ class EventServer:
         router.route("GET", "/", self._status)
         router.route("GET", "/health", self._health)
         router.route("GET", "/metrics", self._metrics)
+        router.route("GET", "/metrics/history", self._metrics_history)
         router.route("GET", "/traces", traces_handler)
         router.route("POST", "/events.json", self._post_event)
         router.route("GET", "/events.json", self._get_events)
@@ -440,6 +458,13 @@ class EventServer:
         return Response.text(REGISTRY.render(),
                              content_type="text/plain; version=0.0.4")
 
+    async def _metrics_history(self, req: Request) -> Response:
+        from predictionio_tpu.utils.timeseries import history_payload
+
+        status, payload = history_payload(
+            self.tsdb, req.param("series") or "", req.param("window") or "")
+        return Response.json(payload, status=status)
+
     async def _post_event(self, req: Request) -> Response:
         auth, err = self._auth(req)
         if err:
@@ -609,9 +634,19 @@ class EventServer:
     # -- lifecycle -------------------------------------------------------------
 
     async def serve_forever(self) -> None:
+        import contextlib
+
+        from predictionio_tpu.utils.timeseries import scrape_loop
+
+        scraper = asyncio.create_task(
+            scrape_loop(self.tsdb, self.scrape_interval),
+            name="pio-events-tsdb")
         try:
             await self.http.serve_forever()
         finally:
+            scraper.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await scraper
             if self._ingest is not None:
                 # drain: everything accepted before shutdown commits —
                 # a 201 promised durability, so the queue must land

@@ -54,9 +54,19 @@ bakes no aiohttp):
   (probe-then-swap + AOT pre-warm happen replica-side), polled back to
   ready, and re-admitted. A full-fleet model swap serves zero errors.
 
+- **Observability plane** — the router keeps an in-process
+  time-series store (``utils/timeseries.py``) fed by its own registry
+  AND by federating every replica's ``/metrics`` (summed, re-exposed
+  as ``pio_fleet_*``), evaluates declarative SLOs from
+  ``conf/slo.json`` into multi-window burn-rate gauges, and runs a
+  low-QPS synthetic prober whose canary queries (tagged
+  ``X-PIO-Probe``) keep the SLO series alive at zero real traffic.
+  ``GET /metrics/history``, ``/slo/status`` and ``/top`` serve the
+  history; a fast burn degrades ``/health``.
+
 Fault sites (``utils/faults.py``): ``router.replica.down`` and
 ``router.replica.slow`` on the forward path, ``router.health.flap`` on
-the active probe.
+the active probe, ``slo.probe.fail`` on the synthetic prober.
 """
 
 from __future__ import annotations
@@ -67,6 +77,7 @@ import json
 import os
 import random
 import urllib.parse
+import uuid
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
@@ -77,11 +88,23 @@ from predictionio_tpu.server.http import (
     Router,
     traces_handler,
 )
+from predictionio_tpu.server.slo import SloEngine
 from predictionio_tpu.server.tenancy import TenantQuotas
 from predictionio_tpu.utils import tracing
 from predictionio_tpu.utils.faults import FAULTS
-from predictionio_tpu.utils.metrics import REGISTRY
+from predictionio_tpu.utils.metrics import REGISTRY, _num, build_info
 from predictionio_tpu.utils.resilience import CircuitBreaker, parse_retry_after
+from predictionio_tpu.utils.timeseries import (
+    LabelSet,
+    TimeSeriesStore,
+    history_payload,
+    parse_duration,
+    parse_prom_text,
+    parse_selector,
+    render_key,
+    scaled_tiers,
+    scrape_loop,
+)
 
 # replica states (the router's view; /health's "ok"/"degraded"/
 # "not-ready" map onto the first three, "down" is the router's own
@@ -100,6 +123,14 @@ _IDEMPOTENT_POSTS = frozenset({"/queries.json"})
 #: consecutive probe failures before a replica is marked down (one
 #: blip must not eject a replica the passive path still likes)
 _DOWN_AFTER = 2
+
+#: paths that get their own per-path latency series; anything else is
+#: folded into "other" — the path is client-controlled and a metric
+#: label must never be an unbounded attacker-chosen string
+_TOP_PATHS = frozenset({"/queries.json", "/feedback.json", "/events.json"})
+
+#: fallback SLO config consulted when the ctor gets no explicit path
+_DEFAULT_SLO_CONFIG = os.path.join("conf", "slo.json")
 
 
 class ReplicaError(RuntimeError):
@@ -243,6 +274,11 @@ class FleetRouter:
         breaker_reset: float = 5.0,
         access_log: bool = False,
         tenant_quotas: Optional[Any] = None,
+        slo_config: Optional[str] = None,
+        scrape_interval: float = 10.0,
+        probe_interval: float = 0.0,
+        probe_path: str = "/queries.json",
+        probe_body: str = '{"user": "pio-probe", "num": 1}',
     ) -> None:
         if not replicas and not manifest:
             raise ValueError("need a replica list or a manifest file")
@@ -296,6 +332,25 @@ class FleetRouter:
         self._reload_lock: Optional[asyncio.Lock] = None
         self._rng = random.Random(0x9107)
 
+        # -- observability plane: TSDB + federation + SLOs + prober
+        self.instance_uid = uuid.uuid4().hex[:12]
+        build_info(self.instance_uid)
+        self.scrape_interval = max(0.05, scrape_interval)
+        self.probe_interval = max(0.0, probe_interval)
+        self.probe_path = probe_path
+        self.probe_body = probe_body.encode("utf-8")
+        self.tsdb = TimeSeriesStore(
+            REGISTRY, tiers=scaled_tiers(self.scrape_interval))
+        if slo_config:
+            self.slo = SloEngine.from_file(slo_config, self.tsdb)
+        elif os.path.exists(_DEFAULT_SLO_CONFIG):
+            self.slo = SloEngine.from_file(_DEFAULT_SLO_CONFIG, self.tsdb)
+        else:
+            self.slo = SloEngine(self.tsdb)
+        #: last federated snapshot, appended verbatim to /metrics so
+        #: one scrape of the router sees the whole fleet
+        self._fleet_snapshot: Dict[Tuple[str, LabelSet], float] = {}
+
         self._m_state = REGISTRY.gauge(
             "pio_router_replica_state",
             "Replica state (0 ok, 1 degraded, 2 not-ready, 3 down, "
@@ -329,11 +384,29 @@ class FleetRouter:
         self._m_rolling = REGISTRY.counter(
             "pio_router_rolling_reloads_total",
             "Rolling fleet reloads", ("result",))
+        self._m_path_s = REGISTRY.histogram(
+            "pio_router_path_seconds",
+            "End-to-end routed request latency per path (seconds)",
+            labelnames=("path",))
+        self._m_probe = REGISTRY.counter(
+            "pio_probe_requests_total",
+            "Synthetic canary probes by path and outcome",
+            ("path", "outcome"))
+        self._m_probe_s = REGISTRY.histogram(
+            "pio_probe_seconds",
+            "Synthetic canary probe latency (seconds)",
+            labelnames=("path",))
+        self._m_federate = REGISTRY.counter(
+            "pio_fleet_scrapes_total",
+            "Replica /metrics federation scrapes", ("replica", "result"))
 
         router = Router()
         router.route("GET", "/", self._root)
         router.route("GET", "/health", self._own_health)
         router.route("GET", "/metrics", self._metrics)
+        router.route("GET", "/metrics/history", self._metrics_history)
+        router.route("GET", "/slo/status", self._slo_status)
+        router.route("GET", "/top", self._top)
         router.route("GET", "/traces", traces_handler)
         router.route("GET", "/router/status", self._router_status)
         router.route("POST", "/router/reload", self._router_reload)
@@ -745,7 +818,9 @@ class FleetRouter:
                     budget = min(budget, v)
             except ValueError:
                 pass
-        deadline = loop.time() + budget
+        t_start = loop.time()
+        deadline = t_start + budget
+        path_label = req.path if req.path in _TOP_PATHS else "other"
         target = req.path
         if req.query:
             target += "?" + urllib.parse.urlencode(req.query, doseq=True)
@@ -783,6 +858,7 @@ class FleetRouter:
                 ("transport", app) if att.status == 0
                 else (str(att.status), app))
 
+        self._m_path_s.observe(loop.time() - t_start, (path_label,))
         if att is None:
             self._m_requests.inc(("503",))
             resp = Response.json(
@@ -903,6 +979,99 @@ class FleetRouter:
             except Exception:  # noqa: BLE001 — the loop must survive
                 pass
 
+    # -- observability plane -----------------------------------------------
+
+    async def _federate(self) -> None:
+        """Scrape every serving replica's ``/metrics``, SUM the
+        ``pio_*`` samples across the fleet per (name, labels), and
+        record them into the router's TSDB under the ``pio_fleet_``
+        prefix. Counters sum to a fleet counter (per-series reset
+        handling still works: one replica restart only dents its own
+        contribution), histogram ``_bucket``/``_sum``/``_count`` lines
+        sum into mergeable fleet buckets. A failed replica scrape costs
+        that replica's samples this tick, nothing else."""
+        ts = self.tsdb.clock()
+        merged: Dict[Tuple[str, LabelSet], float] = {}
+        for rep in list(self.replicas):
+            if rep.state not in (OK, DEGRADED):
+                continue
+            try:
+                status, _, body = await self._fetch(
+                    rep, "GET", "/metrics", {}, b"",
+                    max(1.0, self.scrape_interval))
+            except Exception:  # noqa: BLE001 — fail-soft per replica
+                self._m_federate.inc((rep.name, "error"))
+                continue
+            if status != 200:
+                self._m_federate.inc((rep.name, "error"))
+                continue
+            self._m_federate.inc((rep.name, "ok"))
+            for name, labels, value in parse_prom_text(
+                    body.decode("utf-8", "replace")):
+                if not name.startswith("pio_"):
+                    continue
+                key = ("pio_fleet_" + name[len("pio_"):],
+                       tuple(sorted(labels.items())))
+                merged[key] = merged.get(key, 0.0) + value
+        for (name, labels), value in merged.items():
+            self.tsdb.record(name, dict(labels), value, ts)
+        self._fleet_snapshot = merged
+
+    async def _observe_tick(self) -> None:
+        """Runs on every TSDB scrape tick, after the local registry
+        scrape: federate the fleet, then re-judge every SLO against the
+        fresh history."""
+        await self._federate()
+        self.slo.evaluate()
+
+    def _render_fleet(self) -> str:
+        if not self._fleet_snapshot:
+            return ""
+        lines = ["# fleet-federated series (summed across replicas)"]
+        for (name, labels), v in sorted(self._fleet_snapshot.items()):
+            lines.append(f"{render_key(name, labels)} {_num(v)}")
+        return "\n".join(lines) + "\n"
+
+    async def _probe_once(self) -> None:
+        """One synthetic canary: pick a replica, send the probe query
+        tagged ``X-PIO-Probe`` (replicas exclude it from tenant quota
+        charges and variant scoreboards; going through ``_fetch``
+        rather than ``_proxy`` keeps it out of the router's own request
+        accounting and retry budgets). Outcome lands in the
+        ``pio_probe_*`` series the default SLOs watch — the prober's
+        whole job is making "no traffic" impossible."""
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        outcome = "ok"
+        try:
+            await FAULTS.ahit("slo.probe.fail")
+            replica = self._pick(set())
+            if replica is None:
+                raise ReplicaError("no replica available to probe")
+            await FAULTS.ahit("router.replica.down")
+            status, _, _ = await self._fetch(
+                replica, "POST", self.probe_path,
+                {"Content-Type": "application/json", "X-PIO-Probe": "1"},
+                self.probe_body, min(5.0, self.default_deadline))
+            if status >= 500:
+                outcome = "error"
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — a failed probe IS the signal
+            outcome = "error"
+        self._m_probe.inc((self.probe_path, outcome))
+        self._m_probe_s.observe(loop.time() - t0, (self.probe_path,))
+
+    async def _probe_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.probe_interval)
+            try:
+                await self._probe_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — the loop must survive
+                pass
+
     # -- rolling reload ----------------------------------------------------
 
     async def rolling_reload(self) -> Dict[str, Any]:
@@ -1004,11 +1173,19 @@ class FleetRouter:
     async def _own_health(self, req: Request) -> Response:
         now = asyncio.get_running_loop().time()
         avail = sum(1 for r in self.replicas if r.available(now))
+        burning = self.slo.fast_burning()
         body = {
-            "status": "ok" if avail else "not-ready",
+            "status": ("ok" if avail and not burning
+                       else "degraded" if avail else "not-ready"),
             "available": avail,
             "replicas": {r.name: r.state for r in self.replicas},
+            "instance": self.instance_uid,
         }
+        if burning:
+            # an SLO fast burn means the fleet is eating its error
+            # budget NOW — still serving (200), but degraded so
+            # supervisors and dashboards see it without scraping
+            body["sloFastBurn"] = burning
         if avail:
             return Response.json(body)
         resp = Response.json(body, status=503)
@@ -1034,8 +1211,91 @@ class FleetRouter:
         return Response.json(out, status=200 if out["ok"] else 500)
 
     async def _metrics(self, req: Request) -> Response:
-        return Response.text(REGISTRY.render(),
+        # own registry first, then the federated fleet snapshot: one
+        # scrape of the router is one scrape point for the whole pod
+        return Response.text(REGISTRY.render() + self._render_fleet(),
                              content_type="text/plain; version=0.0.4")
+
+    async def _metrics_history(self, req: Request) -> Response:
+        status, payload = history_payload(
+            self.tsdb, req.param("series") or "", req.param("window") or "")
+        return Response.json(payload, status=status)
+
+    async def _slo_status(self, req: Request) -> Response:
+        self.slo.evaluate()
+        return Response.json(self.slo.to_json())
+
+    async def _top(self, req: Request) -> Response:
+        """Everything ``pio top`` renders, computed server-side over
+        the federated history so the CLI stays a dumb refresh loop."""
+        try:
+            window = parse_duration(req.param("window") or "1m")
+        except ValueError as e:
+            return Response.json({"message": str(e)}, status=400)
+
+        by_status: Dict[str, float] = {}
+        for key in self.tsdb.query("pio_router_requests_total", window):
+            _, labels = parse_selector(key)
+            by_status[labels.get("status", "?")] = round(
+                self.tsdb.rate(key, window), 3)
+
+        def _ms(v: Optional[float]) -> Optional[float]:
+            return None if v is None else round(v * 1e3, 3)
+
+        paths: Dict[str, Dict[str, Any]] = {}
+        for p in sorted(_TOP_PATHS | {"other"}):
+            count_key = render_key("pio_router_path_seconds_count",
+                                   (("path", p),))
+            if not any(self.tsdb.query(count_key, window).values()):
+                continue
+            paths[p] = {
+                "qps": round(self.tsdb.rate(count_key, window), 3),
+                "p50Ms": _ms(self.tsdb.quantile(
+                    "pio_router_path_seconds", 0.5, window, {"path": p})),
+                "p99Ms": _ms(self.tsdb.quantile(
+                    "pio_router_path_seconds", 0.99, window, {"path": p})),
+            }
+
+        variant_rates: Dict[str, float] = {}
+        for key in self.tsdb.query(
+                "pio_fleet_variant_requests_total", window):
+            _, labels = parse_selector(key)
+            v = labels.get("variant", "?")
+            variant_rates[v] = (variant_rates.get(v, 0.0)
+                                + self.tsdb.rate(key, window))
+        vtotal = sum(variant_rates.values())
+        variants = {v: {"qps": round(r, 3),
+                        "share": round(r / vtotal, 4) if vtotal else 0.0}
+                    for v, r in sorted(variant_rates.items())}
+
+        sheds: Dict[str, float] = {}
+        for key in self.tsdb.query("pio_fleet_engine_shed_total", window):
+            _, labels = parse_selector(key)
+            r = self.tsdb.rate(key, window)
+            if r > 0:
+                sheds[labels.get("app", "-")] = round(r, 3)
+
+        probe: Dict[str, float] = {}
+        for key in self.tsdb.query("pio_probe_requests_total", window):
+            _, labels = parse_selector(key)
+            probe[labels.get("outcome", "?")] = round(
+                self.tsdb.rate(key, window), 4)
+
+        self.slo.evaluate()
+        return Response.json({
+            "windowSeconds": window,
+            "qps": {"total": round(sum(by_status.values()), 3),
+                    "byStatus": by_status},
+            "paths": paths,
+            "variants": variants,
+            "tenantSheds": sheds,
+            "probe": probe,
+            "replicas": [dict(r.snapshot(),
+                              modelGeneration=r.last_health.get(
+                                  "modelGeneration"))
+                         for r in self.replicas],
+            "slo": self.slo.to_json(),
+        })
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -1043,14 +1303,25 @@ class FleetRouter:
         # probe the fleet once BEFORE accepting traffic, so the first
         # client request has states to route on
         await self._poll_all()
-        poller = asyncio.create_task(self._health_loop(),
-                                     name="pio-router-health")
+        tasks = [
+            asyncio.create_task(self._health_loop(),
+                                name="pio-router-health"),
+            asyncio.create_task(
+                scrape_loop(self.tsdb, self.scrape_interval,
+                            extra=self._observe_tick),
+                name="pio-router-observe"),
+        ]
+        if self.probe_interval > 0:
+            tasks.append(asyncio.create_task(self._probe_loop(),
+                                             name="pio-router-probe"))
         try:
             await self.http.serve_forever()
         finally:
-            poller.cancel()
-            with contextlib.suppress(asyncio.CancelledError):
-                await poller
+            for t in tasks:
+                t.cancel()
+            for t in tasks:
+                with contextlib.suppress(asyncio.CancelledError):
+                    await t
             for r in self.replicas:
                 r.close_pool()
 

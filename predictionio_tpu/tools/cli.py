@@ -22,6 +22,7 @@ import importlib
 import json
 import os
 import sys
+import time
 from typing import Any, Dict, List, Optional
 
 from predictionio_tpu.storage.registry import get_storage
@@ -277,6 +278,9 @@ def cmd_router(args: argparse.Namespace) -> None:
             ready_timeout=args.ready_timeout,
             access_log=args.access_log,
             tenant_quotas=args.tenant_quotas,
+            slo_config=args.slo_config,
+            scrape_interval=args.scrape_interval,
+            probe_interval=args.probe_interval,
         )
         print(f"[info] Fleet router on {args.ip}:{args.port} over "
               f"{len(router.replicas)} replicas "
@@ -306,6 +310,105 @@ def cmd_router(args: argparse.Namespace) -> None:
     print(json.dumps(out, indent=2, sort_keys=True))
     if not out.get("ok"):
         _die("fleet reload failed")
+
+
+def cmd_slo(args: argparse.Namespace) -> None:
+    """SLO burn-rate status from a running router (jax-free — runs on
+    an ops box). Exit 1 while any SLO is fast-burning, so the runbook's
+    "is it still burning?" check is one shell command."""
+    base = args.url.rstrip("/")
+    try:
+        doc = _http_json(f"{base}/slo/status", timeout=args.timeout)
+    except Exception as e:  # noqa: BLE001 — ops verb, readable failure
+        _die(f"GET {base}/slo/status failed: {type(e).__name__}: {e}")
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        windows = (doc.get("windows") or {})
+        th = (doc.get("thresholds") or {})
+        print(f"[slo] {base}  fast windows "
+              f"{'/'.join(windows.get('fast', []))} > {th.get('fast')}  "
+              f"slow {'/'.join(windows.get('slow', []))} > {th.get('slow')}")
+        labels = {0: "ok", 1: "SLOW BURN", 2: "FAST BURN"}
+        for s in doc.get("slos", []):
+            burns = "  ".join(f"{w}={b:g}" for w, b in
+                              sorted((s.get("burnRate") or {}).items()))
+            print(f"  {s['name']:<24} objective={s['objective']:g}  "
+                  f"{burns}  {labels.get(s.get('alerting'), '?')}")
+    if doc.get("fastBurning"):
+        raise SystemExit(1)
+
+
+def cmd_top(args: argparse.Namespace) -> None:
+    """Terminal fleet dashboard over the router's federated history
+    (jax-free). A dumb refresh loop: everything shown is computed
+    server-side by GET /top."""
+    base = args.url.rstrip("/")
+    once = args.once or args.json
+
+    def frame() -> None:
+        doc = _http_json(f"{base}/top?window={args.window}",
+                         timeout=args.timeout)
+        if args.json:
+            print(json.dumps(doc, indent=2, sort_keys=True))
+            return
+        if "_status" in doc:
+            print(f"[top] {base}: HTTP {doc['_status']}: "
+                  f"{doc.get('message')}")
+            return
+        if not once:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+        qps = doc.get("qps") or {}
+        by = ", ".join(f"{k}:{v:g}" for k, v in
+                       sorted((qps.get("byStatus") or {}).items()))
+        print(f"pio top — {base}  window={doc.get('windowSeconds'):g}s")
+        print(f"qps {qps.get('total', 0):g}" + (f"  ({by})" if by else ""))
+        paths = doc.get("paths") or {}
+        if paths:
+            print(f"{'PATH':<16}{'QPS':>8}{'P50MS':>10}{'P99MS':>10}")
+            for p, row in sorted(paths.items()):
+                p50, p99 = row.get("p50Ms"), row.get("p99Ms")
+                print(f"{p:<16}{row.get('qps', 0):>8g}"
+                      f"{'-' if p50 is None else p50:>10}"
+                      f"{'-' if p99 is None else p99:>10}")
+        variants = doc.get("variants") or {}
+        if variants:
+            print(f"{'VARIANT':<16}{'QPS':>8}{'SHARE':>10}")
+            for v, row in sorted(variants.items()):
+                print(f"{v:<16}{row.get('qps', 0):>8g}"
+                      f"{row.get('share', 0) * 100:>9.1f}%")
+        sheds = doc.get("tenantSheds") or {}
+        if sheds:
+            print("sheds/s  " + "  ".join(
+                f"{a}={r:g}" for a, r in sorted(sheds.items())))
+        probe = doc.get("probe") or {}
+        if probe:
+            print("probe/s  " + "  ".join(
+                f"{o}={r:g}" for o, r in sorted(probe.items())))
+        slo = doc.get("slo") or {}
+        labels = {0: "ok", 1: "SLOW", 2: "FAST-BURN"}
+        for s in slo.get("slos", []):
+            burns = "  ".join(f"{w}={b:g}" for w, b in
+                              sorted((s.get("burnRate") or {}).items()))
+            print(f"slo {s['name']:<22} {burns}  "
+                  f"{labels.get(s.get('alerting'), '?')}")
+        print(f"{'REPLICA':<22}{'STATE':<11}{'BREAKER':<9}"
+              f"{'EWMA-MS':>8}  GEN")
+        for r in doc.get("replicas", []):
+            gen = r.get("modelGeneration")
+            print(f"{r.get('url', '?'):<22}{r.get('state', '?'):<11}"
+                  f"{r.get('breaker', '?'):<9}{r.get('ewmaMs', 0):>8g}"
+                  f"  {'-' if gen is None else gen}")
+
+    try:
+        frame()
+        while not once:
+            time.sleep(max(0.2, args.interval))
+            frame()
+    except KeyboardInterrupt:
+        pass
+    except Exception as e:  # noqa: BLE001 — ops verb, readable failure
+        _die(f"GET {base}/top failed: {type(e).__name__}: {e}")
 
 
 # -- train / eval / batchpredict ----------------------------------------------
@@ -1424,6 +1527,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "retry/hedge budgets and deadline caps "
                         "(default: <storage home>/quotas.json; "
                         "hot-reloaded)")
+    x.add_argument("--slo-config", metavar="PATH", default=None,
+                   help="SLO objectives file for the burn-rate engine "
+                        "(default: ./conf/slo.json if present, else the "
+                        "built-in prober objectives)")
+    x.add_argument("--scrape-interval", type=float, default=10.0,
+                   help="seconds between metrics-history scrape ticks "
+                        "(local registry + fleet federation + SLO "
+                        "evaluation)")
+    x.add_argument("--probe-interval", type=float, default=2.0,
+                   help="seconds between synthetic canary probes "
+                        "(X-PIO-Probe queries feeding the SLO series; "
+                        "0 disables the prober)")
     _add_observability_flags(x)
     x = rts.add_parser("status", help="replica states from a running router")
     x.add_argument("--url", default="http://localhost:8100")
@@ -1662,6 +1777,35 @@ def build_parser() -> argparse.ArgumentParser:
                     help="repo root to analyze (default: the tree this "
                          "package was loaded from)")
     ln.set_defaults(fn=cmd_lint)
+
+    sp = sub.add_parser(
+        "slo", help="SLO burn-rate status from a running router")
+    sps = sp.add_subparsers(dest="slo_cmd", required=True)
+    x = sps.add_parser("status", help="print burn rates per SLO "
+                                      "(exit 1 while fast-burning)")
+    x.add_argument("--url", default="http://localhost:8100",
+                   help="router base URL")
+    x.add_argument("--json", action="store_true",
+                   help="raw /slo/status JSON instead of the table")
+    x.add_argument("--timeout", type=float, default=10.0)
+    x.set_defaults(fn=cmd_slo)
+
+    tp = sub.add_parser(
+        "top", help="live fleet view from a running router "
+                    "(QPS, latency, variants, tenants, SLOs, replicas)")
+    tp.add_argument("--url", default="http://localhost:8100",
+                    help="router base URL")
+    tp.add_argument("--window", default="1m",
+                    help="rate/quantile window over federated history "
+                         "(e.g. 30s, 1m, 5m)")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds")
+    tp.add_argument("--once", action="store_true",
+                    help="render one frame and exit (no screen clear)")
+    tp.add_argument("--json", action="store_true",
+                    help="raw /top JSON once and exit")
+    tp.add_argument("--timeout", type=float, default=10.0)
+    tp.set_defaults(fn=cmd_top)
 
     vp = sub.add_parser("version")
     vp.set_defaults(fn=lambda a: print(__version__))

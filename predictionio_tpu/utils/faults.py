@@ -89,6 +89,14 @@ Known sites (grep ``faults.inject`` for the authoritative list):
                         hash is bypassed and every append lands on
                         writer shard 0 (the skew the per-shard append
                         series must make visible)
+``slo.probe.fail``      router synthetic prober, before the canary is
+                        sent — the probe fails (or stalls) so the SLO
+                        burn-rate series must spike and ``/health``
+                        must degrade on the fast windows
+``tsdb.scrape.stall``   metrics-history scrape tick (every server) —
+                        a wedged/failing scraper costs history ticks,
+                        never the serving path; watch
+                        ``pio_tsdb_scrapes_total{result="error"}``
 ======================  ===================================================
 """
 

@@ -10,6 +10,7 @@ both the event server and the engine server.
 
 from __future__ import annotations
 
+import bisect
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -29,6 +30,17 @@ class Counter:
         key = tuple(str(l) for l in labels)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + n
+
+    def get(self, labels: Sequence[str] = ()) -> float:
+        key = tuple(str(l) for l in labels)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def items(self) -> List[Tuple[Tuple[str, ...], float]]:
+        """Snapshot of every (label values, value) pair — the scrape
+        path the TSDB uses instead of parsing text exposition."""
+        with self._lock:
+            return sorted(self._values.items())
 
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
@@ -69,6 +81,10 @@ class Gauge:
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def items(self) -> List[Tuple[Tuple[str, ...], float]]:
+        with self._lock:
+            return sorted(self._values.items())
+
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} gauge"]
@@ -106,10 +122,8 @@ class Histogram:
             self._sums[()] = 0.0
 
     def _bucket_index(self, value: float) -> int:
-        for i, b in enumerate(self.buckets):
-            if value <= b:
-                return i
-        return len(self.buckets)  # +Inf tail
+        # smallest i with value <= buckets[i]; past the end = +Inf tail
+        return bisect.bisect_left(self.buckets, value)
 
     def observe(self, value: float, labels: Sequence[str] = (),
                 exemplar: Optional[str] = None) -> None:
@@ -139,6 +153,24 @@ class Histogram:
                 return None
         with self._lock:
             return self._exemplars.get((key, i))
+
+    def sum_count(self, labels: Sequence[str] = ()) -> Tuple[float, int]:
+        """(sum of observations, observation count) for one label set —
+        zeroes when the series does not exist yet."""
+        key = tuple(str(l) for l in labels)
+        with self._lock:
+            counts = self._counts.get(key)
+            if counts is None:
+                return 0.0, 0
+            return self._sums[key], sum(counts)
+
+    def items(self) -> List[Tuple[Tuple[str, ...], List[int], float]]:
+        """Snapshot of (label values, per-bucket counts, sum) per
+        series; counts are NON-cumulative, one slot per bucket plus the
+        +Inf tail."""
+        with self._lock:
+            return sorted((k, list(c), self._sums[k])
+                          for k, c in self._counts.items())
 
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
@@ -218,6 +250,11 @@ class Registry:
                     f"{m.labelnames}, requested {tuple(labelnames)}")
             return m
 
+    def metrics(self) -> List[object]:
+        """Snapshot of every registered metric object (scrape path)."""
+        with self._lock:
+            return list(self._metrics.values())
+
     def render(self) -> str:
         with self._lock:
             metrics = list(self._metrics.values())
@@ -245,3 +282,18 @@ def _num(v: float) -> str:
 
 
 REGISTRY = Registry()
+
+
+def build_info(instance: str) -> Gauge:
+    """Emit the ``pio_build_info`` identity gauge for this process:
+    always-1, with the running version and the server's instance uid as
+    labels. Federation turns it into a per-version fleet census — a
+    half-finished rollout is one ``sum by (version)`` away."""
+    from predictionio_tpu.version import __version__
+
+    g = REGISTRY.gauge(
+        "pio_build_info",
+        "Build/identity info (value is always 1; the labels carry it)",
+        ("version", "instance"))
+    g.set(1, (__version__, instance))
+    return g

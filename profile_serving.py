@@ -48,6 +48,18 @@ promotion), an injected ``promote.regression`` (guardrail refusal,
 fleet stays on the champion), and a fenced-out second trainer — all
 under live serving load that must stay all-200s.
 
+SLO burn-rate chaos mode (the acceptance harness for
+docs/operations.md "Responding to an SLO fast-burn alert")::
+
+    python profile_serving.py --slo
+
+runs the synthetic prober against one replica behind a FleetRouter
+with second-scale burn windows, injects ``router.replica.down``, and
+proves the availability SLO trips its FAST burn within two scrape
+intervals and degrades ``/health``, then that the page clears after
+the fault is lifted and the fleet serves all-200 again — with zero
+XLA compiles on the serving path across the whole drill.
+
 Prints ONE JSON line. On this image's tunneled TPU every device→host
 fetch after the first pays a ~66 ms relay round trip (BASELINE.md
 note) — run with ``--platform cpu`` for the HTTP/host shares and on a
@@ -1396,6 +1408,199 @@ def run_tenants_mode(args) -> None:
         shutil.rmtree(home, ignore_errors=True)
 
 
+def run_slo_mode(args, st, factory) -> None:
+    """SLO burn-rate chaos harness (ISSUE 14 acceptance): one engine
+    replica behind a FleetRouter running the synthetic prober, the
+    scraper, and an SLO config with second-scale burn windows so the
+    drill fits in wall-clock seconds. Phases:
+
+    1. healthy — the prober alone keeps every burn rate at 0 and
+       ``/health`` at ok;
+    2. ``router.replica.down`` armed — every probe fails, the
+       availability SLO must trip its FAST burn within two scrape
+       intervals, ``/health`` must report degraded (with
+       ``sloFastBurn`` naming the SLO, the replica itself still
+       polling healthy) and ``pio_slo_alerting`` must read 2;
+    3. disarmed — the page must clear, ``/health`` return to ok, and
+       real user traffic serve all-200 again.
+
+    The whole drill (warmup excluded) triggers ZERO XLA compiles on
+    the serving path.
+    """
+    import os
+    import socket
+    import tempfile
+
+    from predictionio_tpu.server.aot import EXECUTABLES
+    from predictionio_tpu.server.engine_server import EngineServer
+    from predictionio_tpu.server.router import FleetRouter
+    from predictionio_tpu.utils.faults import FAULTS
+    from profile_common import server_thread
+
+    scrape, probe = 0.5, 0.1
+    # production windows are minutes-to-hours (conf/slo.json); the
+    # drill shrinks them so a burn is visible in seconds. The 2 s long
+    # window is what makes "trip within two scrapes" non-trivial: the
+    # first post-fault scrape must already show a bad ratio above
+    # 14.4x the 1% budget across BOTH fast windows.
+    slo_cfg = {
+        "windows": {"fast": ["1s", "2s"], "slow": ["10s"]},
+        "thresholds": {"fast": 14.4, "slow": 6.0},
+        "slos": [
+            {"name": "probe-availability", "type": "availability",
+             "objective": 0.99,
+             "series": "pio_probe_requests_total",
+             "labels": {"path": "/queries.json"},
+             "bad": {"outcome": "error"}},
+            {"name": "probe-latency", "type": "latency",
+             "objective": 0.95,
+             "histogram": "pio_probe_seconds",
+             "labels": {"path": "/queries.json"},
+             "threshold_ms": 1000},
+        ],
+    }
+
+    server = EngineServer(engine_factory=factory, storage=st,
+                          host="127.0.0.1", port=args.port)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    router_port = s.getsockname()[1]
+    s.close()
+
+    def slo_status():
+        conn = http.client.HTTPConnection("127.0.0.1", router_port,
+                                          timeout=10)
+        conn.request("GET", "/slo/status")
+        out = json.loads(conn.getresponse().read())
+        conn.close()
+        return out
+
+    def health():
+        conn = http.client.HTTPConnection("127.0.0.1", router_port,
+                                          timeout=10)
+        conn.request("GET", "/health")
+        resp = conn.getresponse()
+        out = (resp.status, json.loads(resp.read()))
+        conn.close()
+        return out
+
+    def wait_for(pred, what: str, deadline_sec: float):
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < deadline_sec:
+            if pred():
+                return time.perf_counter() - t0
+            time.sleep(0.02)
+        raise TimeoutError(f"timed out waiting for {what}")
+
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump(slo_cfg, f)
+        cfg_path = f.name
+    router = FleetRouter(
+        [f"127.0.0.1:{args.port}"],
+        host="127.0.0.1", port=router_port,
+        health_interval=0.25, hedge=False,
+        slo_config=cfg_path,
+        scrape_interval=scrape, probe_interval=probe)
+    try:
+        with server_thread(server, args.port), \
+                server_thread(router, router_port):
+            # -- warmup: compile the serving buckets, let the prober
+            # and the scraper establish a healthy history ------------
+            _router_load(router_port, args.n_users, 50)
+            wait_for(
+                lambda: router._m_probe.get(("/queries.json", "ok")) >= 5,
+                "the prober to land 5 ok probes", 30)
+
+            def avail_quiet():
+                doc = slo_status()
+                a = {s["name"]: s for s in doc["slos"]}.get(
+                    "probe-availability")
+                return (not doc["fastBurning"] and a is not None
+                        and all(b == 0 for b in a["burnRate"].values()))
+
+            # warmup blips (a probe racing the model load can 503)
+            # age out of even the 10 s slow window inside the deadline
+            wait_for(avail_quiet, "a quiet healthy baseline", 30)
+            healthy = slo_status()
+            h_status, h_body = health()
+            healthy_ok = h_status == 200 and h_body["status"] == "ok"
+            compiles_before = EXECUTABLES.counts().get("compile", 0)
+
+            # -- inject: replica down, every probe fails -------------
+            FAULTS.arm("router.replica.down", error="slo-drill")
+            trip_elapsed = wait_for(
+                lambda: "probe-availability" in slo_status()["fastBurning"],
+                "the fast burn to trip", 15)
+            d_status, d_body = health()
+            degraded = (d_status == 200
+                        and d_body["status"] == "degraded"
+                        and "probe-availability"
+                        in d_body.get("sloFastBurn", []))
+            conn = http.client.HTTPConnection("127.0.0.1", router_port,
+                                              timeout=10)
+            conn.request("GET", "/metrics")
+            metrics_text = conn.getresponse().read().decode()
+            conn.close()
+            alerting_gauge = (
+                'pio_slo_alerting{slo="probe-availability"} 2'
+                in metrics_text)
+
+            # -- lift: the page must clear on its own ----------------
+            FAULTS.disarm()
+            recovery_elapsed = wait_for(
+                lambda: (not slo_status()["fastBurning"]
+                         and health()[1]["status"] == "ok"),
+                "the page to clear after disarm", 30)
+            recovered = slo_status()
+            # the fault dropped user traffic too (replica "down"); the
+            # recovered fleet must serve real users all-200 again
+            post_status, _, _ = _router_load(router_port, args.n_users,
+                                             100)
+            compiles = (EXECUTABLES.counts().get("compile", 0)
+                        - compiles_before)
+    finally:
+        FAULTS.disarm()
+        os.unlink(cfg_path)
+
+    avail = {s["name"]: s for s in healthy["slos"]}["probe-availability"]
+    checks = {
+        "healthy_burn_zero": all(
+            b == 0 for b in avail["burnRate"].values()),
+        "healthy_health_ok": healthy_ok,
+        "fast_burn_tripped_within_two_scrapes":
+            trip_elapsed <= 2 * scrape + probe,
+        "health_degraded_with_slo_named": degraded,
+        "alerting_gauge_reads_2": alerting_gauge,
+        "page_cleared_after_disarm":
+            not recovered["fastBurning"],
+        "serving_all_200_after_recovery":
+            set(post_status) == {"200"},
+        "serving_path_compiles_zero": compiles == 0,
+    }
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "slo_burn_rate_drill",
+        "geometry": {"n_users": args.n_users, "n_items": args.n_items,
+                     "rank": args.rank},
+        "scrape_interval_s": scrape,
+        "probe_interval_s": probe,
+        "windows": slo_cfg["windows"],
+        "healthy": healthy["slos"],
+        "trip_elapsed_s": round(trip_elapsed, 3),
+        "trip_bound_s": round(2 * scrape + probe, 3),
+        "degraded_health": d_body,
+        "recovery_elapsed_s": round(recovery_elapsed, 3),
+        "statuses_after_recovery": post_status,
+        "recovered": recovered["slos"],
+        "serving_path_compiles": compiles,
+        "checks": checks,
+        "ok": ok,
+    }))
+    if not ok:
+        raise SystemExit(1)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--queries", type=int, default=2000)
@@ -1457,6 +1662,15 @@ def main() -> None:
                          "max-inflight (burster shed at its fair "
                          "share, quiet tenants all-200 with p99 <= "
                          "1.5x solo, zero serving-path compiles)")
+    ap.add_argument("--slo", action="store_true",
+                    help="SLO burn-rate chaos mode: the synthetic "
+                         "prober against one replica behind a router "
+                         "with second-scale burn windows; an injected "
+                         "router.replica.down must trip the fast burn "
+                         "within two scrape intervals and degrade "
+                         "/health, disarming must clear the page, and "
+                         "the whole drill must trigger zero "
+                         "serving-path compiles")
     ap.add_argument("--aot", action="store_true",
                     help="AOT bucket-ladder mode: cold vs warm ladder "
                          "compile wall time + per-bucket device p50, "
@@ -1499,6 +1713,9 @@ def main() -> None:
     factory = fabricate_instance(st, args.n_users, args.n_items, args.rank)
     if args.router:
         run_router_mode(args, st, factory)
+        return
+    if args.slo:
+        run_slo_mode(args, st, factory)
         return
     if args.fault:
         run_fault_mode(args, st, factory)

@@ -95,6 +95,13 @@ class TestFaultSiteAudit:
         assert {"tenant.quota.exhausted",
                 "segments.shard.hot"} <= table_sites(project)
 
+    def test_observability_plane_sites_are_registered(self, project):
+        """The observability-plane drill sites must stay in the table:
+        the SLO fast-burn runbook and the chaos harness
+        (``profile_serving.py --slo``) arm them by name."""
+        assert {"slo.probe.fail",
+                "tsdb.scrape.stall"} <= table_sites(project)
+
     def test_ann_index_site_is_registered(self, project):
         """The ANN retrieval-index drill site must stay in the table:
         ``pio fsck`` detection and the ``/reload``-refusal drill

@@ -1,0 +1,457 @@
+"""SLO burn-rate engine + observability-plane tests (server/slo.py,
+the router's federation/prober/`/top` surface, probe exclusion on the
+engine server, and the jax-free `pio slo status` / `pio top` verbs).
+
+Burn-rate math runs against a fake-clock TimeSeriesStore; the
+fast-burn drill arms ``slo.probe.fail`` against a live router over
+stub replicas — the same rehearsal the runbook
+(docs/operations.md "Responding to an SLO fast-burn alert") and
+``profile_serving.py --slo`` perform."""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.server.http import Response
+from predictionio_tpu.server.slo import DEFAULT_CONFIG, SloEngine, _parse_spec
+from predictionio_tpu.utils.faults import FAULTS
+from predictionio_tpu.utils.metrics import Registry
+from predictionio_tpu.utils.timeseries import TimeSeriesStore
+from tests.test_router import StubReplica, cval, fleet, http_full, wait_until
+from tests.test_servers import ServerThread, free_port, http
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def disarm_faults():
+    FAULTS.disarm()
+    yield
+    FAULTS.disarm()
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_store():
+    return TimeSeriesStore(Registry(), tiers=((1.0, 1000),),
+                           clock=FakeClock())
+
+
+WINDOWS = {"windows": {"fast": ["10s", "60s"], "slow": ["60s"]}}
+
+
+def avail_config(objective=0.99):
+    return {**WINDOWS, "slos": [
+        {"name": "avail", "type": "availability", "objective": objective,
+         "series": "pio_p_total", "bad": {"outcome": "error"}}]}
+
+
+# -- burn-rate math ------------------------------------------------------------
+
+
+class TestBurnRateMath:
+    def test_availability_burn_is_bad_ratio_over_budget(self):
+        store = make_store()
+        for outcome in ("ok", "error"):
+            store.record("pio_p_total", {"outcome": outcome}, 0.0, ts=0.0)
+        store.record("pio_p_total", {"outcome": "ok"}, 5.0, ts=10.0)
+        store.record("pio_p_total", {"outcome": "error"}, 5.0, ts=10.0)
+        eng = SloEngine(store, avail_config(), registry=Registry())
+        (st,) = eng.evaluate(ts=10.0)
+        # 5 bad / 10 total = 0.5 bad ratio; budget 0.01 → burn 50
+        assert st.burn["10s"] == pytest.approx(50.0)
+        assert st.burn["60s"] == pytest.approx(50.0)
+        assert st.fast_burn and st.slow_burn and st.alerting == 2
+        assert eng.fast_burning() == ["avail"]
+        # the gauges publish what /metrics will show, capped sanely
+        assert eng._m_burn.get(("avail", "10s")) == pytest.approx(50.0)
+        assert eng._m_alerting.get(("avail",)) == 2
+
+    def test_fast_page_needs_every_fast_window_burning(self):
+        """Google-SRE multi-window semantics: an old burst still inside
+        the long window must NOT page once the short window is clean —
+        that is exactly what makes the page reset quickly."""
+        store = make_store()
+        store.record("pio_p_total", {"outcome": "error"}, 0.0, ts=0.0)
+        store.record("pio_p_total", {"outcome": "error"}, 10.0, ts=5.0)
+        store.record("pio_p_total", {"outcome": "ok"}, 0.0, ts=0.0)
+        # after the burst: errors flat, successes accrue
+        for ts in (10.0, 20.0, 30.0, 40.0, 50.0, 58.0):
+            store.record("pio_p_total", {"outcome": "error"}, 10.0, ts=ts)
+            store.record("pio_p_total", {"outcome": "ok"}, ts, ts=ts)
+        eng = SloEngine(store, avail_config(), registry=Registry())
+        (st,) = eng.evaluate(ts=58.0)
+        assert st.burn["10s"] == pytest.approx(0.0)   # short window clean
+        assert st.burn["60s"] > 6.0                   # long window dirty
+        assert not st.fast_burn and st.slow_burn and st.alerting == 1
+
+    def test_no_events_burns_at_zero(self):
+        eng = SloEngine(make_store(), avail_config(), registry=Registry())
+        (st,) = eng.evaluate(ts=100.0)
+        assert st.burn == {"10s": 0.0, "60s": 0.0}
+        assert st.alerting == 0
+
+    def test_counter_reset_does_not_fake_a_burn(self):
+        """A replica restart drops its counters to zero; reset-aware
+        increase must not turn that into phantom errors."""
+        store = make_store()
+        for ts, ok, err in [(0, 100.0, 4.0), (10, 150.0, 4.0),
+                            (20, 10.0, 0.0), (30, 60.0, 0.0)]:
+            store.record("pio_p_total", {"outcome": "ok"}, ok, ts=float(ts))
+            store.record("pio_p_total", {"outcome": "error"}, err,
+                         ts=float(ts))
+        eng = SloEngine(store, avail_config(), registry=Registry())
+        (st,) = eng.evaluate(ts=30.0)
+        # bad increase = 0 post-reset (0→0); total grew → ratio 0
+        assert st.burn["60s"] == pytest.approx(0.0)
+
+    def test_latency_burn_snaps_threshold_down_to_a_bucket(self):
+        store = make_store()
+        series = "pio_l_seconds"
+        zero = {"0.1": 0.0, "0.25": 0.0, "0.5": 0.0, "+Inf": 0.0}
+        after = {"0.1": 2.0, "0.25": 6.0, "0.5": 9.0, "+Inf": 10.0}
+        for ts, counts in ((0.0, zero), (10.0, after)):
+            for le, v in counts.items():
+                store.record(f"{series}_bucket", {"le": le}, v, ts=ts)
+            store.record(f"{series}_count", {}, counts["+Inf"], ts=ts)
+        cfg = {**WINDOWS, "slos": [
+            {"name": "lat", "type": "latency", "objective": 0.9,
+             "histogram": series, "threshold_ms": 300}]}
+        eng = SloEngine(store, cfg, registry=Registry())
+        (st,) = eng.evaluate(ts=10.0)
+        # 300 ms snaps DOWN to the 0.25 bound: good = 6 of 10 → bad
+        # ratio 0.4; budget 0.1 → burn 4 (stricter than the raw 300 ms)
+        assert st.burn["10s"] == pytest.approx(4.0)
+        assert not st.fast_burn
+
+    def test_latency_threshold_below_all_buckets_is_blind_not_paging(self):
+        store = make_store()
+        store.record("pio_l_seconds_bucket", {"le": "0.5"}, 0.0, ts=0.0)
+        store.record("pio_l_seconds_bucket", {"le": "+Inf"}, 0.0, ts=0.0)
+        store.record("pio_l_seconds_count", {}, 0.0, ts=0.0)
+        store.record("pio_l_seconds_bucket", {"le": "0.5"}, 0.0, ts=10.0)
+        store.record("pio_l_seconds_bucket", {"le": "+Inf"}, 10.0, ts=10.0)
+        store.record("pio_l_seconds_count", {}, 10.0, ts=10.0)
+        cfg = {**WINDOWS, "slos": [
+            {"name": "lat", "type": "latency", "objective": 0.9,
+             "histogram": "pio_l_seconds", "threshold_ms": 1}]}
+        eng = SloEngine(store, cfg, registry=Registry())
+        (st,) = eng.evaluate(ts=10.0)
+        assert st.burn["10s"] == 0.0
+
+
+# -- configuration -------------------------------------------------------------
+
+
+class TestConfig:
+    @pytest.mark.parametrize("doc", [
+        {"type": "availability"},                             # no name
+        {"name": "x", "type": "nope"},                        # bad type
+        {"name": "x", "type": "availability", "objective": 1.5,
+         "series": "s", "bad": {"o": "e"}},                   # objective
+        {"name": "x", "type": "availability", "objective": 0.9},  # no bad
+        {"name": "x", "type": "latency", "objective": 0.9,
+         "histogram": "h"},                                   # no threshold
+    ])
+    def test_bad_specs_are_rejected(self, doc):
+        with pytest.raises(ValueError):
+            _parse_spec(doc)
+
+    def test_repo_slo_json_matches_builtin_default(self):
+        eng = SloEngine.from_file(os.path.join(REPO_ROOT, "conf/slo.json"),
+                                  make_store(), registry=Registry())
+        assert [s.name for s in eng.specs] == \
+            [d["name"] for d in DEFAULT_CONFIG["slos"]]
+        assert eng.fast_threshold == 14.4 and eng.slow_threshold == 6.0
+        assert [w for w, _ in eng.fast_windows] == ["5m", "1h"]
+
+    def test_default_config_targets_the_prober(self):
+        eng = SloEngine(make_store(), registry=Registry())
+        assert {s.series or s.histogram for s in eng.specs} == {
+            "pio_probe_requests_total", "pio_probe_seconds"}
+
+
+# -- live router: prober, federation, /top, fast-burn drill --------------------
+
+
+def http_text(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode()
+
+
+class MetricsStub(StubReplica):
+    """StubReplica that also speaks the /metrics side of the replica
+    contract, so the router has something to federate."""
+
+    def __init__(self, port, instance="stub"):
+        super().__init__(port, instance=instance)
+        self.metrics_text = (
+            'pio_engine_queries_total{status="200"} 5\n')
+        self.http.router.route("GET", "/metrics", self._metrics)
+
+    async def _metrics(self, req):
+        return Response.text(self.metrics_text)
+
+
+def slo_cfg(tmp_path, fast=("300ms", "600ms"), slow=("2s",)):
+    cfg = {"windows": {"fast": list(fast), "slow": list(slow)},
+           "slos": [{"name": "probe-avail", "type": "availability",
+                     "objective": 0.99,
+                     "series": "pio_probe_requests_total",
+                     "labels": {"path": "/queries.json"},
+                     "bad": {"outcome": "error"}}]}
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps(cfg))
+    return str(path)
+
+
+def observed_router_kwargs(cfg_path):
+    return {"hedge": False, "slo_config": cfg_path,
+            "scrape_interval": 0.05, "probe_interval": 0.02}
+
+
+class TestRouterObservability:
+    def test_fast_burn_drill_trips_and_clears(self, tmp_path):
+        """The runbook rehearsal end to end: armed ``slo.probe.fail``
+        → fast burn within the windows, /health degraded (still 200 —
+        replicas are fine, the budget is bleeding), /metrics shows the
+        alerting gauge; disarm → the short window clears the page."""
+        kwargs = observed_router_kwargs(slo_cfg(tmp_path))
+        with fleet(1, kwargs) as (router, stubs, _):
+            base = f"http://127.0.0.1:{router.http.port}"
+            # healthy probes flow to the replica and the counters
+            assert wait_until(
+                lambda: cval(router._m_probe, "/queries.json", "ok") >= 3)
+            code, doc, _ = http_full("GET", f"{base}/slo/status")
+            assert code == 200 and doc["fastBurning"] == []
+            assert doc["windows"]["fast"] == ["300ms", "600ms"]
+
+            FAULTS.arm("slo.probe.fail", error="drill")
+            assert wait_until(
+                lambda: http_full("GET", f"{base}/slo/status")[1]
+                .get("fastBurning"), timeout=10)
+            code, h, _ = http_full("GET", f"{base}/health")
+            assert code == 200 and h["status"] == "degraded"
+            assert h["sloFastBurn"] == ["probe-avail"]
+            text = http_text(f"{base}/metrics")
+            assert 'pio_slo_alerting{slo="probe-avail"} 2' in text
+            assert "pio_slo_burn_rate" in text
+
+            FAULTS.disarm()
+            assert wait_until(
+                lambda: not http_full("GET", f"{base}/slo/status")[1]
+                .get("fastBurning"), timeout=15)
+            code, h, _ = http_full("GET", f"{base}/health")
+            assert code == 200 and h["status"] == "ok"
+
+    def test_probe_is_tagged_and_counted(self, tmp_path):
+        kwargs = observed_router_kwargs(slo_cfg(tmp_path))
+        with fleet(1, kwargs) as (router, stubs, _):
+            assert wait_until(lambda: stubs[0].queries >= 2)
+            # probes ride the real serving path with the marker header
+            base = f"http://127.0.0.1:{router.http.port}"
+            code, doc, _ = http_full(
+                "GET", f"{base}/metrics/history"
+                "?series=pio_probe_requests_total&window=10s")
+            assert code == 200
+            assert any("outcome=\"ok\"" in k for k in doc["series"])
+
+    def test_federation_and_top(self, tmp_path):
+        kwargs = observed_router_kwargs(slo_cfg(tmp_path))
+        stubs = [MetricsStub(free_port(), instance=f"m-{i}")
+                 for i in range(2)]
+        import contextlib
+
+        from predictionio_tpu.server.router import FleetRouter
+        with contextlib.ExitStack() as stack:
+            for s in stubs:
+                stack.enter_context(ServerThread(s))
+            router = FleetRouter([s.url for s in stubs], host="127.0.0.1",
+                                 port=free_port(), **kwargs)
+            stack.enter_context(ServerThread(router))
+            base = f"http://127.0.0.1:{router.http.port}"
+
+            # federated sum re-exposed on the router's own /metrics
+            assert wait_until(lambda: (
+                'pio_fleet_engine_queries_total{status="200"} 10'
+                in http_text(f"{base}/metrics")), timeout=10)
+            text = http_text(f"{base}/metrics")
+            assert "pio_build_info" in text
+            assert cval(router._m_federate, stubs[0].name
+                        if hasattr(stubs[0], "name") else "", "ok") >= 0
+
+            # history answers for the federated series too
+            code, doc, _ = http_full(
+                "GET", f"{base}/metrics/history"
+                "?series=pio_fleet_engine_queries_total&window=10s")
+            assert code == 200 and doc["series"]
+
+            # discoverability contract: no selector → names
+            code, doc, _ = http_full("GET", f"{base}/metrics/history")
+            assert code == 400
+            assert "pio_fleet_engine_queries_total" in doc["names"]
+
+            # /top: the terminal view's data source
+            code, top, _ = http_full("GET", f"{base}/top?window=10s")
+            assert code == 200
+            assert top["qps"]["total"] >= 0
+            assert len(top["replicas"]) == 2
+            assert top["slo"]["slos"][0]["name"] == "probe-avail"
+            assert "/queries.json" in top["paths"] or top["paths"] == {}
+            code, doc, _ = http_full("GET", f"{base}/top?window=bogus")
+            assert code == 400
+
+    def test_slo_and_top_cli_run_without_jax(self, tmp_path):
+        """`pio slo status` / `pio top` are ops-box verbs: they must
+        work where jax does not even install."""
+        kwargs = observed_router_kwargs(slo_cfg(tmp_path))
+        with fleet(1, kwargs) as (router, stubs, _):
+            base = f"http://127.0.0.1:{router.http.port}"
+            assert wait_until(
+                lambda: cval(router._m_probe, "/queries.json", "ok") >= 3)
+
+            def run_cli(*args):
+                code = (
+                    "import sys\n"
+                    "sys.modules['jax'] = None\n"
+                    "sys.modules['jaxlib'] = None\n"
+                    "from predictionio_tpu.tools.cli import main\n"
+                    f"main({list(args)!r})\n")
+                return subprocess.run([sys.executable, "-c", code],
+                                      capture_output=True, text=True,
+                                      cwd=REPO_ROOT)
+
+            proc = run_cli("slo", "status", "--url", base, "--json")
+            assert proc.returncode == 0, proc.stderr
+            doc = json.loads(proc.stdout)
+            assert doc["slos"][0]["name"] == "probe-avail"
+
+            proc = run_cli("slo", "status", "--url", base)
+            assert proc.returncode == 0, proc.stderr
+            assert "probe-avail" in proc.stdout
+
+            proc = run_cli("top", "--url", base, "--once", "--json")
+            assert proc.returncode == 0, proc.stderr
+            doc = json.loads(proc.stdout)
+            assert "qps" in doc and "replicas" in doc
+
+            proc = run_cli("top", "--url", base, "--once")
+            assert proc.returncode == 0, proc.stderr
+            assert "replicas" in proc.stdout or "qps" in proc.stdout
+
+    def test_probe_skips_the_tenant_fair_share_seat(self, storage):
+        """A probe must never spend a tenant's admission seat: with the
+        one inflight seat already taken, a normal query sheds (503
+        overloaded) while the X-PIO-Probe canary passes admission and
+        reaches the serving path."""
+        from predictionio_tpu.server.engine_server import EngineServer
+
+        server = EngineServer(
+            engine_factory="predictionio_tpu.templates.recommendation"
+                           ".engine:engine_factory",
+            storage=storage, host="127.0.0.1", port=free_port(),
+            max_inflight=1, require_engine=False)
+        with ServerThread(server):
+            base = f"http://127.0.0.1:{server.http.port}"
+            assert server._fair.try_acquire("hog")   # saturate the cap
+            try:
+                shed0 = cval(server._m_shed, "-")
+                code, body = http("POST", f"{base}/queries.json",
+                                  {"user": "1"})
+                assert code == 503 and "overloaded" in body["message"]
+                assert cval(server._m_shed, "-") == shed0 + 1
+                code, body = http("POST", f"{base}/queries.json",
+                                  {"user": "1"},
+                                  headers={"X-PIO-Probe": "1"})
+                # past admission: the 503 is "no engine loaded", not a
+                # shed, and the shed counter did not move
+                assert code == 503 and "no engine loaded" in body["message"]
+                assert cval(server._m_shed, "-") == shed0 + 1
+            finally:
+                server._fair.release("hog")
+
+    def test_probe_skips_the_variant_scoreboard(self, tmp_path):
+        """A probe must never become a scoreboard sample: the canary is
+        served by an arm (header and all) but contributes nothing to
+        the served/CTR/RMSE stats the promotion gate reads."""
+        from predictionio_tpu.server.engine_server import EngineServer
+        from predictionio_tpu.server.variant_metrics import _REQUESTS
+        from tests.test_variants import (
+            VARIANT,
+            seed_and_train,
+        )
+        from tests.test_variants import FACTORY as V_FACTORY
+        from predictionio_tpu.storage.meta import MetaStore
+        from predictionio_tpu.storage.models import MemoryModelStore
+        from predictionio_tpu.data.events import MemoryEventStore
+        from predictionio_tpu.storage.registry import (
+            Storage,
+            StorageConfig,
+            set_storage,
+        )
+
+        st = Storage(StorageConfig(metadata_type="MEMORY",
+                                   eventdata_type="MEMORY",
+                                   modeldata_type="MEMORY",
+                                   home=str(tmp_path)))
+        st._meta = MetaStore(":memory:")
+        st._events = MemoryEventStore()
+        st._models = MemoryModelStore()
+        set_storage(st)
+        try:
+            from predictionio_tpu.storage.models import model_registry
+
+            _, iid = seed_and_train(st)
+            reg = model_registry(st)
+            reg.promote(reg.register(iid, b"gen1"))
+            server = EngineServer(
+                engine_factory=V_FACTORY, storage=st, host="127.0.0.1",
+                port=free_port(), variants="champion:1")
+            with ServerThread(server):
+                base = f"http://127.0.0.1:{server.http.port}"
+                served0 = cval(_REQUESTS, "champion", "200")
+
+                code, _, hh = http_full(
+                    "POST", f"{base}/queries.json", {"user": "2", "num": 3},
+                    headers={"X-PIO-Probe": "1"})
+                assert code == 200 and hh["X-PIO-Variant"] == "champion"
+                code, snap, _ = http_full("GET", f"{base}/variants")
+                assert code == 200
+                online = snap["variants"]["champion"].get("online")
+                assert not online or online["served"] == 0
+                assert cval(_REQUESTS, "champion", "200") == served0
+
+                code, _, hh = http_full(
+                    "POST", f"{base}/queries.json", {"user": "2", "num": 3})
+                assert code == 200 and hh["X-PIO-Variant"] == "champion"
+                code, snap, _ = http_full("GET", f"{base}/variants")
+                assert snap["variants"]["champion"]["online"]["served"] == 1
+                assert cval(_REQUESTS, "champion", "200") == served0 + 1
+        finally:
+            set_storage(None)
+
+    def test_slo_status_exits_nonzero_while_fast_burning(self, tmp_path):
+        kwargs = observed_router_kwargs(slo_cfg(tmp_path))
+        with fleet(1, kwargs) as (router, stubs, _):
+            base = f"http://127.0.0.1:{router.http.port}"
+            FAULTS.arm("slo.probe.fail", error="drill")
+            assert wait_until(
+                lambda: http_full("GET", f"{base}/slo/status")[1]
+                .get("fastBurning"), timeout=10)
+            proc = subprocess.run(
+                [sys.executable, "-m", "predictionio_tpu.tools.cli",
+                 "slo", "status", "--url", base],
+                capture_output=True, text=True, cwd=REPO_ROOT,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"})
+            assert proc.returncode == 1
+            assert "FAST BURN" in proc.stdout

@@ -1,0 +1,328 @@
+"""Embedded TSDB tests (utils/timeseries.py): selector/duration
+parsing, ring-buffer downsampling and tier selection, counter-reset
+aware increase/rate under a fake clock, histogram quantiles over a
+window, the federation merge invariant (federated quantile == the
+single-process quantile over the union of observations), the
+``/metrics/history`` payload contract, and the ``tsdb.scrape.stall``
+fail-open drill on the scrape loop."""
+
+import asyncio
+import contextlib
+
+import pytest
+
+from predictionio_tpu.utils.faults import FAULTS
+from predictionio_tpu.utils.metrics import Registry
+from predictionio_tpu.utils.timeseries import (
+    TimeSeriesStore,
+    _m_scrapes,
+    history_payload,
+    parse_duration,
+    parse_prom_text,
+    parse_selector,
+    render_key,
+    scrape_loop,
+)
+
+
+@pytest.fixture(autouse=True)
+def disarm_faults():
+    FAULTS.disarm()
+    yield
+    FAULTS.disarm()
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+# -- parsing -------------------------------------------------------------------
+
+
+class TestParsing:
+    def test_durations(self):
+        assert parse_duration("300") == 300.0
+        assert parse_duration("500ms") == 0.5
+        assert parse_duration("30s") == 30.0
+        assert parse_duration("5m") == 300.0
+        assert parse_duration("1h") == 3600.0
+        assert parse_duration("1d") == 86400.0
+        assert parse_duration("1.5m") == 90.0
+        for bad in ("", "m5", "5x", "-3s"):
+            with pytest.raises(ValueError):
+                parse_duration(bad)
+
+    def test_selectors(self):
+        assert parse_selector("pio_x_total") == ("pio_x_total", {})
+        name, labels = parse_selector('pio_x_total{a="1", b="two"}')
+        assert name == "pio_x_total" and labels == {"a": "1", "b": "two"}
+        for bad in ("", "{a=1}", 'x{a=1}', "na me"):
+            with pytest.raises(ValueError):
+                parse_selector(bad)
+
+    def test_render_key_roundtrips_through_parse_selector(self):
+        key = render_key("pio_x_total", (("a", "1"), ("le", "+Inf")))
+        assert parse_selector(key) == ("pio_x_total",
+                                       {"a": "1", "le": "+Inf"})
+
+    def test_prom_text_parses_real_exposition(self):
+        reg = Registry()
+        c = reg.counter("pio_t_total", "t", ("app",))
+        c.inc(("a",), 3)
+        h = reg.histogram("pio_t_seconds", "t", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        triples = parse_prom_text(reg.render())
+        assert ("pio_t_total", {"app": "a"}, 3.0) in triples
+        assert ("pio_t_seconds_bucket", {"le": "0.1"}, 1.0) in triples
+        assert ("pio_t_seconds_count", {}, 1.0) in triples
+        # comments never appear as samples
+        assert not any(name.startswith("#") for name, _, _ in triples)
+
+    def test_prom_text_skips_malformed_lines(self):
+        text = ("# HELP x y\n"
+                "pio_ok_total 2\n"
+                "not a metric line at all\n"
+                "pio_nan_total notanumber\n"
+                '{no="name"} 3\n')
+        assert parse_prom_text(text) == [("pio_ok_total", {}, 2.0)]
+
+
+# -- ring buffers / tiers ------------------------------------------------------
+
+
+class TestRingsAndTiers:
+    def test_same_resolution_step_is_last_write_wins(self):
+        clk = FakeClock()
+        store = TimeSeriesStore(Registry(), tiers=((10.0, 8),), clock=clk)
+        store.record("g", {}, 1.0, ts=100.0)
+        store.record("g", {}, 2.0, ts=105.0)   # <10 s after last kept
+        store.record("g", {}, 3.0, ts=115.0)   # a full step later
+        (samples,) = store.query("g", 60.0, ts=115.0).values()
+        assert samples == [(105.0, 2.0), (115.0, 3.0)]
+
+    def test_query_picks_finest_covering_tier(self):
+        store = TimeSeriesStore(Registry(), tiers=((1.0, 5), (10.0, 10)),
+                                clock=FakeClock())
+        for t in range(0, 30):
+            store.record("c", {}, float(t), ts=float(t))
+        # 5 s window fits the fine tier (1 s × 5)
+        (fine,) = store.query("c", 5.0, ts=29.0).values()
+        assert len(fine) == 5 and fine[-1] == (29.0, 29.0)
+        # 20 s window overflows it → coarse tier (10 s resolution,
+        # last-write-wins inside each step)
+        (coarse,) = store.query("c", 20.0, ts=29.0).values()
+        assert all(b[0] - a[0] >= 10.0 for a, b in zip(coarse, coarse[1:]))
+
+    def test_label_filter_selects_series_subset(self):
+        store = TimeSeriesStore(Registry(), clock=FakeClock())
+        store.record("c", {"app": "a"}, 1.0, ts=100.0)
+        store.record("c", {"app": "b"}, 2.0, ts=100.0)
+        assert set(store.query("c", 60.0, ts=100.0)) == {
+            'c{app="a"}', 'c{app="b"}'}
+        assert set(store.query('c{app="a"}', 60.0, ts=100.0)) == {
+            'c{app="a"}'}
+        assert store.names() == ["c"]
+
+
+# -- increase / rate -----------------------------------------------------------
+
+
+class TestCounterMath:
+    def test_increase_is_reset_aware(self):
+        store = TimeSeriesStore(Registry(), tiers=((1.0, 100),),
+                                clock=FakeClock())
+        for ts, v in [(0, 0.0), (1, 10.0), (2, 3.0), (3, 5.0)]:
+            store.record("c", {}, v, ts=float(ts))
+        # 0→10 (+10), 10→3 (restart: count the post-reset 3), 3→5 (+2)
+        assert store.increase("c", 10.0, ts=3.0) == pytest.approx(15.0)
+
+    def test_increase_sums_across_matching_series(self):
+        store = TimeSeriesStore(Registry(), tiers=((1.0, 100),),
+                                clock=FakeClock())
+        for app in ("a", "b"):
+            store.record("c", {"app": app}, 0.0, ts=0.0)
+            store.record("c", {"app": app}, 4.0, ts=2.0)
+        assert store.increase("c", 10.0, ts=2.0) == pytest.approx(8.0)
+        assert store.increase('c{app="a"}', 10.0, ts=2.0) == pytest.approx(4.0)
+
+    def test_rate_needs_two_samples_and_divides_by_elapsed(self):
+        store = TimeSeriesStore(Registry(), tiers=((1.0, 100),),
+                                clock=FakeClock())
+        store.record("c", {}, 0.0, ts=0.0)
+        assert store.rate("c", 10.0, ts=0.0) == 0.0   # no history, no claim
+        store.record("c", {}, 30.0, ts=10.0)
+        assert store.rate("c", 60.0, ts=10.0) == pytest.approx(3.0)
+
+    def test_rate_survives_a_counter_reset(self):
+        store = TimeSeriesStore(Registry(), tiers=((1.0, 100),),
+                                clock=FakeClock())
+        for ts, v in [(0, 100.0), (5, 110.0), (10, 2.0)]:
+            store.record("c", {}, v, ts=float(ts))
+        # +10 then reset to 2 → 12 over 10 s, never negative
+        assert store.rate("c", 60.0, ts=10.0) == pytest.approx(1.2)
+
+
+# -- histogram quantiles -------------------------------------------------------
+
+
+def scrape_hist(store, reg, ts):
+    store.scrape(ts=ts)
+
+
+class TestQuantiles:
+    def make(self, buckets=(0.1, 0.5, 1.0)):
+        reg = Registry()
+        hist = reg.histogram("pio_q_seconds", "q", buckets=buckets)
+        store = TimeSeriesStore(reg, tiers=((1.0, 100),), clock=FakeClock())
+        return reg, hist, store
+
+    def test_interpolates_within_the_winning_bucket(self):
+        reg, hist, store = self.make()
+        store.scrape(ts=0.0)             # zero baseline
+        for v in (0.05, 0.2, 0.3, 0.7):
+            hist.observe(v)
+        store.scrape(ts=10.0)
+        # 4 observations, target p50 = 2 → cum hits 3 at le=0.5;
+        # interpolation inside (0.1, 0.5]: 0.1 + 0.4 * (2-1)/2 = 0.3
+        assert store.quantile("pio_q_seconds", 0.5, 60.0,
+                              ts=10.0) == pytest.approx(0.3)
+
+    def test_overflow_quantile_reports_highest_finite_bound(self):
+        reg, hist, store = self.make()
+        store.scrape(ts=0.0)
+        hist.observe(5.0)                # lands in +Inf
+        store.scrape(ts=10.0)
+        assert store.quantile("pio_q_seconds", 0.99, 60.0,
+                              ts=10.0) == pytest.approx(1.0)
+
+    def test_no_observations_in_window_is_none(self):
+        reg, hist, store = self.make()
+        store.scrape(ts=0.0)
+        store.scrape(ts=10.0)
+        assert store.quantile("pio_q_seconds", 0.5, 60.0, ts=10.0) is None
+
+    def test_bad_q_raises(self):
+        _, _, store = self.make()
+        with pytest.raises(ValueError):
+            store.quantile("pio_q_seconds", 1.5, 60.0)
+
+    def test_federated_quantile_equals_single_process_quantile(self):
+        """The router's federation merge (sum cumulative buckets per
+        ``le`` across replicas, recorded under ``pio_fleet_*``) must be
+        lossless for quantiles: merging two replicas' buckets gives the
+        same answer as one process observing the union."""
+        buckets = (0.1, 0.5, 1.0, 2.5)
+        obs_a = [0.01, 0.2, 0.3, 0.9, 0.9]
+        obs_b = [0.05, 0.4, 2.0, 0.2]
+
+        # two replicas with their own registries...
+        regs = [Registry(), Registry()]
+        hists = [r.histogram("pio_q_seconds", "q", buckets=buckets)
+                 for r in regs]
+        # ...and one process that sees everything
+        both = Registry()
+        hist_all = both.histogram("pio_q_seconds", "q", buckets=buckets)
+        local = TimeSeriesStore(both, tiers=((1.0, 100),),
+                                clock=FakeClock())
+        fleet = TimeSeriesStore(Registry(), tiers=((1.0, 100),),
+                                clock=FakeClock())
+
+        def federate(ts):
+            # exactly the router's merge: parse each replica's text
+            # exposition, sum per (renamed series, label set)
+            merged = {}
+            for reg in regs:
+                for name, labels, value in parse_prom_text(reg.render()):
+                    key = ("pio_fleet_" + name[len("pio_"):],
+                           tuple(sorted(labels.items())))
+                    merged[key] = merged.get(key, 0.0) + value
+            for (name, labels), value in merged.items():
+                fleet.record(name, dict(labels), value, ts=ts)
+
+        federate(0.0)
+        local.scrape(ts=0.0)
+        for v in obs_a:
+            hists[0].observe(v)
+            hist_all.observe(v)
+        for v in obs_b:
+            hists[1].observe(v)
+            hist_all.observe(v)
+        federate(10.0)
+        local.scrape(ts=10.0)
+
+        for q in (0.5, 0.9, 0.99):
+            want = local.quantile("pio_q_seconds", q, 60.0, ts=10.0)
+            got = fleet.quantile("pio_fleet_q_seconds", q, 60.0, ts=10.0)
+            assert want is not None
+            assert got == pytest.approx(want)
+
+
+# -- scrape + history payload --------------------------------------------------
+
+
+class TestScrapeAndHistory:
+    def test_scrape_samples_counters_gauges_and_histograms(self):
+        reg = Registry()
+        reg.counter("pio_c_total", "c", ("app",)).inc(("a",), 2)
+        reg.gauge("pio_g", "g").set(7)
+        reg.histogram("pio_h_seconds", "h", buckets=(0.5,)).observe(0.1)
+        store = TimeSeriesStore(reg, clock=FakeClock())
+        assert store.scrape(ts=100.0) > 0
+        assert store.names() == ["pio_c_total", "pio_g",
+                                 "pio_h_seconds_bucket", "pio_h_seconds_count",
+                                 "pio_h_seconds_sum"]
+        # cumulative buckets, +Inf included
+        keys = set(store.query("pio_h_seconds_bucket", 60.0, ts=100.0))
+        assert keys == {'pio_h_seconds_bucket{le="0.5"}',
+                        'pio_h_seconds_bucket{le="+Inf"}'}
+
+    def test_history_payload_contract(self):
+        store = TimeSeriesStore(Registry(), clock=FakeClock())
+        store.record("pio_c_total", {"app": "a"}, 1.0, ts=990.0)
+
+        status, payload = history_payload(store, "", "")
+        assert status == 400 and payload["names"] == ["pio_c_total"]
+
+        status, payload = history_payload(store, "pio_c_total", "bogus")
+        assert status == 400 and "duration" in payload["message"]
+
+        status, payload = history_payload(store, "???", "1m")
+        assert status == 400 and "selector" in payload["message"]
+
+        status, payload = history_payload(store, "pio_c_total", "1m")
+        assert status == 200
+        assert payload["windowSeconds"] == 60.0
+        assert payload["series"] == {'pio_c_total{app="a"}': [[990.0, 1.0]]}
+
+    def test_scrape_loop_stall_fault_is_fail_open(self):
+        """An armed ``tsdb.scrape.stall`` plan costs ticks of history
+        (counted as errors), never kills the loop: once disarmed the
+        same task scrapes again."""
+        reg = Registry()
+        reg.counter("pio_c_total", "c").inc(())
+        store = TimeSeriesStore(reg)
+
+        async def drive():
+            task = asyncio.create_task(scrape_loop(store, 0.01))
+            e0 = _m_scrapes.get(("error",))
+            FAULTS.arm("tsdb.scrape.stall", error="drill")
+            while _m_scrapes.get(("error",)) < e0 + 3:
+                await asyncio.sleep(0.01)
+            assert not store.names()        # no scrape landed while armed
+            FAULTS.disarm()
+            ok0 = _m_scrapes.get(("ok",))
+            while _m_scrapes.get(("ok",)) < ok0 + 2:
+                await asyncio.sleep(0.01)
+            task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await task
+
+        asyncio.run(asyncio.wait_for(drive(), timeout=10))
+        assert "pio_c_total" in store.names()
