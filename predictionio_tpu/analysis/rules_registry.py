@@ -16,10 +16,11 @@ fault injection sites  ``faults.inject("x")``  utils/faults.py
 Prometheus series      ``REGISTRY.counter/     docs/observability.md
                        gauge/histogram("x")``
                        + direct constructors
-fleet/SLO series       any ``pio_fleet_*`` /   docs/observability.md
-                       ``pio_slo_*`` string
-                       literal (these names
-                       are often built
+fleet/SLO/incident     any ``pio_fleet_*`` /   docs/observability.md
+series                 ``pio_slo_*`` /
+                       ``pio_incident_*``
+                       string literal (these
+                       names are often built
                        dynamically, e.g. the
                        federation rename)
 CLI flags              ``add_argument("--x")`` docs/cli.md
@@ -208,11 +209,12 @@ def _metric_findings(project: Project) -> List[Finding]:
     return out
 
 
-_PREFIXED_RE = re.compile(r"^pio_(fleet|slo)_[a-z0-9_]*$")
+_PREFIXED_RE = re.compile(r"^pio_(fleet|slo|incident)_[a-z0-9_]*$")
 
 
 def prefixed_series(project: Project) -> Dict[str, Tuple[str, int]]:
-    """Every ``pio_fleet_*`` / ``pio_slo_*`` string constant in the
+    """Every ``pio_fleet_*`` / ``pio_slo_*`` / ``pio_incident_*``
+    string constant in the
     package, wherever it appears. These series names are often built
     dynamically (federation renames ``pio_*`` to ``pio_fleet_*`` at
     scrape time; ``pio top`` queries the renamed series by literal), so

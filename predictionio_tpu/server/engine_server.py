@@ -107,6 +107,7 @@ class EngineServer:
         variant_salt: str = "pio",
         tenant_quotas: Optional[Any] = None,
         scrape_interval: float = 10.0,
+        incident_dir: Optional[str] = None,
     ) -> None:
         self.storage = storage or get_storage()
         self.engine_factory = engine_factory
@@ -224,6 +225,32 @@ class EngineServer:
             "engine_feedback_sink", failure_threshold=5, reset_timeout=10.0)
         self._breakers: Dict[str, CircuitBreaker] = {
             "feedback_sink": self._sink_breaker}
+        # incident flight recorder: breaker-open / crash / SIGQUIT
+        # postmortem bundles under <home>/incidents (utils/incidents)
+        self.incidents = None
+        if incident_dir:
+            from predictionio_tpu.utils.incidents import (
+                IncidentCapturer,
+                IncidentStore,
+                default_incident_dir,
+            )
+
+            if incident_dir == "auto":
+                incident_dir = default_incident_dir(
+                    self.storage.config.home)
+            self.incidents = IncidentCapturer(
+                IncidentStore(incident_dir), process="engine")
+            self.incidents.add_source("health", self._health_doc)
+            self.incidents.set_history(self.tsdb, lambda: [
+                "pio_engine_queries_total",
+                "pio_engine_query_seconds_bucket",
+                "pio_engine_query_seconds_count",
+                "pio_engine_shed_total", "pio_engine_feedback_total",
+                "pio_circuit_breaker_state",
+            ])
+            for b in self._breakers.values():
+                b.on_open = lambda name: self.incidents.trigger(
+                    "breaker-open", {"breaker": name})
         self._feedback_pool = None
         self._feedback_inflight = 0
         #: AOT warmup: compile the serving program for every padded
@@ -709,6 +736,24 @@ class EngineServer:
             "algorithms": [name for name, _ in self.deployed.algorithms],
         })
 
+    def _health_doc(self) -> Dict[str, Any]:
+        """Sync health/variants snapshot for incident bundles — the
+        /health body's facts without going through the event loop."""
+        doc: Dict[str, Any] = {
+            "breakers": {n: b.state for n, b in self._breakers.items()},
+            "inflight": self._inflight,
+            "reloadGeneration": self.reload_generation,
+            "lastSwap": self.last_swap,
+            "instance": self.instance_uid,
+            "startedAt": round(self.start_epoch, 3),
+            "loaded": self.deployed is not None,
+        }
+        if self._warmup is not None:
+            doc["warmup"] = self._warmup.progress()
+        if self._mux is not None:
+            doc["variants"] = self._mux.snapshot()
+        return doc
+
     async def _health(self, req: Request) -> Response:
         """Liveness/readiness for supervisors and load balancers.
 
@@ -995,6 +1040,12 @@ class EngineServer:
     async def serve_forever(self) -> None:
         from predictionio_tpu.utils.timeseries import scrape_loop
 
+        if self.incidents is not None:
+            from predictionio_tpu.utils.incidents import (
+                install_crash_handlers,
+            )
+
+            install_crash_handlers(self.incidents)
         scraper = asyncio.create_task(
             scrape_loop(self.tsdb, self.scrape_interval),
             name="pio-engine-tsdb")

@@ -171,6 +171,7 @@ class EventServer:
         segment_maintenance: bool = False,
         tenant_quotas: Optional[Any] = None,
         scrape_interval: float = 10.0,
+        incident_dir: Optional[str] = None,
     ) -> None:
         self.storage = storage or get_storage()
         # per-app QoS policy (quotas.json next to the event data,
@@ -233,6 +234,31 @@ class EventServer:
             self._ingest = WriteCoalescer(self.storage.events,
                                           max_batch=ingest_max_batch,
                                           max_queue=ingest_queue_depth)
+        # incident flight recorder: breaker-open / crash / SIGQUIT
+        # postmortem bundles under <home>/incidents (utils/incidents)
+        self.incidents = None
+        if incident_dir:
+            from predictionio_tpu.utils.incidents import (
+                IncidentCapturer,
+                IncidentStore,
+                default_incident_dir,
+            )
+
+            if incident_dir == "auto":
+                incident_dir = default_incident_dir(
+                    self.storage.config.home)
+            self.incidents = IncidentCapturer(
+                IncidentStore(incident_dir), process="events")
+            self.incidents.add_source("health", self._health_doc)
+            self.incidents.set_history(self.tsdb, lambda: [
+                "pio_events_ingested_total", "pio_event_insert_seconds_count",
+                "pio_tenant_quota_rejected_total",
+                "pio_circuit_breaker_state",
+            ])
+            if self._ingest is not None and hasattr(self._ingest, "breaker"):
+                self._ingest.breaker.on_open = (
+                    lambda name: self.incidents.trigger(
+                        "breaker-open", {"breaker": name}))
         self._auth_cache = (AuthCache(self.storage.meta, ttl=auth_cache_ttl)
                             if auth_cache_ttl > 0 else None)
         router = Router()
@@ -301,6 +327,19 @@ class EventServer:
 
     async def _status(self, req: Request) -> Response:
         return Response.json({"status": "alive"})
+
+    def _health_doc(self) -> Dict[str, Any]:
+        """Sync health snapshot for incident bundles: ingest queue /
+        breaker state without going through the event loop."""
+        doc: Dict[str, Any] = {"instance": self.instance_uid}
+        if self._ingest is not None:
+            doc["ingest"] = {
+                "queueDepth": self._ingest.depth,
+                "breaker": self._ingest.breaker.state,
+                "rejected": self._ingest.rejected,
+                "breakerRejected": self._ingest.breaker_rejected,
+            }
+        return doc
 
     async def _health(self, req: Request) -> Response:
         """Liveness/readiness: ``ok`` when storage is reachable,
@@ -638,6 +677,12 @@ class EventServer:
 
         from predictionio_tpu.utils.timeseries import scrape_loop
 
+        if self.incidents is not None:
+            from predictionio_tpu.utils.incidents import (
+                install_crash_handlers,
+            )
+
+            install_crash_handlers(self.incidents)
         scraper = asyncio.create_task(
             scrape_loop(self.tsdb, self.scrape_interval),
             name="pio-events-tsdb")

@@ -275,10 +275,14 @@ class FleetRouter:
         access_log: bool = False,
         tenant_quotas: Optional[Any] = None,
         slo_config: Optional[str] = None,
+        observers: Optional[List[str]] = None,
         scrape_interval: float = 10.0,
         probe_interval: float = 0.0,
         probe_path: str = "/queries.json",
         probe_body: str = '{"user": "pio-probe", "num": 1}',
+        incident_dir: Optional[str] = None,
+        incident_debounce: float = 300.0,
+        incident_retain: int = 20,
     ) -> None:
         if not replicas and not manifest:
             raise ValueError("need a replica list or a manifest file")
@@ -292,10 +296,17 @@ class FleetRouter:
         #: by the health loop (probe-then-apply happens replica-side)
         self._variant_pins: Dict[str, Dict[str, float]] = {}
         self._pins_pushed: Dict[str, Dict[str, float]] = {}
+        #: observe-only members (``observe=1`` manifest lines, e.g. the
+        #: continuous trainer's metrics listener): health-polled and
+        #: federated into the fleet series, never routed or probed
+        self._manifest_observers: List[str] = []
         urls = list(replicas or [])
         if manifest:
             urls = self._manifest_urls() or urls
         self.replicas: List[Replica] = [self._make_replica(u) for u in urls]
+        self.observers: List[Replica] = [
+            self._make_replica(u) for u in (observers or [])
+            + self._manifest_observers]
         self.health_interval = max(0.05, health_interval)
         self.default_deadline = max(0.001, default_deadline_ms / 1e3)
         self.per_try_timeout = max(0.0, per_try_timeout_ms / 1e3)
@@ -350,6 +361,32 @@ class FleetRouter:
         #: last federated snapshot, appended verbatim to /metrics so
         #: one scrape of the router sees the whole fleet
         self._fleet_snapshot: Dict[Tuple[str, LabelSet], float] = {}
+
+        # -- incident flight recorder: postmortem bundles on fast burn,
+        # replica death, breaker open, SIGQUIT/crash (utils/incidents)
+        self.incidents = None
+        if incident_dir:
+            from predictionio_tpu.utils.incidents import (
+                IncidentCapturer,
+                IncidentStore,
+                default_incident_dir,
+            )
+
+            if incident_dir == "auto":
+                incident_dir = default_incident_dir(
+                    os.environ.get("PIO_HOME")
+                    or os.path.join(os.path.expanduser("~"), ".pio_store"))
+            self.incidents = IncidentCapturer(
+                IncidentStore(incident_dir, retain=incident_retain),
+                process="router", debounce=incident_debounce)
+            self.incidents.add_source("slo_status", self.slo.to_json)
+            self.incidents.add_source("replicas", self._replica_doc)
+            self.incidents.add_source(
+                "tenants", lambda: {"appRetryTokens": dict(self._app_tokens)})
+            self.incidents.set_history(self.tsdb, self._incident_selectors)
+            for rep in self.replicas:   # built before the capturer was
+                rep.breaker.on_open = lambda name: self.incidents.trigger(
+                    "breaker-open", {"breaker": name})
 
         self._m_state = REGISTRY.gauge(
             "pio_router_replica_state",
@@ -418,8 +455,44 @@ class FleetRouter:
     # -- replica set -------------------------------------------------------
 
     def _make_replica(self, url: str) -> Replica:
-        return Replica(url, breaker_threshold=self._breaker_threshold,
-                       breaker_reset=self._breaker_reset)
+        rep = Replica(url, breaker_threshold=self._breaker_threshold,
+                      breaker_reset=self._breaker_reset)
+        if getattr(self, "incidents", None) is not None:
+            rep.breaker.on_open = lambda name: self.incidents.trigger(
+                "breaker-open", {"breaker": name})
+        return rep
+
+    # -- incident capture sources ------------------------------------------
+
+    def _replica_doc(self) -> Dict[str, Any]:
+        """Sync replica-state snapshot for incident bundles (the async
+        /router/status answer, minus anything needing the loop)."""
+        return {"instance": self.instance_uid,
+                "manifest": self.manifest,
+                "replicas": [dict(r.snapshot(), name=r.name)
+                             for r in self.replicas],
+                "observers": [dict(r.snapshot(), name=r.name)
+                              for r in self.observers]}
+
+    def _incident_selectors(self) -> List[str]:
+        """The history series a bundle pins: the SLO objectives' own
+        series plus the router/fleet series a postmortem aligns
+        against (replica states, shed and quota counters, burn
+        rates)."""
+        sels = {
+            "pio_router_requests_total", "pio_router_replica_state",
+            "pio_router_attempts_total", "pio_slo_burn_rate",
+            "pio_circuit_breaker_state",
+            "pio_fleet_engine_shed_total",
+            "pio_fleet_tenant_quota_rejected_total",
+        }
+        for spec in self.slo.specs:
+            if spec.series:
+                sels.add(spec.series)
+            if spec.histogram:
+                sels.update({f"{spec.histogram}_bucket",
+                             f"{spec.histogram}_count"})
+        return sorted(sels)
 
     def _read_manifest(self) -> List[str]:
         """One replica URL per line; blank lines and ``#`` comments
@@ -441,9 +514,14 @@ class FleetRouter:
         (and dropping the pin of any replica that left the manifest)."""
         urls: List[str] = []
         pins: Dict[str, Dict[str, float]] = {}
+        observers: List[str] = []
         for line in self._read_manifest():
             parts = line.split()
             url = parts[0]
+            if any(tok == "observe=1" for tok in parts[1:]):
+                # observe-only member: federated, never routed
+                observers.append(url)
+                continue
             urls.append(url)
             for tok in parts[1:]:
                 if tok.startswith("variants="):
@@ -462,6 +540,7 @@ class FleetRouter:
             for name in list(self._pins_pushed):
                 if self._pins_pushed.get(name) != pins.get(name):
                     self._pins_pushed.pop(name, None)
+        self._manifest_observers = observers
         return urls
 
     def _refresh_manifest(self) -> None:
@@ -486,6 +565,16 @@ class FleetRouter:
                 rep.close_pool()
                 self.replicas.remove(rep)
                 self._m_state.set(_STATE_CODE[DOWN], (name,))
+        want_obs = {"%s:%d" % Replica.parse_hostport(u): u
+                    for u in self._manifest_observers}
+        have_obs = {r.name: r for r in self.observers}
+        for name, url in want_obs.items():
+            if name not in have_obs:
+                self.observers.append(self._make_replica(url))
+        for name, rep in list(have_obs.items()):
+            if name not in want_obs:
+                rep.close_pool()
+                self.observers.remove(rep)
 
     # -- retry budget ------------------------------------------------------
 
@@ -893,6 +982,12 @@ class FleetRouter:
         except Exception as e:  # noqa: BLE001 — any probe failure counts
             replica.health_failures += 1
             if replica.health_failures >= _DOWN_AFTER:
+                if replica.state != DOWN and self.incidents is not None:
+                    # trigger (b): the down TRANSITION, not the steady
+                    # state — a replica that stays dead fires once
+                    self.incidents.trigger(
+                        "replica-down",
+                        {"replica": replica.name, "error": str(e)})
                 replica.state = DOWN
             replica.last_health = {"error": str(e)}
             return
@@ -931,9 +1026,10 @@ class FleetRouter:
 
     async def _poll_all(self) -> None:
         self._refresh_manifest()
-        if self.replicas:
+        if self.replicas or self.observers:
             await asyncio.gather(
-                *(self._poll_replica(r) for r in self.replicas))
+                *(self._poll_replica(r)
+                  for r in self.replicas + self.observers))
         self._publish_states()
         await self._push_variant_pins()
 
@@ -992,7 +1088,7 @@ class FleetRouter:
         that replica's samples this tick, nothing else."""
         ts = self.tsdb.clock()
         merged: Dict[Tuple[str, LabelSet], float] = {}
-        for rep in list(self.replicas):
+        for rep in list(self.replicas) + list(self.observers):
             if rep.state not in (OK, DEGRADED):
                 continue
             try:
@@ -1023,6 +1119,12 @@ class FleetRouter:
         fresh history."""
         await self._federate()
         self.slo.evaluate()
+        newly = self.slo.newly_fast_burning
+        if newly and self.incidents is not None:
+            # trigger (a): an SLO ENTERED fast burn this tick — the
+            # capture runs off-loop in its own thread, so the scrape
+            # cadence (and serving) never waits on bundle I/O
+            self.incidents.trigger("slo-fast-burn", {"slos": newly})
 
     def _render_fleet(self) -> str:
         if not self._fleet_snapshot:
@@ -1196,6 +1298,7 @@ class FleetRouter:
     async def _router_status(self, req: Request) -> Response:
         return Response.json({
             "replicas": [r.snapshot() for r in self.replicas],
+            "observers": [r.snapshot() for r in self.observers],
             "retryBudgetTokens": round(self._budget_tokens, 3),
             "appRetryTokens": {a: round(t, 3)
                                for a, t in sorted(self._app_tokens.items())},
@@ -1281,6 +1384,25 @@ class FleetRouter:
             probe[labels.get("outcome", "?")] = round(
                 self.tsdb.rate(key, window), 4)
 
+        # continuous-trainer section, present once a trainer listener
+        # joined federation (observe=1 manifest line / --observer)
+        trainer: Dict[str, Any] = {}
+        cycles: Dict[str, float] = {}
+        for key in self.tsdb.query("pio_fleet_trainer_cycles_total",
+                                   window):
+            _, labels = parse_selector(key)
+            samples = self.tsdb.query(key, window).get(key) or []
+            if samples:
+                cycles[labels.get("outcome", "?")] = samples[-1][1]
+        if cycles:
+            trainer["cycles"] = cycles
+        for name, out_key in (("pio_fleet_trainer_lease_held", "leaseHeld"),
+                              ("pio_fleet_trainer_generation",
+                               "generation")):
+            for key, samples in self.tsdb.query(name, window).items():
+                if samples:
+                    trainer[out_key] = samples[-1][1]
+
         self.slo.evaluate()
         return Response.json({
             "windowSeconds": window,
@@ -1290,16 +1412,24 @@ class FleetRouter:
             "variants": variants,
             "tenantSheds": sheds,
             "probe": probe,
+            "trainer": trainer,
             "replicas": [dict(r.snapshot(),
                               modelGeneration=r.last_health.get(
                                   "modelGeneration"))
                          for r in self.replicas],
+            "observers": [r.snapshot() for r in self.observers],
             "slo": self.slo.to_json(),
         })
 
     # -- lifecycle ---------------------------------------------------------
 
     async def serve_forever(self) -> None:
+        if self.incidents is not None:
+            from predictionio_tpu.utils.incidents import (
+                install_crash_handlers,
+            )
+
+            install_crash_handlers(self.incidents)
         # probe the fleet once BEFORE accepting traffic, so the first
         # client request has states to route on
         await self._poll_all()
@@ -1322,7 +1452,7 @@ class FleetRouter:
             for t in tasks:
                 with contextlib.suppress(asyncio.CancelledError):
                     await t
-            for r in self.replicas:
+            for r in self.replicas + self.observers:
                 r.close_pool()
 
     def run(self) -> None:

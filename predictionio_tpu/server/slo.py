@@ -169,6 +169,11 @@ class SloEngine:
             "SLO alert state: 0 quiet, 1 slow burn, 2 fast burn",
             ("slo",))
         self.last: List[SloStatus] = []
+        #: fast-burn rising edge: SLO names that entered fast burn on
+        #: the most recent evaluate() tick (the incident-capture
+        #: trigger — a page that STAYS firing must not retrigger)
+        self.newly_fast_burning: List[str] = []
+        self._prev_fast: set = set()
 
     @classmethod
     def from_file(cls, path: str, store: TimeSeriesStore,
@@ -240,6 +245,9 @@ class SloEngine:
             self._m_alerting.set(status.alerting, (spec.name,))
             out.append(status)
         self.last = out
+        now_fast = {s.name for s in out if s.fast_burn}
+        self.newly_fast_burning = sorted(now_fast - self._prev_fast)
+        self._prev_fast = now_fast
         return out
 
     def fast_burning(self) -> List[str]:

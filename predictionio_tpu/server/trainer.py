@@ -67,6 +67,7 @@ import math
 import os
 import re
 import signal
+import threading
 import time
 import urllib.request
 import uuid
@@ -81,6 +82,25 @@ from predictionio_tpu.storage.models import (
 from predictionio_tpu.storage.registry import Storage, get_storage
 from predictionio_tpu.utils import faults
 from predictionio_tpu.utils.atomic_write import atomic_write_bytes
+from predictionio_tpu.utils.metrics import REGISTRY
+
+# Trainer observability: these land in the process registry so the
+# optional metrics listener (cfg.metrics_port) can expose them and the
+# fleet router can federate them as pio_fleet_trainer_* (manifest
+# ``observe=1`` line → health-polled + scraped, never routed).
+_m_cycles = REGISTRY.counter(
+    "pio_trainer_cycles_total",
+    "Continuous-trainer wake cycles by outcome",
+    ("outcome",))
+_m_lease_held = REGISTRY.gauge(
+    "pio_trainer_lease_held",
+    "1 while this trainer holds the single-writer lease")
+_m_generation = REGISTRY.gauge(
+    "pio_trainer_generation",
+    "Newest model generation this trainer registered")
+_m_bake_active = REGISTRY.gauge(
+    "pio_trainer_bake_active",
+    "1 while a bake window is judging a freshly promoted generation")
 
 
 class LeaseLost(RuntimeError):
@@ -354,6 +374,13 @@ class TrainerConfig:
     fleet_manifest: Optional[str] = None
     use_mesh: bool = False
     http_timeout: float = 10.0
+    #: observability listener: None disables; an int (0 = ephemeral)
+    #: serves /metrics, /metrics/history and /health in a daemon thread
+    #: so the router can federate the trainer like a replica
+    metrics_port: Optional[int] = None
+    #: incident flight recorder: None disables, ``"auto"`` derives
+    #: ``<home>/incidents``, anything else is an explicit directory
+    incident_dir: Optional[str] = None
 
 
 # -- the trainer ---------------------------------------------------------------
@@ -394,6 +421,36 @@ class ContinuousTrainer:
         self.state_path = os.path.join(home, "trainer.state.json")
         self._app_id: Optional[int] = None
         self._channel_id: Optional[int] = None
+        self.tsdb = None
+        self._listener = None
+        self._listener_loop = None
+        self._listener_thread: Optional[threading.Thread] = None
+        if cfg.metrics_port is not None:
+            from predictionio_tpu.utils.timeseries import (
+                TimeSeriesStore,
+                scaled_tiers,
+            )
+            self.tsdb = TimeSeriesStore(
+                REGISTRY, tiers=scaled_tiers(10.0), clock=clock)
+        self.incidents = None
+        if cfg.incident_dir:
+            from predictionio_tpu.utils.incidents import (
+                IncidentCapturer,
+                IncidentStore,
+                default_incident_dir,
+            )
+            root = (default_incident_dir(home)
+                    if cfg.incident_dir == "auto" else cfg.incident_dir)
+            self.incidents = IncidentCapturer(
+                IncidentStore(root, clock=clock), process="trainer",
+                clock=clock)
+            self.incidents.add_source("trainer", self._status_doc)
+            if self.tsdb is not None:
+                self.incidents.set_history(
+                    self.tsdb, lambda: ["pio_trainer_cycles_total",
+                                        "pio_trainer_lease_held",
+                                        "pio_trainer_generation",
+                                        "pio_trainer_bake_active"])
 
     # -- plumbing --------------------------------------------------------------
 
@@ -789,6 +846,7 @@ class ContinuousTrainer:
         gen = self.registry.register(
             instance_id, blob, token=self.lease.token,
             created_us=int(self.clock() * 1_000_000))
+        _m_generation.set(float(gen))
         # candidate is SHELVED in meta until judged: a concurrent
         # /reload keeps serving the champion
         self.registry.sync_meta(self.storage.meta)
@@ -809,7 +867,11 @@ class ContinuousTrainer:
         pushed = self._push_reload()
         self._save_state(cur)
 
-        keep, bake = self._bake(baseline)
+        _m_bake_active.set(1.0)
+        try:
+            keep, bake = self._bake(baseline)
+        finally:
+            _m_bake_active.set(0.0)
         if not keep:
             self.lease.renew()
             restored = self.registry.rollback(token=self.lease.token)
@@ -823,6 +885,110 @@ class ContinuousTrainer:
                     "detail": {"gate": gate}}
         return {"outcome": "promoted", "generation": gen,
                 "detail": {"gate": gate, "bake": bake}}
+
+    # -- observability listener ------------------------------------------------
+
+    def _status_doc(self) -> Dict[str, Any]:
+        """Sync snapshot for incident bundles (runs off-loop)."""
+        return {
+            "instance": self.lease.owner,
+            "app": self.cfg.app_name,
+            "engineFactory": self.cfg.engine_factory,
+            "leaseHeld": self.lease.token is not None,
+            "leaseToken": self.lease.token,
+            "bakeSeconds": self.cfg.bake_seconds,
+            "state": self._load_state(),
+        }
+
+    @property
+    def metrics_bound_port(self) -> Optional[int]:
+        """Actual listener port (use with ``metrics_port=0`` in tests)."""
+        if self._listener is None:
+            return None
+        return self._listener.bound_port
+
+    def _start_listener(self) -> None:
+        """The tiny /metrics + /metrics/history + /health listener, in a
+        daemon thread with its own event loop: the trainer is a sync
+        process, but federation speaks HTTP. Routes only observability —
+        there is nothing to proxy to a trainer."""
+        import asyncio
+
+        from predictionio_tpu.server.http import HTTPServer, Response, Router
+        from predictionio_tpu.utils.timeseries import (
+            history_payload,
+            scrape_loop,
+        )
+
+        tsdb = self.tsdb
+        assert tsdb is not None
+
+        async def metrics(req):
+            return Response.text(
+                REGISTRY.render(),
+                content_type="text/plain; version=0.0.4; charset=utf-8")
+
+        async def history(req):
+            status, payload = history_payload(
+                tsdb, req.param("series", ""), req.param("window", ""))
+            return Response.json(payload, status=status)
+
+        async def health(req):
+            return Response.json({"status": "ok", "role": "trainer",
+                                  "instance": self.lease.owner,
+                                  "leaseHeld": self.lease.token is not None})
+
+        router = Router()
+        router.route("GET", "/metrics", metrics)
+        router.route("GET", "/metrics/history", history)
+        router.route("GET", "/health", health)
+        srv = HTTPServer(router, host="0.0.0.0",
+                         port=int(self.cfg.metrics_port or 0),
+                         server_name="trainer-metrics")
+        started = threading.Event()
+
+        def _serve() -> None:
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._listener_loop = loop
+
+            async def main() -> None:
+                await srv.start()
+                started.set()
+                scraper = asyncio.get_running_loop().create_task(
+                    scrape_loop(tsdb, 10.0))
+                try:
+                    await srv._shutdown.wait()
+                finally:
+                    scraper.cancel()
+                    await srv.stop()
+
+            try:
+                loop.run_until_complete(main())
+            finally:
+                loop.close()
+
+        self._listener = srv
+        t = threading.Thread(target=_serve, name="trainer-metrics",
+                             daemon=True)
+        t.start()
+        self._listener_thread = t
+        if not started.wait(5.0):
+            raise RuntimeError("trainer metrics listener failed to start")
+
+    def _stop_listener(self) -> None:
+        srv, loop = self._listener, self._listener_loop
+        if srv is None or loop is None:
+            return
+        try:
+            loop.call_soon_threadsafe(srv.request_shutdown)
+        except RuntimeError:
+            pass  # loop already closed
+        if self._listener_thread is not None:
+            self._listener_thread.join(timeout=5.0)
+        self._listener = None
+        self._listener_loop = None
+        self._listener_thread = None
 
     # -- the loop --------------------------------------------------------------
 
@@ -843,6 +1009,12 @@ class ContinuousTrainer:
         if install_signals:
             signal.signal(signal.SIGTERM, self.stop)
             signal.signal(signal.SIGINT, self.stop)
+        if self.incidents is not None:
+            from predictionio_tpu.utils.incidents import install_crash_handlers
+            install_crash_handlers(self.incidents,
+                                   install_signals=install_signals)
+        if self.tsdb is not None and self._listener is None:
+            self._start_listener()
         outcomes: List[Dict[str, Any]] = []
         cycles = 0
         while not self._stopping:
@@ -854,6 +1026,12 @@ class ContinuousTrainer:
                 self.lease.token = None
                 rec = {"outcome": "lease-lost", "generation": None,
                        "detail": {}}
+            _m_cycles.inc((rec["outcome"],))
+            _m_lease_held.set(1.0 if self.lease.token is not None else 0.0)
+            if rec["outcome"] == "rolled_back" and self.incidents is not None:
+                self.incidents.trigger(
+                    "bake-rollback", {"generation": rec.get("generation"),
+                                      "detail": rec.get("detail")})
             outcomes.append(rec)
             cycles += 1
             if max_cycles is not None and cycles >= max_cycles:
@@ -877,4 +1055,8 @@ class ContinuousTrainer:
         # window. A crash skips this on purpose: the lease expires (or
         # is superseded) and the fencing token refuses any late write.
         self.lease.release()
+        _m_lease_held.set(0.0)
+        self._stop_listener()
+        if self.incidents is not None:
+            self.incidents.join(2.0)
         return outcomes

@@ -197,7 +197,8 @@ def cmd_eventserver(args: argparse.Namespace) -> None:
                          durable_acks=args.durable_acks,
                          access_log=args.access_log,
                          segment_maintenance=args.segment_maintenance,
-                         tenant_quotas=args.tenant_quotas)
+                         tenant_quotas=args.tenant_quotas,
+                         incident_dir=_incident_dir(args))
     mode = "group-commit" if args.ingest_batching else "per-event commit"
     print(f"[info] Event Server listening on {args.ip}:{args.port} ({mode})")
     server.run()
@@ -230,6 +231,7 @@ def cmd_deploy(args: argparse.Namespace) -> None:
         variants=args.variants,
         variant_salt=args.variant_salt,
         tenant_quotas=args.tenant_quotas,
+        incident_dir=_incident_dir(args),
     )
     if args.variants:
         snap = server._mux.snapshot()
@@ -281,6 +283,7 @@ def cmd_router(args: argparse.Namespace) -> None:
             slo_config=args.slo_config,
             scrape_interval=args.scrape_interval,
             probe_interval=args.probe_interval,
+            incident_dir=_incident_dir(args),
         )
         print(f"[info] Fleet router on {args.ip}:{args.port} over "
               f"{len(router.replicas)} replicas "
@@ -344,7 +347,9 @@ def cmd_top(args: argparse.Namespace) -> None:
     (jax-free). A dumb refresh loop: everything shown is computed
     server-side by GET /top."""
     base = args.url.rstrip("/")
-    once = args.once or args.json
+    watch = getattr(args, "watch", 0.0) or 0.0
+    once = (args.once or args.json) and not watch
+    interval = watch or args.interval
 
     def frame() -> None:
         doc = _http_json(f"{base}/top?window={args.window}",
@@ -403,7 +408,7 @@ def cmd_top(args: argparse.Namespace) -> None:
     try:
         frame()
         while not once:
-            time.sleep(max(0.2, args.interval))
+            time.sleep(max(0.2, interval))
             frame()
     except KeyboardInterrupt:
         pass
@@ -479,6 +484,8 @@ def _run_continuous(args: argparse.Namespace, variant: Dict[str, Any],
         router_url=args.router_url,
         fleet_manifest=args.fleet_manifest,
         use_mesh=not args.no_mesh,
+        metrics_port=args.metrics_port,
+        incident_dir=_incident_dir(args),
     )
     trainer = ContinuousTrainer(cfg)
     print(f"[info] Continuous trainer: app={app_name!r} "
@@ -1119,6 +1126,117 @@ def cmd_trace(args: argparse.Namespace) -> None:
             print(json.dumps(s, sort_keys=True))
 
 
+def cmd_incidents(args: argparse.Namespace) -> None:
+    """Browse the incident flight recorder's bundles (jax-free — runs
+    on an ops box against a copied store just as well)."""
+    from predictionio_tpu.storage.registry import StorageConfig
+    from predictionio_tpu.utils import incidents as incmod
+
+    root = args.dir or incmod.default_incident_dir(
+        StorageConfig.from_env().home)
+    store = incmod.IncidentStore(root)
+    if args.inc_cmd == "list":
+        rows = store.list_bundles()
+        if args.json:
+            print(json.dumps(rows, indent=2, sort_keys=True))
+            return
+        if not rows:
+            print(f"[info] no incident bundles under {root}")
+            return
+        print(f"{'ID':<38}{'PROC':<9}{'TRIGGERS':<28}SLOS / ARMED FAULTS")
+        for r in rows:
+            if r.get("incomplete"):
+                print(f"{r['id']:<38}{'?':<9}(incomplete: no manifest)")
+                continue
+            trig = ",".join(r.get("triggers") or [r.get("trigger") or "?"])
+            tail = "  ".join((r.get("sloFastBurning") or [])
+                             + [f"fault:{s}" for s in r.get("faults") or []])
+            print(f"{r['id']:<38}{r.get('process') or '?':<9}"
+                  f"{trig:<28}{tail}")
+        return
+    if args.inc_cmd == "show":
+        iid = args.id or (store.ids() or [None])[0]
+        if not iid:
+            _die(f"no incident bundles under {root}")
+        bundle = store.load_bundle(iid)
+        if bundle is None:
+            _die(f"incident {iid!r} not found (or incomplete) under {root}")
+        if args.json:
+            print(json.dumps(bundle, indent=2, sort_keys=True))
+            return
+        m = bundle["manifest"]
+        print(f"incident {iid}  process={m.get('process')}  "
+              f"at={m.get('capturedAt')}")
+        for t in m.get("triggers", []):
+            print(f"  trigger {t.get('trigger')}  "
+                  f"detail={json.dumps(t.get('detail') or {}, sort_keys=True)}")
+        if m.get("sloFastBurning"):
+            print(f"  fast-burning SLOs: {', '.join(m['sloFastBurning'])}")
+        if m.get("faults"):
+            print(f"  armed fault sites: {', '.join(sorted(m['faults']))}")
+        ex = m.get("exemplars") or []
+        if ex:
+            print(f"  pinned exemplars: {len(ex)} "
+                  f"(worst {ex[0].get('valueMs')}ms in "
+                  f"{ex[0].get('series')}, trace {ex[0].get('traceId')})")
+        print(f"  files: {', '.join(m.get('files', []))}")
+        return
+    removed = store.prune(args.retain)
+    print(f"[info] removed {len(removed)} bundle(s); "
+          f"{len(store.ids())} retained under {root}")
+
+
+def cmd_doctor(args: argparse.Namespace) -> None:
+    """Ranked findings from a captured incident bundle or the live
+    fleet (jax-free). Exit 0 = clean, 1 = warnings, 2 = firing
+    evidence — scriptable straight into the paging runbook."""
+    from predictionio_tpu.utils import incidents as incmod
+
+    if args.incident:
+        from predictionio_tpu.storage.registry import StorageConfig
+
+        root = args.dir or incmod.default_incident_dir(
+            StorageConfig.from_env().home)
+        store = incmod.IncidentStore(root)
+        iid = args.incident
+        if iid == "latest":
+            ids = store.ids()
+            if not ids:
+                _die(f"no incident bundles under {root}")
+            iid = ids[0]
+        bundle = store.load_bundle(iid)
+        if bundle is None:
+            _die(f"incident {iid!r} not found (or incomplete) under {root}")
+        findings = incmod.diagnose(bundle)
+        header = (f"doctor — incident {iid} "
+                  f"(process={bundle['manifest'].get('process')})")
+    else:
+        base = args.url.rstrip("/")
+        try:
+            slo_doc = _http_json(f"{base}/slo/status", timeout=args.timeout)
+            health_doc = _http_json(f"{base}/health", timeout=args.timeout)
+            top_doc = _http_json(f"{base}/top?window=5m",
+                                 timeout=args.timeout)
+        except Exception as e:  # noqa: BLE001 — ops verb, readable failure
+            _die(f"live diagnosis against {base} failed: "
+                 f"{type(e).__name__}: {e}")
+        findings = incmod.diagnose_live(slo_doc, health_doc, top_doc)
+        header = f"doctor — live fleet at {base}"
+    code = incmod.exit_code(findings)
+    if args.json:
+        print(json.dumps({"findings": findings, "exit": code},
+                         indent=2, sort_keys=True))
+    else:
+        print(header)
+        if not findings:
+            print("  no findings — clean bill of health")
+        labels = {2: "FIRING", 1: "warn", 0: "info"}
+        for f in findings:
+            print(f"  [{labels[f['severity']]:<6}] {f['title']}")
+            print(f"           {f['evidence']}")
+    raise SystemExit(code)
+
+
 def cmd_dashboard(args: argparse.Namespace) -> None:
     from predictionio_tpu.tools.dashboard import Dashboard
 
@@ -1252,6 +1370,20 @@ def _add_observability_flags(sp: argparse.ArgumentParser) -> None:
                          "'pio.access' logger")
 
 
+def _add_incident_flags(sp: argparse.ArgumentParser) -> None:
+    """Incident flight-recorder flags shared by the long-lived server
+    verbs (eventserver/deploy/router serve/train --continuous)."""
+    sp.add_argument("--incident-dir", default="auto", metavar="PATH",
+                    help="incident-bundle store directory (default: "
+                         "<storage home>/incidents)")
+    sp.add_argument("--no-incidents", action="store_true",
+                    help="disable automatic postmortem capture")
+
+
+def _incident_dir(args: argparse.Namespace) -> Optional[str]:
+    return None if args.no_incidents else args.incident_dir
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="pio", description="TPU-native PredictionIO")
     p.add_argument("--version", action="version", version=__version__)
@@ -1335,6 +1467,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "<storage home>/quotas.json, managed by "
                          "'pio app quota'; hot-reloaded)")
     _add_observability_flags(es)
+    _add_incident_flags(es)
     es.set_defaults(fn=cmd_eventserver)
 
     tr = sub.add_parser("train", help="train an engine")
@@ -1425,6 +1558,13 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--max-cycles", type=int,
                     help="stop after N wake cycles (smoke/testing; "
                          "default: run until SIGTERM)")
+    tr.add_argument("--metrics-port", type=int, default=None,
+                    help="continuous mode: serve /metrics, "
+                         "/metrics/history and /health on this port so "
+                         "the router federates the trainer (manifest "
+                         "'observe=1' line); 0 = ephemeral, unset = "
+                         "no listener")
+    _add_incident_flags(tr)
     tr.set_defaults(fn=cmd_train)
 
     dp = sub.add_parser("deploy", help="serve the latest trained instance")
@@ -1485,6 +1625,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "admission under --max-inflight (default: "
                          "<storage home>/quotas.json; hot-reloaded)")
     _add_observability_flags(dp)
+    _add_incident_flags(dp)
     dp.set_defaults(fn=cmd_deploy)
 
     rt = sub.add_parser(
@@ -1540,6 +1681,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "(X-PIO-Probe queries feeding the SLO series; "
                         "0 disables the prober)")
     _add_observability_flags(x)
+    _add_incident_flags(x)
     x = rts.add_parser("status", help="replica states from a running router")
     x.add_argument("--url", default="http://localhost:8100")
     x.add_argument("--timeout", type=float, default=10.0)
@@ -1804,8 +1946,59 @@ def build_parser() -> argparse.ArgumentParser:
                     help="render one frame and exit (no screen clear)")
     tp.add_argument("--json", action="store_true",
                     help="raw /top JSON once and exit")
+    tp.add_argument("--watch", type=float, default=0.0, metavar="N",
+                    help="redraw every N seconds (overrides --interval "
+                         "and --once; ctrl-C exits)")
     tp.add_argument("--timeout", type=float, default=10.0)
     tp.set_defaults(fn=cmd_top)
+
+    ic = sub.add_parser(
+        "incidents",
+        help="browse incident flight-recorder bundles (postmortems)")
+    ics = ic.add_subparsers(dest="inc_cmd", required=True)
+    x = ics.add_parser("list", help="resident bundles, newest first")
+    x.add_argument("--dir", metavar="PATH",
+                   help="incident store (default: "
+                        "<storage home>/incidents)")
+    x.add_argument("--json", action="store_true",
+                   help="summary rows as JSON")
+    x.set_defaults(fn=cmd_incidents)
+    x = ics.add_parser("show",
+                       help="one bundle's manifest (default: newest)")
+    x.add_argument("id", nargs="?",
+                   help="bundle id from 'pio incidents list'")
+    x.add_argument("--dir", metavar="PATH",
+                   help="incident store (default: "
+                        "<storage home>/incidents)")
+    x.add_argument("--json", action="store_true",
+                   help="the full bundle (manifest + parsed files) as "
+                        "JSON")
+    x.set_defaults(fn=cmd_incidents)
+    x = ics.add_parser("prune",
+                       help="drop the oldest bundles beyond --retain")
+    x.add_argument("--retain", type=int, default=20,
+                   help="bundles to keep (newest first)")
+    x.add_argument("--dir", metavar="PATH",
+                   help="incident store (default: "
+                        "<storage home>/incidents)")
+    x.set_defaults(fn=cmd_incidents)
+
+    dr = sub.add_parser(
+        "doctor",
+        help="ranked findings from an incident bundle or the live "
+             "fleet (exit 0 clean / 1 warn / 2 firing)")
+    dr.add_argument("--incident", metavar="ID",
+                    help="diagnose a captured bundle ('latest' = "
+                         "newest) instead of the live fleet")
+    dr.add_argument("--dir", metavar="PATH",
+                    help="incident store for --incident (default: "
+                         "<storage home>/incidents)")
+    dr.add_argument("--url", default="http://localhost:8100",
+                    help="router base URL for live diagnosis")
+    dr.add_argument("--json", action="store_true",
+                    help="findings + exit code as JSON")
+    dr.add_argument("--timeout", type=float, default=10.0)
+    dr.set_defaults(fn=cmd_doctor)
 
     vp = sub.add_parser("version")
     vp.set_defaults(fn=lambda a: print(__version__))

@@ -280,6 +280,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     cmd = normalize_command(args.command)
     if not cmd:
         ap.error("no command given")
+    # crash-dump plumbing: a wedged supervisor answers SIGQUIT with a
+    # full thread dump on stderr (→ the daemon log); the supervised
+    # servers install their own SIGQUIT → incident-bundle handlers
+    import faulthandler
+    if not faulthandler.is_enabled():
+        faulthandler.enable()
+    try:
+        faulthandler.register(signal.SIGQUIT, chain=True)
+    except (AttributeError, ValueError):
+        pass  # platform without SIGQUIT, or not the main thread
     sup = Supervisor(cmd, health_url=args.health_url,
                      health_interval=args.health_interval,
                      health_grace=args.health_grace,

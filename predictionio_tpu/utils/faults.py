@@ -97,6 +97,10 @@ Known sites (grep ``faults.inject`` for the authoritative list):
                         a wedged/failing scraper costs history ticks,
                         never the serving path; watch
                         ``pio_tsdb_scrapes_total{result="error"}``
+``incident.capture.stall``  incident-bundle capture task (every
+                        server) — a wedged/failing capture costs the
+                        postmortem bundle, never the serving path;
+                        watch ``pio_incident_captures_total{result}``
 ======================  ===================================================
 """
 

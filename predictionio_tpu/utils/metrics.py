@@ -154,6 +154,19 @@ class Histogram:
         with self._lock:
             return self._exemplars.get((key, i))
 
+    def exemplars(self) -> List[Tuple[Tuple[str, ...], str, str, float]]:
+        """Every retained bucket exemplar as ``(label values, le text,
+        trace id, observed value)`` — the incident capture path walks
+        these to pin concrete traces for the offending latency buckets
+        without knowing the bucket geometry up front."""
+        with self._lock:
+            snap = sorted(self._exemplars.items())
+        out: List[Tuple[Tuple[str, ...], str, str, float]] = []
+        for (key, i), (trace_id, value) in snap:
+            le = "+Inf" if i >= len(self.buckets) else _num(self.buckets[i])
+            out.append((key, le, trace_id, value))
+        return out
+
     def sum_count(self, labels: Sequence[str] = ()) -> Tuple[float, int]:
         """(sum of observations, observation count) for one label set —
         zeroes when the series does not exist yet."""

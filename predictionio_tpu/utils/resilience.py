@@ -275,6 +275,11 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = 0.0
         self._half_open_inflight = 0
+        #: optional observer fired (OUTSIDE the lock) with the breaker
+        #: name each time the state transitions to OPEN — the incident
+        #: capture plane hangs its "breaker opened" trigger here. Must
+        #: never raise into the recording caller; exceptions are eaten.
+        self.on_open: Optional[Callable[[str], None]] = None
         from predictionio_tpu.utils.metrics import REGISTRY
 
         self._m_state = REGISTRY.gauge(
@@ -349,6 +354,7 @@ class CircuitBreaker:
                 self._set_state(CLOSED)
 
     def record_failure(self) -> None:
+        opened = False
         with self._lock:
             self._tick()
             if self._half_open_inflight > 0:
@@ -357,11 +363,19 @@ class CircuitBreaker:
                 self._set_state(OPEN)
                 self._opened_at = self._clock()
                 self._failures = self.failure_threshold
-                return
-            self._failures += 1
-            if self._state == CLOSED and self._failures >= self.failure_threshold:
-                self._set_state(OPEN)
-                self._opened_at = self._clock()
+                opened = True
+            else:
+                self._failures += 1
+                if (self._state == CLOSED
+                        and self._failures >= self.failure_threshold):
+                    self._set_state(OPEN)
+                    self._opened_at = self._clock()
+                    opened = True
+        if opened and self.on_open is not None:
+            try:
+                self.on_open(self.name)
+            except Exception:
+                pass  # an observer must never fail the recording caller
 
     def reset(self) -> None:
         """Force-close (admin/test hook)."""

@@ -259,6 +259,19 @@ class RingBufferExporter:
         got.sort(key=lambda d: d.get("startUs", 0))
         return got
 
+    def export_by_trace_ids(self, trace_ids) -> List[Dict[str, Any]]:
+        """All buffered spans belonging to any of the given trace ids,
+        oldest first — the incident-bundle pin of the traces the
+        offending latency buckets name via exemplars."""
+        wanted = set(trace_ids)
+        if not wanted:
+            return []
+        with self._lock:
+            snap = list(self._buf)
+        got = [d for d in snap if d.get("traceId") in wanted]
+        got.sort(key=lambda d: d.get("startUs", 0))
+        return got
+
 
 class JSONLExporter:
     """Append-one-JSON-line-per-span file exporter with size-based

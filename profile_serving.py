@@ -1601,6 +1601,207 @@ def run_slo_mode(args, st, factory) -> None:
         raise SystemExit(1)
 
 
+def run_incident_mode(args, st, factory) -> None:
+    """Incident flight-recorder chaos harness (ISSUE 15 acceptance):
+    the SLO drill topology — one replica behind a router running the
+    prober with second-scale burn windows — plus the capture plane.
+    Phases:
+
+    1. healthy — warmup traffic populates histogram exemplars (tracing
+       on) and the scraper builds history; the incident store must
+       stay EMPTY (steady-state overhead is zero);
+    2. ``router.replica.down`` armed — the fast burn trips and, within
+       two scrape intervals of the trip, EXACTLY ONE bundle appears
+       whose manifest names the firing SLO, pins a >= 5 m history
+       window for its series, carries >= 1 exemplar trace id
+       resolvable in the bundled trace ring, and records the armed
+       fault site;
+    3. ``pio doctor --incident <id>`` (the real CLI, jax-free) must
+       exit 2 with a finding naming the ``router.replica.down`` era.
+
+    Zero serving-path compiles across the whole drill.
+    """
+    import os
+    import shutil
+    import socket
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    from predictionio_tpu.server.aot import EXECUTABLES
+    from predictionio_tpu.server.engine_server import EngineServer
+    from predictionio_tpu.server.router import FleetRouter
+    from predictionio_tpu.utils import tracing
+    from predictionio_tpu.utils.faults import FAULTS
+    from predictionio_tpu.utils.incidents import IncidentStore
+    from profile_common import server_thread
+
+    scrape, probe = 0.5, 0.1
+    slo_cfg = {
+        "windows": {"fast": ["1s", "2s"], "slow": ["10s"]},
+        "thresholds": {"fast": 14.4, "slow": 6.0},
+        "slos": [
+            {"name": "probe-availability", "type": "availability",
+             "objective": 0.99,
+             "series": "pio_probe_requests_total",
+             "labels": {"path": "/queries.json"},
+             "bad": {"outcome": "error"}},
+        ],
+    }
+    # exemplars ride on histogram observations only while tracing is
+    # on — the bundle's trace pin is part of what this drill proves
+    tracing.TRACER.configure(enabled=True, sample_rate=1.0)
+
+    server = EngineServer(engine_factory=factory, storage=st,
+                          host="127.0.0.1", port=args.port)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    router_port = s.getsockname()[1]
+    s.close()
+
+    def slo_status():
+        conn = http.client.HTTPConnection("127.0.0.1", router_port,
+                                          timeout=10)
+        conn.request("GET", "/slo/status")
+        out = json.loads(conn.getresponse().read())
+        conn.close()
+        return out
+
+    def wait_for(pred, what: str, deadline_sec: float):
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < deadline_sec:
+            if pred():
+                return time.perf_counter() - t0
+            time.sleep(0.02)
+        raise TimeoutError(f"timed out waiting for {what}")
+
+    inc_dir = tempfile.mkdtemp(prefix="pio-incident-drill-")
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump(slo_cfg, f)
+        cfg_path = f.name
+    store = IncidentStore(inc_dir)
+
+    def complete_bundles():
+        return [i for i in store.ids()
+                if store.load_manifest(i) is not None]
+
+    router = FleetRouter(
+        [f"127.0.0.1:{args.port}"],
+        host="127.0.0.1", port=router_port,
+        health_interval=0.25, hedge=False,
+        slo_config=cfg_path,
+        scrape_interval=scrape, probe_interval=probe,
+        incident_dir=inc_dir)
+    try:
+        with server_thread(server, args.port), \
+                server_thread(router, router_port):
+            # -- warmup: compiles + exemplars + a healthy history ----
+            _router_load(router_port, args.n_users, 50)
+            wait_for(
+                lambda: router._m_probe.get(("/queries.json", "ok")) >= 5,
+                "the prober to land 5 ok probes", 30)
+            wait_for(
+                lambda: not slo_status()["fastBurning"],
+                "a quiet healthy baseline", 30)
+            time.sleep(2 * scrape)          # two quiet scrape ticks
+            steady_state_empty = not store.ids()
+            compiles_before = EXECUTABLES.counts().get("compile", 0)
+
+            # -- inject: replica down -> fast burn -> capture --------
+            FAULTS.arm("router.replica.down", error="incident-drill")
+            wait_for(
+                lambda: "probe-availability" in slo_status()["fastBurning"],
+                "the fast burn to trip", 15)
+            capture_elapsed = wait_for(
+                lambda: complete_bundles(),
+                "the incident bundle to land", 15)
+            # give a racing coalesced re-capture (breaker-open on the
+            # same fault) time to finish writing before reading
+            time.sleep(0.5)
+            router.incidents.join(5.0)
+            bundles = complete_bundles()
+            compiles = (EXECUTABLES.counts().get("compile", 0)
+                        - compiles_before)
+    finally:
+        FAULTS.disarm()
+        os.unlink(cfg_path)
+
+    exactly_one = len(bundles) == 1 and len(store.ids()) == 1
+    iid = bundles[0] if bundles else None
+    bundle = store.load_bundle(iid) if iid else None
+    manifest = (bundle or {}).get("manifest") or {}
+    files = (bundle or {}).get("files") or {}
+
+    slo_named = "probe-availability" in (manifest.get("sloFastBurning")
+                                         or [])
+    window_s = manifest.get("metricsWindowSeconds") or 0
+    history = files.get("metrics_history.json") or {}
+    history_ok = (window_s >= 300
+                  and history.get("windowSeconds", 0) >= 300
+                  and any(k.startswith("pio_probe_requests_total")
+                          for k in (history.get("series") or {})))
+    traces = files.get("traces.json") or {}
+    ring_ids = {s.get("traceId") for s in traces.get("spans") or []}
+    exemplar_ids = set(traces.get("exemplarTraceIds") or [])
+    exemplar_resolvable = bool(exemplar_ids & ring_ids)
+    fault_recorded = "router.replica.down" in (manifest.get("faults")
+                                               or {})
+
+    # -- the real CLI: pio doctor --incident <id> (jax-free) ---------
+    doctor_exit, doctor_named = -1, False
+    if iid:
+        proc = subprocess.run(
+            [_sys.executable, "-m", "predictionio_tpu.tools.cli",
+             "doctor", "--incident", iid, "--dir", inc_dir, "--json"],
+            capture_output=True, text=True, timeout=60)
+        doctor_exit = proc.returncode
+        try:
+            doc = json.loads(proc.stdout)
+            doctor_named = any(
+                "router.replica.down" in f.get("title", "")
+                for f in doc.get("findings", []))
+        except ValueError:
+            pass
+
+    checks = {
+        "steady_state_store_empty": steady_state_empty,
+        "exactly_one_bundle": exactly_one,
+        "captured_within_two_scrapes":
+            capture_elapsed <= 2 * scrape + probe,
+        "manifest_names_firing_slo": slo_named,
+        "history_window_at_least_5m": history_ok,
+        "exemplar_trace_resolvable_in_ring": exemplar_resolvable,
+        "armed_fault_site_recorded": fault_recorded,
+        "doctor_exits_2": doctor_exit == 2,
+        "doctor_names_fault_era": doctor_named,
+        "serving_path_compiles_zero": compiles == 0,
+    }
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "incident_flight_recorder_drill",
+        "geometry": {"n_users": args.n_users, "n_items": args.n_items,
+                     "rank": args.rank},
+        "scrape_interval_s": scrape,
+        "probe_interval_s": probe,
+        "incident_id": iid,
+        "capture_elapsed_s": round(capture_elapsed, 3),
+        "capture_bound_s": round(2 * scrape + probe, 3),
+        "manifest_triggers": [t.get("trigger")
+                              for t in manifest.get("triggers", [])],
+        "manifest_slo_fast_burning": manifest.get("sloFastBurning"),
+        "metrics_window_seconds": window_s,
+        "exemplar_trace_ids": sorted(exemplar_ids),
+        "doctor_exit": doctor_exit,
+        "serving_path_compiles": compiles,
+        "checks": checks,
+        "ok": ok,
+    }))
+    shutil.rmtree(inc_dir, ignore_errors=True)
+    if not ok:
+        raise SystemExit(1)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--queries", type=int, default=2000)
@@ -1671,6 +1872,16 @@ def main() -> None:
                          "/health, disarming must clear the page, and "
                          "the whole drill must trigger zero "
                          "serving-path compiles")
+    ap.add_argument("--incident", action="store_true",
+                    help="incident flight-recorder chaos mode: the "
+                         "--slo topology plus the capture plane; an "
+                         "armed router.replica.down must produce "
+                         "exactly one postmortem bundle within two "
+                         "scrape intervals of the fast-burn trip "
+                         "(firing SLO named, >=5m history pinned, "
+                         "exemplar traces resolvable, fault era "
+                         "recorded) and `pio doctor --incident` must "
+                         "exit 2; zero serving-path compiles")
     ap.add_argument("--aot", action="store_true",
                     help="AOT bucket-ladder mode: cold vs warm ladder "
                          "compile wall time + per-bucket device p50, "
@@ -1716,6 +1927,9 @@ def main() -> None:
         return
     if args.slo:
         run_slo_mode(args, st, factory)
+        return
+    if args.incident:
+        run_incident_mode(args, st, factory)
         return
     if args.fault:
         run_fault_mode(args, st, factory)

@@ -58,3 +58,28 @@ def cpu_mesh():
     from predictionio_tpu.parallel.mesh import MeshConfig, make_mesh
 
     return make_mesh(MeshConfig(axes={"data": 8}))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """When ``PIO_TEST_INCIDENT_EXPORT`` names a directory, copy every
+    incident bundle the run left under the pytest basetemp into it —
+    CI uploads these as postmortem artifacts on failure. A bundle is
+    any directory holding a ``manifest.json`` (chaos-marked tests
+    write real ones via the flight recorder)."""
+    export = os.environ.get("PIO_TEST_INCIDENT_EXPORT")
+    if not export:
+        return
+    import shutil
+
+    tmp = session.config._tmp_path_factory.getbasetemp() \
+        if hasattr(session.config, "_tmp_path_factory") else None
+    if tmp is None or not tmp.exists():
+        return
+    os.makedirs(export, exist_ok=True)
+    for manifest in tmp.rglob("manifest.json"):
+        bundle = manifest.parent
+        dest = os.path.join(export, bundle.name)
+        try:
+            shutil.copytree(str(bundle), dest, dirs_exist_ok=True)
+        except OSError:
+            pass  # artifact export is best-effort, never a test failure

@@ -1,0 +1,344 @@
+"""Incident flight-recorder tests (utils/incidents.py): the bounded
+on-disk store (id uniquify, manifest-written-last, retention pruning
+under a fake clock), the capture plane (per-trigger debounce,
+near-in-time coalescing into one bundle, partial-capture degradation,
+the ``incident.capture.stall`` fail-open drill), crash-dump plumbing
+(``sys.excepthook`` chaining), exemplar collection, and the ``pio
+doctor`` correlation/exit-code contract."""
+
+import os
+import sys
+
+import pytest
+
+from predictionio_tpu.utils import incidents
+from predictionio_tpu.utils.faults import FAULTS
+from predictionio_tpu.utils.incidents import (
+    IncidentCapturer,
+    IncidentStore,
+    build_info_snapshot,
+    collect_exemplars,
+    default_incident_dir,
+    diagnose,
+    diagnose_live,
+    exit_code,
+    install_crash_handlers,
+    thread_dump,
+)
+from predictionio_tpu.utils.metrics import Registry
+from predictionio_tpu.utils.timeseries import TimeSeriesStore
+
+
+@pytest.fixture(autouse=True)
+def disarm_faults():
+    FAULTS.disarm()
+    yield
+    FAULTS.disarm()
+
+
+class FakeClock:
+    def __init__(self, t=1_700_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _captures(trigger, result):
+    return dict(incidents._m_captures.items()).get((trigger, result), 0.0)
+
+
+# -- the store -----------------------------------------------------------------
+
+
+class TestIncidentStore:
+    def test_default_dir(self):
+        assert default_incident_dir("/x/home") == os.path.join(
+            "/x/home", "incidents")
+
+    def test_new_id_uniquifies_within_one_second(self, tmp_path):
+        store = IncidentStore(str(tmp_path))
+        ts = 1_700_000_000.0
+        seen = []
+        for _ in range(3):
+            iid = store.new_id(ts, "crash")
+            os.makedirs(store.path(iid))
+            seen.append(iid)
+        assert len(set(seen)) == 3
+        assert seen[1] == f"{seen[0]}-2" and seen[2] == f"{seen[0]}-3"
+
+    def test_write_bundle_manifest_last_and_files_list(self, tmp_path):
+        store = IncidentStore(str(tmp_path))
+        d = store.write_bundle(
+            "20240101T000000-test",
+            {"health.json": {"status": "ok"}, "note.txt": "plain text"},
+            {"trigger": "test"})
+        assert sorted(os.listdir(d)) == [
+            "health.json", "manifest.json", "note.txt"]
+        m = store.load_manifest("20240101T000000-test")
+        assert m["files"] == ["health.json", "manifest.json", "note.txt"]
+        with open(os.path.join(d, "note.txt")) as f:
+            assert f.read() == "plain text"      # str written raw
+        assert store.read_json(
+            "20240101T000000-test", "health.json") == {"status": "ok"}
+        bundle = store.load_bundle("20240101T000000-test")
+        assert bundle["files"] == {"health.json": {"status": "ok"}}
+
+    def test_ids_newest_first_and_incomplete_listing(self, tmp_path):
+        store = IncidentStore(str(tmp_path))
+        store.write_bundle("20240101T000000-a", {}, {"trigger": "a"})
+        store.write_bundle("20240102T000000-b", {}, {"trigger": "b"})
+        os.makedirs(store.path("20240103T000000-c"))  # manifest never landed
+        assert store.ids() == ["20240103T000000-c", "20240102T000000-b",
+                               "20240101T000000-a"]
+        rows = store.list_bundles()
+        assert rows[0] == {"id": "20240103T000000-c", "incomplete": True}
+        assert rows[1]["trigger"] == "b"
+        assert store.load_bundle("20240103T000000-c") is None
+
+    def test_prune_drops_oldest_beyond_retention(self, tmp_path):
+        clk = FakeClock()
+        store = IncidentStore(str(tmp_path), retain=3, clock=clk)
+        ids = []
+        for i in range(5):
+            iid = store.new_id(clk(), "slo-fast-burn")
+            store.write_bundle(iid, {}, {"trigger": "slo-fast-burn"})
+            ids.append(iid)
+            clk.advance(1.0)
+        removed = store.prune()
+        assert removed == [ids[1], ids[0]]       # oldest beyond retain=3
+        assert store.ids() == [ids[4], ids[3], ids[2]]
+        assert store.prune(retain=1) == [ids[3], ids[2]]
+        assert store.ids() == [ids[4]]           # newest always survives
+
+    def test_missing_root_is_empty_not_error(self, tmp_path):
+        store = IncidentStore(str(tmp_path / "never-created"))
+        assert store.ids() == []
+        assert store.list_bundles() == []
+        assert store.prune() == []
+
+
+# -- capture helpers -----------------------------------------------------------
+
+
+class TestCaptureHelpers:
+    def test_collect_exemplars_worst_first(self):
+        reg = Registry()
+        h = reg.histogram("pio_t_seconds", "t", buckets=[0.1, 1.0],
+                          labelnames=("path",))
+        h.observe(0.05, ("a",), exemplar="trace-fast")
+        h.observe(5.0, ("b",), exemplar="trace-slow")
+        out = collect_exemplars(reg)
+        assert [e["traceId"] for e in out] == ["trace-slow", "trace-fast"]
+        assert out[0]["le"] == "+Inf" and out[0]["valueMs"] == 5000.0
+        assert out[1]["labels"] == {"path": "a"}
+        assert collect_exemplars(reg, limit=1) == out[:1]
+
+    def test_build_info_snapshot(self):
+        reg = Registry()
+        reg.gauge("pio_build_info", "b", ("version", "commit")).set(
+            1.0, ("1.2.3", "abc123"))
+        assert build_info_snapshot(reg) == {"version": "1.2.3",
+                                            "commit": "abc123"}
+        assert build_info_snapshot(Registry()) == {}
+
+    def test_fault_snapshot_reflects_armed_plans(self):
+        FAULTS.arm("incident.capture.stall", error="chaos")
+        snap = incidents.fault_snapshot()
+        assert snap["incident.capture.stall"]["error"] == "chaos"
+
+    def test_thread_dump_names_this_thread(self):
+        dump = thread_dump()
+        assert "MainThread" in dump and "test_thread_dump" in dump
+
+
+# -- the capturer --------------------------------------------------------------
+
+
+def _capturer(tmp_path, clk, **kw):
+    store = IncidentStore(str(tmp_path), clock=clk)
+    return store, IncidentCapturer(store, "test", clock=clk, **kw)
+
+
+class TestIncidentCapturer:
+    def test_debounce_suppresses_flapping_trigger(self, tmp_path):
+        clk = FakeClock()
+        store, cap = _capturer(tmp_path, clk, debounce=300.0)
+        before = _captures("slo-fast-burn", "debounced")
+        first = cap.trigger("slo-fast-burn", sync=True)
+        assert first is not None
+        assert cap.trigger("slo-fast-burn", sync=True) is None
+        assert _captures("slo-fast-burn", "debounced") == before + 1
+        clk.advance(301.0)
+        third = cap.trigger("slo-fast-burn", sync=True)
+        assert third is not None and third != first
+        assert len(store.ids()) == 2
+
+    def test_near_in_time_triggers_coalesce_into_one_bundle(self, tmp_path):
+        clk = FakeClock()
+        store, cap = _capturer(tmp_path, clk, debounce=300.0, coalesce=60.0)
+        i1 = cap.trigger("slo-fast-burn", {"slos": ["avail"]}, sync=True)
+        clk.advance(5.0)
+        i2 = cap.trigger("breaker-open", {"slos": ["latency"]}, sync=True)
+        assert i2 == i1                       # one page, one bundle
+        assert store.ids() == [i1]
+        m = store.load_manifest(i1)
+        assert m["trigger"] == "slo-fast-burn"
+        assert [t["trigger"] for t in m["triggers"]] == [
+            "slo-fast-burn", "breaker-open"]
+        assert m["sloFastBurning"] == ["avail", "latency"]  # unioned
+
+    @pytest.mark.chaos
+    def test_capture_pins_sources_history_and_faults(self, tmp_path):
+        clk = FakeClock()
+        store, cap = _capturer(tmp_path, clk)
+        cap.add_source("health", lambda: {"status": "ok"})
+        cap.add_source("slo_status", lambda: {"fastBurning": ["avail"]})
+        cap.add_source("broken", lambda: 1 / 0)
+        tsdb = TimeSeriesStore(Registry(), clock=clk)
+        tsdb.record("pio_probe_requests_total", {"path": "/q"}, 7.0)
+        cap.set_history(tsdb, lambda: ["pio_probe_requests_total"],
+                        window=900.0)
+        before = _captures("slo-fast-burn", "ok")
+        iid = cap.trigger("slo-fast-burn", sync=True)
+        assert _captures("slo-fast-burn", "ok") == before + 1
+        bundle = store.load_bundle(iid)
+        m = bundle["manifest"]
+        assert m["process"] == "test" and m["sloFastBurning"] == ["avail"]
+        assert m["metricsWindowSeconds"] == 900.0
+        assert set(m["files"]) >= {"manifest.json", "health.json",
+                                   "slo_status.json", "broken.json",
+                                   "traces.json", "faults.json",
+                                   "metrics_history.json"}
+        files = bundle["files"]
+        assert files["health.json"] == {"status": "ok"}
+        # a failing source degrades to an error doc, never kills capture
+        assert files["broken.json"]["error"].startswith("ZeroDivisionError")
+        hist = files["metrics_history.json"]
+        assert hist["windowSeconds"] == 900.0
+        assert any(k.startswith("pio_probe_requests_total")
+                   for k in hist["series"])
+        assert "exemplarTraceIds" in files["traces.json"]
+
+    def test_capture_stall_fault_is_fail_open(self, tmp_path):
+        """The ``incident.capture.stall`` drill: an armed error plan
+        fails the capture (counted, no bundle) without harming the
+        host process — the flight recorder never becomes the crash."""
+        clk = FakeClock()
+        store, cap = _capturer(tmp_path, clk)
+        FAULTS.arm("incident.capture.stall", error="chaos")
+        before = _captures("slo-fast-burn", "error")
+        iid = cap.trigger("slo-fast-burn", sync=True)  # must not raise
+        assert iid is not None
+        assert _captures("slo-fast-burn", "error") == before + 1
+        assert store.load_manifest(iid) is None       # nothing half-written
+        FAULTS.disarm()
+        clk.advance(cap.debounce + 1)
+        iid2 = cap.trigger("slo-fast-burn", sync=True)
+        assert store.load_manifest(iid2) is not None  # recovered
+
+    def test_async_trigger_joins(self, tmp_path):
+        clk = FakeClock()
+        store, cap = _capturer(tmp_path, clk)
+        iid = cap.trigger("replica-down", {"url": "http://x"})
+        cap.join(5.0)
+        m = store.load_manifest(iid)
+        assert m["triggers"][0]["detail"] == {"url": "http://x"}
+
+    def test_capture_prunes_store(self, tmp_path):
+        clk = FakeClock()
+        store = IncidentStore(str(tmp_path), retain=1, clock=clk)
+        cap = IncidentCapturer(store, "test", debounce=0.0, coalesce=0.0,
+                               clock=clk)
+        for _ in range(3):
+            cap.trigger("slo-fast-burn", sync=True)
+            clk.advance(61.0)
+        assert len(store.ids()) == 1
+
+
+# -- crash-dump plumbing -------------------------------------------------------
+
+
+class TestCrashHandlers:
+    def test_excepthook_captures_then_chains(self, tmp_path):
+        clk = FakeClock()
+        store, cap = _capturer(tmp_path, clk)
+        chained = []
+        prev = sys.excepthook
+        sys.excepthook = lambda *a: chained.append(a)
+        try:
+            install_crash_handlers(cap, install_signals=False)
+            try:
+                raise ValueError("boom")
+            except ValueError:
+                sys.excepthook(*sys.exc_info())
+        finally:
+            sys.excepthook = prev
+        assert len(chained) == 1              # previous hook still ran
+        (iid,) = store.ids()
+        assert iid.endswith("-crash")
+        m = store.load_manifest(iid)
+        assert m["triggers"][0]["detail"]["exception"] == "ValueError: boom"
+        with open(os.path.join(store.path(iid), "crash_traceback.txt")) as f:
+            assert "ValueError: boom" in f.read()
+
+
+# -- doctor --------------------------------------------------------------------
+
+
+class TestDoctor:
+    def test_diagnose_ranks_and_exit_code(self):
+        bundle = {
+            "manifest": {
+                "sloFastBurning": ["avail"],
+                "faults": {"router.replica.down": {"error": "drill"}},
+                "exemplars": [{"valueMs": 123.0, "series": "pio_t_seconds",
+                               "traceId": "t1"}],
+                "triggers": [{"trigger": "slo-fast-burn"},
+                             {"trigger": "breaker-open"}],
+            },
+            "files": {
+                "replicas.json": {"replicas": [
+                    {"url": "http://a", "state": "down", "breaker": "open"},
+                    {"url": "http://b", "state": "not-ready"},
+                ]},
+                "metrics_history.json": {"series": {
+                    'pio_engine_shed_total{app="x"}': [[1.0, 0.0], [2.0, 5.0]],
+                    'pio_probe_requests_total': [[1.0, 3.0], [2.0, 3.0]],
+                }},
+            },
+        }
+        findings = diagnose(bundle)
+        sev = [f["severity"] for f in findings]
+        assert sev == sorted(sev, reverse=True)
+        titles = "\n".join(f["title"] for f in findings)
+        assert "SLO avail fast-burning" in titles
+        assert "router.replica.down" in titles
+        assert "http://a was down" in titles
+        assert "http://b was not-ready" in titles
+        assert "tenant pressure" in titles and "moved first" in titles
+        assert "2 triggers coalesced" in titles
+        assert exit_code(findings) == 2
+
+    def test_diagnose_clean_bundle_exits_zero(self):
+        findings = diagnose({"manifest": {}, "files": {}})
+        assert findings == [] and exit_code(findings) == 0
+
+    def test_diagnose_live(self):
+        findings = diagnose_live(
+            {"fastBurning": ["avail"],
+             "slos": [{"name": "lat", "slowBurn": True, "fastBurn": False}]},
+            {"status": "degraded", "reason": "replica down"},
+            {"replicas": [{"url": "http://a", "state": "down",
+                           "breaker": "open"}]})
+        assert exit_code(findings) == 2
+        titles = "\n".join(f["title"] for f in findings)
+        assert "fast-burning NOW" in titles and "slow-burning" in titles
+        assert "degraded" in titles
+        assert findings[0]["severity"] == 2
+
+    def test_diagnose_live_quiet_fleet_exits_zero(self):
+        assert exit_code(diagnose_live({}, {"status": "ok"}, {})) == 0

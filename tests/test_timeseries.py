@@ -301,6 +301,66 @@ class TestScrapeAndHistory:
         assert payload["windowSeconds"] == 60.0
         assert payload["series"] == {'pio_c_total{app="a"}': [[990.0, 1.0]]}
 
+    def test_retention_under_clock_jumps(self):
+        """Wall-clock jumps must never resurface stale samples or grow
+        a ring past its slot bound: a forward jump ages everything out
+        of the query window, a backward jump inside the resolution
+        step last-write-wins instead of appending out of order."""
+        clk = FakeClock(0.0)
+        store = TimeSeriesStore(Registry(), tiers=((1.0, 4),), clock=clk)
+        for t in range(4):
+            store.record("g", {}, float(t), ts=float(t))
+        clk.t = 1_000_000.0
+        store.record("g", {}, 9.0)
+        (samples,) = store.query("g", 4.0).values()
+        assert samples == [(1_000_000.0, 9.0)]
+        (series,) = store._series.values()
+        assert len(series.rings[0].samples) <= 4
+        clk.t = 999_999.5                      # NTP step backwards
+        store.record("g", {}, 10.0)
+        (samples,) = store.query("g", 4.0, ts=1_000_000.0).values()
+        assert samples == [(999_999.5, 10.0)]
+
+    def test_snapshot_window_skips_bad_selectors(self):
+        """The incident-bundle pin: several selectors in one payload,
+        malformed or unmatched ones skipped — a capture degrades to a
+        partial bundle, never raises."""
+        store = TimeSeriesStore(Registry(), clock=FakeClock())
+        store.record("pio_a_total", {"app": "x"}, 1.0, ts=990.0)
+        store.record("pio_b", {}, 2.0, ts=995.0)
+        snap = store.snapshot_window(
+            ["pio_a_total", "pio_b", "???bad", "pio_missing"],
+            window=60.0, ts=1000.0)
+        assert snap["windowSeconds"] == 60.0
+        assert snap["series"] == {'pio_a_total{app="x"}': [[990.0, 1.0]],
+                                  "pio_b": [[995.0, 2.0]]}
+
+    def test_scrape_loop_cancels_cleanly(self):
+        """Shutdown contract: cancelling the scraper task stops it for
+        good — no further scrapes land and no stray thread survives
+        (the loop is a coroutine, not a thread)."""
+        import threading
+
+        reg = Registry()
+        reg.counter("pio_c_total", "c").inc(())
+        store = TimeSeriesStore(reg)
+        n_threads = threading.active_count()
+
+        async def drive():
+            task = asyncio.create_task(scrape_loop(store, 0.01))
+            while not store.names():
+                await asyncio.sleep(0.01)
+            task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await task
+            assert task.done()
+            before = store.query("pio_c_total", 60.0)
+            await asyncio.sleep(0.05)
+            assert store.query("pio_c_total", 60.0) == before
+
+        asyncio.run(asyncio.wait_for(drive(), timeout=10))
+        assert threading.active_count() <= n_threads
+
     def test_scrape_loop_stall_fault_is_fail_open(self):
         """An armed ``tsdb.scrape.stall`` plan costs ticks of history
         (counted as errors), never kills the loop: once disarmed the

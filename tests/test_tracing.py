@@ -164,6 +164,27 @@ class TestRingAndSampling:
         with pytest.raises(ValueError):
             tracing.TRACER.configure(enabled=True, sample_rate=1.5)
 
+    def test_export_by_trace_ids(self):
+        """The incident-bundle pin: filter the ring to an exemplar's
+        trace-id set, oldest first; an empty set is an empty list, not
+        a full dump."""
+        tracing.TRACER.configure(enabled=True)
+        ids = []
+        for i in range(3):
+            with tracing.span(f"root{i}") as sp:
+                ids.append(sp.trace_id)
+                with tracing.span(f"child{i}"):
+                    pass
+        wanted = {ids[0], ids[2]}
+        got = tracing.TRACER.ring.export_by_trace_ids(wanted)
+        assert {d["traceId"] for d in got} == wanted
+        assert [d["name"] for d in got] == \
+            ["root0", "child0", "root2", "child2"]  # oldest first
+        starts = [d["startUs"] for d in got]
+        assert starts == sorted(starts)
+        assert tracing.TRACER.ring.export_by_trace_ids(set()) == []
+        assert tracing.TRACER.ring.export_by_trace_ids({"nope"}) == []
+
 
 class TestTraceparent:
     def test_roundtrip(self):

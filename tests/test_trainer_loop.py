@@ -567,3 +567,70 @@ def test_sigkill_mid_delta_train_resumes_and_promotes_once(tmp_path):
     assert doc["fence_token"] >= 2, "restart bumped the fencing token"
     # COMPLETED consumed the checkpoints: the resume point is gone
     assert not checkpointed()
+
+
+# -- observability listener (ISSUE 15) -----------------------------------------
+
+
+class TestMetricsListener:
+    def test_listener_serves_metrics_history_and_health(self, home_storage):
+        """The trainer's /metrics + /metrics/history + /health listener
+        makes it a federation peer; ``metrics_port=0`` binds ephemeral."""
+        import threading
+        import urllib.request
+
+        clock = FakeClock()
+        _seed_events(home_storage)
+        t = _trainer(home_storage, clock, metrics_port=0)
+        assert t.tsdb is not None
+        t._start_listener()
+        try:
+            port = t.metrics_bound_port
+            assert port
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics",
+                timeout=5).read().decode()
+            assert "pio_trainer_cycles_total" in body
+            assert "pio_trainer_lease_held" in body
+            doc = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=5))
+            assert doc["status"] == "ok" and doc["role"] == "trainer"
+            assert doc["leaseHeld"] is False
+            t.tsdb.record("pio_trainer_lease_held", {}, 1.0)
+            hist = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics/history"
+                "?series=pio_trainer_lease_held&window=15m", timeout=5))
+            assert hist["windowSeconds"] == 900.0
+            assert "pio_trainer_lease_held" in hist["series"]
+        finally:
+            t._stop_listener()
+        assert t.metrics_bound_port is None
+        assert not any(th.name == "trainer-metrics"
+                       for th in threading.enumerate())
+
+    def test_run_counts_cycles_and_stops_listener(self, home_storage):
+        """run() starts the listener, counts cycle outcomes, and tears
+        the listener down on graceful exit — no stray thread after."""
+        import threading
+
+        from predictionio_tpu.utils.metrics import REGISTRY
+
+        clock = FakeClock()
+        _seed_events(home_storage)
+        t = _trainer(home_storage, clock, metrics_port=0)
+        outcomes = t.run(max_cycles=1, install_signals=False)
+        assert len(outcomes) == 1
+        out = outcomes[0]["outcome"]
+        assert f'pio_trainer_cycles_total{{outcome="{out}"}}' \
+            in REGISTRY.render()
+        assert t.metrics_bound_port is None
+        assert not any(th.name == "trainer-metrics"
+                       for th in threading.enumerate())
+
+    def test_no_metrics_port_means_no_listener(self, home_storage):
+        clock = FakeClock()
+        _seed_events(home_storage)
+        t = _trainer(home_storage, clock)
+        assert t.tsdb is None and t.metrics_bound_port is None
+        t.run(max_cycles=1, install_signals=False)
+        assert t.metrics_bound_port is None
