@@ -135,17 +135,22 @@ def _backend_watchdog(seconds: float):
 
     def fire():
         if not done.wait(seconds):
-            print("bench.py: accelerator backend unreachable after "
-                  f"{seconds:.0f}s (tunnel relay wedged?) — no "
-                  "measurement possible; see the previous round's BENCH "
-                  "file for last good numbers (r5 measured on the v5e: "
-                  "docs/perf.md hardware A/B + bench tables). The "
-                  "wedged-relay outage previously ate rounds 3–4; "
-                  "chip-free validation is docs/perf.md 'AOT compile "
-                  "validation' (profile_aot.py) and the live-chip "
-                  "sequence is docs/perf/hardware_runbook.md",
-                  flush=True)
-            os._exit(2)
+            # a PARSEABLE record, not prose + rc=2: BENCH rounds 3–5
+            # came back "parsed": null because this path printed an
+            # explanation the driver could not ingest. The driver keys
+            # on "metric"; "skipped": true marks no-measurement so the
+            # previous round's numbers stay the reference.
+            print(json.dumps({
+                "metric": "als_train_throughput_ml20m_synthetic",
+                "skipped": True,
+                "reason": ("accelerator backend unreachable after "
+                           f"{seconds:.0f}s (tunnel relay wedged?) — no "
+                           "measurement possible; chip-free validation: "
+                           "docs/perf.md 'AOT compile validation' "
+                           "(profile_aot.py); live-chip sequence: "
+                           "docs/perf/hardware_runbook.md"),
+            }), flush=True)
+            os._exit(0)
 
     threading.Thread(target=fire, daemon=True).start()
     return done

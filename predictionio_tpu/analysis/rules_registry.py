@@ -209,12 +209,13 @@ def _metric_findings(project: Project) -> List[Finding]:
     return out
 
 
-_PREFIXED_RE = re.compile(r"^pio_(fleet|slo|incident)_[a-z0-9_]*$")
+_PREFIXED_RE = re.compile(
+    r"^pio_(fleet|slo|incident|ann_shard)_[a-z0-9_]*$")
 
 
 def prefixed_series(project: Project) -> Dict[str, Tuple[str, int]]:
-    """Every ``pio_fleet_*`` / ``pio_slo_*`` / ``pio_incident_*``
-    string constant in the
+    """Every ``pio_fleet_*`` / ``pio_slo_*`` / ``pio_incident_*`` /
+    ``pio_ann_shard_*`` string constant in the
     package, wherever it appears. These series names are often built
     dynamically (federation renames ``pio_*`` to ``pio_fleet_*`` at
     scrape time; ``pio top`` queries the renamed series by literal), so

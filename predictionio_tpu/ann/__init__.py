@@ -19,14 +19,17 @@ verbs — ``pio index status`` among them — must stay jax-free).
 from predictionio_tpu.ann.index import (INDEX_BASENAME, MANIFEST_BASENAME,
                                         PQIndex, build_index, load_index,
                                         manifest_dict, save_index)
+from predictionio_tpu.ann.index import shard_view
 from predictionio_tpu.ann.pq import (decode, encode, reconstruction_mse,
-                                     train_codebooks)
+                                     train_codebooks, train_opq)
 from predictionio_tpu.ann.scorer import (DEFAULT_SHORTLIST, ANNScorer,
-                                         maybe_ann_scorer)
+                                         ShardedANNScorer, maybe_ann_scorer)
 
 __all__ = [
     "PQIndex", "build_index", "load_index", "save_index", "manifest_dict",
-    "INDEX_BASENAME", "MANIFEST_BASENAME",
-    "train_codebooks", "encode", "decode", "reconstruction_mse",
-    "ANNScorer", "maybe_ann_scorer", "DEFAULT_SHORTLIST",
+    "shard_view", "INDEX_BASENAME", "MANIFEST_BASENAME",
+    "train_codebooks", "train_opq", "encode", "decode",
+    "reconstruction_mse",
+    "ANNScorer", "ShardedANNScorer", "maybe_ann_scorer",
+    "DEFAULT_SHORTLIST",
 ]

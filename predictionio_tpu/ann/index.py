@@ -50,12 +50,15 @@ _F32 = 4
 class PQIndex:
     """In-memory PQ index: ``codebooks`` (m, K, dsub) f32, ``codes``
     (N, m) u8, optional ``ids`` (N,) i32 mapping code rows to corpus
-    rows (None = identity), plus build metadata."""
+    rows (None = identity), optional OPQ ``rotation`` (dim, dim) f32
+    (codes quantize ``V @ rotation``; serving rotates the query before
+    the ADC LUT), plus build metadata."""
 
     codebooks: np.ndarray
     codes: np.ndarray
     ids: Optional[np.ndarray] = None
     meta: dict = field(default_factory=dict)
+    rotation: Optional[np.ndarray] = None
 
     @property
     def m(self) -> int:
@@ -83,23 +86,39 @@ class PQIndex:
     def codebook_bytes(self) -> int:
         return self.codebooks.size * _F32
 
+    def rotation_bytes(self) -> int:
+        return 0 if self.rotation is None else self.rotation.size * _F32
+
     def hbm_estimate_bytes(self) -> int:
         """Device-resident footprint of ANN serving: codes + codebooks
-        + the float corpus kept for exact shortlist re-rank."""
+        (+ OPQ rotation) + the float corpus kept for exact shortlist
+        re-rank. Per-device under an S-way shard mesh:
+        :func:`shard_view`."""
         return (self.code_bytes() + self.codebook_bytes()
-                + self.n_items * self.dim * _F32)
+                + self.rotation_bytes() + self.n_items * self.dim * _F32)
 
     # -- wire format ----------------------------------------------------------
 
     def to_bytes(self) -> bytes:
+        """Serialize. Version 1 (bitwise-unchanged since PR 10) when
+        the index has no rotation and no shard-layout hint, so plain-PQ
+        blobs stay readable by pre-OPQ loaders; version 2 appends the
+        rotation to the payload and carries ``has_rotation`` + the
+        intended serving ``shard_layout`` in the header."""
         codebooks = np.ascontiguousarray(self.codebooks, np.float32)
         codes = np.ascontiguousarray(self.codes, np.uint8)
         payload = codebooks.tobytes() + codes.tobytes()
         has_ids = self.ids is not None
         if has_ids:
             payload += np.ascontiguousarray(self.ids, np.int32).tobytes()
+        has_rotation = self.rotation is not None
+        shards = self.meta.get("shards")
+        version = 2 if (has_rotation or shards) else 1
+        if has_rotation:
+            payload += np.ascontiguousarray(
+                self.rotation, np.float32).tobytes()
         header = {
-            "version": 1,
+            "version": version,
             "m": self.m, "k": self.k, "dsub": self.dsub,
             "n": self.n_items, "dim": self.dim,
             "has_ids": has_ids,
@@ -107,6 +126,11 @@ class PQIndex:
             "build_sec": self.meta.get("build_sec"),
             "built_unix": self.meta.get("built_unix"),
         }
+        if version >= 2:
+            header["has_rotation"] = has_rotation
+            if shards:
+                header["shard_layout"] = shard_layout(self.n_items,
+                                                      int(shards))
         hj = json.dumps(header, sort_keys=True).encode("utf-8")
         return MAGIC + struct.pack("<I", len(hj)) + hj + payload
 
@@ -128,7 +152,7 @@ class PQIndex:
             header = json.loads(blob[off:off + hlen].decode("utf-8"))
             off += hlen
             payload = blob[off:]
-            if header.get("version") != 1:
+            if header.get("version") not in (1, 2):
                 raise ValueError(f"unknown version {header.get('version')!r}")
             verify_blob(payload, header["payload_sha256"], "ann_index",
                         what="payload")
@@ -148,43 +172,117 @@ class PQIndex:
             if header.get("has_ids"):
                 ids = np.frombuffer(
                     payload, np.int32, count=n, offset=pos).copy()
+                pos += n * _F32
+            rotation = None
+            if header.get("has_rotation"):    # v2-only key; absent in v1
+                dim = m * dsub
+                rotation = np.frombuffer(
+                    payload, np.float32, count=dim * dim,
+                    offset=pos).reshape(dim, dim).copy()
         except IntegrityError:
             raise
         except Exception as e:
             raise IntegrityError(f"ann index blob corrupt: {e}") from e
         meta = {"build_sec": header.get("build_sec"),
                 "built_unix": header.get("built_unix")}
-        return cls(codebooks=codebooks, codes=codes, ids=ids, meta=meta)
+        layout = header.get("shard_layout")
+        if layout:
+            meta["shards"] = layout.get("shards")
+        return cls(codebooks=codebooks, codes=codes, ids=ids, meta=meta,
+                   rotation=rotation)
+
+
+def shard_layout(n_items: int, shards: int) -> dict:
+    """Contiguous item-wise partition of the corpus over an S-way
+    ``shards`` mesh axis: the item axis is padded to a multiple of S
+    and split into equal blocks (shard i owns rows
+    [i·rows, (i+1)·rows)); pad rows live in the last shard's tail and
+    are masked on device. Pure arithmetic — shared by the serving
+    scorer, the blob header, and the jax-free ``pio index status``
+    per-shard view."""
+    shards = max(1, int(shards))
+    rows = -(-n_items // shards)          # ceil → per-shard block
+    return {"shards": shards, "rows_per_shard": rows,
+            "padded_items": rows * shards}
+
+
+def shard_view(man: dict, shards: int) -> dict:
+    """Per-shard byte / per-device HBM breakdown from a manifest dict
+    alone (jax-free — ``pio index status --shards N`` sizes a mesh from
+    an ops box with no accelerator stack). Codebooks and the OPQ
+    rotation are replicated on every device; codes and the re-rank
+    floats are partitioned item-wise."""
+    layout = shard_layout(int(man["n_items"]), shards)
+    rows = layout["rows_per_shard"]
+    per_item_code = int(man["m"])           # uint8 per subspace
+    replicated = (int(man.get("codebook_bytes", 0))
+                  + int(man.get("rotation_bytes") or 0))
+    code_b = rows * per_item_code
+    rerank_b = rows * int(man["dim"]) * _F32
+    return {
+        **layout,
+        "code_bytes_per_shard": code_b,
+        "rerank_bytes_per_shard": rerank_b,
+        "replicated_bytes": replicated,
+        "hbm_per_device_bytes": code_b + rerank_b + replicated,
+    }
 
 
 def build_index(V, m: int, k: int, *, iters: int = 8, seed: int = 0,
-                sample: int = 65536) -> PQIndex:
+                sample: int = 65536, opq: bool = False,
+                opq_iters: int = 4,
+                shards: Optional[int] = None) -> PQIndex:
     """Train codebooks + encode the corpus → :class:`PQIndex` with
-    build timing in ``meta`` (surfaced by ``pio index status``)."""
+    build timing in ``meta`` (surfaced by ``pio index status``).
+
+    ``opq=True`` trains an OPQ-style orthogonal rotation first
+    (:func:`predictionio_tpu.ann.pq.train_opq`) and quantizes the
+    ROTATED corpus — better recall at the same code bytes; the
+    rotation rides in the (version-2) blob. ``shards`` records the
+    intended serving mesh size in the blob header / manifest so
+    ``pio index status`` and the deploy-time scorer agree on layout —
+    it does not change the encoded payload (the blob is shard-count
+    agnostic; partitioning happens at device placement)."""
     from predictionio_tpu.ann import pq
 
     t0 = time.perf_counter()
-    codebooks = pq.train_codebooks(V, m, k, iters=iters, seed=seed,
-                                   sample=sample)
-    codes = pq.encode(V, codebooks)
-    return PQIndex(codebooks=codebooks, codes=codes,
-                   meta={"build_sec": round(time.perf_counter() - t0, 3),
-                         "built_unix": int(time.time())})
+    V = np.asarray(V, np.float32)
+    rotation = None
+    if opq:
+        rotation, codebooks = pq.train_opq(
+            V, m, k, iters=iters, opq_iters=opq_iters, seed=seed,
+            sample=sample)
+        codes = pq.encode(V @ rotation, codebooks)
+    else:
+        codebooks = pq.train_codebooks(V, m, k, iters=iters, seed=seed,
+                                       sample=sample)
+        codes = pq.encode(V, codebooks)
+    meta = {"build_sec": round(time.perf_counter() - t0, 3),
+            "built_unix": int(time.time())}
+    if shards and int(shards) > 1:
+        meta["shards"] = int(shards)
+    return PQIndex(codebooks=codebooks, codes=codes, meta=meta,
+                   rotation=rotation)
 
 
 def manifest_dict(index: PQIndex, blob_sha256: str) -> dict:
     """The jax-free geometry summary ``pio index status`` prints."""
-    return {
-        "version": 1,
+    man = {
+        "version": 2 if (index.rotation is not None
+                         or index.meta.get("shards")) else 1,
         "m": index.m, "k": index.k, "dsub": index.dsub,
         "dim": index.dim, "n_items": index.n_items,
         "code_bytes": index.code_bytes(),
         "codebook_bytes": index.codebook_bytes(),
+        "rotation_bytes": index.rotation_bytes(),
         "hbm_estimate_bytes": index.hbm_estimate_bytes(),
         "build_sec": index.meta.get("build_sec"),
         "built_unix": index.meta.get("built_unix"),
         "sha256": blob_sha256,
     }
+    if index.meta.get("shards"):
+        man["shards"] = int(index.meta["shards"])
+    return man
 
 
 def save_index(index: PQIndex, algo_dir: str) -> str:

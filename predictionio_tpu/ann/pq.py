@@ -139,6 +139,50 @@ def train_codebooks(V, m: int, k: int, *, iters: int = 8, seed: int = 0,
     return np.asarray(C)
 
 
+def train_opq(V, m: int, k: int, *, iters: int = 8, opq_iters: int = 4,
+              seed: int = 0, sample: int = 65536):
+    """OPQ-style learned rotation + codebooks: alternate Lloyd codebook
+    training with an orthogonal-Procrustes rotation update so the
+    subspace split aligns with the corpus' principal structure —
+    recall at a given M (i.e. at the same code bytes per item), or the
+    same recall at lower M.
+
+    Returns ``(rotation (dim, dim) f32, codebooks (m, k, dim/m) f32)``.
+    The rotation is orthogonal, so inner products are preserved
+    exactly: ``q·v == (qR)·(vR)`` — serving rotates the query once
+    before the ADC LUT and re-ranks against the UN-rotated float
+    corpus, identical contract to plain PQ.
+
+    Each OPQ iteration: train codebooks on the rotated sample, encode +
+    reconstruct, then solve ``min_R ||X R − recon||_F`` over orthogonal
+    R in closed form (SVD of ``Xᵀ·recon``). A final codebook pass on
+    the converged rotation keeps codebooks and rotation consistent.
+    ``opq_iters=0`` degrades to plain PQ with an identity rotation.
+    """
+    V = np.asarray(V, np.float32)
+    n, dim = V.shape
+    _check_geometry(dim, m, k)
+    rng = np.random.default_rng(seed)
+    if n > sample:
+        X = V[rng.choice(n, size=sample, replace=False)]
+    else:
+        X = V
+    R = np.eye(dim, dtype=np.float32)
+    for _ in range(max(0, int(opq_iters))):
+        Xr = X @ R
+        C = train_codebooks(Xr, m, k, iters=iters, seed=seed,
+                            sample=len(X))
+        recon = decode(encode(Xr, C), C)
+        # orthogonal Procrustes in f64: the SVD of a near-singular
+        # cross-covariance is where f32 visibly degrades orthogonality
+        M = (X.astype(np.float64).T @ recon.astype(np.float64))
+        Uo, _s, Vt = np.linalg.svd(M)
+        R = (Uo @ Vt).astype(np.float32)
+    codebooks = train_codebooks(X @ R, m, k, iters=iters, seed=seed,
+                                sample=len(X))
+    return R, codebooks
+
+
 def encode(V, codebooks: np.ndarray) -> np.ndarray:
     """Encode the corpus to (N, m) uint8 nearest-centroid code words,
     chunked (last chunk padded then sliced — one compile total)."""

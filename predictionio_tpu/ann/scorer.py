@@ -32,13 +32,25 @@ from typing import Optional
 import numpy as np
 
 from predictionio_tpu.ann.index import PQIndex
-from predictionio_tpu.models.als import _SERVE_MIN_ITEMS, _bucket_k
+from predictionio_tpu.models.als import _bucket_k, serve_on_device
 
 DEFAULT_SHORTLIST = 128
 
 
-def _ann_topk_impl(U, V, codebooks, codesT, user_ids, rows_valid=None, *,
-                   k: int, kprime: int):
+def _rotate_query(Q, rotation):
+    """OPQ query rotation: the LUT must be built against the rotated
+    query (codes quantize ``V @ R``; R orthogonal ⇒ ``q·v == qR·vR``),
+    while the exact re-rank keeps the UN-rotated Q against the
+    un-rotated corpus. HIGHEST precision for run-to-run determinism."""
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.dot(Q, rotation, preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.HIGHEST)
+
+
+def _ann_topk_impl(U, V, codebooks, codesT, user_ids, rows_valid=None,
+                   rotation=None, *, k: int, kprime: int):
     import jax.numpy as jnp
 
     from predictionio_tpu import ops
@@ -47,7 +59,8 @@ def _ann_topk_impl(U, V, codebooks, codesT, user_ids, rows_valid=None, *,
     Q = U[user_ids]
     if rows_valid is not None:
         Q = _mask_pad_rows(Q, rows_valid)
-    _svals, sidx = ops.adc_shortlist(Q, codebooks, codesT, kprime)
+    Qr = Q if rotation is None else _rotate_query(Q, rotation)
+    _svals, sidx = ops.adc_shortlist(Qr, codebooks, codesT, kprime)
     vals, idx = ops.rerank_topk(Q, V, sidx, k)
     # ONE packed output array — one host fetch per query batch, same
     # rationale as als._gather_score_topk_impl (indices exact in f32
@@ -79,8 +92,6 @@ class ANNScorer:
 
     def __init__(self, U: np.ndarray, V: np.ndarray, index: PQIndex,
                  shortlist: int = DEFAULT_SHORTLIST):
-        import jax
-        import jax.numpy as jnp
         import weakref
 
         try:
@@ -99,9 +110,22 @@ class ANNScorer:
             raise ValueError(
                 f"index dim {index.dim} != embedding dim {self.rank}")
         self.m, self.K = index.m, index.k
+        #: the shortlist the caller asked for (pre-clamp) — what
+        #: ``maybe_ann_scorer`` compares for cached reuse
+        self._want_shortlist = int(shortlist)
         #: shortlist size k′ — the recall/latency knob (clamped to the
         #: catalog; serving k is further clamped to k′)
         self.shortlist = max(1, min(int(shortlist), self.n_items))
+        self._place(U, V, index)
+        self.bucket_ladder = None
+        self._aot: dict = {}   # (B, k) -> compiled
+
+    def _place(self, U, V, index: PQIndex) -> None:
+        """Device placement of the serving state (subclass hook — the
+        sharded scorer pads + lays the corpus out over its mesh here)."""
+        import jax
+        import jax.numpy as jnp
+
         self._U = jax.device_put(jnp.asarray(U, jnp.float32))
         # float corpus stays resident for the exact re-rank; UNPADDED —
         # the re-rank gathers only shortlist rows, never scans V
@@ -112,8 +136,10 @@ class ANNScorer:
         # one contiguous row
         self._codesT = jax.device_put(jnp.asarray(
             np.ascontiguousarray(np.asarray(index.codes, np.uint8).T)))
-        self.bucket_ladder = None
-        self._aot: dict = {}   # (B, k) -> compiled
+        # OPQ rotation (None for plain-PQ / legacy v1 blobs — those
+        # keep the exact pre-rotation program and executables)
+        self._rot = (None if index.rotation is None else jax.device_put(
+            jnp.asarray(index.rotation, jnp.float32)))
 
     # -- AOT bucket ladder (server/aot) ---------------------------------------
 
@@ -129,7 +155,8 @@ class ANNScorer:
         import jax
 
         return ("ann_adc_topk", self.n_users, self.rank, self.m, self.K,
-                self.n_items, B, k, self.shortlist, jax.default_backend())
+                self.n_items, B, k, self.shortlist,
+                self._rot is not None, jax.default_backend())
 
     def _ensure_executable(self, B: int, k: int) -> bool:
         """AOT lower+compile one (bucket, k) serving program via the
@@ -142,6 +169,8 @@ class ANNScorer:
         was_cold = EXECUTABLES.get(key) is None
 
         def build():
+            rot_sds = (None if self._rot is None else jax.ShapeDtypeStruct(
+                (self.rank, self.rank), np.float32))
             sds = (
                 jax.ShapeDtypeStruct((self.n_users, self.rank), np.float32),
                 jax.ShapeDtypeStruct((self.n_items, self.rank), np.float32),
@@ -150,6 +179,7 @@ class ANNScorer:
                 jax.ShapeDtypeStruct((self.m, self.n_items), np.uint8),
                 jax.ShapeDtypeStruct((B,), np.int32),
                 jax.ShapeDtypeStruct((), np.int32),  # rows_valid
+                rot_sds,
             )
             return _ann_topk_jit().lower(
                 *sds, k=k, kprime=self.shortlist).compile()
@@ -191,12 +221,13 @@ class ANNScorer:
             if prog is not None:
                 packed = np.asarray(prog(
                     self._U, self._V, self._codebooks, self._codesT,
-                    np.asarray(user_ids, np.int32), rows_valid))
+                    np.asarray(user_ids, np.int32), rows_valid,
+                    self._rot))
             else:
                 packed = np.asarray(_ann_topk_jit()(
                     self._U, self._V, self._codebooks, self._codesT,
                     jnp.asarray(user_ids, jnp.int32), rows_valid,
-                    k=k, kprime=self.shortlist))
+                    self._rot, k=k, kprime=self.shortlist))
             out = packed[..., :k], packed[..., k:].astype(np.int32)
             aot.record_device_latency(B, time.perf_counter() - t0, path,
                                       trace_exemplar=tracing.exemplar())
@@ -246,20 +277,255 @@ class ANNScorer:
         return iv, vv
 
 
+@functools.lru_cache(maxsize=None)
+def _sharded_ann_jit(mesh, local_n: int, n_items: int, k: int,
+                     kprime: int, rotated: bool):
+    """One jitted shard_map program per (mesh, geometry, k, k′): the
+    whole sharded serving path — per-shard ADC scan at a global column
+    offset, all-gather of per-shard shortlists, distributed top-k′
+    merge, partial exact re-rank + psum — fused in ONE executable so
+    serving stays single-dispatch exactly like the unsharded path.
+
+    With ``shards == 1`` every collective degenerates (all_gather of
+    one shard, psum over one device, top-k′ of an already-sorted list)
+    and the outputs are bitwise identical to ``_ann_topk_impl``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from predictionio_tpu import ops
+    from predictionio_tpu.ops.topk import _NEG, _mask_pad_rows
+    from predictionio_tpu.parallel.mesh import shard_map_unchecked
+
+    def body(U, V_local, codebooks, codesT_local, user_ids, rows_valid,
+             *rot):
+        Q = _mask_pad_rows(U[user_ids], rows_valid)
+        Qr = Q if not rotated else _rotate_query(Q, rot[0])
+        off = jax.lax.axis_index("shards") * local_n
+        # local scan, GLOBAL row ids + validity: pad rows (only the
+        # last shard's tail) come out at _NEG and never win the merge
+        _lv, li_ = ops.adc_shortlist(Qr, codebooks, codesT_local, kprime,
+                                     n_valid=n_items, col_offset=off)
+        gv = jax.lax.all_gather(_lv, "shards")        # (S, B, k′)
+        gi = jax.lax.all_gather(li_, "shards")
+        _mv, mi = ops.merge_shortlists(gv, gi, kprime)
+        part = ops.rerank_partial(Q, V_local, mi, off)
+        exact = jax.lax.psum(part, "shards")
+        # zero-padded V rows re-rank to 0.0 which would beat real _NEG
+        # candidates — push any pad candidate back below everything
+        exact = jnp.where(mi < n_items, exact, _NEG)
+        vals, loc = jax.lax.top_k(exact, k)
+        idx = jnp.take_along_axis(mi, loc, axis=1)
+        return jnp.concatenate([vals, idx.astype(jnp.float32)], axis=-1)
+
+    in_specs = [P(), P("shards", None), P(), P(None, "shards"), P(), P()]
+    if rotated:
+        in_specs.append(P())
+    # unchecked: the streamed ADC scan's lax.scan carries per-shard
+    # (varying) tiles, which the replication checker rejects without
+    # pvary annotations it cannot see through ops.adc_shortlist
+    sm = shard_map_unchecked(body, mesh, tuple(in_specs), P())
+    return jax.jit(sm)
+
+
+class ShardedANNScorer(ANNScorer):
+    """ANN scorer with the serving corpus partitioned item-wise over a
+    ``"shards"`` mesh axis: each device holds ``1/S`` of the PQ codes
+    and exact-rerank vectors, queries replicate, and one pjit'd
+    program runs scan → all-gather → merge → re-rank across the mesh.
+
+    This is how catalogs beyond one chip's HBM serve: per-device
+    residency is ``local_n · (m + 4·dim)`` bytes instead of
+    ``N · (m + 4·dim)``. Same external contract as ``ANNScorer``;
+    ``shards=1`` is bitwise identical to it (asserted in tests).
+    """
+
+    def __init__(self, U: np.ndarray, V: np.ndarray, index: PQIndex,
+                 shortlist: int = DEFAULT_SHORTLIST, *,
+                 shards: Optional[int] = None, mesh=None):
+        from predictionio_tpu.parallel.mesh import shards_mesh
+
+        if mesh is None:
+            if not shards or int(shards) < 1:
+                raise ValueError(
+                    "ShardedANNScorer needs shards >= 1 or an explicit mesh")
+            mesh = shards_mesh(int(shards))
+        if "shards" not in mesh.axis_names:
+            raise ValueError(
+                'sharded ANN serving mesh must carry a "shards" axis')
+        self.mesh = mesh
+        self.shards = int(mesh.shape["shards"])
+        super().__init__(U, V, index, shortlist=shortlist)
+
+    def _place(self, U, V, index: PQIndex) -> None:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from predictionio_tpu.parallel.mesh import pad_to_multiple
+        from predictionio_tpu.server import aot
+
+        #: padded per-device item rows — shard i owns global rows
+        #: [i·local_n, (i+1)·local_n); pad rows live only in the last
+        #: shard's tail and are masked by ``n_valid`` / the pad re-mask
+        self.local_n = pad_to_multiple(self.n_items, self.shards) \
+            // self.shards
+        # a shard can only nominate from its own rows, so k′ beyond
+        # local_n is meaningless (and would break the local top-k)
+        self.shortlist = max(1, min(self.shortlist, self.local_n))
+        n_pad = self.local_n * self.shards
+        rep = NamedSharding(self.mesh, P())
+        self._replicated = rep
+        Vp = np.asarray(V, np.float32)
+        codesT = np.ascontiguousarray(
+            np.asarray(index.codes, np.uint8).T)
+        if n_pad != self.n_items:
+            Vp = np.concatenate([Vp, np.zeros(
+                (n_pad - self.n_items, self.rank), np.float32)])
+            codesT = np.concatenate([codesT, np.zeros(
+                (self.m, n_pad - self.n_items), np.uint8)], axis=1)
+        self._U = jax.device_put(jnp.asarray(U, jnp.float32), rep)
+        self._V = jax.device_put(
+            jnp.asarray(Vp), NamedSharding(self.mesh, P("shards", None)))
+        self._codebooks = jax.device_put(
+            jnp.asarray(index.codebooks, jnp.float32), rep)
+        self._codesT = jax.device_put(
+            jnp.asarray(codesT), NamedSharding(self.mesh, P(None, "shards")))
+        self._rot = (None if index.rotation is None else jax.device_put(
+            jnp.asarray(index.rotation, jnp.float32), rep))
+        aot.record_shard_layout(self.shards, self.local_n, self.shortlist)
+
+    def _aot_key(self, B: int, k: int) -> tuple:
+        import jax
+
+        return ("ann_sharded_topk", self.n_users, self.rank, self.m,
+                self.K, self.n_items, self.local_n, self.shards, B, k,
+                self.shortlist, self._rot is not None,
+                jax.default_backend())
+
+    def _fn(self, k: int):
+        return _sharded_ann_jit(self.mesh, self.local_n, self.n_items,
+                                k, self.shortlist, self._rot is not None)
+
+    def _ensure_executable(self, B: int, k: int) -> bool:
+        import jax
+
+        from predictionio_tpu.server.aot import EXECUTABLES
+
+        key = self._aot_key(B, k)
+        was_cold = EXECUTABLES.get(key) is None
+
+        def build():
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            n_pad = self.local_n * self.shards
+            rep = self._replicated
+            rows = NamedSharding(self.mesh, P("shards", None))
+            cols = NamedSharding(self.mesh, P(None, "shards"))
+            sds = [
+                jax.ShapeDtypeStruct(
+                    (self.n_users, self.rank), np.float32, sharding=rep),
+                jax.ShapeDtypeStruct(
+                    (n_pad, self.rank), np.float32, sharding=rows),
+                jax.ShapeDtypeStruct(
+                    (self.m, self.K, self.rank // self.m), np.float32,
+                    sharding=rep),
+                jax.ShapeDtypeStruct((self.m, n_pad), np.uint8,
+                                     sharding=cols),
+                jax.ShapeDtypeStruct((B,), np.int32, sharding=rep),
+                jax.ShapeDtypeStruct((), np.int32, sharding=rep),
+            ]
+            if self._rot is not None:
+                sds.append(jax.ShapeDtypeStruct(
+                    (self.rank, self.rank), np.float32, sharding=rep))
+            return self._fn(k).lower(*sds).compile()
+
+        self._aot[(B, k)] = EXECUTABLES.get_or_compile(key, build)
+        return was_cold
+
+    def _topk(self, user_ids, k: int, rows: Optional[int] = None):
+        import time
+
+        import jax
+        import jax.numpy as jnp
+
+        from predictionio_tpu.server import aot
+        from predictionio_tpu.utils import tracing
+
+        B = len(user_ids)
+        rows_valid = np.int32(B if rows is None else rows)
+        prog = self._aot.get((B, k))
+        path = "ann" if prog is not None else "jit"
+        ids = jax.device_put(
+            jnp.asarray(np.asarray(user_ids, np.int32)), self._replicated)
+        rv = jax.device_put(jnp.asarray(rows_valid), self._replicated)
+        args = [self._U, self._V, self._codebooks, self._codesT, ids, rv]
+        if self._rot is not None:
+            args.append(self._rot)
+        with tracing.span("serving.device", bucket=B, k=k, path=path):
+            t0 = time.perf_counter()
+            fn = prog if prog is not None else self._fn(k)
+            packed = np.asarray(fn(*args))
+            out = packed[..., :k], packed[..., k:].astype(np.int32)
+            aot.record_device_latency(B, time.perf_counter() - t0, path,
+                                      trace_exemplar=tracing.exemplar())
+        return out
+
+
+def _resolve_shards(index: PQIndex, shards: int) -> int:
+    """Shard-count resolution: ``PIO_ANN_SHARDS`` env beats the
+    explicit argument beats the index blob's ``shards`` build hint."""
+    env = os.environ.get("PIO_ANN_SHARDS", "").strip()
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    if shards:
+        return int(shards)
+    try:
+        return int((index.meta or {}).get("shards") or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
 def maybe_ann_scorer(U, V, index: Optional[PQIndex], cached=None,
-                     shortlist: int = DEFAULT_SHORTLIST):
+                     shortlist: int = DEFAULT_SHORTLIST,
+                     shards: int = 0):
     """ANN twin of ``als.maybe_resident_scorer``: None (→ caller's
     exact/host path) when there is no index or the catalog is below
     ``_SERVE_MIN_ITEMS`` in auto mode; honors the same
     ``PIO_ALS_SERVE`` override and reuses ``cached`` only when built
-    from these exact U/V arrays."""
+    from these exact U/V arrays.
+
+    ``shards > 1`` (explicit, ``PIO_ANN_SHARDS``, or the index blob's
+    build hint) selects the mesh-sharded scorer; when the process has
+    fewer devices than shards it logs and degrades to the unsharded
+    scorer rather than failing the deploy.
+    """
+    import logging
+
     if index is None:
         return None
-    mode = os.environ.get("PIO_ALS_SERVE", "auto")
-    if mode == "host" or (mode == "auto"
-                          and V.shape[0] < _SERVE_MIN_ITEMS):
+    if not serve_on_device(V.shape[0]):
         return None
-    if (cached is not None and isinstance(cached, ANNScorer)
-            and cached.built_from(U, V) and cached.shortlist == shortlist):
+    want = _resolve_shards(index, shards)
+    if want > 1:
+        if (cached is not None and type(cached) is ShardedANNScorer
+                and cached.built_from(U, V)
+                and cached._want_shortlist == int(shortlist)
+                and cached.shards == want):
+            return cached
+        try:
+            return ShardedANNScorer(U, V, index, shortlist=shortlist,
+                                    shards=want)
+        except ValueError as e:
+            logging.getLogger("pio.ann").warning(
+                "sharded ANN serving unavailable (%s); serving unsharded",
+                e)
+    if (cached is not None and type(cached) is ANNScorer
+            and cached.built_from(U, V)
+            and cached._want_shortlist == int(shortlist)):
         return cached
     return ANNScorer(U, V, index, shortlist=shortlist)

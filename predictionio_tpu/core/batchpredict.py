@@ -11,6 +11,7 @@ device per dispatch.
 from __future__ import annotations
 
 import json
+import os
 from typing import Optional, TextIO
 
 from predictionio_tpu.core.workflow import DeployedEngine, prepare_deploy
@@ -24,7 +25,24 @@ def run_batch_predict(
     src: TextIO,
     out: TextIO,
     batch_size: int = BATCH,
+    shards: int = 0,
 ) -> int:
+    """``shards > 1`` runs the ANN-served templates over the
+    item-sharded retrieval mesh (``ann.scorer.ShardedANNScorer``):
+    scorers build lazily inside ``batch_predict``, so exporting
+    ``PIO_ANN_SHARDS`` for the duration of the run is the one hook
+    that reaches every engine variant without threading a parameter
+    through the template contract."""
+    if shards and int(shards) > 1:
+        prev = os.environ.get("PIO_ANN_SHARDS")
+        os.environ["PIO_ANN_SHARDS"] = str(int(shards))
+        try:
+            return run_batch_predict(deployed, src, out, batch_size)
+        finally:
+            if prev is None:
+                os.environ.pop("PIO_ANN_SHARDS", None)
+            else:
+                os.environ["PIO_ANN_SHARDS"] = prev
     n = 0
     batch = []
 

@@ -1082,21 +1082,32 @@ def _bucket_k(want: int) -> int:
 _SERVE_MIN_ITEMS = 2048
 
 
+def serve_on_device(n_items: int) -> bool:
+    """The device-vs-host serving policy shared by every scorer
+    selector (:func:`maybe_resident_scorer` and the ANN twin
+    ``ann.scorer.maybe_ann_scorer``): device-resident serving for
+    production-size catalogs (≥ ``_SERVE_MIN_ITEMS`` items), host
+    numpy below that, where a matvec beats a device dispatch and
+    tests/demos stay free of compile time. ``PIO_ALS_SERVE``
+    overrides: "host" forces the host path, "device" forces a
+    scorer."""
+    mode = os.environ.get("PIO_ALS_SERVE", "auto")
+    if mode == "host":
+        return False
+    return mode != "auto" or n_items >= _SERVE_MIN_ITEMS
+
+
 def maybe_resident_scorer(U, V, cached=None):
     """Serving-path policy shared by the ALS-family templates: a lazy
-    device-resident :class:`ResidentScorer` for production-size
-    catalogs (≥ ``_SERVE_MIN_ITEMS`` items), None (→ host numpy
-    scoring) below that, where a matvec beats a device dispatch and
-    tests/demos stay free of compile time. ``PIO_ALS_SERVE`` overrides:
-    "host" forces None, "device" forces a scorer. Pass the previous
-    return value as ``cached`` so the scorer is built once per model;
-    a cached scorer is reused only if it was built from these exact
-    U/V arrays (identity check) — a caller that retrains and swaps
-    factors gets a fresh scorer, never stale scores.
+    device-resident :class:`ResidentScorer` when
+    :func:`serve_on_device` says so, else None (→ host numpy scoring).
+    Pass the previous return value as ``cached`` so the scorer is
+    built once per model; a cached scorer is reused only if it was
+    built from these exact U/V arrays (identity check) — a caller that
+    retrains and swaps factors gets a fresh scorer, never stale
+    scores.
     """
-    mode = os.environ.get("PIO_ALS_SERVE", "auto")
-    if mode == "host" or (mode == "auto"
-                          and V.shape[0] < _SERVE_MIN_ITEMS):
+    if not serve_on_device(V.shape[0]):
         return None
     if cached is not None and cached.built_from(U, V):
         return cached

@@ -340,15 +340,24 @@ def two_tower_embed_users(user_variables, n_users: int,
 
 def two_tower_build_index(item_embeds: np.ndarray, m: int = 8, k: int = 256,
                           *, iters: int = 8, seed: int = 0,
-                          sample: int = 65536):
+                          sample: int = 65536, opq: bool = False,
+                          opq_iters: int = 4, shards: int = 0):
     """Build the PQ retrieval index over the materialized item table
     (ROADMAP item 3) — the `pio train`-time step that turns exact
     top-k serving into ADC-shortlist + re-rank at 10M+ corpora. Thin
     model-layer wrapper so templates depend on models/, not on the
     index internals; returns a :class:`predictionio_tpu.ann.PQIndex`
     (persisted inside the model artifact by the template's
-    ``save_model``)."""
+    ``save_model``).
+
+    ``opq=True`` trains an OPQ-style learned rotation before
+    quantization (versioned into the blob); ``shards > 1`` records the
+    intended serving-mesh width as a build hint that
+    ``maybe_ann_scorer`` picks up at deploy time."""
     from predictionio_tpu import ann
 
     return ann.build_index(np.asarray(item_embeds, np.float32), m, k,
-                           iters=iters, seed=seed, sample=sample)
+                           iters=iters, seed=seed, sample=sample,
+                           opq=opq, opq_iters=opq_iters,
+                           shards=(int(shards) if shards
+                                   and int(shards) > 1 else None))

@@ -15,6 +15,7 @@ backend (compiled on TPU, XLA elsewhere, interpret-mode in tests).
 from predictionio_tpu.ops.gram import rows_gram, rows_gram_xla
 from predictionio_tpu.ops.segment import segment_count, segment_mean, segment_sum
 from predictionio_tpu.ops.topk import (adc_scores, adc_shortlist,
+                                       merge_shortlists, rerank_partial,
                                        rerank_topk, score_topk,
                                        score_topk_xla)
 
@@ -41,7 +42,8 @@ def use_pallas(platform=None) -> bool:
 
 
 __all__ = [
-    "adc_scores", "adc_shortlist", "rerank_topk",
+    "adc_scores", "adc_shortlist", "merge_shortlists", "rerank_partial",
+    "rerank_topk",
     "rows_gram", "rows_gram_xla", "score_topk", "score_topk_xla",
     "segment_sum", "segment_count", "segment_mean", "use_pallas",
 ]

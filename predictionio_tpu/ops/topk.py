@@ -148,7 +148,8 @@ def adc_scores(Q, codebooks, codesT):
 
 
 def adc_shortlist(Q, codebooks, codesT, kprime: int,
-                  chunk: int = _ADC_CHUNK):
+                  chunk: int = _ADC_CHUNK, *, n_valid: int = 0,
+                  col_offset=None):
     """Top-``kprime`` shortlist by ADC score → (vals, idx (B, k′) i32).
 
     Streams the corpus in ``chunk``-column tiles: each
@@ -159,14 +160,31 @@ def adc_shortlist(Q, codebooks, codesT, kprime: int,
     (B, N) score matrix is never materialized — the live set is
     (B, chunk), so a 10M-item scan holds steady at megabytes where the
     dense scan needs gigabytes of HBM per batch.
+
+    Sharded serving runs this per mesh shard on a contiguous column
+    block of the global code matrix: ``col_offset`` (traced scalar ok —
+    it is ``axis_index * local_n`` inside shard_map) is added to the
+    returned indices so they are GLOBAL corpus rows, and ``n_valid``
+    (static, global real item count) masks pad columns past the corpus
+    tail. Defaults leave the single-device path byte-identical.
     """
     m = codesT.shape[0]
     N = codesT.shape[1]
     B = Q.shape[0]
     lut = _adc_lut(Q, codebooks)
     if N <= 2 * chunk or kprime > chunk:   # small corpus: one dense tile
-        vals, idx = jax.lax.top_k(_adc_sum(lut, codesT), kprime)
-        return vals, idx.astype(jnp.int32)
+        s = _adc_sum(lut, codesT)
+        if n_valid or col_offset is not None:
+            col = jnp.arange(N, dtype=jnp.int32)[None, :]
+            if col_offset is not None:
+                col = col + col_offset
+            if n_valid:
+                s = jnp.where(col < n_valid, s, _NEG)
+        vals, idx = jax.lax.top_k(s, kprime)
+        idx = idx.astype(jnp.int32)
+        if col_offset is not None:
+            idx = idx + col_offset
+        return vals, idx
     n_tiles = -(-N // chunk)
     pad = n_tiles * chunk - N
     ct = codesT
@@ -174,20 +192,69 @@ def adc_shortlist(Q, codebooks, codesT, kprime: int,
         ct = jnp.concatenate([ct, jnp.zeros((m, pad), ct.dtype)], axis=1)
     ct = jnp.moveaxis(ct.reshape(m, n_tiles, chunk), 1, 0)  # (T, m, chunk)
     starts = jnp.arange(n_tiles, dtype=jnp.int32) * chunk
+    if not n_valid:
+        local_valid = N              # mask only the chunk-pad tail
+    elif col_offset is None:
+        local_valid = n_valid
+    else:
+        local_valid = n_valid - col_offset   # global bound, local columns
 
     def tile_step(carry, xs):
         codes, start = xs
         s = _adc_sum(lut, codes)                            # (B, chunk)
         col = start + jnp.arange(chunk, dtype=jnp.int32)
-        s = jnp.where((col < N)[None, :], s, _NEG)          # tail padding
+        s = jnp.where((col < local_valid)[None, :], s, _NEG)  # tail padding
         v, i = jax.lax.top_k(s, kprime)
-        return carry, (v, (i + start).astype(jnp.int32))
+        i = i + start
+        if col_offset is not None:
+            i = i + col_offset
+        return carry, (v, i.astype(jnp.int32))
 
     _, (tv, ti) = jax.lax.scan(tile_step, 0, (ct, starts))
     tv = jnp.moveaxis(tv, 0, 1).reshape(B, n_tiles * kprime)
     ti = jnp.moveaxis(ti, 0, 1).reshape(B, n_tiles * kprime)
     vals, loc = jax.lax.top_k(tv, kprime)
     return vals, jnp.take_along_axis(ti, loc, axis=1)
+
+
+def merge_shortlists(vals, idx, kprime: int):
+    """Distributed top-k′ merge: (S, B, k′) per-shard shortlists (as
+    produced by ``all_gather`` over the ``shards`` axis) → global
+    (B, k′) (vals, idx).
+
+    A small dense top-k over the (k′ · S) gathered candidates — every
+    global winner won its own shard, so this equals a top-k′ over the
+    full dense ADC scores. With S=1 the input is already sorted and
+    ``lax.top_k`` (stable, lowest-index tie-break) returns it
+    unchanged, which is what keeps the one-shard program bitwise equal
+    to the single-device scorer.
+    """
+    S, B, kp = vals.shape
+    v = jnp.moveaxis(vals, 0, 1).reshape(B, S * kp)
+    i = jnp.moveaxis(idx, 0, 1).reshape(B, S * kp)
+    mv, loc = jax.lax.top_k(v, kprime)
+    return mv, jnp.take_along_axis(i, loc, axis=1)
+
+
+def rerank_partial(Q, V_local, idx, col_offset):
+    """This shard's contribution to the exact re-rank of a GLOBAL
+    candidate list: scores the candidates whose corpus row lives in
+    this shard's ``V_local`` block (rows [col_offset, col_offset +
+    local_n)), zero elsewhere — a ``psum`` over the ``shards`` axis
+    assembles the full exact scores without ever gathering V.
+
+    Pure per-shard math (no collectives — the caller owns the mesh);
+    out-of-shard rows clip to a valid local row and are masked to 0.0,
+    so every shard does identical work (no divergent gathers).
+    """
+    local_n = V_local.shape[0]
+    own = (idx >= col_offset) & (idx < col_offset + local_n)
+    lrow = jnp.clip(idx - col_offset, 0, local_n - 1)
+    Vs = V_local[lrow]                                      # (B, k', d)
+    exact = jnp.einsum("bd,bqd->bq", Q, Vs,
+                       preferred_element_type=jnp.float32,
+                       precision=jax.lax.Precision.HIGHEST)
+    return jnp.where(own, exact, 0.0)
 
 
 def rerank_topk(Q, V, shortlist_idx, k: int):

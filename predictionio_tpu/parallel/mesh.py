@@ -13,6 +13,10 @@ Axis conventions used across the framework:
   two-tower batch shards)
 - ``"model"`` — parameter-parallel axis (sharded embedding tables /
   factor matrices when they outgrow one chip's HBM)
+- ``"shards"`` — item-parallel retrieval axis: the ANN serving corpus
+  (PQ codes + exact-rerank vectors) partitioned item-wise across
+  devices (``ann/scorer.ShardedANNScorer``, sharded ``pio
+  batchpredict``); queries replicate, shortlists all-gather + merge
 
 Single-process multi-chip and multi-host (``jax.distributed``) both
 yield the same mesh; tests force 8 virtual CPU devices (conftest).
@@ -79,6 +83,17 @@ def make_mesh(config: Optional[MeshConfig] = None, devices: Optional[Sequence[An
     return Mesh(grid, tuple(axes.keys()))
 
 
+def shards_mesh(shards: int, devices: Optional[Sequence[Any]] = None):
+    """1-D mesh over the ``shards`` axis — the item-parallel layout of
+    sharded ANN serving and sharded batchpredict. Honors
+    ``PIO_MESH_PLATFORM`` like :func:`make_mesh`; raises when fewer
+    than ``shards`` devices are available (an undersized retrieval
+    mesh would silently change the serving corpus layout — callers
+    that can degrade choose to, this helper never does)."""
+    return make_mesh(MeshConfig(axes={"shards": int(shards)},
+                                allow_smaller=False), devices)
+
+
 def platform_devices(platform: Optional[str] = None):
     """``jax.devices(platform)`` that tolerates an unavailable default
     backend.
@@ -112,14 +127,40 @@ def get_shard_map():
         return shard_map
 
 
+def has_vma() -> bool:
+    """True when this jax tracks replication in the type system
+    (pvary/pcast exist); False on pre-vma jax (< 0.5), whose set-based
+    shard_map replication inference rejects scan carries the
+    annotations would fix."""
+    import jax
+
+    return hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")
+
+
+def shard_map_unchecked(f, mesh, in_specs, out_specs):
+    """``shard_map`` with the replication checker off, tolerant of the
+    ``check_rep`` → ``check_vma`` kwarg rename across jax versions."""
+    sm = get_shard_map()
+    try:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+    except TypeError:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+
+
 def pvary(x, axis: str):
     """Mark ``x`` varying over ``axis`` (vma typing for scan/fori carries
-    inside shard_map). pcast on new jax, pvary on older."""
+    inside shard_map). pcast on new jax, pvary on older; on pre-vma
+    jax (< 0.5, neither exists) replication is not tracked in the type
+    system at all and the annotation is a no-op."""
     import jax
 
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axis, to="varying")
-    return jax.lax.pvary(x, axis)
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axis)
+    return x
 
 
 def replicated(mesh) -> Any:

@@ -234,6 +234,32 @@ def record_device_latency(bucket: int, seconds: float, path: str,
     _DISPATCHES.inc(labels)
 
 
+#: sharded-ANN serving layout (ann/scorer.ShardedANNScorer): shard
+#: count of the serving mesh, padded item rows resident per device,
+#: and the (k′ · shards) width of the distributed top-k merge — the
+#: three numbers that size per-device HBM and the collective
+#: (docs/observability.md; `pio index status --shards` predicts them
+#: from the manifest alone).
+ANN_SHARDS = REGISTRY.gauge(
+    "pio_ann_shard_count",
+    "Item shards in the sharded ANN serving mesh (0 = unsharded)")
+ANN_SHARD_ITEMS = REGISTRY.gauge(
+    "pio_ann_shard_items_per_device",
+    "Padded item rows resident per device under sharded ANN serving")
+ANN_SHARD_MERGE = REGISTRY.gauge(
+    "pio_ann_shard_merge_candidates",
+    "Distributed shortlist-merge width (k' x shards) per query row")
+
+
+def record_shard_layout(shards: int, items_per_device: int,
+                        shortlist: int) -> None:
+    """Publish the sharded-ANN serving layout (called once per scorer
+    construction, not per dispatch — layout only changes on /reload)."""
+    ANN_SHARDS.set(shards)
+    ANN_SHARD_ITEMS.set(items_per_device)
+    ANN_SHARD_MERGE.set(shortlist * shards)
+
+
 def device_p50_ms_by_bucket(path: str = "aot") -> Dict[str, float]:
     """Approximate per-bucket p50 (ms) from the histogram buckets —
     the ``predict_p50_device_ms`` series bench.py / profile_serving.py

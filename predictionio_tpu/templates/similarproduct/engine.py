@@ -137,15 +137,57 @@ class ALSAlgorithmParams:
     lambda_: float = 0.01
     alpha: float = 1.0
     seed: Optional[int] = None
+    # -- approximate item-to-item retrieval (predictionio_tpu/ann):
+    # builds the PQ index over the NORMALIZED item factors at train
+    # time, so the ADC scan + exact re-rank computes cosine directly.
+    # engine.json spelling: ann, annM, annK, annShortlist, annShards.
+    ann: bool = False
+    ann_m: int = 5            # subspaces (must divide rank)
+    ann_k: int = 256          # centroids per subspace
+    ann_shortlist: int = 128  # k′ re-rank candidates
+    ann_shards: int = 0       # serving-mesh width hint (> 1 = sharded)
 
 
 class SimilarProductModel:
     def __init__(self, V: np.ndarray, item_ids: BiMap,
-                 item_categories: Dict[str, List[str]]) -> None:
+                 item_categories: Dict[str, List[str]],
+                 ann_index=None, ann_shortlist: int = 128,
+                 ann_shards: int = 0) -> None:
         self.V = V
         self.item_ids = item_ids
         self._inv = item_ids.inverse()
         self.item_categories = item_categories
+        self.ann_index = ann_index
+        self.ann_shortlist = ann_shortlist
+        self.ann_shards = ann_shards
+        self._Vn: Optional[np.ndarray] = None
+        self._scorer = None
+
+    def _normalized(self) -> np.ndarray:
+        if self._Vn is None:
+            norms = np.linalg.norm(self.V, axis=1, keepdims=True)
+            self._Vn = (self.V / np.maximum(norms, 1e-12)).astype(
+                np.float32)
+        return self._Vn
+
+    def _device_scorer(self):
+        """Lazy ANN scorer over the normalized corpus with itself as
+        the query table: ``U[i] · V[j] = cos(v_i, v_j)``, so a
+        single-liked-item query is ONE ADC-shortlist dispatch — the
+        same serving program (sharded or not) as the user-to-item
+        templates. Multi-item queries keep the host mean-direction
+        path (`models/als.similar_items`)."""
+        if self.ann_index is None:
+            return None
+        from predictionio_tpu.ann import maybe_ann_scorer
+
+        Vn = self._normalized()
+        s = maybe_ann_scorer(Vn, Vn, self.ann_index, self._scorer,
+                             shortlist=self.ann_shortlist,
+                             shards=self.ann_shards)
+        if s is not None:
+            self._scorer = s
+        return s
 
     def query(self, items: List[str], num: int,
               categories: Optional[List[str]] = None,
@@ -156,8 +198,13 @@ class SimilarProductModel:
         if idxs.size == 0:
             return []
         # over-fetch so post-filters still fill `num`
-        top, scores = similar_items(self.V, idxs, min(len(self.item_ids),
-                                                      num + idxs.size + 50))
+        fetch = min(len(self.item_ids), num + idxs.size + 50)
+        scorer = self._device_scorer() if idxs.size == 1 else None
+        if scorer is not None:
+            top, scores = scorer.recommend(int(idxs[0]), fetch,
+                                           exclude=idxs)
+        else:
+            top, scores = similar_items(self.V, idxs, fetch)
         cats = set(categories or [])
         white = set(white_list or [])
         black = set(black_list or [])
@@ -201,6 +248,22 @@ class ALSAlgorithm(Algorithm):
                          reg=p.lambda_, implicit=True, alpha=p.alpha,
                          seed=0 if p.seed is None else p.seed)
 
+    @staticmethod
+    def _maybe_index(V: np.ndarray, p: ALSAlgorithmParams):
+        """PQ index over the NORMALIZED factors (cosine = inner
+        product there); None when ANN is off or the rank doesn't split
+        into ``ann_m`` subspaces."""
+        if not p.ann:
+            return None
+        from predictionio_tpu import ann
+
+        norms = np.linalg.norm(V, axis=1, keepdims=True)
+        Vn = (V / np.maximum(norms, 1e-12)).astype(np.float32)
+        return ann.build_index(
+            Vn, p.ann_m, min(p.ann_k, max(2, V.shape[0])),
+            shards=(int(p.ann_shards) if p.ann_shards
+                    and int(p.ann_shards) > 1 else None))
+
     @classmethod
     def train_many(cls, ctx: WorkflowContext, pd: TrainingData,
                    params_list) -> List[SimilarProductModel]:
@@ -212,14 +275,20 @@ class ALSAlgorithm(Algorithm):
         coo = cls._to_coo(pd)
         results = als_train_many(
             coo, [cls._als_params(p) for p in params_list], mesh=ctx.mesh)
-        return [SimilarProductModel(V, pd.item_ids, pd.item_categories)
-                for _, V in results]
+        return [SimilarProductModel(V, pd.item_ids, pd.item_categories,
+                                    ann_index=cls._maybe_index(V, p),
+                                    ann_shortlist=p.ann_shortlist,
+                                    ann_shards=p.ann_shards)
+                for p, (_, V) in zip(params_list, results)]
 
     def train(self, ctx: WorkflowContext, pd: TrainingData) -> SimilarProductModel:
         p: ALSAlgorithmParams = self.params
         _, V = als_train(self._to_coo(pd), self._als_params(p),
                          mesh=ctx.mesh)
-        return SimilarProductModel(V, pd.item_ids, pd.item_categories)
+        return SimilarProductModel(V, pd.item_ids, pd.item_categories,
+                                   ann_index=self._maybe_index(V, p),
+                                   ann_shortlist=p.ann_shortlist,
+                                   ann_shards=p.ann_shards)
 
     def predict(self, model: SimilarProductModel, query: Dict[str, Any]) -> Dict[str, Any]:
         return {"itemScores": model.query(
@@ -233,17 +302,41 @@ class ALSAlgorithm(Algorithm):
     def save_model(self, model: SimilarProductModel, instance_dir: Optional[str]) -> bytes:
         buf = io.BytesIO()
         np.savez_compressed(buf, V=model.V)
-        return pickle.dumps({
+        d = {
             "npz": buf.getvalue(),
             "item_ids": model.item_ids.to_dict(),
             "cats": model.item_categories,
-        })
+            "ann_shortlist": model.ann_shortlist,
+            "ann_shards": model.ann_shards,
+        }
+        # same persistence contract as the twotower template: wire
+        # bytes inside the blob, plus the fsck-auditable sidecar
+        # layout when the model store has a real directory
+        if model.ann_index is not None:
+            from predictionio_tpu import ann
+
+            d["ann_index"] = model.ann_index.to_bytes()
+            if instance_dir:
+                ann.save_index(model.ann_index, instance_dir)
+        return pickle.dumps(d)
 
     def load_model(self, blob: Optional[bytes], instance_dir: Optional[str]) -> SimilarProductModel:
         assert blob is not None
         d = pickle.loads(blob)
         arrs = np.load(io.BytesIO(d["npz"]))
-        return SimilarProductModel(arrs["V"], BiMap(d["item_ids"]), d["cats"])
+        ann_index = None
+        if instance_dir:
+            from predictionio_tpu import ann
+
+            ann_index = ann.load_index(instance_dir)
+        if ann_index is None and d.get("ann_index") is not None:
+            from predictionio_tpu.ann import PQIndex
+
+            ann_index = PQIndex.from_bytes(d["ann_index"])
+        return SimilarProductModel(arrs["V"], BiMap(d["item_ids"]),
+                                   d["cats"], ann_index=ann_index,
+                                   ann_shortlist=d.get("ann_shortlist", 128),
+                                   ann_shards=d.get("ann_shards", 0))
 
 
 def engine_factory() -> Engine:

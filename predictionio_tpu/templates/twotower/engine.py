@@ -132,13 +132,21 @@ class TTAlgorithmParams:
     ann_iters: int = 8        # Lloyd iterations
     ann_shortlist: int = 128  # k′ re-rank candidates (recall knob)
     ann_sample: int = 65536   # codebook training sample bound
+    # OPQ learned rotation before quantization (engine.json annOpq) —
+    # better recall at the same code bytes; versions the blob to v2
+    ann_opq: bool = False
+    # serving-mesh width hint (engine.json annShards): > 1 partitions
+    # codes + rerank vectors item-wise over a "shards" mesh axis at
+    # deploy time (docs/perf.md "Sharded retrieval")
+    ann_shards: int = 0
 
 
 class TwoTowerModel:
     def __init__(self, user_vars, item_embeds: np.ndarray, user_ids: BiMap,
                  item_ids: BiMap, params: TwoTowerParams,
                  user_embeds: Optional[np.ndarray] = None,
-                 ann_index=None, ann_shortlist: int = 128) -> None:
+                 ann_index=None, ann_shortlist: int = 128,
+                 ann_shards: int = 0) -> None:
         self.user_vars = user_vars
         self.item_embeds = item_embeds
         self.user_ids = user_ids
@@ -155,6 +163,9 @@ class TwoTowerModel:
         #: ADC-shortlist + exact re-rank instead of a full-corpus scan
         self.ann_index = ann_index
         self.ann_shortlist = ann_shortlist
+        #: serving-mesh width (0 = unsharded / follow the index blob's
+        #: build hint); resolved by ``maybe_ann_scorer``
+        self.ann_shards = ann_shards
         self._scorer = None
 
     def _device_scorer(self):
@@ -172,7 +183,8 @@ class TwoTowerModel:
 
             s = maybe_ann_scorer(self.user_embeds, self.item_embeds,
                                  self.ann_index, self._scorer,
-                                 shortlist=self.ann_shortlist)
+                                 shortlist=self.ann_shortlist,
+                                 shards=self.ann_shards)
             if s is not None:
                 self._scorer = s
                 return s
@@ -249,10 +261,12 @@ class TwoTowerAlgorithm(Algorithm):
 
             ann_index = two_tower_build_index(
                 item_embeds, m=p.ann_m, k=p.ann_k, iters=p.ann_iters,
-                seed=p.seed, sample=p.ann_sample)
+                seed=p.seed, sample=p.ann_sample, opq=p.ann_opq,
+                shards=p.ann_shards)
         return TwoTowerModel(uv, item_embeds, user_ids, item_ids, tp,
                              user_embeds=user_embeds, ann_index=ann_index,
-                             ann_shortlist=p.ann_shortlist)
+                             ann_shortlist=p.ann_shortlist,
+                             ann_shards=p.ann_shards)
 
     def predict(self, model: TwoTowerModel, query: Dict[str, Any]) -> Dict[str, Any]:
         return {"itemScores": model.recommend(str(query["user"]),
@@ -298,6 +312,7 @@ class TwoTowerAlgorithm(Algorithm):
             "item_ids": model.item_ids.to_dict(),
             "params": model.params,
             "ann_shortlist": model.ann_shortlist,
+            "ann_shards": model.ann_shards,
         }
         if model.ann_index is not None:
             from predictionio_tpu import ann
@@ -331,7 +346,8 @@ class TwoTowerAlgorithm(Algorithm):
                                  d["user_vars"], len(user_ids),
                                  d["params"]),
                              ann_index=ann_index,
-                             ann_shortlist=d.get("ann_shortlist", 128))
+                             ann_shortlist=d.get("ann_shortlist", 128),
+                             ann_shards=d.get("ann_shards", 0))
 
 
 def engine_factory() -> Engine:

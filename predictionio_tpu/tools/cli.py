@@ -769,11 +769,22 @@ def cmd_index(args: argparse.Namespace) -> None:
                                  else "unchecksummed")
             else:
                 digest_status = "MISMATCH"
-        found.append({"algorithm": algo, "digest_status": digest_status,
-                      **{k: man.get(k) for k in (
-                          "m", "k", "dsub", "dim", "n_items", "code_bytes",
-                          "codebook_bytes", "hbm_estimate_bytes",
-                          "build_sec", "built_unix", "sha256")}})
+        entry = {"algorithm": algo, "digest_status": digest_status,
+                 **{k: man.get(k) for k in (
+                     "m", "k", "dsub", "dim", "n_items", "code_bytes",
+                     "codebook_bytes", "rotation_bytes",
+                     "hbm_estimate_bytes", "shards",
+                     "build_sec", "built_unix", "sha256")}}
+        # per-shard layout math from the manifest alone (ann package
+        # root is jax-free by design — safe on an ops box): size a
+        # candidate serving mesh before any deploy touches a chip
+        want_shards = int(getattr(args, "shards", 0) or 0) \
+            or int(man.get("shards") or 0)
+        if want_shards > 1 and man.get("n_items") is not None:
+            from predictionio_tpu.ann.index import shard_view
+
+            entry["shard_view"] = shard_view(man, want_shards)
+        found.append(entry)
     doc = {"engineInstanceId": iid, "instanceDir": instance_dir,
            "indexes": found}
     if args.json:
@@ -799,6 +810,16 @@ def cmd_index(args: argparse.Namespace) -> None:
               f"codebooks {_human_bytes(ix['codebook_bytes'])}")
         print(f"        HBM est.   {_human_bytes(ix['hbm_estimate_bytes'])} "
               "(codes + codebooks + re-rank floats)")
+        sv = ix.get("shard_view")
+        if sv:
+            print(f"        sharded    {sv['shards']}-way mesh: "
+                  f"{sv['rows_per_shard']:,} rows/device "
+                  f"({sv['padded_items'] - ix['n_items']} pad), "
+                  f"codes {_human_bytes(sv['code_bytes_per_shard'])}/dev, "
+                  f"rerank {_human_bytes(sv['rerank_bytes_per_shard'])}/dev")
+            print(f"        HBM/device {_human_bytes(sv['hbm_per_device_bytes'])} "
+                  f"(+ {_human_bytes(sv['replicated_bytes'])} replicated "
+                  "codebooks/rotation)")
         built = ix.get("built_unix")
         when = (datetime.fromtimestamp(built, timezone.utc)
                 .strftime("%Y-%m-%d %H:%M:%SZ") if built else "?")
@@ -861,7 +882,9 @@ def cmd_batchpredict(args: argparse.Namespace) -> None:
                               variant_id=str(variant.get("id", "")))
     with open(args.input, "r", encoding="utf-8") as src, \
          open(args.output, "w", encoding="utf-8") as out:
-        n = run_batch_predict(deployed, src, out, batch_size=args.batch_size)
+        n = run_batch_predict(deployed, src, out,
+                              batch_size=args.batch_size,
+                              shards=getattr(args, "shards", 0))
     print(f"[info] Batch predicted {n} queries → {args.output}")
 
 
@@ -1713,6 +1736,10 @@ def build_parser() -> argparse.ArgumentParser:
     bp.add_argument("--output", required=True)
     bp.add_argument("--engine-instance-id")
     bp.add_argument("--batch-size", type=int, default=1024)
+    bp.add_argument("--shards", type=int, default=0,
+                    help="serve ANN-indexed engines over an N-way "
+                         "item-sharded retrieval mesh (needs >= N "
+                         "devices; docs/perf.md \"Sharded retrieval\")")
     bp.set_defaults(fn=cmd_batchpredict)
 
     ex = sub.add_parser("export", help="export events to JSONL")
@@ -1820,6 +1847,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "COMPLETED one")
     x.add_argument("--json", action="store_true",
                    help="emit the full report as one JSON document")
+    x.add_argument("--shards", type=int, default=0,
+                   help="also print the per-shard layout (rows, code "
+                        "bytes, per-device HBM) for an N-way serving "
+                        "mesh — pure manifest math, still jax-free")
     ix.set_defaults(fn=cmd_index)
 
     sg = sub.add_parser(

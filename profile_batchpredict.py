@@ -24,12 +24,84 @@ import time
 import numpy as np
 
 
+def _run_shards_ab(args) -> None:
+    """Chip-free sharded-vs-unsharded serving A/B on ``--shards N``
+    virtual CPU devices: the same ANN corpus + PQ index served through
+    the single-device ``ANNScorer`` and the mesh-sharded
+    ``ShardedANNScorer``, rows/s each, ONE JSON line. Proves the
+    distributed scan + top-k-merge program end to end (result parity
+    asserted) without a chip; absolute CPU throughput is not the
+    point — layout and correctness are."""
+    import time
+
+    from profile_common import force_host_devices
+
+    force_host_devices(args.shards)
+    import jax  # noqa: F401  (after the device-count flag)
+
+    jax.config.update("jax_platforms", "cpu")
+    from predictionio_tpu import ann
+    from predictionio_tpu.ann.scorer import ANNScorer, ShardedANNScorer
+    from predictionio_tpu.server.aot import BucketLadder
+
+    rng = np.random.default_rng(0)
+    n_items, dim, n_users = args.ann_items, 64, 32_768
+    centers = rng.normal(size=(max(16, n_items // 128), dim)).astype(
+        np.float32)
+    V = (centers[rng.integers(0, len(centers), n_items)]
+         + 0.25 * rng.normal(size=(n_items, dim))).astype(np.float32)
+    V /= np.linalg.norm(V, axis=1, keepdims=True)
+    U = rng.normal(size=(n_users, dim)).astype(np.float32)
+    index = ann.build_index(V, m=8, k=256, iters=4, sample=65_536)
+
+    B, k = args.batch, 16
+    ladder = BucketLadder([B])
+    ids = rng.integers(0, n_users, args.queries).astype(np.int32)
+    results = {}
+    parity = {}
+    for label, scorer in (
+            ("unsharded", ANNScorer(U, V, index)),
+            ("sharded", ShardedANNScorer(U, V, index,
+                                         shards=args.shards))):
+        scorer.warm_buckets(ladder, ks=(k,))
+        out = scorer.recommend_batch(ids[:B], k)  # warm dispatch
+        parity[label] = np.concatenate([iv for iv, _ in out])
+        t0 = time.perf_counter()
+        for lo in range(0, len(ids) - B + 1, B):
+            scorer.recommend_batch(ids[lo:lo + B], k)
+        wall = time.perf_counter() - t0
+        served = (len(ids) // B) * B
+        results[label] = round(served / wall, 1)
+    assert np.array_equal(parity["unsharded"], parity["sharded"]), (
+        "sharded serving returned different items than unsharded")
+    print(json.dumps({
+        "metric": "batchpredict_sharded_ab",
+        "shards": args.shards,
+        "n_items": n_items,
+        "batch_size": B,
+        "rows_per_sec_unsharded": results["unsharded"],
+        "rows_per_sec_sharded": results["sharded"],
+        "parity": True,
+    }))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--queries", type=int, default=1_000_000)
     ap.add_argument("--batch", type=int, default=1024)
     ap.add_argument("--platform", default="cpu")
+    ap.add_argument("--shards", type=int, default=0,
+                    help="run the sharded-vs-unsharded ANN serving A/B "
+                         "on N virtual CPU devices instead of the "
+                         "batchpredict scale test")
+    ap.add_argument("--ann-items", type=int, default=200_000,
+                    help="A/B corpus size (--shards mode)")
     args = ap.parse_args()
+
+    if args.shards and args.shards > 1:
+        args.queries = min(args.queries, 16_384)
+        _run_shards_ab(args)
+        return
 
     from profile_common import make_memory_storage, resolve_platform
 

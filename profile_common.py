@@ -35,6 +35,27 @@ def resolve_platform(platform: str):
     return jax
 
 
+def force_host_devices(n: int):
+    """Expose ``n`` virtual CPU devices for chip-free mesh runs
+    (sharded ANN A/Bs, dryruns). XLA reads the flag at backend init,
+    so this MUST run before the first ``import jax`` anywhere in the
+    process — same discipline as ``__graft_entry__.dryrun_multichip``."""
+    import os
+    import re
+    import sys
+
+    if "jax" in sys.modules:
+        raise SystemExit(
+            "force_host_devices must run before jax is imported "
+            "(XLA reads --xla_force_host_platform_device_count at "
+            "backend init)")
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   flags).strip()
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={int(n)}".strip())
+
+
 def make_memory_storage():
     """A fresh all-in-memory Storage installed as process default."""
     from predictionio_tpu.data.events import MemoryEventStore

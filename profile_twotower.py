@@ -87,6 +87,29 @@ def _run_ann(args, jax) -> None:
     exact.warm_buckets(ladder, ks=(10,))
     approx.warm_buckets(ladder, ks=(10,))
 
+    sharded = shard1_parity = None
+    if getattr(args, "shards", 0) and args.shards > 1:
+        # the mesh-sharded serving path under the SAME acceptance
+        # harness: recall vs exact, device p50, zero-compile audit —
+        # plus a shards=1 scorer asserted BITWISE equal to the
+        # single-device ANN program (degenerate collectives must not
+        # perturb a single result bit)
+        sharded = ann.ShardedANNScorer(U, V, index,
+                                       shortlist=args.ann_shortlist,
+                                       shards=args.shards)
+        sharded.warm_buckets(ladder, ks=(10,))
+        s1 = ann.ShardedANNScorer(U, V, index,
+                                  shortlist=args.ann_shortlist, shards=1)
+        s1.warm_buckets(ladder, ks=(10,))
+        pids = np.arange(B, dtype=np.int32)
+        bv, bi = approx._topk(pids, 10)
+        sv, si = s1._topk(pids, 10)
+        shard1_parity = bool(np.array_equal(bv, sv)
+                             and np.array_equal(bi, si))
+        print(f"sharded mesh: {sharded.shards}x{sharded.local_n} rows, "
+              f"shard1_parity={shard1_parity}", file=sys.stderr,
+              flush=True)
+
     def jit_gaps():
         return sum(v for key, v in aot_mod._DISPATCHES._values.items()
                    if key[1] == "jit")
@@ -94,11 +117,13 @@ def _run_ann(args, jax) -> None:
     # one unmeasured dispatch per path past warmup (first-touch layout)
     exact.recommend_batch(np.arange(B, dtype=np.int32), 10)
     approx.recommend_batch(np.arange(B, dtype=np.int32), 10)
+    if sharded is not None:
+        sharded.recommend_batch(np.arange(B, dtype=np.int32), 10)
 
     compiles0 = aot_mod.EXECUTABLES.counts().get("compile", 0)
     gaps0 = jit_gaps()
-    hits = 0
-    exact_lat, ann_lat = [], []
+    hits = sharded_hits = 0
+    exact_lat, ann_lat, sharded_lat = [], [], []
     for rep in range(args.repeats):
         for s in range(0, nq, B):
             uids = np.arange(s, s + B, dtype=np.int32)
@@ -108,9 +133,16 @@ def _run_ann(args, jax) -> None:
             t0 = time.perf_counter()
             ar = approx.recommend_batch(uids, 10)
             ann_lat.append(time.perf_counter() - t0)
+            if sharded is not None:
+                t0 = time.perf_counter()
+                sr = sharded.recommend_batch(uids, 10)
+                sharded_lat.append(time.perf_counter() - t0)
             if rep == 0:
                 for (ei, _), (ai, _) in zip(er, ar):
                     hits += np.intersect1d(ei, ai).size
+                if sharded is not None:
+                    for (ei, _), (si_, _) in zip(er, sr):
+                        sharded_hits += np.intersect1d(ei, si_).size
     # any compile (AOT cache miss OR jit-path dispatch) during the
     # serving sweep is a warmup gap — the acceptance bar is zero
     compiles = ((aot_mod.EXECUTABLES.counts().get("compile", 0)
@@ -120,9 +152,20 @@ def _run_ann(args, jax) -> None:
     # p50s are also reported but their geometric buckets are coarse
     exact_p50 = float(np.percentile(exact_lat, 50)) * 1e3
     ann_p50 = float(np.percentile(ann_lat, 50)) * 1e3
+    sharded_fields = {}
+    if sharded is not None:
+        sharded_p50 = float(np.percentile(sharded_lat, 50)) * 1e3
+        sharded_fields = {
+            "shards": sharded.shards,
+            "rows_per_shard": sharded.local_n,
+            "sharded_recall_at_10": round(sharded_hits / (nq * 10), 4),
+            "sharded_p50_device_ms": round(sharded_p50, 4),
+            "shard1_parity": shard1_parity,
+        }
     print(json.dumps({
         "metric": "ann_recall_latency",
         "recall_at_10": round(hits / (nq * 10), 4),
+        **sharded_fields,
         "n_items": n, "dim": d, "m": index.m,
         "k_per_subspace": index.k, "shortlist": approx.shortlist,
         "queries": nq, "bucket": B, "repeats": args.repeats,
@@ -169,10 +212,23 @@ def main() -> None:
     ap.add_argument("--ann-queries", type=int, default=1024)
     ap.add_argument("--ann-iters", type=int, default=4)
     ap.add_argument("--ann-sample", type=int, default=65536)
+    ap.add_argument("--shards", type=int, default=0,
+                    help="with --ann: also serve through the N-way "
+                         "mesh-sharded scorer (N virtual CPU devices "
+                         "when no multichip backend; implies "
+                         "--platform cpu unless set) and assert "
+                         "shards=1 bitwise parity")
     args = ap.parse_args()
     hidden = tuple(int(h) for h in args.hidden.split(",") if h)
 
-    from profile_common import resolve_platform
+    from profile_common import force_host_devices, resolve_platform
+
+    if args.ann and args.shards and args.shards > 1:
+        # XLA reads the virtual-device-count flag at backend init —
+        # must precede the first jax import (resolve_platform)
+        force_host_devices(args.shards)
+        if not args.platform:
+            args.platform = "cpu"
 
     jax = resolve_platform(args.platform)
 

@@ -1,0 +1,316 @@
+"""Mesh-sharded ANN serving: distributed ADC scan + top-k merge.
+
+Contract under test (docs/perf.md "Sharded retrieval"):
+
+- ``shards=1`` through the full shard_map program is BITWISE identical
+  to the single-device ``ANNScorer`` — degenerate collectives must not
+  perturb one bit;
+- 2-/4-way sharded serving returns the SAME items as unsharded (each
+  global-top candidate is in its own shard's local top-k′, so the
+  k′×S merge provably covers the dense top-k′);
+- the OPQ rotation + shard hint round-trip through the versioned
+  ``PIOANN01`` blob, and legacy un-rotated v1 blobs still load and
+  serve;
+- PAD-masked parity holds across every AOT bucket of a ladder.
+
+Runs on the conftest's 8 virtual CPU devices.
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from predictionio_tpu import ann
+from predictionio_tpu.ann.index import PQIndex, shard_layout, shard_view
+from predictionio_tpu.ann.scorer import ANNScorer, ShardedANNScorer
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _restore_aot_counters():
+    from predictionio_tpu.server import aot as aot_mod
+
+    counters = (aot_mod.EXECUTABLES._m_lookups, aot_mod._DISPATCHES)
+    snaps = [dict(c._values) for c in counters]
+    yield
+    for c, snap in zip(counters, snaps):
+        with c._lock:
+            c._values.clear()
+            c._values.update(snap)
+
+
+def _clustered(n, d, centers, seed=0, noise=0.2):
+    rng = np.random.default_rng(seed)
+    C = rng.standard_normal((centers, d)).astype(np.float32)
+    V = (C[rng.integers(0, centers, size=n)]
+         + noise * rng.standard_normal((n, d)).astype(np.float32))
+    V /= np.linalg.norm(V, axis=1, keepdims=True) + 1e-9
+    return V
+
+
+def _corpus(n=3000, d=16, seed=8, n_users=64):
+    rng = np.random.default_rng(seed)
+    V = _clustered(n, d, 40, seed=seed)
+    U = rng.standard_normal((n_users, d)).astype(np.float32)
+    U /= np.linalg.norm(U, axis=1, keepdims=True) + 1e-9
+    return U, V
+
+
+# -- shards=1 bitwise parity ---------------------------------------------------
+
+
+class TestShard1Bitwise:
+    def test_topk_bitwise_equal_to_single_device(self):
+        U, V = _corpus()
+        idx = ann.build_index(V, 4, 16, iters=3, sample=len(V))
+        base = ANNScorer(U, V, idx, shortlist=64)
+        s1 = ShardedANNScorer(U, V, idx, shortlist=64, shards=1)
+        ids = np.arange(32, dtype=np.int32)
+        bv, bi = base._topk(ids, 10)
+        sv, si = s1._topk(ids, 10)
+        assert np.array_equal(bv, sv)   # bitwise, not allclose
+        assert np.array_equal(bi, si)
+
+    def test_bitwise_holds_with_opq_rotation(self):
+        U, V = _corpus(seed=9)
+        idx = ann.build_index(V, 4, 16, iters=3, sample=len(V),
+                              opq=True, opq_iters=2)
+        assert idx.rotation is not None
+        base = ANNScorer(U, V, idx, shortlist=64)
+        s1 = ShardedANNScorer(U, V, idx, shortlist=64, shards=1)
+        ids = np.arange(16, dtype=np.int32)
+        bv, bi = base._topk(ids, 10)
+        sv, si = s1._topk(ids, 10)
+        assert np.array_equal(bv, sv) and np.array_equal(bi, si)
+
+
+# -- distributed merge parity on real meshes -----------------------------------
+
+
+class TestMergeParity:
+    @pytest.mark.parametrize("shards", [2, 4])
+    def test_sharded_equals_unsharded(self, shards):
+        """Every dense-top-k′ candidate sits inside its own shard's
+        local top-k′, so merge(k′×S) ⊇ dense top-k′ and the served
+        items match exactly."""
+        U, V = _corpus(n=3100, seed=10)   # uneven: last shard padded
+        idx = ann.build_index(V, 4, 16, iters=3, sample=len(V))
+        base = ANNScorer(U, V, idx, shortlist=64)
+        sh = ShardedANNScorer(U, V, idx, shortlist=64, shards=shards)
+        assert sh.local_n * shards >= 3100
+        ids = np.arange(32, dtype=np.int32)
+        bv, bi = base._topk(ids, 16)
+        sv, si = sh._topk(ids, 16)
+        assert np.array_equal(bi, si)
+        # non-owner shards contribute exact zeros through the psum, so
+        # values match up to fp reduction order
+        np.testing.assert_allclose(bv, sv, rtol=1e-5, atol=1e-6)
+
+    def test_ops_level_merge_matches_dense_topk(self):
+        import jax.numpy as jnp
+
+        from predictionio_tpu.ops import merge_shortlists
+
+        rng = np.random.default_rng(3)
+        S, B, kp = 4, 8, 16
+        vals = rng.standard_normal((S, B, kp)).astype(np.float32)
+        # per-shard shortlists arrive sorted desc (lax.top_k output)
+        vals = -np.sort(-vals, axis=-1)
+        idx = rng.integers(0, 10_000, (S, B, kp)).astype(np.int32)
+        mv, mi = merge_shortlists(jnp.asarray(vals), jnp.asarray(idx), kp)
+        flat_v = np.moveaxis(vals, 0, 1).reshape(B, S * kp)
+        flat_i = np.moveaxis(idx, 0, 1).reshape(B, S * kp)
+        for b in range(B):
+            order = np.argsort(-flat_v[b], kind="stable")[:kp]
+            np.testing.assert_allclose(np.asarray(mv)[b], flat_v[b][order])
+            np.testing.assert_array_equal(np.asarray(mi)[b],
+                                          flat_i[b][order])
+
+    def test_pad_candidates_never_served(self):
+        """k′·S larger than the corpus forces pad indices through the
+        merge; the served ids must all be real rows."""
+        U, V = _corpus(n=50, seed=11)
+        idx = ann.build_index(V, 4, 8, iters=2, sample=len(V))
+        sh = ShardedANNScorer(U, V, idx, shortlist=16, shards=4)
+        assert sh.local_n * 4 > 50          # pad tail exists
+        ids = np.arange(8, dtype=np.int32)
+        _, si = sh._topk(ids, 8)
+        assert si.max() < 50
+
+
+# -- versioned blob: OPQ rotation + shard hint ---------------------------------
+
+
+class TestVersionedBlob:
+    def test_plain_index_stays_version_1(self):
+        V = _clustered(400, 16, 10, seed=12)
+        idx = ann.build_index(V, 4, 16, iters=2, sample=400)
+        blob = idx.to_bytes()
+        (hlen,) = struct.unpack_from("<I", blob, 8)
+        import json
+
+        header = json.loads(blob[12:12 + hlen])
+        assert header["version"] == 1
+        assert "has_rotation" not in header
+
+    def test_opq_shard_blob_roundtrip_and_serves(self):
+        U, V = _corpus(n=800, seed=13)
+        idx = ann.build_index(V, 4, 16, iters=2, sample=800,
+                              opq=True, opq_iters=2, shards=4)
+        R = idx.rotation
+        assert R is not None
+        # learned rotation stays orthogonal (inner products preserved)
+        np.testing.assert_allclose(R @ R.T, np.eye(R.shape[0]),
+                                   atol=1e-4)
+        back = PQIndex.from_bytes(idx.to_bytes())
+        np.testing.assert_array_equal(back.rotation, R)
+        np.testing.assert_array_equal(back.codes, idx.codes)
+        assert back.meta.get("shards") == 4
+        s = ANNScorer(U, V, back, shortlist=64)
+        iv, vv = s.recommend(3, 5)
+        assert len(iv) == 5 and np.isfinite(vv).all()
+
+    def test_legacy_v1_blob_loads_and_serves(self):
+        """Un-rotated blobs written before the OPQ/shards header
+        extension keep loading — and serve through both scorers."""
+        U, V = _corpus(n=600, seed=14)
+        idx = ann.build_index(V, 4, 16, iters=2, sample=600)
+        back = PQIndex.from_bytes(idx.to_bytes())   # v1 wire bytes
+        assert back.rotation is None
+        single = ANNScorer(U, V, back, shortlist=64)
+        sharded = ShardedANNScorer(U, V, back, shortlist=64, shards=2)
+        ids = np.arange(8, dtype=np.int32)
+        bv, bi = single._topk(ids, 8)
+        sv, si = sharded._topk(ids, 8)
+        assert np.array_equal(bi, si)
+
+    def test_manifest_carries_rotation_and_shards(self, tmp_path):
+        V = _clustered(500, 16, 10, seed=15)
+        idx = ann.build_index(V, 4, 16, iters=2, sample=500,
+                              opq=True, opq_iters=1, shards=2)
+        man = ann.manifest_dict(idx, "0" * 64)
+        assert man["version"] == 2
+        assert man["rotation_bytes"] == 16 * 16 * 4
+        assert man["shards"] == 2
+
+
+# -- PAD masking across AOT buckets --------------------------------------------
+
+
+class TestPadMaskingAcrossBuckets:
+    def test_parity_on_every_bucket(self):
+        from predictionio_tpu.server.aot import BucketLadder
+
+        U, V = _corpus(n=2600, seed=16)
+        idx = ann.build_index(V, 4, 16, iters=3, sample=len(V))
+        ladder = BucketLadder([4, 8, 16])
+        base = ANNScorer(U, V, idx, shortlist=64)
+        sh = ShardedANNScorer(U, V, idx, shortlist=64, shards=4)
+        base.warm_buckets(ladder, ks=(8,))
+        sh.warm_buckets(ladder, ks=(8,))
+        for B in (1, 3, 4, 5, 8, 11, 16):   # off-bucket → PAD rows
+            ids = np.arange(B, dtype=np.int32)
+            want = base.recommend_batch(ids, 8)
+            got = sh.recommend_batch(ids, 8)
+            assert len(got) == B
+            for (wi, wv), (gi, gv) in zip(want, got):
+                np.testing.assert_array_equal(wi, gi)
+                np.testing.assert_allclose(wv, gv, rtol=1e-5, atol=1e-6)
+
+    def test_zero_compiles_after_warmup(self):
+        from predictionio_tpu.server import aot as aot_mod
+        from predictionio_tpu.server.aot import BucketLadder
+
+        U, V = _corpus(n=2400, seed=17)
+        idx = ann.build_index(V, 4, 16, iters=2, sample=len(V))
+        sh = ShardedANNScorer(U, V, idx, shortlist=64, shards=2)
+        sh.warm_buckets(BucketLadder([8, 16]), ks=(8,))
+        sh.recommend_batch(np.arange(8, dtype=np.int32), 8)  # first touch
+        compiles0 = aot_mod.EXECUTABLES.counts().get("compile", 0)
+        for B in (2, 8, 13, 16):
+            sh.recommend_batch(np.arange(B, dtype=np.int32), 8)
+        assert aot_mod.EXECUTABLES.counts().get("compile", 0) == compiles0
+
+
+# -- scorer selection ----------------------------------------------------------
+
+
+class TestScorerSelection:
+    def test_blob_shard_hint_selects_sharded(self, monkeypatch):
+        monkeypatch.setenv("PIO_ALS_SERVE", "device")
+        monkeypatch.delenv("PIO_ANN_SHARDS", raising=False)
+        U, V = _corpus(n=400, seed=18)
+        idx = ann.build_index(V, 4, 8, iters=2, sample=400, shards=2)
+        s = ann.maybe_ann_scorer(U, V, idx)
+        assert isinstance(s, ShardedANNScorer) and s.shards == 2
+        # cached reuse: same arrays, same geometry → same object
+        assert ann.maybe_ann_scorer(U, V, idx, cached=s) is s
+
+    def test_env_overrides_hint_and_argument(self, monkeypatch):
+        monkeypatch.setenv("PIO_ALS_SERVE", "device")
+        monkeypatch.setenv("PIO_ANN_SHARDS", "4")
+        U, V = _corpus(n=400, seed=19)
+        idx = ann.build_index(V, 4, 8, iters=2, sample=400, shards=2)
+        s = ann.maybe_ann_scorer(U, V, idx, shards=2)
+        assert isinstance(s, ShardedANNScorer) and s.shards == 4
+
+    def test_too_few_devices_degrades_to_unsharded(self, monkeypatch):
+        monkeypatch.setenv("PIO_ALS_SERVE", "device")
+        monkeypatch.delenv("PIO_ANN_SHARDS", raising=False)
+        U, V = _corpus(n=400, seed=20)
+        idx = ann.build_index(V, 4, 8, iters=2, sample=400)
+        s = ann.maybe_ann_scorer(U, V, idx, shards=64)   # > 8 devices
+        assert type(s) is ANNScorer
+
+    def test_shards_one_means_unsharded(self, monkeypatch):
+        monkeypatch.setenv("PIO_ALS_SERVE", "device")
+        monkeypatch.delenv("PIO_ANN_SHARDS", raising=False)
+        U, V = _corpus(n=400, seed=21)
+        idx = ann.build_index(V, 4, 8, iters=2, sample=400)
+        assert type(ann.maybe_ann_scorer(U, V, idx, shards=1)) is ANNScorer
+
+
+# -- jax-free layout math ------------------------------------------------------
+
+
+class TestShardViewMath:
+    def test_layout_and_view(self):
+        lay = shard_layout(100, 8)
+        assert lay == {"shards": 8, "rows_per_shard": 13,
+                       "padded_items": 104}
+        man = {"n_items": 1_000_000, "m": 8, "dim": 64,
+               "codebook_bytes": 8 * 256 * 8 * 4, "rotation_bytes": 0}
+        sv = shard_view(man, 4)
+        assert sv["rows_per_shard"] == 250_000
+        assert sv["code_bytes_per_shard"] == 250_000 * 8
+        assert sv["rerank_bytes_per_shard"] == 250_000 * 64 * 4
+        assert sv["hbm_per_device_bytes"] == (
+            sv["code_bytes_per_shard"] + sv["rerank_bytes_per_shard"]
+            + sv["replicated_bytes"])
+
+    def test_cli_index_status_shards_is_jax_free(self, tmp_path,
+                                                 monkeypatch):
+        """`pio index status --shards N` must never import jax — it
+        runs on ops boxes with no accelerator stack."""
+        import json as _json
+        import subprocess
+        import sys
+
+        V = _clustered(300, 16, 8, seed=22)
+        idx = ann.build_index(V, 4, 8, iters=2, sample=300)
+        ann.save_index(idx, str(tmp_path))
+        code = (
+            "import sys, json\n"
+            "sys.modules['jax'] = None  # poison: any import explodes\n"
+            "from predictionio_tpu.ann.index import shard_view\n"
+            f"man = json.load(open({str(tmp_path / 'ann_index.json')!r}))\n"
+            "print(json.dumps(shard_view(man, 4)))\n"
+        )
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             cwd=os.path.dirname(os.path.dirname(
+                                 os.path.abspath(__file__))))
+        assert out.returncode == 0, out.stderr
+        sv = _json.loads(out.stdout)
+        assert sv["shards"] == 4 and sv["rows_per_shard"] == 75
