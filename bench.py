@@ -226,6 +226,16 @@ def main() -> None:
     mfu_device = flops / t_dev / (V5E_PEAK_BF16 * n_chips)
     hbm_gbps = _train_bytes(prep, args.rank, args.iters) / t_dev / 1e9
 
+    # dispatch accounting (chip-free abstract trace, utils/opcount): the
+    # r5 wall was device-op COUNT, not FLOPs, so the bench emits it as a
+    # first-class metric next to mfu_device — both paths counted even
+    # when only one actually ran on this chip
+    from predictionio_tpu import ops as ops_mod
+    from predictionio_tpu.utils import opcount as opcount_mod
+
+    dispatch_rep = opcount_mod.als_dispatch_report(prep, params)
+    gram_mode = ops_mod.resolve_gram_mode(jax.default_backend())
+
     # r4 grid contract on hardware: 3 extra reg candidates on the SAME
     # prep must pay ZERO compiles (reg is a traced scalar) — wall time
     # ≈ 3 × train_sec_warm. Measured here so the BENCH file carries the
@@ -373,6 +383,15 @@ def main() -> None:
             "mfu_device": round(mfu_device, 4),
             "model_tflops": round(flops / 1e12, 2),
             "hbm_gbps": round(hbm_gbps, 1),
+            # dispatch wall: device ops per iteration for the fused
+            # gather→Gram path vs the XLA path (abstract jaxpr count,
+            # utils/opcount) and the gram mode this run resolved to
+            "device_ops_per_iter": dispatch_rep["device_ops_per_iter"],
+            "device_ops_per_iter_xla":
+                dispatch_rep["device_ops_per_iter_xla"],
+            "dispatch_collapse_ratio":
+                round(dispatch_rep["dispatch_collapse_ratio"], 1),
+            "gram_mode": gram_mode,
             # reg-grid contract: 3 extra reg candidates on the same
             # prep; must show 0 extra compiles (traced scalars, r4)
             "grid_reg3_sec": round(t_grid3, 3),

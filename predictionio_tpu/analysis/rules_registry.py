@@ -25,6 +25,9 @@ series                 ``pio_slo_*`` /
                        federation rename)
 CLI flags              ``add_argument("--x")`` docs/cli.md
                        in tools/cli.py
+environment flags      ``environ.get("PIO_x")``  docs/cli.md
+                       / ``os.getenv`` /
+                       ``environ["PIO_x"]``
 =====================  ======================  =======================
 
 The fault-site closure is bidirectional (a table row no code wires is
@@ -276,8 +279,59 @@ def _flag_findings(project: Project) -> List[Finding]:
     return out
 
 
+# -- environment flags --------------------------------------------------------
+
+_ENV_RE = re.compile(r"^PIO_[A-Z0-9_]+$")
+
+
+def _is_environ(node: ast.AST) -> bool:
+    return ((isinstance(node, ast.Name) and node.id == "environ")
+            or (isinstance(node, ast.Attribute) and node.attr == "environ"))
+
+
+def env_flags(project: Project) -> Dict[str, Tuple[str, int]]:
+    """Every ``PIO_*`` environment variable the package reads —
+    ``environ.get``/``environ.setdefault``/``os.getenv``/
+    ``environ["..."]`` — mapped to the first (path, line) reading it.
+    An env knob that ships undocumented (PIO_PALLAS_GRAM and friends
+    select entire device code paths) is a tuning surface operators
+    cannot discover."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for mod in project.iter_modules():
+        if _excluded(project, mod):
+            continue
+        for node in ast.walk(mod.tree):
+            s = None
+            if isinstance(node, ast.Call) and node.args:
+                name = call_name(node)
+                if name == "getenv":
+                    s = const_str(node.args[0])
+                elif (name in ("get", "setdefault", "pop")
+                      and isinstance(node.func, ast.Attribute)
+                      and _is_environ(node.func.value)):
+                    s = const_str(node.args[0])
+            elif isinstance(node, ast.Subscript) and _is_environ(node.value):
+                s = const_str(node.slice)
+            if s and _ENV_RE.match(s):
+                out.setdefault(s, (mod.relpath, node.lineno))
+    return out
+
+
+def _env_findings(project: Project) -> List[Finding]:
+    doc = project.read_doc("docs/cli.md")
+    out: List[Finding] = []
+    for var, (path, line) in sorted(env_flags(project).items()):
+        if var not in doc:
+            out.append(Finding(
+                RULE, path, line, f"env:{var}",
+                f"environment flag '{var}' is read by the package but "
+                "not documented in docs/cli.md — an invisible knob"))
+    return out
+
+
 def check(project: Project) -> List[Finding]:
     return (fault_site_closure(project)
             + _metric_findings(project)
             + _prefixed_findings(project)
-            + _flag_findings(project))
+            + _flag_findings(project)
+            + _env_findings(project))

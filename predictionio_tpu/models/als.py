@@ -564,7 +564,7 @@ def als_train_many(
 
 def _make_half(k: int, implicit: bool, weighted_reg: bool, pvary=None,
                platform=None, bf16_gather: bool = False,
-               precision: str = "high"):
+               precision: str = "high", gram_mode: str = "off"):
     """Build the half-step program shared by the single-device and
     sharded (shard_map) paths:
     ``half(F_other, bufs, geometry, reg, alpha)`` — one full re-solve
@@ -597,6 +597,17 @@ def _make_half(k: int, implicit: bool, weighted_reg: bool, pvary=None,
     ``platform`` is the platform the trace will RUN on (mesh/device
     platform — may differ from the default backend): it routes the
     solve to the Pallas VMEM kernel on TPU, XLA elsewhere.
+
+    ``gram_mode`` selects the gather→Gram implementation (resolved by
+    :func:`predictionio_tpu.ops.resolve_gram_mode` from
+    ``PIO_PALLAS_GRAM``): ``"off"`` keeps today's XLA gather + packed
+    einsum with its per-bucket slab ``lax.scan``s; ``"pallas"`` /
+    ``"interpret"`` route every bucket through the fused
+    :func:`predictionio_tpu.ops.gather_gram` kernel — the slab scans
+    flatten into ONE fat kernel dispatch per bucket, the seg merge
+    becomes one einsum + one (tiny) scatter-add, and the solve pass
+    prefers the VMEM Cholesky kernel — collapsing the ~8.8k device
+    ops/iteration the r5 trace measured to a fixed few hundred.
     """
     import functools
 
@@ -607,10 +618,17 @@ def _make_half(k: int, implicit: bool, weighted_reg: bool, pvary=None,
     eye = jnp.eye(k, dtype=jnp.float32)
     prec = (jax.lax.Precision.HIGHEST if precision == "highest"
             else jax.lax.Precision.HIGH)
+    fused = gram_mode in ("pallas", "interpret")
+    interp = gram_mode == "interpret"
 
+    from predictionio_tpu.ops import gram as ops_gram
     from predictionio_tpu.ops.cholesky import chol_solve_batched as _csb
 
-    chol_solve_batched = functools.partial(_csb, platform=platform)
+    chol_solve_batched = functools.partial(
+        _csb, platform=platform,
+        # fat-dispatch regime: the ~50-op XLA solve recursion would
+        # re-create the dispatch wall the Gram fusion removes
+        prefer_pallas=(gram_mode == "pallas"))
 
     # reg/alpha are bound per trace by ``half`` (traced scalars shared
     # by every helper below via this cell — threading them through five
@@ -664,14 +682,44 @@ def _make_half(k: int, implicit: bool, weighted_reg: bool, pvary=None,
         lam = jnp.where(cnt_s > 0, jnp.maximum(lam, 1e-8), 1.0)
         return A + lam[:, None, None] * eye
 
+    def fused_grams(F_g, oi2, v2, m2):
+        """All of a bucket's rows through ONE fused gather→Gram kernel
+        dispatch (``ops.gather_gram``): the weights are two cheap XLA
+        elementwise ops streamed as kernel operands, the gather and the
+        Gram run inside the kernel, and only the (R, k, k) / (R, k)
+        normal-equation blocks come back — the gathered (R, C, k)
+        factor block never exists in HBM."""
+        wo, wb = weights(v2, m2)
+        return ops_gram.gather_gram(F_g, oi2, wo, wb, interpret=interp)
+
     def seg_equations(F_g, buf, nb, slab, G):
         """Heavy bucket: entities span rows; each slab aggregates its
         per-row partials into ≤ slab consecutive entities with one
         (slab, slab) × (slab, k·(k+1)) matmul (slab-local one-hot, no
         scatter), accumulated into the per-entity buffer at the slab's
         entity offset. Buffer is over-allocated by one slab so the
-        update-slice never clamps."""
+        update-slice never clamps.
+
+        Fused mode drops the slab scan: one kernel call over ALL rows,
+        one batched aggregation einsum, and one scatter-add of the
+        slab-local blocks at their entity offsets. (The no-scatter rule
+        targets ~nnz/W-row scatters — this one moves n_seg_rows ≈
+        hundreds of k×(k+1) blocks, noise next to the kernel call.)"""
         oi, vv, mm, cnt, seg, seg_off = buf
+        n_slabs, _, C = oi.shape
+        if fused:
+            R = n_slabs * slab
+            A_r, b_r = fused_grams(F_g, oi.reshape(R, C),
+                                   vv.reshape(R, C), mm.reshape(R, C))
+            Ab_r = jnp.concatenate([A_r, b_r[:, :, None]], axis=-1)
+            Ab_l = jnp.einsum("nre,nrkm->nekm", seg,
+                              Ab_r.reshape(n_slabs, slab, k, k + 1),
+                              precision=prec,
+                              preferred_element_type=jnp.float32)
+            ids = seg_off[:, None] + jnp.arange(slab, dtype=jnp.int32)
+            Ab_e = pv(jnp.zeros((nb + slab, k, k + 1),
+                                jnp.float32)).at[ids].add(Ab_l)
+            return ridge(Ab_e[:nb, :, :k], cnt, G), Ab_e[:nb, :, k]
 
         def seg_body(Ab_e, chunk):
             oi_s, v_s, m_s, seg_s, off_s = chunk
@@ -735,6 +783,16 @@ def _make_half(k: int, implicit: bool, weighted_reg: bool, pvary=None,
                 A_e, b_e = seg_equations(F_other, buf, nb, slab, G)
                 A_parts.append(A_e)
                 b_parts.append(b_e)
+            elif fused:
+                # the whole bucket — every slab — as ONE fused kernel
+                # dispatch (no slab scan; the kernel streams (RB, C)
+                # row blocks through VMEM itself)
+                oi, vv, mm, cnt = buf
+                R = n_slabs * slab
+                A, b = fused_grams(F_other, oi.reshape(R, C),
+                                   vv.reshape(R, C), mm.reshape(R, C))
+                A_parts.append(ridge(A, cnt.reshape(R), G))
+                b_parts.append(b)
             else:
                 oi, vv, mm, cnt = buf
 
@@ -819,6 +877,13 @@ def _make_half(k: int, implicit: bool, weighted_reg: bool, pvary=None,
             if is_seg:
                 A_e, b_e = seg_equations(F_g, buf, nb, slab, G)
                 x = chol_solve_batched(A_e, b_e)
+            elif fused:
+                oi, vv, mm, cnt = buf
+                R = n_slabs * slab
+                A, b = fused_grams(F_g, oi.reshape(R, C),
+                                   vv.reshape(R, C), mm.reshape(R, C))
+                x = chol_solve_batched(ridge(A, cnt.reshape(R), G),
+                                       b)[:nb]
             else:
                 oi, vv, mm, cnt = buf
 
@@ -857,7 +922,8 @@ def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
                        implicit: bool, weighted_reg: bool,
                        platform: Optional[str] = None,
                        bf16_gather: bool = False,
-                       precision: str = "high"):
+                       precision: str = "high",
+                       gram_mode: str = "off"):
     """Build + jit the full single-device training program for one
     problem geometry (two `_make_half` programs under one iteration
     scan). ``reg`` and ``alpha`` are traced inputs of the returned
@@ -871,7 +937,7 @@ def _compiled_bucketed(geom_u, geom_i, n_users: int, n_items: int,
     k = rank
     half = _make_half(k, bool(implicit), bool(weighted_reg),
                       platform=platform, bf16_gather=bf16_gather,
-                      precision=precision)
+                      precision=precision, gram_mode=gram_mode)
 
     def train(u_bufs, i_bufs, V0p, reg, alpha):
         if iterations == 0:
@@ -930,6 +996,12 @@ def als_train_prepared(prep: ALSPrepared, p: ALSParams, device=None,
 
     platform = (device.platform if device is not None
                 else jax.default_backend())
+    # resolved HERE (not inside the lru_cached builder) so an env flip
+    # between calls can't be shadowed by a stale cache entry — the mode
+    # is part of the cache key
+    from predictionio_tpu import ops
+
+    gram_mode = ops.resolve_gram_mode(platform)
 
     def compiled(n_iters: int):
         return _compiled_bucketed(
@@ -937,7 +1009,7 @@ def als_train_prepared(prep: ALSPrepared, p: ALSParams, device=None,
             prep.n_users, prep.n_items,
             p.rank, n_iters, bool(p.implicit),
             bool(p.weighted_reg), platform,
-            bool(p.bf16_gather), _gram_precision())
+            bool(p.bf16_gather), _gram_precision(), gram_mode)
 
     reg_a = np.float32(p.reg)
     alpha_a = np.float32(p.alpha)

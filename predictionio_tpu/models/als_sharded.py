@@ -202,7 +202,8 @@ def als_prepare_sharded(coo: RatingsCOO, n_dev: int) -> ALSShardedPrepared:
 @functools.lru_cache(maxsize=16)  # chunked checkpointing adds block-size
 def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,  # variants (full/block/remainder) per geometry
                       implicit: bool, weighted_reg: bool,
-                      bf16_gather: bool = False, precision: str = "high"):
+                      bf16_gather: bool = False, precision: str = "high",
+                      gram_mode: str = "off"):
     """``reg``/``alpha`` are traced scalar inputs of the returned
     program (replicated into the shard_map body), so an eval grid over
     regularization shares one sharded executable — the cache keys only
@@ -219,7 +220,8 @@ def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,  # varia
     half = _make_half(k, implicit, weighted_reg,
                       pvary=lambda x: pvary(x, "data"),
                       platform=mesh.devices.flat[0].platform,
-                      bf16_gather=bf16_gather, precision=precision)
+                      bf16_gather=bf16_gather, precision=precision,
+                      gram_mode=gram_mode)
 
     def body(u_bufs, i_bufs, V0_l, reg, alpha):
         # inside shard_map the stacked arrays arrive with a local
@@ -270,12 +272,20 @@ def _compiled_sharded(mesh, geom_u, geom_i, rank: int, iterations: int,  # varia
             specs.append(tuple(s))
         return (dense, tuple(specs))
 
-    fn = shard_map(
-        body, mesh=mesh,
-        in_specs=(side_specs(geom_u), side_specs(geom_i),
-                  P("data", None), P(), P()),
-        out_specs=(P("data", None), P("data", None)),
-    )
+    in_specs = (side_specs(geom_u), side_specs(geom_i),
+                P("data", None), P(), P())
+    out_specs = (P("data", None), P("data", None))
+    if gram_mode in ("pallas", "interpret"):
+        # pallas_call has no shard_map replication rule — the fused
+        # gather→Gram (and the VMEM solve it prefers) run with the
+        # checker off; specs are identical, only the static rep-type
+        # verification is skipped
+        from predictionio_tpu.parallel.mesh import shard_map_unchecked
+
+        fn = shard_map_unchecked(body, mesh, in_specs, out_specs)
+    else:
+        fn = shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs)
     return jax.jit(fn)
 
 
@@ -319,13 +329,19 @@ def als_train_sharded_prepared(
             f"layout was prepared for {n_dev} devices but the mesh has "
             f"{int(np.prod(mesh.devices.shape))}")
 
+    from predictionio_tpu import ops
     from predictionio_tpu.models.als import _gram_precision
+
+    # resolved per call (not inside the lru_cached builder) so an env
+    # flip between calls is never shadowed by a stale cache entry
+    gram_mode = ops.resolve_gram_mode(mesh.devices.flat[0].platform)
 
     def compiled(n_iters: int):
         return _compiled_sharded(
             mesh, prep.geom_u, prep.geom_i,
             p.rank, n_iters, bool(p.implicit),
-            bool(p.weighted_reg), bool(p.bf16_gather), _gram_precision())
+            bool(p.weighted_reg), bool(p.bf16_gather), _gram_precision(),
+            gram_mode)
 
     # inputs are placed directly onto the mesh with their shard_map
     # layouts (cached per mesh) — never through the default backend

@@ -12,7 +12,9 @@ Every Pallas kernel has an XLA fallback; ``use_pallas()`` decides by
 backend (compiled on TPU, XLA elsewhere, interpret-mode in tests).
 """
 
-from predictionio_tpu.ops.gram import rows_gram, rows_gram_xla
+from predictionio_tpu.ops.gram import (gather_gram, gather_gram_xla,
+                                       resolve_gram_mode, rows_gram,
+                                       rows_gram_xla)
 from predictionio_tpu.ops.segment import segment_count, segment_mean, segment_sum
 from predictionio_tpu.ops.topk import (adc_scores, adc_shortlist,
                                        merge_shortlists, rerank_partial,
@@ -42,8 +44,9 @@ def use_pallas(platform=None) -> bool:
 
 
 __all__ = [
-    "adc_scores", "adc_shortlist", "merge_shortlists", "rerank_partial",
-    "rerank_topk",
+    "adc_scores", "adc_shortlist", "gather_gram", "gather_gram_xla",
+    "merge_shortlists", "rerank_partial", "rerank_topk",
+    "resolve_gram_mode",
     "rows_gram", "rows_gram_xla", "score_topk", "score_topk_xla",
     "segment_sum", "segment_count", "segment_mean", "use_pallas",
 ]

@@ -143,7 +143,7 @@ def _chol_solve(A, b):
     return x[..., :k]
 
 
-def chol_solve_batched(A, b, platform=None):
+def chol_solve_batched(A, b, platform=None, prefer_pallas=False):
     """Solve the batched SPD systems ``A x = b``.
 
     A: (..., k, k) SPD (symmetric positive definite — ALS adds a ridge),
@@ -161,6 +161,13 @@ def chol_solve_batched(A, b, platform=None):
     vs 9.78 s with the Pallas kernel — the VMEM solve halves the cold
     compile (24.5 s vs 113 s) but loses 2× on execution on real
     hardware, so it stays opt-in for compile-latency-sensitive runs.
+
+    ``prefer_pallas=True`` flips the UNSET-flag default to ``auto``:
+    callers already committed to the fat-dispatch regime (the fused
+    gather→Gram ALS mode, ``PIO_PALLAS_GRAM``) also want the ~50-op
+    XLA solve recursion collapsed to one kernel per chunk — otherwise
+    the solve pass alone re-creates the dispatch wall the Gram fusion
+    just removed. An explicit ``PIO_PALLAS_SOLVE`` setting still wins.
     """
     A = jnp.asarray(A, jnp.float32)
     b = jnp.asarray(b, jnp.float32)
@@ -169,6 +176,8 @@ def chol_solve_batched(A, b, platform=None):
     from predictionio_tpu import ops
 
     flag = os.environ.get("PIO_PALLAS_SOLVE", "")
+    if flag == "" and prefer_pallas:
+        flag = "auto"
     if A.ndim == 3 and A.shape[0] >= 256 and ops.use_pallas(platform):
         if flag == "1" or (flag == "auto" and _pallas_solve_preflight()):
             return chol_solve_pallas(A, b)

@@ -1,6 +1,6 @@
 """Profile / A-B the ALS training program on the real chip.
 
-Two modes:
+Modes:
 
 - default: run the ML-20M-shape train (bench.py protocol), print phase
   timings, and capture a JAX profiler trace of a short warm run —
@@ -8,9 +8,14 @@ Two modes:
 - ``--ab``: run the optimization matrix and print one line per
   configuration — the decision data for flipping defaults:
     * baseline (materialized solve pass, XLA recursion, f32 gathers)
+    * PIO_PALLAS_GRAM=1 (fused gather→Gram Pallas kernel)
     * PIO_PALLAS_SOLVE=1 (VMEM-resident Pallas solve kernel)
     * in-body solves (no solve-buffer materialization)
     * bf16 gathers
+- ``--opcount``: CHIP-FREE — trace the TPU train program abstractly
+  and report device ops/iteration for the XLA vs fused gather→Gram
+  paths (the r5 dispatch-wall metric), then assert the ≥10× collapse
+  regression guard. Runs on any host; no accelerator touched.
 """
 
 import argparse
@@ -50,8 +55,10 @@ def _measure_device(prep, params, label, repeats=3):
     tunneled chip executes lazily and moves d2h bytes at ~20 MB/s, so
     the big fetch adds ~4.7 s of pure image artifact and its variance
     swamps 20% device-level wins."""
+    import jax
     import jax.numpy as jnp
 
+    from predictionio_tpu import ops
     from predictionio_tpu.models import als
 
     u_bufs, i_bufs = prep.device_buffers()
@@ -59,7 +66,8 @@ def _measure_device(prep, params, label, repeats=3):
         prep.u_side.geometry, prep.i_side.geometry,
         prep.n_users, prep.n_items, params.rank, params.iterations,
         bool(params.implicit), bool(params.weighted_reg),
-        None, bool(params.bf16_gather), als._gram_precision())
+        None, bool(params.bf16_gather), als._gram_precision(),
+        ops.resolve_gram_mode(jax.default_backend()))
     V0 = jnp.asarray(
         als.init_factors(prep.n_items, params.rank, params.seed)[
             prep.i_side.perm])
@@ -182,6 +190,48 @@ def _sharded_ckpt_overhead(args):
           f"per_boundary_overhead={per:.1f}ms", flush=True)
 
 
+def _opcount(args):
+    """Chip-free dispatch-count report + regression guard.
+
+    Traces the TPU train program abstractly (ShapeDtypeStructs, no
+    device buffers) on the CPU host and counts device ops/iteration
+    for the XLA path vs the fused gather→Gram path. Exits non-zero if
+    the collapse ratio falls below the ISSUE-17 acceptance floor of
+    10× — this is the device-ops-count regression guard, cheap enough
+    for CI.
+    """
+    import sys
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from bench import synthetic_ml20m
+    from predictionio_tpu.models.als import ALSParams, RatingsCOO, als_prepare
+    from predictionio_tpu.utils import opcount
+
+    users, items, ratings = synthetic_ml20m(args.nnz)
+    coo = RatingsCOO(users, items, ratings, 138_493, 26_744)
+    prep = als_prepare(coo)
+    params = ALSParams(rank=args.rank, iterations=args.iters, reg=0.05,
+                       seed=1)
+    rep = opcount.als_dispatch_report(prep, params)
+    print(f"nnz={coo.nnz} rank={params.rank} "
+          f"geometry: u={[(b.C, b.nb) for b in prep.u_side.buckets]} "
+          f"i={[(b.C, b.nb) for b in prep.i_side.buckets]}", flush=True)
+    print(f"device_ops_per_iter_xla   = {rep['device_ops_per_iter_xla']}",
+          flush=True)
+    print(f"device_ops_per_iter_fused = {rep['device_ops_per_iter']}",
+          flush=True)
+    print(f"dispatch_collapse_ratio   = "
+          f"{rep['dispatch_collapse_ratio']:.1f}x", flush=True)
+    if rep["dispatch_collapse_ratio"] < 10:
+        print("FAIL: dispatch collapse below the 10x acceptance floor",
+              flush=True)
+        sys.exit(1)
+    print("OK: collapse >= 10x", flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nnz", type=int, default=None,
@@ -206,12 +256,20 @@ def main():
                     help="measure the per-boundary overhead of "
                          "block-wise checkpointing on the sharded "
                          "trainer (8-device CPU mesh)")
+    ap.add_argument("--opcount", action="store_true",
+                    help="chip-free device-ops/iter report (XLA vs "
+                         "fused gather-Gram) + >=10x collapse guard")
     args = ap.parse_args()
 
     if args.sharded_ckpt:
         if args.nnz is None:
             args.nnz = 400_000  # CPU-mesh measurement, not TPU scale
         _sharded_ckpt_overhead(args)
+        return
+    if args.opcount:
+        if args.nnz is None:
+            args.nnz = 500_000  # abstract trace: geometry, not scale
+        _opcount(args)
         return
     if args.nnz is None:
         args.nnz = 20_000_000
@@ -248,10 +306,15 @@ def main():
                        seed=1)
 
     if args.ab:
+        os.environ["PIO_PALLAS_GRAM"] = "0"
         _measure(prep, params, "baseline (materialized, XLA solve)")
+        os.environ["PIO_PALLAS_GRAM"] = "1"
+        _measure(prep, params, "fused gather-Gram (pallas)")
+        os.environ["PIO_PALLAS_GRAM"] = "0"
         os.environ["PIO_PALLAS_SOLVE"] = "1"
         _measure(prep, params, "pallas VMEM solve")
         del os.environ["PIO_PALLAS_SOLVE"]
+        del os.environ["PIO_PALLAS_GRAM"]
         saved = als._SOLVE_BUF_MB
         als._SOLVE_BUF_MB = 0
         _measure(prep, params, "in-body solves (no solve buffer)")

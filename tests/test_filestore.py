@@ -404,6 +404,46 @@ class TestColumnarScan:
             assert (f_pairs[n][1] == s_pairs[n][1]).all(), n
         assert f_pairs["view"][0].size == 1  # the one view event
 
+    def test_times_us_microsecond_parity(self, col_store):
+        """µs-precision parity: every scan_columnar provider must
+        return the EXACT integer microsecond timestamps — the same
+        expected array pins all three backends (EVENTLOG, SQLITE, ES)
+        to bit-identical ``times_us``. Regression for the ES float-
+        second epoch field, which rounded sub-second times (≈0.5 µs
+        spacing) until the exact ``eventTimeUs`` doc field landed."""
+        import numpy as np
+
+        store = col_store
+        stamps = [
+            "2026-01-02T03:04:05Z",             # whole second
+            "2026-01-02T03:04:05.123Z",         # millis
+            "2026-01-02T03:04:05.123456Z",      # full micros
+            "2026-01-02T03:04:05.123457Z",      # 1 µs later — must differ
+            "2026-01-02T03:04:05.000001Z",      # 1 µs past the second
+            "2026-01-02T08:34:05.999999+05:30", # tz-shifted, .999999
+        ]
+        from predictionio_tpu.data.event import parse_event_time
+
+        for k, s in enumerate(stamps):
+            store.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{k}",
+                target_entity_type="item", target_entity_id=f"i{k}",
+                properties={"rating": 1.0},
+                event_time=parse_event_time(s)), APP)
+        cols = store.scan_columnar(
+            APP, entity_type="user", target_entity_type="item",
+            event_names=["rate"], value_key="rating")
+        epoch = dt.datetime(1970, 1, 1, tzinfo=dt.timezone.utc)
+        want = np.sort(np.asarray(
+            [int((parse_event_time(s) - epoch).total_seconds() * 1e6
+                 + 0.5) for s in stamps], np.int64))
+        got = np.sort(np.asarray(cols.times_us, np.int64))
+        assert got.dtype == np.int64
+        np.testing.assert_array_equal(got, want)
+        # the two 1-µs-apart events stayed distinct (the old float-
+        # second ES field collapsed them)
+        assert len(np.unique(got)) == len(stamps)
+
 
 class TestNativeJsonlImport:
     """`pio import` NDJSON parity: the C++ fast path must produce
@@ -882,5 +922,70 @@ class TestImportFuzzParity:
                         assert got.event_time == ref.event_time, line
                     if d.get("creationTime"):
                         assert got.creation_time == ref.creation_time, line
+            finally:
+                s.close()
+
+    def test_duplicate_keys_last_wins(self, tmp_path):
+        """Duplicate JSON keys — ``json.dumps`` can never emit them, so
+        the random fuzz above is blind to this grammar corner. Python's
+        ``json.loads`` keeps the LAST occurrence; the native parser
+        must agree on every field it narrows (fixed in the native
+        eventlog parser; this pins the behavior)."""
+        import io
+        import json as _json
+
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.filestore import NativeEventLogStore
+        from predictionio_tpu.tools.export_import import import_events
+
+        lines = [
+            # top-level dup: event name, last value wins
+            '{"event": "rate", "event": "buy", "entityType": "user", '
+            '"entityId": "u1"}',
+            # dup entityId, including one non-string earlier occurrence
+            '{"event": "rate", "entityType": "user", "entityId": "old", '
+            '"entityId": "new"}',
+            # dup eventTime: first invalid, last valid (accept) and the
+            # reverse (reject) — narrowing must use the surviving value
+            '{"event": "e", "entityType": "u", "entityId": "x", '
+            '"eventTime": "bogus", "eventTime": "2026-01-02T03:04:05Z"}',
+            '{"event": "e", "entityType": "u", "entityId": "x", '
+            '"eventTime": "2026-01-02T03:04:05Z", "eventTime": "bogus"}',
+            # dup inside properties objects
+            '{"event": "e", "entityType": "u", "entityId": "x", '
+            '"properties": {"rating": 1.5, "rating": 4.5}}',
+            # the whole properties object duplicated
+            '{"event": "e", "entityType": "u", "entityId": "x", '
+            '"properties": {"a": 1}, "properties": {"b": 2}}',
+            # dup targetEntityId where the first would be one-sided
+            '{"event": "e", "entityType": "u", "entityId": "x", '
+            '"targetEntityType": "item", "targetEntityId": "t1", '
+            '"targetEntityId": "t2"}',
+        ]
+        for i, line in enumerate(lines):
+            s = NativeEventLogStore(str(tmp_path / f"dup{i}"))
+            try:
+                try:
+                    ref = Event.from_json(_json.loads(line))
+                except ValueError:
+                    ref = None
+                try:
+                    n = import_events(APP, io.StringIO(line + "\n"),
+                                      storage=type("S", (), {"events": s}))
+                except ValueError:
+                    n = -1
+                if ref is None:
+                    assert n <= 0, (line, "native accepted what Python "
+                                          "rejects")
+                else:
+                    assert n == 1, (line, "both should accept")
+                    got = next(iter(s.find(APP)))
+                    assert got.event == ref.event, line
+                    assert got.entity_id == ref.entity_id, line
+                    assert got.target_entity_id == ref.target_entity_id, \
+                        line
+                    assert got.properties == ref.properties, line
+                    if '"eventTime"' in line:  # else defaults to now()
+                        assert got.event_time == ref.event_time, line
             finally:
                 s.close()

@@ -364,6 +364,32 @@ class TestRegistryClosure:
         report = lint(root, "PL04")
         assert "known-sites-table" in symbols(report)
 
+    def test_env_flag_closure(self, tmp_path):
+        """Every PIO_* read style must be collected (environ.get,
+        os.getenv, environ[...], setdefault) and checked against
+        docs/cli.md; non-PIO vars and documented flags stay quiet."""
+        root = make_tree(tmp_path, {
+            f"{PKG}/ops/__init__.py": "",
+            f"{PKG}/ops/kern.py": """\
+                import os
+
+                def modes():
+                    a = os.environ.get("PIO_DOCUMENTED_FLAG", "auto")
+                    b = os.environ.get("PIO_GHOST_GET", "")
+                    c = os.getenv("PIO_GHOST_GETENV")
+                    d = os.environ["PIO_GHOST_SUBSCRIPT"]
+                    e = os.environ.setdefault("PIO_GHOST_SETDEFAULT", "1")
+                    f = os.environ.get("XLA_FLAGS", "")   # not PIO_*
+                    return a, b, c, d, e, f
+            """,
+            "docs/cli.md": "env: PIO_DOCUMENTED_FLAG\n",
+        })
+        report = lint(root, "PL04")
+        got = {s for s in symbols(report) if s.startswith("env:")}
+        assert got == {"env:PIO_GHOST_GET", "env:PIO_GHOST_GETENV",
+                       "env:PIO_GHOST_SUBSCRIPT",
+                       "env:PIO_GHOST_SETDEFAULT"}
+
 
 # -- PL05: resilience hygiene -------------------------------------------------
 

@@ -274,3 +274,117 @@ class TestTPULowering:
                                        n_valid=2000),
                      jax.ShapeDtypeStruct((8, 64), jnp.float32),
                      jax.ShapeDtypeStruct((2048, 64), jnp.float32))
+
+    def test_gather_gram(self):
+        import jax
+        import jax.export  # plain `jax.export` attr access raises on 0.4.x
+        from predictionio_tpu.ops.gram import gather_gram
+
+        txt = jax.export.export(jax.jit(gather_gram), platforms=["tpu"])(
+            jax.ShapeDtypeStruct((26744, 64), jnp.float32),
+            jax.ShapeDtypeStruct((300, 512), jnp.int32),
+            jax.ShapeDtypeStruct((300, 512), jnp.float32),
+            jax.ShapeDtypeStruct((300, 512), jnp.float32)).mlir_module()
+        assert "tpu_custom_call" in txt, txt[:300]
+
+
+class TestGatherGram:
+    """Fused gather→weighted-Gram kernel (ISSUE 17) vs the XLA
+    gather+einsum reference, interpret mode — every bucket width the
+    ALS ladder produces, plus the padding/degenerate geometries."""
+
+    def _data(self, R, C, k, n_other=999, seed=0, dtype=np.float32):
+        rng = np.random.default_rng(seed)
+        F = rng.standard_normal((n_other, k)).astype(dtype)
+        idx = rng.integers(0, n_other, (R, C)).astype(np.int32)
+        wo = rng.uniform(0, 2, (R, C)).astype(np.float32)
+        wb = rng.uniform(0, 2, (R, C)).astype(np.float32)
+        # sprinkle masked-out columns (weight 0) like real PAD entries
+        wo[rng.uniform(size=(R, C)) < 0.2] = 0.0
+        wb[wo == 0.0] = 0.0
+        return F, idx, wo, wb
+
+    def _ref(self, F, idx, wo, wb):
+        G = F[idx].astype(np.float64)  # exact-order-free reference
+        A = np.einsum("rc,rck,rcl->rkl", wo.astype(np.float64), G, G)
+        b = np.einsum("rc,rck->rk", wb.astype(np.float64), G)
+        return A, b
+
+    def _check(self, R, C, k, **kw):
+        from predictionio_tpu.ops.gram import gather_gram
+
+        F, idx, wo, wb = self._data(R, C, k, **kw)
+        A, b = gather_gram(jnp.asarray(F), jnp.asarray(idx),
+                           jnp.asarray(wo), jnp.asarray(wb),
+                           interpret=True)
+        An, bn = self._ref(F, idx, wo, wb)
+        assert A.shape == (R, k, k) and b.shape == (R, k)
+        # f32 accumulation error grows with the C-length reduction;
+        # the f64 reference is order-free so scale atol with sqrt(C)
+        tol = dict(rtol=1e-4, atol=2e-5 * np.sqrt(C))
+        np.testing.assert_allclose(np.asarray(A), An, **tol)
+        np.testing.assert_allclose(np.asarray(b), bn, **tol)
+
+    @pytest.mark.parametrize("C", [8, 32, 128, 512, 2048, 8192])
+    def test_every_ladder_width(self, C):
+        # R=16 divides the RB=8 row block exactly — no pad rows
+        self._check(16, C, 13)
+
+    @pytest.mark.parametrize("C", [8, 512])
+    def test_pad_rows(self, C):
+        # R=3 forces padding up to the RB=8 row block; the padded
+        # rows must not leak into the first R outputs
+        self._check(3, C, 13)
+
+    def test_bf16_factors(self):
+        from predictionio_tpu.ops.gram import gather_gram
+
+        F, idx, wo, wb = self._data(16, 32, 8, dtype=np.float32)
+        A32, b32 = gather_gram(jnp.asarray(F), jnp.asarray(idx),
+                               jnp.asarray(wo), jnp.asarray(wb),
+                               interpret=True)
+        A16, b16 = gather_gram(jnp.asarray(F, jnp.bfloat16),
+                               jnp.asarray(idx), jnp.asarray(wo),
+                               jnp.asarray(wb), interpret=True)
+        assert A16.dtype == jnp.float32  # accumulation stays f32
+        # bf16 carries an 8-bit mantissa: products of two quantized
+        # values drift ~1%, so judge by absolute error at this scale
+        np.testing.assert_allclose(np.asarray(A16), np.asarray(A32),
+                                   rtol=5e-2, atol=1e-1)
+        np.testing.assert_allclose(np.asarray(b16), np.asarray(b32),
+                                   rtol=5e-2, atol=1e-1)
+
+    def test_empty_rows(self):
+        from predictionio_tpu.ops.gram import gather_gram
+
+        F = jnp.zeros((10, 5), jnp.float32)
+        A, b = gather_gram(F, jnp.zeros((0, 8), jnp.int32),
+                           jnp.zeros((0, 8), jnp.float32),
+                           jnp.zeros((0, 8), jnp.float32), interpret=True)
+        assert A.shape == (0, 5, 5) and b.shape == (0, 5)
+
+    def test_xla_reference_matches_numpy(self):
+        from predictionio_tpu.ops.gram import gather_gram_xla
+
+        F, idx, wo, wb = self._data(7, 32, 5, seed=3)
+        A, b = gather_gram_xla(jnp.asarray(F), jnp.asarray(idx),
+                               jnp.asarray(wo), jnp.asarray(wb))
+        An, bn = self._ref(F, idx, wo, wb)
+        np.testing.assert_allclose(np.asarray(A), An, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(b), bn, rtol=1e-5, atol=1e-5)
+
+    def test_resolve_gram_mode_env(self, monkeypatch):
+        from predictionio_tpu.ops import gram as g
+
+        monkeypatch.setenv("PIO_PALLAS_GRAM", "0")
+        assert g.resolve_gram_mode("tpu") == "off"
+        monkeypatch.setenv("PIO_PALLAS_GRAM", "off")
+        assert g.resolve_gram_mode("tpu") == "off"
+        monkeypatch.setenv("PIO_PALLAS_GRAM", "interpret")
+        assert g.resolve_gram_mode("cpu") == "interpret"
+        # force on a non-TPU platform warns and falls back to off
+        monkeypatch.setenv("PIO_PALLAS_GRAM", "1")
+        assert g.resolve_gram_mode("cpu") == "off"
+        # auto never picks the kernel off-TPU
+        monkeypatch.delenv("PIO_PALLAS_GRAM")
+        assert g.resolve_gram_mode("cpu") == "off"
