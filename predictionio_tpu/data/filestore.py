@@ -169,9 +169,33 @@ class NativeEventLogStore(EventStore):
         # durable-ack mode: fsync after each append call (one sync per
         # group commit, not per event — pel_sync covers the whole batch)
         self._durable = False
+        # leader-side replication (data/replication.Replicator): when
+        # set, every committed mutation pushes its active-file tail to
+        # the followers before the call returns, and a fenced
+        # ex-leader's writes are refused before any byte lands
+        self._replicator = None
 
     def set_durable(self, durable: bool = True) -> None:
         self._durable = durable
+
+    def set_replicator(self, replicator) -> None:
+        """Attach (or detach, with None) the event-plane replicator.
+        Hooks run under each namespace's writer lock, so followers see
+        mutations in exactly the commit order."""
+        self._replicator = replicator
+
+    def _repl_commit(self, ns: LogNamespace) -> None:
+        """Post-append tail of every write path (must hold ns.lock):
+        push the new active-file bytes to the followers, then roll if
+        over threshold — and if rolled, ship the seal (digest included)
+        so the follower renames its byte-identical copy in lockstep."""
+        r = self._replicator
+        if r is None:
+            ns.maybe_roll(self.segment_bytes)
+            return
+        r.on_append(ns)
+        if ns.maybe_roll(self.segment_bytes):
+            r.on_seal(ns, ns.sealed[-1])
 
     # -- plumbing ----------------------------------------------------------
 
@@ -415,6 +439,10 @@ class NativeEventLogStore(EventStore):
                         self._lib.pel_delete(ns.h, b, len(b))
                     if ns.sealed:
                         ns.tombstone_sealed(client_ids)
+                    if self._replicator is not None:
+                        # cross-shard tombstones are appended frames:
+                        # ship them so followers converge per shard
+                        self._replicator.on_append(ns)
         return ids  # type: ignore[return-value]
 
     def _append_frames(self, ns: LogNamespace, frames: List[bytes],
@@ -423,6 +451,8 @@ class NativeEventLogStore(EventStore):
         # partitions — and different writer shards of one hot partition
         # — never contend; rollover swaps the active handle under the
         # same lock
+        if self._replicator is not None:
+            self._replicator.check_fenced()
         with ns.lock:
             h = ns.h
             for lo in range(0, len(frames), self._APPEND_CHUNK):
@@ -440,7 +470,7 @@ class NativeEventLogStore(EventStore):
                 # filters, so a brand-new id never stalls the writer
                 # lock behind a cold-tier fetch
                 ns.tombstone_sealed(client_ids)
-            ns.maybe_roll(self.segment_bytes)
+            self._repl_commit(ns)
 
     def append_jsonl(
         self, lines: bytes, n_lines: int, app_id: int,
@@ -472,6 +502,8 @@ class NativeEventLogStore(EventStore):
         """
         import time as _time
 
+        if self._replicator is not None:
+            self._replicator.check_fenced()
         ns = self._ns(app_id, channel_id)
         status = ctypes.create_string_buffer(n_lines)
         now_us = int(_time.time() * 1e6)
@@ -519,22 +551,29 @@ class NativeEventLogStore(EventStore):
                             pass
                 if ids:
                     ns.tombstone_sealed(ids)
-            ns.maybe_roll(self.segment_bytes)
+            self._repl_commit(ns)
         fallback = [i for i in range(n_lines) if status.raw[i] == 1]
         return int(n), fallback
 
     def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
+        if self._replicator is not None:
+            self._replicator.check_fenced()
         b = event_id.encode()
         deleted = False
         # the live copy sits in at most one segment of one shard, but a
         # resharded id may have stale copies elsewhere — walk them all
         for ns in self._all_ns(app_id, channel_id):
-            r = self._lib.pel_delete(ns.h, b, len(b))
-            if r < 0:
-                raise IOError("event log delete failed")
-            if r:
-                deleted = True
-                continue
+            with ns.lock:
+                r = self._lib.pel_delete(ns.h, b, len(b))
+                if r < 0:
+                    raise IOError("event log delete failed")
+                if r:
+                    deleted = True
+                    if self._replicator is not None:
+                        # the tombstone is an APPENDED frame — same
+                        # tail-ship as any other committed mutation
+                        self._replicator.on_append(ns)
+                    continue
             if ns.sealed and ns.tombstone_sealed([event_id]):
                 deleted = True
         return deleted

@@ -492,6 +492,50 @@ def check_model_registry(root: str,
     return reports
 
 
+def check_replica_state(home: str) -> Optional[Dict[str, object]]:
+    """Follower cursor doc (``<home>/replica_state.json``, written by
+    data/replication.py): must be well-formed JSON, and no cursor may
+    claim more replicated bytes than the active file actually holds —
+    an offset past EOF means the follower acked bytes it does not
+    have, which is a replication bug, not a crash artifact. Absent
+    file = not a follower = no-op (returns ``None``)."""
+    path = os.path.join(home, "replica_state.json")
+    if not os.path.exists(path):
+        return None
+    report: Dict[str, object] = {
+        "path": path, "artifact": "replica", "status": "ok",
+        "errors": [],
+    }
+    errors: List[str] = report["errors"]  # type: ignore[assignment]
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        cursors = doc.get("cursors")
+        if cursors is None:
+            cursors = {}
+        if not isinstance(cursors, dict):
+            raise ValueError(f"cursors is {type(cursors).__name__}")
+    except (OSError, ValueError, AttributeError) as e:
+        report["status"] = "corrupt"
+        errors.append(f"unreadable replica state: {e}")
+        return report
+    for tag in sorted(cursors):
+        cur = cursors[tag]
+        try:
+            offset = int(cur.get("offset", 0))
+        except (AttributeError, TypeError, ValueError):
+            report["status"] = "corrupt"
+            errors.append(f"{tag}: malformed cursor {cur!r}")
+            continue
+        active = os.path.join(home, "eventlog", f"{tag}.pel")
+        size = os.path.getsize(active) if os.path.exists(active) else 0
+        if offset > size:
+            report["status"] = "corrupt"
+            errors.append(f"{tag}: cursor at byte {offset} but the "
+                          f"active file holds {size}")
+    return report
+
+
 def fsck_home(home: str, repair: bool = False) -> Dict[str, object]:
     """Scan every persisted artifact under one storage home.
 
@@ -505,6 +549,10 @@ def fsck_home(home: str, repair: bool = False) -> Dict[str, object]:
     """
     artifacts: List[Dict[str, object]] = []
     quarantines: List[str] = []
+
+    rep_state = check_replica_state(home)
+    if rep_state is not None:
+        artifacts.append(rep_state)
 
     log_dir = os.path.join(home, "eventlog")
     if os.path.isdir(log_dir):

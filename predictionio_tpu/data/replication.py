@@ -1,0 +1,664 @@
+"""Replicated event plane: WAL shipping, follower apply, fencing.
+
+PR 6 made the event log durable on one box (segments, digests, cold
+tier); this module makes it survive the box. The design is classic
+primary-backup WAL shipping, specialized to the PEL on-disk contract:
+
+- **Leader side** (:class:`Replicator`): attached to a
+  :class:`~predictionio_tpu.data.filestore.NativeEventLogStore` via
+  ``set_replicator``. After every committed append (and inside the
+  same per-namespace writer lock, so ordering is exact) it tails the
+  ACTIVE segment file from the last replicated byte offset and pushes
+  the new bytes to every follower as one **WAL batch**: raw file
+  bytes + ``(namespace, segment id, start offset, crc32c, epoch)``.
+  Because the payload is the file's own bytes — 8-byte ``PELOGv2``
+  header included — a follower that applies every batch holds a
+  **byte-identical** copy: same frames, same CRCs, same digests,
+  ``pio fsck``-clean by construction. Rollover ships a **seal**
+  command carrying the sealed file's sha256; the follower renames its
+  copy and refuses a digest mismatch exactly like the cold-tier fetch
+  path does.
+
+- **Follower side** (:class:`ReplicaHome`): a pure-Python applier
+  over a storage-home-shaped directory (``<home>/eventlog/...``). It
+  needs no native engine while following — it appends verified bytes,
+  maintains ``segments.json`` manifests compatible with
+  :class:`~predictionio_tpu.data.segments.SegMeta`, and persists an
+  acked-offset cursor in ``replica_state.json``. On promotion the
+  event server simply opens a real store over the same home.
+
+- **Fencing**: every batch carries the leader's **epoch** — its
+  fencing token from the shared election lease (the
+  ``TrainerLease`` pattern, see ``server/repl_server.py``). A
+  follower records the highest epoch it has seen and refuses anything
+  older (:class:`StaleEpochError`), so a demoted leader's late pushes
+  can never land. Locally, a demoted leader's own appends raise
+  :class:`FencedWriteError` before touching the log.
+
+Failure handling is explicit, never silent: a CRC mismatch on a
+batch is :class:`WalTornError` (drilled via the
+``replication.wal.torn`` byte-flip site), an offset mismatch is
+:class:`WalGapError` and the error carries the follower's true cursor
+so the leader can resend from it, and sealed segments the push stream
+missed (or whose digest moved under tombstone re-seals) are healed by
+:meth:`ReplicaHome.sync_sealed` — a digest-verified full-file fetch
+riding the same blob+sha discipline as ``LogNamespace.ship``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from predictionio_tpu.data.pel_integrity import PEL_MAGIC, crc32c
+from predictionio_tpu.data.segments import MANIFEST_SCHEMA, SegMeta
+from predictionio_tpu.utils import faults, tracing
+from predictionio_tpu.utils.atomic_write import atomic_write_text
+from predictionio_tpu.utils.metrics import REGISTRY
+
+_U32 = struct.Struct("<I")
+
+#: follower state gauge values (documented in docs/observability.md)
+STATE_IDLE, STATE_FOLLOWING, STATE_PROMOTING, STATE_LEADER, STATE_FENCED = (
+    0, 1, 2, 3, 4)
+
+REPL_LAG_BYTES = REGISTRY.gauge(
+    "pio_repl_lag_bytes",
+    "Active-segment bytes appended on the leader but not yet acked by "
+    "the follower", ("follower",))
+REPL_LAG_RECORDS = REGISTRY.gauge(
+    "pio_repl_lag_records",
+    "Event records appended on the leader but not yet acked by the "
+    "follower", ("follower",))
+REPL_EPOCH = REGISTRY.gauge(
+    "pio_repl_epoch",
+    "This node's current replication fencing epoch (the election "
+    "lease token)")
+REPL_STATE = REGISTRY.gauge(
+    "pio_repl_follower_state",
+    "Replication role state: 0 idle, 1 following, 2 promoting, "
+    "3 leader, 4 fenced (demoted)")
+REPL_BATCHES = REGISTRY.counter(
+    "pio_repl_batches_total",
+    "WAL batches applied/refused by result (ok, stale_epoch, "
+    "crc_refused, gap, error)", ("result",))
+REPL_PROMOTIONS = REGISTRY.counter(
+    "pio_repl_promotions_total", "Follower promotions to leader")
+REPL_SEALS = REGISTRY.counter(
+    "pio_repl_seals_total",
+    "Sealed-segment transfers applied on the follower by result",
+    ("result",))
+
+
+class ReplicationError(RuntimeError):
+    """Base class for replication protocol failures."""
+
+
+class StaleEpochError(ReplicationError):
+    """A write carried a fencing epoch older than one already seen —
+    a demoted leader is trying to land a late write. Always refused."""
+
+
+class WalTornError(ReplicationError):
+    """A WAL batch failed its CRC — torn or corrupted in flight. The
+    follower's log is untouched; the leader must resend."""
+
+
+class WalGapError(ReplicationError):
+    """A WAL batch does not start where the follower's log ends.
+    Carries the follower's true cursor so the leader can resend."""
+
+    def __init__(self, message: str, seg_id: int, offset: int) -> None:
+        super().__init__(message)
+        self.seg_id = seg_id
+        self.offset = offset
+
+
+class FencedWriteError(ReplicationError):
+    """A local append was attempted on a node whose leadership was
+    lost. Raised BEFORE bytes touch the log — a demoted leader can
+    never corrupt the log it no longer owns."""
+
+
+# -- WAL batch ----------------------------------------------------------------
+
+
+@dataclass
+class WalBatch:
+    """One replicated chunk of an active segment file."""
+
+    ns_tag: str            # e.g. "events_1" / "events_1_2" / "events_1.s1"
+    seg_id: int            # the id this ACTIVE file will get when sealed
+    offset: int            # byte offset the payload starts at
+    payload: bytes         # raw file bytes (offset 0 includes the header)
+    crc: int               # crc32c over payload
+    epoch: int             # leader's fencing token
+    records: int = 0       # complete frames in the payload (lag metric)
+
+    @classmethod
+    def build(cls, ns_tag: str, seg_id: int, offset: int, payload: bytes,
+              epoch: int) -> "WalBatch":
+        return cls(ns_tag=ns_tag, seg_id=seg_id, offset=offset,
+                   payload=payload, crc=crc32c(payload), epoch=epoch,
+                   records=count_frames(payload, offset == 0))
+
+
+def count_frames(payload: bytes, file_start: bool, version: int = 2) -> int:
+    """Number of complete PEL frames in ``payload``. ``file_start``
+    skips the 8-byte magic header. Counts only — the byte-level CRC of
+    each frame is the follower's fsck's job, not the wire protocol's
+    (the batch has its own CRC)."""
+    off = len(PEL_MAGIC) if file_start else 0
+    trailer = 4 if version == 2 else 0
+    n = 0
+    size = len(payload)
+    while off + 5 <= size:
+        rec_len = _U32.unpack_from(payload, off)[0]
+        if rec_len < 1 or off + 4 + rec_len + trailer > size:
+            break
+        off += 4 + rec_len + trailer
+        n += 1
+    return n
+
+
+# -- follower: the replica home -----------------------------------------------
+
+REPLICA_STATE_NAME = "replica_state.json"
+
+
+class ReplicaHome:
+    """Byte-level applier over a storage-home-shaped directory.
+
+    Not a store: while following, nothing opens the files through the
+    native engine — this class appends verified bytes and keeps the
+    manifests that a real :class:`NativeEventLogStore` will read the
+    moment the node is promoted. All mutation is serialized by one
+    lock (follower apply is single-streamed by design: the leader
+    pushes in commit order)."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self.log_dir = os.path.join(root, "eventlog")
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.lock = threading.Lock()
+        self.epoch = 0
+        #: ns_tag -> {"seg": active seg id, "offset": bytes applied}
+        self.cursors: Dict[str, Dict[str, int]] = {}
+        self._load_state()
+
+    # -- persisted state ---------------------------------------------------
+
+    @property
+    def state_path(self) -> str:
+        return os.path.join(self.root, REPLICA_STATE_NAME)
+
+    def _load_state(self) -> None:
+        try:
+            with open(self.state_path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return
+        self.epoch = int(doc.get("epoch", 0))
+        self.cursors = {str(k): {"seg": int(v["seg"]),
+                                 "offset": int(v["offset"])}
+                        for k, v in doc.get("cursors", {}).items()}
+
+    def _save_state(self) -> None:
+        atomic_write_text(self.state_path, json.dumps(
+            {"epoch": self.epoch, "cursors": self.cursors},
+            indent=1, sort_keys=True))
+
+    # -- paths -------------------------------------------------------------
+
+    def active_path(self, ns_tag: str) -> str:
+        return os.path.join(self.log_dir, ns_tag + ".pel")
+
+    def seg_dir(self, ns_tag: str) -> str:
+        return os.path.join(self.log_dir, ns_tag + ".peld")
+
+    def manifest_path(self, ns_tag: str) -> str:
+        return os.path.join(self.seg_dir(ns_tag), "segments.json")
+
+    def _load_manifest(self, ns_tag: str) -> Dict[str, Any]:
+        try:
+            with open(self.manifest_path(ns_tag), "r",
+                      encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {"schema": MANIFEST_SCHEMA, "next_id": 0, "segments": []}
+
+    def _write_manifest(self, ns_tag: str, doc: Dict[str, Any]) -> None:
+        os.makedirs(self.seg_dir(ns_tag), exist_ok=True)
+        atomic_write_text(self.manifest_path(ns_tag),
+                          json.dumps(doc, indent=1, sort_keys=True))
+
+    # -- epoch fencing -----------------------------------------------------
+
+    def check_epoch(self, epoch: int) -> None:
+        """Refuse anything older than the highest epoch seen; learn a
+        newer one. Must hold ``lock``."""
+        if epoch < self.epoch:
+            REPL_BATCHES.inc(("stale_epoch",))
+            raise StaleEpochError(
+                f"write carries epoch {epoch} but this replica has "
+                f"seen epoch {self.epoch} — refusing a demoted "
+                "leader's late write")
+        if epoch > self.epoch:
+            self.epoch = epoch
+            REPL_EPOCH.set(epoch)
+
+    # -- WAL apply ---------------------------------------------------------
+
+    def cursor(self, ns_tag: str) -> Tuple[int, int]:
+        """(active seg id, applied byte offset) for one namespace."""
+        cur = self.cursors.get(ns_tag)
+        if cur is None:
+            return 0, 0
+        return cur["seg"], cur["offset"]
+
+    def apply_wal(self, batch: WalBatch) -> int:
+        """Verify and append one WAL batch; returns the new applied
+        offset. The follower-lag drill site lives here: an armed
+        ``replication.follower.lag`` latency plan slows every apply,
+        which the leader sees as ack latency → lag."""
+        faults.inject("replication.follower.lag")
+        with self.lock:
+            self.check_epoch(batch.epoch)
+            payload = faults.corrupt_bytes("replication.wal.torn",
+                                           batch.payload)
+            if crc32c(payload) != batch.crc:
+                REPL_BATCHES.inc(("crc_refused",))
+                raise WalTornError(
+                    f"WAL batch for {batch.ns_tag} @ {batch.offset} "
+                    "failed crc32c — refusing torn frame")
+            seg, off = self.cursor(batch.ns_tag)
+            path = self.active_path(batch.ns_tag)
+            have = os.path.getsize(path) if os.path.exists(path) else 0
+            # the authoritative offset is the FILE, not the cursor doc
+            # (a crash between append and state write leaves the file
+            # ahead by exactly one acked batch — trust the bytes)
+            off = max(off, have) if seg == batch.seg_id else off
+            if batch.seg_id != seg or batch.offset != off:
+                REPL_BATCHES.inc(("gap",))
+                raise WalGapError(
+                    f"WAL batch for {batch.ns_tag} starts at "
+                    f"seg {batch.seg_id}/{batch.offset} but replica is "
+                    f"at seg {seg}/{off}", seg, off)
+            if off == 0 and not payload.startswith(PEL_MAGIC):
+                REPL_BATCHES.inc(("error",))
+                raise ReplicationError(
+                    f"first batch for {batch.ns_tag} does not begin "
+                    "with the PELOGv2 header")
+            with open(path, "ab") as f:
+                f.write(payload)
+                f.flush()
+                # follower apply is single-streamed (the leader pushes
+                # serially, in commit order) — no other writer exists
+                # to stall behind this sync, and the ack contract
+                # requires it inside the cursor update
+                os.fsync(f.fileno())  # pio-lint: disable=PL03
+            new_off = off + len(payload)
+            self.cursors[batch.ns_tag] = {"seg": batch.seg_id,
+                                          "offset": new_off}
+            self._save_state()
+            REPL_BATCHES.inc(("ok",))
+            return new_off
+
+    def apply_seal(self, ns_tag: str, seg_meta: Dict[str, Any],
+                   epoch: int) -> None:
+        """The leader sealed its active segment: rename our copy into
+        the ``.peld`` dir, verify the byte-identity claim against the
+        leader's digest, and record the manifest row. A digest mismatch
+        refuses the seal and leaves the file in place for resync."""
+        meta = SegMeta.from_dict(seg_meta)
+        with self.lock:
+            self.check_epoch(epoch)
+            src = self.active_path(ns_tag)
+            if not os.path.exists(src):
+                REPL_SEALS.inc(("error",))
+                raise ReplicationError(
+                    f"seal for {ns_tag}/{meta.file} but replica has no "
+                    "active file — resync needed")
+            if meta.sha256 is not None:
+                actual = _file_sha256(src)
+                if actual != meta.sha256:
+                    REPL_SEALS.inc(("digest_mismatch",))
+                    raise ReplicationError(
+                        f"sealed segment {ns_tag}/{meta.file} digest "
+                        f"mismatch (leader {meta.sha256[:12]}…, replica "
+                        f"{actual[:12]}…) — replica diverged, resync "
+                        "needed")
+            os.makedirs(self.seg_dir(ns_tag), exist_ok=True)
+            os.rename(src, os.path.join(self.seg_dir(ns_tag), meta.file))
+            doc = self._load_manifest(ns_tag)
+            rows = [d for d in doc["segments"]
+                    if int(d.get("id", -1)) != meta.id]
+            row = meta.to_dict()
+            # local-cache sidecars (columnar, id filter) do not ship
+            # over the WAL stream; the promoted store rebuilds them
+            row["cols"] = None
+            row["idf"] = None
+            rows.append(row)
+            rows.sort(key=lambda d: int(d["id"]))
+            doc["segments"] = rows
+            doc["next_id"] = max(int(doc.get("next_id", 0)), meta.id + 1)
+            self._write_manifest(ns_tag, doc)
+            cur = self.cursors.setdefault(ns_tag, {"seg": 0, "offset": 0})
+            cur["seg"] = meta.id + 1
+            cur["offset"] = 0
+            self._save_state()
+            REPL_SEALS.inc(("ok",))
+
+    # -- sealed-segment catch-up ------------------------------------------
+
+    def sync_sealed(self, ns_tag: str, manifest: Dict[str, Any],
+                    fetch: Callable[[str, str], Optional[bytes]],
+                    epoch: int) -> int:
+        """Heal sealed segments the push stream missed: for every row
+        in the leader's ``manifest`` whose frame file we lack (or whose
+        digest moved — tombstone re-seals), fetch the blob, verify its
+        sha256, and install it. ``fetch(ns_tag, file)`` returns the
+        blob or None (cold segments have no local frame file on the
+        leader either; their manifest row is copied as-is and the
+        cold-tier digest check applies on any later fetch). Returns
+        the number of files installed."""
+        installed = 0
+        with self.lock:
+            self.check_epoch(epoch)
+            doc = self._load_manifest(ns_tag)
+            rows = {int(d["id"]): d for d in doc["segments"]}
+            for d in manifest.get("segments", []):
+                meta = SegMeta.from_dict(d)
+                path = os.path.join(self.seg_dir(ns_tag), meta.file)
+                have = rows.get(meta.id)
+                digest_ok = (os.path.exists(path) and meta.sha256
+                             and _file_sha256(path) == meta.sha256)
+                if have and digest_ok:
+                    continue
+                if meta.state != "cold":
+                    blob = fetch(ns_tag, meta.file)
+                    if blob is None:
+                        REPL_SEALS.inc(("error",))
+                        continue
+                    blob = faults.corrupt_bytes("replication.wal.torn",
+                                                blob)
+                    if meta.sha256 and _sha256(blob) != meta.sha256:
+                        REPL_SEALS.inc(("digest_mismatch",))
+                        raise ReplicationError(
+                            f"fetched segment {ns_tag}/{meta.file} "
+                            "failed digest verification — refusing it")
+                    os.makedirs(self.seg_dir(ns_tag), exist_ok=True)
+                    tmp = path + ".part"
+                    with open(tmp, "wb") as f:
+                        f.write(blob)
+                        f.flush()
+                        # catch-up runs on the follower's watch
+                        # thread; the apply stream shares this lock by
+                        # design (sealed installs must serialize with
+                        # WAL appends), so there is no writer to stall
+                        os.fsync(f.fileno())  # pio-lint: disable=PL03
+                    os.rename(tmp, path)
+                    installed += 1
+                row = meta.to_dict()
+                row["cols"] = None
+                row["idf"] = None
+                rows[meta.id] = row
+                REPL_SEALS.inc(("ok",))
+            doc["segments"] = sorted(rows.values(),
+                                     key=lambda r: int(r["id"]))
+            doc["next_id"] = max(
+                [int(manifest.get("next_id", 0)),
+                 int(doc.get("next_id", 0))]
+                + [int(r["id"]) + 1 for r in doc["segments"]])
+            self._write_manifest(ns_tag, doc)
+            cur = self.cursors.setdefault(ns_tag, {"seg": 0, "offset": 0})
+            if cur["seg"] < int(doc["next_id"]):
+                # sealed rows beyond our cursor: the active stream
+                # restarts at the leader's current active segment
+                cur["seg"] = int(doc["next_id"])
+                cur["offset"] = 0
+            self._save_state()
+        return installed
+
+    def status(self) -> Dict[str, Any]:
+        with self.lock:
+            return {"epoch": self.epoch,
+                    "cursors": {k: dict(v)
+                                for k, v in sorted(self.cursors.items())}}
+
+
+def _sha256(blob: bytes) -> str:
+    import hashlib
+
+    return hashlib.sha256(blob).hexdigest()
+
+
+def _file_sha256(path: str) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+# -- leader: the replicator ----------------------------------------------------
+
+
+class FollowerLink:
+    """Leader's view of one follower: transport + replication cursor.
+
+    The transport is injectable (``apply_fn``/``seal_fn`` — HTTP in
+    production via :class:`~predictionio_tpu.server.repl_server.`
+    ``FollowerClient``, in-process in tests). A :class:`WalGapError`
+    raised by the transport resets the cursor to the follower's true
+    position so the next push resends from there."""
+
+    def __init__(self, name: str,
+                 apply_fn: Callable[[WalBatch], int],
+                 seal_fn: Callable[[str, Dict[str, Any], int], None],
+                 status_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 ) -> None:
+        self.name = name
+        self.apply_fn = apply_fn
+        self.seal_fn = seal_fn
+        self.status_fn = status_fn
+        #: ns_tag -> [seg_id, offset] acked by this follower
+        self.cursors: Dict[str, List[int]] = {}
+        self.healthy = True
+        self.last_error: Optional[str] = None
+        self.probe_countdown = 0
+
+
+class Replicator:
+    """Leader-side push replication, attached to the native store.
+
+    ``on_append(ns)`` runs under the namespace writer lock right after
+    a committed append: it reads the active file's new bytes and
+    pushes them to every follower, waiting for acks — an acked client
+    write therefore implies the bytes are fsynced on every healthy
+    follower (semi-synchronous replication; a follower that errors is
+    marked unhealthy and skipped until it resyncs, so one dead
+    follower degrades durability, never availability)."""
+
+    def __init__(self, followers: List[FollowerLink],
+                 epoch: Callable[[], int],
+                 fenced: Callable[[], bool] = lambda: False,
+                 max_batch_bytes: int = 4 << 20) -> None:
+        self.followers = followers
+        self._epoch = epoch
+        self._fenced = fenced
+        self.max_batch_bytes = max_batch_bytes
+
+    # -- fencing (local) ---------------------------------------------------
+
+    def check_fenced(self) -> None:
+        if self._fenced():
+            raise FencedWriteError(
+                "this node's event-plane leadership was lost "
+                f"(epoch {self._epoch()}) — writes are fenced; retry "
+                "against the new leader")
+
+    # -- hooks (called by NativeEventLogStore under ns.lock) ---------------
+
+    def on_append(self, ns) -> None:
+        """Push everything between each follower's cursor and the
+        active file's current end."""
+        tag = ns.namespace_tag()
+        path = ns.base_path
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return
+        with open(path, "rb") as f:
+            for link in self.followers:
+                if not link.healthy and not self._probe(link, tag,
+                                                        ns.next_id):
+                    continue
+                self._push_range(link, tag, ns.next_id, f, size)
+
+    def _probe(self, link: FollowerLink, tag: str, seg_id: int) -> bool:
+        """Try to revive an unhealthy link: every 64th append, ask the
+        follower where it actually is (it may have healed itself via
+        :meth:`ReplicaHome.sync_sealed`). Revives the link when the
+        follower's cursor is back on the current active segment."""
+        link.probe_countdown -= 1
+        if link.probe_countdown > 0 or link.status_fn is None:
+            return False
+        link.probe_countdown = 64
+        try:
+            doc = link.status_fn()
+        except Exception as e:  # noqa: BLE001
+            link.last_error = f"{type(e).__name__}: {e}"
+            return False
+        cur = doc.get("cursors", {}).get(tag)
+        if cur is None and not doc.get("cursors"):
+            # a blank follower starts wherever we start it
+            link.cursors[tag] = [seg_id, 0]
+            link.healthy = True
+            return True
+        if cur is not None and int(cur.get("seg", -1)) == seg_id:
+            link.cursors[tag] = [seg_id, int(cur.get("offset", 0))]
+            link.healthy = True
+            return True
+        return False
+
+    def _push_range(self, link: FollowerLink, tag: str, seg_id: int,
+                    f, size: int) -> None:
+        cur = link.cursors.setdefault(tag, [seg_id, 0])
+        if cur[0] != seg_id:
+            # follower is on an older active file than we think —
+            # a seal push must have failed; mark for resync
+            link.healthy = False
+            link.last_error = (f"cursor on seg {cur[0]} but active is "
+                               f"seg {seg_id}")
+            return
+        while cur[1] < size:
+            f.seek(cur[1])
+            payload = f.read(min(size - cur[1], self.max_batch_bytes))
+            if not payload:
+                break
+            batch = WalBatch.build(tag, seg_id, cur[1], payload,
+                                   self._epoch())
+            try:
+                with tracing.span("repl.push", follower=link.name,
+                                  ns=tag, bytes=len(payload)):
+                    acked = link.apply_fn(batch)
+                cur[1] = acked
+                link.last_error = None
+            except WalGapError as e:
+                if e.seg_id != seg_id:
+                    link.healthy = False
+                    link.last_error = str(e)
+                    break
+                cur[1] = e.offset        # resend from the true cursor
+            except StaleEpochError as e:
+                link.healthy = False
+                link.last_error = str(e)
+                break
+            except Exception as e:  # noqa: BLE001 — degrade, don't block
+                link.healthy = False
+                link.last_error = f"{type(e).__name__}: {e}"
+                break
+            self._lag(link, tag, size, cur[1])
+        self._lag(link, tag, size, cur[1], f)
+
+    def _lag(self, link: FollowerLink, tag: str, size: int,
+             acked: int, f=None) -> None:
+        lag = max(0, size - acked)
+        REPL_LAG_BYTES.set(lag, (link.name,))
+        if lag == 0:
+            REPL_LAG_RECORDS.set(0, (link.name,))
+        elif f is not None:
+            f.seek(acked)
+            rem = f.read(lag)
+            REPL_LAG_RECORDS.set(count_frames(rem, acked == 0),
+                                 (link.name,))
+
+    def on_seal(self, ns, seg) -> None:
+        """The active segment just rolled: finalize its digest (the
+        follower verifies byte identity against it) and push the seal.
+        Cursors move to (new active seg id, 0)."""
+        ns.finalize(seg)
+        tag = ns.namespace_tag()
+        meta = seg.meta.to_dict()
+        for link in self.followers:
+            if not link.healthy:
+                continue
+            cur = link.cursors.setdefault(tag, [seg.meta.id, 0])
+            try:
+                # drain any unpushed tail of the sealed file first
+                sealed_path = ns.seg_path(seg)
+                with open(sealed_path, "rb") as f:
+                    size = os.path.getsize(sealed_path)
+                    self._push_range(link, tag, seg.meta.id, f, size)
+                if not link.healthy:
+                    continue
+                link.seal_fn(tag, meta, self._epoch())
+                cur[0] = seg.meta.id + 1
+                cur[1] = 0
+            except Exception as e:  # noqa: BLE001
+                link.healthy = False
+                link.last_error = f"{type(e).__name__}: {e}"
+
+    def status(self) -> Dict[str, Any]:
+        return {"followers": [
+            {"name": l.name, "healthy": l.healthy,
+             "lastError": l.last_error,
+             "cursors": {k: list(v) for k, v in sorted(l.cursors.items())}}
+            for l in self.followers]}
+
+
+# -- read fan-out --------------------------------------------------------------
+
+
+def select_read_home(read_from: str, leader_home: str,
+                     replica_home: Optional[str] = None) -> str:
+    """Resolve ``--read-from follower|leader|any`` to a storage home.
+
+    ``follower`` requires a replica home (``--replica-home`` or
+    ``PIO_REPL_REPLICA_HOME``) holding a replicated event log;
+    ``any`` prefers the replica when it exists (training reads then
+    never contend with the leader's ingest fsyncs) and falls back to
+    the leader's home; ``leader`` is the default passthrough."""
+    replica_home = replica_home or os.environ.get("PIO_REPL_REPLICA_HOME")
+    if read_from == "leader":
+        return leader_home
+    has_replica = bool(replica_home) and os.path.isdir(
+        os.path.join(replica_home, "eventlog"))
+    if read_from == "follower":
+        if not has_replica:
+            raise ValueError(
+                "--read-from follower needs a replica home with a "
+                "replicated event log (set --replica-home or "
+                "PIO_REPL_REPLICA_HOME)")
+        return replica_home  # type: ignore[return-value]
+    if read_from == "any":
+        return replica_home if has_replica else leader_home
+    raise ValueError(f"unknown --read-from {read_from!r} "
+                     "(want follower|leader|any)")

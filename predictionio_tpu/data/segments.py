@@ -84,6 +84,9 @@ SEG_COMPACTIONS = REGISTRY.counter(
     "pio_segment_compactions_total", "Sealed segments compacted to columnar")
 SEG_SHIPPED = REGISTRY.counter(
     "pio_segment_shipped_total", "Sealed segments shipped to the cold tier")
+SEG_SHIP_VERIFY = REGISTRY.counter(
+    "pio_segment_ship_verify_total",
+    "Post-ship cold-tier read-back digest checks by result", ("result",))
 SEG_FETCHES = REGISTRY.counter(
     "pio_segment_fetches_total", "Cold segments fetched back on demand")
 SEG_MAINT_ERRORS = REGISTRY.counter(
@@ -842,10 +845,19 @@ class LogNamespace:
     def namespace_tag(self) -> str:
         return os.path.splitext(os.path.basename(self.base_path))[0]
 
-    def ship(self, seg: Segment, tier=None) -> bool:
+    def ship(self, seg: Segment, tier=None, verify: bool = False) -> bool:
         """Ship one sealed segment's frame file to the cold tier and
         drop the local copy (the compaction sidecar stays local, so
-        warm scans never refetch)."""
+        warm scans never refetch).
+
+        ``verify`` closes the silent-ship-corruption gap: after the
+        put, re-fetch the object from the tier and compare its sha256
+        against the manifest digest BEFORE trusting the cold copy and
+        unlinking the local file. A mismatch (bit rot in flight, a
+        lying proxy, an eventually-consistent tier serving a stale
+        body) deletes the bad remote object, keeps the local file, and
+        raises :class:`IntegrityError` — the segment stays ``sealed``
+        and a later ship retries."""
         tier = tier or cold_tier()
         if tier is None:
             return False
@@ -870,6 +882,23 @@ class LogNamespace:
         with tracing.span("storage.segment.ship", key=key,
                           bytes=len(blob)):
             tier.put(key, blob)
+        if verify:
+            with tracing.span("storage.segment.ship_verify", key=key):
+                back = tier.get(key)
+                digest = sha256_hex(back) if back is not None else None
+                if digest != seg.meta.sha256:
+                    SEG_SHIP_VERIFY.inc(("mismatch",))
+                    try:
+                        tier.delete(key)
+                    except Exception:  # noqa: BLE001 — best effort
+                        pass
+                    raise IntegrityError(
+                        f"cold tier read-back of {key} does not match "
+                        f"the manifest digest (got "
+                        f"{digest[:12] if digest else 'nothing'}…, want "
+                        f"{seg.meta.sha256[:12]}…) — keeping the local "
+                        "copy, remote object deleted")
+                SEG_SHIP_VERIFY.inc(("ok",))
         with self.lock:
             seg.meta.state = "cold"
             seg.meta.remote_key = key

@@ -40,6 +40,7 @@ from predictionio_tpu.server.http import (
     Router,
     traces_handler,
 )
+from predictionio_tpu.data.replication import FencedWriteError
 from predictionio_tpu.server.ingest import IngestOverload, StorageUnavailable
 from predictionio_tpu.server.tenancy import TenantQuotas
 from predictionio_tpu.storage.registry import Storage, get_storage
@@ -172,8 +173,14 @@ class EventServer:
         tenant_quotas: Optional[Any] = None,
         scrape_interval: float = 10.0,
         incident_dir: Optional[str] = None,
+        replication: Optional[Any] = None,
     ) -> None:
         self.storage = storage or get_storage()
+        # replicated event plane (server/repl_server.ReplNode): when
+        # set, every event-data handler passes through its gate —
+        # followers 307 to the leader, fenced ex-leaders shed 503 —
+        # and the node mounts its /repl/* wire on this router
+        self.repl = replication
         # per-app QoS policy (quotas.json next to the event data,
         # written by `pio app quota`): ingest token buckets + writer
         # shard counts. Zero-config default is unlimited/1-shard, so
@@ -275,6 +282,8 @@ class EventServer:
         router.route("GET", "/stats.json", self._get_stats)
         router.route("POST", "/webhooks/{connector}.json", self._webhook)
         router.route("GET", "/webhooks/{connector}.json", self._webhook_probe)
+        if self.repl is not None:
+            self.repl.attach(self, router)
         if ssl_context is None:
             from predictionio_tpu.server.ssl_config import ssl_context_from_env
             ssl_context = ssl_context_from_env()
@@ -322,6 +331,14 @@ class EventServer:
 
     def _check_permitted(self, allowed: List[str], name: str) -> bool:
         return not allowed or name in allowed
+
+    def _repl_gate(self, req: Request) -> Optional[Response]:
+        """Replication role gate for event-data routes: None when this
+        node serves, else the follower's 307-to-leader redirect or the
+        fenced ex-leader's 503. Observability routes are never gated."""
+        if self.repl is None:
+            return None
+        return self.repl.gate(req)
 
     # -- handlers --------------------------------------------------------------
 
@@ -456,8 +473,14 @@ class EventServer:
         if deny is not None:
             return deny
         if self._ingest is None:
-            status, body = await asyncio.to_thread(
-                self._insert_one, obj, app_id, channel_id, allowed)
+            try:
+                status, body = await asyncio.to_thread(
+                    self._insert_one, obj, app_id, channel_id, allowed)
+            except FencedWriteError as e:
+                # demotion raced this write: the bytes never landed —
+                # an honest 503 sends the client to the new leader
+                self._m_events.inc((app_id, 503))
+                return self._throttled(503, str(e), 1.0)
             if status == 201:
                 return self._created(body["eventId"])
             return Response.json(body, status=status)
@@ -484,6 +507,11 @@ class EventServer:
             # storage breaker open: fail fast, don't queue doomed work
             self._m_events.inc((app_id, 503))
             return self._throttled(503, str(e), e.retry_after)
+        except FencedWriteError as e:
+            # this node was demoted while the event sat in the queue:
+            # the append was refused before any byte landed
+            self._m_events.inc((app_id, 503))
+            return self._throttled(503, str(e), 1.0)
         except Exception as e:
             self._m_events.inc((app_id, 500))
             return Response.json(
@@ -505,6 +533,9 @@ class EventServer:
         return Response.json(payload, status=status)
 
     async def _post_event(self, req: Request) -> Response:
+        deny = self._repl_gate(req)
+        if deny is not None:
+            return deny
         auth, err = self._auth(req)
         if err:
             return err
@@ -512,6 +543,9 @@ class EventServer:
         return await self._ingest_obj(req.json(), app_id, channel_id, allowed)
 
     async def _post_batch(self, req: Request) -> Response:
+        deny = self._repl_gate(req)
+        if deny is not None:
+            return deny
         auth, err = self._auth(req)
         if err:
             return err
@@ -543,6 +577,12 @@ class EventServer:
                                       app_id=app_id, records=len(events)):
                         ids = self.storage.events.insert_batch(
                             events, app_id, channel_id)
+                except FencedWriteError as e:
+                    # demoted mid-batch: nothing landed; every item
+                    # gets the same honest shed status
+                    self._m_events.inc((app_id, 503))
+                    return [{"status": 503, "message": str(e)}
+                            for _ in events]
                 except Exception:
                     pass
                 else:
@@ -561,6 +601,10 @@ class EventServer:
                 t1 = time.perf_counter()
                 try:
                     eid = self.storage.events.insert(ev, app_id, channel_id)
+                except FencedWriteError as e:
+                    self._m_events.inc((app_id, 503))
+                    results.append({"status": 503, "message": str(e)})
+                    continue
                 except Exception as e:
                     self._m_events.inc((app_id, 500))
                     results.append({"status": 500,
@@ -574,6 +618,9 @@ class EventServer:
         return Response.json(await asyncio.to_thread(run))
 
     async def _get_events(self, req: Request) -> Response:
+        deny = self._repl_gate(req)
+        if deny is not None:
+            return deny
         auth, err = self._auth(req)
         if err:
             return err
@@ -607,6 +654,9 @@ class EventServer:
         return Response.json(out)
 
     async def _get_event(self, req: Request) -> Response:
+        deny = self._repl_gate(req)
+        if deny is not None:
+            return deny
         auth, err = self._auth(req)
         if err:
             return err
@@ -618,6 +668,9 @@ class EventServer:
         return Response.json(ev.to_json())
 
     async def _delete_event(self, req: Request) -> Response:
+        deny = self._repl_gate(req)
+        if deny is not None:
+            return deny
         auth, err = self._auth(req)
         if err:
             return err
@@ -638,6 +691,9 @@ class EventServer:
     async def _webhook(self, req: Request) -> Response:
         from predictionio_tpu.data.webhooks import get_connector
 
+        deny = self._repl_gate(req)
+        if deny is not None:
+            return deny
         auth, err = self._auth(req)
         if err:
             return err
@@ -683,6 +739,10 @@ class EventServer:
             )
 
             install_crash_handlers(self.incidents)
+        if self.repl is not None:
+            # one election attempt decides leader vs follower; the
+            # role's background threads keep it honest from here
+            self.repl.start()
         scraper = asyncio.create_task(
             scrape_loop(self.tsdb, self.scrape_interval),
             name="pio-events-tsdb")
@@ -692,6 +752,10 @@ class EventServer:
             scraper.cancel()
             with contextlib.suppress(asyncio.CancelledError):
                 await scraper
+            if self.repl is not None:
+                # a graceful leader releases the lease here so a
+                # follower promotes without waiting out the TTL
+                self.repl.stop()
             if self._ingest is not None:
                 # drain: everything accepted before shutdown commits —
                 # a 201 promised durability, so the queue must land

@@ -31,6 +31,7 @@ both sinks.
 from __future__ import annotations
 
 import json
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -48,15 +49,29 @@ from predictionio_tpu.utils.resilience import (
 
 class EventSink(ABC):
     """Delivers one feedback event; raises on failure (the caller
-    counts and swallows — feedback must never break serving)."""
+    counts and swallows — feedback must never break serving). Returns
+    the server-assigned event id when the backend reports one."""
 
     @abstractmethod
-    def send(self, event: Event) -> None:
+    def send(self, event: Event) -> Optional[str]:
         ...
 
 
 class HTTPEventSink(EventSink):
-    """Authenticated POST to an Event Server's ``/events.json``."""
+    """Authenticated POST to an Event Server's ``/events.json``.
+
+    Understands the replicated event plane: a follower answers writes
+    with ``307`` + ``Location`` pointing at the current leader, so the
+    sink re-POSTs there (bounded hops — a redirect loop between two
+    confused nodes must not spin forever; any ``Retry-After`` on the
+    redirect is honored first). A redirect onto a node that just died
+    surfaces as a retryable error, and the backoff retry re-enters at
+    the ORIGINAL url — whose redirect points at the NEW leader once
+    promotion lands. Writers therefore never hard-fail across a
+    failover."""
+
+    #: additional hops followed after the initial POST
+    REDIRECT_HOPS = 4
 
     def __init__(self, url: str, access_key: str,
                  channel: Optional[str] = None,
@@ -70,38 +85,65 @@ class HTTPEventSink(EventSink):
         self.retries = retries
         self.breaker = breaker
 
-    def _post(self, event: Event) -> None:
+    def _post(self, event: Event) -> Optional[str]:
         faults.inject("eventsink.send")
         qs: Dict[str, str] = {"accessKey": self.access_key}
         if self.channel:
             qs["channel"] = self.channel
-        req = urllib.request.Request(
-            f"{self.url}/events.json?{urllib.parse.urlencode(qs)}",
-            data=json.dumps(event.to_json()).encode("utf-8"),
-            headers={"Content-Type": "application/json"},
-            method="POST")
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                if resp.status not in (200, 201):
-                    raise RuntimeError(f"event server returned {resp.status}")
-        except urllib.error.HTTPError as e:
-            hint = parse_retry_after(e.headers.get("Retry-After"))
-            if e.code == 429:
-                # backpressure, not rejection: retryable, and the
-                # server's Retry-After hint overrides our backoff guess
-                err = RuntimeError("event server throttled feedback: 429")
+        target = f"{self.url}/events.json?{urllib.parse.urlencode(qs)}"
+        body = json.dumps(event.to_json()).encode("utf-8")
+        for hop in range(self.REDIRECT_HOPS + 1):
+            req = urllib.request.Request(
+                target, data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout) as resp:
+                    if resp.status not in (200, 201):
+                        raise RuntimeError(
+                            f"event server returned {resp.status}")
+                    try:
+                        doc = json.loads(resp.read())
+                    except ValueError:
+                        return None
+                    return (doc or {}).get("eventId")
+            except urllib.error.HTTPError as e:
+                hint = parse_retry_after(e.headers.get("Retry-After"))
+                if e.code in (307, 308):
+                    # follower → leader redirect (urllib refuses to
+                    # auto-resend a POST body, so we follow by hand)
+                    loc = e.headers.get("Location")
+                    if loc and hop < self.REDIRECT_HOPS:
+                        target = urllib.parse.urljoin(target, loc)
+                        if hint:
+                            time.sleep(min(hint, 1.0))
+                        continue
+                    err = RuntimeError(
+                        f"event server redirect not followable after "
+                        f"{hop} hop(s): {e.code}")
+                    err.retry_after = hint
+                    raise err from e
+                if e.code == 429:
+                    # backpressure, not rejection: retryable, and the
+                    # server's Retry-After hint overrides our backoff
+                    # guess
+                    err = RuntimeError(
+                        "event server throttled feedback: 429")
+                    err.retry_after = hint
+                    raise err from e
+                if e.code < 500:
+                    # deterministic rejection (bad key, bad event):
+                    # raise a type outside retry_on so it is NOT
+                    # retried
+                    raise ValueError(
+                        f"event server rejected feedback: {e.code}") from e
+                err = RuntimeError(f"event server returned {e.code}")
                 err.retry_after = hint
                 raise err from e
-            if e.code < 500:
-                # deterministic rejection (bad key, bad event): raise a
-                # type outside retry_on so it is NOT retried
-                raise ValueError(
-                    f"event server rejected feedback: {e.code}") from e
-            err = RuntimeError(f"event server returned {e.code}")
-            err.retry_after = hint
-            raise err from e
+        raise RuntimeError("unreachable: redirect loop guard")
 
-    def send(self, event: Event) -> None:
+    def send(self, event: Event) -> Optional[str]:
         # retry transient delivery failures (short, jittered — feedback
         # is best-effort and must not occupy its worker for long), but
         # NOT client errors: a 4xx (bad key, bad event) is deterministic
@@ -112,9 +154,8 @@ class HTTPEventSink(EventSink):
                 retry_on=(OSError, RuntimeError),
             )(self._post)
             if self.breaker is not None:
-                self.breaker.call(attempt, event)
-            else:
-                attempt(event)
+                return self.breaker.call(attempt, event)
+            return attempt(event)
 
 
 class DirectEventSink(EventSink):
@@ -124,10 +165,10 @@ class DirectEventSink(EventSink):
         self.storage = storage
         self.app_name = app_name
 
-    def send(self, event: Event) -> None:
+    def send(self, event: Event) -> Optional[str]:
         with tracing.span("sink.send", sink="direct", app=self.app_name):
             faults.inject("eventsink.send")
             app = self.storage.meta.get_app_by_name(self.app_name)
             if app is None:
                 raise ValueError(f"no app named {self.app_name!r}")
-            self.storage.events.insert(event, app.id)
+            return self.storage.events.insert(event, app.id)

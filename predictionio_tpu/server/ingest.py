@@ -57,6 +57,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.replication import FencedWriteError
 from predictionio_tpu.utils import faults, tracing
 from predictionio_tpu.utils.resilience import CircuitBreaker
 
@@ -315,6 +316,16 @@ class WriteCoalescer:
                     raise RuntimeError(
                         f"insert_batch returned {len(ids)} ids for "
                         f"{len(events)} events")
+            except FencedWriteError as e:
+                # leadership lost, not a storage outage: the breaker
+                # must stay closed (storage is fine — WE are fenced)
+                # and per-item isolation is pointless (every retry
+                # refuses identically); every caller sees the fence
+                sp.set_error(f"fenced: {e}")
+                for _, fut, _ in pairs:
+                    if not fut.done():
+                        fut.set_exception(e)
+                return
             except Exception as e:
                 self.breaker.record_failure()
                 sp.set_error(f"{type(e).__name__}: {e}")

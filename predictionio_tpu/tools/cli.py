@@ -189,6 +189,19 @@ def cmd_eventserver(args: argparse.Namespace) -> None:
     from predictionio_tpu.server.event_server import EventServer
 
     _configure_tracing(args)
+    replication = None
+    if args.lease_home:
+        from predictionio_tpu.server.repl_server import ReplNode
+        from predictionio_tpu.storage.registry import StorageConfig
+
+        ip = args.ip if args.ip not in ("0.0.0.0", "::") else "127.0.0.1"
+        advertise = args.advertise_url or f"http://{ip}:{args.port}"
+        replication = ReplNode(
+            lease_home=args.lease_home,
+            advertise_url=advertise,
+            home=StorageConfig.from_env().home,
+            replicate_to=args.replicate_to,
+            lease_ttl=args.lease_ttl)
     server = EventServer(host=args.ip, port=args.port, stats=args.stats,
                          ingest_batching=args.ingest_batching,
                          ingest_max_batch=args.ingest_max_batch,
@@ -198,8 +211,11 @@ def cmd_eventserver(args: argparse.Namespace) -> None:
                          access_log=args.access_log,
                          segment_maintenance=args.segment_maintenance,
                          tenant_quotas=args.tenant_quotas,
-                         incident_dir=_incident_dir(args))
+                         incident_dir=_incident_dir(args),
+                         replication=replication)
     mode = "group-commit" if args.ingest_batching else "per-event commit"
+    if replication is not None:
+        mode += f", replicated event plane ({replication.advertise_url})"
     print(f"[info] Event Server listening on {args.ip}:{args.port} ({mode})")
     server.run()
 
@@ -424,6 +440,16 @@ def cmd_train(args: argparse.Namespace) -> None:
         # per-invocation override of the segment-scan fan-out; the
         # EVENTLOG store reads it wherever the Storage gets built
         os.environ["PIO_SCAN_WORKERS"] = str(args.scan_workers)
+    if getattr(args, "read_from", "leader") != "leader":
+        from predictionio_tpu.data.replication import select_read_home
+        from predictionio_tpu.storage.registry import pio_home
+
+        home = select_read_home(args.read_from, pio_home(),
+                                getattr(args, "replica_home", None))
+        # the storage home is resolved from the env wherever the
+        # Storage gets built — repoint it at the replicated copy
+        os.environ["PIO_HOME"] = home
+        print(f"[info] Training reads from {args.read_from} home: {home}")
     variant = _load_variant_file(args.engine_dir, args.variant)
     factory = variant.get("engineFactory") or _die("engine.json missing engineFactory")
     # engine dir on sys.path so user engine modules import
@@ -1055,12 +1081,15 @@ def cmd_segments(args: argparse.Namespace) -> None:
                     except (IOError, OSError) as e:
                         print(f"[segments] compact {seg.meta.file}: {e}")
         elif args.action == "ship":
+            from predictionio_tpu.utils.integrity import IntegrityError
+
             for seg in list(ns.sealed):
                 if seg.meta.state == "sealed":
                     try:
-                        if ns.ship(seg):
+                        if ns.ship(seg, verify=getattr(args, "verify",
+                                                       False)):
                             acted["shipped"] += 1
-                    except (IOError, OSError) as e:
+                    except (IOError, OSError, IntegrityError) as e:
                         print(f"[segments] ship {seg.meta.file}: {e}")
         active_bytes = (os.path.getsize(ns.base_path)
                         if os.path.exists(ns.base_path) else 0)
@@ -1090,6 +1119,38 @@ def cmd_segments(args: argparse.Namespace) -> None:
     if args.action != "status":
         print(f"[segments] rolled={acted['rolled']} "
               f"compacted={acted['compacted']} shipped={acted['shipped']}")
+
+
+def cmd_failover(args: argparse.Namespace) -> None:
+    """Event-plane failover (jax-free): ``--target URL`` promotes a
+    follower by hand (POST /repl/promote — refused while the current
+    leader's lease is live, so it cannot split-brain); ``--drill``
+    runs the kill -9 harness from server/repl_server.py and prints the
+    proof document as one JSON line."""
+    if args.target:
+        from predictionio_tpu.server.repl_server import FollowerClient
+
+        doc = FollowerClient(args.target, timeout=args.timeout).promote()
+        print(json.dumps(doc, indent=2 if args.json else None,
+                         sort_keys=True))
+        if doc.get("role") != "leader":
+            sys.exit(1)
+        return
+    if not args.drill:
+        _die("pio failover needs --drill or --target URL")
+    import tempfile
+
+    from predictionio_tpu.server.repl_server import run_failover_drill
+
+    base = args.dir or tempfile.mkdtemp(prefix="pio-failover-")
+    proof = run_failover_drill(
+        base, events=args.events, kill_after=args.kill_after,
+        lease_ttl=args.lease_ttl,
+        log=lambda s: print(f"[failover] {s}", file=sys.stderr))
+    print(json.dumps(proof, indent=2 if args.json else None,
+                     sort_keys=True))
+    if not proof.get("ok"):
+        sys.exit(3)
 
 
 def cmd_trace(args: argparse.Namespace) -> None:
@@ -1489,6 +1550,25 @@ def build_parser() -> argparse.ArgumentParser:
                     help="per-app QoS policy file (default: "
                          "<storage home>/quotas.json, managed by "
                          "'pio app quota'; hot-reloaded)")
+    es.add_argument("--lease-home", metavar="DIR", default=None,
+                    help="shared directory holding the event-plane "
+                         "leader lease; setting it turns on the "
+                         "replicated event plane (leader election with "
+                         "fencing tokens, follower streaming — "
+                         "docs/operations.md \"Event-plane HA\")")
+    es.add_argument("--advertise-url", metavar="URL", default=None,
+                    help="base URL peers and redirected clients reach "
+                         "THIS node at (default: http://<ip>:<port>; "
+                         "also the lease owner identity)")
+    es.add_argument("--replicate-to", action="append", metavar="URL",
+                    help="follower base URL to stream the event log to "
+                         "when this node leads (repeatable; a node "
+                         "never replicates to its own advertise URL)")
+    es.add_argument("--lease-ttl", type=float, default=2.0,
+                    help="event-plane lease TTL seconds: a leader that "
+                         "stops heartbeating is superseded after this "
+                         "(promotion latency trades against false "
+                         "failover on GC/IO stalls)")
     _add_observability_flags(es)
     _add_incident_flags(es)
     es.set_defaults(fn=cmd_eventserver)
@@ -1509,6 +1589,17 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--scan-workers", type=int,
                     help="parallel segment scans per training read "
                          "(default: PIO_SCAN_WORKERS)")
+    tr.add_argument("--read-from", choices=("leader", "follower", "any"),
+                    default="leader",
+                    help="which event-plane node training reads come "
+                         "from: 'follower' trains off a replicated "
+                         "home (--replica-home / PIO_REPL_REPLICA_HOME) "
+                         "so scans never contend with leader ingest; "
+                         "'any' prefers the replica when present and "
+                         "falls back to the leader")
+    tr.add_argument("--replica-home", metavar="DIR",
+                    help="storage home of a replicated follower to "
+                         "train from (default: PIO_REPL_REPLICA_HOME)")
     tr.add_argument("--continuous", action="store_true",
                     help="run the supervised continuous-training loop: "
                          "single-writer lease with fencing tokens, "
@@ -1861,7 +1952,48 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=("status", "roll", "compact", "ship"))
     sg.add_argument("--json", action="store_true",
                     help="emit the full segment report as JSON")
+    sg.add_argument("--verify", action="store_true",
+                    help="ship: re-fetch every uploaded object from the "
+                         "cold tier and compare sha256 before trusting "
+                         "it; a mismatch deletes the cold copy, keeps "
+                         "the local file, and fails the ship")
     sg.set_defaults(fn=cmd_segments)
+
+    fo = sub.add_parser(
+        "failover",
+        help="event-plane failover: promote a follower by hand "
+             "(--target) or run the kill -9 drill (--drill) that "
+             "proves zero acked loss, sub-second promotion, "
+             "stale-epoch refusal, fsck-clean logs, and one coalesced "
+             "incident bundle (jax-free)")
+    fo.add_argument("--drill", action="store_true",
+                    help="spawn a leader+follower pair, ingest through "
+                         "the follower's 307 redirect, kill -9 the "
+                         "leader mid-stream, and print the proof "
+                         "document as one JSON line (exit 3 if any "
+                         "proof fails)")
+    fo.add_argument("--target", metavar="URL",
+                    help="follower base URL to promote now (POST "
+                         "/repl/promote; refused while the current "
+                         "leader's lease is live)")
+    fo.add_argument("--dir", metavar="PATH",
+                    help="drill working directory (default: a fresh "
+                         "temp dir; kept afterward for inspection)")
+    fo.add_argument("--events", type=int, default=120,
+                    help="drill: total events to ingest")
+    fo.add_argument("--kill-after", type=int, default=40,
+                    help="drill: kill -9 the leader after this many "
+                         "acked events")
+    fo.add_argument("--lease-ttl", type=float, default=0.35,
+                    help="drill: event-plane lease TTL seconds "
+                         "(promotion must still land under 1s "
+                         "including the expiry wait)")
+    fo.add_argument("--timeout", type=float, default=10.0,
+                    help="--target: HTTP timeout seconds")
+    fo.add_argument("--json", action="store_true",
+                    help="pretty-print the proof document instead of "
+                         "one line")
+    fo.set_defaults(fn=cmd_failover)
 
     tc = sub.add_parser(
         "trace",

@@ -101,6 +101,18 @@ Known sites (grep ``faults.inject`` for the authoritative list):
                         server) — a wedged/failing capture costs the
                         postmortem bundle, never the serving path;
                         watch ``pio_incident_captures_total{result}``
+``replication.follower.lag``  follower WAL apply path — a slow/down
+                        follower; the leader must degrade (mark the
+                        link unhealthy, keep acking) never block;
+                        watch ``pio_repl_lag_bytes``
+``replication.wal.torn``  byte-flip on a replicated WAL batch before
+                        the CRC check — the follower must refuse the
+                        frame (422) and keep its cursor; watch
+                        ``pio_repl_batches_total{result="torn"}``
+``replication.leader.partition``  event-plane leader heartbeat — the
+                        lease renewal fails as if partitioned; the
+                        leader must fence itself (writes 503) before
+                        the TTL lets a follower promote
 ======================  ===================================================
 """
 

@@ -54,7 +54,9 @@ import numpy as np
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--events", type=int, default=5000)
+    ap.add_argument("--events", type=int, default=None,
+                    help="events to ingest (default 5000; 120 with "
+                         "--failover, which ingests serially)")
     ap.add_argument("--storage", default="memory",
                     choices=["memory", "sqlite", "eventlog"])
     ap.add_argument("--port", type=int, default=8791)
@@ -86,9 +88,45 @@ def main() -> None:
     ap.add_argument("--scan-workers", type=int, default=4,
                     help="segment scan fan-out width for the parallel "
                          "phase of --segments")
+    ap.add_argument("--failover", action="store_true",
+                    help="event-plane chaos harness: run the kill -9 "
+                         "failover drill (two real event servers, "
+                         "leader killed mid-stream) and report the "
+                         "proof document — zero acked loss, promotion "
+                         "latency, epoch bump, stale-epoch refusal, "
+                         "fsck verdicts, incident-bundle count")
+    ap.add_argument("--kill-after", type=int, default=40,
+                    help="failover: kill -9 the leader after this many "
+                         "acked events")
+    ap.add_argument("--lease-ttl", type=float, default=0.35,
+                    help="failover: event-plane lease TTL seconds")
     args = ap.parse_args()
+    args.events = args.events or (120 if args.failover else 5000)
     if args.verify_crc or args.segments:
         args.storage = "eventlog"  # the A/B only exists natively
+
+    if args.failover:
+        # jax-free: the drill spawns real `pio eventserver` processes
+        # (EVENTLOG storage, durable acks) and never imports jax here
+        from predictionio_tpu.server.repl_server import run_failover_drill
+
+        base = tempfile.mkdtemp(prefix="pio_failover_drill_")
+        t0 = time.perf_counter()
+        proof = run_failover_drill(base, events=args.events,
+                                   kill_after=args.kill_after,
+                                   lease_ttl=args.lease_ttl)
+        print(json.dumps({
+            "metric": "event_plane_failover",
+            "events": args.events,
+            "kill_after": args.kill_after,
+            "lease_ttl_sec": args.lease_ttl,
+            "wall_sec": round(time.perf_counter() - t0, 2),
+            "dir": base,
+            **proof,
+        }))
+        if not proof.get("ok"):
+            raise SystemExit(3)
+        return
 
     import jax
 

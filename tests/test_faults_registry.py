@@ -102,6 +102,13 @@ class TestFaultSiteAudit:
         assert {"slo.probe.fail",
                 "tsdb.scrape.stall"} <= table_sites(project)
 
+    def test_replication_sites_are_registered(self, project):
+        """The event-plane HA drill sites must stay in the table: the
+        chaos harness (``profile_events.py --failover``) and the
+        "Event-plane HA" runbook both arm them by name."""
+        assert {"replication.follower.lag", "replication.wal.torn",
+                "replication.leader.partition"} <= table_sites(project)
+
     def test_ann_index_site_is_registered(self, project):
         """The ANN retrieval-index drill site must stay in the table:
         ``pio fsck`` detection and the ``/reload``-refusal drill

@@ -1,0 +1,452 @@
+"""Replicated event plane: WAL shipping, rollover continuity, epoch
+fencing, degrade-not-block, redirect-following writers, verified
+cold-tier ships.
+
+The proofs that need two real processes and a ``kill -9`` live in the
+drill (``pio failover --drill`` / ``profile_events.py --failover``,
+exercised here under the ``slow`` marker); this module pins the
+mechanism in-process where every byte is inspectable:
+
+- a follower's copy is BYTE-IDENTICAL across an active-segment
+  rollover — no duplicated and no lost frame at the seal boundary;
+- a stale fencing epoch (``replication.*`` drill sites armed by name:
+  ``replication.wal.torn``, ``replication.follower.lag``,
+  ``replication.leader.partition``) is refused without touching disk;
+- a fenced ex-leader cannot append locally;
+- the HTTP event sink follows a follower's ``307`` to the leader with
+  bounded hops;
+- ``pio segments ship --verify`` refuses a cold tier that returns
+  bytes that do not match the manifest digest.
+"""
+
+import http.server
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.pel_integrity import PEL_MAGIC, scan_pel
+from predictionio_tpu.data.replication import (
+    FencedWriteError,
+    FollowerLink,
+    ReplicaHome,
+    Replicator,
+    StaleEpochError,
+    WalBatch,
+    WalTornError,
+    select_read_home,
+)
+from predictionio_tpu.utils import faults
+from predictionio_tpu.utils.integrity import IntegrityError
+
+APP = 1
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.FAULTS.disarm()
+
+
+def _store(directory, seg_bytes=None):
+    from predictionio_tpu.data.filestore import NativeEventLogStore
+
+    try:
+        s = NativeEventLogStore(str(directory))
+    except RuntimeError as e:  # no g++ in this environment
+        pytest.skip(str(e))
+    if seg_bytes is not None:
+        s.segment_bytes = seg_bytes
+    return s
+
+
+def _events(n, start=0):
+    return [Event(event="rate", entity_type="user",
+                  entity_id=f"u{start + i}",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties={"n": start + i})
+            for i in range(n)]
+
+
+def _link(replica, name="local"):
+    return FollowerLink(name, apply_fn=replica.apply_wal,
+                        seal_fn=replica.apply_seal,
+                        status_fn=replica.status)
+
+
+# -- WAL tail across rollover -------------------------------------------------
+
+
+def test_wal_tail_across_rollover_no_dup_no_gap(tmp_path):
+    """Stream through at least one seal: every leader log file (sealed
+    AND active) must be byte-identical on the follower, and the
+    follower cursor must land at the head of the new active segment —
+    a duplicated or dropped frame at the boundary would break the byte
+    equality or the fsck below."""
+    st = _store(tmp_path / "leader", seg_bytes=4096)
+    replica = ReplicaHome(str(tmp_path / "replica"))
+    rep = Replicator([_link(replica)], epoch=lambda: 1)
+    st.set_replicator(rep)
+
+    for lo in range(0, 600, 50):
+        st.insert_batch(_events(50, start=lo), APP)
+    # a small post-roll batch so the NEW active segment has a pushed
+    # tail too (a roll leaves the follower's next active file pending
+    # until the first append lands in it)
+    st.insert_batch(_events(3, start=600), APP)
+    ns = st._ns(APP, None)
+    assert ns.sealed, "threshold should have sealed at least one segment"
+
+    # byte identity: sealed files and the active tail
+    for seg in ns.sealed:
+        leader_bytes = open(ns.seg_path(seg), "rb").read()
+        follower_path = os.path.join(replica.seg_dir("events_1"),
+                                     seg.meta.file)
+        assert open(follower_path, "rb").read() == leader_bytes
+    leader_active = open(ns.base_path, "rb").read()
+    follower_active = open(replica.active_path("events_1"), "rb").read()
+    assert follower_active == leader_active
+
+    # the cursor is exactly at the end of the new active segment
+    seg_id, offset = replica.cursor("events_1")
+    assert seg_id == ns.next_id
+    assert offset == len(leader_active)
+
+    # the replica's copies are fsck-clean in their own right
+    r = scan_pel(replica.active_path("events_1"))
+    assert r["status"] == "ok"
+    total = r["records"]
+    for seg in ns.sealed:
+        r = scan_pel(os.path.join(replica.seg_dir("events_1"),
+                                  seg.meta.file))
+        assert r["status"] == "ok"
+        total += r["records"]
+    assert total == 603
+
+    # the follower manifest carries the leader's digests
+    doc = json.load(open(replica.manifest_path("events_1")))
+    assert {row["sha256"] for row in doc["segments"]} == {
+        seg.meta.sha256 for seg in ns.sealed}
+
+
+def test_delete_tombstone_rides_the_wal_stream(tmp_path):
+    """``delete`` appends a tombstone frame — the follower must get it
+    through the same tail-ship, keeping byte identity."""
+    st = _store(tmp_path / "leader")
+    replica = ReplicaHome(str(tmp_path / "replica"))
+    st.set_replicator(Replicator([_link(replica)], epoch=lambda: 1))
+    ids = st.insert_batch(_events(5), APP)
+    assert st.delete(ids[2], APP)
+    ns = st._ns(APP, None)
+    assert (open(replica.active_path("events_1"), "rb").read()
+            == open(ns.base_path, "rb").read())
+
+
+# -- epoch fencing ------------------------------------------------------------
+
+
+def test_stale_epoch_refused_without_touching_disk(tmp_path):
+    replica = ReplicaHome(str(tmp_path / "replica"))
+    replica.apply_wal(WalBatch.build("events_1", 0, 0, PEL_MAGIC, epoch=7))
+    size_before = os.path.getsize(replica.active_path("events_1"))
+
+    with pytest.raises(StaleEpochError):
+        replica.apply_wal(WalBatch.build("events_1", 0, len(PEL_MAGIC),
+                                         b"late-write", epoch=6))
+    assert os.path.getsize(replica.active_path("events_1")) == size_before
+    assert replica.cursor("events_1") == (0, len(PEL_MAGIC))
+    # a NEWER epoch is learned, and then the old one stays refused
+    replica.apply_wal(WalBatch.build("events_1", 0, len(PEL_MAGIC),
+                                     b"x", epoch=9))
+    assert replica.epoch == 9
+    with pytest.raises(StaleEpochError):
+        replica.apply_seal(
+            "events_1",
+            {"id": 0, "file": "whatever.pel", "state": "sealed",
+             "records": 0, "bytes": 0, "sha256": None},
+            epoch=8)
+
+
+def test_fenced_leader_cannot_append_locally(tmp_path):
+    """A demoted leader's writes are refused BEFORE bytes land — the
+    local end of the fencing contract (the remote end is the epoch
+    check above)."""
+    st = _store(tmp_path / "leader")
+    st.insert_batch(_events(2), APP)
+    fenced = {"v": False}
+    st.set_replicator(Replicator([], epoch=lambda: 3,
+                                 fenced=lambda: fenced["v"]))
+    st.insert_batch(_events(1, start=10), APP)     # healthy leader: fine
+    fenced["v"] = True
+    ns = st._ns(APP, None)
+    size_before = os.path.getsize(ns.base_path)
+    with pytest.raises(FencedWriteError):
+        st.insert_batch(_events(1, start=11), APP)
+    with pytest.raises(FencedWriteError):
+        st.delete("nonexistent", APP)
+    assert os.path.getsize(ns.base_path) == size_before
+
+
+def test_leader_partition_fault_demotes_and_fences(tmp_path):
+    """Arming ``replication.leader.partition`` makes the heartbeat
+    renewal fail as if the lease home vanished: the leader must fence
+    itself (role ``fenced``) before the TTL lets anyone else in."""
+    from predictionio_tpu.server.repl_server import ReplNode
+
+    node = ReplNode(lease_home=str(tmp_path / "lease"),
+                    advertise_url="http://127.0.0.1:1",
+                    home=str(tmp_path / "home"),
+                    lease_ttl=0.09)
+    faults.FAULTS.arm("replication.leader.partition", error="partitioned")
+    try:
+        node.start()
+        assert node.role == "leader"
+        deadline = time.monotonic() + 5.0
+        while node.role != "fenced" and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert node.role == "fenced"
+        # the gate answers writes 503 (no leader known to point at)
+        class _Req:
+            path = "/events.json"
+            query = {}
+        deny = node.gate(_Req())
+        assert deny is not None and deny.status == 503
+    finally:
+        faults.FAULTS.disarm()
+        node.stop()
+
+
+# -- WAL integrity ------------------------------------------------------------
+
+
+def test_torn_wal_batch_refused_and_log_untouched(tmp_path):
+    """A byte-flipped batch (armed ``replication.wal.torn``) must fail
+    the CRC and leave both the file and the cursor exactly where they
+    were."""
+    replica = ReplicaHome(str(tmp_path / "replica"))
+    replica.apply_wal(WalBatch.build("events_1", 0, 0, PEL_MAGIC, epoch=1))
+    faults.FAULTS.arm("replication.wal.torn")
+    with pytest.raises(WalTornError):
+        replica.apply_wal(WalBatch.build(
+            "events_1", 0, len(PEL_MAGIC), b"payload-bytes", epoch=1))
+    faults.FAULTS.disarm()
+    assert replica.cursor("events_1") == (0, len(PEL_MAGIC))
+    assert os.path.getsize(replica.active_path("events_1")) == len(PEL_MAGIC)
+    # undamaged resend of the same batch applies cleanly
+    replica.apply_wal(WalBatch.build("events_1", 0, len(PEL_MAGIC),
+                                     b"payload-bytes", epoch=1))
+
+
+def test_follower_lag_fault_degrades_never_blocks(tmp_path):
+    """An armed ``replication.follower.lag`` error plan downs the
+    follower — the leader must keep acking writes (semi-sync degrades
+    to solo) and mark the link unhealthy, not raise."""
+    st = _store(tmp_path / "leader")
+    replica = ReplicaHome(str(tmp_path / "replica"))
+    link = _link(replica)
+    st.set_replicator(Replicator([link], epoch=lambda: 1))
+    st.insert_batch(_events(3), APP)
+    assert link.healthy
+
+    faults.FAULTS.arm("replication.follower.lag", error="follower down")
+    ids = st.insert_batch(_events(3, start=10), APP)   # still acked
+    assert len(ids) == 3
+    assert not link.healthy
+    assert "follower down" in (link.last_error or "")
+    faults.FAULTS.disarm()
+
+
+def test_wal_gap_resends_from_follower_cursor(tmp_path):
+    """A leader whose cursor guess is ahead of the follower's truth
+    (e.g. after a follower restart) gets a WalGapError carrying the
+    true cursor and must resend from there — exercised end-to-end by
+    pointing a fresh Replicator (blank cursors) at a part-filled
+    replica."""
+    st = _store(tmp_path / "leader")
+    replica = ReplicaHome(str(tmp_path / "replica"))
+    st.set_replicator(Replicator([_link(replica)], epoch=lambda: 1))
+    st.insert_batch(_events(4), APP)
+
+    # leader restarts: new Replicator, cursors forgotten
+    link2 = _link(replica, name="after-restart")
+    st.set_replicator(Replicator([link2], epoch=lambda: 2))
+    st.insert_batch(_events(4, start=4), APP)
+    ns = st._ns(APP, None)
+    assert link2.healthy
+    assert (open(replica.active_path("events_1"), "rb").read()
+            == open(ns.base_path, "rb").read())
+
+
+# -- the redirect-following writer -------------------------------------------
+
+
+class _Redirector(http.server.BaseHTTPRequestHandler):
+    leader_url = ""
+
+    def do_POST(self):                                 # noqa: N802
+        self.send_response(307)
+        self.send_header("Location", self.leader_url + self.path)
+        self.send_header("Retry-After", "0")
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+class _Leader(http.server.BaseHTTPRequestHandler):
+    def do_POST(self):                                 # noqa: N802
+        self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        body = json.dumps({"eventId": "ev-307-followed"}).encode()
+        self.send_response(201)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def _serve(handler):
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def test_eventsink_follows_follower_307_to_leader():
+    from predictionio_tpu.server.eventsink import HTTPEventSink
+
+    leader_srv, leader_url = _serve(_Leader)
+    _Redirector.leader_url = leader_url
+    follower_srv, follower_url = _serve(_Redirector)
+    try:
+        sink = HTTPEventSink(follower_url, "k", retries=0)
+        eid = sink.send(Event(event="e", entity_type="u", entity_id="1"))
+        assert eid == "ev-307-followed"
+    finally:
+        leader_srv.shutdown()
+        follower_srv.shutdown()
+
+
+def test_eventsink_redirect_loop_is_bounded():
+    from predictionio_tpu.server.eventsink import HTTPEventSink
+
+    class _Loop(_Redirector):
+        pass
+
+    srv, url = _serve(_Loop)
+    _Loop.leader_url = url            # redirects to itself, forever
+    try:
+        sink = HTTPEventSink(url, "k", retries=0)
+        with pytest.raises(RuntimeError, match="redirect not followable"):
+            sink.send(Event(event="e", entity_type="u", entity_id="1"))
+    finally:
+        srv.shutdown()
+
+
+# -- verified cold-tier ship --------------------------------------------------
+
+
+class _LyingTier:
+    """A cold tier whose reads don't match its writes."""
+
+    def __init__(self, lie=True):
+        self.blobs = {}
+        self.lie = lie
+        self.deleted = []
+
+    def put(self, key, blob):
+        self.blobs[key] = blob
+
+    def get(self, key):
+        blob = self.blobs.get(key)
+        if blob is None:
+            return None
+        return blob[:-1] + b"\x00" if self.lie else blob
+
+    def delete(self, key):
+        self.deleted.append(key)
+        self.blobs.pop(key, None)
+
+
+def test_ship_verify_refuses_mismatched_cold_copy(tmp_path):
+    st = _store(tmp_path / "log", seg_bytes=2048)
+    for lo in range(0, 300, 50):
+        st.insert_batch(_events(50, start=lo), APP)
+    ns = st._ns(APP, None)
+    assert ns.sealed
+    seg = ns.sealed[0]
+
+    tier = _LyingTier(lie=True)
+    with pytest.raises(IntegrityError, match="read-back"):
+        ns.ship(seg, tier=tier, verify=True)
+    # local copy kept, remote poison deleted, segment still shippable
+    assert os.path.exists(ns.seg_path(seg))
+    assert seg.meta.state == "sealed"
+    assert tier.deleted
+
+    tier.lie = False
+    assert ns.ship(seg, tier=tier, verify=True)
+    assert seg.meta.state == "cold"
+    assert not os.path.exists(ns.seg_path(seg))
+
+
+# -- read fan-out -------------------------------------------------------------
+
+
+def test_select_read_home(tmp_path, monkeypatch):
+    leader = str(tmp_path / "leader")
+    replica = str(tmp_path / "replica")
+    os.makedirs(os.path.join(replica, "eventlog"))
+    assert select_read_home("leader", leader, replica) == leader
+    assert select_read_home("follower", leader, replica) == replica
+    assert select_read_home("any", leader, replica) == replica
+    assert select_read_home("any", leader, None) == leader
+    monkeypatch.setenv("PIO_REPL_REPLICA_HOME", replica)
+    assert select_read_home("follower", leader, None) == replica
+    with pytest.raises(ValueError):
+        select_read_home("follower", leader, str(tmp_path / "missing"))
+
+
+def test_fsck_flags_replica_cursor_past_eof(tmp_path):
+    from predictionio_tpu.data.pel_integrity import fsck_home
+
+    home = str(tmp_path / "home")
+    replica = ReplicaHome(home)
+    replica.apply_wal(WalBatch.build("events_1", 0, 0, PEL_MAGIC, epoch=1))
+    assert fsck_home(home)["corrupt"] == 0
+
+    # hand-corrupt the cursor to claim more bytes than the file holds
+    doc = json.load(open(replica.state_path))
+    doc["cursors"]["events_1"]["offset"] = 10_000
+    with open(replica.state_path, "w") as f:
+        json.dump(doc, f)
+    rep = fsck_home(home)
+    assert rep["corrupt"] == 1
+    bad = [a for a in rep["artifacts"] if a["artifact"] == "replica"]
+    assert bad and "cursor" in bad[0]["errors"][0]
+
+
+# -- the whole drill (slow) ---------------------------------------------------
+
+
+@pytest.mark.slow
+def test_failover_drill_end_to_end(tmp_path):
+    """Two real event servers, serial ingest through the follower's
+    307, kill -9 on the leader: zero acked loss, sub-second promotion
+    at a bumped epoch, stale-epoch refusal, both homes fsck-clean,
+    exactly one coalesced incident bundle naming the failover."""
+    from predictionio_tpu.server.repl_server import run_failover_drill
+
+    proof = run_failover_drill(str(tmp_path / "drill"), events=60,
+                               kill_after=20)
+    assert proof["ok"], proof
+    assert proof["ackedLost"] == 0
+    assert proof["epoch"] > proof["epochBefore"]
+    assert proof["promotionMs"] < 1000.0
+    assert proof["staleEpochRefused"]
+    assert proof["fsck"] == {"leader": 0, "follower": 0}
+    assert proof["incidentBundles"] == 1
