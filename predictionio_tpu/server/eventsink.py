@@ -40,11 +40,35 @@ from typing import Any, Dict, Optional
 
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.utils import faults, tracing
+from predictionio_tpu.utils.metrics import REGISTRY
 from predictionio_tpu.utils.resilience import (
     CircuitBreaker,
     parse_retry_after,
     retry_with_backoff,
 )
+
+#: leader-redirect traffic: result="followed" per 307/308 hop taken,
+#: "exhausted" when the hop budget runs out mid-chain. A rising
+#: followed-rate means writers are pointed at a follower (update the
+#: sink URL); any exhausted means two nodes redirect at each other —
+#: a split-brain symptom worth a page.
+_M_REDIRECTS = REGISTRY.counter(
+    "pio_eventsink_redirects_total",
+    "Event-plane leader redirects (307/308) seen by the feedback sink",
+    ("result",))
+
+
+class RedirectExhausted(RuntimeError):
+    """The redirect chain outlived ``REDIRECT_HOPS`` — distinct from a
+    generic send failure so dashboards and tests can tell "the leader
+    moved" from "the event server is down". Still a
+    :class:`RuntimeError`, so the send retry (which re-enters at the
+    original URL, picking up the post-failover redirect) applies."""
+
+    def __init__(self, message: str, retry_after: Optional[float] = None
+                 ) -> None:
+        super().__init__(message)
+        self.retry_after = retry_after
 
 
 class EventSink(ABC):
@@ -115,15 +139,15 @@ class HTTPEventSink(EventSink):
                     # auto-resend a POST body, so we follow by hand)
                     loc = e.headers.get("Location")
                     if loc and hop < self.REDIRECT_HOPS:
+                        _M_REDIRECTS.inc(("followed",))
                         target = urllib.parse.urljoin(target, loc)
                         if hint:
                             time.sleep(min(hint, 1.0))
                         continue
-                    err = RuntimeError(
+                    _M_REDIRECTS.inc(("exhausted",))
+                    raise RedirectExhausted(
                         f"event server redirect not followable after "
-                        f"{hop} hop(s): {e.code}")
-                    err.retry_after = hint
-                    raise err from e
+                        f"{hop} hop(s): {e.code}", hint) from e
                 if e.code == 429:
                     # backpressure, not rejection: retryable, and the
                     # server's Retry-After hint overrides our backoff

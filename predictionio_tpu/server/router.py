@@ -76,6 +76,7 @@ import contextlib
 import json
 import os
 import random
+import time
 import urllib.parse
 import uuid
 from collections import deque
@@ -283,6 +284,9 @@ class FleetRouter:
         incident_dir: Optional[str] = None,
         incident_debounce: float = 300.0,
         incident_retain: int = 20,
+        pool: Optional[Any] = None,
+        autoscale: Optional[Any] = None,
+        remediations: Optional[str] = None,
     ) -> None:
         if not replicas and not manifest:
             raise ValueError("need a replica list or a manifest file")
@@ -388,6 +392,40 @@ class FleetRouter:
                 rep.breaker.on_open = lambda name: self.incidents.trigger(
                     "breaker-open", {"breaker": name})
 
+        # -- self-healing plane: replica pool + autoscaler + remediator
+        # (server/autoscale, server/remediate). The pool rewrites the
+        # manifest; the mtime watcher above is how scaling reaches the
+        # routing table — no extra discovery plumbing.
+        self.pool = pool
+        self.autoscaler = None
+        self.remediator = None
+        #: monotonic deadline until which the synthetic prober stays
+        #: quiet (the probe-exclusion playbook / POST /probe?pause=N)
+        self._probe_paused_until = 0.0
+        if pool is not None or autoscale is not None:
+            from predictionio_tpu.server.autoscale import Autoscaler
+            from predictionio_tpu.server.remediate import (
+                RemediationEngine,
+                RouterActuator,
+                load_playbooks,
+            )
+
+            self.remediator = RemediationEngine(
+                RouterActuator(self, pool),
+                load_playbooks(remediations),
+                on_action=self._on_remediation)
+            if autoscale is not None and pool is not None:
+                self.autoscaler = Autoscaler(
+                    self, pool, autoscale, remediator=self.remediator,
+                    log=lambda *a: print(*a, flush=True))
+            if self.incidents is not None:
+                if self.autoscaler is not None:
+                    self.incidents.add_source(
+                        "autoscale", self.autoscaler.status_doc)
+                self.incidents.add_source(
+                    "remediations",
+                    lambda: {"log": list(self.remediator.log)})
+
         self._m_state = REGISTRY.gauge(
             "pio_router_replica_state",
             "Replica state (0 ok, 1 degraded, 2 not-ready, 3 down, "
@@ -447,6 +485,12 @@ class FleetRouter:
         router.route("GET", "/traces", traces_handler)
         router.route("GET", "/router/status", self._router_status)
         router.route("POST", "/router/reload", self._router_reload)
+        router.route("GET", "/pool/status", self._pool_status)
+        router.route("POST", "/pool/add", self._pool_add)
+        router.route("POST", "/pool/remove", self._pool_remove)
+        router.route("POST", "/pool/restart", self._pool_restart)
+        router.route("GET", "/autoscale/status", self._autoscale_status)
+        router.route("POST", "/probe", self._probe_ctl)
         router.route("GET", "/{path+}", self._proxy)
         router.route("POST", "/{path+}", self._proxy)
         self.http = HTTPServer(router, host, port, access_log=access_log,
@@ -461,6 +505,28 @@ class FleetRouter:
             rep.breaker.on_open = lambda name: self.incidents.trigger(
                 "breaker-open", {"breaker": name})
         return rep
+
+    # -- self-healing plane ------------------------------------------------
+
+    def _on_remediation(self, entry: Dict[str, Any]) -> None:
+        """Every executed (or refused) remediation becomes an incident
+        timeline entry — the bundle answers "what did the machine do
+        about it" next to "what went wrong"."""
+        if self.incidents is not None:
+            self.incidents.trigger("remediation", {
+                "playbook": entry.get("playbook"),
+                "action": entry.get("action"),
+                "target": entry.get("target"),
+                "result": entry.get("result")})
+
+    def pause_probe(self, seconds: float) -> None:
+        """Silence the synthetic prober for ``seconds`` (auto-resumes;
+        the probe-exclusion playbook's verb). Probing a known-broken
+        canary target burns SLO budget without information."""
+        self._probe_paused_until = time.monotonic() + max(0.0, seconds)
+
+    def resume_probe(self) -> None:
+        self._probe_paused_until = 0.0
 
     # -- incident capture sources ------------------------------------------
 
@@ -485,6 +551,9 @@ class FleetRouter:
             "pio_circuit_breaker_state",
             "pio_fleet_engine_shed_total",
             "pio_fleet_tenant_quota_rejected_total",
+            "pio_autoscale_decisions_total",
+            "pio_autoscale_replicas",
+            "pio_remediate_actions_total",
         }
         for spec in self.slo.specs:
             if spec.series:
@@ -1167,6 +1236,8 @@ class FleetRouter:
     async def _probe_loop(self) -> None:
         while True:
             await asyncio.sleep(self.probe_interval)
+            if time.monotonic() < self._probe_paused_until:
+                continue
             try:
                 await self._probe_once()
             except asyncio.CancelledError:
@@ -1313,6 +1384,80 @@ class FleetRouter:
                      else self.reload_all())
         return Response.json(out, status=200 if out["ok"] else 500)
 
+    async def _pool_status(self, req: Request) -> Response:
+        if self.pool is None:
+            return Response.json(
+                {"message": "no replica pool attached "
+                            "(start with --pool-spawn)"}, status=409)
+        snap = await asyncio.to_thread(self.pool.snapshot)
+        return Response.json({"pool": snap, "size": len(snap)})
+
+    async def _pool_add(self, req: Request) -> Response:
+        if self.pool is None:
+            return Response.json(
+                {"message": "no replica pool attached"}, status=409)
+        try:
+            name = await asyncio.to_thread(self.pool.add_replica)
+        except Exception as e:  # noqa: BLE001 — surface, don't 500-trace
+            return Response.json({"ok": False, "message": str(e)},
+                                 status=500)
+        return Response.json({"ok": True, "added": name})
+
+    async def _pool_remove(self, req: Request) -> Response:
+        if self.pool is None:
+            return Response.json(
+                {"message": "no replica pool attached"}, status=409)
+        try:
+            name = await asyncio.to_thread(
+                self.pool.remove_replica, req.param("replica") or None)
+        except Exception as e:  # noqa: BLE001
+            return Response.json({"ok": False, "message": str(e)},
+                                 status=409)
+        return Response.json({"ok": True, "removed": name})
+
+    async def _pool_restart(self, req: Request) -> Response:
+        if self.pool is None:
+            return Response.json(
+                {"message": "no replica pool attached"}, status=409)
+        name = req.param("replica")
+        if not name:
+            return Response.json({"message": "need ?replica=host:port"},
+                                 status=400)
+        try:
+            await asyncio.to_thread(self.pool.restart_replica, name)
+        except Exception as e:  # noqa: BLE001
+            return Response.json({"ok": False, "message": str(e)},
+                                 status=404)
+        return Response.json({"ok": True, "restarting": name})
+
+    async def _autoscale_status(self, req: Request) -> Response:
+        if self.autoscaler is None:
+            return Response.json(
+                {"message": "autoscaler not running "
+                            "(needs --pool-spawn without --no-autoscale)"},
+                status=409)
+        return Response.json(self.autoscaler.status_doc())
+
+    async def _probe_ctl(self, req: Request) -> Response:
+        """``POST /probe?pause=SECONDS`` / ``POST /probe?resume=1`` —
+        the probe-exclusion playbook's HTTP surface."""
+        if req.param("resume"):
+            self.resume_probe()
+            return Response.json({"ok": True, "probe": "running"})
+        pause = req.param("pause")
+        if pause is None:
+            return Response.json(
+                {"message": "need ?pause=SECONDS or ?resume=1"},
+                status=400)
+        try:
+            seconds = float(pause)
+        except ValueError:
+            return Response.json({"message": f"bad pause {pause!r}"},
+                                 status=400)
+        self.pause_probe(seconds)
+        return Response.json({"ok": True, "probe": "paused",
+                              "resumeAfterSec": seconds})
+
     async def _metrics(self, req: Request) -> Response:
         # own registry first, then the federated fleet snapshot: one
         # scrape of the router is one scrape point for the whole pod
@@ -1444,6 +1589,9 @@ class FleetRouter:
         if self.probe_interval > 0:
             tasks.append(asyncio.create_task(self._probe_loop(),
                                              name="pio-router-probe"))
+        if self.autoscaler is not None:
+            tasks.append(asyncio.create_task(self.autoscaler.loop(),
+                                             name="pio-router-autoscale"))
         try:
             await self.http.serve_forever()
         finally:

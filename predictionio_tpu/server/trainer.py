@@ -124,6 +124,24 @@ class TrainerLease:
     a dead process and broken. The lease itself expires by wall clock:
     a holder that stops heartbeating is supersedable after ``ttl``.
 
+    **Wall-clock jumps**: the expiry in the file must stay wall-clock
+    (it is compared across hosts), but a contender double-checks it
+    against its OWN monotonic observations of the lease document —
+    every renewal bumps a ``beat`` counter, so a live holder's document
+    visibly changes each heartbeat:
+
+    - a forward jump makes a live lease LOOK expired; the contender
+      refuses to steal while it has watched the document change within
+      the last ``ttl`` of monotonic time (heartbeats are landing, the
+      wall is lying);
+    - a backward jump makes a dead lease LOOK live forever; the
+      contender steals anyway once the document has been byte-identical
+      for ``ttl`` of monotonic time (nobody is heartbeating, whatever
+      the wall says).
+
+    A contender with no observation history trusts the wall clock — so
+    a genuinely expired lease is still stolen on first sighting.
+
     **Fencing**: every successful :meth:`acquire` bumps ``token`` past
     the previous holder's, whether or not that holder is alive. The
     token rides along on every registry write, and the registry refuses
@@ -135,13 +153,21 @@ class TrainerLease:
 
     def __init__(self, path: str, owner: str, ttl: float = 30.0,
                  clock: Callable[[], float] = time.time,
-                 sleep: Callable[[float], None] = time.sleep) -> None:
+                 sleep: Callable[[float], None] = time.sleep,
+                 mono: Callable[[], float] = time.monotonic) -> None:
         self.path = path
         self.owner = owner
         self.ttl = float(ttl)
         self.token: Optional[int] = None
         self._clock = clock
         self._sleep = sleep
+        self._mono = mono
+        #: observation fingerprint: the lease document's bytes as last
+        #: seen, when THIS fingerprint was first seen (monotonic), and
+        #: when we last watched the document CHANGE (monotonic)
+        self._seen_fp: Optional[str] = None
+        self._seen_at = 0.0
+        self._changed_at: Optional[float] = None
 
     # -- the .lock mutex -------------------------------------------------------
 
@@ -200,15 +226,40 @@ class TrainerLease:
 
     # -- protocol --------------------------------------------------------------
 
+    def _observe(self, doc: Optional[Dict[str, Any]]) -> float:
+        """Update the observation fingerprint; returns monotonic now."""
+        mono_now = self._mono()
+        fp = (None if doc is None
+              else json.dumps(doc, sort_keys=True))
+        if fp != self._seen_fp:
+            if self._seen_fp is not None:
+                self._changed_at = mono_now
+            self._seen_fp = fp
+            self._seen_at = mono_now
+        return mono_now
+
     def acquire(self) -> bool:
         """Try to take the lease. True on success (``self.token`` is the
-        new fencing token); False when another live holder has it."""
+        new fencing token); False when another live holder has it.
+        Wall expiry decides, cross-checked against this contender's
+        monotonic observations (see class doc) so a clock jump neither
+        self-expires a live lease nor immortalizes a dead one."""
         with self._locked():
             doc = self._read()
             now = self._clock()
-            if (doc is not None and doc.get("owner") != self.owner
-                    and float(doc.get("expires", 0)) > now):
-                return False
+            mono_now = self._observe(doc)
+            if doc is not None and doc.get("owner") != self.owner:
+                wall_live = float(doc.get("expires", 0)) > now
+                # dead to monotonic eyes: byte-identical for >= ttl
+                stale_mono = mono_now - self._seen_at >= self.ttl
+                # alive to monotonic eyes: we watched it change < ttl ago
+                fresh_mono = (self._changed_at is not None
+                              and mono_now - self._changed_at < self.ttl)
+                if wall_live and not stale_mono:
+                    return False
+                if not wall_live and fresh_mono:
+                    # forward wall jump: heartbeats are visibly landing
+                    return False
             prev = int(doc.get("token", 0)) if doc else 0
             self.token = prev + 1
             self._write({"owner": self.owner, "token": self.token,
@@ -233,7 +284,11 @@ class TrainerLease:
                     f"lease superseded (file shows "
                     f"{doc.get('owner') if doc else None!r} "
                     f"token {doc.get('token') if doc else None})")
+            # the beat makes every renewal change the document bytes,
+            # so contenders' monotonic fingerprints see a live holder
+            # even when a backward wall jump leaves ``expires`` equal
             doc["expires"] = self._clock() + self.ttl
+            doc["beat"] = int(doc.get("beat", 0)) + 1
             self._write(doc)
 
     def release(self) -> None:

@@ -282,6 +282,30 @@ def cmd_router(args: argparse.Namespace) -> None:
         _configure_tracing(args)
         replicas = ([u for u in args.replicas.split(",") if u.strip()]
                     if args.replicas else None)
+        pool = None
+        autoscale_cfg = None
+        if args.pool_spawn:
+            if not args.manifest:
+                _die("--pool-spawn needs --manifest (the file the pool "
+                     "rewrites and the router watches)")
+            import shlex
+
+            from predictionio_tpu.tools.supervise import ReplicaPool
+
+            pool = ReplicaPool(shlex.split(args.pool_spawn),
+                               args.manifest)
+            for _ in range(max(1, args.min_replicas)):
+                name = pool.add_replica()
+                print(f"[info] pool replica {name} ready")
+            if not args.no_autoscale:
+                from predictionio_tpu.server.autoscale import (
+                    AutoscaleConfig,
+                )
+
+                autoscale_cfg = AutoscaleConfig(
+                    min_replicas=max(1, args.min_replicas),
+                    max_replicas=max(1, args.max_replicas),
+                    interval=args.autoscale_interval)
         router = FleetRouter(
             replicas=replicas,
             manifest=args.manifest,
@@ -300,11 +324,23 @@ def cmd_router(args: argparse.Namespace) -> None:
             scrape_interval=args.scrape_interval,
             probe_interval=args.probe_interval,
             incident_dir=_incident_dir(args),
+            pool=pool,
+            autoscale=autoscale_cfg,
+            remediations=args.remediations,
         )
         print(f"[info] Fleet router on {args.ip}:{args.port} over "
               f"{len(router.replicas)} replicas "
               f"({', '.join(r.name for r in router.replicas)})")
-        router.run()
+        if autoscale_cfg is not None:
+            print(f"[info] autoscaler on: {autoscale_cfg.min_replicas}"
+                  f"-{autoscale_cfg.max_replicas} replicas, tick every "
+                  f"{autoscale_cfg.interval:g}s (--no-autoscale to "
+                  "disable)")
+        try:
+            router.run()
+        finally:
+            if pool is not None:
+                pool.stop_all()
         return
 
     import urllib.error
@@ -1307,9 +1343,30 @@ def cmd_doctor(args: argparse.Namespace) -> None:
         findings = incmod.diagnose_live(slo_doc, health_doc, top_doc)
         header = f"doctor — live fleet at {base}"
     code = incmod.exit_code(findings)
+    results = None
+    if args.act:
+        # remediation engine: map findings onto conf/remediations.json
+        # playbooks. Without --yes this is a pure dry run — the full
+        # plan prints, NOTHING executes.
+        from predictionio_tpu.server.remediate import (
+            OpsActuator,
+            RemediationEngine,
+            load_playbooks,
+        )
+        from predictionio_tpu.storage.registry import StorageConfig
+
+        home = StorageConfig.from_env().home
+        engine = RemediationEngine(
+            OpsActuator(url=None if args.incident else args.url,
+                        home=home, timeout=args.timeout),
+            load_playbooks(args.remediations),
+            lock_path=os.path.join(home, "remediation.lock"))
+        results = engine.execute(engine.plan(findings), yes=args.yes)
     if args.json:
-        print(json.dumps({"findings": findings, "exit": code},
-                         indent=2, sort_keys=True))
+        out = {"findings": findings, "exit": code}
+        if results is not None:
+            out["remediation"] = results
+        print(json.dumps(out, indent=2, sort_keys=True))
     else:
         print(header)
         if not findings:
@@ -1318,6 +1375,17 @@ def cmd_doctor(args: argparse.Namespace) -> None:
         for f in findings:
             print(f"  [{labels[f['severity']]:<6}] {f['title']}")
             print(f"           {f['evidence']}")
+        if results is not None:
+            mode = ("EXECUTED" if args.yes else
+                    "DRY RUN — pass --yes to execute")
+            print(f"remediation plan ({mode}):")
+            if not results:
+                print("  nothing to do — no finding matches a playbook")
+            for r in results:
+                print(f"  [{r['result']:<11}] {r['playbook']}: "
+                      f"{r['action']} -> {r['target']}")
+                if r.get("detail"):
+                    print(f"               {r['detail']}")
     raise SystemExit(code)
 
 
@@ -1794,6 +1862,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds between synthetic canary probes "
                         "(X-PIO-Probe queries feeding the SLO series; "
                         "0 disables the prober)")
+    x.add_argument("--pool-spawn", metavar="CMD",
+                   help="own the replica fleet: spawn each replica with "
+                        "this command ('{port}' substituted), supervise "
+                        "it, and rewrite --manifest on membership "
+                        "changes (enables the autoscaler and POST "
+                        "/pool/* endpoints)")
+    x.add_argument("--min-replicas", type=int, default=1,
+                   help="pool floor: replicas started at boot and the "
+                        "scale-down limit")
+    x.add_argument("--max-replicas", type=int, default=4,
+                   help="pool ceiling: the autoscaler never scales past "
+                        "this")
+    x.add_argument("--autoscale-interval", type=float, default=5.0,
+                   help="seconds between autoscaler control ticks")
+    x.add_argument("--no-autoscale", action="store_true",
+                   help="own the pool but hold the fleet size fixed "
+                        "(manual scaling via POST /pool/add|remove)")
+    x.add_argument("--remediations", metavar="PATH", default=None,
+                   help="remediation playbooks for the auto-remediator "
+                        "(default: ./conf/remediations.json if present, "
+                        "else built-ins)")
     _add_observability_flags(x)
     _add_incident_flags(x)
     x = rts.add_parser("status", help="replica states from a running router")
@@ -2161,6 +2250,18 @@ def build_parser() -> argparse.ArgumentParser:
     dr.add_argument("--json", action="store_true",
                     help="findings + exit code as JSON")
     dr.add_argument("--timeout", type=float, default=10.0)
+    dr.add_argument("--act", action="store_true",
+                    help="map findings onto conf/remediations.json "
+                         "playbooks and print the remediation plan "
+                         "(dry run: NOTHING executes without --yes)")
+    dr.add_argument("--yes", action="store_true",
+                    help="with --act: actually execute the plan "
+                         "(rate-limited, target-verified, one "
+                         "remediation in flight)")
+    dr.add_argument("--remediations", metavar="PATH", default=None,
+                    help="playbook file for --act (default: "
+                         "./conf/remediations.json if present, else "
+                         "built-ins)")
     dr.set_defaults(fn=cmd_doctor)
 
     vp = sub.add_parser("version")

@@ -35,20 +35,48 @@ acquires instantly instead of waiting out the lease TTL. Size
 ``term_grace`` so a cycle can complete; a child killed at the grace
 deadline simply leaves the lease to expire (the fencing token keeps
 late writes out either way).
+
+Every restart is exported as ``pio_supervise_restarts_total{name,
+reason}`` (reason ``crash`` / ``health`` / ``operator``) and the
+current backoff delay as ``pio_supervise_backoff_seconds{name}``, so
+the autoscaler and ``pio doctor`` can tell a crash-looping replica
+from a healthy one without inferring it from /health flaps.
+
+:class:`ReplicaPool` builds on the supervisor: N supervised
+engine-server replicas on one host, with port allocation, health-gated
+add, drain-then-stop remove, and an atomically rewritten router
+manifest the fleet router's existing mtime watcher picks up. The pool
+is the actuator half of the autoscaler
+(:mod:`predictionio_tpu.server.autoscale`) and of the
+``restart_replica`` remediation playbook.
 """
 
 from __future__ import annotations
 
 import os
 import signal
+import socket
 import subprocess
 import sys
+import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Iterator, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
+from predictionio_tpu.utils.atomic_write import atomic_write_text
+from predictionio_tpu.utils.metrics import REGISTRY
 from predictionio_tpu.utils.resilience import backoff_delays
+
+_M_RESTARTS = REGISTRY.counter(
+    "pio_supervise_restarts_total",
+    "Supervised child restarts by cause (crash = unexpected exit, "
+    "health = failed health check, operator = requested restart)",
+    ("name", "reason"))
+_M_BACKOFF = REGISTRY.gauge(
+    "pio_supervise_backoff_seconds",
+    "Most recent restart-backoff delay; 0 once the child is stable",
+    ("name",))
 
 
 def _log(*args) -> None:
@@ -72,9 +100,14 @@ class Supervisor:
         backoff_max: float = 30.0,
         term_grace: float = 10.0,
         pidfile: Optional[str] = None,
+        name: str = "default",
         log=_log,
     ) -> None:
         self.argv = list(argv)
+        #: metric label (the pool uses ``host:port``); NOT a uniqueness
+        #: claim — two supervisors may share a name and their restart
+        #: counters then sum, which is what a dashboard wants anyway
+        self.name = name
         self.health_url = health_url
         self.health_interval = health_interval
         self.health_timeout = health_timeout
@@ -90,9 +123,11 @@ class Supervisor:
         self.log = log
         self._child: Optional[subprocess.Popen] = None
         self._stopping = False
+        self._restart_requested = False
         self.restarts = 0
         self.last_backoff = 0.0  # most recent restart delay (for logs/tests)
         self._restart_times: List[float] = []
+        _M_BACKOFF.set(0.0, (self.name,))
 
     # -- child lifecycle -------------------------------------------------------
 
@@ -146,6 +181,25 @@ class Supervisor:
             time.sleep(min(0.2, left))
         return False
 
+    def _record_restart(self, reason: str) -> None:
+        self.restarts += 1
+        _M_RESTARTS.inc((self.name, reason))
+
+    def child_pid(self) -> Optional[int]:
+        """Pid of the live child, or None (chaos drills kill -9 it)."""
+        child = self._child
+        if child is None or child.poll() is not None:
+            return None
+        return child.pid
+
+    def request_restart(self) -> None:
+        """Ask the run loop to bounce the child: terminate + immediate
+        respawn, no backoff and no restart-budget charge. This is the
+        remediation path ("restart wedged replica") — an operator
+        decision, not a crash, so it must neither burn the crash budget
+        nor wait out a backoff schedule."""
+        self._restart_requested = True
+
     # -- main loop -------------------------------------------------------------
 
     def run(self) -> int:
@@ -173,9 +227,19 @@ class Supervisor:
             last_health = started
             delays: Optional[Iterator[float]] = None  # None = fresh schedule
             while not self._stopping:
+                if self._restart_requested:
+                    self._restart_requested = False
+                    self.log("[supervise] operator restart requested")
+                    self._terminate_child()
+                    self._record_restart("operator")
+                    self._spawn()
+                    started = time.monotonic()
+                    last_health = started
+                    continue
                 code = self._child.poll() if self._child else None
                 now = time.monotonic()
                 restart = False
+                reason = "crash"
                 if code is not None:
                     if self._stopping:
                         break
@@ -196,6 +260,7 @@ class Supervisor:
                                  "restarting child")
                         self._terminate_child()
                         restart = True
+                        reason = "health"
                 if restart:
                     if self._budget_exceeded(now):
                         self.log(f"[supervise] {self.max_restarts} restarts "
@@ -203,10 +268,11 @@ class Supervisor:
                                  "giving up")
                         return 1
                     self._restart_times.append(now)
-                    self.restarts += 1
+                    self._record_restart(reason)
                     if delays is None:
                         delays = self._new_delays()
                     self.last_backoff = next(delays)
+                    _M_BACKOFF.set(self.last_backoff, (self.name,))
                     self.log(f"[supervise] restarting in "
                              f"{self.last_backoff:.2f}s")
                     if not self._sleep(self.last_backoff):
@@ -218,10 +284,15 @@ class Supervisor:
                     if (self._child is not None
                             and now - started > 2 * max(self.backoff, 1.0)):
                         delays = None  # stable → reset backoff schedule
+                        _M_BACKOFF.set(0.0, (self.name,))
                     time.sleep(0.2)
             self._terminate_child()
             return 0
         finally:
+            # whatever ended the loop (clean stop, budget exhausted),
+            # there is no pending backoff any more — a gauge stuck at
+            # the last delay would read as a live crash loop
+            _M_BACKOFF.set(0.0, (self.name,))
             for sig, handler in old.items():
                 signal.signal(sig, handler)
             if self.pidfile:
@@ -233,6 +304,213 @@ class Supervisor:
     def stop(self) -> None:
         self._stopping = True
         self._terminate_child()
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """One free TCP port on ``host`` (bind-0 probe). Racy by nature —
+    the pool's health-gated add is what actually confirms the replica
+    bound it; a lost race just fails the add loudly."""
+    s = socket.socket()
+    try:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+class PoolError(RuntimeError):
+    """A replica-pool operation refused or failed (add never became
+    healthy, remove would empty the pool, unknown replica name)."""
+
+
+class ReplicaPool:
+    """N supervised engine-server replicas on one host, fronted by a
+    fleet-router manifest this pool owns and rewrites atomically.
+
+    ``spawn`` describes how to start one replica: either a callable
+    ``port -> argv`` or an argv template whose ``{port}`` tokens are
+    substituted. Each replica runs under its own :class:`Supervisor`
+    in a daemon thread (crash restart with backoff, restart metrics),
+    so a kill -9'd replica is backfilled without anyone paging.
+
+    - **add** is health-gated: the replica joins the manifest only
+      after its ``/health`` answers 200, so the router never routes to
+      a replica that is still compiling/loading.
+    - **remove** is drain-then-stop: the replica leaves the manifest
+      first (the router's watcher stops picking it), waits
+      ``drain_grace`` for in-flight requests to finish, then SIGTERMs.
+    - **restart** is the remediation actuator: terminate + respawn via
+      :meth:`Supervisor.request_restart` (no budget charge), then the
+      health gate re-admits it.
+
+    Mutating methods serialize on one op lock ("one membership change
+    at a time" is exactly the serialization the autoscaler wants),
+    while a second short-held lock guards the member dict so status
+    snapshots never wait behind a minutes-long health-gated add.
+    """
+
+    def __init__(self, spawn: Any, manifest: str, *,
+                 host: str = "127.0.0.1",
+                 ready_timeout: float = 120.0,
+                 drain_grace: float = 2.0,
+                 health_interval: float = 2.0,
+                 health_grace: float = 30.0,
+                 max_restarts: int = 20,
+                 backoff: float = 0.5,
+                 backoff_max: float = 10.0,
+                 log: Callable[..., None] = _log) -> None:
+        self.spawn = spawn
+        self.manifest = manifest
+        self.host = host
+        self.ready_timeout = ready_timeout
+        self.drain_grace = drain_grace
+        self.health_interval = health_interval
+        self.health_grace = health_grace
+        self.max_restarts = max_restarts
+        self.backoff = backoff
+        self.backoff_max = backoff_max
+        self.log = log
+        self._lock = threading.Lock()     # guards _members (short holds)
+        self._op = threading.Lock()       # serializes membership changes
+        #: name ("host:port") → {"port", "supervisor", "thread"}
+        self._members: Dict[str, Dict[str, Any]] = {}
+
+    # -- introspection ---------------------------------------------------------
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._members)
+
+    def child_pid(self, name: str) -> Optional[int]:
+        with self._lock:
+            member = self._members.get(name)
+        return member["supervisor"].child_pid() if member else None
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            members = dict(self._members)
+        return [{"name": name,
+                 "port": m["port"],
+                 "pid": m["supervisor"].child_pid(),
+                 "restarts": m["supervisor"].restarts,
+                 "lastBackoffSec": m["supervisor"].last_backoff}
+                for name, m in sorted(members.items())]
+
+    # -- manifest --------------------------------------------------------------
+
+    def _write_manifest_locked(self) -> None:
+        lines = ["# written by ReplicaPool — do not edit by hand"]
+        lines += [f"http://{name}" for name in sorted(self._members)]
+        atomic_write_text(self.manifest, "\n".join(lines) + "\n")
+
+    # -- replica helpers -------------------------------------------------------
+
+    def _argv(self, port: int) -> List[str]:
+        if callable(self.spawn):
+            return [str(a) for a in self.spawn(port)]
+        return [str(a).replace("{port}", str(port)) for a in self.spawn]
+
+    def _ready(self, port: int) -> bool:
+        try:
+            url = f"http://{self.host}:{port}/health"
+            with urllib.request.urlopen(url, timeout=2.0) as r:
+                return r.status == 200
+        except Exception:  # noqa: BLE001 — not up yet, whatever the cause
+            return False
+
+    # -- membership ------------------------------------------------------------
+
+    def add_replica(self) -> str:
+        """Spawn + health-gate + admit one replica; returns its name.
+        Raises :class:`PoolError` when the replica never becomes
+        healthy inside ``ready_timeout`` (the supervisor is stopped —
+        a failed add must not leave an orphan crash-looping)."""
+        with self._op:
+            port = free_port(self.host)
+            name = f"{self.host}:{port}"
+            sup = Supervisor(
+                self._argv(port),
+                health_url=f"http://{self.host}:{port}/health",
+                health_interval=self.health_interval,
+                health_grace=self.health_grace,
+                max_restarts=self.max_restarts,
+                backoff=self.backoff, backoff_max=self.backoff_max,
+                name=name, log=self.log)
+            thread = threading.Thread(
+                target=sup.run, name=f"pio-pool-{name}", daemon=True)
+            thread.start()
+            deadline = time.monotonic() + self.ready_timeout
+            while time.monotonic() < deadline:
+                if self._ready(port):
+                    break
+                if not thread.is_alive():
+                    raise PoolError(
+                        f"replica {name} supervisor died during startup")
+                time.sleep(0.1)
+            else:
+                sup.stop()
+                raise PoolError(
+                    f"replica {name} not healthy after "
+                    f"{self.ready_timeout:.0f}s")
+            with self._lock:
+                self._members[name] = {
+                    "port": port, "supervisor": sup, "thread": thread}
+                self._write_manifest_locked()
+                n = len(self._members)
+            self.log(f"[pool] admitted replica {name} ({n} in manifest)")
+            return name
+
+    def remove_replica(self, name: Optional[str] = None) -> str:
+        """Drain-then-stop one replica (the named one, else the
+        newest). Refuses to empty the pool — scale-down past one
+        replica is an outage, not an optimization."""
+        with self._op:
+            with self._lock:
+                if len(self._members) <= 1:
+                    raise PoolError("refusing to remove the last replica")
+                if name is None:
+                    name = max(self._members,
+                               key=lambda n: self._members[n]["port"])
+                member = self._members.pop(name, None)
+                if member is None:
+                    raise PoolError(
+                        f"no replica named {name!r} in the pool")
+                # manifest first: the router stops routing to it, THEN
+                # the process goes away — never the other way around
+                self._write_manifest_locked()
+                n = len(self._members)
+            time.sleep(self.drain_grace)
+            member["supervisor"].stop()
+            member["thread"].join(timeout=30.0)
+            self.log(f"[pool] removed replica {name} ({n} in manifest)")
+            return name
+
+    def restart_replica(self, name: str) -> None:
+        """Bounce one replica (operator/remediation restart — no
+        budget charge, no backoff). The supervisor's health gate and
+        the router's own /health polling re-admit it."""
+        with self._lock:
+            member = self._members.get(name)
+            if member is None:
+                raise PoolError(f"no replica named {name!r} in the pool")
+            member["supervisor"].request_restart()
+
+    def stop_all(self) -> None:
+        with self._lock:
+            members = dict(self._members)
+            self._members.clear()
+            try:
+                self._write_manifest_locked()
+            except OSError:
+                pass
+        for member in members.values():
+            member["supervisor"].stop()
+        for member in members.values():
+            member["thread"].join(timeout=30.0)
 
 
 def normalize_command(command: Sequence[str]) -> List[str]:

@@ -113,6 +113,18 @@ Known sites (grep ``faults.inject`` for the authoritative list):
                         lease renewal fails as if partitioned; the
                         leader must fence itself (writes 503) before
                         the TTL lets a follower promote
+``autoscale.flap``      autoscaler decision tick — the raw desire is
+                        inverted every tick (a poisoned signal); the
+                        cooldown/flap-damping guardrails, not the
+                        thresholds, must bound membership churn
+``remediate.wrong_target``  remediation target selection — the engine
+                        picks a plausible WRONG target (a healthy
+                        replica); pre-action verification must refuse
+                        it, never act on it
+``remediate.storm``     auto-remediation dedup — the same finding
+                        re-fires every tick as if brand new; the
+                        per-playbook rate limit alone must bound the
+                        blast radius
 ======================  ===================================================
 """
 

@@ -473,6 +473,18 @@ def _series_entity(key: str) -> str:
     return key
 
 
+def _series_label(key: str, want: str) -> Optional[str]:
+    """One label value out of a rendered series key, or None."""
+    if "{" not in key:
+        return None
+    _, _, labels = key.partition("{")
+    for p in labels.rstrip("}").split(","):
+        k, _, v = p.partition("=")
+        if k == want:
+            return v.strip('"')
+    return None
+
+
 def _first_movers(history: Dict[str, Any], limit: int = 3
                   ) -> List[Tuple[float, str]]:
     """Timeline alignment: for every captured series, the earliest
@@ -494,10 +506,13 @@ def _first_movers(history: Dict[str, Any], limit: int = 3
 
 def diagnose(bundle: Dict[str, Any]) -> List[Dict[str, Any]]:
     """Correlate one bundle into ranked findings
-    ``{"severity": 0|1|2, "title", "evidence"}`` — severity 2 = firing
-    (page-worthy), 1 = warn, 0 = informational. Sorted most severe
-    first; :func:`exit_code` maps the ranking onto the ``pio doctor``
-    exit contract."""
+    ``{"severity": 0|1|2, "kind", "title", "evidence"}`` — severity
+    2 = firing (page-worthy), 1 = warn, 0 = informational. ``kind`` is
+    the machine handle ``conf/remediations.json`` playbooks match on;
+    kinds with a target also carry the structured field the actuator
+    needs (``replica``, ``app``, ``site``, ``slo``). Sorted most
+    severe first; :func:`exit_code` maps the ranking onto the
+    ``pio doctor`` exit contract."""
     manifest = bundle.get("manifest") or {}
     files = bundle.get("files") or {}
     findings: List[Dict[str, Any]] = []
@@ -505,6 +520,8 @@ def diagnose(bundle: Dict[str, Any]) -> List[Dict[str, Any]]:
     for name in manifest.get("sloFastBurning") or []:
         findings.append({
             "severity": 2,
+            "kind": "slo-fast-burn",
+            "slo": name,
             "title": f"SLO {name} fast-burning at capture",
             "evidence": "manifest.sloFastBurning; burn rates in "
                         "slo_status.json",
@@ -513,6 +530,8 @@ def diagnose(bundle: Dict[str, Any]) -> List[Dict[str, Any]]:
     for site, plan in sorted(faults.items()):
         findings.append({
             "severity": 2,
+            "kind": "fault-armed",
+            "site": site,
             "title": f"fault site {site} armed during the incident era",
             "evidence": f"injected plan {plan} — this window is a "
                         "drill/chaos era, not organic failure",
@@ -522,6 +541,9 @@ def diagnose(bundle: Dict[str, Any]) -> List[Dict[str, Any]]:
         if state in ("down", "not-ready"):
             findings.append({
                 "severity": 2 if state == "down" else 1,
+                "kind": ("replica-down" if state == "down"
+                         else "replica-not-ready"),
+                "replica": rep.get("url"),
                 "title": f"replica {rep.get('url')} was {state}",
                 "evidence": f"breaker={rep.get('breaker')} "
                             f"ewmaMs={rep.get('ewmaMs')}",
@@ -529,6 +551,8 @@ def diagnose(bundle: Dict[str, Any]) -> List[Dict[str, Any]]:
         elif rep.get("breaker") == "open":
             findings.append({
                 "severity": 2,
+                "kind": "breaker-open",
+                "replica": rep.get("url"),
                 "title": f"replica {rep.get('url')} breaker open",
                 "evidence": "passive breaker ejected the replica; "
                             "Retry-After windows applied",
@@ -540,6 +564,7 @@ def diagnose(bundle: Dict[str, Any]) -> List[Dict[str, Any]]:
         rest = ", ".join(w for _, w in movers[1:])
         findings.append({
             "severity": 1,
+            "kind": "first-mover",
             "title": f"{who} moved first (t={t0:.1f})",
             "evidence": ("followed by " + rest if rest else
                          "no other series moved in the window"),
@@ -553,6 +578,8 @@ def diagnose(bundle: Dict[str, Any]) -> List[Dict[str, Any]]:
         if len(samples) >= 2 and samples[-1][1] > samples[0][1]:
             findings.append({
                 "severity": 1,
+                "kind": "tenant-pressure",
+                "app": _series_label(key, "app"),
                 "title": f"tenant pressure: {_series_entity(key)} "
                          f"rose {samples[0][1]:g} → {samples[-1][1]:g}",
                 "evidence": "shed/quota 429s carried Retry-After "
@@ -563,6 +590,7 @@ def diagnose(bundle: Dict[str, Any]) -> List[Dict[str, Any]]:
         worst = exemplars[0]
         findings.append({
             "severity": 0,
+            "kind": "exemplar",
             "title": f"worst pinned exemplar {worst.get('valueMs')}ms "
                      f"in {worst.get('series')}",
             "evidence": f"trace {worst.get('traceId')} resolvable in "
@@ -572,6 +600,7 @@ def diagnose(bundle: Dict[str, Any]) -> List[Dict[str, Any]]:
     if len(triggers) > 1:
         findings.append({
             "severity": 0,
+            "kind": "coalesced",
             "title": f"{len(triggers)} triggers coalesced into this "
                      "bundle",
             "evidence": ", ".join(t.get("trigger", "?") for t in triggers),
@@ -583,18 +612,43 @@ def diagnose(bundle: Dict[str, Any]) -> List[Dict[str, Any]]:
 def diagnose_live(slo_doc: Dict[str, Any], health_doc: Dict[str, Any],
                   top_doc: Dict[str, Any]) -> List[Dict[str, Any]]:
     """The live-fleet variant of :func:`diagnose`, over the router's
-    ``/slo/status`` + ``/health`` + ``/top`` answers."""
+    ``/slo/status`` + ``/health`` + ``/top`` answers. Same
+    ``kind``/target contract as :func:`diagnose` — this is what
+    ``pio doctor --act --url`` feeds the remediation engine."""
     findings: List[Dict[str, Any]] = []
-    for name in slo_doc.get("fastBurning") or []:
+    fast = slo_doc.get("fastBurning") or []
+    for name in fast:
         findings.append({
             "severity": 2,
+            "kind": "slo-fast-burn",
+            "slo": name,
             "title": f"SLO {name} fast-burning NOW",
             "evidence": "live /slo/status",
         })
+    if fast:
+        # a fast burn while a model generation is serving is the
+        # rollback playbook's trigger — the most common cause of a
+        # sudden fleet-wide burn is the generation just promoted
+        gens = sorted({rep.get("modelGeneration")
+                       for rep in top_doc.get("replicas") or []
+                       if rep.get("modelGeneration") is not None})
+        if gens:
+            findings.append({
+                "severity": 1,
+                "kind": "model-regression",
+                "generation": gens[-1],
+                "title": f"fast burn while model generation {gens[-1]} "
+                         "serves — suspect the last promotion",
+                "evidence": "fastBurning + replica modelGeneration on "
+                            "live /top; rollback restores the previous "
+                            "champion",
+            })
     for s in slo_doc.get("slos") or []:
         if s.get("slowBurn") and not s.get("fastBurn"):
             findings.append({
                 "severity": 1,
+                "kind": "slo-slow-burn",
+                "slo": s.get("name"),
                 "title": f"SLO {s.get('name')} slow-burning",
                 "evidence": "ticket-grade budget spend on live "
                             "/slo/status",
@@ -602,6 +656,7 @@ def diagnose_live(slo_doc: Dict[str, Any], health_doc: Dict[str, Any],
     if health_doc.get("status") == "degraded":
         findings.append({
             "severity": 1,
+            "kind": "router-degraded",
             "title": "router /health degraded",
             "evidence": str(health_doc.get("reason", "")),
         })
@@ -609,11 +664,36 @@ def diagnose_live(slo_doc: Dict[str, Any], health_doc: Dict[str, Any],
         if rep.get("state") == "down" or rep.get("breaker") == "open":
             findings.append({
                 "severity": 2,
+                "kind": ("replica-down" if rep.get("state") == "down"
+                         else "breaker-open"),
+                "replica": rep.get("url"),
                 "title": f"replica {rep.get('url')} "
                          f"state={rep.get('state')} "
                          f"breaker={rep.get('breaker')}",
                 "evidence": "live /top replica table",
             })
+    for app, rate in sorted((top_doc.get("tenantSheds") or {}).items()):
+        if rate > 0:
+            findings.append({
+                "severity": 1,
+                "kind": "tenant-pressure",
+                "app": app,
+                "title": f"tenant {app} being shed at {rate:g}/s",
+                "evidence": "live /top tenantSheds; clamp playbook "
+                            "rewrites quotas.json",
+            })
+    probe = top_doc.get("probe") or {}
+    err = sum(v for k, v in probe.items() if k != "ok")
+    if err > 0 and err >= probe.get("ok", 0.0):
+        findings.append({
+            "severity": 2,
+            "kind": "probe-failing",
+            "title": f"synthetic probe failing at {err:g}/s "
+                     f"(ok {probe.get('ok', 0.0):g}/s)",
+            "evidence": "live /top probe outcomes; exclusion playbook "
+                        "pauses the prober while the canary target is "
+                        "repaired",
+        })
     findings.sort(key=lambda f: -f["severity"])
     return findings
 

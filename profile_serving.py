@@ -60,6 +60,20 @@ intervals and degrades ``/health``, then that the page clears after
 the fault is lifted and the fleet serves all-200 again — with zero
 XLA compiles on the serving path across the whole drill.
 
+Self-healing fleet chaos mode (the acceptance harness for
+docs/operations.md "Self-healing fleet")::
+
+    python profile_serving.py --autoscale
+
+runs a ReplicaPool of real replica subprocesses behind a FleetRouter
+with the SLO-driven autoscaler and auto-remediation enabled: a 10x
+traffic ramp must scale the fleet 1→N with zero 5xx and post-scale
+p99 within 2x of baseline; a kill -9'd replica under an armed
+``remediate.storm`` must be remediated exactly once (the rate limit
+is the storm guard); scale-down must never drop below one healthy
+replica; and ``pio doctor --act`` WITHOUT ``--yes`` must print the
+full remediation plan while executing nothing.
+
 Prints ONE JSON line. On this image's tunneled TPU every device→host
 fetch after the first pays a ~66 ms relay round trip (BASELINE.md
 note) — run with ``--platform cpu`` for the HTTP/host shares and on a
@@ -1802,6 +1816,275 @@ def run_incident_mode(args, st, factory) -> None:
         raise SystemExit(1)
 
 
+def run_autoscale_mode(args) -> None:
+    """Self-healing fleet chaos harness (ISSUE 19 acceptance). Real
+    replica subprocesses under a :class:`ReplicaPool` behind a
+    :class:`FleetRouter` running the autoscaler + auto-remediation
+    control loop. Phases:
+
+    1. baseline — paced low-rate traffic; the fleet must hold at one
+       replica (no scale thrash at rest) while p99 is measured;
+    2. 10x ramp — sustained pressure must scale 1→N (N >= 2) with zero
+       5xx across the whole ramp and post-scale p99 <= 2x baseline;
+    3. kill -9 + ``remediate.storm`` — the dead replica is detected
+       (health → down), remediated through the restart playbook
+       EXACTLY once (storm re-presents the finding every tick; the
+       per-playbook rate limit alone bounds the blast radius), and
+       backfilled by its supervisor;
+    4. scale-down — traffic stops; the fleet drains back to one
+       replica and no down decision ever fires with <= 1 healthy;
+    5. ``pio doctor --act`` (no ``--yes``) against the incident bundle
+       the remediation pinned — the full plan prints, every entry is
+       ``dry-run``, and no replica is touched.
+
+    The parent process stays jax-free: replicas are subprocesses.
+    """
+    import os
+    import shutil
+    import socket
+    import subprocess
+    import sys as _sys
+    import tempfile
+    import threading
+
+    from predictionio_tpu.server.autoscale import AutoscaleConfig
+    from predictionio_tpu.server.router import FleetRouter
+    from predictionio_tpu.tools.supervise import ReplicaPool
+    from predictionio_tpu.utils.faults import FAULTS
+    from predictionio_tpu.utils.incidents import IncidentStore
+    from profile_common import server_thread
+
+    work = tempfile.mkdtemp(prefix="pio-autoscale-drill-")
+    manifest = os.path.join(work, "fleet.txt")
+    inc_dir = os.path.join(work, "incidents")
+    rem_path = os.path.join(work, "remediations.json")
+    with open(rem_path, "w") as f:
+        # rateLimit max=1 makes "exactly once" a property of the
+        # engine, not of lucky timing
+        json.dump({"playbooks": [
+            {"name": "restart-wedged-replica",
+             "match": {"kinds": ["replica-down", "replica-not-ready",
+                                 "breaker-open"], "minSeverity": 1},
+             "action": "restart_replica",
+             "rateLimit": {"max": 1, "windowSec": 600}},
+        ]}, f)
+
+    pool = ReplicaPool(
+        [_sys.executable, __file__, "--_replica-port", "{port}",
+         "--platform", args.platform, "--n-users", str(args.n_users),
+         "--n-items", str(args.n_items), "--rank", str(args.rank)],
+        manifest, ready_timeout=240.0, drain_grace=0.5,
+        health_interval=0.5, health_grace=120.0, backoff=0.2,
+        backoff_max=1.0, log=lambda *a: None)
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    router_port = s.getsockname()[1]
+    s.close()
+
+    def paced_load(rate_hz: float, threads: int = 4):
+        """Open-loop paced client threads; unlike the closed-loop
+        ``_router_load`` the offered rate is fixed, so the autoscaler's
+        qps signal is the experiment variable, not a side effect of
+        latency. Returns (stop_fn, samples, lock)."""
+        stop = threading.Event()
+        lock = threading.Lock()
+        samples = []  # (status, latency_s, started_at)
+
+        def worker(seed: int):
+            import http.client as hc
+
+            rng = np.random.default_rng(seed)
+            conn = hc.HTTPConnection("127.0.0.1", router_port, timeout=30)
+            interval = threads / rate_hz
+            next_t = time.perf_counter()
+            while not stop.is_set():
+                now = time.perf_counter()
+                if now < next_t:
+                    time.sleep(min(0.01, next_t - now))
+                    continue
+                next_t += interval
+                body = json.dumps(
+                    {"user": str(int(rng.integers(0, args.n_users))),
+                     "num": 10})
+                t0 = time.perf_counter()
+                try:
+                    conn.request("POST", "/queries.json", body,
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    status = resp.status
+                except Exception:
+                    conn.close()
+                    conn = hc.HTTPConnection("127.0.0.1", router_port,
+                                             timeout=30)
+                    status = 0
+                with lock:
+                    samples.append((status, time.perf_counter() - t0, t0))
+            conn.close()
+
+        ts = [threading.Thread(target=worker, args=(31 + i,), daemon=True)
+              for i in range(threads)]
+        for t in ts:
+            t.start()
+
+        def stop_fn():
+            stop.set()
+            for t in ts:
+                t.join(timeout=15)
+            with lock:
+                return list(samples)
+
+        return stop_fn, samples, lock
+
+    def wait_for(pred, what: str, deadline_sec: float):
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < deadline_sec:
+            if pred():
+                return time.perf_counter() - t0
+            time.sleep(0.05)
+        raise TimeoutError(f"timed out waiting for {what}")
+
+    def p99(lats):
+        return float(np.percentile(np.asarray(lats), 99)) if lats else 0.0
+
+    cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=3, interval=0.5, window=5.0,
+        up_qps_per_replica=25.0, down_qps_per_replica=4.0,
+        sustain_ticks=3, quiet_ticks=6, cooldown_up=5.0,
+        cooldown_down=6.0, flap_window=600.0, flap_max_actions=10)
+
+    checks: dict = {}
+    detail: dict = {}
+    try:
+        pool.add_replica()          # the fleet starts at min_replicas
+        router = FleetRouter(
+            manifest=manifest, host="127.0.0.1", port=router_port,
+            health_interval=0.25, scrape_interval=0.25,
+            probe_interval=0.0, incident_dir=inc_dir,
+            pool=pool, autoscale=cfg, remediations=rem_path)
+        with server_thread(router, router_port):
+            wait_for(lambda: all(r.state == "ok"
+                                 for r in router.replicas)
+                     and len(router.replicas) == 1,
+                     "the seed replica behind the router", 60)
+
+            # -- phase 1: baseline at 1 replica ----------------------
+            stop_fn, _, _ = paced_load(8.0, threads=2)
+            time.sleep(6.0)
+            base_samples = stop_fn()
+            base_p99 = p99([l for _, l, _ in base_samples])
+            checks["baseline_no_scale"] = pool.size() == 1
+            detail["baseline_p99_ms"] = round(base_p99 * 1e3, 2)
+
+            # -- phase 2: 10x ramp -----------------------------------
+            stop_fn, samples, lock = paced_load(80.0, threads=8)
+            scale_elapsed = wait_for(lambda: pool.size() >= 2,
+                                     "the ramp to scale the fleet up",
+                                     200)
+            time.sleep(8.0)         # settle at the scaled size
+            ramp_samples = stop_fn()
+            t_end = max(t for _, _, t in ramp_samples)
+            post = [l for st, l, t in ramp_samples if t >= t_end - 5.0]
+            post_p99 = p99(post)
+            bad = {str(st): sum(1 for s, _, _ in ramp_samples if s == st)
+                   for st in {s for s, _, _ in ramp_samples}
+                   if st == 0 or st >= 500}
+            n_scaled = pool.size()
+            checks["ramp_scaled_up"] = n_scaled >= 2
+            checks["ramp_zero_5xx"] = not bad
+            checks["post_scale_p99_within_2x"] = post_p99 <= 2 * base_p99
+            detail.update(
+                ramp_replicas=n_scaled,
+                ramp_scale_elapsed_s=round(scale_elapsed, 1),
+                ramp_bad_statuses=bad,
+                post_scale_p99_ms=round(post_p99 * 1e3, 2))
+
+            # -- phase 3: kill -9 under remediate.storm --------------
+            victim = pool.names()[-1]
+            pid0 = pool.child_pid(victim)
+            eng = router.remediator
+            executed = lambda: sum(  # noqa: E731
+                1 for e in eng.log if e["result"] == "executed")
+            FAULTS.arm("remediate.storm", error="storm drill")
+            os.kill(pid0, 9)
+            wait_for(lambda: executed() >= 1,
+                     "the restart remediation to fire", 60)
+            wait_for(lambda: pool.child_pid(victim) not in (None, pid0),
+                     "the supervisor to backfill the victim", 120)
+            wait_for(lambda: all(r.state == "ok"
+                                 for r in router.replicas),
+                     "the fleet to heal", 180)
+            time.sleep(3.0)         # several more storm ticks
+            FAULTS.disarm("remediate.storm")
+            checks["kill_remediated_exactly_once"] = executed() == 1
+            checks["storm_guard_rate_limited"] = all(
+                e["result"] in ("executed", "rate-limited")
+                for e in eng.log)
+            checks["kill_backfilled"] = (
+                pool.child_pid(victim) not in (None, pid0))
+            detail["remediation_log"] = [
+                {"playbook": e["playbook"], "target": e["target"],
+                 "result": e["result"]} for e in eng.log]
+
+            # -- phase 4: quiet -> scale-down to one healthy ---------
+            wait_for(lambda: pool.size() == 1,
+                     "the quiet fleet to scale back down", 120)
+            time.sleep(2.0)
+            downs = [d for d in router.autoscaler.decisions
+                     if d["action"] == "down"]
+            checks["scaled_down_to_min"] = pool.size() == 1
+            checks["down_never_below_one_healthy"] = all(
+                d["signals"]["healthy"] >= 2 for d in downs)
+            detail["down_decisions"] = len(downs)
+
+            # -- phase 5: doctor --act WITHOUT --yes -----------------
+            store = IncidentStore(inc_dir)
+            bundles = [i for i in store.ids()
+                       if store.load_manifest(i) is not None]
+            checks["incident_bundle_pinned"] = bool(bundles)
+            plan, plan_ok = [], False
+            pids_before = {n: pool.child_pid(n) for n in pool.names()}
+            if bundles:
+                proc = subprocess.run(
+                    [_sys.executable, "-m",
+                     "predictionio_tpu.tools.cli", "doctor",
+                     "--incident", bundles[0], "--dir", inc_dir,
+                     "--act", "--remediations", rem_path, "--json"],
+                    capture_output=True, text=True, timeout=120)
+                try:
+                    plan = json.loads(proc.stdout).get("remediation", [])
+                except ValueError:
+                    pass
+                plan_ok = bool(plan) and all(
+                    e["result"] == "dry-run" for e in plan)
+            checks["doctor_act_plans_without_executing"] = (
+                plan_ok and executed() == 1
+                and {n: pool.child_pid(n) for n in pool.names()}
+                == pids_before)
+            detail["doctor_plan"] = [
+                {"playbook": e.get("playbook"), "target": e.get("target"),
+                 "result": e.get("result")} for e in plan]
+    finally:
+        FAULTS.disarm()
+        pool.stop_all()
+
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "self_healing_autoscale_drill",
+        "geometry": {"n_users": args.n_users, "n_items": args.n_items,
+                     "rank": args.rank},
+        "autoscale": {"min": cfg.min_replicas, "max": cfg.max_replicas,
+                      "interval_s": cfg.interval},
+        **detail,
+        "checks": checks,
+        "ok": ok,
+    }))
+    shutil.rmtree(work, ignore_errors=True)
+    if not ok:
+        raise SystemExit(1)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--queries", type=int, default=2000)
@@ -1842,6 +2125,16 @@ def main() -> None:
                          "injected promote.regression, and a fenced "
                          "second trainer must all leave the fleet "
                          "serving the right champion with zero errors")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="self-healing fleet chaos mode: a ReplicaPool "
+                         "of replica subprocesses behind a FleetRouter "
+                         "with the autoscaler + auto-remediation loop; "
+                         "a 10x ramp must scale 1->N with zero 5xx and "
+                         "post-scale p99 <= 2x baseline, a kill -9 "
+                         "under remediate.storm must be remediated "
+                         "exactly once, scale-down must never drop "
+                         "below one healthy replica, and `pio doctor "
+                         "--act` without --yes must plan only")
     ap.add_argument("--_replica-port", dest="replica_port", type=int,
                     default=0, help=argparse.SUPPRESS)
     ap.add_argument("--_replica-home", dest="replica_home", default="",
@@ -1901,6 +2194,11 @@ def main() -> None:
         # no jax in the parent: the trainers and the replica are real
         # subprocesses, the harness only seeds events and watches files
         run_train_loop_mode(args)
+        return
+    if args.autoscale:
+        # likewise jax-free in the parent: the pool's replicas are
+        # subprocesses, the router/autoscaler/remediator are pure host
+        run_autoscale_mode(args)
         return
 
     from profile_common import make_memory_storage, resolve_platform

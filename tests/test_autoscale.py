@@ -1,0 +1,648 @@
+"""Self-healing fleet (ISSUE 19): ReplicaPool membership over real
+subprocess replicas, autoscaler decisions + guardrails over a fake
+router, the remediation engine's playbooks and drills, the eventsink
+redirect counter, and a chaos-marked end-to-end smoke (router + pool +
+auto-remediator with ``router.replica.down`` armed — exactly one
+remediation fires, no storm).
+
+Fault sites exercised here (closure-audited by test_faults_registry):
+``autoscale.flap``, ``remediate.wrong_target``, ``remediate.storm``,
+``router.replica.down``.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.server.autoscale import AutoscaleConfig, Autoscaler
+from predictionio_tpu.server.remediate import (
+    DEFAULT_PLAYBOOKS_PATH,
+    Playbook,
+    RemediationEngine,
+    finding_target,
+    load_playbooks,
+)
+from predictionio_tpu.tools.supervise import (
+    _M_RESTARTS,
+    PoolError,
+    ReplicaPool,
+)
+from predictionio_tpu.utils.faults import FAULTS
+from tests.test_servers import free_port
+from tests.test_router import wait_until
+
+
+@pytest.fixture(autouse=True)
+def disarm_faults():
+    FAULTS.disarm()
+    yield
+    FAULTS.disarm()
+
+
+# a jax-free engine-server stand-in fast enough to spawn in bulk:
+# /health 200, /queries.json 200, /metrics minimal prom text
+STUB = """
+import json, sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+port = int(sys.argv[1])
+
+
+class H(BaseHTTPRequestHandler):
+    def _send(self, code, body, ctype="application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path.startswith("/metrics"):
+            self._send(200, b"pio_stub_up 1\\n", "text/plain")
+        else:
+            self._send(200, json.dumps(
+                {"status": "ok", "instance": "stub%d" % port,
+                 "startedAt": 1.0, "reloadGeneration": 0}).encode())
+
+    def do_POST(self):
+        self.rfile.read(int(self.headers.get("Content-Length") or 0))
+        self._send(200, b'{"itemScores": []}')
+
+    def log_message(self, *a):
+        pass
+
+
+ThreadingHTTPServer(("127.0.0.1", port), H).serve_forever()
+"""
+
+STUB_SPAWN = [sys.executable, "-S", "-c", STUB, "{port}"]
+
+
+def _pool(tmp_path, **kw):
+    kw.setdefault("drain_grace", 0.05)
+    kw.setdefault("ready_timeout", 30.0)
+    kw.setdefault("backoff", 0.05)
+    kw.setdefault("backoff_max", 0.1)
+    kw.setdefault("log", lambda *a: None)
+    return ReplicaPool(STUB_SPAWN, str(tmp_path / "manifest.txt"), **kw)
+
+
+# -- replica pool --------------------------------------------------------------
+
+
+class TestReplicaPool:
+    def test_add_remove_rewrite_manifest(self, tmp_path):
+        pool = _pool(tmp_path)
+        try:
+            a = pool.add_replica()
+            b = pool.add_replica()
+            assert pool.names() == sorted([a, b])
+            manifest = (tmp_path / "manifest.txt").read_text()
+            assert f"http://{a}" in manifest and f"http://{b}" in manifest
+            # default remove picks the newest (highest port) member,
+            # and the manifest loses it atomically
+            newest = max([a, b], key=lambda n: int(n.rsplit(":", 1)[1]))
+            assert pool.remove_replica() == newest
+            manifest = (tmp_path / "manifest.txt").read_text()
+            assert f"http://{newest}" not in manifest
+            with pytest.raises(PoolError):
+                pool.remove_replica()  # never empty the pool
+        finally:
+            pool.stop_all()
+        # stop_all leaves an empty (comment-only) manifest behind
+        lines = [ln for ln in
+                 (tmp_path / "manifest.txt").read_text().splitlines()
+                 if ln and not ln.startswith("#")]
+        assert lines == []
+
+    def test_operator_restart_and_kill9_backfill(self, tmp_path):
+        pool = _pool(tmp_path)
+        try:
+            name = pool.add_replica()
+            pid1 = pool.child_pid(name)
+            assert pid1 is not None
+            # operator restart: new pid, "operator" reason, health-gated
+            pool.restart_replica(name)
+            assert wait_until(
+                lambda: pool.child_pid(name) not in (None, pid1),
+                timeout=15)
+            assert wait_until(lambda: pool._ready(
+                int(name.rsplit(":", 1)[1])), timeout=15)
+            assert _M_RESTARTS.get((name, "operator")) == 1
+            # kill -9 the replica: the supervisor backfills it without
+            # anyone paging — the chaos drill's detection path
+            pid2 = pool.child_pid(name)
+            os.kill(pid2, 9)
+            assert wait_until(
+                lambda: pool.child_pid(name) not in (None, pid2),
+                timeout=15)
+            assert wait_until(lambda: pool._ready(
+                int(name.rsplit(":", 1)[1])), timeout=15)
+            assert _M_RESTARTS.get((name, "crash")) >= 1
+            snap = pool.snapshot()
+            assert snap[0]["name"] == name and snap[0]["restarts"] >= 2
+        finally:
+            pool.stop_all()
+
+
+# -- autoscaler decisions (fake router, no processes) --------------------------
+
+
+class FakeBreaker:
+    def __init__(self, state="closed"):
+        self.state = state
+
+
+class FakeReplica:
+    def __init__(self, name, state="ok"):
+        self.name = name
+        self.state = state
+        self.draining = False
+        self.inflight = 0
+        self.breaker = FakeBreaker()
+
+
+class FakeTsdb:
+    def __init__(self):
+        self.qps = 0.0
+        self.p99 = None  # seconds
+
+    def query(self, selector, window):
+        return ({'pio_router_requests_total{status="200"}': []}
+                if self.qps else {})
+
+    def rate(self, key, window):
+        return self.qps
+
+    def quantile(self, name, q, window, labels=None):
+        return self.p99
+
+
+class FakeSlo:
+    def __init__(self):
+        self.burning = []
+
+    def fast_burning(self):
+        return list(self.burning)
+
+
+class FakeRouter:
+    def __init__(self, n=1):
+        self.replicas = [FakeReplica(f"127.0.0.1:{9000 + i}")
+                         for i in range(n)]
+        self.tsdb = FakeTsdb()
+        self.slo = FakeSlo()
+
+
+class FakePool:
+    def __init__(self, router):
+        self.router = router
+
+    def size(self):
+        return len(self.router.replicas)
+
+    def add_replica(self):
+        name = f"127.0.0.1:{9000 + len(self.router.replicas)}"
+        self.router.replicas.append(FakeReplica(name))
+        return name
+
+    def remove_replica(self, name=None):
+        return self.router.replicas.pop().name
+
+
+class Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _scaler(n=1, **cfg_kw):
+    cfg_kw.setdefault("sustain_ticks", 3)
+    cfg_kw.setdefault("quiet_ticks", 2)
+    cfg_kw.setdefault("cooldown_up", 10.0)
+    cfg_kw.setdefault("cooldown_down", 60.0)
+    router = FakeRouter(n)
+    pool = FakePool(router)
+    clk = Clock()
+    a = Autoscaler(router, pool, AutoscaleConfig(**cfg_kw), clock=clk)
+    return a, router, pool, clk
+
+
+def _step(a):
+    """One synchronous control cycle: decide then apply."""
+    decision = a.tick()
+    a.act(decision)
+    return decision
+
+
+class TestAutoscalerDecisions:
+    def test_scale_up_needs_sustained_pressure(self):
+        a, router, pool, _ = _scaler(n=1)
+        router.tsdb.qps = 1000.0
+        assert _step(a)["reason"] == "sustaining"
+        assert _step(a)["reason"] == "sustaining"
+        d = _step(a)
+        assert d["action"] == "up" and d["reason"] == "qps"
+        assert pool.size() == 2
+
+    def test_single_spike_resets_the_sustain_counter(self):
+        a, router, pool, _ = _scaler(n=1)
+        router.tsdb.qps = 1000.0
+        _step(a)
+        _step(a)
+        router.tsdb.qps = 0.0          # pressure vanished
+        _step(a)
+        router.tsdb.qps = 1000.0       # back — but the count restarts
+        assert _step(a)["action"] == "hold"
+        assert pool.size() == 1
+
+    def test_slo_fast_burn_is_pressure(self):
+        a, router, pool, _ = _scaler(n=1, sustain_ticks=1)
+        router.slo.burning = ["availability"]
+        d = _step(a)
+        assert d["action"] == "up" and d["reason"] == "slo-burn"
+        assert pool.size() == 2
+
+    def test_p99_is_pressure(self):
+        a, router, _, _ = _scaler(n=1, sustain_ticks=1)
+        router.tsdb.qps = 20.0         # between thresholds on qps
+        router.tsdb.p99 = 0.9          # 900ms > up_p99_ms
+        assert _step(a)["reason"] == "p99"
+
+    def test_scale_down_when_quiet_then_floors(self):
+        a, router, pool, clk = _scaler(n=3, quiet_ticks=2,
+                                       cooldown_down=5.0)
+        _step(a)
+        d = _step(a)
+        assert d["action"] == "down" and d["reason"] == "quiet"
+        assert pool.size() == 2
+        clk.t += 10.0                  # past the down cooldown
+        _step(a)
+        d = _step(a)
+        assert d["action"] == "down"
+        assert pool.size() == 1
+        clk.t += 10.0
+        _step(a)
+        d = _step(a)
+        # the hard rule outranks min_replicas accounting
+        assert d["action"] == "hold" and d["reason"] == "last-healthy"
+        assert pool.size() == 1
+
+    def test_down_never_removes_last_HEALTHY_replica(self):
+        # two members, but only one can serve: scale-down must refuse
+        # even though size > min_replicas
+        a, router, pool, _ = _scaler(n=2, quiet_ticks=1)
+        router.replicas[0].state = "down"
+        d = _step(a)
+        assert d["action"] == "hold" and d["reason"] == "last-healthy"
+        assert pool.size() == 2
+
+    def test_cooldown_blocks_back_to_back_actions(self):
+        a, router, pool, clk = _scaler(n=1, sustain_ticks=1,
+                                       cooldown_up=30.0)
+        router.tsdb.qps = 1000.0
+        assert _step(a)["action"] == "up"
+        d = _step(a)
+        assert d["action"] == "hold" and d["reason"] == "cooldown"
+        clk.t += 31.0
+        assert _step(a)["action"] == "up"
+        assert pool.size() == 3
+
+    def test_at_max_holds(self):
+        a, router, pool, _ = _scaler(n=4, sustain_ticks=1, max_replicas=4)
+        router.tsdb.qps = 10_000.0
+        d = _step(a)
+        assert d["action"] == "hold" and d["reason"] == "at-max"
+        assert pool.size() == 4
+
+    def test_flap_fault_is_bounded_by_damping(self):
+        # the drill: autoscale.flap inverts the desire EVERY tick; only
+        # flap damping (and nothing about the thresholds) may bound
+        # membership churn
+        a, router, pool, clk = _scaler(
+            n=2, sustain_ticks=1, quiet_ticks=1, cooldown_up=0.0,
+            cooldown_down=0.0, flap_window=600.0, flap_max_actions=3)
+        FAULTS.arm("autoscale.flap", error="poisoned signal")
+        for i in range(12):
+            # alternate genuine pressure/quiet so the INVERTED desire
+            # alternates down/up — the worst-case oscillation
+            router.tsdb.qps = 1000.0 if i % 2 == 0 else 0.0
+            d = _step(a)
+            clk.t += 1.0
+            assert d["reason"] in ("fault:autoscale.flap", "flap-damped",
+                                   "last-healthy", "at-max", "at-min")
+        # at most flap_max_actions membership changes landed, then the
+        # fleet froze (damped) instead of oscillating forever
+        assert len(a._actions) <= 3
+        assert sum(1 for d in a.decisions
+                   if d["action"] != "hold") <= 3
+        assert sum(1 for d in a.decisions
+                   if d["reason"] == "flap-damped") >= 5
+
+    def test_decision_log_and_status_doc(self):
+        a, router, _, _ = _scaler(n=1)
+        _step(a)
+        doc = a.status_doc()
+        assert doc["config"]["maxReplicas"] == 4
+        assert doc["decisions"][-1]["action"] == "hold"
+        assert set(doc["decisions"][-1]["signals"]) >= {
+            "replicas", "healthy", "qps", "p99_ms", "inflight"}
+
+
+# -- remediation engine --------------------------------------------------------
+
+
+class FakeActuator:
+    """Records every verb call; refuses verification for targets that
+    look healthy (the wrong_target drill hands out 'healthy:9999')."""
+
+    def __init__(self):
+        self.calls = []
+
+    def verify(self, action, target):
+        if str(target).startswith("healthy"):
+            return False, f"replica {target} is ok — not wedged"
+        return True, ""
+
+    def wrong_target(self, action, target):
+        return "healthy:9999"
+
+    def restart_replica(self, target):
+        self.calls.append(("restart_replica", target))
+        return "restarted"
+
+    def rollback_model(self, target):
+        self.calls.append(("rollback_model", target))
+        return "rolled back"
+
+    def clamp_tenant(self, app, **kw):
+        self.calls.append(("clamp_tenant", app))
+        return "clamped"
+
+    def exclude_probe(self, target, **kw):
+        self.calls.append(("exclude_probe", target))
+        return "paused"
+
+
+def _findings():
+    return [
+        {"severity": 2, "kind": "breaker-open",
+         "replica": "http://127.0.0.1:8001",
+         "title": "replica 127.0.0.1:8001 breaker open",
+         "evidence": "x"},
+        {"severity": 1, "kind": "tenant-pressure", "app": "hog",
+         "title": "tenant hog shed", "evidence": "x"},
+        {"severity": 2, "kind": "probe-failing",
+         "title": "probe failing", "evidence": "x"},
+        {"severity": 1, "kind": "model-regression", "generation": 7,
+         "title": "suspect promotion", "evidence": "x"},
+        {"severity": 0, "kind": "exemplar", "title": "info only",
+         "evidence": "x"},
+        {"severity": 2, "kind": "no-playbook-for-this",
+         "title": "unmatched", "evidence": "x"},
+    ]
+
+
+class TestRemediationEngine:
+    def test_plan_maps_findings_to_playbooks(self):
+        eng = RemediationEngine(FakeActuator(), load_playbooks())
+        plan = eng.plan(_findings())
+        by_action = {e["action"]: e for e in plan}
+        assert by_action["restart_replica"]["target"] == "127.0.0.1:8001"
+        assert by_action["clamp_tenant"]["target"] == "hog"
+        assert by_action["exclude_probe"]["target"] == "probe"
+        assert by_action["rollback_model"]["target"] == "champion"
+        # severity-0 and unmatched kinds produce no entries
+        assert len(plan) == 4
+
+    def test_dry_run_by_default_executes_nothing(self):
+        act = FakeActuator()
+        eng = RemediationEngine(act, load_playbooks())
+        results = eng.execute(eng.plan(_findings()), yes=False)
+        assert results and all(r["result"] == "dry-run" for r in results)
+        assert act.calls == []
+
+    def test_yes_executes_through_verification(self):
+        act = FakeActuator()
+        eng = RemediationEngine(act, load_playbooks())
+        results = eng.execute(eng.plan(_findings()), yes=True)
+        assert {r["result"] for r in results} == {"executed"}
+        assert ("restart_replica", "127.0.0.1:8001") in act.calls
+
+    def test_wrong_target_drill_is_refused(self):
+        # remediate.wrong_target corrupts target selection into a
+        # HEALTHY replica; pre-action verification must refuse it
+        act = FakeActuator()
+        eng = RemediationEngine(act, load_playbooks())
+        FAULTS.arm("remediate.wrong_target", error="drill")
+        results = eng.execute(eng.plan(_findings()[:1]), yes=True)
+        assert results[0]["result"].startswith("refused")
+        assert results[0]["target"] == "healthy:9999"
+        assert act.calls == []
+
+    def test_per_playbook_rate_limit(self):
+        clk = Clock()
+        pb = Playbook(name="restart", action="restart_replica",
+                      kinds=("breaker-open",), rate_max=2,
+                      rate_window=600.0)
+        eng = RemediationEngine(FakeActuator(), [pb], clock=clk)
+        for i, expected in [(1, "executed"), (2, "executed"),
+                            (3, "rate-limited")]:
+            f = dict(_findings()[0],
+                     replica=f"http://127.0.0.1:800{i}")
+            assert eng.execute(eng.plan([f]), yes=True)[0][
+                "result"] == expected
+        clk.t += 601.0                 # window rolls off → budget back
+        f = dict(_findings()[0], replica="http://127.0.0.1:8009")
+        assert eng.execute(eng.plan([f]), yes=True)[0][
+            "result"] == "executed"
+
+    def test_auto_remediate_dedups_persistent_findings(self):
+        clk = Clock()
+        eng = RemediationEngine(FakeActuator(), load_playbooks(),
+                                clock=clk)
+        assert len(eng.auto_remediate(_findings()[:1])) == 1
+        # the same finding next tick: deduped, nothing executes
+        assert eng.auto_remediate(_findings()[:1]) == []
+
+    def test_storm_guard_holds_on_rate_limit(self):
+        # remediate.storm bypasses the dedup — every tick re-presents
+        # the finding as brand new; the rate limiter ALONE must bound
+        # the blast radius
+        clk = Clock()
+        pb = Playbook(name="restart", action="restart_replica",
+                      kinds=("breaker-open",), rate_max=1,
+                      rate_window=600.0)
+        act = FakeActuator()
+        eng = RemediationEngine(act, [pb], clock=clk)
+        FAULTS.arm("remediate.storm", error="storm drill")
+        executed = 0
+        for _ in range(6):
+            executed += sum(1 for r in eng.auto_remediate(_findings()[:1])
+                            if r["result"] == "executed")
+            clk.t += 1.0
+        assert executed == 1
+        assert len(act.calls) == 1
+
+    def test_one_remediation_in_flight_lock(self, tmp_path):
+        lock = str(tmp_path / "remediation.lock")
+        act = FakeActuator()
+        eng = RemediationEngine(act, load_playbooks(), lock_path=lock)
+        with open(lock, "w") as f:   # another actor holds the lock
+            f.write("12345")
+        results = eng.execute(eng.plan(_findings()[:1]), yes=True)
+        assert results[0]["result"] == "locked"
+        assert act.calls == []
+        os.unlink(lock)
+        results = eng.execute(eng.plan(_findings()[:1]), yes=True)
+        assert results[0]["result"] == "executed"
+        assert not os.path.exists(lock)   # released after the run
+
+    def test_load_playbooks_paths(self, tmp_path):
+        assert DEFAULT_PLAYBOOKS_PATH == os.path.join(
+            "conf", "remediations.json")
+        # repo conf file and built-ins agree on the contract
+        names = {p.name for p in load_playbooks(
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "conf", "remediations.json"))}
+        assert names == {p.name for p in load_playbooks()}
+        with pytest.raises(OSError):
+            load_playbooks(str(tmp_path / "missing.json"))
+        bad = tmp_path / "bad.json"
+        bad.write_text('{"playbooks": [{"name": "x", "action": "rm -rf"}]}')
+        with pytest.raises(ValueError):
+            load_playbooks(str(bad))
+
+    def test_finding_target_normalizes_replica_urls(self):
+        f = {"replica": "http://10.0.0.1:8000/"}
+        assert finding_target(f, "restart_replica") == "10.0.0.1:8000"
+        assert finding_target({}, "restart_replica") is None
+
+
+# -- eventsink redirect counter (ISSUE 19 satellite) ---------------------------
+
+
+class TestEventsinkRedirects:
+    def test_redirect_loop_exhausts_distinctly(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.server.eventsink import (
+            _M_REDIRECTS,
+            HTTPEventSink,
+            RedirectExhausted,
+        )
+
+        class Redirector(BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length") or 0))
+                self.send_response(307)
+                self.send_header("Location", self.path)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Redirector)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            sink = HTTPEventSink(
+                f"http://127.0.0.1:{srv.server_address[1]}", "key",
+                timeout=5.0, retries=0)
+            followed0 = _M_REDIRECTS.get(("followed",))
+            exhausted0 = _M_REDIRECTS.get(("exhausted",))
+            with pytest.raises(RedirectExhausted):
+                sink.send(Event(event="e", entity_type="u",
+                                entity_id="1"))
+            # every hop counted, then ONE distinct exhaustion — not a
+            # generic send failure
+            assert (_M_REDIRECTS.get(("followed",)) - followed0
+                    == HTTPEventSink.REDIRECT_HOPS)
+            assert _M_REDIRECTS.get(("exhausted",)) - exhausted0 == 1
+        finally:
+            srv.shutdown()
+
+
+# -- the chaos smoke: router + pool + auto-remediator --------------------------
+
+
+@pytest.mark.chaos
+class TestSelfHealingSmoke:
+    def test_wedged_replica_remediated_exactly_once(self, tmp_path):
+        """CI's failover-style drill (ISSUE 19 satellite): 2 subprocess
+        replicas under a real pool behind a real router, the
+        autoscaler's remediation loop running, ``router.replica.down``
+        armed so forwards fail while /health stays green (breakers
+        open → breaker-open findings). Exactly one restart remediation
+        fires; the dedup + rate limit hold the storm."""
+        from predictionio_tpu.server.router import FleetRouter
+        from tests.test_servers import ServerThread
+
+        remfile = tmp_path / "remediations.json"
+        remfile.write_text(json.dumps({"playbooks": [
+            {"name": "restart-wedged-replica",
+             "match": {"kinds": ["replica-down", "breaker-open"],
+                       "minSeverity": 1},
+             "action": "restart_replica",
+             "rateLimit": {"max": 1, "windowSec": 600}},
+        ]}))
+        pool = _pool(tmp_path)
+        router = None
+        try:
+            pool.add_replica()
+            pool.add_replica()
+            router = FleetRouter(
+                manifest=str(tmp_path / "manifest.txt"),
+                host="127.0.0.1", port=free_port(),
+                health_interval=0.1, scrape_interval=0.2,
+                probe_interval=0.0,
+                incident_dir=str(tmp_path / "incidents"),
+                pool=pool,
+                # min == max: membership is pinned, so the loop we are
+                # watching is remediation, not scaling
+                autoscale=AutoscaleConfig(
+                    min_replicas=2, max_replicas=2, interval=0.2,
+                    window=5.0),
+                remediations=str(remfile),
+            )
+            eng = router.remediator
+            executed = lambda: sum(  # noqa: E731
+                1 for e in eng.log if e["result"] == "executed")
+            with ServerThread(router):
+                base = f"http://127.0.0.1:{router.http.port}"
+                assert wait_until(lambda: all(
+                    r.state == "ok" for r in router.replicas), timeout=15)
+                FAULTS.arm("router.replica.down", error="wedged")
+                # traffic through the router trips the breakers (the
+                # fault hits the forward path, NOT the health polls)
+                from tests.test_router import http_full
+                for _ in range(12):
+                    http_full("POST", f"{base}/queries.json",
+                              {"user": "u", "num": 1}, timeout=10)
+                assert wait_until(lambda: any(
+                    r.breaker.state == "open" for r in router.replicas),
+                    timeout=15)
+                # the auto-remediator sees the wedged replica and fires
+                # the restart playbook — exactly once
+                assert wait_until(lambda: executed() >= 1, timeout=15)
+                time.sleep(1.5)   # several more control ticks
+                assert executed() == 1, (
+                    f"remediation storm: {list(eng.log)}")
+                # the non-executed attempts were bounded by the rate
+                # limit / dedup, never errors
+                assert all(e["result"] in ("executed", "rate-limited")
+                           for e in eng.log)
+                FAULTS.disarm("router.replica.down")
+                # the restarted replica comes back and the fleet heals
+                assert wait_until(lambda: all(
+                    r.state == "ok" for r in router.replicas), timeout=20)
+        finally:
+            pool.stop_all()

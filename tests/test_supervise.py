@@ -9,7 +9,11 @@ import time
 
 import pytest
 
-from predictionio_tpu.tools.supervise import Supervisor
+from predictionio_tpu.tools.supervise import (
+    _M_BACKOFF,
+    _M_RESTARTS,
+    Supervisor,
+)
 
 
 def _run_in_thread(sup):
@@ -109,6 +113,47 @@ class TestSupervisor:
         sup.stop()
         t.join(timeout=15)
         assert not pidfile.exists()  # removed on shutdown
+
+
+class TestSupervisorMetrics:
+    def test_crash_restarts_counted_by_reason(self, tmp_path):
+        """Every restart lands in ``pio_supervise_restarts_total`` with
+        its reason, and ``pio_supervise_backoff_seconds`` tracks the
+        current delay (zero again after the supervisor gives up)."""
+        sup = Supervisor(
+            [sys.executable, "-S", "-c", "raise SystemExit(3)"],
+            name="metrics-crash", max_restarts=2, restart_window=60.0,
+            backoff=0.05, backoff_max=0.05, log=lambda *a: None)
+        before = _M_RESTARTS.get(("metrics-crash", "crash"))
+        t, out = _run_in_thread(sup)
+        t.join(timeout=30)
+        assert out["code"] == 1
+        assert _M_RESTARTS.get(("metrics-crash", "crash")) - before == 2
+        assert _M_BACKOFF.get(("metrics-crash",)) == 0.0
+
+    def test_operator_restart_reason(self, tmp_path):
+        sup = Supervisor(
+            [sys.executable, "-S", "-c", "import time; time.sleep(60)"],
+            name="metrics-op", max_restarts=5, backoff=0.05,
+            backoff_max=0.05, log=lambda *a: None)
+        before = _M_RESTARTS.get(("metrics-op", "operator"))
+        t, out = _run_in_thread(sup)
+        deadline = time.time() + 10
+        while time.time() < deadline and sup.child_pid() is None:
+            time.sleep(0.05)
+        pid = sup.child_pid()
+        sup.request_restart()
+        deadline = time.time() + 15
+        while (time.time() < deadline
+               and sup.child_pid() in (None, pid)):
+            time.sleep(0.05)
+        assert sup.child_pid() not in (None, pid)
+        assert _M_RESTARTS.get(("metrics-op", "operator")) - before == 1
+        # operator restarts are free: no crash-budget charge, no backoff
+        assert sup._restart_times == []
+        assert sup.last_backoff == 0.0
+        sup.stop()
+        t.join(timeout=15)
 
 
 class TestNormalizeCommand:

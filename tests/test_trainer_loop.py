@@ -166,6 +166,93 @@ class TestTrainerLease:
             a.renew()
 
 
+class JumpyClock:
+    """Wall + monotonic clocks that normally tick together; the tests
+    jump the WALL alone — the failure mode NTP steps and VM migrations
+    inflict on a real trainer."""
+
+    def __init__(self):
+        self.wall = 1000.0
+        self.mono = 50.0
+
+    def clock(self):
+        return self.wall
+
+    def monotonic(self):
+        return self.mono
+
+    def sleep(self, seconds):
+        self.wall += seconds
+        self.mono += seconds
+
+    def tick(self, seconds):
+        self.wall += seconds
+        self.mono += seconds
+
+
+class TestTrainerLeaseWallJumps:
+    """The lease must neither self-expire on a forward wall jump nor
+    immortalize a dead holder on a backward one (ISSUE 19 satellite:
+    renewal/expiry cross-checked against monotonic observations)."""
+
+    def _pair(self, tmp_path, clk):
+        path = str(tmp_path / "t.lease")
+        a = TrainerLease(path, "a:1", ttl=30.0, clock=clk.clock,
+                         sleep=clk.sleep, mono=clk.monotonic)
+        b = TrainerLease(path, "b:2", ttl=30.0, clock=clk.clock,
+                         sleep=clk.sleep, mono=clk.monotonic)
+        return a, b
+
+    def test_forward_wall_jump_does_not_self_expire_live_lease(
+            self, tmp_path):
+        clk = JumpyClock()
+        a, b = self._pair(tmp_path, clk)
+        assert a.acquire()
+        assert not b.acquire()          # b starts watching the document
+        clk.tick(5.0)
+        a.renew()                       # heartbeat: beat++, doc changes
+        assert not b.acquire()          # b SEES the change land
+        clk.wall += 10_000.0            # forward jump: looks long-expired
+        # b watched a heartbeat < ttl of monotonic time ago — the
+        # holder is visibly alive, so the steal must be refused
+        assert not b.acquire()
+        a.renew()                       # and a still holds the lease
+        # once a genuinely stops heartbeating, monotonic staleness
+        # re-enables the steal: b observes the final heartbeat, then
+        # after ttl of byte-identical document it wins
+        assert not b.acquire()
+        clk.tick(31.0)
+        assert b.acquire() and b.token == 2
+        with pytest.raises(LeaseLost):
+            a.renew()
+
+    def test_backward_wall_jump_does_not_immortalize_dead_lease(
+            self, tmp_path):
+        clk = JumpyClock()
+        a, b = self._pair(tmp_path, clk)
+        assert a.acquire()
+        clk.wall -= 10_000.0            # backward jump: expires > wall
+        # forever — and a never heartbeats again (crashed holder)
+        assert not b.acquire()          # first sighting: wall says live
+        clk.tick(31.0)                  # document byte-identical >= ttl
+        assert b.acquire() and b.token == 2
+
+    def test_renewal_changes_document_every_beat(self, tmp_path):
+        clk = JumpyClock()
+        a, _ = self._pair(tmp_path, clk)
+        assert a.acquire()
+        with open(str(tmp_path / "t.lease")) as f:
+            before = f.read()
+        # a backward-stepped wall can hand two renewals the same
+        # expires value; the beat counter must still change the bytes
+        clk.wall -= 30.0
+        a.renew()
+        with open(str(tmp_path / "t.lease")) as f:
+            after = f.read()
+        assert before != after
+        assert json.loads(after)["beat"] == 1
+
+
 # -- registry ------------------------------------------------------------------
 
 
