@@ -121,6 +121,18 @@ class Algorithm(ABC, Generic[PD, M, Q, PR]):
         trace+compile+run cycles into one."""
         return [cls(p).train(ctx, prepared_data) for p in params_list]
 
+    @classmethod
+    def sweep_programs(cls, ctx: WorkflowContext, prepared_data: PD,
+                       params_list: Sequence[Any], qpa: Sequence[Any],
+                       metric: Any) -> Optional[List[Any]]:
+        """Distributed-sweep hook (``core/sweep.py``): return a list of
+        ``SweepProgram``s that together cover every candidate in
+        ``params_list`` — each a pure vmappable train+score fn over a
+        stacked hyperparameter axis, bucketed by compile geometry — or
+        None when this algorithm (or ``metric.sweep_kind``) can only run
+        on the serial qpa path. ``qpa`` is the fold's ``[(q, a), ...]``."""
+        return None
+
     # -- persistence (PersistentModel analogue) --------------------------------
 
     def save_model(self, model: M, instance_dir: Optional[str]) -> Optional[bytes]:

@@ -20,11 +20,34 @@ from predictionio_tpu.controller.base import WorkflowContext
 from predictionio_tpu.controller.engine import Engine, EngineParams
 
 
+def ranking_key(metric: "Metric", score: float) -> float:
+    """Ordering key shared by MetricEvaluator and core/sweep: NaN ranks
+    last (-inf, never poisons a max), otherwise sign-normalized so a
+    larger key is always better."""
+    if math.isnan(score):
+        return -math.inf
+    return score if metric.higher_is_better else -score
+
+
 class Metric(ABC):
     """Scores one evaluation run: ``[(eval_info, [(q, p, a), ...]), ...]``."""
 
     #: larger is better when True (reference: Metric.compare ordering)
     higher_is_better: bool = True
+
+    #: Name of the device-side statistic family this metric can consume
+    #: on the distributed sweep path (core/sweep.py), e.g. "accuracy"
+    #: or "sq_err"; the template's ``sweep_programs`` checks it to pick
+    #: (or refuse) a scoring program. None → serial path only.
+    sweep_kind: Optional[str] = None
+
+    def sweep_finalize(self, stat_sum: float, stat_count: float) -> float:
+        """Fold a device ``(stat_sum, stat_count)`` pair into this
+        metric's score. Default: the mean (the AverageMetric family);
+        zero count → NaN, matching the empty-scores serial convention."""
+        if stat_count <= 0:
+            return float("nan")
+        return float(stat_sum) / float(stat_count)
 
     @abstractmethod
     def calculate(
@@ -167,10 +190,7 @@ class MetricEvaluator:
         ctx.log(f"fast-eval cache: {cache.stats}")
 
         def key(i: int) -> float:
-            s = rows[i][1]
-            if math.isnan(s):
-                return -math.inf
-            return s if self.metric.higher_is_better else -s
+            return ranking_key(self.metric, rows[i][1])
 
         best_i = max(range(len(rows)), key=key)
         best = rows[best_i]

@@ -309,9 +309,20 @@ def run_evaluation(
     use_mesh: bool = True,
     evaluation_class: str = "",
     generator_class: str = "",
+    distributed: bool = False,
+    sweep_shards: int = 0,
 ) -> Tuple[str, MetricEvaluatorResult]:
     """Grid-search evaluation; persists an EvaluationInstance row the
-    dashboard renders (reference: EvaluationWorkflow, SURVEY.md §3.4)."""
+    dashboard renders (reference: EvaluationWorkflow, SURVEY.md §3.4)
+    plus a versioned ``leaderboard.json`` artifact next to it (the
+    promotion gate's input — storage/leaderboard.py).
+
+    ``distributed=True`` routes the grid through ``core/sweep.py``:
+    candidates bucketed by compile geometry, each bucket's sub-grid
+    one vmapped (and, with ``sweep_shards > 1``, shard_map'd) device
+    program instead of a per-candidate loop. Rankings are identical
+    to the serial path; groups the sweep can't stack fall back to it.
+    """
     from predictionio_tpu.utils import compilecache
 
     compilecache.enable()
@@ -326,8 +337,21 @@ def run_evaluation(
     storage.meta.insert_evaluation_instance(vi)
     ctx = _build_context(storage, None, verbose, instance_id, use_mesh)
     try:
-        result = evaluation.run(ctx, candidates)
-        assert evaluation.metric is not None
+        assert evaluation.metric is not None, "Evaluation.metric not set"
+        sweep_stats = None
+        fold_scores = None
+        if distributed:
+            from predictionio_tpu.core.sweep import run_sweep
+
+            sres = run_sweep(
+                ctx, evaluation.get_engine(), candidates,
+                evaluation.metric, evaluation.other_metrics,
+                sweep_shards=sweep_shards)
+            result = sres.result
+            sweep_stats = sres.stats()
+            fold_scores = sres.fold_scores
+        else:
+            result = evaluation.run(ctx, candidates)
         vi.status = "EVALCOMPLETED"
         vi.end_time = utcnow()
         vi.evaluator_results = (
@@ -335,9 +359,41 @@ def run_evaluation(
             f"(candidate {result.best_index} of {len(result.candidates)})")
         vi.evaluator_results_json = result.to_json()
         storage.meta.update_evaluation_instance(vi)
+        _write_leaderboard(storage, instance_id, evaluation.metric, result,
+                           fold_scores=fold_scores, sweep_stats=sweep_stats,
+                           distributed=distributed)
         return instance_id, result
-    except Exception:
+    except Exception as e:
         vi.status = "FAILED"
         vi.end_time = utcnow()
+        # record WHY: `pio evals show` must be able to explain a dead
+        # sweep without anyone grepping driver logs
+        vi.evaluator_results = f"{type(e).__name__}: {e}"
         storage.meta.update_evaluation_instance(vi)
         raise
+
+
+def _write_leaderboard(storage: Storage, instance_id: str, metric,
+                       result: MetricEvaluatorResult,
+                       fold_scores=None, sweep_stats=None,
+                       distributed: bool = False) -> Optional[str]:
+    """Persist the versioned leaderboard artifact for this evaluation
+    under ``<home>/leaderboards/<instance_id>.json``. Best-effort: a
+    leaderboard write failure must not fail a completed evaluation."""
+    import warnings
+
+    from predictionio_tpu.storage import leaderboard as lb
+
+    try:
+        ep_rows = json.loads(result.to_json())["candidates"]
+        doc = lb.build(
+            instance_id, metric.header, bool(metric.higher_is_better),
+            [row["engineParams"] for row in ep_rows],
+            [s for _, s, _ in result.candidates],
+            fold_scores=fold_scores,
+            mode="distributed" if distributed else "serial",
+            stats=sweep_stats)
+        return lb.write(storage.config.home, doc)
+    except Exception as e:  # pragma: no cover - defensive
+        warnings.warn(f"leaderboard write failed: {e}", RuntimeWarning)
+        return None

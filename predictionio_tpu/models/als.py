@@ -1075,6 +1075,92 @@ def _als_train_single(coo: RatingsCOO, p: ALSParams,
     return als_train_prepared(als_prepare(coo), p, device=device)
 
 
+@functools.lru_cache(maxsize=8)
+def als_train_scored(geom_u, geom_i, n_users: int, n_items: int,
+                     rank: int, iterations: int,
+                     implicit: bool, weighted_reg: bool,
+                     platform: Optional[str] = None,
+                     bf16_gather: bool = False,
+                     precision: str = "high",
+                     gram_mode: str = "off"):
+    """Pure vmappable train+score half of the distributed sweep
+    (core/sweep.py): ``one(hyper, u_bufs, i_bufs, V0p, uq, iq, rq,
+    valid) -> (sq_err_sum, valid_count)`` with ``hyper = [reg, alpha]``
+    a TRACED row of the stacked grid. The training body is EXACTLY
+    :func:`_compiled_bucketed`'s (same ``_make_half`` statics, same
+    iteration scan, same zero-U0 start), with the held-out fold scored
+    on-device: ``uq``/``iq`` index PERMUTED factor rows (callers map
+    through ``inv_perm`` on the host), ``valid`` masks cold pairs —
+    matching NegRMSE's skip-empty-prediction convention — so a
+    candidate with zero warm pairs returns count 0 (NaN downstream,
+    ranks last, never poisons the batch)."""
+    import jax
+    import jax.numpy as jnp
+
+    k = rank
+    half = _make_half(k, bool(implicit), bool(weighted_reg),
+                      platform=platform, bf16_gather=bf16_gather,
+                      precision=precision, gram_mode=gram_mode)
+
+    def one(hyper, u_bufs, i_bufs, V0p, uq, iq, rq, valid):
+        reg, alpha = hyper[0], hyper[1]
+
+        def step(carry, _):
+            U, V = carry
+            U = half(V, u_bufs, geom_u, reg, alpha)
+            V = half(U, i_bufs, geom_i, reg, alpha)
+            return (U, V), None
+
+        U0 = jnp.zeros((n_users, k), jnp.float32)
+        (U, V), _ = jax.lax.scan(step, (U0, V0p), None, length=iterations)
+        pred = (jnp.take(U, uq, axis=0) * jnp.take(V, iq, axis=0)).sum(-1)
+        err = jnp.where(valid, (pred - rq) ** 2, 0.0)
+        return err.sum(), valid.astype(jnp.float32).sum()
+
+    return one
+
+
+def als_sweep_program(prep: ALSPrepared, p0: ALSParams,
+                      users: np.ndarray, items: np.ndarray,
+                      ratings: np.ndarray, valid: np.ndarray,
+                      device=None):
+    """Assemble the ``(geometry, build, data)`` triple core/sweep.py's
+    SweepProgram wants for a bucket of ALS candidates sharing compile
+    geometry (rank/iterations/implicit/weighted_reg/seed + the prepared
+    layout). ``users``/``items`` are fold-local dense entity ids (cold
+    pairs carry any in-range id with ``valid`` False); they are mapped
+    to permuted factor positions HERE so the device program gathers
+    directly. Hyper rows are ``[reg, alpha]``."""
+    import jax
+
+    platform = (device.platform if device is not None
+                else jax.default_backend())
+    from predictionio_tpu import ops
+
+    gram_mode = ops.resolve_gram_mode(platform)
+    precision = _gram_precision()
+    geometry = ("als_scored", prep.u_side.geometry, prep.i_side.geometry,
+                prep.n_users, prep.n_items, int(p0.rank),
+                int(p0.iterations), bool(p0.implicit),
+                bool(p0.weighted_reg), platform, bool(p0.bf16_gather),
+                precision, gram_mode, int(p0.seed), len(users))
+    u_bufs, i_bufs = prep.device_buffers(device)
+    V0p = init_factors(prep.n_items, p0.rank, p0.seed)[prep.i_side.perm]
+    uq = prep.u_side.inv_perm[np.asarray(users, np.int64)].astype(np.int32)
+    iq = prep.i_side.inv_perm[np.asarray(items, np.int64)].astype(np.int32)
+    data = (u_bufs, i_bufs, V0p.astype(np.float32), uq, iq,
+            np.asarray(ratings, np.float32), np.asarray(valid, bool))
+
+    def build():
+        return als_train_scored(
+            prep.u_side.geometry, prep.i_side.geometry,
+            prep.n_users, prep.n_items, int(p0.rank), int(p0.iterations),
+            bool(p0.implicit), bool(p0.weighted_reg), platform,
+            bool(p0.bf16_gather), precision, gram_mode)
+
+    return geometry, build, data
+
+
 # -- scoring ------------------------------------------------------------------
 
 

@@ -200,6 +200,64 @@ def _compiled_logreg_many(iterations: int, use_lbfgs: bool):
                             in_axes=(0, 0, None, None, None, None)))
 
 
+def logreg_train_scored(num_classes: int, iterations: int, use_lbfgs: bool):
+    """Pure vmappable train+score half of the distributed sweep
+    (core/sweep.py): ``one(hyper, Xd, yd, Xe, ye) -> (correct, count)``
+    with ``hyper = [reg, learning_rate]`` a TRACED row of the stacked
+    grid. The loss is exactly :func:`_compiled_logreg_many`'s
+    ``train_one`` (the unmasked ``.mean()`` form the serial grid path
+    trains through), so per-candidate accuracies match the serial eval
+    to fp tolerance."""
+    import jax.numpy as jnp
+    import optax
+
+    C = num_classes
+
+    def one(hyper, Xd, yd, Xe, ye):
+        reg, lr = hyper[0], hyper[1]
+        d = Xd.shape[1]
+        W0 = jnp.zeros((d, C), jnp.float32)
+        b0 = jnp.zeros((C,), jnp.float32)
+
+        def loss_fn(wb):
+            W, b = wb
+            logits = Xd @ W + b
+            ll = optax.softmax_cross_entropy_with_integer_labels(
+                logits, yd).mean()
+            return ll + 0.5 * reg * (W * W).sum()
+
+        (W, b), _losses = _optimize(loss_fn, W0, b0, lr, iterations,
+                                    use_lbfgs)
+        pred = jnp.argmax(Xe @ W + b, axis=-1)
+        correct = (pred == ye).astype(jnp.float32).sum()
+        return correct, jnp.float32(ye.shape[0])
+
+    return one
+
+
+def logreg_sweep_program(X: np.ndarray, y: np.ndarray, Xe: np.ndarray,
+                         ye: np.ndarray, num_classes: int, iterations: int,
+                         optimizer: str = "lbfgs"):
+    """Assemble the ``(geometry, build, data)`` triple core/sweep.py's
+    SweepProgram wants for a bucket of logreg candidates sharing
+    (num_classes, iterations, optimizer). Hyper rows are
+    ``[reg, learning_rate]``."""
+    import optax
+
+    use_lbfgs = optimizer == "lbfgs" and hasattr(optax, "lbfgs")
+    geometry = ("logreg_scored", int(num_classes), int(X.shape[1]),
+                int(iterations), bool(use_lbfgs), tuple(X.shape),
+                tuple(Xe.shape))
+    data = (np.asarray(X, np.float32), np.asarray(y, np.int32),
+            np.asarray(Xe, np.float32), np.asarray(ye, np.int32))
+
+    def build():
+        return logreg_train_scored(int(num_classes), int(iterations),
+                                   use_lbfgs)
+
+    return geometry, build, data
+
+
 def logreg_predict(W: np.ndarray, b: np.ndarray, X: np.ndarray) -> np.ndarray:
     """Class indices for rows of X."""
     return np.argmax(X @ W + b, axis=-1)

@@ -55,6 +55,62 @@ def nb_train(
     return np.asarray(lp), np.asarray(lt)
 
 
+def nb_train_scored(num_classes: int, bernoulli: bool):
+    """Pure vmappable train+score half of the distributed sweep
+    (core/sweep.py): ``one(hyper, Xd, yd, Xe, ye) -> (correct, count)``
+    where ``hyper = [lambda_]`` is a TRACED row of the stacked grid —
+    smoothing appears only additively in the closed-form fit, so every
+    lambda in a bucket shares one compiled program. The fit body and
+    the bernoulli/multinomial scoring mirror :func:`nb_train` /
+    :func:`nb_predict` exactly (parity with the serial eval path)."""
+    import jax
+    import jax.numpy as jnp
+
+    C = num_classes
+
+    def one(hyper, Xd, yd, Xe, ye):
+        lam = hyper[0]
+        d = Xd.shape[1]
+        Xb = (Xd > 0).astype(jnp.float32) if bernoulli else Xd
+        Y = jax.nn.one_hot(yd, C, dtype=jnp.float32)
+        class_count = Y.sum(axis=0)
+        feat_sum = Y.T @ Xb
+        log_prior = jnp.log(class_count + lam) - jnp.log(
+            class_count.sum() + C * lam)
+        if bernoulli:
+            log_theta = (jnp.log(feat_sum + lam)
+                         - jnp.log(class_count[:, None] + 2.0 * lam))
+            theta = jnp.exp(log_theta)
+            log_neg = jnp.log1p(-jnp.clip(theta, 1e-12, 1 - 1e-12))
+            Xeb = (Xe > 0).astype(jnp.float32)
+            scores = Xeb @ log_theta.T + (1.0 - Xeb) @ log_neg.T + log_prior
+        else:
+            log_theta = (jnp.log(feat_sum + lam)
+                         - jnp.log(feat_sum.sum(axis=1, keepdims=True) + d * lam))
+            scores = Xe @ log_theta.T + log_prior
+        pred = jnp.argmax(scores, axis=-1)
+        correct = (pred == ye).astype(jnp.float32).sum()
+        return correct, jnp.float32(ye.shape[0])
+
+    return one
+
+
+def nb_sweep_program(X: np.ndarray, y: np.ndarray, Xe: np.ndarray,
+                     ye: np.ndarray, num_classes: int, bernoulli: bool):
+    """Assemble the ``(geometry, build, data)`` triple core/sweep.py's
+    SweepProgram wants for a bucket of NaiveBayes candidates sharing
+    (num_classes, model_type). Hyper rows are ``[lambda_]``."""
+    geometry = ("nb_scored", int(num_classes), int(X.shape[1]),
+                bool(bernoulli), tuple(X.shape), tuple(Xe.shape))
+    data = (np.asarray(X, np.float32), np.asarray(y, np.int32),
+            np.asarray(Xe, np.float32), np.asarray(ye, np.int32))
+
+    def build():
+        return nb_train_scored(int(num_classes), bool(bernoulli))
+
+    return geometry, build, data
+
+
 def nb_predict(log_prior: np.ndarray, log_theta: np.ndarray, X: np.ndarray,
                model_type: str = "multinomial") -> np.ndarray:
     if model_type == "bernoulli":

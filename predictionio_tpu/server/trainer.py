@@ -402,8 +402,13 @@ class TrainerConfig:
     guardrail_min_events: int = 10
     #: promotion gate mode: ``offline`` (held-out RMSE, the default),
     #: ``online`` (the challenger's accrued LIVE metrics scraped from
-    #: the fleet's ``pio_variant_online_rmse`` series), or ``both``
+    #: the fleet's ``pio_variant_online_rmse`` series), ``both``, or
+    #: ``eval`` (consult the latest persisted `pio eval` sweep
+    #: leaderboard and refuse candidates it ranked below the champion)
     gate: str = "offline"
+    #: eval gate: leaderboards older than this many seconds are stale
+    #: and the gate passes trivially (0 = never stale)
+    eval_leaderboard_max_age: float = 0.0
     #: online gate: the variant names of the incumbent and the arm
     #: whose accrued live RMSE is being judged
     online_champion: str = "champion"
@@ -752,10 +757,91 @@ class ContinuousTrainer:
                             f"limit {limit:.4f}")
         return False, detail
 
+    def _algo_params_of(self, instance_id: str) -> Optional[Any]:
+        ei = self.storage.meta.get_engine_instance(instance_id)
+        if ei is None or not ei.algorithms_params:
+            return None
+        try:
+            return json.loads(ei.algorithms_params)
+        except (TypeError, ValueError):
+            return None
+
+    def _guardrail_eval(self, candidate_id: str) -> Tuple[bool, Dict[str, Any]]:
+        """Sweep-leaderboard gate (``--gate eval``): the verdict comes
+        from the latest persisted `pio eval` leaderboard
+        (storage/leaderboard.py) instead of a fresh replay — the sweep
+        already scored the whole grid, so promotion just looks the
+        candidate's hyperparameters up. Refuses when the fresh sweep
+        ranked the candidate's params below the current champion's.
+        Trivial pass mirrors the other gates' unscoreable semantics:
+        no leaderboard, a stale one (``eval_leaderboard_max_age``), or
+        params the grid never swept."""
+        from predictionio_tpu.storage import leaderboard as lb
+
+        detail: Dict[str, Any] = {
+            "mode": "eval", "candidate": candidate_id,
+            "candidate_rank": None, "champion_rank": None,
+            "leaderboard": None}
+        regressed = False
+        try:
+            faults.inject("promote.regression")
+        except faults.FaultError:
+            regressed = True
+        if regressed:
+            detail["reason"] = "injected regression"
+            return False, detail
+        doc = lb.latest(self.storage.config.home)
+        if doc is None:
+            detail["reason"] = "no sweep leaderboard: pass"
+            return True, detail
+        detail["leaderboard"] = {
+            "instanceId": doc.get("instanceId"),
+            "metric": doc.get("metric"),
+            "digest": lb.digest(doc),
+        }
+        max_age = self.cfg.eval_leaderboard_max_age
+        if max_age > 0:
+            age = self.clock() - float(doc.get("createdAt") or 0.0)
+            detail["leaderboard"]["age"] = age
+            if age > max_age:
+                detail["reason"] = (f"leaderboard {age:.0f}s old "
+                                    f"(> {max_age:.0f}s): stale, pass")
+                return True, detail
+        cand_params = self._algo_params_of(candidate_id)
+        if cand_params is None:
+            detail["reason"] = "candidate params unavailable: pass"
+            return True, detail
+        cand_rank = lb.candidate_rank_for(doc, cand_params)
+        detail["candidate_rank"] = cand_rank
+        if cand_rank is None:
+            detail["reason"] = "candidate params not in swept grid: pass"
+            return True, detail
+        champ = self.registry.champion()
+        if champ is None:
+            detail["reason"] = "no champion: first generation promotes"
+            return True, detail
+        champ_params = self._algo_params_of(champ["instance_id"])
+        champ_rank = (lb.candidate_rank_for(doc, champ_params)
+                      if champ_params is not None else None)
+        detail["champion_rank"] = champ_rank
+        if champ_rank is None:
+            detail["reason"] = "champion params not in swept grid: pass"
+            return True, detail
+        if cand_rank <= champ_rank:
+            detail["reason"] = (f"sweep rank {cand_rank} <= champion "
+                                f"rank {champ_rank}")
+            return True, detail
+        detail["reason"] = (f"sweep rank {cand_rank} > champion "
+                            f"rank {champ_rank}")
+        return False, detail
+
     def _gate(self, candidate_id: str) -> Tuple[bool, Dict[str, Any]]:
         """The promotion gate: offline held-out guardrail (default),
-        the online live-metrics gate, or both (both must pass)."""
+        the online live-metrics gate, the sweep-leaderboard gate
+        (``eval``), or both offline+online (both must pass)."""
         mode = (self.cfg.gate or "offline").lower()
+        if mode == "eval":
+            return self._guardrail_eval(candidate_id)
         if mode == "online":
             return self._guardrail_online(candidate_id)
         if mode == "both":

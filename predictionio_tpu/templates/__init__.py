@@ -11,6 +11,7 @@ engine directory (``pio template new <name> <dir>``).
 TEMPLATES = {
     "recommendation": "predictionio_tpu.templates.recommendation.engine",
     "classification": "predictionio_tpu.templates.classification.engine",
+    "textclassification": "predictionio_tpu.templates.textclassification.engine",
     "similarproduct": "predictionio_tpu.templates.similarproduct.engine",
     "ecommercerecommendation": "predictionio_tpu.templates.ecommercerecommendation.engine",
     "universal": "predictionio_tpu.templates.universal.engine",

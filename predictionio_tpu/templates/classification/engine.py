@@ -115,6 +115,17 @@ class ClassificationModel:
                           np.float32)
 
 
+def _qa_features(attrs: List[str], qa) -> tuple:
+    """Held-out (query, label) pairs → the same feature rows
+    ``ClassificationModel.features`` builds at serve time (missing
+    attrs read 0.0), so device-side sweep scoring sees byte-identical
+    inputs to the serial predict path."""
+    Xe = np.asarray([[float(q.get(a, 0.0)) for a in attrs] for q, _ in qa],
+                    np.float32)
+    ye = np.asarray([int(float(a)) for _, a in qa], np.int32)
+    return Xe, ye
+
+
 @dataclass
 class NBAlgoParams:
     lambda_: float = 1.0
@@ -127,6 +138,32 @@ class NaiveBayesAlgorithm(Algorithm):
     def sanity_check(self, data: LabeledData) -> None:
         if len(data.y) == 0:
             raise ValueError("empty training data")
+
+    @classmethod
+    def sweep_programs(cls, ctx: WorkflowContext, pd: LabeledData,
+                       params_list, qa, metric):
+        """Distributed `pio eval`: the whole smoothing grid per
+        model_type is ONE vmapped closed-form fit+score (lambda enters
+        the fit only additively, so it stacks as a traced row)."""
+        if getattr(metric, "sweep_kind", None) != "accuracy":
+            return None
+        from predictionio_tpu.core.sweep import SweepProgram
+        from predictionio_tpu.models.naive_bayes import nb_sweep_program
+
+        Xe, ye = _qa_features(pd.attrs, qa)
+        num_classes = int(pd.y.max()) + 1
+        groups: Dict[str, List[int]] = {}
+        for i, p in enumerate(params_list):
+            groups.setdefault(p.model_type, []).append(i)
+        progs = []
+        for model_type, idxs in groups.items():
+            geometry, build, data = nb_sweep_program(
+                pd.X, pd.y, Xe, ye, num_classes,
+                model_type == "bernoulli")
+            hyper = np.asarray([[params_list[i].lambda_] for i in idxs],
+                               np.float32)
+            progs.append(SweepProgram(geometry, build, hyper, data, idxs))
+        return progs
 
     def train(self, ctx: WorkflowContext, pd: LabeledData) -> ClassificationModel:
         p: NBAlgoParams = self.params
@@ -193,6 +230,38 @@ class LogisticRegressionAlgorithm(Algorithm):
             mesh=ctx.mesh)
         return [ClassificationModel("lr", pd.attrs, W=W, b=b)
                 for W, b in wbs]
+
+    @classmethod
+    def sweep_programs(cls, ctx: WorkflowContext, pd: LabeledData,
+                       params_list, qa, metric):
+        """Distributed `pio eval`: candidates sharing (num_classes,
+        iterations, optimizer) geometry stack their reg values into
+        ONE vmapped train+score program — the same loss
+        ``logreg_train_many`` trains through on the serial path."""
+        if getattr(metric, "sweep_kind", None) != "accuracy":
+            return None
+        from predictionio_tpu.core.sweep import SweepProgram
+        from predictionio_tpu.models.linear import logreg_sweep_program
+
+        Xe, ye = _qa_features(pd.attrs, qa)
+        data_classes = int(pd.y.max()) + 1
+        groups: Dict[tuple, List[int]] = {}
+        for i, p in enumerate(params_list):
+            key = (max(int(p.num_classes), data_classes),
+                   int(p.iterations), p.optimizer)
+            groups.setdefault(key, []).append(i)
+        progs = []
+        for (C, iters, optname), idxs in groups.items():
+            geometry, build, data = logreg_sweep_program(
+                pd.X, pd.y, Xe, ye, C, iters, optname)
+            # hyper = [reg, learning_rate]; LRAlgoParams carries no lr —
+            # the serial path trains at LogisticRegressionParams'
+            # default, so the stacked rows pin the same value
+            lr = LogisticRegressionParams().learning_rate
+            hyper = np.asarray([[params_list[i].reg, lr] for i in idxs],
+                               np.float32)
+            progs.append(SweepProgram(geometry, build, hyper, data, idxs))
+        return progs
 
     def predict(self, model: ClassificationModel, query: Dict[str, Any]) -> Dict[str, Any]:
         label = logreg_predict(model.arrays["W"], model.arrays["b"],
@@ -267,6 +336,10 @@ def engine_factory() -> Engine:
 
 class Accuracy(AverageMetric):
     """Fraction of held-out rows labeled correctly."""
+
+    #: distributed sweeps accumulate (#correct, #rows) on device; the
+    #: base sweep_finalize (mean) folds them into the same fraction
+    sweep_kind = "accuracy"
 
     def calculate_one(self, query, predicted, actual) -> float:
         return 1.0 if float(predicted.get("label", float("nan"))) == \

@@ -331,6 +331,54 @@ class ALSAlgorithm(Algorithm):
             queries, fallback=lambda q: self.predict(model, q),
             per_query=lambda q: "item" in q)
 
+    @classmethod
+    def sweep_programs(cls, ctx: WorkflowContext, pd: TrainingData,
+                       params_list, qa, metric):
+        """Distributed `pio eval` (core/sweep.py): candidates sharing
+        (rank, iterations, implicit, seed, bf16) bucket into ONE
+        vmapped train+score program over stacked [lambda, alpha] rows
+        — the canonical regularization grid compiles once per rank.
+        Held-out pairs are mapped to the fold's dense ids here; cold
+        pairs (user/item unseen by the trained fold) get valid=False,
+        mirroring NegRMSE's skip-empty-prediction convention."""
+        if getattr(metric, "sweep_kind", None) != "sq_err":
+            return None
+        from predictionio_tpu.core.sweep import SweepProgram
+        from predictionio_tpu.models.als import als_prepare, als_sweep_program
+
+        coo, user_ids, item_ids = cls._to_coo(pd)
+        prep = als_prepare(coo)
+        n = len(qa)
+        users = np.zeros(n, np.int32)
+        items = np.zeros(n, np.int32)
+        ratings = np.zeros(n, np.float32)
+        valid = np.zeros(n, bool)
+        for j, (q, a) in enumerate(qa):
+            uidx = user_ids.get(str(q.get("user")))
+            iidx = (item_ids.get(str(q["item"])) if "item" in q else None)
+            if uidx is not None and iidx is not None:
+                users[j], items[j], valid[j] = uidx, iidx, True
+            ratings[j] = float(a)
+        device = (ctx.mesh.devices.flat[0] if ctx.mesh is not None
+                  else None)
+        groups: Dict[tuple, List[int]] = {}
+        for i, p in enumerate(params_list):
+            key = (int(p.rank), int(p.num_iterations),
+                   bool(p.implicit_prefs),
+                   0 if p.seed is None else int(p.seed),
+                   bool(p.bf16_gather))
+            groups.setdefault(key, []).append(i)
+        progs = []
+        for idxs in groups.values():
+            p0 = cls._als_params(params_list[idxs[0]])
+            geometry, build, data = als_sweep_program(
+                prep, p0, users, items, ratings, valid, device=device)
+            hyper = np.asarray(
+                [[params_list[i].lambda_, params_list[i].alpha]
+                 for i in idxs], np.float32)
+            progs.append(SweepProgram(geometry, build, hyper, data, idxs))
+        return progs
+
     def aot_warm(self, model: ALSModel, ladder, ks=(16,)):
         """Compile the gather→score→top-k serving executable for every
         (bucket, k) before traffic arrives (server/aot warmup contract);
@@ -377,6 +425,15 @@ class NegRMSE(Metric):
     are skipped, the OptionAverageMetric convention."""
 
     higher_is_better = True
+    #: distributed sweeps (core/sweep.py) accumulate (Σ sq_err, #warm)
+    #: on device; sweep_finalize folds them into the same -RMSE
+    sweep_kind = "sq_err"
+
+    def sweep_finalize(self, stat_sum: float, stat_count: float) -> float:
+        import math
+
+        return (-math.sqrt(stat_sum / stat_count) if stat_count > 0
+                else float("nan"))
 
     def calculate(self, ctx, eval_data):
         import math

@@ -535,6 +535,7 @@ def _run_continuous(args: argparse.Namespace, variant: Dict[str, Any],
         guardrail_max_regress=args.guardrail_max_regress,
         guardrail_min_events=args.guardrail_min_events,
         gate=args.gate,
+        eval_leaderboard_max_age=args.eval_leaderboard_max_age,
         online_champion=args.online_champion,
         online_challenger=args.online_challenger,
         online_min_pairs=args.online_min_pairs,
@@ -889,10 +890,63 @@ def cmd_index(args: argparse.Namespace) -> None:
               f"sha256 {str(ix.get('sha256'))[:12]}…")
 
 
+def _print_leaderboard(doc: dict, as_json: bool) -> None:
+    from predictionio_tpu.storage import leaderboard as lb
+
+    if as_json:
+        print(json.dumps(doc, indent=2))
+        return
+    print(f"[leaderboard] instance={doc.get('instanceId')} "
+          f"metric={doc.get('metric')} mode={doc.get('mode')} "
+          f"grid={doc.get('gridSize')} digest={lb.digest(doc)}")
+    if doc.get("mode") == "distributed":
+        print(f"[leaderboard] buckets={doc.get('buckets')} "
+              f"compiles={doc.get('compiles')} "
+              f"dispatches={doc.get('dispatches')} "
+              f"shards={doc.get('shards')} "
+              f"wall={doc.get('wallSeconds', 0):.3f}s "
+              f"device={doc.get('deviceSeconds', 0):.3f}s")
+    for e in doc.get("entries", []):
+        score = e.get("score")
+        folds = e.get("foldScores") or []
+        fold_s = (" folds=[" + ", ".join(
+            "nan" if s is None else f"{s:.4f}" for s in folds) + "]"
+            if folds else "")
+        algos = (e.get("engineParams") or {}).get("algorithmsParams") or []
+        algo_s = "; ".join(
+            f"{a.get('name')}:{json.dumps(a.get('params'), sort_keys=True, default=str)}"
+            for a in algos)
+        print(f"  #{e['rank']:<3} cand {e['index']:<3} "
+              f"score={'nan' if score is None else f'{score:.6f}'}"
+              f"{fold_s}  {algo_s}")
+
+
+def _eval_leaderboard(args: argparse.Namespace) -> None:
+    """`pio eval leaderboard [instance_id]` — inspect a persisted sweep
+    leaderboard. Pure artifact read (jax-free ops path): no jax import,
+    no engine code."""
+    from predictionio_tpu.storage import leaderboard as lb
+
+    home = get_storage().config.home
+    iid = args.engine_params_generator  # optional positional, reused
+    doc = lb.read(home, iid) if iid else lb.latest(home)
+    if doc is None:
+        _die("no leaderboard found"
+             + (f" for instance {iid}" if iid else
+                f" under {lb.leaderboard_dir(home)}; run `pio eval "
+                "--distributed` (or any eval) first"))
+    _print_leaderboard(doc, args.json)
+
+
 def cmd_eval(args: argparse.Namespace) -> None:
+    if args.evaluation == "leaderboard":
+        _eval_leaderboard(args)
+        return
     from predictionio_tpu.controller.evaluation import Evaluation, EngineParamsGenerator
     from predictionio_tpu.core.workflow import run_evaluation
 
+    if not args.engine_params_generator:
+        _die("pio eval needs an engine params generator (module:attr)")
     sys.path.insert(0, os.path.abspath(args.engine_dir))
     ev_obj = _resolve(args.evaluation)
     evaluation: Evaluation = ev_obj() if isinstance(ev_obj, type) else ev_obj
@@ -903,6 +957,8 @@ def cmd_eval(args: argparse.Namespace) -> None:
         verbose=args.verbose,
         evaluation_class=args.evaluation,
         generator_class=args.engine_params_generator,
+        distributed=args.distributed,
+        sweep_shards=args.sweep_shards,
     )
     print(f"[info] Evaluation completed: instance {instance_id}")
     metric = evaluation.metric
@@ -910,10 +966,78 @@ def cmd_eval(args: argparse.Namespace) -> None:
     for i, (_, score, _) in enumerate(result.candidates):
         mark = " *best*" if i == result.best_index else ""
         print(f"  candidate {i}: {metric.header} = {score:.6f}{mark}")
+    from predictionio_tpu.storage import leaderboard as lb
+
+    doc = lb.read(get_storage().config.home, instance_id)
+    if doc is not None:
+        _print_leaderboard(doc, args.json)
     if args.output:
         with open(args.output, "w", encoding="utf-8") as f:
             f.write(result.to_json())
         print(f"[info] wrote {args.output}")
+
+
+def cmd_evals(args: argparse.Namespace) -> None:
+    """Evaluation-instance inspection (jax-free ops path, like
+    `pio models`/`pio slo`): list past grid searches, explain a dead
+    one (the FAILED row carries the exception), surface leaderboards."""
+    from predictionio_tpu.storage import leaderboard as lb
+
+    st = get_storage()
+    home = st.config.home
+    if args.evals_cmd == "list":
+        rows = []
+        for vi in st.meta.list_evaluation_instances():
+            rows.append({
+                "id": vi.id,
+                "status": vi.status,
+                "evaluationClass": vi.evaluation_class,
+                "startTime": str(vi.start_time) if vi.start_time else None,
+                "endTime": str(vi.end_time) if vi.end_time else None,
+                "results": vi.evaluator_results or "",
+                "hasLeaderboard": os.path.exists(
+                    lb.leaderboard_path(home, vi.id)),
+            })
+        if args.json:
+            print(json.dumps({"evaluations": rows}, indent=2))
+            return
+        if not rows:
+            print("[evals] no evaluation instances")
+            return
+        for r in rows:
+            mark = " +leaderboard" if r["hasLeaderboard"] else ""
+            print(f"  {r['id']}  {r['status']:<14} "
+                  f"{r['evaluationClass']:<24} {r['results']}{mark}")
+        return
+    vi = st.meta.get_evaluation_instance(args.instance_id)
+    if vi is None:
+        _die(f"no evaluation instance {args.instance_id!r}")
+    doc = {
+        "id": vi.id,
+        "status": vi.status,
+        "evaluationClass": vi.evaluation_class,
+        "generatorClass": vi.engine_params_generator_class,
+        "startTime": str(vi.start_time) if vi.start_time else None,
+        "endTime": str(vi.end_time) if vi.end_time else None,
+        # EVALCOMPLETED: the best-candidate summary. FAILED: the
+        # recorded exception type/message — the whole point of the
+        # verb, a dead sweep explains itself here
+        "results": vi.evaluator_results or "",
+        "resultsJson": (json.loads(vi.evaluator_results_json)
+                        if vi.evaluator_results_json else None),
+        "leaderboard": lb.read(home, vi.id),
+    }
+    if args.json:
+        print(json.dumps(doc, indent=2, default=str))
+        return
+    print(f"[evals] {doc['id']}  status={doc['status']}")
+    print(f"[evals] class={doc['evaluationClass']} "
+          f"generator={doc['generatorClass'] or '-'}")
+    print(f"[evals] start={doc['startTime']} end={doc['endTime']}")
+    if doc["results"]:
+        print(f"[evals] results: {doc['results']}")
+    if doc["leaderboard"] is not None:
+        _print_leaderboard(doc["leaderboard"], False)
 
 
 def cmd_daemon(args: argparse.Namespace) -> None:
@@ -1698,14 +1822,21 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--guardrail-min-events", type=int, default=10,
                     help="below this many scoreable holdout pairs the "
                          "gate passes trivially")
-    tr.add_argument("--gate", choices=("offline", "online", "both"),
+    tr.add_argument("--gate", choices=("offline", "online", "both", "eval"),
                     default="offline",
                     help="promotion gate mode: 'offline' scores the "
                          "candidate on held-out feedback (default); "
                          "'online' judges the CHALLENGER arm's accrued "
                          "live metrics (pio_variant_online_rmse, fed by "
                          "real traffic on a --variants replica) against "
-                         "the champion's; 'both' requires both to pass")
+                         "the champion's; 'both' requires both to pass; "
+                         "'eval' consults the latest persisted `pio eval` "
+                         "sweep leaderboard and refuses candidates the "
+                         "sweep ranked below the current champion")
+    tr.add_argument("--eval-leaderboard-max-age", type=float, default=0.0,
+                    help="with --gate eval: leaderboards older than this "
+                         "many seconds are considered stale and the gate "
+                         "passes trivially (0 = never stale)")
     tr.add_argument("--online-challenger", default="challenger",
                     help="variant name whose accrued online RMSE the "
                          "online gate judges")
@@ -1902,12 +2033,38 @@ def build_parser() -> argparse.ArgumentParser:
     ud.set_defaults(fn=cmd_undeploy)
 
     ev = sub.add_parser("eval", help="hyperparameter evaluation (grid search)")
-    ev.add_argument("evaluation", help="module:attr of the Evaluation")
-    ev.add_argument("engine_params_generator", help="module:attr of the generator")
+    ev.add_argument("evaluation",
+                    help="module:attr of the Evaluation, or the literal "
+                         "'leaderboard' to inspect a persisted sweep "
+                         "leaderboard (no engine code loaded)")
+    ev.add_argument("engine_params_generator", nargs="?", default=None,
+                    help="module:attr of the generator (after "
+                         "'leaderboard': an optional evaluation instance "
+                         "id, default latest)")
     ev.add_argument("--engine-dir", default=".")
     ev.add_argument("-v", "--verbose", action="count", default=0)
     ev.add_argument("--output", help="write full results JSON here")
+    ev.add_argument("--distributed", action="store_true",
+                    help="run the grid as vmapped sweep programs: one "
+                         "compile per program geometry bucket instead of "
+                         "one train per candidate per fold")
+    ev.add_argument("--sweep-shards", type=int, default=0,
+                    help="additionally shard_map each vmapped sweep over "
+                         "this many devices (0 = single-device vmap)")
+    ev.add_argument("--json", action="store_true",
+                    help="print the leaderboard document as JSON")
     ev.set_defaults(fn=cmd_eval)
+
+    evs = sub.add_parser(
+        "evals", help="inspect past evaluation instances (jax-free)")
+    evsub = evs.add_subparsers(dest="evals_cmd", required=True)
+    evl = evsub.add_parser("list", help="list evaluation instances")
+    evl.add_argument("--json", action="store_true")
+    evw = evsub.add_parser(
+        "show", help="one instance: status, results/error, leaderboard")
+    evw.add_argument("instance_id")
+    evw.add_argument("--json", action="store_true")
+    evs.set_defaults(fn=cmd_evals)
 
     bp = sub.add_parser("batchpredict", help="bulk predictions from a JSONL file")
     bp.add_argument("--engine-dir", default=".")

@@ -1,0 +1,210 @@
+"""A/B harness for the distributed `pio eval` sweep (core/sweep.py).
+
+Runs the SAME candidate grid twice through ``run_evaluation`` — once
+serial (the reference's P4 loop: one train per candidate per fold, and
+for ALS one Python ``predict_rating`` call per held-out pair), once
+``distributed=True`` (every geometry bucket's sub-grid as ONE
+vmapped+jitted train+score program) — and emits one JSON proof line:
+grid size, geometry buckets, compile counts on both paths, wall-clock
+speedup, and the leaderboard digests, which must MATCH (identical
+ranking) for the run to count.
+
+Usage::
+
+    python profile_eval.py --template classification --grid 16
+    python profile_eval.py --template recommendation --grid 64
+    python profile_eval.py --template recommendation --grid 16 --shards 4
+
+``--shards N`` additionally shard_maps each vmapped program over N
+virtual CPU devices (the mesh axis the ISSUE's acceptance calls "when
+a mesh is up").
+
+Serial compile accounting: the serial path launches one jitted train
+program per (candidate, fold) — NB even re-traces per call because
+``nb_train`` builds a fresh closure — so ``programs_serial`` is
+``grid × folds``. The distributed path's ``compiles`` is counted by
+the sweep's own cache (``pio_eval_sweep_compiles_total``) and must be
+≤ ``buckets``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+from profile_common import force_host_devices, make_memory_storage
+
+FOLDS = 2
+
+
+def _seed_classification(st, n=240):
+    import numpy as np
+
+    from predictionio_tpu.data.event import Event
+
+    app = st.meta.create_app("ProfClsApp")
+    st.events.init_channel(app.id)
+    rng = np.random.default_rng(5)
+    evs = []
+    for i in range(n):
+        label = i % 2
+        base = [0.0, 0.0, 0.0] if label == 0 else [4.0, 4.0, 0.0]
+        feats = rng.normal(base, 0.4)
+        evs.append(Event(
+            event="$set", entity_type="user", entity_id=f"u{i}",
+            properties={"attr0": float(feats[0]), "attr1": float(feats[1]),
+                        "attr2": float(feats[2]), "label": label}))
+    st.events.insert_batch(evs, app.id)
+
+
+def _classification_grid(grid: int):
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.templates.classification.engine import (
+        ClsEvaluation,
+        DataSourceParams,
+        LRAlgoParams,
+        NBAlgoParams,
+    )
+
+    dsp = DataSourceParams(app_name="ProfClsApp", eval_k=FOLDS)
+    # one geometry class (multinomial NB smoothing sweep): the serial
+    # path re-traces nb_train per candidate per fold, the sweep
+    # compiles once per fold. LR/mixed grids are covered by tests; the
+    # speedup proof uses the shape a grid search actually has — many
+    # points along one knob.
+    cands = [EngineParams(dsp, None,
+                          [("naive", NBAlgoParams(lambda_=0.25 * (i + 1)))],
+                          None)
+             for i in range(grid)]
+    _ = LRAlgoParams  # imported for parity with tests' mixed grids
+    return ClsEvaluation(), cands
+
+
+def _seed_recommendation(st, n_users=150, n_items=80):
+    import numpy as np
+
+    from predictionio_tpu.data.event import Event
+
+    app = st.meta.create_app("ProfRecApp")
+    st.events.init_channel(app.id)
+    rng = np.random.default_rng(0)
+    evs = []
+    for u in range(n_users):
+        for i in range(n_items):
+            if rng.random() < 0.5:
+                r = 5.0 if (u % 2) == (i % 2) else 1.0
+                evs.append(Event(
+                    event="rate", entity_type="user", entity_id=str(u),
+                    target_entity_type="item", target_entity_id=str(i),
+                    properties={"rating": r}))
+    st.events.insert_batch(evs, app.id)
+
+
+def _recommendation_grid(grid: int):
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.templates.recommendation.engine import (
+        ALSAlgorithmParams,
+        DataSourceParams,
+        RecEvaluation,
+    )
+
+    dsp = DataSourceParams(app_name="ProfRecApp", eval_k=FOLDS)
+    # ≤16 points: a λ sweep at one rank (1 geometry bucket per fold);
+    # larger grids span 4 ranks to exercise multi-bucket accounting
+    # (the 64-point acceptance: compiles ≤ #geometry buckets)
+    ranks = (8,) if grid <= 16 else (2, 4, 8, 16)
+    per_rank = max(1, grid // len(ranks))
+    cands = []
+    for r in ranks:
+        for j in range(per_rank):
+            if len(cands) >= grid:
+                break
+            cands.append(EngineParams(
+                dsp, None,
+                [("als", ALSAlgorithmParams(
+                    rank=r, num_iterations=6, seed=3,
+                    lambda_=0.01 * (j + 1)))], None))
+    while len(cands) < grid:
+        cands.append(EngineParams(
+            dsp, None,
+            [("als", ALSAlgorithmParams(
+                rank=ranks[-1], num_iterations=6, seed=3,
+                lambda_=0.01 * (len(cands) + 1)))], None))
+    return RecEvaluation(), cands
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--template", default="classification",
+                    choices=("classification", "recommendation"))
+    ap.add_argument("--grid", type=int, default=16)
+    ap.add_argument("--shards", type=int, default=0)
+    args = ap.parse_args()
+
+    # before any jax import: virtual devices for --shards runs
+    force_host_devices(max(8, args.shards))
+    import os
+    import tempfile
+
+    os.environ.setdefault("PIO_MESH_PLATFORM", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    st = make_memory_storage()
+    st.config.home = tempfile.mkdtemp(prefix="pio_profile_eval_")
+
+    if args.template == "classification":
+        _seed_classification(st)
+        evaluation, cands = _classification_grid(args.grid)
+    else:
+        _seed_recommendation(st)
+        evaluation, cands = _recommendation_grid(args.grid)
+
+    from predictionio_tpu.core.workflow import run_evaluation
+    from predictionio_tpu.storage import leaderboard as lb
+
+    t0 = time.perf_counter()
+    iid_s, res_s = run_evaluation(evaluation, cands, storage=st,
+                                  use_mesh=False)
+    wall_serial = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    iid_d, res_d = run_evaluation(evaluation, cands, storage=st,
+                                  use_mesh=False, distributed=True,
+                                  sweep_shards=args.shards)
+    wall_dist = time.perf_counter() - t0
+
+    doc_s = lb.read(st.config.home, iid_s)
+    doc_d = lb.read(st.config.home, iid_d)
+    dig_s, dig_d = lb.digest(doc_s), lb.digest(doc_d)
+    proof = {
+        "harness": "profile_eval",
+        "template": args.template,
+        "grid": len(cands),
+        "folds": FOLDS,
+        "programs_serial": len(cands) * FOLDS,
+        "buckets": doc_d.get("buckets"),
+        "compiles_distributed": doc_d.get("compiles"),
+        "dispatches": doc_d.get("dispatches"),
+        "vmapped_candidates": doc_d.get("vmapped"),
+        "serial_fallback_candidates": doc_d.get("serial"),
+        "shards": args.shards,
+        "wall_serial_s": round(wall_serial, 3),
+        "wall_distributed_s": round(wall_dist, 3),
+        "speedup": round(wall_serial / wall_dist, 2) if wall_dist else None,
+        "digest_serial": dig_s,
+        "digest_distributed": dig_d,
+        "ranking_match": dig_s == dig_d,
+        "best_serial": res_s.best_index,
+        "best_distributed": res_d.best_index,
+    }
+    print(json.dumps(proof))
+    if not proof["ranking_match"]:
+        raise SystemExit("leaderboard digests differ: sweep is not "
+                         "parity with the serial path")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,610 @@
+"""Distributed `pio eval` sweep (core/sweep.py + storage/leaderboard.py).
+
+Covers the tentpole contract end to end: vmapped-vs-serial parity
+(identical rankings, scores within fp tolerance), the uneven tail
+bucket, a NaN-scoring candidate ranking last without poisoning the
+sweep, compiles ≤ geometry buckets, the persisted leaderboard
+artifact, the FAILED row recording the exception, the jax-free
+``pio evals`` verbs, and the trainer's ``--gate eval``.
+"""
+
+import datetime as dt
+import json
+import math
+import os
+import subprocess
+import sys
+from dataclasses import dataclass
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineParams,
+    Evaluation,
+    FirstServing,
+    IdentityPreparator,
+)
+from predictionio_tpu.controller.base import WorkflowContext
+from predictionio_tpu.controller.evaluation import Metric
+from predictionio_tpu.core.sweep import SweepProgram, run_sweep
+from predictionio_tpu.core.workflow import run_evaluation
+from predictionio_tpu.storage import leaderboard as lb
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- a transparent toy engine: model is y = scale * x --------------------------
+
+
+@dataclass
+class ToyDSParams:
+    n: int = 40
+    eval_k: int = 2
+
+
+@dataclass
+class ToyData:
+    x: np.ndarray
+    y: np.ndarray
+
+
+class ToyDS(DataSource):
+    ParamsClass = ToyDSParams
+
+    def _all(self):
+        rng = np.random.default_rng(7)
+        x = rng.normal(1.0, 0.5, self.params.n).astype(np.float32)
+        y = (3.0 * x).astype(np.float32)
+        return x, y
+
+    def read_training(self, ctx):
+        return ToyData(*self._all())
+
+    def read_eval(self, ctx):
+        x, y = self._all()
+        folds = []
+        k = self.params.eval_k
+        for f in range(k):
+            tr = np.arange(len(x)) % k != f
+            te = np.nonzero(~tr)[0]
+            qa = [({"x": float(x[j])}, float(y[j])) for j in te]
+            folds.append((ToyData(x[tr], y[tr]), {"fold": f}, qa))
+        return folds
+
+
+@dataclass
+class ToyParams:
+    scale: float = 1.0
+
+
+class ToyAlgo(Algorithm):
+    ParamsClass = ToyParams
+
+    def train(self, ctx, pd):
+        return {"scale": float(self.params.scale)}
+
+    @classmethod
+    def sweep_programs(cls, ctx, pd, params_list, qa, metric):
+        if getattr(metric, "sweep_kind", None) != "sq_err":
+            return None
+        import jax.numpy as jnp
+
+        xe = np.asarray([q["x"] for q, _ in qa], np.float32)
+        ye = np.asarray([a for _, a in qa], np.float32)
+
+        def build():
+            def one(hyper, xe, ye):
+                err = hyper[0] * xe - ye
+                return (err * err).sum(), jnp.asarray(
+                    xe.shape[0], jnp.float32)
+            return one
+
+        hyper = np.asarray([[p.scale] for p in params_list], np.float32)
+        return [SweepProgram(("toy", xe.shape), build, hyper,
+                             (xe, ye), list(range(len(params_list))))]
+
+    def predict(self, model, query):
+        return {"y": model["scale"] * query["x"]}
+
+
+class PlainAlgo(ToyAlgo):
+    """Same model, but NO usable sweep program — forces the serial
+    fallback for its whole group (the mixed-grid path)."""
+
+    @classmethod
+    def sweep_programs(cls, ctx, pd, params_list, qa, metric):
+        return None
+
+
+class ToyNegRMSE(Metric):
+    sweep_kind = "sq_err"
+
+    def calculate(self, ctx, eval_data):
+        errs = [(p["y"] - a) ** 2
+                for _, qpa in eval_data for q, p, a in qpa]
+        return (-math.sqrt(sum(errs) / len(errs)) if errs
+                else float("nan"))
+
+    def sweep_finalize(self, stat_sum, stat_count):
+        if stat_count <= 0:
+            return float("nan")
+        return -math.sqrt(stat_sum / stat_count)
+
+    @property
+    def header(self):
+        return "ToyNegRMSE"
+
+
+def toy_factory():
+    return Engine(data_source_cls=ToyDS,
+                  preparator_cls=IdentityPreparator,
+                  algorithm_cls_map={"toy": ToyAlgo, "plain": PlainAlgo},
+                  serving_cls=FirstServing)
+
+
+class ToyEvaluation(Evaluation):
+    engine_factory = staticmethod(toy_factory)
+    metric = ToyNegRMSE()
+
+
+def _toy_candidates(scales, algo="toy"):
+    return [EngineParams(ToyDSParams(), None,
+                         [(algo, ToyParams(scale=s))], None)
+            for s in scales]
+
+
+def _ctx(storage):
+    return WorkflowContext(storage=storage, mesh=None, verbose=0)
+
+
+class TestToySweep:
+    def test_parity_uneven_tail(self, storage):
+        """5 candidates pad to the next ladder width (8): the sweep's
+        scores and ranking must equal the serial path's exactly."""
+        scales = [0.5, 1.0, 2.0, 3.0, 4.0]
+        sres = run_sweep(_ctx(storage), toy_factory(),
+                         _toy_candidates(scales), ToyNegRMSE())
+        assert sres.vmapped == 5 and sres.serial == 0
+        assert sres.compiles <= sres.buckets <= 2  # one per fold
+        iid, serial = run_evaluation(ToyEvaluation(),
+                                     _toy_candidates(scales),
+                                     storage=storage, use_mesh=False)
+        for (_, ss, _), (_, ds, _) in zip(serial.candidates,
+                                          sres.result.candidates):
+            assert ds == pytest.approx(ss, abs=1e-5)
+        assert sres.result.best_index == serial.best_index == 3
+
+    def test_nan_candidate_ranks_last(self, storage, tmp_path):
+        """A candidate whose program yields NaN must lose to every
+        finite candidate on BOTH paths — and not poison the others."""
+        storage.config.home = str(tmp_path)
+        scales = [3.0, float("nan"), 1.0]
+        iid_d, res_d = run_evaluation(
+            ToyEvaluation(), _toy_candidates(scales), storage=storage,
+            use_mesh=False, distributed=True)
+        iid_s, res_s = run_evaluation(
+            ToyEvaluation(), _toy_candidates(scales), storage=storage,
+            use_mesh=False)
+        for res in (res_d, res_s):
+            assert res.best_index == 0
+            assert math.isnan(res.candidates[1][1])
+            assert not math.isnan(res.candidates[2][1])
+        doc = lb.read(str(tmp_path), iid_d)
+        by_index = {e["index"]: e for e in doc["entries"]}
+        assert by_index[1]["rank"] == 2 and by_index[1]["score"] is None
+        assert lb.digest(doc) == lb.digest(lb.read(str(tmp_path), iid_s))
+
+    def test_mixed_grid_serial_fallback(self, storage):
+        """toy (sweepable) + plain (sweep_programs → None) in one grid:
+        the plain group falls back to eval_batch; scores still match
+        the all-serial run."""
+        cands = _toy_candidates([1.0, 3.0]) + \
+            _toy_candidates([1.0, 3.0], algo="plain")
+        sres = run_sweep(_ctx(storage), toy_factory(), cands, ToyNegRMSE())
+        assert sres.vmapped == 2 and sres.serial == 2
+        _, serial = run_evaluation(ToyEvaluation(), cands,
+                                   storage=storage, use_mesh=False)
+        for (_, ss, _), (_, ds, _) in zip(serial.candidates,
+                                          sres.result.candidates):
+            assert ds == pytest.approx(ss, abs=1e-5)
+
+    def test_sweep_shards(self, storage):
+        """shard_map over the 8 virtual CPU devices: same scores."""
+        scales = [0.5, 1.0, 2.0, 3.0]
+        base = run_sweep(_ctx(storage), toy_factory(),
+                         _toy_candidates(scales), ToyNegRMSE())
+        sh = run_sweep(_ctx(storage), toy_factory(),
+                       _toy_candidates(scales), ToyNegRMSE(),
+                       sweep_shards=4)
+        assert sh.shards == 4
+        for (_, bs, _), (_, ss, _) in zip(base.result.candidates,
+                                          sh.result.candidates):
+            assert ss == pytest.approx(bs, abs=1e-5)
+
+
+# -- real templates through the sweep ------------------------------------------
+
+
+def seed_classification(storage, app_name="SweepClsApp"):
+    from predictionio_tpu.data.event import Event
+
+    app = storage.meta.create_app(app_name)
+    storage.events.init_channel(app.id)
+    rng = np.random.default_rng(5)
+    evs = []
+    for i in range(120):
+        label = i % 2
+        base = [0.0, 0.0, 0.0] if label == 0 else [4.0, 4.0, 0.0]
+        feats = rng.normal(base, 0.4)
+        evs.append(Event(
+            event="$set", entity_type="user", entity_id=f"u{i}",
+            properties={"attr0": float(feats[0]),
+                        "attr1": float(feats[1]),
+                        "attr2": float(feats[2]), "label": label}))
+    storage.events.insert_batch(evs, app.id)
+
+
+class TestClassificationSweep:
+    def test_eight_point_grid_parity_and_compiles(self, storage, tmp_path):
+        """The CI smoke: an 8-point NB/LR grid — compiles ≤ buckets,
+        identical ranking to the serial path, leaderboard persisted."""
+        from predictionio_tpu.templates.classification.engine import (
+            ClsEvaluation,
+            DataSourceParams,
+            LRAlgoParams,
+            NBAlgoParams,
+        )
+
+        storage.config.home = str(tmp_path)
+        seed_classification(storage)
+        dsp = DataSourceParams(app_name="SweepClsApp", eval_k=2)
+        cands = [EngineParams(dsp, None,
+                              [("naive", NBAlgoParams(lambda_=l))], None)
+                 for l in (0.25, 0.5, 1.0, 2.0, 4.0, 8.0)]
+        cands += [EngineParams(dsp, None,
+                               [("lr", LRAlgoParams(reg=r, iterations=40))],
+                               None)
+                  for r in (0.0, 0.01)]
+        iid_s, res_s = run_evaluation(ClsEvaluation(), cands,
+                                      storage=storage, use_mesh=False)
+        iid_d, res_d = run_evaluation(ClsEvaluation(), cands,
+                                      storage=storage, use_mesh=False,
+                                      distributed=True)
+        for (_, ss, _), (_, ds) in zip(
+                res_s.candidates,
+                [(c[0], c[1]) for c in res_d.candidates]):
+            assert ds == pytest.approx(ss, abs=1e-5)
+        doc = lb.read(str(tmp_path), iid_d)
+        assert doc["mode"] == "distributed"
+        assert doc["compiles"] <= doc["buckets"]
+        assert doc["vmapped"] == len(cands) and doc["serial"] == 0
+        assert lb.digest(doc) == lb.digest(lb.read(str(tmp_path), iid_s))
+        vi = storage.meta.get_evaluation_instance(iid_d)
+        assert vi.status == "EVALCOMPLETED"
+
+
+class TestTextClassificationTemplate:
+    def _seed(self, storage):
+        from predictionio_tpu.data.event import Event
+
+        app = storage.meta.create_app("TxtApp")
+        storage.events.init_channel(app.id)
+        pos = ["great movie loved it", "wonderful acting superb plot",
+               "amazing fantastic film", "loved the cast great script"]
+        neg = ["terrible movie hated it", "awful acting boring plot",
+               "dreadful bad film", "hated the cast awful script"]
+        evs = []
+        for i in range(40):
+            lab = i % 2
+            text = (pos if lab else neg)[i % 4] + f" tok{i}"
+            evs.append(Event(event="$set", entity_type="doc",
+                             entity_id=f"d{i}",
+                             properties={"text": text, "label": lab}))
+        storage.events.insert_batch(evs, app.id)
+
+    def test_hash_features_deterministic(self):
+        from predictionio_tpu.templates.textclassification.engine import (
+            HashingConfig,
+            hash_features,
+        )
+
+        cfg = HashingConfig(hash_bits=8, ngrams=2)
+        a = hash_features(["the quick brown fox"], cfg)
+        b = hash_features(["the quick brown fox"], cfg)
+        assert a.shape == (1, 256)
+        np.testing.assert_array_equal(a, b)
+        assert a.sum() == 7.0  # 4 unigrams + 3 bigrams
+
+    def test_registered_in_gallery(self):
+        from predictionio_tpu.templates import TEMPLATES
+
+        assert TEMPLATES["textclassification"] == \
+            "predictionio_tpu.templates.textclassification.engine"
+        eng_json = os.path.join(
+            REPO, "predictionio_tpu", "templates", "textclassification",
+            "engine.json")
+        spec = json.load(open(eng_json))
+        assert "textclassification" in spec["engineFactory"]
+
+    def test_sweep_parity(self, storage, tmp_path):
+        from predictionio_tpu.templates.textclassification.engine import (
+            TextDataSourceParams,
+            TextEvaluation,
+            TextLRParams,
+            TextNBParams,
+        )
+
+        storage.config.home = str(tmp_path)
+        self._seed(storage)
+        ds = TextDataSourceParams(app_name="TxtApp", eval_k=2,
+                                  hash_bits=9)
+        cands = [EngineParams(ds, None,
+                              [("naive", TextNBParams(lambda_=l))], None)
+                 for l in (0.25, 1.0)]
+        cands += [EngineParams(ds, None,
+                               [("lr", TextLRParams(iterations=40,
+                                                    reg=r))], None)
+                  for r in (0.0, 0.01)]
+        iid_s, res_s = run_evaluation(TextEvaluation(), cands,
+                                      storage=storage, use_mesh=False)
+        iid_d, res_d = run_evaluation(TextEvaluation(), cands,
+                                      storage=storage, use_mesh=False,
+                                      distributed=True)
+        for (_, ss, _), (_, ds_, _) in zip(res_s.candidates,
+                                           res_d.candidates):
+            assert ds_ == pytest.approx(ss, abs=1e-5)
+        assert lb.digest(lb.read(str(tmp_path), iid_s)) == \
+            lb.digest(lb.read(str(tmp_path), iid_d))
+
+
+# -- leaderboard artifact ------------------------------------------------------
+
+
+class TestLeaderboard:
+    def test_rank_and_digest(self):
+        scores = [0.5, float("nan"), 0.9, 0.9]
+        ranks = lb.rank_candidates(scores, True)
+        # ties keep candidate order (max() first-argmax), NaN last
+        assert ranks == [2, 3, 0, 1]
+        assert lb.rank_candidates([-1.0, -2.0], False) == [1, 0]
+        eps = [{"algorithmsParams": [{"name": "a", "params": {"k": i}}]}
+               for i in range(4)]
+        doc = lb.build("i1", "M", True, eps, scores)
+        doc2 = lb.build("i2", "M", True, eps, scores)
+        assert lb.digest(doc) == lb.digest(doc2)  # timing-independent
+        assert doc["entries"][0]["index"] == 2
+        assert lb.candidate_rank_for(
+            doc, [{"name": "a", "params": {"k": 2}}]) == 0
+        assert lb.candidate_rank_for(
+            doc, [{"name": "a", "params": {"k": 99}}]) is None
+
+    def test_write_read_latest(self, tmp_path):
+        home = str(tmp_path)
+        eps = [{"algorithmsParams": []}]
+        d1 = lb.build("a", "M", True, eps, [0.1])
+        d1["createdAt"] = 100.0
+        d2 = lb.build("b", "M", True, eps, [0.2])
+        d2["createdAt"] = 200.0
+        lb.write(home, d1)
+        lb.write(home, d2)
+        assert lb.read(home, "a")["instanceId"] == "a"
+        assert lb.read(home, "missing") is None
+        assert lb.latest(home)["instanceId"] == "b"
+
+    def test_run_evaluation_persists(self, storage, tmp_path):
+        storage.config.home = str(tmp_path)
+        iid, _ = run_evaluation(ToyEvaluation(),
+                                _toy_candidates([1.0, 3.0]),
+                                storage=storage, use_mesh=False,
+                                distributed=True)
+        doc = lb.read(str(tmp_path), iid)
+        assert doc["version"] == lb.LEADERBOARD_VERSION
+        assert doc["instanceId"] == iid
+        assert doc["metric"] == "ToyNegRMSE"
+        assert doc["gridSize"] == 2
+        assert len(doc["entries"][0]["foldScores"]) == 2
+        assert doc["entries"][0]["engineParams"]["algorithmsParams"][0][
+            "name"] == "toy"
+
+
+# -- satellite: FAILED rows explain themselves ---------------------------------
+
+
+class BoomDS(ToyDS):
+    def read_eval(self, ctx):
+        raise ValueError("boom: no such app")
+
+
+def boom_factory():
+    return Engine(data_source_cls=BoomDS,
+                  preparator_cls=IdentityPreparator,
+                  algorithm_cls_map={"toy": ToyAlgo},
+                  serving_cls=FirstServing)
+
+
+class BoomEvaluation(Evaluation):
+    engine_factory = staticmethod(boom_factory)
+    metric = ToyNegRMSE()
+
+
+class TestFailedRecordsError:
+    @pytest.mark.parametrize("distributed", [False, True])
+    def test_error_text_recorded(self, storage, distributed):
+        with pytest.raises(ValueError):
+            run_evaluation(BoomEvaluation(), _toy_candidates([1.0]),
+                           storage=storage, use_mesh=False,
+                           distributed=distributed)
+        rows = storage.meta.list_evaluation_instances()
+        vi = rows[0] if rows[0].status == "FAILED" else rows[-1]
+        assert vi.status == "FAILED"
+        assert "ValueError" in vi.evaluator_results
+        assert "boom: no such app" in vi.evaluator_results
+
+
+# -- satellite: jax-free `pio evals` / `pio eval leaderboard` ------------------
+
+
+class TestEvalsCliJaxFree:
+    def test_evals_verbs_survive_poisoned_jax(self, tmp_path):
+        """`pio evals list/show` and `pio eval leaderboard` run on ops
+        boxes without jax — poison the import and drive the real CLI."""
+        code = (
+            "import sys, os, json, datetime as dt\n"
+            "sys.modules['jax'] = None  # poison: any import explodes\n"
+            "from predictionio_tpu.tools import cli\n"
+            "from predictionio_tpu.storage.registry import get_storage\n"
+            "from predictionio_tpu.storage.meta import EvaluationInstance\n"
+            "from predictionio_tpu.storage import leaderboard as lb\n"
+            "st = get_storage()\n"
+            "iid = st.meta.new_instance_id()\n"
+            "now = dt.datetime.now(dt.timezone.utc)\n"
+            "st.meta.insert_evaluation_instance(EvaluationInstance(\n"
+            "    id=iid, status='FAILED', start_time=now, end_time=now,\n"
+            "    evaluation_class='my.Ev',\n"
+            "    engine_params_generator_class='my.Grid', batch='',\n"
+            "    env={}, evaluator_results='ValueError: boom',\n"
+            "    evaluator_results_html='', evaluator_results_json=''))\n"
+            "doc = lb.build(iid, 'M', True,\n"
+            "               [{'algorithmsParams': []}], [0.5])\n"
+            "lb.write(st.config.home, doc)\n"
+            "for argv in (['pio', 'evals', 'list', '--json'],\n"
+            "             ['pio', 'evals', 'show', iid, '--json'],\n"
+            "             ['pio', 'eval', 'leaderboard', '--json']):\n"
+            "    sys.argv = argv\n"
+            "    cli.main()\n"
+            "print('JAXFREE_OK', sys.modules['jax'] is None)\n"
+        )
+        env = dict(os.environ, PIO_HOME=str(tmp_path))
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             cwd=REPO, env=env)
+        assert out.returncode == 0, out.stderr
+        assert "JAXFREE_OK True" in out.stdout
+        assert "ValueError: boom" in out.stdout
+
+
+# -- satellite: trainer --gate eval --------------------------------------------
+
+
+class TestTrainerEvalGate:
+    def _trainer(self, storage, tmp_path, **cfg_kw):
+        from predictionio_tpu.server.trainer import (
+            ContinuousTrainer,
+            TrainerConfig,
+        )
+
+        storage.config.home = str(tmp_path)
+        cfg = TrainerConfig(engine_factory="f", app_name="App",
+                            gate="eval", **cfg_kw)
+        return ContinuousTrainer(cfg, storage=storage,
+                                 clock=lambda: 1000.0,
+                                 sleep=lambda s: None)
+
+    def _engine_instance(self, storage, iid, lam):
+        from predictionio_tpu.storage.meta import EngineInstance
+
+        now = dt.datetime.now(dt.timezone.utc)
+        storage.meta.insert_engine_instance(EngineInstance(
+            id=iid, status="COMPLETED", start_time=now, end_time=now,
+            engine_factory="f", engine_variant="default", batch="",
+            env={}, mesh_conf={}, data_source_params="{}",
+            preparator_params="{}",
+            algorithms_params=json.dumps(
+                [{"name": "als", "params": {"lambda_": lam}}]),
+            serving_params="{}"))
+
+    def _leaderboard(self, home, lams, scores, created=900.0):
+        eps = [{"algorithmsParams":
+                [{"name": "als", "params": {"lambda_": l}}]}
+               for l in lams]
+        doc = lb.build("ev1", "NegRMSE", True, eps, scores,
+                       mode="distributed")
+        doc["createdAt"] = created
+        lb.write(home, doc)
+
+    def test_refuses_lower_ranked_candidate(self, storage, tmp_path):
+        tr = self._trainer(storage, tmp_path)
+        self._engine_instance(storage, "cand", 0.5)
+        self._engine_instance(storage, "champ", 0.1)
+        self._leaderboard(str(tmp_path), [0.1, 0.5], [0.9, 0.2])
+        tr.registry.champion = lambda: {"instance_id": "champ"}
+        ok, detail = tr._gate("cand")
+        assert not ok
+        assert detail["candidate_rank"] == 1
+        assert detail["champion_rank"] == 0
+        assert "sweep rank 1 > champion rank 0" in detail["reason"]
+
+    def test_promotes_better_ranked_candidate(self, storage, tmp_path):
+        tr = self._trainer(storage, tmp_path)
+        self._engine_instance(storage, "cand", 0.1)
+        self._engine_instance(storage, "champ", 0.5)
+        self._leaderboard(str(tmp_path), [0.1, 0.5], [0.9, 0.2])
+        tr.registry.champion = lambda: {"instance_id": "champ"}
+        ok, detail = tr._guardrail_eval("cand")
+        assert ok and detail["candidate_rank"] == 0
+
+    def test_trivial_passes(self, storage, tmp_path):
+        tr = self._trainer(storage, tmp_path)
+        # no leaderboard at all
+        ok, detail = tr._guardrail_eval("cand")
+        assert ok and "no sweep leaderboard" in detail["reason"]
+        # candidate params the grid never swept
+        self._engine_instance(storage, "cand", 9.9)
+        self._leaderboard(str(tmp_path), [0.1, 0.5], [0.9, 0.2])
+        ok, detail = tr._guardrail_eval("cand")
+        assert ok and "not in swept grid" in detail["reason"]
+        # no champion → first generation promotes
+        self._engine_instance(storage, "cand2", 0.5)
+        tr.registry.champion = lambda: None
+        ok, detail = tr._guardrail_eval("cand2")
+        assert ok and "no champion" in detail["reason"]
+
+    def test_stale_leaderboard_passes(self, storage, tmp_path):
+        tr = self._trainer(storage, tmp_path,
+                           eval_leaderboard_max_age=50.0)
+        self._engine_instance(storage, "cand", 0.5)
+        self._engine_instance(storage, "champ", 0.1)
+        # clock=1000, createdAt=900 → 100s old > 50s max age
+        self._leaderboard(str(tmp_path), [0.1, 0.5], [0.9, 0.2],
+                          created=900.0)
+        tr.registry.champion = lambda: {"instance_id": "champ"}
+        ok, detail = tr._guardrail_eval("cand")
+        assert ok and "stale" in detail["reason"]
+
+    def test_injected_regression_refused(self, storage, tmp_path):
+        from predictionio_tpu.utils import faults
+
+        tr = self._trainer(storage, tmp_path)
+        faults.FAULTS.arm("promote.regression", error="regressed")
+        try:
+            ok, detail = tr._guardrail_eval("cand")
+            assert not ok and "injected regression" in detail["reason"]
+        finally:
+            faults.FAULTS.disarm("promote.regression")
+
+
+class TestCliFlags:
+    def test_eval_parser_flags(self):
+        from predictionio_tpu.tools.cli import build_parser
+
+        p = build_parser()
+        a = p.parse_args(["eval", "mod:Ev", "mod:Grid",
+                          "--distributed", "--sweep-shards", "4"])
+        assert a.distributed and a.sweep_shards == 4
+        a = p.parse_args(["eval", "leaderboard"])
+        assert a.engine_params_generator is None
+        a = p.parse_args(["evals", "list", "--json"])
+        assert a.evals_cmd == "list" and a.json
+        a = p.parse_args(["train", "--continuous", "--gate", "eval",
+                          "--eval-leaderboard-max-age", "60"])
+        assert a.gate == "eval"
+        assert a.eval_leaderboard_max_age == 60.0
+
+    def test_evals_is_not_a_jax_verb(self):
+        from predictionio_tpu.tools.cli import _JAX_VERBS
+
+        assert "evals" not in _JAX_VERBS
